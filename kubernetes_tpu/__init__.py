@@ -1,0 +1,32 @@
+"""kubernetes_tpu — a TPU-native pod-scheduling framework.
+
+A brand-new scheduling framework with the capabilities of the Kubernetes
+kube-scheduler (reference: /root/reference, pkg/scheduler), re-designed
+TPU-first:
+
+- Host (Python + C-extension hot paths) owns API ingestion (list/watch),
+  the three-tier pending queue with queueing hints, the authoritative
+  generation-tracked cluster cache, preemption orchestration, binding I/O,
+  metrics and config.
+- Device (JAX/XLA on TPU) owns the per-cycle math: Filter predicates,
+  Score, normalization, weighted aggregation and masked argmax over a dense
+  ``nodes x features`` tensor resident in HBM, with pending pods batched
+  along a second axis so one XLA launch schedules a whole batch
+  (as-if-serial semantics via a lax.scan commit loop).
+
+Layer map (mirrors SURVEY.md section 1, scheduler-internal layering):
+
+    kubernetes_tpu.api        — object model (Pod/Node/...), quantities, labels
+    kubernetes_tpu.utils      — interner, clock, misc
+    kubernetes_tpu.backend    — cache, snapshot, node_tree, queue, heap, mirror
+    kubernetes_tpu.framework  — extension points, CycleState, runtime, registry
+    kubernetes_tpu.plugins    — in-tree plugins (device kernels + host logic)
+    kubernetes_tpu.ops        — the JAX kernels behind the device plugins
+    kubernetes_tpu.models     — the flagship batched scheduling pipeline
+    kubernetes_tpu.parallel   — mesh/sharding for the node axis (ICI scale-out)
+    kubernetes_tpu.config     — SchedulerConfiguration types/defaults/validation
+    kubernetes_tpu.scheduler  — the Scheduler: event handlers + scheduling loop
+    kubernetes_tpu.hub        — in-process API hub (list/watch/bind) for tests+bench
+"""
+
+__version__ = "0.1.0"

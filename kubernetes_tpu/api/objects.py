@@ -1,0 +1,373 @@
+"""The scheduler-facing object model.
+
+A from-scratch, Python-native equivalent of the slice of ``k8s.io/api/core/v1``
+the kube-scheduler consumes (Pod, Node, affinity/taint/spread types) plus
+``scheduling.k8s.io/v1`` PriorityClass. Field coverage follows what the
+reference scheduler's plugins actually read (see SURVEY.md section 2.4);
+reference type definitions live in
+/root/reference/staging/src/k8s.io/api/core/v1/types.go.
+
+Objects are plain mutable dataclasses; the hub/cache layers treat stored
+objects as immutable and replace them wholesale on update (copy-on-write via
+``clone()``), which is what makes the generation-diffed device mirror sound.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+# --- well-known constants -------------------------------------------------
+
+DEFAULT_SCHEDULER_NAME = "default-scheduler"
+
+# taint effects
+NO_SCHEDULE = "NoSchedule"
+PREFER_NO_SCHEDULE = "PreferNoSchedule"
+NO_EXECUTE = "NoExecute"
+
+# selector / toleration operators
+OP_IN = "In"
+OP_NOT_IN = "NotIn"
+OP_EXISTS = "Exists"
+OP_DOES_NOT_EXIST = "DoesNotExist"
+OP_GT = "Gt"
+OP_LT = "Lt"
+OP_EQUAL = "Equal"
+
+# topology spread UnsatisfiableConstraintAction
+DO_NOT_SCHEDULE = "DoNotSchedule"
+SCHEDULE_ANYWAY = "ScheduleAnyway"
+
+# NodeInclusionPolicy
+POLICY_HONOR = "Honor"
+POLICY_IGNORE = "Ignore"
+
+# well-known topology label keys
+LABEL_HOSTNAME = "kubernetes.io/hostname"
+LABEL_ZONE = "topology.kubernetes.io/zone"
+LABEL_REGION = "topology.kubernetes.io/region"
+
+# pod phases
+PHASE_PENDING = "Pending"
+PHASE_RUNNING = "Running"
+PHASE_SUCCEEDED = "Succeeded"
+PHASE_FAILED = "Failed"
+
+# pod condition types
+POD_SCHEDULED = "PodScheduled"
+
+_uid_counter = itertools.count(1)
+
+
+def new_uid() -> str:
+    return f"uid-{next(_uid_counter)}"
+
+
+# --- metadata ---------------------------------------------------------------
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = field(default_factory=new_uid)
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    creation_timestamp: float = field(default_factory=time.time)
+    resource_version: int = 0
+    deletion_timestamp: Optional[float] = None
+
+
+# --- label selectors (metav1.LabelSelector) ---------------------------------
+
+
+@dataclass
+class LabelSelectorRequirement:
+    key: str
+    operator: str  # In / NotIn / Exists / DoesNotExist
+    values: list[str] = field(default_factory=list)
+
+
+@dataclass
+class LabelSelector:
+    match_labels: dict[str, str] = field(default_factory=dict)
+    match_expressions: list[LabelSelectorRequirement] = field(default_factory=list)
+
+
+# --- node selectors (v1.NodeSelector) ---------------------------------------
+
+
+@dataclass
+class NodeSelectorRequirement:
+    key: str
+    operator: str  # In / NotIn / Exists / DoesNotExist / Gt / Lt
+    values: list[str] = field(default_factory=list)
+
+
+@dataclass
+class NodeSelectorTerm:
+    match_expressions: list[NodeSelectorRequirement] = field(default_factory=list)
+    match_fields: list[NodeSelectorRequirement] = field(default_factory=list)
+
+
+@dataclass
+class NodeSelector:
+    node_selector_terms: list[NodeSelectorTerm] = field(default_factory=list)
+
+
+@dataclass
+class PreferredSchedulingTerm:
+    weight: int
+    preference: NodeSelectorTerm
+
+
+@dataclass
+class NodeAffinity:
+    required: Optional[NodeSelector] = None  # requiredDuringSchedulingIgnoredDuringExecution
+    preferred: list[PreferredSchedulingTerm] = field(default_factory=list)
+
+
+# --- pod (anti)affinity ------------------------------------------------------
+
+
+@dataclass
+class PodAffinityTerm:
+    topology_key: str
+    label_selector: Optional[LabelSelector] = None
+    namespaces: list[str] = field(default_factory=list)
+    namespace_selector: Optional[LabelSelector] = None
+    match_label_keys: list[str] = field(default_factory=list)
+    mismatch_label_keys: list[str] = field(default_factory=list)
+
+
+@dataclass
+class WeightedPodAffinityTerm:
+    weight: int
+    pod_affinity_term: PodAffinityTerm
+
+
+@dataclass
+class PodAffinity:
+    required: list[PodAffinityTerm] = field(default_factory=list)
+    preferred: list[WeightedPodAffinityTerm] = field(default_factory=list)
+
+
+@dataclass
+class PodAntiAffinity:
+    required: list[PodAffinityTerm] = field(default_factory=list)
+    preferred: list[WeightedPodAffinityTerm] = field(default_factory=list)
+
+
+@dataclass
+class Affinity:
+    node_affinity: Optional[NodeAffinity] = None
+    pod_affinity: Optional[PodAffinity] = None
+    pod_anti_affinity: Optional[PodAntiAffinity] = None
+
+
+# --- taints & tolerations -----------------------------------------------------
+
+
+@dataclass
+class Taint:
+    key: str
+    effect: str  # NoSchedule / PreferNoSchedule / NoExecute
+    value: str = ""
+
+
+@dataclass
+class Toleration:
+    key: str = ""  # empty + Exists tolerates everything
+    operator: str = OP_EQUAL  # Exists / Equal
+    value: str = ""
+    effect: str = ""  # empty matches all effects
+    toleration_seconds: Optional[int] = None
+
+    def tolerates(self, taint: Taint) -> bool:
+        """Reference: k8s.io/api/core/v1/toleration.go ToleratesTaint."""
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key and self.key != taint.key:
+            return False
+        if self.operator == OP_EXISTS:
+            return True
+        # Equal (or empty operator, which defaults to Equal)
+        return self.value == taint.value
+
+
+# --- topology spread ----------------------------------------------------------
+
+
+@dataclass
+class TopologySpreadConstraint:
+    max_skew: int
+    topology_key: str
+    when_unsatisfiable: str  # DoNotSchedule / ScheduleAnyway
+    label_selector: Optional[LabelSelector] = None
+    min_domains: Optional[int] = None
+    node_affinity_policy: str = POLICY_HONOR
+    node_taints_policy: str = POLICY_IGNORE
+    match_label_keys: list[str] = field(default_factory=list)
+
+
+# --- containers & resources -----------------------------------------------------
+
+
+@dataclass
+class ResourceRequirements:
+    requests: dict[str, str] = field(default_factory=dict)
+    limits: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ContainerPort:
+    host_port: int = 0
+    container_port: int = 0
+    protocol: str = "TCP"
+    host_ip: str = ""
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    ports: list[ContainerPort] = field(default_factory=list)
+    restart_policy: Optional[str] = None  # "Always" on an init container = sidecar
+
+
+# --- pod ------------------------------------------------------------------------
+
+
+@dataclass
+class PodSchedulingGate:
+    name: str
+
+
+@dataclass
+class PodSpec:
+    node_name: str = ""
+    scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    containers: list[Container] = field(default_factory=list)
+    init_containers: list[Container] = field(default_factory=list)
+    overhead: dict[str, str] = field(default_factory=dict)
+    node_selector: dict[str, str] = field(default_factory=dict)
+    affinity: Optional[Affinity] = None
+    tolerations: list[Toleration] = field(default_factory=list)
+    topology_spread_constraints: list[TopologySpreadConstraint] = field(default_factory=list)
+    priority: Optional[int] = None
+    priority_class_name: str = ""
+    preemption_policy: str = "PreemptLowerPriority"  # or "Never"
+    scheduling_gates: list[PodSchedulingGate] = field(default_factory=list)
+    host_network: bool = False
+    volumes: list = field(default_factory=list)  # volume plugins: round 2
+
+
+@dataclass
+class PodCondition:
+    type: str
+    status: str  # "True" / "False" / "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = 0.0
+
+
+@dataclass
+class PodStatus:
+    phase: str = PHASE_PENDING
+    conditions: list[PodCondition] = field(default_factory=list)
+    nominated_node_name: str = ""
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.uid
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def priority(self) -> int:
+        return self.spec.priority if self.spec.priority is not None else 0
+
+    def clone(self) -> "Pod":
+        """Copy safe for *assigning* top-level metadata/spec/status fields (the
+        only mutations the scheduler performs: nodeName, conditions,
+        nominatedNodeName, labels). Deeper structures (containers, affinity,
+        tolerations...) are shared and must never be mutated in place."""
+        return replace(
+            self,
+            metadata=replace(self.metadata, labels=dict(self.metadata.labels)),
+            spec=replace(self.spec),
+            status=replace(self.status, conditions=list(self.status.conditions)),
+        )
+
+
+# --- node -------------------------------------------------------------------------
+
+
+@dataclass
+class ContainerImage:
+    names: list[str] = field(default_factory=list)
+    size_bytes: int = 0
+
+
+@dataclass
+class NodeSpec:
+    unschedulable: bool = False
+    taints: list[Taint] = field(default_factory=list)
+
+
+@dataclass
+class NodeStatus:
+    capacity: dict[str, str] = field(default_factory=dict)
+    allocatable: dict[str, str] = field(default_factory=dict)
+    images: list[ContainerImage] = field(default_factory=list)
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def clone(self) -> "Node":
+        """Same contract as Pod.clone(): top-level field assignment only;
+        nested structures shared, never mutated in place."""
+        return replace(
+            self,
+            metadata=replace(self.metadata, labels=dict(self.metadata.labels)),
+            spec=replace(self.spec, taints=list(self.spec.taints)),
+            status=replace(self.status),
+        )
+
+
+# --- priority class ------------------------------------------------------------------
+
+
+@dataclass
+class PriorityClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    value: int = 0
+    global_default: bool = False
+    preemption_policy: str = "PreemptLowerPriority"

@@ -1,0 +1,13 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU platform so all
+sharding/mesh tests run without TPU hardware (the driver separately
+dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_COMPILATION_CACHE", "true")

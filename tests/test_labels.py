@@ -1,0 +1,121 @@
+from kubernetes_tpu.api.labels import (
+    find_untolerated_taint,
+    label_selector_matches,
+    node_selector_matches,
+    node_selector_term_matches,
+    pod_matches_node_selector_and_affinity,
+)
+from kubernetes_tpu.api.objects import (
+    Affinity,
+    LabelSelector,
+    LabelSelectorRequirement,
+    Node,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    Taint,
+    Toleration,
+)
+
+
+def node(name="n1", labels=None):
+    return Node(metadata=ObjectMeta(name=name, labels=labels or {}))
+
+
+def test_label_selector():
+    assert label_selector_matches(LabelSelector(), {"a": "b"})  # empty matches all
+    assert not label_selector_matches(None, {"a": "b"})  # nil matches nothing
+    sel = LabelSelector(match_labels={"app": "web"})
+    assert label_selector_matches(sel, {"app": "web", "x": "y"})
+    assert not label_selector_matches(sel, {"app": "db"})
+    sel = LabelSelector(match_expressions=[
+        LabelSelectorRequirement("tier", "In", ["fe", "be"]),
+        LabelSelectorRequirement("canary", "DoesNotExist"),
+    ])
+    assert label_selector_matches(sel, {"tier": "fe"})
+    assert not label_selector_matches(sel, {"tier": "fe", "canary": "1"})
+    assert not label_selector_matches(sel, {"tier": "db"})
+
+
+def test_node_selector_ops():
+    n = node(labels={"zone": "us-1a", "cpus": "32"})
+    term = NodeSelectorTerm(match_expressions=[
+        NodeSelectorRequirement("zone", "In", ["us-1a", "us-1b"])])
+    assert node_selector_term_matches(term, n)
+    term = NodeSelectorTerm(match_expressions=[
+        NodeSelectorRequirement("cpus", "Gt", ["16"])])
+    assert node_selector_term_matches(term, n)
+    term = NodeSelectorTerm(match_expressions=[
+        NodeSelectorRequirement("cpus", "Lt", ["16"])])
+    assert not node_selector_term_matches(term, n)
+    # Gt with non-integer label: no match
+    term = NodeSelectorTerm(match_expressions=[
+        NodeSelectorRequirement("zone", "Gt", ["16"])])
+    assert not node_selector_term_matches(term, n)
+    # empty term matches nothing
+    assert not node_selector_term_matches(NodeSelectorTerm(), n)
+
+
+def test_match_fields():
+    n = node(name="special")
+    term = NodeSelectorTerm(match_fields=[
+        NodeSelectorRequirement("metadata.name", "In", ["special"])])
+    assert node_selector_term_matches(term, n)
+    assert not node_selector_term_matches(term, node(name="other"))
+
+
+def test_node_selector_or_terms():
+    n = node(labels={"a": "1"})
+    sel = NodeSelector(node_selector_terms=[
+        NodeSelectorTerm(match_expressions=[NodeSelectorRequirement("b", "Exists")]),
+        NodeSelectorTerm(match_expressions=[NodeSelectorRequirement("a", "In", ["1"])]),
+    ])
+    assert node_selector_matches(sel, n)
+    assert node_selector_matches(None, n)  # nil matches everything
+
+
+def test_pod_node_selector_and_affinity():
+    n = node(labels={"disk": "ssd"})
+    pod = Pod(spec=PodSpec(node_selector={"disk": "ssd"}))
+    assert pod_matches_node_selector_and_affinity(pod, n)
+    pod = Pod(spec=PodSpec(node_selector={"disk": "hdd"}))
+    assert not pod_matches_node_selector_and_affinity(pod, n)
+    pod = Pod(spec=PodSpec(affinity=Affinity(node_affinity=NodeAffinity(
+        required=NodeSelector(node_selector_terms=[
+            NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement("disk", "In", ["ssd"])])])))))
+    assert pod_matches_node_selector_and_affinity(pod, n)
+
+
+def test_tolerations():
+    taints = [Taint(key="gpu", value="true", effect="NoSchedule")]
+    assert find_untolerated_taint(taints, []) is not None
+    assert find_untolerated_taint(
+        taints, [Toleration(key="gpu", operator="Equal", value="true",
+                            effect="NoSchedule")]) is None
+    assert find_untolerated_taint(
+        taints, [Toleration(key="gpu", operator="Exists")]) is None
+    assert find_untolerated_taint(taints, [Toleration(operator="Exists")]) is None
+    # PreferNoSchedule taints never fail the filter
+    soft = [Taint(key="x", effect="PreferNoSchedule")]
+    assert find_untolerated_taint(soft, []) is None
+
+
+def test_interner():
+    from kubernetes_tpu.utils.interner import NONE, Interner
+
+    it = Interner()
+    a = it.intern("app")
+    b = it.intern("web")
+    assert it.intern("app") == a != b
+    assert it.string(a) == "app"
+    assert it.lookup("nope") == NONE
+    n = it.intern("42")
+    assert it.numeric(n) == 42.0
+    import math
+    assert math.isnan(it.numeric(a))
+    assert it.string(0) == ""

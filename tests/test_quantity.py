@@ -1,0 +1,28 @@
+from kubernetes_tpu.utils.quantity import parse_bytes, parse_cpu_milli, parse_int
+
+
+def test_cpu_milli():
+    assert parse_cpu_milli("100m") == 100
+    assert parse_cpu_milli("2") == 2000
+    assert parse_cpu_milli("0.5") == 500
+    assert parse_cpu_milli("1500m") == 1500
+    assert parse_cpu_milli(4) == 4000
+    # rounds up
+    assert parse_cpu_milli("1m") == 1
+    assert parse_cpu_milli("0.0001") == 1
+
+
+def test_bytes():
+    assert parse_bytes("128974848") == 128974848
+    assert parse_bytes("129e6") == 129000000
+    assert parse_bytes("123Mi") == 123 * 1024 * 1024
+    assert parse_bytes("1G") == 10**9
+    assert parse_bytes("1Gi") == 2**30
+    assert parse_bytes("500M") == 500 * 10**6
+    assert parse_bytes("1Ki") == 1024
+    assert parse_bytes("2Ti") == 2 * 2**40
+
+
+def test_pods():
+    assert parse_int("110") == 110
+    assert parse_int("1k") == 1000

@@ -1,0 +1,73 @@
+from kubernetes_tpu.api.objects import Container, Pod, PodSpec, ResourceRequirements
+from kubernetes_tpu.api.resources import (
+    DEFAULT_MEMORY_REQUEST,
+    DEFAULT_MILLI_CPU_REQUEST,
+    Resource,
+    pod_request,
+)
+
+
+def ctr(cpu=None, mem=None, restart=None, **scalar):
+    req = {}
+    if cpu is not None:
+        req["cpu"] = cpu
+    if mem is not None:
+        req["memory"] = mem
+    req.update(scalar)
+    return Container(resources=ResourceRequirements(requests=req), restart_policy=restart)
+
+
+def test_from_map():
+    r = Resource.from_map({"cpu": "2", "memory": "1Gi", "pods": "110",
+                           "ephemeral-storage": "10Gi", "nvidia.com/gpu": "4"})
+    assert r.milli_cpu == 2000
+    assert r.memory == 2**30
+    assert r.allowed_pod_number == 110
+    assert r.ephemeral_storage == 10 * 2**30
+    assert r.scalar == {"nvidia.com/gpu": 4}
+
+
+def test_pod_request_sum_of_containers():
+    pod = Pod(spec=PodSpec(containers=[ctr("100m", "1Gi"), ctr("200m", "2Gi")]))
+    r = pod_request(pod)
+    assert r.milli_cpu == 300
+    assert r.memory == 3 * 2**30
+
+
+def test_pod_request_init_max():
+    # max(sum(app), max(init)): a big init container dominates
+    pod = Pod(spec=PodSpec(
+        containers=[ctr("100m", "1Gi")],
+        init_containers=[ctr("500m", "512Mi"), ctr("2", "128Mi")],
+    ))
+    r = pod_request(pod)
+    assert r.milli_cpu == 2000  # max init 2 cores > 100m app
+    assert r.memory == 1 * 2**30  # app memory > either init
+
+
+def test_pod_request_sidecars_accumulate():
+    pod = Pod(spec=PodSpec(
+        containers=[ctr("100m", "1Gi")],
+        init_containers=[ctr("50m", "100Mi", restart="Always"), ctr("1", "1Gi")],
+    ))
+    r = pod_request(pod)
+    # app 100m + sidecar 50m = 150m; init peak = 50m sidecar + 1000m = 1050m
+    assert r.milli_cpu == 1050
+    # memory: app 1Gi + 100Mi sidecar vs init peak 100Mi + 1Gi -> equal = 1Gi+100Mi
+    assert r.memory == 2**30 + 100 * 2**20
+
+
+def test_pod_request_overhead():
+    pod = Pod(spec=PodSpec(containers=[ctr("100m", "1Gi")],
+                           overhead={"cpu": "10m", "memory": "64Mi"}))
+    r = pod_request(pod)
+    assert r.milli_cpu == 110
+    assert r.memory == 2**30 + 64 * 2**20
+
+
+def test_non_zero_defaults():
+    pod = Pod(spec=PodSpec(containers=[Container()]))
+    assert pod_request(pod).is_zero()
+    nz = pod_request(pod, non_zero=True)
+    assert nz.milli_cpu == DEFAULT_MILLI_CPU_REQUEST
+    assert nz.memory == DEFAULT_MEMORY_REQUEST
