@@ -1,0 +1,591 @@
+"""Host->HBM mirror: packs the generation-diffed snapshot into dense blobs.
+
+This is the TPU-native replacement for the reference's incremental snapshot
+refresh (cache.go:186 UpdateSnapshot): instead of cloning Go NodeInfo structs,
+we re-pack only *changed* node rows (generation diff) directly into dense
+numpy blob buffers (one f32 + one i32 per struct kind, see ops.blobs) and ship
+at most three arrays to the device per cycle. Each node keeps a stable row
+index for its lifetime; scheduled pods occupy slots of a device pod table used
+by inter-pod-affinity / topology-spread kernels.
+
+All strings are interned (utils.interner); set-valued fields are padded to
+the static Capacities. Over-capacity conditions raise CapacityError — the
+caller re-buckets (doubles the capacity and re-packs, which recompiles the
+kernels once per bucket).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_tpu.api.objects import (
+    Affinity,
+    Pod,
+    PodAffinityTerm,
+)
+from kubernetes_tpu.api.resources import Resource
+from kubernetes_tpu.backend.node_info import NodeInfo, PodInfo
+from kubernetes_tpu.backend.snapshot import Snapshot
+from kubernetes_tpu.ops import features as F
+from kubernetes_tpu.ops.features import (
+    Capacities,
+    ClusterBlobs,
+    ClusterTensors,
+    PodBlobs,
+    PodFeatures,
+    codecs,
+    unpack_cluster,
+    unpack_pods,
+)
+from kubernetes_tpu.utils.interner import NONE, Interner
+
+MI = 1024 * 1024
+
+# taint the node controller applies for spec.unschedulable; the
+# NodeUnschedulable plugin simulates tolerating it (plugins/nodeunschedulable)
+TAINT_UNSCHEDULABLE = "node.kubernetes.io/unschedulable"
+
+_unpack_cluster_jit = jax.jit(unpack_cluster, static_argnums=1)
+_unpack_pods_jit = jax.jit(unpack_pods, static_argnums=1)
+
+
+class CapacityError(Exception):
+    """A padded capacity was exceeded; caller should re-bucket (double the
+    capacity and re-pack; kernels recompile once per bucket)."""
+
+    def __init__(self, field: str, needed: int):
+        super().__init__(f"capacity exceeded for {field}: need {needed}")
+        self.field = field
+        self.needed = needed
+
+
+class UnsupportedFeatureError(Exception):
+    """The object uses a construct the device encoding cannot express (e.g.
+    NotIn/Exists label-selector expressions inside affinity terms). The caller
+    must route this pod/plugin through the host fallback path — re-bucketing
+    will not help."""
+
+
+
+class Mirror:
+    def __init__(self, interner: Interner | None = None,
+                 caps: Capacities = Capacities()):
+        self.caps = caps
+        self.interner = interner or Interner()
+        self.node_codec, self.table_codec, self.pod_codec = codecs(caps)
+        self.node_f32, self.node_i32 = self.node_codec.alloc(caps.nodes)
+        _, self.pods_i32 = self.table_codec.alloc(caps.pods)
+        self.vocab = np.full((caps.vocab,), np.nan, np.float32)
+        self._row_of: dict[str, int] = {}        # node name -> row
+        self._row_gen: dict[str, int] = {}       # node name -> packed generation
+        self._free_rows: list[int] = list(range(caps.nodes - 1, -1, -1))
+        self._ext_index: dict[str, int] = {}     # extended resource -> column
+        self._pod_slot: dict[str, int] = {}      # pod uid -> pod-table slot
+        self._node_pods: dict[str, dict[str, int]] = {}  # node -> uid -> slot
+        self._pod_obj_id: dict[str, int] = {}    # uid -> id(pod) packed (change detect)
+        self._node_of_pod: dict[str, str] = {}   # uid -> node name
+        self._free_slots: list[int] = list(range(caps.pods - 1, -1, -1))
+        self._vocab_len = 0
+        self._row_names: list[str | None] = [None] * caps.nodes
+        self._dirty = {"node": True, "pods": True, "vocab": True}
+        self._dev: dict[str, jax.Array] = {}
+        # stable well-known ids, interned up front
+        self.wk_unschedulable_key = self._i(TAINT_UNSCHEDULABLE)
+        self.wk_wildcard_ip = self._i("0.0.0.0")
+
+    def well_known(self) -> dict[str, jnp.ndarray]:
+        return {
+            "unschedulable_taint_key": jnp.int32(self.wk_unschedulable_key),
+            "wildcard_ip": jnp.int32(self.wk_wildcard_ip),
+        }
+
+    # ------------- interning helpers -------------
+
+    def _i(self, s: str) -> int:
+        i = self.interner.intern(s)
+        if i >= self.caps.vocab:
+            raise CapacityError("vocab", i + 1)
+        return i
+
+    def ext_col(self, resource_name: str) -> int:
+        col = self._ext_index.get(resource_name)
+        if col is None:
+            nxt = F.NUM_NATIVE_COLS + len(self._ext_index)
+            if nxt >= self.caps.res_cols:
+                raise CapacityError("ext_resources", len(self._ext_index) + 1)
+            self._ext_index[resource_name] = col = nxt
+        return col
+
+    def _res_row(self, r: Resource) -> np.ndarray:
+        row = np.zeros((self.caps.res_cols,), np.float32)
+        row[F.COL_CPU] = r.milli_cpu
+        row[F.COL_MEM] = r.memory / MI
+        row[F.COL_EPH] = r.ephemeral_storage / MI
+        row[F.COL_PODS] = r.allowed_pod_number
+        for name, v in r.scalar.items():
+            row[self.ext_col(name)] = v
+        return row
+
+    def _pairs(self, labels: dict[str, str], cap: int, what: str
+               ) -> tuple[np.ndarray, np.ndarray]:
+        if len(labels) > cap:
+            raise CapacityError(what, len(labels))
+        k = np.full((cap,), NONE, np.int32)
+        v = np.full((cap,), NONE, np.int32)
+        for idx, (key, val) in enumerate(labels.items()):
+            k[idx] = self._i(key)
+            v[idx] = self._i(val)
+        return k, v
+
+    # ------------- node rows -------------
+
+    def row_of(self, name: str) -> int:
+        return self._row_of.get(name, -1)
+
+    def name_of_row(self, row: int) -> str | None:
+        return self._row_names[row] if 0 <= row < len(self._row_names) else None
+
+    def _pack_node_row(self, row: int, info: NodeInfo) -> None:
+        caps = self.caps
+        node = info.node
+        assert node is not None
+        f: dict[str, np.ndarray] = {}
+        f["allocatable"] = self._res_row(info.allocatable)
+        req = self._res_row(info.requested)
+        free = f["allocatable"] - req
+        free[F.COL_PODS] = info.allocatable.allowed_pod_number - len(info.pods)
+        f["free"] = free
+        f["nonzero_requested"] = np.asarray(
+            [info.non_zero_requested.milli_cpu,
+             info.non_zero_requested.memory / MI], np.float32)
+        f["node_valid"] = np.bool_(True)
+        f["unschedulable"] = np.bool_(node.spec.unschedulable)
+        f["node_name_id"] = np.int32(self._i(node.metadata.name))
+        f["label_keys"], f["label_vals"] = self._pairs(
+            node.metadata.labels, caps.node_labels, "node_labels")
+        if len(node.spec.taints) > caps.node_taints:
+            raise CapacityError("node_taints", len(node.spec.taints))
+        tk = np.full((caps.node_taints,), NONE, np.int32)
+        tv = np.full((caps.node_taints,), NONE, np.int32)
+        te = np.full((caps.node_taints,), NONE, np.int32)
+        for i, t in enumerate(node.spec.taints):
+            tk[i] = self._i(t.key)
+            tv[i] = self._i(t.value)
+            te[i] = F.effect_id(t.effect)
+        f["taint_keys"], f["taint_vals"], f["taint_effects"] = tk, tv, te
+        entries = [(ip, proto, port)
+                   for ip, s in info.used_ports.ports.items() for (proto, port) in s]
+        if len(entries) > caps.node_ports:
+            raise CapacityError("node_ports", len(entries))
+        pi = np.full((caps.node_ports,), NONE, np.int32)
+        pp = np.full((caps.node_ports,), NONE, np.int32)
+        pn = np.full((caps.node_ports,), NONE, np.int32)
+        for i, (ip, proto, port) in enumerate(entries):
+            pi[i] = self._i(ip)
+            pp[i] = self._i(proto)
+            pn[i] = port
+        f["port_ips"], f["port_protos"], f["port_nums"] = pi, pp, pn
+        imgs = list(info.image_sizes.items())
+        if len(imgs) > caps.node_images:
+            imgs = imgs[: caps.node_images]  # best-effort: scoring-only signal
+        ii = np.full((caps.node_images,), NONE, np.int32)
+        isz = np.zeros((caps.node_images,), np.float32)
+        for i, (name, size) in enumerate(imgs):
+            ii[i] = self._i(name)
+            isz[i] = size / MI
+        f["image_ids"], f["image_sizes"] = ii, isz
+        self.node_codec.pack_into(self.node_f32[row], self.node_i32[row], f)
+        self._reconcile_node_pods(row, info)
+
+    def _reconcile_node_pods(self, row: int, info: NodeInfo) -> None:
+        name = info.name
+        current = self._node_pods.setdefault(name, {})
+        live_uids = {p.pod.metadata.uid for p in info.pods}
+        for uid in list(current):
+            if uid not in live_uids:
+                self._release_pod_slot(uid)
+        for pi in info.pods:
+            uid = pi.pod.metadata.uid
+            if (uid not in current
+                    or self._pod_obj_id.get(uid) != id(pi.pod)):
+                # new on this node, moved here, or the pod object was replaced
+                # (update): repack. Releasing first also covers the
+                # moved-before-source-reconciled ordering.
+                self._release_pod_slot(uid)
+                self._pack_pod_slot(uid, pi, row, name)
+
+    def _pack_pod_slot(self, uid: str, pi: PodInfo, row: int, node_name: str) -> None:
+        if not self._free_slots:
+            raise CapacityError("pods", self.caps.pods + 1)
+        slot = self._free_slots.pop()
+        caps = self.caps
+        pod = pi.pod
+        f: dict[str, np.ndarray] = {}
+        f["pod_valid"] = np.bool_(True)
+        f["pod_node"] = np.int32(row)
+        f["pod_ns"] = np.int32(self._i(pod.metadata.namespace))
+        f["pod_label_keys"], f["pod_label_vals"] = self._pairs(
+            pod.metadata.labels, caps.pod_labels, "pod_labels")
+        topo = np.full((caps.aff_terms,), NONE, np.int32)
+        ns = np.full((caps.aff_terms, caps.aff_ns), NONE, np.int32)
+        sk = np.full((caps.aff_terms, caps.aff_sel), NONE, np.int32)
+        sv = np.full((caps.aff_terms, caps.aff_sel), NONE, np.int32)
+        terms = pi.required_anti_affinity_terms
+        if len(terms) > caps.aff_terms:
+            raise CapacityError("aff_terms", len(terms))
+        for t_idx, term in enumerate(terms):
+            self._pack_aff_term(term, pod, topo, ns, sk, sv, t_idx)
+        f["pod_anti_topo"], f["pod_anti_ns"] = topo, ns
+        f["pod_anti_sel_keys"], f["pod_anti_sel_vals"] = sk, sv
+        empty_f32 = self.pods_i32[slot, :0].view(np.float32)
+        self.table_codec.pack_into(empty_f32, self.pods_i32[slot], f)
+        self._pod_slot[uid] = slot
+        self._node_pods[node_name][uid] = slot
+        self._pod_obj_id[uid] = id(pod)
+        self._node_of_pod[uid] = node_name
+
+    def _pack_aff_term(self, term: PodAffinityTerm, pod: Pod,
+                       topo: np.ndarray, ns: np.ndarray,
+                       sel_k: np.ndarray, sel_v: np.ndarray, t_idx: int) -> None:
+        """Shared (anti)affinity term encoding. Selectors are folded to exact
+        (key, value) pairs: matchLabels plus single-value In expressions;
+        richer expressions raise (host-plugin fallback, round 2)."""
+        caps = self.caps
+        topo[t_idx] = self._i(term.topology_key)
+        namespaces = term.namespaces or [pod.metadata.namespace]
+        if len(namespaces) > caps.aff_ns:
+            raise CapacityError("aff_ns", len(namespaces))
+        for i, n in enumerate(namespaces):
+            ns[t_idx, i] = self._i(n)
+        sel = term.label_selector
+        pairs: dict[str, str] = {}
+        if sel is not None:
+            pairs.update(sel.match_labels)
+            for expr in sel.match_expressions:
+                if expr.operator == "In" and len(expr.values) == 1:
+                    pairs[expr.key] = expr.values[0]
+                else:
+                    raise UnsupportedFeatureError(
+                        f"affinity selector operator {expr.operator} with "
+                        f"{len(expr.values)} values needs the host fallback")
+        # matchLabelKeys merge: copy the pod's own values for those keys
+        for k in term.match_label_keys:
+            if k in pod.metadata.labels:
+                pairs[k] = pod.metadata.labels[k]
+        if len(pairs) > caps.aff_sel:
+            raise CapacityError("aff_sel", len(pairs))
+        for i, (k, v) in enumerate(pairs.items()):
+            sel_k[t_idx, i] = self._i(k)
+            sel_v[t_idx, i] = self._i(v)
+
+    def _release_pod_slot(self, uid: str) -> None:
+        slot = self._pod_slot.pop(uid, None)
+        if slot is None:
+            return
+        self.pods_i32[slot] = 0  # pod_valid -> False, rest zeroed
+        self._free_slots.append(slot)
+        self._pod_obj_id.pop(uid, None)
+        node = self._node_of_pod.pop(uid, None)
+        if node is not None:
+            self._node_pods.get(node, {}).pop(uid, None)
+
+    def _invalidate_row(self, name: str) -> None:
+        row = self._row_of.pop(name)
+        self._row_gen.pop(name, None)
+        self._row_names[row] = None
+        self.node_f32[row] = 0.0
+        self.node_i32[row] = 0  # node_valid -> False
+        for uid in list(self._node_pods.get(name, {})):
+            self._release_pod_slot(uid)
+        self._node_pods.pop(name, None)
+        self._free_rows.append(row)
+
+    # ------------- sync -------------
+
+    def sync(self, snapshot: Snapshot) -> int:
+        """Incrementally repack rows for nodes whose generation advanced.
+        Returns the number of rows repacked."""
+        live = {info.name for info in snapshot.node_info_list}
+        repacked = 0
+        # removals first so a same-sync node swap can reuse the freed row
+        for name in list(self._row_of):
+            if name not in live:
+                self._invalidate_row(name)
+                repacked += 1
+        for info in snapshot.node_info_list:
+            name = info.name
+            row = self._row_of.get(name)
+            if row is None:
+                if not self._free_rows:
+                    raise CapacityError("nodes", len(self._row_of) + 1)
+                row = self._free_rows.pop()
+                self._row_of[name] = row
+                self._row_names[row] = name
+            if self._row_gen.get(name) != info.generation:
+                self._pack_node_row(row, info)
+                self._row_gen[name] = info.generation
+                repacked += 1
+        if repacked:
+            self._dirty["node"] = True
+            self._dirty["pods"] = True
+        # vocab numeric side-table
+        if len(self.interner) != self._vocab_len:
+            table = self.interner.numeric_table()
+            self.vocab[: len(table)] = np.asarray(table, np.float32)
+            self._vocab_len = len(table)
+            self._dirty["vocab"] = True
+        return repacked
+
+    def to_blobs(self) -> ClusterBlobs:
+        """Upload changed buffers (at most 3 transfers) and return the
+        device-side ClusterBlobs."""
+        if self._dirty["node"] or "node_f32" not in self._dev:
+            self._dev["node_f32"] = jnp.asarray(self.node_f32)
+            self._dev["node_i32"] = jnp.asarray(self.node_i32)
+            self._dirty["node"] = False
+        if self._dirty["pods"] or "pods_i32" not in self._dev:
+            self._dev["pods_i32"] = jnp.asarray(self.pods_i32)
+            self._dirty["pods"] = False
+        if self._dirty["vocab"] or "vocab" not in self._dev:
+            self._dev["vocab"] = jnp.asarray(self.vocab)
+            self._dirty["vocab"] = False
+        return ClusterBlobs(node_f32=self._dev["node_f32"],
+                            node_i32=self._dev["node_i32"],
+                            pods_i32=self._dev["pods_i32"],
+                            vocab_numeric=self._dev["vocab"])
+
+    def to_device(self) -> ClusterTensors:
+        """ClusterTensors view (single jitted unpack dispatch) — test/tooling
+        convenience; the scheduling pipeline unpacks blobs inside its own jit."""
+        return _unpack_cluster_jit(self.to_blobs(), self.caps)
+
+    def reserve_batch_slots(self, n: int) -> np.ndarray:
+        """Pod-table slots the batched commit scan will fill on device; host
+        confirms/repacks them on the next sync after binding."""
+        if len(self._free_slots) < n:
+            raise CapacityError("pods", self.caps.pods + n)
+        return np.asarray(self._free_slots[-n:][::-1], np.int32)
+
+    # ------------- pod packing -------------
+
+    def pack_pod(self, pod: Pod) -> dict[str, np.ndarray]:
+        """Pod -> PodFeatures field dict (numpy)."""
+        caps = self.caps
+        pi = PodInfo(pod)
+        out: dict[str, np.ndarray] = {}
+        out["req"] = self._res_row(pi.request)
+        out["req"][F.COL_PODS] = 1.0  # each pod consumes one pod slot
+        out["nonzero_req"] = np.asarray(
+            [pi.non_zero_request.milli_cpu, pi.non_zero_request.memory / MI],
+            np.float32)
+        out["num_containers"] = np.float32(
+            len(pod.spec.containers) + len(pod.spec.init_containers))
+        out["priority"] = np.int32(pod.priority())
+        out["ns"] = np.int32(self._i(pod.metadata.namespace))
+        out["name_id"] = np.int32(self._i(pod.metadata.name))
+        out["labels_keys"], out["labels_vals"] = self._pairs(
+            pod.metadata.labels, caps.pod_labels, "pod_labels")
+        out["nodesel_keys"], out["nodesel_vals"] = self._pairs(
+            pod.spec.node_selector, caps.pod_labels, "pod_labels")
+        self._pack_node_affinity(pod, out)
+        self._pack_tolerations(pod, out)
+        self._pack_host_ports(pod, out)
+        self._pack_pod_affinity(pod, out)
+        self._pack_spread(pod, out)
+        out["image_ids"] = np.full((caps.pod_images,), NONE, np.int32)
+        idx = 0
+        for c in pod.spec.containers:
+            if c.image and idx < caps.pod_images:
+                out["image_ids"][idx] = self._i(c.image)
+                idx += 1
+        out["node_name_id"] = np.int32(
+            self._i(pod.spec.node_name) if pod.spec.node_name else NONE)
+        out["valid"] = np.bool_(True)
+        return out
+
+    def _pack_node_affinity(self, pod: Pod, out: dict[str, np.ndarray]) -> None:
+        caps = self.caps
+        T, E, V = caps.sel_terms, caps.sel_exprs, caps.sel_vals
+        out["sel_term_valid"] = np.zeros((T,), bool)
+        out["sel_key"] = np.full((T, E), NONE, np.int32)
+        out["sel_op"] = np.full((T, E), NONE, np.int32)
+        out["sel_is_field"] = np.zeros((T, E), bool)
+        out["sel_vals"] = np.full((T, E, V), NONE, np.int32)
+        out["sel_num"] = np.full((T, E), np.nan, np.float32)
+        aff = pod.spec.affinity
+        required = (aff.node_affinity.required
+                    if aff and aff.node_affinity else None)
+        if required is not None:
+            terms = required.node_selector_terms
+            if len(terms) > T:
+                raise CapacityError("sel_terms", len(terms))
+            for ti, term in enumerate(terms):
+                out["sel_term_valid"][ti] = True
+                self._pack_term_exprs(term, out["sel_key"], out["sel_op"],
+                                      out["sel_is_field"], out["sel_vals"],
+                                      out["sel_num"], ti)
+        # preferred
+        PW = caps.pref_terms
+        out["pref_weight"] = np.zeros((PW,), np.int32)
+        out["pref_key"] = np.full((PW, E), NONE, np.int32)
+        out["pref_op"] = np.full((PW, E), NONE, np.int32)
+        out["pref_is_field"] = np.zeros((PW, E), bool)
+        out["pref_vals"] = np.full((PW, E, V), NONE, np.int32)
+        out["pref_num"] = np.full((PW, E), np.nan, np.float32)
+        preferred = (aff.node_affinity.preferred
+                     if aff and aff.node_affinity else [])
+        if len(preferred) > PW:
+            raise CapacityError("pref_terms", len(preferred))
+        for ti, wterm in enumerate(preferred):
+            out["pref_weight"][ti] = wterm.weight
+            self._pack_term_exprs(wterm.preference, out["pref_key"],
+                                  out["pref_op"], out["pref_is_field"],
+                                  out["pref_vals"], out["pref_num"], ti)
+
+    def _pack_term_exprs(self, term, keys, ops, is_field, vals, nums, ti) -> None:
+        caps = self.caps
+        exprs = ([(e, False) for e in term.match_expressions]
+                 + [(e, True) for e in term.match_fields])
+        if len(exprs) > caps.sel_exprs:
+            raise CapacityError("sel_exprs", len(exprs))
+        for ei, (e, fld) in enumerate(exprs):
+            keys[ti, ei] = self._i(e.key)
+            ops[ti, ei] = F.op_id(e.operator)
+            is_field[ti, ei] = fld
+            if len(e.values) > caps.sel_vals:
+                raise CapacityError("sel_vals", len(e.values))
+            for vi, v in enumerate(e.values):
+                vals[ti, ei, vi] = self._i(v)
+            if e.operator in ("Gt", "Lt") and len(e.values) == 1:
+                try:
+                    nums[ti, ei] = float(int(e.values[0]))
+                except ValueError:
+                    nums[ti, ei] = np.nan
+
+    def _pack_tolerations(self, pod: Pod, out: dict[str, np.ndarray]) -> None:
+        TO = self.caps.tolerations
+        tols = pod.spec.tolerations
+        if len(tols) > TO:
+            raise CapacityError("tolerations", len(tols))
+        out["tol_key"] = np.full((TO,), NONE, np.int32)
+        out["tol_op"] = np.full((TO,), NONE, np.int32)
+        out["tol_val"] = np.full((TO,), NONE, np.int32)
+        out["tol_effect"] = np.full((TO,), NONE, np.int32)
+        out["tol_valid"] = np.zeros((TO,), bool)
+        for i, t in enumerate(tols):
+            out["tol_valid"][i] = True
+            out["tol_key"][i] = self._i(t.key) if t.key else NONE
+            out["tol_op"][i] = (F.TOL_EXISTS if t.operator == "Exists"
+                                else F.TOL_EQUAL)
+            out["tol_val"][i] = self._i(t.value)
+            out["tol_effect"][i] = (F.effect_id(t.effect) if t.effect else NONE)
+
+    def _pack_host_ports(self, pod: Pod, out: dict[str, np.ndarray]) -> None:
+        HP = self.caps.pod_ports
+        ports = [(p.host_ip, p.protocol, p.host_port)
+                 for c in pod.spec.containers for p in c.ports if p.host_port > 0]
+        if len(ports) > HP:
+            raise CapacityError("pod_ports", len(ports))
+        out["hp_ip"] = np.full((HP,), NONE, np.int32)
+        out["hp_proto"] = np.full((HP,), NONE, np.int32)
+        out["hp_port"] = np.full((HP,), NONE, np.int32)
+        for i, (ip, proto, port) in enumerate(ports):
+            out["hp_ip"][i] = self._i(ip or "0.0.0.0")
+            out["hp_proto"][i] = self._i(proto or "TCP")
+            out["hp_port"][i] = port
+
+    def _pack_aff_group(self, pod: Pod, terms: list[PodAffinityTerm],
+                        weights: list[int] | None,
+                        prefix: str, out: dict[str, np.ndarray]) -> None:
+        caps = self.caps
+        A, NS, MS = caps.aff_terms, caps.aff_ns, caps.aff_sel
+        topo = np.full((A,), NONE, np.int32)
+        ns = np.full((A, NS), NONE, np.int32)
+        sk = np.full((A, MS), NONE, np.int32)
+        sv = np.full((A, MS), NONE, np.int32)
+        if len(terms) > A:
+            raise CapacityError("aff_terms", len(terms))
+        for ti, term in enumerate(terms):
+            self._pack_aff_term(term, pod, topo, ns, sk, sv, ti)
+        out[f"{prefix}_topo"] = topo
+        out[f"{prefix}_ns"] = ns
+        out[f"{prefix}_sel_keys"] = sk
+        out[f"{prefix}_sel_vals"] = sv
+        if weights is not None:
+            w = np.zeros((A,), np.int32)
+            for ti in range(len(terms)):
+                w[ti] = weights[ti]
+            out[f"{prefix}_weight"] = w
+
+    def _pack_pod_affinity(self, pod: Pod, out: dict[str, np.ndarray]) -> None:
+        aff = pod.spec.affinity or Affinity()
+        pa = aff.pod_affinity
+        paa = aff.pod_anti_affinity
+        self._pack_aff_group(pod, pa.required if pa else [], None, "aff", out)
+        self._pack_aff_group(pod, paa.required if paa else [], None, "anti", out)
+        pref = pa.preferred if pa else []
+        self._pack_aff_group(pod, [w.pod_affinity_term for w in pref],
+                             [w.weight for w in pref], "paff", out)
+        prefa = paa.preferred if paa else []
+        self._pack_aff_group(pod, [w.pod_affinity_term for w in prefa],
+                             [w.weight for w in prefa], "panti", out)
+
+    def _pack_spread(self, pod: Pod, out: dict[str, np.ndarray]) -> None:
+        caps = self.caps
+        C, MS = caps.spread_constraints, caps.aff_sel
+        out["tsc_topo"] = np.full((C,), NONE, np.int32)
+        out["tsc_max_skew"] = np.zeros((C,), np.int32)
+        out["tsc_hard"] = np.zeros((C,), bool)
+        out["tsc_min_domains"] = np.zeros((C,), np.int32)
+        out["tsc_sel_keys"] = np.full((C, MS), NONE, np.int32)
+        out["tsc_sel_vals"] = np.full((C, MS), NONE, np.int32)
+        out["tsc_honor_affinity"] = np.ones((C,), bool)
+        out["tsc_honor_taints"] = np.zeros((C,), bool)
+        tscs = pod.spec.topology_spread_constraints
+        if len(tscs) > C:
+            raise CapacityError("spread_constraints", len(tscs))
+        for i, t in enumerate(tscs):
+            out["tsc_topo"][i] = self._i(t.topology_key)
+            out["tsc_max_skew"][i] = t.max_skew
+            out["tsc_hard"][i] = t.when_unsatisfiable == "DoNotSchedule"
+            out["tsc_min_domains"][i] = t.min_domains or 0
+            pairs: dict[str, str] = {}
+            if t.label_selector is not None:
+                pairs.update(t.label_selector.match_labels)
+                for expr in t.label_selector.match_expressions:
+                    if expr.operator == "In" and len(expr.values) == 1:
+                        pairs[expr.key] = expr.values[0]
+                    else:
+                        raise UnsupportedFeatureError(
+                            f"spread selector operator {expr.operator} needs "
+                            "the host fallback")
+            for k in t.match_label_keys:
+                if k in pod.metadata.labels:
+                    pairs[k] = pod.metadata.labels[k]
+            if len(pairs) > MS:
+                raise CapacityError("aff_sel", len(pairs))
+            for j, (k, v) in enumerate(pairs.items()):
+                out["tsc_sel_keys"][i, j] = self._i(k)
+                out["tsc_sel_vals"][i, j] = self._i(v)
+            out["tsc_honor_affinity"][i] = t.node_affinity_policy == "Honor"
+            out["tsc_honor_taints"][i] = t.node_taints_policy == "Honor"
+
+    def pack_batch_blobs(self, pods: list[Pod], batch_size: int) -> PodBlobs:
+        """Pack pods into a [B]-batched PodBlobs (2 device transfers), padding
+        to batch_size with invalid rows."""
+        if not pods:
+            raise ValueError("empty batch")
+        if len(pods) > batch_size:
+            raise ValueError(f"{len(pods)} pods exceed batch_size {batch_size}")
+        f32, i32 = self.pod_codec.alloc(batch_size)
+        for b, pod in enumerate(pods):
+            self.pod_codec.pack_into(f32[b], i32[b], self.pack_pod(pod))
+        # padding rows stay zeroed => valid False
+        return PodBlobs(f32=jnp.asarray(f32), i32=jnp.asarray(i32))
+
+    def pack_batch(self, pods: list[Pod], batch_size: int) -> PodFeatures:
+        """PodFeatures view of a packed batch (jitted unpack; test/tooling)."""
+        return _unpack_pods_jit(self.pack_batch_blobs(pods, batch_size), self.caps)

@@ -1,0 +1,93 @@
+"""Blob codec: many small feature arrays <-> two dense transfer buffers.
+
+Per-array host->device transfers cost ~5-20ms each on the TPU tunnel; a
+ClusterTensors/PodFeatures pytree has ~25/~55 leaves, which would dominate the
+per-cycle budget. Instead the host packs all fields of a struct into ONE f32
+blob and ONE i32 blob (bools stored as i32), ships two arrays, and the jitted
+pipeline unpacks them with slices/reshapes that XLA folds away.
+
+The codec is schema-driven: field name -> (shape, kind). Schemas are derived
+from Capacities so pack/unpack stay in lockstep with the dataclasses in
+ops.features.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Kind = str  # "f32" | "i32" | "bool"
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class Blobs:
+    """The two transfer buffers. Leading batch axes allowed."""
+
+    f32: jax.Array
+    i32: jax.Array
+
+
+class BlobCodec:
+    def __init__(self, schema: dict[str, tuple[tuple[int, ...], Kind]]):
+        self.schema = schema
+        self._f32_off: dict[str, tuple[int, int]] = {}
+        self._i32_off: dict[str, tuple[int, int]] = {}
+        f = i = 0
+        for name, (shape, kind) in schema.items():
+            size = math.prod(shape) if shape else 1
+            if kind == "f32":
+                self._f32_off[name] = (f, size)
+                f += size
+            else:  # i32 / bool
+                self._i32_off[name] = (i, size)
+                i += size
+        self.f32_size = f
+        self.i32_size = i
+
+    def alloc(self, *batch: int) -> tuple[np.ndarray, np.ndarray]:
+        return (np.zeros(batch + (self.f32_size,), np.float32),
+                np.zeros(batch + (self.i32_size,), np.int32))
+
+    def pack_into(self, out_f32: np.ndarray, out_i32: np.ndarray,
+                  fields: dict[str, np.ndarray]) -> None:
+        """Write one struct's fields into (already-allocated) blob rows.
+        out_* may be views (e.g. one batch row)."""
+        for name, arr in fields.items():
+            shape, kind = self.schema[name]
+            if kind == "f32":
+                off, size = self._f32_off[name]
+                out_f32[..., off:off + size] = np.asarray(arr, np.float32).reshape(
+                    arr.shape[: arr.ndim - len(shape)] + (size,)) if shape else arr
+            else:
+                off, size = self._i32_off[name]
+                flat = (np.asarray(arr, np.int32).reshape(
+                    arr.shape[: arr.ndim - len(shape)] + (size,)) if shape else arr)
+                out_i32[..., off:off + size] = flat
+
+    def pack(self, fields: dict[str, np.ndarray]) -> Blobs:
+        f32, i32 = self.alloc()
+        self.pack_into(f32, i32, fields)
+        return Blobs(f32=jnp.asarray(f32), i32=jnp.asarray(i32))
+
+    def unpack(self, blobs: Blobs, cls=None):
+        """Slice the blobs back into named arrays (inside jit: free).
+        Leading batch axes of the blobs are preserved on every field."""
+        out = {}
+        for name, (shape, kind) in self.schema.items():
+            if kind == "f32":
+                off, size = self._f32_off[name]
+                arr = jax.lax.slice_in_dim(blobs.f32, off, off + size, axis=-1)
+            else:
+                off, size = self._i32_off[name]
+                arr = jax.lax.slice_in_dim(blobs.i32, off, off + size, axis=-1)
+            batch = arr.shape[:-1]
+            arr = arr.reshape(batch + shape) if shape else arr.reshape(batch)
+            if kind == "bool":
+                arr = arr != 0
+            out[name] = arr
+        return cls(**out) if cls is not None else out

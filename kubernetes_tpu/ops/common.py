@@ -1,0 +1,99 @@
+"""Shared device-side primitives for the plugin kernels.
+
+All functions are pure jnp ops over the explicit node axis [N]; the pod axis
+is added by vmap at the model level (models.pipeline). No Python control flow
+on traced values anywhere — everything is masked arithmetic, which is what
+lets XLA fuse the whole Filter/Score pipeline into a handful of TPU kernels.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from kubernetes_tpu.utils.interner import NONE
+
+
+def node_label_value(label_keys: jnp.ndarray, label_vals: jnp.ndarray,
+                     key: jnp.ndarray) -> jnp.ndarray:
+    """Value id of label `key` per node, NONE where absent.
+
+    label_keys/label_vals: [N, L]; key: scalar (or broadcastable).
+    Label keys are unique per node, so max over matching slots recovers the
+    single value (NONE=-1 loses to any real id).
+    """
+    eq = label_keys == key
+    return jnp.max(jnp.where(eq, label_vals, NONE), axis=-1)
+
+
+def has_label(label_keys: jnp.ndarray, key: jnp.ndarray) -> jnp.ndarray:
+    return jnp.any(label_keys == key, axis=-1)
+
+
+def isin(value: jnp.ndarray, candidates: jnp.ndarray) -> jnp.ndarray:
+    """value: [...]; candidates: [..., V] padded with NONE. True if value
+    equals any non-NONE candidate."""
+    v = value[..., None]
+    return jnp.any((candidates == v) & (candidates != NONE), axis=-1)
+
+
+def tolerations_tolerate(
+    tol_valid: jnp.ndarray, tol_key: jnp.ndarray, tol_op: jnp.ndarray,
+    tol_val: jnp.ndarray, tol_effect: jnp.ndarray,
+    taint_key: jnp.ndarray, taint_val: jnp.ndarray, taint_effect: jnp.ndarray,
+) -> jnp.ndarray:
+    """For each taint slot, is it tolerated by any toleration?
+
+    tol_*: [TO] (pod side); taint_*: [N, T] (node side). Returns [N, T] bool.
+    Semantics: v1.Toleration.ToleratesTaint (api/core/v1/toleration.go).
+    """
+    from kubernetes_tpu.ops.features import TOL_EXISTS
+
+    tk = taint_key[..., None]      # [N, T, 1]
+    tv = taint_val[..., None]
+    te = taint_effect[..., None]
+    m_effect = (tol_effect == NONE) | (tol_effect == te)
+    m_key = (tol_key == NONE) | (tol_key == tk)
+    m_op = (tol_op == TOL_EXISTS) | (tol_val == tv)
+    m = tol_valid & m_effect & m_key & m_op
+    return jnp.any(m, axis=-1)
+
+
+def pairs_subset_of_labels(
+    sel_keys: jnp.ndarray, sel_vals: jnp.ndarray,
+    label_keys: jnp.ndarray, label_vals: jnp.ndarray,
+) -> jnp.ndarray:
+    """Are all (key, value) pairs present in the labels?
+
+    sel_*: [..., S]; label_*: [..., L] (leading axes broadcast).
+    Empty selector (all NONE) matches everything. Returns [...] bool.
+    """
+    sk = sel_keys[..., :, None]    # [..., S, 1]
+    sv = sel_vals[..., :, None]
+    lk = label_keys[..., None, :]  # [..., 1, L]
+    lv = label_vals[..., None, :]
+    hit = jnp.any((sk == lk) & (sv == lv), axis=-1)  # [..., S]
+    return jnp.all(hit | (sel_keys == NONE), axis=-1)
+
+
+def masked_max(x: jnp.ndarray, mask: jnp.ndarray, axis=None) -> jnp.ndarray:
+    return jnp.max(jnp.where(mask, x, -jnp.inf), axis=axis)
+
+
+def masked_argmax_first(score: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Index of the max score among masked-in entries (first on ties);
+    -1 if mask is empty."""
+    s = jnp.where(mask, score, -jnp.inf)
+    idx = jnp.argmax(s)
+    return jnp.where(jnp.any(mask), idx.astype(jnp.int32), jnp.int32(-1))
+
+
+def masked_argmax_random(score: jnp.ndarray, mask: jnp.ndarray,
+                         perturb: jnp.ndarray) -> jnp.ndarray:
+    """Tie-broken argmax: equal top scores pick uniformly via a pre-drawn
+    perturbation in [0, 1) — the device analog of selectHost's reservoir
+    sampling (schedule_one.go:865)."""
+    s = jnp.where(mask, score, -jnp.inf)
+    top = jnp.max(s)
+    tie = mask & (s == top)
+    pick = jnp.argmax(jnp.where(tie, perturb, -1.0))
+    return jnp.where(jnp.any(mask), pick.astype(jnp.int32), jnp.int32(-1))

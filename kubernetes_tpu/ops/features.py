@@ -1,0 +1,398 @@
+"""Dense tensor schema for the device-resident cluster mirror and pod batches.
+
+This is the TPU-native replacement for the reference's per-cycle NodeInfo
+snapshot (types.go:780): every string is interned to an int32 id host-side
+(kubernetes_tpu.utils.interner) and every set-valued field becomes a
+fixed-capacity padded array, so all Filter/Score extension points are pure
+integer/float tensor ops vmappable over the node axis and batchable over the
+pod axis (SURVEY.md section 7.0).
+
+Shape/capacity notes
+- All capacities are static (XLA compiles once per capacity bucket); the
+  mirror grows capacities by power-of-two re-bucketing when exceeded.
+- Resource units: cpu in milli-cores, memory/ephemeral-storage in MiB
+  (float32 is exact for Mi-granular values up to 16 TiB), extended resources
+  in raw counts. The host cache keeps exact integers; int->f32 conversion is
+  monotonic, so `request <= free` compares identically to the exact-integer
+  comparison whenever both sides are Mi-granular.
+- `NONE` (-1) marks empty padded slots everywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.utils.interner import NONE
+
+# --- resource column layout ---
+
+COL_CPU = 0       # milli-cores
+COL_MEM = 1       # MiB
+COL_EPH = 2       # MiB
+COL_PODS = 3      # pod count
+NUM_NATIVE_COLS = 4
+
+# taint effect encoding
+EFFECT_NO_SCHEDULE = 0
+EFFECT_PREFER_NO_SCHEDULE = 1
+EFFECT_NO_EXECUTE = 2
+_EFFECTS = {"NoSchedule": EFFECT_NO_SCHEDULE,
+            "PreferNoSchedule": EFFECT_PREFER_NO_SCHEDULE,
+            "NoExecute": EFFECT_NO_EXECUTE}
+
+# node-selector operator encoding
+OP_IN = 0
+OP_NOT_IN = 1
+OP_EXISTS = 2
+OP_DOES_NOT_EXIST = 3
+OP_GT = 4
+OP_LT = 5
+_OPS = {"In": OP_IN, "NotIn": OP_NOT_IN, "Exists": OP_EXISTS,
+        "DoesNotExist": OP_DOES_NOT_EXIST, "Gt": OP_GT, "Lt": OP_LT}
+
+# toleration operator encoding
+TOL_EQUAL = 0
+TOL_EXISTS = 1
+
+
+@dataclass(frozen=True)
+class Capacities:
+    """Static capacity configuration — part of the jit cache key."""
+
+    nodes: int = 1024            # N
+    ext_resources: int = 4       # extended/scalar resource columns
+    node_labels: int = 16        # L: labels per node
+    node_taints: int = 8         # T
+    node_ports: int = 64         # P: occupied host ports per node
+    node_images: int = 16        # I
+    pods: int = 4096             # PT: pod-table slots (scheduled pods)
+    pod_labels: int = 8          # PL
+    sel_terms: int = 4           # node-selector terms per pod
+    sel_exprs: int = 6           # expressions per term
+    sel_vals: int = 4            # values per expression
+    pref_terms: int = 8          # preferred scheduling terms
+    tolerations: int = 8
+    pod_ports: int = 8
+    aff_terms: int = 4           # pod (anti)affinity terms per kind
+    aff_ns: int = 4              # namespaces per affinity term
+    aff_sel: int = 4             # matchLabels pairs per affinity selector
+    spread_constraints: int = 4
+    pod_images: int = 8
+    vocab: int = 65536           # interner id space mirrored to device
+
+    @property
+    def res_cols(self) -> int:
+        return NUM_NATIVE_COLS + self.ext_resources
+
+
+def _register(cls):
+    """Register a dataclass of arrays as a JAX pytree."""
+    fields = [f.name for f in dataclasses.fields(cls)]
+    jax.tree_util.register_dataclass(cls, data_fields=fields, meta_fields=[])
+    return cls
+
+
+@_register
+@dataclass
+class ClusterTensors:
+    """The HBM-resident cluster mirror: one row per node (+ the pod table).
+
+    Device analog of the reference's Snapshot (snapshot.go:29); refreshed
+    incrementally from the host cache's generation diff by backend.mirror.
+    """
+
+    # resources (f32): free = allocatable - requested, maintained exactly on host
+    allocatable: jax.Array       # [N, R]
+    free: jax.Array              # [N, R]
+    nonzero_requested: jax.Array  # [N, 2] cpu/mem with 100m/200Mi defaults
+    # validity + flags
+    node_valid: jax.Array        # [N] bool
+    unschedulable: jax.Array     # [N] bool
+    node_name_id: jax.Array      # [N] i32
+    # labels (padded pairs)
+    label_keys: jax.Array        # [N, L] i32
+    label_vals: jax.Array        # [N, L] i32
+    # taints
+    taint_keys: jax.Array        # [N, T] i32
+    taint_vals: jax.Array        # [N, T] i32
+    taint_effects: jax.Array     # [N, T] i32
+    # occupied host ports
+    port_ips: jax.Array          # [N, P] i32
+    port_protos: jax.Array       # [N, P] i32
+    port_nums: jax.Array         # [N, P] i32 (-1 empty)
+    # images present on node
+    image_ids: jax.Array         # [N, I] i32
+    image_sizes: jax.Array       # [N, I] f32 MiB
+    # pod table (scheduled pods, for inter-pod affinity / topology spread)
+    pod_valid: jax.Array         # [PT] bool
+    pod_node: jax.Array          # [PT] i32 node row index
+    pod_ns: jax.Array            # [PT] i32 namespace id
+    pod_label_keys: jax.Array    # [PT, PL] i32
+    pod_label_vals: jax.Array    # [PT, PL] i32
+    # existing pods' REQUIRED anti-affinity terms (satisfyExistingPodsAntiAffinity)
+    pod_anti_topo: jax.Array     # [PT, A] i32 topology key id (-1 = unused term)
+    pod_anti_ns: jax.Array       # [PT, A, NS] i32 namespace ids the term selects
+    pod_anti_sel_keys: jax.Array  # [PT, A, MS] i32 matchLabels keys
+    pod_anti_sel_vals: jax.Array  # [PT, A, MS] i32 matchLabels values
+    # vocab side-table: interned id -> numeric value (NaN if not integer)
+    vocab_numeric: jax.Array     # [V] f32
+
+
+def node_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
+    """Per-node-row field schema for the blob codec (leading N axis implied)."""
+    r = caps.res_cols
+    return {
+        "allocatable": ((r,), "f32"),
+        "free": ((r,), "f32"),
+        "nonzero_requested": ((2,), "f32"),
+        "image_sizes": ((caps.node_images,), "f32"),
+        "node_valid": ((), "bool"),
+        "unschedulable": ((), "bool"),
+        "node_name_id": ((), "i32"),
+        "label_keys": ((caps.node_labels,), "i32"),
+        "label_vals": ((caps.node_labels,), "i32"),
+        "taint_keys": ((caps.node_taints,), "i32"),
+        "taint_vals": ((caps.node_taints,), "i32"),
+        "taint_effects": ((caps.node_taints,), "i32"),
+        "port_ips": ((caps.node_ports,), "i32"),
+        "port_protos": ((caps.node_ports,), "i32"),
+        "port_nums": ((caps.node_ports,), "i32"),
+        "image_ids": ((caps.node_images,), "i32"),
+    }
+
+
+def pod_table_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
+    """Per-pod-slot schema for the scheduled-pod table (leading PT axis implied)."""
+    a, ns, ms = caps.aff_terms, caps.aff_ns, caps.aff_sel
+    return {
+        "pod_valid": ((), "bool"),
+        "pod_node": ((), "i32"),
+        "pod_ns": ((), "i32"),
+        "pod_label_keys": ((caps.pod_labels,), "i32"),
+        "pod_label_vals": ((caps.pod_labels,), "i32"),
+        "pod_anti_topo": ((a,), "i32"),
+        "pod_anti_ns": ((a, ns), "i32"),
+        "pod_anti_sel_keys": ((a, ms), "i32"),
+        "pod_anti_sel_vals": ((a, ms), "i32"),
+    }
+
+
+def pod_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
+    """Per-pending-pod PodFeatures schema (batch axis B implied)."""
+    r = caps.res_cols
+    T, E, V = caps.sel_terms, caps.sel_exprs, caps.sel_vals
+    PW, TO, HP = caps.pref_terms, caps.tolerations, caps.pod_ports
+    A, NS, MS, C = caps.aff_terms, caps.aff_ns, caps.aff_sel, caps.spread_constraints
+    PL, IM = caps.pod_labels, caps.pod_images
+    return {
+        "req": ((r,), "f32"),
+        "nonzero_req": ((2,), "f32"),
+        "num_containers": ((), "f32"),
+        "sel_num": ((T, E), "f32"),
+        "pref_num": ((PW, E), "f32"),
+        "priority": ((), "i32"),
+        "ns": ((), "i32"),
+        "name_id": ((), "i32"),
+        "labels_keys": ((PL,), "i32"),
+        "labels_vals": ((PL,), "i32"),
+        "nodesel_keys": ((PL,), "i32"),
+        "nodesel_vals": ((PL,), "i32"),
+        "sel_term_valid": ((T,), "bool"),
+        "sel_key": ((T, E), "i32"),
+        "sel_op": ((T, E), "i32"),
+        "sel_is_field": ((T, E), "bool"),
+        "sel_vals": ((T, E, V), "i32"),
+        "pref_weight": ((PW,), "i32"),
+        "pref_key": ((PW, E), "i32"),
+        "pref_op": ((PW, E), "i32"),
+        "pref_is_field": ((PW, E), "bool"),
+        "pref_vals": ((PW, E, V), "i32"),
+        "tol_key": ((TO,), "i32"),
+        "tol_op": ((TO,), "i32"),
+        "tol_val": ((TO,), "i32"),
+        "tol_effect": ((TO,), "i32"),
+        "tol_valid": ((TO,), "bool"),
+        "hp_ip": ((HP,), "i32"),
+        "hp_proto": ((HP,), "i32"),
+        "hp_port": ((HP,), "i32"),
+        "aff_topo": ((A,), "i32"),
+        "aff_ns": ((A, NS), "i32"),
+        "aff_sel_keys": ((A, MS), "i32"),
+        "aff_sel_vals": ((A, MS), "i32"),
+        "anti_topo": ((A,), "i32"),
+        "anti_ns": ((A, NS), "i32"),
+        "anti_sel_keys": ((A, MS), "i32"),
+        "anti_sel_vals": ((A, MS), "i32"),
+        "paff_topo": ((A,), "i32"),
+        "paff_weight": ((A,), "i32"),
+        "paff_ns": ((A, NS), "i32"),
+        "paff_sel_keys": ((A, MS), "i32"),
+        "paff_sel_vals": ((A, MS), "i32"),
+        "panti_topo": ((A,), "i32"),
+        "panti_weight": ((A,), "i32"),
+        "panti_ns": ((A, NS), "i32"),
+        "panti_sel_keys": ((A, MS), "i32"),
+        "panti_sel_vals": ((A, MS), "i32"),
+        "tsc_topo": ((C,), "i32"),
+        "tsc_max_skew": ((C,), "i32"),
+        "tsc_hard": ((C,), "bool"),
+        "tsc_min_domains": ((C,), "i32"),
+        "tsc_sel_keys": ((C, MS), "i32"),
+        "tsc_sel_vals": ((C, MS), "i32"),
+        "tsc_honor_affinity": ((C,), "bool"),
+        "tsc_honor_taints": ((C,), "bool"),
+        "image_ids": ((IM,), "i32"),
+        "node_name_id": ((), "i32"),
+        "valid": ((), "bool"),
+    }
+
+
+@_register
+@dataclass
+class PodFeatures:
+    """One pending pod, fully interned/padded. Batched by stacking (axis 0)."""
+
+    # resources
+    req: jax.Array               # [R] f32
+    nonzero_req: jax.Array       # [2] f32
+    num_containers: jax.Array    # f32 scalar (incl. init; image-locality threshold)
+    priority: jax.Array          # i32 scalar
+    ns: jax.Array                # i32 scalar namespace id
+    name_id: jax.Array           # i32 scalar (pod name, for debugging)
+    labels_keys: jax.Array       # [PL] i32
+    labels_vals: jax.Array       # [PL] i32
+    # unified required node selection: spec.nodeSelector (converted to one term
+    # AND-ed into every term? no — nodeSelector is a separate AND) — we encode
+    # spec.nodeSelector as its own conjunction evaluated separately:
+    nodesel_keys: jax.Array      # [PL] i32 (exact-match pairs from spec.nodeSelector)
+    nodesel_vals: jax.Array      # [PL] i32
+    # required node affinity: OR over terms, AND within term
+    sel_term_valid: jax.Array    # [T] bool
+    sel_key: jax.Array           # [T, E] i32 (-1 = unused expr)
+    sel_op: jax.Array            # [T, E] i32
+    sel_is_field: jax.Array      # [T, E] bool (metadata.name matchFields)
+    sel_vals: jax.Array          # [T, E, V] i32
+    sel_num: jax.Array           # [T, E] f32 (rhs for Gt/Lt)
+    # preferred node affinity
+    pref_weight: jax.Array       # [PW] i32 (0 = unused)
+    pref_key: jax.Array          # [PW, E] i32
+    pref_op: jax.Array           # [PW, E] i32
+    pref_is_field: jax.Array     # [PW, E] bool
+    pref_vals: jax.Array         # [PW, E, V] i32
+    pref_num: jax.Array          # [PW, E] f32
+    # tolerations
+    tol_key: jax.Array           # [TO] i32 (-1 = unused; key NONE+valid uses empty id 0)
+    tol_op: jax.Array            # [TO] i32 TOL_EQUAL/TOL_EXISTS
+    tol_val: jax.Array           # [TO] i32
+    tol_effect: jax.Array        # [TO] i32 (-1 = all effects)
+    tol_valid: jax.Array         # [TO] bool
+    # requested host ports
+    hp_ip: jax.Array             # [HP] i32
+    hp_proto: jax.Array          # [HP] i32
+    hp_port: jax.Array           # [HP] i32 (-1 unused)
+    # pod (anti)affinity terms — required and preferred, both directions
+    aff_topo: jax.Array          # [A] i32 (-1 unused) required affinity
+    aff_ns: jax.Array            # [A, NS] i32
+    aff_sel_keys: jax.Array      # [A, MS] i32
+    aff_sel_vals: jax.Array      # [A, MS] i32
+    anti_topo: jax.Array         # [A] i32 required anti-affinity
+    anti_ns: jax.Array           # [A, NS] i32
+    anti_sel_keys: jax.Array     # [A, MS] i32
+    anti_sel_vals: jax.Array     # [A, MS] i32
+    paff_topo: jax.Array         # [A] i32 preferred affinity
+    paff_weight: jax.Array       # [A] i32
+    paff_ns: jax.Array           # [A, NS] i32
+    paff_sel_keys: jax.Array     # [A, MS] i32
+    paff_sel_vals: jax.Array     # [A, MS] i32
+    panti_topo: jax.Array        # [A] i32 preferred anti-affinity
+    panti_weight: jax.Array      # [A] i32
+    panti_ns: jax.Array          # [A, NS] i32
+    panti_sel_keys: jax.Array    # [A, MS] i32
+    panti_sel_vals: jax.Array    # [A, MS] i32
+    # topology spread constraints
+    tsc_topo: jax.Array          # [C] i32 (-1 unused)
+    tsc_max_skew: jax.Array      # [C] i32
+    tsc_hard: jax.Array          # [C] bool (DoNotSchedule)
+    tsc_min_domains: jax.Array   # [C] i32 (0 = unset)
+    tsc_sel_keys: jax.Array      # [C, MS] i32
+    tsc_sel_vals: jax.Array      # [C, MS] i32
+    tsc_honor_affinity: jax.Array  # [C] bool (nodeAffinityPolicy == Honor)
+    tsc_honor_taints: jax.Array    # [C] bool (nodeTaintsPolicy == Honor)
+    # images referenced by containers
+    image_ids: jax.Array         # [IM] i32
+    # misc
+    node_name_id: jax.Array      # i32 scalar: spec.nodeName pin (-1 = unset)
+    valid: jax.Array             # bool scalar: padding rows in a batch are False
+
+
+@_register
+@dataclass
+class ClusterBlobs:
+    """Transfer form of ClusterTensors: three dense buffers + vocab table."""
+
+    node_f32: jax.Array   # [N, nf]
+    node_i32: jax.Array   # [N, ni]
+    pods_i32: jax.Array   # [PT, pi] (pod table has no f32 fields)
+    vocab_numeric: jax.Array  # [V] f32
+
+
+@_register
+@dataclass
+class PodBlobs:
+    """Transfer form of a PodFeatures batch."""
+
+    f32: jax.Array        # [B, pf]
+    i32: jax.Array        # [B, pi]
+
+
+def _codecs(caps: Capacities):
+    from kubernetes_tpu.ops.blobs import BlobCodec
+
+    return (BlobCodec(node_schema(caps)), BlobCodec(pod_table_schema(caps)),
+            BlobCodec(pod_schema(caps)))
+
+
+_codec_cache: dict[Capacities, tuple] = {}
+
+
+def codecs(caps: Capacities):
+    c = _codec_cache.get(caps)
+    if c is None:
+        c = _codec_cache[caps] = _codecs(caps)
+    return c
+
+
+def unpack_cluster(blobs: ClusterBlobs, caps: Capacities) -> ClusterTensors:
+    """Slice the blobs into the full ClusterTensors view (inside jit: free)."""
+    from kubernetes_tpu.ops.blobs import Blobs
+
+    node_codec, table_codec, _ = codecs(caps)
+    fields = node_codec.unpack(Blobs(f32=blobs.node_f32, i32=blobs.node_i32))
+    empty = jnp.zeros(blobs.pods_i32.shape[:-1] + (0,), jnp.float32)
+    fields.update(table_codec.unpack(Blobs(f32=empty, i32=blobs.pods_i32)))
+    fields["vocab_numeric"] = blobs.vocab_numeric
+    return ClusterTensors(**fields)
+
+
+def unpack_pods(blobs: PodBlobs, caps: Capacities) -> PodFeatures:
+    from kubernetes_tpu.ops.blobs import Blobs
+
+    _, _, pod_codec = codecs(caps)
+    return pod_codec.unpack(Blobs(f32=blobs.f32, i32=blobs.i32), PodFeatures)
+
+
+def effect_id(effect: str) -> int:
+    return _EFFECTS[effect]
+
+
+def op_id(op: str) -> int:
+    return _OPS[op]
+
+
+# nodesel/PodFeatures helpers live in backend.mirror (the packer); this module
+# only defines the schema and encodings so ops/* stay free of host imports.

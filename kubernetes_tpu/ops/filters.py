@@ -1,0 +1,151 @@
+"""Filter extension point as vmapped device predicates.
+
+Each function evaluates one plugin's Filter for ONE pod against ALL nodes at
+once — the tensorized replacement of the reference's per-node goroutine loop
+``findNodesThatPassFilters`` (schedule_one.go:583-650). Returns [N] boolean
+accept masks plus, where relevant, an "unresolvable" mask (the
+UnschedulableAndUnresolvable distinction preemption relies on,
+framework/types.go NodeToStatus).
+
+Reference algorithms:
+- NodeName:           plugins/nodename/node_name.go (spec.nodeName == node)
+- NodeUnschedulable:  plugins/nodeunschedulable (spec.unschedulable unless tolerated)
+- TaintToleration:    plugins/tainttoleration/taint_toleration.go:111
+- NodeAffinity:       plugins/nodeaffinity/node_affinity.go:206-228
+- NodePorts:          plugins/nodeports (HostPortInfo conflict, types.go:1291)
+- NodeResourcesFit:   plugins/noderesources/fit.go:509-592 fitsRequest
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from kubernetes_tpu.ops import common as C
+from kubernetes_tpu.ops.features import (
+    EFFECT_NO_EXECUTE,
+    EFFECT_NO_SCHEDULE,
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+    ClusterTensors,
+    PodFeatures,
+)
+from kubernetes_tpu.utils.interner import NONE
+
+
+def node_name(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
+    """spec.nodeName pin; unset matches every node."""
+    return (pod.node_name_id == NONE) | (ct.node_name_id == pod.node_name_id)
+
+
+def node_unschedulable(ct: ClusterTensors, pod: PodFeatures,
+                       unschedulable_taint_key: jnp.ndarray) -> jnp.ndarray:
+    """node.spec.unschedulable rejected unless the pod tolerates the
+    node.kubernetes.io/unschedulable:NoSchedule taint."""
+    n = ct.unschedulable.shape[0]
+    key = jnp.broadcast_to(unschedulable_taint_key, (n, 1))
+    val = jnp.broadcast_to(jnp.int32(0), (n, 1))  # empty-string value id 0
+    eff = jnp.broadcast_to(jnp.int32(EFFECT_NO_SCHEDULE), (n, 1))
+    tolerated = C.tolerations_tolerate(
+        pod.tol_valid, pod.tol_key, pod.tol_op, pod.tol_val, pod.tol_effect,
+        key, val, eff)[:, 0]
+    return ~ct.unschedulable | tolerated
+
+
+def taint_toleration(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
+    """Any untolerated NoSchedule/NoExecute taint rejects the node
+    (UnschedulableAndUnresolvable in the reference)."""
+    tolerated = C.tolerations_tolerate(
+        pod.tol_valid, pod.tol_key, pod.tol_op, pod.tol_val, pod.tol_effect,
+        ct.taint_keys, ct.taint_vals, ct.taint_effects)  # [N, T]
+    hard = ((ct.taint_effects == EFFECT_NO_SCHEDULE)
+            | (ct.taint_effects == EFFECT_NO_EXECUTE))
+    untolerated = hard & ~tolerated & (ct.taint_keys != NONE)
+    return ~jnp.any(untolerated, axis=-1)
+
+
+def _selector_match(ct: ClusterTensors, keys, ops, is_field, vals, nums):
+    """match[N, *keys.shape] for node-selector expressions.
+
+    keys/ops/is_field/nums: [T, E]; vals: [T, E, V].
+    """
+    lead = (None,) * keys.ndim
+    lk = ct.label_keys[(slice(None),) + lead]            # [N, 1, 1, L]
+    lvs = ct.label_vals[(slice(None),) + lead]
+    k = keys[None, ..., None]                            # [1, T, E, 1]
+    eq = lk == k                                         # [N, T, E, L]
+    present = jnp.any(eq, axis=-1)                       # [N, T, E]
+    label_val = jnp.max(jnp.where(eq, lvs, NONE), axis=-1)  # [N, T, E]
+
+    # matchFields: the only supported key is metadata.name -> node name id
+    name_val = ct.node_name_id.reshape((-1,) + (1,) * keys.ndim)  # [N, 1, 1]
+    name_val = jnp.broadcast_to(name_val, eq.shape[:-1])          # [N, T, E]
+    val = jnp.where(is_field[None], name_val, label_val)
+    present = jnp.where(is_field[None], True, present)
+
+    in_vals = C.isin(val, vals[None])                    # [N, T, E]
+    num_val = ct.vocab_numeric[jnp.clip(val, 0, ct.vocab_numeric.shape[0] - 1)]
+    num_ok = ~jnp.isnan(num_val) & ~jnp.isnan(nums[None])
+    gt = num_ok & (num_val > nums[None])
+    lt = num_ok & (num_val < nums[None])
+
+    op = ops[None]
+    match = jnp.where(op == OP_IN, present & in_vals,
+            jnp.where(op == OP_NOT_IN, ~(present & in_vals),
+            jnp.where(op == OP_EXISTS, present,
+            jnp.where(op == OP_DOES_NOT_EXIST, ~present,
+            jnp.where(op == OP_GT, present & gt,
+            jnp.where(op == OP_LT, present & lt, False))))))
+    return match  # [N, *keys.shape]
+
+
+def node_affinity(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
+    """spec.nodeSelector (exact pairs, ANDed) AND required node affinity
+    (OR over terms, AND within term)."""
+    # nodeSelector pairs
+    sel_ok = C.pairs_subset_of_labels(
+        pod.nodesel_keys[None], pod.nodesel_vals[None],
+        ct.label_keys, ct.label_vals)  # [N]
+
+    match = _selector_match(ct, pod.sel_key, pod.sel_op, pod.sel_is_field,
+                            pod.sel_vals, pod.sel_num)  # [N, T, E]
+    used = pod.sel_key != NONE  # [T, E]
+    term_ok = jnp.all(match | ~used[None], axis=-1)  # [N, T]
+    term_nonempty = jnp.any(used, axis=-1)  # [T]
+    term_ok = term_ok & term_nonempty[None] & pod.sel_term_valid[None]
+    any_term = jnp.any(pod.sel_term_valid)
+    affinity_ok = jnp.where(any_term, jnp.any(term_ok, axis=-1), True)
+    return sel_ok & affinity_ok
+
+
+def node_ports(ct: ClusterTensors, pod: PodFeatures,
+               wildcard_ip: jnp.ndarray) -> jnp.ndarray:
+    """No requested host port may conflict with an occupied one
+    (types.go:1291 CheckConflict: wildcard IP clashes with any IP)."""
+    # pod ports [HP] vs node ports [N, P]
+    pp = pod.hp_port[None, None, :]       # [1, 1, HP]
+    pproto = pod.hp_proto[None, None, :]
+    pip = pod.hp_ip[None, None, :]
+    np_ = ct.port_nums[..., None]          # [N, P, 1]
+    nproto = ct.port_protos[..., None]
+    nip = ct.port_ips[..., None]
+    same = (pp != NONE) & (np_ == pp) & (nproto == pproto)
+    ip_clash = (nip == pip) | (nip == wildcard_ip) | (pip == wildcard_ip)
+    conflict = same & ip_clash
+    return ~jnp.any(conflict, axis=(1, 2))
+
+
+def resources_fit(ct: ClusterTensors, pod: PodFeatures
+                  ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """request <= free per resource column (fit.go:509-592).
+
+    Returns (ok [N], unresolvable [N]) — unresolvable when the request
+    exceeds the node's *allocatable* (no amount of preemption helps).
+    """
+    req = pod.req[None]                      # [1, R]
+    ok = jnp.all(req <= ct.free, axis=-1)
+    unresolvable = jnp.any(req > ct.allocatable, axis=-1)
+    return ok, unresolvable

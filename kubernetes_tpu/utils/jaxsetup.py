@@ -1,0 +1,32 @@
+"""Process-wide JAX configuration for the scheduler runtime.
+
+XLA compilation on the target environment is expensive (seconds per program,
+including trivial ones), while cached executions are microseconds. The
+framework therefore (a) funnels all per-cycle math through a small number of
+large jitted programs keyed by static capacity buckets, and (b) enables the
+persistent compilation cache so restarts skip recompiles entirely.
+"""
+
+from __future__ import annotations
+
+import os
+
+_done = False
+
+
+def setup(cache_dir: str | None = None) -> None:
+    global _done
+    if _done:
+        return
+    import jax
+
+    default = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        ".jax_cache")
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("KTPU_JAX_CACHE") or cache_dir or default,
+    )
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    _done = True

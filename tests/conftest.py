@@ -1,6 +1,11 @@
 """Test bootstrap: force JAX onto a virtual 8-device CPU platform so all
 sharding/mesh tests run without TPU hardware (the driver separately
-dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip)."""
+dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip).
+
+XLA compilation on this box is slow (~2-8s per jit even for trivial
+programs), so the persistent compilation cache is enabled with no size/time
+floor: the first full test run pays the compiles, subsequent runs hit disk.
+"""
 
 import os
 
@@ -10,4 +15,14 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_COMPILATION_CACHE", "true")
+
+# persistent compile cache: the JAX_* env vars are not honored by this JAX
+# build (verified: cache stays "disabled/not initialized"), so use the config
+# API via the shared setup helper; respects a pre-set KTPU_JAX_CACHE.
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+import sys  # noqa: E402
+
+sys.path.insert(0, _repo)
+from kubernetes_tpu.utils.jaxsetup import setup as _jax_setup  # noqa: E402
+
+_jax_setup(os.path.join(_repo, ".jax_cache"))

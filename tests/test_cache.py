@@ -1,0 +1,174 @@
+from kubernetes_tpu.api.objects import (
+    LABEL_ZONE,
+    Container,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResourceRequirements,
+)
+from kubernetes_tpu.backend.cache import Cache
+from kubernetes_tpu.backend.node_info import NodeInfo
+from kubernetes_tpu.backend.snapshot import Snapshot
+
+
+def mknode(name, zone=None, cpu="4", mem="8Gi"):
+    labels = {LABEL_ZONE: zone} if zone else {}
+    return Node(metadata=ObjectMeta(name=name, labels=labels),
+                status=NodeStatus(allocatable={"cpu": cpu, "memory": mem, "pods": "110"}))
+
+
+def mkpod(name, node="", cpu="100m", uid=None):
+    meta = ObjectMeta(name=name)
+    if uid:
+        meta.uid = uid
+    return Pod(metadata=meta,
+               spec=PodSpec(node_name=node, containers=[
+                   Container(resources=ResourceRequirements(requests={"cpu": cpu}))]))
+
+
+def test_node_info_aggregates():
+    ni = NodeInfo(mknode("n1"))
+    assert ni.allocatable.milli_cpu == 4000
+    p = mkpod("p1", "n1", cpu="500m")
+    ni.add_pod(p)
+    assert ni.requested.milli_cpu == 500
+    assert len(ni.pods) == 1
+    assert ni.remove_pod(p)
+    assert ni.requested.milli_cpu == 0
+    assert not ni.pods
+
+
+def test_assume_confirm_flow():
+    c = Cache()
+    c.add_node(mknode("n1"))
+    p = mkpod("p1", "n1", cpu="1")
+    c.assume_pod(p)
+    assert c.is_assumed_pod(p)
+    assert c.pod_count() == 1
+    c.finish_binding(p)
+    # informer confirms
+    c.add_pod(p)
+    assert not c.is_assumed_pod(p)
+    assert c.pod_count() == 1
+    c.remove_pod(p)
+    assert c.pod_count() == 0
+
+
+def test_forget_pod():
+    c = Cache()
+    c.add_node(mknode("n1"))
+    p = mkpod("p1", "n1")
+    c.assume_pod(p)
+    c.forget_pod(p)
+    assert c.pod_count() == 0
+    assert not c.is_assumed_pod(p)
+
+
+def test_assumed_pod_ttl_expiry():
+    t = [100.0]
+    c = Cache(ttl=30.0, now=lambda: t[0])
+    c.add_node(mknode("n1"))
+    p = mkpod("p1", "n1")
+    c.assume_pod(p)
+    c.finish_binding(p)
+    assert c.cleanup_assumed_pods() == []
+    t[0] = 131.0
+    expired = c.cleanup_assumed_pods()
+    assert [e.metadata.uid for e in expired] == [p.metadata.uid]
+    assert c.pod_count() == 0
+
+
+def test_snapshot_incremental():
+    c = Cache()
+    snap = Snapshot()
+    c.add_node(mknode("n1"))
+    c.add_node(mknode("n2"))
+    c.update_snapshot(snap)
+    assert snap.num_nodes() == 2
+    gen1 = snap.generation
+
+    # adding a pod touches only n1's row
+    c.add_pod(mkpod("p1", "n1", cpu="2"))
+    c.update_snapshot(snap)
+    assert snap.generation > gen1
+    assert snap.get("n1").requested.milli_cpu == 2000
+    assert snap.get("n2").requested.milli_cpu == 0
+
+    # removing a node shrinks the list
+    c.remove_node(mknode("n2"))
+    c.update_snapshot(snap)
+    assert snap.num_nodes() == 1
+    assert snap.get("n2") is None
+
+
+def test_snapshot_is_immutable_view():
+    c = Cache()
+    snap = Snapshot()
+    c.add_node(mknode("n1"))
+    c.update_snapshot(snap)
+    before = snap.get("n1").requested.milli_cpu
+    c.add_pod(mkpod("p1", "n1", cpu="3"))
+    # cache changed, snapshot not yet refreshed
+    assert snap.get("n1").requested.milli_cpu == before
+
+
+def test_zone_interleaving():
+    c = Cache()
+    snap = Snapshot()
+    for i in range(4):
+        c.add_node(mknode(f"a{i}", zone="za"))
+    for i in range(2):
+        c.add_node(mknode(f"b{i}", zone="zb"))
+    c.update_snapshot(snap)
+    order = [ni.name for ni in snap.node_info_list]
+    # round-robin: zones alternate while both have nodes
+    first_four = order[:4]
+    assert {first_four[0][0], first_four[1][0]} == {"a", "b"}
+    assert {first_four[2][0], first_four[3][0]} == {"a", "b"}
+
+
+def test_remove_node_with_pods_keeps_info():
+    c = Cache()
+    n = mknode("n1")
+    c.add_node(n)
+    c.add_pod(mkpod("p1", "n1"))
+    c.remove_node(n)
+    snap = Snapshot()
+    c.update_snapshot(snap)
+    # node-less info is excluded from the snapshot list
+    assert snap.num_nodes() == 0
+    # but pod removal later fully cleans up
+    assert c.pod_count() == 1
+
+
+def test_imaginary_node_from_early_pod():
+    c = Cache()
+    c.add_pod(mkpod("p1", "ghost"))
+    assert c.pod_count() == 1
+    snap = Snapshot()
+    c.update_snapshot(snap)
+    assert snap.num_nodes() == 0
+    c.add_node(mknode("ghost"))
+    c.update_snapshot(snap)
+    assert snap.num_nodes() == 1
+    assert snap.get("ghost").requested.milli_cpu == 100
+
+
+def test_host_port_conflicts():
+    from kubernetes_tpu.backend.node_info import HostPortInfo
+
+    h = HostPortInfo()
+    h.add("", "TCP", 8080)
+    assert h.conflicts("", "TCP", 8080)
+    assert h.conflicts("10.0.0.1", "TCP", 8080)  # wildcard clashes with any ip
+    assert not h.conflicts("", "UDP", 8080)
+    assert not h.conflicts("", "TCP", 8081)
+    h2 = HostPortInfo()
+    h2.add("10.0.0.1", "TCP", 443)
+    assert h2.conflicts("0.0.0.0", "TCP", 443)
+    assert h2.conflicts("10.0.0.1", "TCP", 443)
+    assert not h2.conflicts("10.0.0.2", "TCP", 443)
+    h2.remove("10.0.0.1", "TCP", 443)
+    assert not h2.conflicts("0.0.0.0", "TCP", 443)

@@ -1,0 +1,329 @@
+"""Golden parity: device filter/score kernels vs the host-side oracles.
+
+Mirrors the reference's plugin unit-test tables (fit_test.go,
+taint_toleration_test.go, node_affinity_test.go...) — each case builds real
+objects, packs them through the Mirror, runs the JAX kernel over all nodes,
+and compares with the exact host-semantics implementation."""
+
+import jax
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.labels import (
+    find_untolerated_taint,
+    pod_matches_node_selector_and_affinity,
+)
+from kubernetes_tpu.api.objects import (
+    Affinity,
+    Container,
+    ContainerImage,
+    ContainerPort,
+    Node,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    PreferredSchedulingTerm,
+    ResourceRequirements,
+    Taint,
+    Toleration,
+)
+from kubernetes_tpu.backend.cache import Cache
+from kubernetes_tpu.backend.mirror import Mirror
+from kubernetes_tpu.backend.snapshot import Snapshot
+from kubernetes_tpu.ops import filters as _OF
+from kubernetes_tpu.ops import scores as _OS
+from kubernetes_tpu.ops.features import Capacities
+
+
+class _Jitted:
+    """Jit-wrap every kernel so the 29 parity cases share compiled code
+    (same Capacities -> same shapes -> one compile per kernel)."""
+
+    def __init__(self, mod):
+        self._mod = mod
+        self._cache = {}
+
+    def __getattr__(self, name):
+        fn = self._cache.get(name)
+        if fn is None:
+            fn = self._cache[name] = jax.jit(getattr(self._mod, name))
+        return fn
+
+
+OF = _Jitted(_OF)
+OS = _Jitted(_OS)
+
+
+def mknode(name, cpu="4", mem="8Gi", labels=None, taints=None, unsched=False,
+           images=None):
+    return Node(
+        metadata=ObjectMeta(name=name, labels=labels or {}),
+        spec=NodeSpec(unschedulable=unsched, taints=taints or []),
+        status=NodeStatus(
+            allocatable={"cpu": cpu, "memory": mem, "pods": "110"},
+            images=[ContainerImage(names=[n], size_bytes=s) for n, s in (images or [])],
+        ),
+    )
+
+
+def mkpod(name, cpu="0", mem="0", **kw):
+    requests = {}
+    if cpu != "0":
+        requests["cpu"] = cpu
+    if mem != "0":
+        requests["memory"] = mem
+    ports = [ContainerPort(host_port=p, protocol=proto, host_ip=ip)
+             for ip, proto, p in kw.pop("host_ports", [])]
+    image = kw.pop("image", "")
+    return Pod(
+        metadata=ObjectMeta(name=name, labels=kw.pop("labels", {})),
+        spec=PodSpec(
+            containers=[Container(resources=ResourceRequirements(requests=requests),
+                                  ports=ports, image=image)],
+            **kw,
+        ),
+    )
+
+
+class Rig:
+    """cache -> snapshot -> mirror -> device tensors, one call."""
+
+    def __init__(self, nodes, scheduled=None):
+        self.cache = Cache()
+        for n in nodes:
+            self.cache.add_node(n)
+        for p in scheduled or []:
+            self.cache.add_pod(p)
+        self.snap = Snapshot()
+        self.cache.update_snapshot(self.snap)
+        self.mirror = Mirror(caps=Capacities(nodes=16, pods=64, vocab=1024))
+        self.mirror.sync(self.snap)
+        self.ct = self.mirror.to_device()
+        self.names = [ni.name for ni in self.snap.node_info_list]
+        self.rows = [self.mirror.row_of(n) for n in self.names]
+
+    def pod_features(self, pod):
+        return self.mirror.pack_batch([pod], 1)
+
+    def mask_by_name(self, device_mask):
+        m = np.asarray(device_mask)
+        return {name: bool(m[row]) for name, row in zip(self.names, self.rows)}
+
+
+def unbatch(pf):
+    import jax
+    return jax.tree.map(lambda x: x[0], pf)
+
+
+def test_fit_parity():
+    nodes = [mknode("big", cpu="8", mem="16Gi"), mknode("small", cpu="1", mem="1Gi")]
+    rig = Rig(nodes, scheduled=[mkpod("busy", cpu="500m", mem="512Mi",
+                                      node_name="small")])
+    pod = mkpod("p", cpu="600m", mem="256Mi")
+    pf = unbatch(rig.pod_features(pod))
+    ok, unresolvable = OF.resources_fit(rig.ct, pf)
+    got = rig.mask_by_name(ok)
+    assert got == {"big": True, "small": False}
+    # 600m > 1000m-500m on small but 600m < 1000m allocatable -> resolvable
+    assert not rig.mask_by_name(unresolvable)["small"]
+    # a pod requesting more than allocatable anywhere is unresolvable there
+    giant = unbatch(rig.pod_features(mkpod("g", cpu="32")))
+    ok2, unres2 = OF.resources_fit(rig.ct, giant)
+    assert not any(rig.mask_by_name(ok2).values())
+    assert all(rig.mask_by_name(unres2).values())
+
+
+def test_node_name_parity():
+    rig = Rig([mknode("a"), mknode("b")])
+    pf = unbatch(rig.pod_features(mkpod("p", node_name="")))
+    assert all(rig.mask_by_name(OF.node_name(rig.ct, pf)).values())
+    pf = unbatch(rig.pod_features(mkpod("p2", node_name="b")))
+    assert rig.mask_by_name(OF.node_name(rig.ct, pf)) == {"a": False, "b": True}
+
+
+def test_unschedulable_parity():
+    rig = Rig([mknode("ok"), mknode("cordoned", unsched=True)])
+    wk = rig.mirror.well_known()
+    pf = unbatch(rig.pod_features(mkpod("p")))
+    got = rig.mask_by_name(
+        OF.node_unschedulable(rig.ct, pf, wk["unschedulable_taint_key"]))
+    assert got == {"ok": True, "cordoned": False}
+    # toleration lets it through
+    tol = mkpod("p2", tolerations=[Toleration(
+        key="node.kubernetes.io/unschedulable", operator="Exists",
+        effect="NoSchedule")])
+    pf = unbatch(rig.pod_features(tol))
+    got = rig.mask_by_name(
+        OF.node_unschedulable(rig.ct, pf, wk["unschedulable_taint_key"]))
+    assert got == {"ok": True, "cordoned": True}
+
+
+TAINT_CASES = [
+    ([], [], True),
+    ([Taint("gpu", "NoSchedule", "true")], [], False),
+    ([Taint("gpu", "NoSchedule", "true")],
+     [Toleration(key="gpu", operator="Equal", value="true", effect="NoSchedule")],
+     True),
+    ([Taint("gpu", "NoSchedule", "true")],
+     [Toleration(key="gpu", operator="Equal", value="false", effect="NoSchedule")],
+     False),
+    ([Taint("gpu", "NoSchedule", "true")],
+     [Toleration(key="gpu", operator="Exists")], True),
+    ([Taint("gpu", "NoSchedule", "true")], [Toleration(operator="Exists")], True),
+    ([Taint("soft", "PreferNoSchedule")], [], True),  # soft taint passes filter
+    ([Taint("evict", "NoExecute", "x")], [], False),
+    ([Taint("a", "NoSchedule"), Taint("b", "NoSchedule")],
+     [Toleration(key="a", operator="Exists", effect="NoSchedule")], False),
+]
+
+
+@pytest.mark.parametrize("taints,tols,want", TAINT_CASES)
+def test_taint_toleration_parity(taints, tols, want):
+    rig = Rig([mknode("n", taints=taints)])
+    pf = unbatch(rig.pod_features(mkpod("p", tolerations=tols)))
+    got = rig.mask_by_name(OF.taint_toleration(rig.ct, pf))["n"]
+    oracle = find_untolerated_taint(taints, tols) is None
+    assert got == oracle == want
+
+
+def _affinity_pod(terms=None, node_selector=None, preferred=None):
+    aff = None
+    if terms is not None or preferred is not None:
+        aff = Affinity(node_affinity=NodeAffinity(
+            required=NodeSelector(node_selector_terms=terms) if terms else None,
+            preferred=preferred or []))
+    return mkpod("p", node_selector=node_selector or {}, affinity=aff)
+
+
+AFFINITY_NODES = [
+    mknode("ssd-east", labels={"disk": "ssd", "zone": "east", "cpus": "32"}),
+    mknode("hdd-west", labels={"disk": "hdd", "zone": "west", "cpus": "8"}),
+    mknode("bare", labels={}),
+]
+
+AFFINITY_PODS = [
+    _affinity_pod(),                                        # no constraints
+    _affinity_pod(node_selector={"disk": "ssd"}),
+    _affinity_pod(terms=[NodeSelectorTerm(match_expressions=[
+        NodeSelectorRequirement("zone", "In", ["east", "north"])])]),
+    _affinity_pod(terms=[NodeSelectorTerm(match_expressions=[
+        NodeSelectorRequirement("disk", "NotIn", ["hdd"])])]),
+    _affinity_pod(terms=[NodeSelectorTerm(match_expressions=[
+        NodeSelectorRequirement("cpus", "Gt", ["16"])])]),
+    _affinity_pod(terms=[NodeSelectorTerm(match_expressions=[
+        NodeSelectorRequirement("cpus", "Lt", ["16"])])]),
+    _affinity_pod(terms=[NodeSelectorTerm(match_expressions=[
+        NodeSelectorRequirement("disk", "Exists")])]),
+    _affinity_pod(terms=[NodeSelectorTerm(match_expressions=[
+        NodeSelectorRequirement("disk", "DoesNotExist")])]),
+    # OR of two terms
+    _affinity_pod(terms=[
+        NodeSelectorTerm(match_expressions=[
+            NodeSelectorRequirement("zone", "In", ["west"])]),
+        NodeSelectorTerm(match_expressions=[
+            NodeSelectorRequirement("disk", "In", ["ssd"])]),
+    ]),
+    # AND within a term
+    _affinity_pod(terms=[NodeSelectorTerm(match_expressions=[
+        NodeSelectorRequirement("disk", "In", ["ssd"]),
+        NodeSelectorRequirement("zone", "In", ["west"])])]),
+    # matchFields on metadata.name
+    _affinity_pod(terms=[NodeSelectorTerm(match_fields=[
+        NodeSelectorRequirement("metadata.name", "In", ["bare"])])]),
+    # nodeSelector AND affinity together
+    _affinity_pod(node_selector={"zone": "east"},
+                  terms=[NodeSelectorTerm(match_expressions=[
+                      NodeSelectorRequirement("disk", "In", ["ssd", "hdd"])])]),
+]
+
+
+@pytest.mark.parametrize("pod", AFFINITY_PODS)
+def test_node_affinity_parity(pod):
+    rig = Rig(AFFINITY_NODES)
+    pf = unbatch(rig.pod_features(pod))
+    got = rig.mask_by_name(OF.node_affinity(rig.ct, pf))
+    for node in AFFINITY_NODES:
+        oracle = pod_matches_node_selector_and_affinity(pod, node)
+        assert got[node.name] == oracle, (
+            f"node {node.name}: device={got[node.name]} oracle={oracle}")
+
+
+def test_node_ports_parity():
+    busy = mkpod("busy", node_name="n1", host_ports=[("", "TCP", 8080)])
+    busy2 = mkpod("busy2", node_name="n2", host_ports=[("10.0.0.1", "TCP", 9000)])
+    rig = Rig([mknode("n1"), mknode("n2"), mknode("n3")], scheduled=[busy, busy2])
+    wk = rig.mirror.well_known()
+
+    pf = unbatch(rig.pod_features(mkpod("p", host_ports=[("", "TCP", 8080)])))
+    got = rig.mask_by_name(OF.node_ports(rig.ct, pf, wk["wildcard_ip"]))
+    assert got == {"n1": False, "n2": True, "n3": True}
+
+    # wildcard vs specific-ip clash
+    pf = unbatch(rig.pod_features(mkpod("p2", host_ports=[("", "TCP", 9000)])))
+    got = rig.mask_by_name(OF.node_ports(rig.ct, pf, wk["wildcard_ip"]))
+    assert got == {"n1": True, "n2": False, "n3": True}
+
+    # different protocol is fine
+    pf = unbatch(rig.pod_features(mkpod("p3", host_ports=[("", "UDP", 8080)])))
+    assert all(rig.mask_by_name(OF.node_ports(rig.ct, pf, wk["wildcard_ip"])).values())
+
+
+def test_least_most_balanced_scores():
+    rig = Rig([mknode("empty", cpu="10", mem="10Gi"),
+               mknode("half", cpu="10", mem="10Gi")],
+              scheduled=[mkpod("busy", cpu="5", mem="5Gi", node_name="half")])
+    pod = mkpod("p", cpu="1", mem="1Gi")
+    pf = unbatch(rig.pod_features(pod))
+    least = rig.mask_by_name_float(OS.least_allocated(rig.ct, pf)) \
+        if hasattr(rig, "mask_by_name_float") else None
+    s = np.asarray(OS.least_allocated(rig.ct, pf))
+    by = {n: s[r] for n, r in zip(rig.names, rig.rows)}
+    # empty node: frac = (100m? no: 1000m/10000m)=0.1, mem 1/10 -> least = 90
+    assert by["empty"] > by["half"]
+    assert abs(by["empty"] - 90.0) < 1.0
+    s = np.asarray(OS.most_allocated(rig.ct, pf))
+    by = {n: s[r] for n, r in zip(rig.names, rig.rows)}
+    assert by["half"] > by["empty"]
+    # balanced: both fractions equal on each node -> std 0 -> 100 for both
+    s = np.asarray(OS.balanced_allocation(rig.ct, pf))
+    by = {n: s[r] for n, r in zip(rig.names, rig.rows)}
+    assert abs(by["empty"] - 100.0) < 0.5 and abs(by["half"] - 100.0) < 0.5
+
+
+def test_preferred_node_affinity_score():
+    rig = Rig(AFFINITY_NODES)
+    pod = _affinity_pod(preferred=[
+        PreferredSchedulingTerm(weight=5, preference=NodeSelectorTerm(
+            match_expressions=[NodeSelectorRequirement("disk", "In", ["ssd"])])),
+        PreferredSchedulingTerm(weight=2, preference=NodeSelectorTerm(
+            match_expressions=[NodeSelectorRequirement("zone", "Exists")])),
+    ])
+    pf = unbatch(rig.pod_features(pod))
+    s = np.asarray(OS.node_affinity_score(rig.ct, pf))
+    by = {n: s[r] for n, r in zip(rig.names, rig.rows)}
+    assert by == {"ssd-east": 7.0, "hdd-west": 2.0, "bare": 0.0}
+
+
+def test_taint_toleration_score():
+    rig = Rig([mknode("clean"), mknode("soft", taints=[
+        Taint("a", "PreferNoSchedule"), Taint("b", "PreferNoSchedule")])])
+    pf = unbatch(rig.pod_features(mkpod("p")))
+    s = np.asarray(OS.taint_toleration_score(rig.ct, pf))
+    by = {n: s[r] for n, r in zip(rig.names, rig.rows)}
+    assert by == {"clean": 0.0, "soft": 2.0}
+
+
+def test_image_locality_score():
+    import jax.numpy as jnp
+    big = 800 * 1024 * 1024
+    rig = Rig([mknode("has", images=[("redis:7", big)]), mknode("not")])
+    pf = unbatch(rig.pod_features(mkpod("p", image="redis:7")))
+    s = np.asarray(OS.image_locality(rig.ct, pf, jnp.int32(2)))
+    by = {n: s[r] for n, r in zip(rig.names, rig.rows)}
+    assert by["has"] > by["not"] == 0.0
