@@ -1,0 +1,88 @@
+"""Headline benchmark: batched scheduling throughput at 5k nodes.
+
+Mirrors the reference's scheduler_perf SchedulingBasic/5000Nodes_10000Pods
+workload (test/integration/scheduler_perf/misc/performance-config.yaml:63,
+CI threshold 270 pods/s): 5000 nodes, pending pods drained in batches of 256
+through the device pipeline (pack → one XLA launch per batch → winners back).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is the multiple of the reference's 270 pods/s threshold.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_repo = os.path.dirname(os.path.abspath(__file__))
+if _repo not in sys.path:
+    sys.path.insert(0, _repo)
+
+BASELINE_PODS_PER_SEC = 270.0  # misc/performance-config.yaml:63
+NUM_NODES = 5000
+NUM_PODS = 10000
+BATCH = 256
+
+
+def main() -> None:
+    from kubernetes_tpu.utils import jaxsetup
+
+    jaxsetup.setup(os.path.join(_repo, ".jax_cache"))
+    import jax
+
+    from kubernetes_tpu.models.pipeline import default_weights, schedule_batch_jit
+    from kubernetes_tpu.models.testbed import build_cluster, make_pod
+    from kubernetes_tpu.ops.features import Capacities
+
+    t0 = time.time()
+    caps = Capacities(nodes=8192, pods=16384)
+    cache, snap, mirror = build_cluster(NUM_NODES, caps=caps)
+    cblobs = mirror.to_blobs()
+    wk = mirror.well_known()
+    weights = default_weights()
+    pods = [make_pod(i) for i in range(NUM_PODS)]
+    print(f"setup {time.time() - t0:.1f}s on {jax.devices()[0].platform}",
+          file=sys.stderr)
+
+    # warmup / compile
+    warm = mirror.pack_batch_blobs(pods[:BATCH], BATCH)
+    t0 = time.time()
+    jax.block_until_ready(schedule_batch_jit(cblobs, warm, wk, weights, caps))
+    print(f"compile+first-run {time.time() - t0:.1f}s", file=sys.stderr)
+
+    t0 = time.time()
+    scheduled = 0
+    for start in range(0, NUM_PODS, BATCH):
+        chunk = pods[start:start + BATCH]
+        pblobs = mirror.pack_batch_blobs(chunk, BATCH)
+        out = schedule_batch_jit(cblobs, pblobs, wk, weights, caps)
+        rows = out.node_row[: len(chunk)]
+        # commit winners through the production assume->snapshot->mirror path
+        # so every batch schedules against the progressively filled cluster
+        # (the serial loop's assume step, schedule_one.go:938)
+        for pod, row in zip(chunk, rows.tolist()):
+            if row < 0:
+                continue
+            scheduled += 1
+            bound = pod.clone()
+            bound.spec.node_name = mirror.name_of_row(row)
+            cache.assume_pod(bound)
+        cache.update_snapshot(snap)
+        mirror.sync(snap)
+        cblobs = mirror.to_blobs()
+    elapsed = time.time() - t0
+    assert scheduled == NUM_PODS, f"only {scheduled}/{NUM_PODS} pods placed"
+
+    pods_per_sec = NUM_PODS / elapsed
+    print(json.dumps({
+        "metric": "scheduling_throughput_5000nodes",
+        "value": round(pods_per_sec, 1),
+        "unit": "pods/sec",
+        "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -78,19 +78,19 @@ class Mirror:
         self.node_codec, self.table_codec, self.pod_codec = codecs(caps)
         self.node_f32, self.node_i32 = self.node_codec.alloc(caps.nodes)
         _, self.pods_i32 = self.table_codec.alloc(caps.pods)
-        self.vocab = np.full((caps.vocab,), np.nan, np.float32)
         self._row_of: dict[str, int] = {}        # node name -> row
         self._row_gen: dict[str, int] = {}       # node name -> packed generation
         self._free_rows: list[int] = list(range(caps.nodes - 1, -1, -1))
         self._ext_index: dict[str, int] = {}     # extended resource -> column
         self._pod_slot: dict[str, int] = {}      # pod uid -> pod-table slot
         self._node_pods: dict[str, dict[str, int]] = {}  # node -> uid -> slot
-        self._pod_obj_id: dict[str, int] = {}    # uid -> id(pod) packed (change detect)
+        # uid -> packed Pod object, held strongly so identity comparison is a
+        # sound change detector (a bare id() could be reused after GC)
+        self._pod_obj: dict[str, Pod] = {}
         self._node_of_pod: dict[str, str] = {}   # uid -> node name
         self._free_slots: list[int] = list(range(caps.pods - 1, -1, -1))
-        self._vocab_len = 0
         self._row_names: list[str | None] = [None] * caps.nodes
-        self._dirty = {"node": True, "pods": True, "vocab": True}
+        self._dirty = {"node": True, "pods": True}
         self._dev: dict[str, jax.Array] = {}
         # stable well-known ids, interned up front
         self.wk_unschedulable_key = self._i(TAINT_UNSCHEDULABLE)
@@ -105,10 +105,9 @@ class Mirror:
     # ------------- interning helpers -------------
 
     def _i(self, s: str) -> int:
-        i = self.interner.intern(s)
-        if i >= self.caps.vocab:
-            raise CapacityError("vocab", i + 1)
-        return i
+        # ids are unbounded: no device-side vocab table exists (numeric label
+        # values ride the per-node label_nums column instead)
+        return self.interner.intern(s)
 
     def ext_col(self, resource_name: str) -> int:
         col = self._ext_index.get(resource_name)
@@ -166,6 +165,10 @@ class Mirror:
         f["node_name_id"] = np.int32(self._i(node.metadata.name))
         f["label_keys"], f["label_vals"] = self._pairs(
             node.metadata.labels, caps.node_labels, "node_labels")
+        nums = np.full((caps.node_labels,), np.nan, np.float32)
+        for idx in range(len(node.metadata.labels)):
+            nums[idx] = self.interner.numeric(int(f["label_vals"][idx]))
+        f["label_nums"] = nums
         if len(node.spec.taints) > caps.node_taints:
             raise CapacityError("node_taints", len(node.spec.taints))
         tk = np.full((caps.node_taints,), NONE, np.int32)
@@ -210,7 +213,7 @@ class Mirror:
         for pi in info.pods:
             uid = pi.pod.metadata.uid
             if (uid not in current
-                    or self._pod_obj_id.get(uid) != id(pi.pod)):
+                    or self._pod_obj.get(uid) is not pi.pod):
                 # new on this node, moved here, or the pod object was replaced
                 # (update): repack. Releasing first also covers the
                 # moved-before-source-reconciled ordering.
@@ -244,7 +247,7 @@ class Mirror:
         self.table_codec.pack_into(empty_f32, self.pods_i32[slot], f)
         self._pod_slot[uid] = slot
         self._node_pods[node_name][uid] = slot
-        self._pod_obj_id[uid] = id(pod)
+        self._pod_obj[uid] = pod
         self._node_of_pod[uid] = node_name
 
     def _pack_aff_term(self, term: PodAffinityTerm, pod: Pod,
@@ -287,7 +290,7 @@ class Mirror:
             return
         self.pods_i32[slot] = 0  # pod_valid -> False, rest zeroed
         self._free_slots.append(slot)
-        self._pod_obj_id.pop(uid, None)
+        self._pod_obj.pop(uid, None)
         node = self._node_of_pod.pop(uid, None)
         if node is not None:
             self._node_pods.get(node, {}).pop(uid, None)
@@ -331,12 +334,6 @@ class Mirror:
         if repacked:
             self._dirty["node"] = True
             self._dirty["pods"] = True
-        # vocab numeric side-table
-        if len(self.interner) != self._vocab_len:
-            table = self.interner.numeric_table()
-            self.vocab[: len(table)] = np.asarray(table, np.float32)
-            self._vocab_len = len(table)
-            self._dirty["vocab"] = True
         return repacked
 
     def to_blobs(self) -> ClusterBlobs:
@@ -349,13 +346,9 @@ class Mirror:
         if self._dirty["pods"] or "pods_i32" not in self._dev:
             self._dev["pods_i32"] = jnp.asarray(self.pods_i32)
             self._dirty["pods"] = False
-        if self._dirty["vocab"] or "vocab" not in self._dev:
-            self._dev["vocab"] = jnp.asarray(self.vocab)
-            self._dirty["vocab"] = False
         return ClusterBlobs(node_f32=self._dev["node_f32"],
                             node_i32=self._dev["node_i32"],
-                            pods_i32=self._dev["pods_i32"],
-                            vocab_numeric=self._dev["vocab"])
+                            pods_i32=self._dev["pods_i32"])
 
     def to_device(self) -> ClusterTensors:
         """ClusterTensors view (single jitted unpack dispatch) — test/tooling

@@ -1,0 +1,7 @@
+from kubernetes_tpu.models.pipeline import (  # noqa: F401
+    BatchResult,
+    DEFAULT_WEIGHTS,
+    ScoreWeights,
+    schedule_batch,
+    schedule_batch_jit,
+)

@@ -1,0 +1,218 @@
+"""The flagship model: one XLA launch schedules a whole batch of pods.
+
+This replaces the reference's serial per-pod hot path — ``schedulingCycle`` →
+``findNodesThatPassFilters`` (goroutine fan-out over nodes,
+schedule_one.go:583-650) → ``prioritizeNodes`` (3-stage score pipeline,
+runtime/framework.go:1117-1194) → ``selectHost`` (schedule_one.go:865) →
+``assume`` (schedule_one.go:938) — with a single jitted program in two
+phases:
+
+1. **Parallel phase** (vmap over the pod batch): every Filter and raw Score
+   whose result cannot be changed by in-batch placements — taints, node
+   affinity/selectors, host ports, unschedulable, image locality — is
+   evaluated for ALL (pod, node) pairs at once. This is where the FLOPs
+   are, and it is embarrassingly parallel over both axes.
+2. **Commit scan** (lax.scan over pods): a deliberately tiny sequential
+   pass that re-evaluates only what a previous pod's commit can invalidate
+   — the resource fit predicate and the utilization scores — then
+   normalizes, aggregates, argmaxes, and commits the winner's resources to
+   the scan carry. Pod b+1 therefore sees pod b's placement exactly as the
+   serial loop's assume step would provide ("as-if-serial").
+
+The node axis is the sharding axis: under a ``jax.sharding.Mesh`` the
+per-node work is data-parallel; argmax and normalization reductions become
+XLA collectives over ICI (SURVEY.md §5.8).
+
+Filter order follows the reference's default plugin order
+(apis/config/v1/default_plugins.go:30-58); a node's rejection is attributed
+to its FIRST failing plugin, mirroring RunFilterPlugins' short-circuit
+(runtime/framework.go:877-922) so Diagnosis/FitError parity holds.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_tpu.ops import common as C
+from kubernetes_tpu.ops import filters as FL
+from kubernetes_tpu.ops import scores as SC
+from kubernetes_tpu.ops.features import (
+    Capacities,
+    ClusterBlobs,
+    ClusterTensors,
+    PodBlobs,
+    PodFeatures,
+    unpack_cluster,
+    unpack_pods,
+)
+
+# --- filter plugin order (first-fail attribution; default_plugins.go) ---
+
+FILTER_PLUGINS = (
+    "NodeUnschedulable",
+    "NodeName",
+    "TaintToleration",
+    "NodeAffinity",
+    "NodePorts",
+    "NodeResourcesFit",
+)
+NUM_FILTER_PLUGINS = len(FILTER_PLUGINS)
+
+# --- score plugin set with default weights (default_plugins.go:30-58) ---
+
+SCORE_PLUGINS = (
+    "TaintToleration",            # w=3, inverse-normalized
+    "NodeAffinity",               # w=2, max-normalized
+    "NodeResourcesFit",           # w=1, least-allocated 0..100
+    "NodeResourcesBalancedAllocation",  # w=1, 0..100
+    "ImageLocality",              # w=1, 0..100
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class ScoreWeights:
+    """Per-plugin score weights (scorePluginWeight, runtime/framework.go:57).
+    A dynamic arg — changing weights does not recompile."""
+
+    taint_toleration: jax.Array
+    node_affinity: jax.Array
+    resources_fit: jax.Array
+    balanced_allocation: jax.Array
+    image_locality: jax.Array
+
+
+def default_weights() -> ScoreWeights:
+    return ScoreWeights(
+        taint_toleration=jnp.float32(3.0),
+        node_affinity=jnp.float32(2.0),
+        resources_fit=jnp.float32(1.0),
+        balanced_allocation=jnp.float32(1.0),
+        image_locality=jnp.float32(1.0),
+    )
+
+
+DEFAULT_WEIGHTS = default_weights
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class BatchResult:
+    """Per-pod outcome of one batched launch."""
+
+    node_row: jax.Array        # [B] i32: chosen node row, -1 = unschedulable
+    score: jax.Array           # [B] f32: winning aggregate score
+    feasible_count: jax.Array  # [B] i32: nodes passing all filters
+    reject_counts: jax.Array   # [B, P] i32: nodes rejected per plugin (first-fail)
+    unresolvable_count: jax.Array  # [B] i32: nodes where fit can never succeed
+
+
+def static_filters(ct: ClusterTensors, pod: PodFeatures,
+                   wk: dict[str, jnp.ndarray]) -> jnp.ndarray:
+    """Commit-invariant Filter plugins for one pod over all nodes: [P-1, N]
+    masks in FILTER_PLUGINS order (NodeResourcesFit runs in the commit scan).
+    """
+    return jnp.stack([
+        FL.node_unschedulable(ct, pod, wk["unschedulable_taint_key"]),
+        FL.node_name(ct, pod),
+        FL.taint_toleration(ct, pod),
+        FL.node_affinity(ct, pod),
+        FL.node_ports(ct, pod, wk["wildcard_ip"]),
+    ])
+
+
+def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
+                   wk: dict[str, jnp.ndarray], weights: ScoreWeights,
+                   caps: Capacities) -> BatchResult:
+    """Schedule a whole pod batch in one launch, as-if-serial (see module
+    docstring for the two-phase structure)."""
+    ct = unpack_cluster(cblobs, caps)
+    pods = unpack_pods(pblobs, caps)  # leaves [B, ...]
+    num_valid = jnp.sum(ct.node_valid)
+    valid = ct.node_valid
+
+    # ---- phase 1: parallel over the batch ----
+    def per_pod(pod: PodFeatures):
+        masks = static_filters(ct, pod, wk)                    # [P-1, N]
+        static_ok = jnp.all(masks, axis=0) & valid & pod.valid  # [N]
+        # first-fail attribution among the static plugins
+        prev_ok = jnp.cumprod(
+            jnp.concatenate([jnp.ones((1, masks.shape[1]), masks.dtype),
+                             masks[:-1]], axis=0), axis=0).astype(bool)
+        first_fail = prev_ok & ~masks & valid[None]
+        static_rejects = jnp.sum(first_fail, axis=1).astype(jnp.int32)  # [P-1]
+        # raw commit-invariant scores
+        taint_raw = SC.taint_toleration_score(ct, pod)         # [N]
+        aff_raw = SC.node_affinity_score(ct, pod)              # [N]
+        img = SC.image_locality(ct, pod, num_valid)            # [N]
+        # fit can never succeed: request exceeds allocatable (Unresolvable)
+        unresolvable = jnp.any(pod.req[None] > ct.allocatable, axis=-1)
+        unres_count = jnp.sum(unresolvable & valid).astype(jnp.int32)
+        return static_ok, static_rejects, taint_raw, aff_raw, img, unres_count
+
+    static_ok, static_rejects, taint_raw, aff_raw, img, unres = jax.vmap(
+        per_pod)(pods)
+
+    # ---- phase 2: sequential commit scan (tiny per-step work) ----
+    alloc2 = SC.alloc_cpu_mem(ct)                               # [N, 2]
+    B = pblobs.f32.shape[0]
+    # pairwise hostPort conflicts: pod j can't join a node where an earlier
+    # conflicting batch pod was committed (as-if-serial NodePorts)
+    port_conf = FL.pod_pair_port_conflict(pods, wk["wildcard_ip"])  # [B, B]
+
+    def body(carry, xs):
+        free, nzr, committed_rows = carry
+        b, ok_s, t_raw, a_raw, im, req, nzreq = xs
+        fit_ok = jnp.all(req[None] <= free, axis=-1)            # [N]
+        # nodes holding an earlier batch commit that clashes on hostPort
+        clash = port_conf[b] & (committed_rows >= 0)            # [B]
+        forbidden = jnp.zeros_like(fit_ok).at[
+            jnp.maximum(committed_rows, 0)].max(clash)          # [N]
+        ports_ok = ~forbidden
+        feasible = ok_s & ports_ok & fit_ok
+        frac = SC.utilization_fractions(alloc2, nzr, nzreq)
+        least = SC.least_allocated_from_fractions(frac)
+        bal = SC.balanced_allocation_from_fractions(frac)
+        taint = SC.normalize_inverse(t_raw, feasible)
+        aff = SC.normalize_max(a_raw, feasible)
+        total = (weights.taint_toleration * taint
+                 + weights.node_affinity * aff
+                 + weights.resources_fit * least
+                 + weights.balanced_allocation * bal
+                 + weights.image_locality * im)
+        row = C.masked_argmax_first(total, feasible)
+        # commit the winner (the "assume"): free -= request, nonzero += request
+        do = row >= 0
+        r = jnp.maximum(row, 0)
+        free = free.at[r].add(jnp.where(do, -req, 0.0))
+        nzr = nzr.at[r].add(jnp.where(do, nzreq, 0.0))
+        committed_rows = committed_rows.at[b].set(row)
+        # first-fail order: NodePorts (in-batch) before NodeResourcesFit
+        port_rejects = jnp.sum(ok_s & ~ports_ok).astype(jnp.int32)
+        fit_rejects = jnp.sum(ok_s & ports_ok & ~fit_ok).astype(jnp.int32)
+        win = jnp.where(do, total[r], 0.0)
+        return (free, nzr, committed_rows), (
+            row, win, jnp.sum(feasible).astype(jnp.int32),
+            port_rejects, fit_rejects)
+
+    xs = (jnp.arange(B), static_ok, taint_raw, aff_raw, img,
+          pods.req, pods.nonzero_req)
+    init = (ct.free, ct.nonzero_requested, jnp.full((B,), -1, jnp.int32))
+    _, (rows, win_scores, feas, port_rejects, fit_rejects) = jax.lax.scan(
+        body, init, xs)
+
+    ports_idx = FILTER_PLUGINS.index("NodePorts")
+    static_rejects = static_rejects.at[:, ports_idx].add(port_rejects)
+    reject_counts = jnp.concatenate(
+        [static_rejects, fit_rejects[:, None]], axis=1)
+    return BatchResult(node_row=rows, score=win_scores, feasible_count=feas,
+                       reject_counts=reject_counts, unresolvable_count=unres)
+
+
+@partial(jax.jit, static_argnames=("caps",))
+def schedule_batch_jit(cblobs, pblobs, wk, weights, caps):
+    return schedule_batch(cblobs, pblobs, wk, weights, caps)

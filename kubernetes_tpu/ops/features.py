@@ -117,6 +117,8 @@ class ClusterTensors:
     # labels (padded pairs)
     label_keys: jax.Array        # [N, L] i32
     label_vals: jax.Array        # [N, L] i32
+    label_nums: jax.Array        # [N, L] f32 numeric label value (NaN if not int)
+                                 # — avoids a huge vocab gather in Gt/Lt matching
     # taints
     taint_keys: jax.Array        # [N, T] i32
     taint_vals: jax.Array        # [N, T] i32
@@ -139,8 +141,6 @@ class ClusterTensors:
     pod_anti_ns: jax.Array       # [PT, A, NS] i32 namespace ids the term selects
     pod_anti_sel_keys: jax.Array  # [PT, A, MS] i32 matchLabels keys
     pod_anti_sel_vals: jax.Array  # [PT, A, MS] i32 matchLabels values
-    # vocab side-table: interned id -> numeric value (NaN if not integer)
-    vocab_numeric: jax.Array     # [V] f32
 
 
 def node_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
@@ -150,6 +150,7 @@ def node_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
         "allocatable": ((r,), "f32"),
         "free": ((r,), "f32"),
         "nonzero_requested": ((2,), "f32"),
+        "label_nums": ((caps.node_labels,), "f32"),
         "image_sizes": ((caps.node_images,), "f32"),
         "node_valid": ((), "bool"),
         "unschedulable": ((), "bool"),
@@ -338,7 +339,6 @@ class ClusterBlobs:
     node_f32: jax.Array   # [N, nf]
     node_i32: jax.Array   # [N, ni]
     pods_i32: jax.Array   # [PT, pi] (pod table has no f32 fields)
-    vocab_numeric: jax.Array  # [V] f32
 
 
 @_register
@@ -375,7 +375,6 @@ def unpack_cluster(blobs: ClusterBlobs, caps: Capacities) -> ClusterTensors:
     fields = node_codec.unpack(Blobs(f32=blobs.node_f32, i32=blobs.node_i32))
     empty = jnp.zeros(blobs.pods_i32.shape[:-1] + (0,), jnp.float32)
     fields.update(table_codec.unpack(Blobs(f32=empty, i32=blobs.pods_i32)))
-    fields["vocab_numeric"] = blobs.vocab_numeric
     return ClusterTensors(**fields)
 
 

@@ -87,8 +87,16 @@ def _selector_match(ct: ClusterTensors, keys, ops, is_field, vals, nums):
     present = jnp.where(is_field[None], True, present)
 
     in_vals = C.isin(val, vals[None])                    # [N, T, E]
-    num_val = ct.vocab_numeric[jnp.clip(val, 0, ct.vocab_numeric.shape[0] - 1)]
-    num_ok = ~jnp.isnan(num_val) & ~jnp.isnan(nums[None])
+    # Gt/Lt: numeric label value from the packed per-node table (label_nums)
+    # instead of a [N, T, E]-sized gather into the vocab table, which is the
+    # single most expensive op on TPU at 5k nodes x 256 pods. matchFields
+    # (metadata.name) Gt/Lt is not supported (invalid per reference
+    # validation: matchFields only allows metadata.name with In/NotIn).
+    lnum = ct.label_nums[(slice(None),) + lead]          # [N, 1, 1, L]
+    numeric = eq & ~jnp.isnan(lnum)                      # [N, T, E, L]
+    num_val = jnp.max(jnp.where(numeric, lnum, -jnp.inf), axis=-1)
+    num_ok = (jnp.any(numeric, axis=-1) & ~jnp.isnan(nums[None])
+              & ~is_field[None])
     gt = num_ok & (num_val > nums[None])
     lt = num_ok & (num_val < nums[None])
 
@@ -136,6 +144,26 @@ def node_ports(ct: ClusterTensors, pod: PodFeatures,
     ip_clash = (nip == pip) | (nip == wildcard_ip) | (pip == wildcard_ip)
     conflict = same & ip_clash
     return ~jnp.any(conflict, axis=(1, 2))
+
+
+def pod_pair_port_conflict(pods: PodFeatures,
+                           wildcard_ip: jnp.ndarray) -> jnp.ndarray:
+    """[B, B] bool: would pods i and j conflict on host ports if co-located?
+    Wildcard-IP semantics as types.go:1291 CheckConflict.
+
+    Used by the batched commit scan to preserve as-if-serial NodePorts
+    semantics inside one launch: pod j may not land on a node where an
+    earlier batch pod i with a conflicting hostPort was just committed."""
+    pp = pods.hp_port
+    a_port = pp[:, None, :, None]
+    b_port = pp[None, :, None, :]
+    a_proto = pods.hp_proto[:, None, :, None]
+    b_proto = pods.hp_proto[None, :, None, :]
+    a_ip = pods.hp_ip[:, None, :, None]
+    b_ip = pods.hp_ip[None, :, None, :]
+    same = (a_port != NONE) & (a_port == b_port) & (a_proto == b_proto)
+    ip_clash = (a_ip == b_ip) | (a_ip == wildcard_ip) | (b_ip == wildcard_ip)
+    return jnp.any(same & ip_clash, axis=(2, 3))
 
 
 def resources_fit(ct: ClusterTensors, pod: PodFeatures

@@ -31,21 +31,43 @@ from kubernetes_tpu.utils.interner import NONE
 MAX_NODE_SCORE = 100.0
 
 
-def _requested_fractions(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
+def utilization_fractions(alloc2: jnp.ndarray, nonzero_requested: jnp.ndarray,
+                          pod_nonzero_req: jnp.ndarray) -> jnp.ndarray:
     """(NonZeroRequested + pod nonzero request) / allocatable for cpu, memory.
-    [N, 2], clamped to [0, 1]; allocatable 0 -> fraction 1."""
-    alloc = jnp.stack([ct.allocatable[:, COL_CPU], ct.allocatable[:, COL_MEM]],
-                      axis=-1)
-    req = ct.nonzero_requested + pod.nonzero_req[None]
-    frac = jnp.where(alloc > 0, req / jnp.maximum(alloc, 1e-9), 1.0)
+    [N, 2], clamped to [0, 1]; allocatable 0 -> fraction 1.
+
+    Parameterized on the live ``nonzero_requested`` so the batched commit
+    scan can feed its carry instead of the static snapshot column."""
+    req = nonzero_requested + pod_nonzero_req[None]
+    frac = jnp.where(alloc2 > 0, req / jnp.maximum(alloc2, 1e-9), 1.0)
     return jnp.clip(frac, 0.0, 1.0)
 
 
-def least_allocated(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
-    """mean over {cpu, mem} of (allocatable - requested)/allocatable * 100
-    (least_allocated.go:30, default weights 1/1)."""
-    frac = _requested_fractions(ct, pod)
+def least_allocated_from_fractions(frac: jnp.ndarray) -> jnp.ndarray:
+    """mean over {cpu, mem} of (1 - utilization) * 100 (least_allocated.go:30,
+    default weights 1/1)."""
     return jnp.mean(1.0 - frac, axis=-1) * MAX_NODE_SCORE
+
+
+def balanced_allocation_from_fractions(frac: jnp.ndarray) -> jnp.ndarray:
+    """(1 - std(fractions)) * 100 (balanced_allocation.go)."""
+    mean = jnp.mean(frac, axis=-1, keepdims=True)
+    std = jnp.sqrt(jnp.mean((frac - mean) ** 2, axis=-1))
+    return (1.0 - std) * MAX_NODE_SCORE
+
+
+def alloc_cpu_mem(ct: ClusterTensors) -> jnp.ndarray:
+    return jnp.stack([ct.allocatable[:, COL_CPU], ct.allocatable[:, COL_MEM]],
+                     axis=-1)
+
+
+def _requested_fractions(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
+    return utilization_fractions(alloc_cpu_mem(ct), ct.nonzero_requested,
+                                 pod.nonzero_req)
+
+
+def least_allocated(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
+    return least_allocated_from_fractions(_requested_fractions(ct, pod))
 
 
 def most_allocated(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
@@ -54,12 +76,7 @@ def most_allocated(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
 
 
 def balanced_allocation(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
-    """score = (1 - std(fractions)) * 100 over cpu/mem utilization after
-    placing the pod (balanced_allocation.go)."""
-    frac = _requested_fractions(ct, pod)
-    mean = jnp.mean(frac, axis=-1, keepdims=True)
-    std = jnp.sqrt(jnp.mean((frac - mean) ** 2, axis=-1))
-    return (1.0 - std) * MAX_NODE_SCORE
+    return balanced_allocation_from_fractions(_requested_fractions(ct, pod))
 
 
 def node_affinity_score(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:

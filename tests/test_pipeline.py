@@ -1,0 +1,110 @@
+"""Batched pipeline: as-if-serial commit semantics + driver entry points.
+
+The key property (SURVEY.md §7.2 hard part 2): scheduling a batch in one
+launch must produce the same placements as running the serial loop pod by
+pod with an assume between pods (schedule_one.go:65 comment)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.models.pipeline import (
+    FILTER_PLUGINS,
+    default_weights,
+    schedule_batch_jit,
+)
+from kubernetes_tpu.models.testbed import build_cluster, make_pod
+from kubernetes_tpu.ops.features import Capacities
+
+CAPS = Capacities(nodes=16, pods=64)
+
+
+def _run(mirror, pods, batch=8):
+    return schedule_batch_jit(mirror.to_blobs(),
+                              mirror.pack_batch_blobs(pods, batch),
+                              mirror.well_known(), default_weights(), CAPS)
+
+
+def test_batch_places_all_when_space():
+    _, snap, mirror = build_cluster(4, caps=CAPS)
+    pods = [make_pod(i) for i in range(6)]
+    out = _run(mirror, pods)
+    rows = np.asarray(out.node_row)
+    assert (rows[:6] >= 0).all()
+    assert (rows[6:] == -1).all()  # padding rows stay unscheduled
+    assert (np.asarray(out.feasible_count)[:6] == 4).all()
+
+
+def test_in_batch_resource_exhaustion():
+    """Nodes fit exactly one big pod each: the batch must spread, and the
+    (n+1)th big pod must be unschedulable — proves pod b sees pod b-1's
+    commit inside one launch."""
+    _, snap, mirror = build_cluster(3, caps=CAPS)
+    pods = [make_pod(i, cpu="20", mem="100Gi") for i in range(4)]  # node: 32 cpu
+    out = _run(mirror, pods)
+    rows = np.asarray(out.node_row)[:4]
+    assert (rows[:3] >= 0).all()
+    assert len(set(rows[:3].tolist())) == 3, "one big pod per node"
+    assert rows[3] == -1, "fourth big pod must not fit anywhere"
+    # first-fail attribution: rejected by NodeResourcesFit
+    fit_idx = FILTER_PLUGINS.index("NodeResourcesFit")
+    assert np.asarray(out.reject_counts)[3, fit_idx] == 3
+
+
+def test_in_batch_host_port_conflict():
+    """Two pods with the same hostPort in one batch must not co-locate
+    (as-if-serial NodePorts, types.go:1291)."""
+    from kubernetes_tpu.api.objects import Container, ContainerPort
+
+    _, snap, mirror = build_cluster(2, caps=CAPS)
+    pods = []
+    for i in range(3):
+        p = make_pod(i)
+        p.spec.containers[0].ports = [ContainerPort(host_port=8080)]
+        pods.append(p)
+    out = _run(mirror, pods)
+    rows = np.asarray(out.node_row)[:3]
+    assert rows[0] >= 0 and rows[1] >= 0
+    assert rows[0] != rows[1], "same hostPort pods must spread"
+    assert rows[2] == -1, "third pod: both nodes' port taken in-batch"
+    ports_idx = FILTER_PLUGINS.index("NodePorts")
+    assert np.asarray(out.reject_counts)[2, ports_idx] == 2
+
+
+def test_matches_serial_oracle():
+    """One launch over B pods == B launches of batch-size-1 with host-side
+    re-sync between them."""
+    pods = [make_pod(i, cpu="3", mem="1Gi") for i in range(10)]
+
+    _, _, mirror = build_cluster(5, caps=CAPS)
+    batched = np.asarray(_run(mirror, pods, batch=16).node_row)[:10]
+
+    cache2, snap2, mirror2 = build_cluster(5, caps=CAPS)
+    serial = []
+    for p in pods:
+        out = _run(mirror2, [p], batch=1)
+        row = int(out.node_row[0])
+        serial.append(row)
+        if row >= 0:
+            name = mirror2.name_of_row(row)
+            p2 = p.clone()
+            p2.spec.node_name = name
+            cache2.assume_pod(p2)
+            cache2.update_snapshot(snap2)
+            mirror2.sync(snap2)
+    assert batched.tolist() == serial
+
+
+def test_graft_entry_compiles():
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    assert (np.asarray(out.node_row) >= 0).all()
+
+
+def test_dryrun_multichip():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(min(8, len(jax.devices())))
