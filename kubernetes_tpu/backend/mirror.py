@@ -82,6 +82,10 @@ class Mirror:
         self._row_gen: dict[str, int] = {}       # node name -> packed generation
         self._free_rows: list[int] = list(range(caps.nodes - 1, -1, -1))
         self._ext_index: dict[str, int] = {}     # extended resource -> column
+        # columnized node labels: key string -> column; per-column compact
+        # domain ids (value id -> dense domain index, append-only)
+        self._label_col: dict[str, int] = {}
+        self._col_domains: list[dict[int, int]] = []
         self._pod_slot: dict[str, int] = {}      # pod uid -> pod-table slot
         self._node_pods: dict[str, dict[str, int]] = {}  # node -> uid -> slot
         # uid -> packed Pod object, held strongly so identity comparison is a
@@ -108,6 +112,33 @@ class Mirror:
         # ids are unbounded: no device-side vocab table exists (numeric label
         # values ride the per-node label_nums column instead)
         return self.interner.intern(s)
+
+    def label_col(self, key: str) -> int:
+        """Register (or fetch) the label column for a node-label key.
+        Only NODES register columns; pods resolve with label_col_lookup."""
+        col = self._label_col.get(key)
+        if col is None:
+            if len(self._label_col) >= self.caps.label_cols:
+                raise CapacityError("label_cols", len(self._label_col) + 1)
+            self._label_col[key] = col = len(self._label_col)
+            self._col_domains.append({})
+        return col
+
+    def label_col_lookup(self, key: str) -> int:
+        """Column for a key, NONE if no node carries it (the selector then
+        matches no node's label — pods repack every cycle, so a key that
+        appears later is picked up on the next pack)."""
+        return self._label_col.get(key, NONE)
+
+    def domain_id(self, col: int, value_id: int) -> int:
+        """Compact per-column domain index for a label value."""
+        dmap = self._col_domains[col]
+        d = dmap.get(value_id)
+        if d is None:
+            d = dmap[value_id] = len(dmap)
+            if d >= self.caps.domain_cap:
+                raise CapacityError("domains", d + 1)
+        return d
 
     def ext_col(self, resource_name: str) -> int:
         col = self._ext_index.get(resource_name)
@@ -163,12 +194,18 @@ class Mirror:
         f["node_valid"] = np.bool_(True)
         f["unschedulable"] = np.bool_(node.spec.unschedulable)
         f["node_name_id"] = np.int32(self._i(node.metadata.name))
-        f["label_keys"], f["label_vals"] = self._pairs(
-            node.metadata.labels, caps.node_labels, "node_labels")
-        nums = np.full((caps.node_labels,), np.nan, np.float32)
-        for idx in range(len(node.metadata.labels)):
-            nums[idx] = self.interner.numeric(int(f["label_vals"][idx]))
-        f["label_nums"] = nums
+        vals = np.full((caps.label_cols,), NONE, np.int32)
+        doms = np.full((caps.label_cols,), NONE, np.int32)
+        nums = np.full((caps.label_cols,), np.nan, np.float32)
+        for key, value in node.metadata.labels.items():
+            col = self.label_col(key)
+            vid = self._i(value)
+            vals[col] = vid
+            doms[col] = self.domain_id(col, vid)
+            nums[col] = self.interner.numeric(vid)
+        f["label_col_vals"] = vals
+        f["label_col_dom"] = doms
+        f["label_col_nums"] = nums
         if len(node.spec.taints) > caps.node_taints:
             raise CapacityError("node_taints", len(node.spec.taints))
         tk = np.full((caps.node_taints,), NONE, np.int32)
@@ -381,8 +418,14 @@ class Mirror:
         out["name_id"] = np.int32(self._i(pod.metadata.name))
         out["labels_keys"], out["labels_vals"] = self._pairs(
             pod.metadata.labels, caps.pod_labels, "pod_labels")
-        out["nodesel_keys"], out["nodesel_vals"] = self._pairs(
-            pod.spec.node_selector, caps.pod_labels, "pod_labels")
+        if len(pod.spec.node_selector) > caps.pod_labels:
+            raise CapacityError("pod_labels", len(pod.spec.node_selector))
+        ns_cols = np.full((caps.pod_labels,), NONE, np.int32)
+        ns_vals = np.full((caps.pod_labels,), NONE, np.int32)
+        for idx, (k, v) in enumerate(pod.spec.node_selector.items()):
+            ns_cols[idx] = self.label_col_lookup(k)
+            ns_vals[idx] = self._i(v)
+        out["nodesel_cols"], out["nodesel_vals"] = ns_cols, ns_vals
         self._pack_node_affinity(pod, out)
         self._pack_tolerations(pod, out)
         self._pack_host_ports(pod, out)
@@ -403,7 +446,7 @@ class Mirror:
         caps = self.caps
         T, E, V = caps.sel_terms, caps.sel_exprs, caps.sel_vals
         out["sel_term_valid"] = np.zeros((T,), bool)
-        out["sel_key"] = np.full((T, E), NONE, np.int32)
+        out["sel_col"] = np.full((T, E), NONE, np.int32)
         out["sel_op"] = np.full((T, E), NONE, np.int32)
         out["sel_is_field"] = np.zeros((T, E), bool)
         out["sel_vals"] = np.full((T, E, V), NONE, np.int32)
@@ -417,13 +460,13 @@ class Mirror:
                 raise CapacityError("sel_terms", len(terms))
             for ti, term in enumerate(terms):
                 out["sel_term_valid"][ti] = True
-                self._pack_term_exprs(term, out["sel_key"], out["sel_op"],
+                self._pack_term_exprs(term, out["sel_col"], out["sel_op"],
                                       out["sel_is_field"], out["sel_vals"],
                                       out["sel_num"], ti)
         # preferred
         PW = caps.pref_terms
         out["pref_weight"] = np.zeros((PW,), np.int32)
-        out["pref_key"] = np.full((PW, E), NONE, np.int32)
+        out["pref_col"] = np.full((PW, E), NONE, np.int32)
         out["pref_op"] = np.full((PW, E), NONE, np.int32)
         out["pref_is_field"] = np.zeros((PW, E), bool)
         out["pref_vals"] = np.full((PW, E, V), NONE, np.int32)
@@ -434,7 +477,7 @@ class Mirror:
             raise CapacityError("pref_terms", len(preferred))
         for ti, wterm in enumerate(preferred):
             out["pref_weight"][ti] = wterm.weight
-            self._pack_term_exprs(wterm.preference, out["pref_key"],
+            self._pack_term_exprs(wterm.preference, out["pref_col"],
                                   out["pref_op"], out["pref_is_field"],
                                   out["pref_vals"], out["pref_num"], ti)
 
@@ -445,7 +488,9 @@ class Mirror:
         if len(exprs) > caps.sel_exprs:
             raise CapacityError("sel_exprs", len(exprs))
         for ei, (e, fld) in enumerate(exprs):
-            keys[ti, ei] = self._i(e.key)
+            # matchExpressions reference a label COLUMN (NONE if no node
+            # carries the key); matchFields (metadata.name) keep col NONE
+            keys[ti, ei] = NONE if fld else self.label_col_lookup(e.key)
             ops[ti, ei] = F.op_id(e.operator)
             is_field[ti, ei] = fld
             if len(e.values) > caps.sel_vals:

@@ -13,22 +13,6 @@ import jax.numpy as jnp
 from kubernetes_tpu.utils.interner import NONE
 
 
-def node_label_value(label_keys: jnp.ndarray, label_vals: jnp.ndarray,
-                     key: jnp.ndarray) -> jnp.ndarray:
-    """Value id of label `key` per node, NONE where absent.
-
-    label_keys/label_vals: [N, L]; key: scalar (or broadcastable).
-    Label keys are unique per node, so max over matching slots recovers the
-    single value (NONE=-1 loses to any real id).
-    """
-    eq = label_keys == key
-    return jnp.max(jnp.where(eq, label_vals, NONE), axis=-1)
-
-
-def has_label(label_keys: jnp.ndarray, key: jnp.ndarray) -> jnp.ndarray:
-    return jnp.any(label_keys == key, axis=-1)
-
-
 def isin(value: jnp.ndarray, candidates: jnp.ndarray) -> jnp.ndarray:
     """value: [...]; candidates: [..., V] padded with NONE. True if value
     equals any non-NONE candidate."""

@@ -66,7 +66,12 @@ class Capacities:
 
     nodes: int = 1024            # N
     ext_resources: int = 4       # extended/scalar resource columns
-    node_labels: int = 16        # L: labels per node
+    label_cols: int = 32         # K: distinct node-label KEYS cluster-wide.
+                                 # Labels are columnized: one dense value
+                                 # column per key (TPU-native: no per-node
+                                 # key-value pair scans in the kernels)
+    domains: int = 0             # per-column compact domain-id space for
+                                 # topology aggregation; 0 = same as nodes
     node_taints: int = 8         # T
     node_ports: int = 64         # P: occupied host ports per node
     node_images: int = 16        # I
@@ -88,6 +93,10 @@ class Capacities:
     @property
     def res_cols(self) -> int:
         return NUM_NATIVE_COLS + self.ext_resources
+
+    @property
+    def domain_cap(self) -> int:
+        return self.domains or self.nodes
 
 
 def _register(cls):
@@ -114,11 +123,14 @@ class ClusterTensors:
     node_valid: jax.Array        # [N] bool
     unschedulable: jax.Array     # [N] bool
     node_name_id: jax.Array      # [N] i32
-    # labels (padded pairs)
-    label_keys: jax.Array        # [N, L] i32
-    label_vals: jax.Array        # [N, L] i32
-    label_nums: jax.Array        # [N, L] f32 numeric label value (NaN if not int)
-                                 # — avoids a huge vocab gather in Gt/Lt matching
+    # labels, columnized: one column per distinct label KEY cluster-wide.
+    # label_col_vals[n, k] = value id of key k on node n (NONE if absent);
+    # label_col_nums = numeric parse of the value (NaN if absent/non-int,
+    # for Gt/Lt without a vocab gather); label_col_dom = compact per-column
+    # domain id (stable, dense) for topology-domain scatter/aggregation.
+    label_col_vals: jax.Array    # [N, K] i32
+    label_col_nums: jax.Array    # [N, K] f32
+    label_col_dom: jax.Array     # [N, K] i32
     # taints
     taint_keys: jax.Array        # [N, T] i32
     taint_vals: jax.Array        # [N, T] i32
@@ -150,13 +162,13 @@ def node_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
         "allocatable": ((r,), "f32"),
         "free": ((r,), "f32"),
         "nonzero_requested": ((2,), "f32"),
-        "label_nums": ((caps.node_labels,), "f32"),
+        "label_col_nums": ((caps.label_cols,), "f32"),
         "image_sizes": ((caps.node_images,), "f32"),
         "node_valid": ((), "bool"),
         "unschedulable": ((), "bool"),
         "node_name_id": ((), "i32"),
-        "label_keys": ((caps.node_labels,), "i32"),
-        "label_vals": ((caps.node_labels,), "i32"),
+        "label_col_vals": ((caps.label_cols,), "i32"),
+        "label_col_dom": ((caps.label_cols,), "i32"),
         "taint_keys": ((caps.node_taints,), "i32"),
         "taint_vals": ((caps.node_taints,), "i32"),
         "taint_effects": ((caps.node_taints,), "i32"),
@@ -201,15 +213,15 @@ def pod_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
         "name_id": ((), "i32"),
         "labels_keys": ((PL,), "i32"),
         "labels_vals": ((PL,), "i32"),
-        "nodesel_keys": ((PL,), "i32"),
+        "nodesel_cols": ((PL,), "i32"),
         "nodesel_vals": ((PL,), "i32"),
         "sel_term_valid": ((T,), "bool"),
-        "sel_key": ((T, E), "i32"),
+        "sel_col": ((T, E), "i32"),
         "sel_op": ((T, E), "i32"),
         "sel_is_field": ((T, E), "bool"),
         "sel_vals": ((T, E, V), "i32"),
         "pref_weight": ((PW,), "i32"),
-        "pref_key": ((PW, E), "i32"),
+        "pref_col": ((PW, E), "i32"),
         "pref_op": ((PW, E), "i32"),
         "pref_is_field": ((PW, E), "bool"),
         "pref_vals": ((PW, E, V), "i32"),
@@ -267,21 +279,22 @@ class PodFeatures:
     name_id: jax.Array           # i32 scalar (pod name, for debugging)
     labels_keys: jax.Array       # [PL] i32
     labels_vals: jax.Array       # [PL] i32
-    # unified required node selection: spec.nodeSelector (converted to one term
-    # AND-ed into every term? no — nodeSelector is a separate AND) — we encode
-    # spec.nodeSelector as its own conjunction evaluated separately:
-    nodesel_keys: jax.Array      # [PL] i32 (exact-match pairs from spec.nodeSelector)
-    nodesel_vals: jax.Array      # [PL] i32
-    # required node affinity: OR over terms, AND within term
+    # spec.nodeSelector: exact (label-column, value) pairs, ANDed; a pair on a
+    # key no node carries packs col=NONE (matches nothing). Unused slots have
+    # val=NONE.
+    nodesel_cols: jax.Array      # [PL] i32 label-column index (-1 = key unseen)
+    nodesel_vals: jax.Array      # [PL] i32 (-1 = unused slot)
+    # required node affinity: OR over terms, AND within term. Expressions
+    # reference label COLUMNS (host-resolved); unused slots have op=NONE.
     sel_term_valid: jax.Array    # [T] bool
-    sel_key: jax.Array           # [T, E] i32 (-1 = unused expr)
-    sel_op: jax.Array            # [T, E] i32
+    sel_col: jax.Array           # [T, E] i32 (-1 = key unseen cluster-wide)
+    sel_op: jax.Array            # [T, E] i32 (-1 = unused expr)
     sel_is_field: jax.Array      # [T, E] bool (metadata.name matchFields)
     sel_vals: jax.Array          # [T, E, V] i32
     sel_num: jax.Array           # [T, E] f32 (rhs for Gt/Lt)
     # preferred node affinity
     pref_weight: jax.Array       # [PW] i32 (0 = unused)
-    pref_key: jax.Array          # [PW, E] i32
+    pref_col: jax.Array          # [PW, E] i32
     pref_op: jax.Array           # [PW, E] i32
     pref_is_field: jax.Array     # [PW, E] bool
     pref_vals: jax.Array         # [PW, E, V] i32

@@ -67,36 +67,43 @@ def taint_toleration(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
     return ~jnp.any(untolerated, axis=-1)
 
 
-def _selector_match(ct: ClusterTensors, keys, ops, is_field, vals, nums):
-    """match[N, *keys.shape] for node-selector expressions.
+def _take_cols(table: jnp.ndarray, cols: jnp.ndarray,
+               fill) -> jnp.ndarray:
+    """table: [N, K]; cols: [...] i32 column indices (NONE = key unseen
+    cluster-wide). Returns [N, *cols.shape] with `fill` where col is NONE.
 
-    keys/ops/is_field/nums: [T, E]; vals: [T, E, V].
+    Node labels are columnized (one dense value column per distinct label
+    key), so selector evaluation is a cheap gather over K ~ 32 columns
+    instead of a [N, ..., L] pair scan — the hot-path win that makes
+    affinity kernels bandwidth-bound on [N, T, E] rather than [N, T, E, L].
     """
-    lead = (None,) * keys.ndim
-    lk = ct.label_keys[(slice(None),) + lead]            # [N, 1, 1, L]
-    lvs = ct.label_vals[(slice(None),) + lead]
-    k = keys[None, ..., None]                            # [1, T, E, 1]
-    eq = lk == k                                         # [N, T, E, L]
-    present = jnp.any(eq, axis=-1)                       # [N, T, E]
-    label_val = jnp.max(jnp.where(eq, lvs, NONE), axis=-1)  # [N, T, E]
+    k = table.shape[1]
+    safe = jnp.clip(cols, 0, k - 1)
+    out = jnp.take(table, safe.reshape(-1), axis=1)
+    out = out.reshape((table.shape[0],) + cols.shape)
+    return jnp.where(cols[None] >= 0, out, fill)
+
+
+def _selector_match(ct: ClusterTensors, cols, ops, is_field, vals, nums):
+    """match[N, *cols.shape] for node-selector expressions.
+
+    cols/ops/is_field/nums: [T, E]; vals: [T, E, V].
+    """
+    val = _take_cols(ct.label_col_vals, cols, NONE)       # [N, T, E]
+    present = val != NONE
 
     # matchFields: the only supported key is metadata.name -> node name id
-    name_val = ct.node_name_id.reshape((-1,) + (1,) * keys.ndim)  # [N, 1, 1]
-    name_val = jnp.broadcast_to(name_val, eq.shape[:-1])          # [N, T, E]
-    val = jnp.where(is_field[None], name_val, label_val)
+    name_val = ct.node_name_id.reshape((-1,) + (1,) * cols.ndim)  # [N, 1, 1]
+    name_val = jnp.broadcast_to(name_val, val.shape)              # [N, T, E]
+    val = jnp.where(is_field[None], name_val, val)
     present = jnp.where(is_field[None], True, present)
 
     in_vals = C.isin(val, vals[None])                    # [N, T, E]
-    # Gt/Lt: numeric label value from the packed per-node table (label_nums)
-    # instead of a [N, T, E]-sized gather into the vocab table, which is the
-    # single most expensive op on TPU at 5k nodes x 256 pods. matchFields
+    # Gt/Lt: numeric label value from the packed per-column table. matchFields
     # (metadata.name) Gt/Lt is not supported (invalid per reference
     # validation: matchFields only allows metadata.name with In/NotIn).
-    lnum = ct.label_nums[(slice(None),) + lead]          # [N, 1, 1, L]
-    numeric = eq & ~jnp.isnan(lnum)                      # [N, T, E, L]
-    num_val = jnp.max(jnp.where(numeric, lnum, -jnp.inf), axis=-1)
-    num_ok = (jnp.any(numeric, axis=-1) & ~jnp.isnan(nums[None])
-              & ~is_field[None])
+    num_val = _take_cols(ct.label_col_nums, cols, jnp.nan)
+    num_ok = (~jnp.isnan(num_val) & ~jnp.isnan(nums[None]) & ~is_field[None])
     gt = num_ok & (num_val > nums[None])
     lt = num_ok & (num_val < nums[None])
 
@@ -107,20 +114,22 @@ def _selector_match(ct: ClusterTensors, keys, ops, is_field, vals, nums):
             jnp.where(op == OP_DOES_NOT_EXIST, ~present,
             jnp.where(op == OP_GT, present & gt,
             jnp.where(op == OP_LT, present & lt, False))))))
-    return match  # [N, *keys.shape]
+    return match  # [N, *cols.shape]
 
 
 def node_affinity(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
     """spec.nodeSelector (exact pairs, ANDed) AND required node affinity
     (OR over terms, AND within term)."""
-    # nodeSelector pairs
-    sel_ok = C.pairs_subset_of_labels(
-        pod.nodesel_keys[None], pod.nodesel_vals[None],
-        ct.label_keys, ct.label_vals)  # [N]
+    # nodeSelector pairs: node's value in the pair's label column must equal
+    # the pair's value (col NONE -> key on no node -> never matches)
+    node_val = _take_cols(ct.label_col_vals, pod.nodesel_cols, NONE)  # [N, PL]
+    used_pair = pod.nodesel_vals != NONE
+    hit = node_val == pod.nodesel_vals[None]
+    sel_ok = jnp.all(hit | ~used_pair[None], axis=-1)     # [N]
 
-    match = _selector_match(ct, pod.sel_key, pod.sel_op, pod.sel_is_field,
+    match = _selector_match(ct, pod.sel_col, pod.sel_op, pod.sel_is_field,
                             pod.sel_vals, pod.sel_num)  # [N, T, E]
-    used = pod.sel_key != NONE  # [T, E]
+    used = pod.sel_op != NONE  # [T, E]
     term_ok = jnp.all(match | ~used[None], axis=-1)  # [N, T]
     term_nonempty = jnp.any(used, axis=-1)  # [T]
     term_ok = term_ok & term_nonempty[None] & pod.sel_term_valid[None]

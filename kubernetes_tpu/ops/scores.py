@@ -82,9 +82,9 @@ def balanced_allocation(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
 def node_affinity_score(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
     """Sum of weights of matching PreferredSchedulingTerms (raw; normalized by
     max across nodes at aggregation)."""
-    match = _selector_match(ct, pod.pref_key, pod.pref_op, pod.pref_is_field,
+    match = _selector_match(ct, pod.pref_col, pod.pref_op, pod.pref_is_field,
                             pod.pref_vals, pod.pref_num)  # [N, PW, E]
-    used = pod.pref_key != NONE
+    used = pod.pref_op != NONE
     term_ok = jnp.all(match | ~used[None], axis=-1)       # [N, PW]
     term_nonempty = jnp.any(used, axis=-1)                # [PW]
     active = term_nonempty[None] & (pod.pref_weight[None] != 0)
