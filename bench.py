@@ -39,7 +39,6 @@ def main() -> None:
     t0 = time.time()
     caps = Capacities(nodes=8192, pods=16384)
     cache, snap, mirror = build_cluster(NUM_NODES, caps=caps)
-    cblobs = mirror.to_blobs()
     wk = mirror.well_known()
     weights = default_weights()
     pods = [make_pod(i) for i in range(NUM_PODS)]
@@ -47,17 +46,19 @@ def main() -> None:
           file=sys.stderr)
 
     # warmup / compile
-    warm = mirror.pack_batch_blobs(pods[:BATCH], BATCH)
     t0 = time.time()
-    jax.block_until_ready(schedule_batch_jit(cblobs, warm, wk, weights, caps))
+    cblobs, pblobs, topo, d_cap = mirror.prepare_launch(pods[:BATCH], BATCH)
+    jax.block_until_ready(schedule_batch_jit(cblobs, pblobs, wk, weights,
+                                             caps, topo, d_cap))
     print(f"compile+first-run {time.time() - t0:.1f}s", file=sys.stderr)
 
     t0 = time.time()
     scheduled = 0
     for start in range(0, NUM_PODS, BATCH):
         chunk = pods[start:start + BATCH]
-        pblobs = mirror.pack_batch_blobs(chunk, BATCH)
-        out = schedule_batch_jit(cblobs, pblobs, wk, weights, caps)
+        cblobs, pblobs, topo, d_cap = mirror.prepare_launch(chunk, BATCH)
+        out = schedule_batch_jit(cblobs, pblobs, wk, weights, caps,
+                                 topo, d_cap)
         rows = out.node_row[: len(chunk)]
         # commit winners through the production assume->snapshot->mirror path
         # so every batch schedules against the progressively filled cluster
@@ -71,7 +72,6 @@ def main() -> None:
             cache.assume_pod(bound)
         cache.update_snapshot(snap)
         mirror.sync(snap)
-        cblobs = mirror.to_blobs()
     elapsed = time.time() - t0
     assert scheduled == NUM_PODS, f"only {scheduled}/{NUM_PODS} pods placed"
 

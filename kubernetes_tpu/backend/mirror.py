@@ -52,6 +52,14 @@ _unpack_cluster_jit = jax.jit(unpack_cluster, static_argnums=1)
 _unpack_pods_jit = jax.jit(unpack_pods, static_argnums=1)
 
 
+def _scatter_rows(buf, idx, rows):
+    return buf.at[idx].set(rows)
+
+
+# donate the resident buffer: the update happens in place on device
+_scatter_rows_jit = jax.jit(_scatter_rows, donate_argnums=(0,))
+
+
 class CapacityError(Exception):
     """A padded capacity was exceeded; caller should re-bucket (double the
     capacity and re-pack; kernels recompile once per bucket)."""
@@ -82,10 +90,23 @@ class Mirror:
         self._row_gen: dict[str, int] = {}       # node name -> packed generation
         self._free_rows: list[int] = list(range(caps.nodes - 1, -1, -1))
         self._ext_index: dict[str, int] = {}     # extended resource -> column
-        # columnized node labels: key string -> column; per-column compact
-        # domain ids (value id -> dense domain index, append-only)
+        # columnized node labels: key string -> column
         self._label_col: dict[str, int] = {}
-        self._col_domains: list[dict[int, int]] = []
+        # columnized pod labels (separate key space from node labels)
+        self._pod_label_col: dict[str, int] = {}
+        # topology keys in use by any term/constraint: key -> tk index, with
+        # per-tk compact domain ids (value id -> dense domain index) and the
+        # raw node labels per row for backfilling when a NEW topology key
+        # registers after nodes were already packed (rare: hostname/zone/
+        # region are pre-registered below)
+        self._topo_col: dict[str, int] = {}
+        self._tk_key: list[str] = []
+        self._tk_domains: list[dict[int, int]] = []
+        self._row_node_labels: dict[int, dict[str, str]] = {}
+        # topo keys referenced by any packed term/constraint (batch or table):
+        # bounds the domain scatter space a launch actually needs
+        self._used_tks: set[int] = set()
+        self._uids_with_terms: set[str] = set()  # table pods carrying terms
         self._pod_slot: dict[str, int] = {}      # pod uid -> pod-table slot
         self._node_pods: dict[str, dict[str, int]] = {}  # node -> uid -> slot
         # uid -> packed Pod object, held strongly so identity comparison is a
@@ -94,11 +115,22 @@ class Mirror:
         self._node_of_pod: dict[str, str] = {}   # uid -> node name
         self._free_slots: list[int] = list(range(caps.pods - 1, -1, -1))
         self._row_names: list[str | None] = [None] * caps.nodes
-        self._dirty = {"node": True, "pods": True}
+        # incremental device-mirror dirty tracking: per-row/slot sets feed a
+        # scatter-update of the resident HBM buffers (the row-level analog of
+        # the reference's generation-diffed UpdateSnapshot, cache.go:186);
+        # the bool flags force a full re-upload (first sync, topo backfill)
+        self._dirty_full = {"node": True, "pods": True}
+        self._dirty_rows: set[int] = set()
+        self._dirty_slots: set[int] = set()
         self._dev: dict[str, jax.Array] = {}
         # stable well-known ids, interned up front
         self.wk_unschedulable_key = self._i(TAINT_UNSCHEDULABLE)
         self.wk_wildcard_ip = self._i("0.0.0.0")
+        # pre-register the ubiquitous topology keys so backfill never runs
+        # for them (LABEL_HOSTNAME/ZONE/REGION, api.objects)
+        for key in ("kubernetes.io/hostname", "topology.kubernetes.io/zone",
+                    "topology.kubernetes.io/region"):
+            self.topo_col(key)
 
     def well_known(self) -> dict[str, jnp.ndarray]:
         return {
@@ -121,7 +153,6 @@ class Mirror:
             if len(self._label_col) >= self.caps.label_cols:
                 raise CapacityError("label_cols", len(self._label_col) + 1)
             self._label_col[key] = col = len(self._label_col)
-            self._col_domains.append({})
         return col
 
     def label_col_lookup(self, key: str) -> int:
@@ -130,9 +161,43 @@ class Mirror:
         appears later is picked up on the next pack)."""
         return self._label_col.get(key, NONE)
 
-    def domain_id(self, col: int, value_id: int) -> int:
-        """Compact per-column domain index for a label value."""
-        dmap = self._col_domains[col]
+    def pod_label_col(self, key: str) -> int:
+        """Register (or fetch) the pod-label column for a key. Registered
+        from BOTH pod labels and term selectors so that whichever side packs
+        first, the (col, value) match stays consistent."""
+        col = self._pod_label_col.get(key)
+        if col is None:
+            if len(self._pod_label_col) >= self.caps.pod_label_cols:
+                raise CapacityError("pod_label_cols",
+                                    len(self._pod_label_col) + 1)
+            self._pod_label_col[key] = col = len(self._pod_label_col)
+        return col
+
+    def topo_col(self, key: str) -> int:
+        """Register (or fetch) the topology-key index for a term/constraint
+        topology key. A NEW key after nodes were packed backfills the
+        topo_dom column for every packed row from the retained node labels."""
+        tk = self._topo_col.get(key)
+        if tk is not None:
+            return tk
+        if len(self._topo_col) >= self.caps.topo_cols:
+            raise CapacityError("topo_cols", len(self._topo_col) + 1)
+        self._topo_col[key] = tk = len(self._topo_col)
+        self._tk_key.append(key)
+        self._tk_domains.append({})
+        if self._row_node_labels:
+            off, _ = self.node_codec._i32_off["topo_dom"]
+            for row, labels in self._row_node_labels.items():
+                value = labels.get(key)
+                dom = (self.domain_id(tk, self._i(value))
+                       if value is not None else NONE)
+                self.node_i32[row, off + tk] = dom
+            self._dirty_full["node"] = True
+        return tk
+
+    def domain_id(self, tk: int, value_id: int) -> int:
+        """Compact per-topology-key domain index for a label value."""
+        dmap = self._tk_domains[tk]
         d = dmap.get(value_id)
         if d is None:
             d = dmap[value_id] = len(dmap)
@@ -195,17 +260,22 @@ class Mirror:
         f["unschedulable"] = np.bool_(node.spec.unschedulable)
         f["node_name_id"] = np.int32(self._i(node.metadata.name))
         vals = np.full((caps.label_cols,), NONE, np.int32)
-        doms = np.full((caps.label_cols,), NONE, np.int32)
         nums = np.full((caps.label_cols,), np.nan, np.float32)
         for key, value in node.metadata.labels.items():
             col = self.label_col(key)
             vid = self._i(value)
             vals[col] = vid
-            doms[col] = self.domain_id(col, vid)
             nums[col] = self.interner.numeric(vid)
         f["label_col_vals"] = vals
-        f["label_col_dom"] = doms
         f["label_col_nums"] = nums
+        doms = np.full((caps.topo_cols,), NONE, np.int32)
+        for tk, key in enumerate(self._tk_key):
+            value = node.metadata.labels.get(key)
+            if value is not None:
+                doms[tk] = self.domain_id(tk, self._i(value))
+        f["topo_dom"] = doms
+        self._row_node_labels[row] = node.metadata.labels
+        self._dirty_rows.add(row)
         if len(node.spec.taints) > caps.node_taints:
             raise CapacityError("node_taints", len(node.spec.taints))
         tk = np.full((caps.node_taints,), NONE, np.int32)
@@ -257,50 +327,73 @@ class Mirror:
                 self._release_pod_slot(uid)
                 self._pack_pod_slot(uid, pi, row, name)
 
+    def pod_labels_row(self, labels: dict[str, str]) -> np.ndarray:
+        """Labels as a pod-label-column value row [Kp] (registers keys)."""
+        row = np.full((self.caps.pod_label_cols,), NONE, np.int32)
+        for k, v in labels.items():
+            row[self.pod_label_col(k)] = self._i(v)
+        return row
+
+    def _pack_term_group(self, pi_terms, weights, pod: Pod, prefix: str,
+                         f: dict[str, np.ndarray]) -> None:
+        """One (anti)affinity term group -> tk/ns/sel_cols/sel_vals arrays
+        (+ weight for preferred groups)."""
+        caps = self.caps
+        A, NS, MS = caps.aff_terms, caps.aff_ns, caps.aff_sel
+        tk = np.full((A,), NONE, np.int32)
+        ns = np.full((A, NS), NONE, np.int32)
+        sc = np.full((A, MS), NONE, np.int32)
+        sv = np.full((A, MS), NONE, np.int32)
+        if len(pi_terms) > A:
+            raise CapacityError("aff_terms", len(pi_terms))
+        for t_idx, term in enumerate(pi_terms):
+            self._pack_aff_term(term, pod, tk, ns, sc, sv, t_idx)
+        f[f"{prefix}_tk"] = tk
+        f[f"{prefix}_ns"] = ns
+        f[f"{prefix}_sel_cols"] = sc
+        f[f"{prefix}_sel_vals"] = sv
+        if weights is not None:
+            w = np.zeros((A,), np.int32)
+            w[: len(weights)] = weights
+            f[f"{prefix}_weight"] = w
+
     def _pack_pod_slot(self, uid: str, pi: PodInfo, row: int, node_name: str) -> None:
         if not self._free_slots:
             raise CapacityError("pods", self.caps.pods + 1)
         slot = self._free_slots.pop()
-        caps = self.caps
         pod = pi.pod
         f: dict[str, np.ndarray] = {}
         f["pod_valid"] = np.bool_(True)
         f["pod_node"] = np.int32(row)
         f["pod_ns"] = np.int32(self._i(pod.metadata.namespace))
-        f["pod_label_keys"], f["pod_label_vals"] = self._pairs(
-            pod.metadata.labels, caps.pod_labels, "pod_labels")
-        topo = np.full((caps.aff_terms,), NONE, np.int32)
-        ns = np.full((caps.aff_terms, caps.aff_ns), NONE, np.int32)
-        sk = np.full((caps.aff_terms, caps.aff_sel), NONE, np.int32)
-        sv = np.full((caps.aff_terms, caps.aff_sel), NONE, np.int32)
-        terms = pi.required_anti_affinity_terms
-        if len(terms) > caps.aff_terms:
-            raise CapacityError("aff_terms", len(terms))
-        for t_idx, term in enumerate(terms):
-            self._pack_aff_term(term, pod, topo, ns, sk, sv, t_idx)
-        f["pod_anti_topo"], f["pod_anti_ns"] = topo, ns
-        f["pod_anti_sel_keys"], f["pod_anti_sel_vals"] = sk, sv
+        f["pt_label_vals"] = self.pod_labels_row(pod.metadata.labels)
+        self._pack_term_group(pi.required_anti_affinity_terms, None, pod,
+                              "pod_anti", f)
+        self._pack_term_group(pi.required_affinity_terms, None, pod,
+                              "pod_aff", f)
+        self._pack_term_group(
+            [w.pod_affinity_term for w in pi.preferred_affinity_terms],
+            [w.weight for w in pi.preferred_affinity_terms], pod, "pod_paff", f)
+        self._pack_term_group(
+            [w.pod_affinity_term for w in pi.preferred_anti_affinity_terms],
+            [w.weight for w in pi.preferred_anti_affinity_terms], pod,
+            "pod_panti", f)
         empty_f32 = self.pods_i32[slot, :0].view(np.float32)
         self.table_codec.pack_into(empty_f32, self.pods_i32[slot], f)
+        self._dirty_slots.add(slot)
         self._pod_slot[uid] = slot
         self._node_pods[node_name][uid] = slot
         self._pod_obj[uid] = pod
         self._node_of_pod[uid] = node_name
+        if (pi.required_anti_affinity_terms or pi.required_affinity_terms
+                or pi.preferred_affinity_terms
+                or pi.preferred_anti_affinity_terms):
+            self._uids_with_terms.add(uid)
 
-    def _pack_aff_term(self, term: PodAffinityTerm, pod: Pod,
-                       topo: np.ndarray, ns: np.ndarray,
-                       sel_k: np.ndarray, sel_v: np.ndarray, t_idx: int) -> None:
-        """Shared (anti)affinity term encoding. Selectors are folded to exact
-        (key, value) pairs: matchLabels plus single-value In expressions;
-        richer expressions raise (host-plugin fallback, round 2)."""
-        caps = self.caps
-        topo[t_idx] = self._i(term.topology_key)
-        namespaces = term.namespaces or [pod.metadata.namespace]
-        if len(namespaces) > caps.aff_ns:
-            raise CapacityError("aff_ns", len(namespaces))
-        for i, n in enumerate(namespaces):
-            ns[t_idx, i] = self._i(n)
-        sel = term.label_selector
+    def _fold_selector(self, sel, pod: Pod, match_label_keys) -> dict[str, str]:
+        """Fold a LabelSelector to exact (key, value) pairs: matchLabels plus
+        single-value In expressions; richer expressions raise (host-plugin
+        fallback). matchLabelKeys copy the pod's own values."""
         pairs: dict[str, str] = {}
         if sel is not None:
             pairs.update(sel.match_labels)
@@ -311,15 +404,47 @@ class Mirror:
                     raise UnsupportedFeatureError(
                         f"affinity selector operator {expr.operator} with "
                         f"{len(expr.values)} values needs the host fallback")
-        # matchLabelKeys merge: copy the pod's own values for those keys
-        for k in term.match_label_keys:
+        for k in match_label_keys:
             if k in pod.metadata.labels:
                 pairs[k] = pod.metadata.labels[k]
+        return pairs
+
+    def _pack_aff_term(self, term: PodAffinityTerm, pod: Pod,
+                       tk: np.ndarray, ns: np.ndarray,
+                       sel_c: np.ndarray, sel_v: np.ndarray, t_idx: int) -> None:
+        """Shared (anti)affinity term encoding: topology key -> tk index,
+        selector -> (pod-label column, value id) pairs."""
+        caps = self.caps
+        tk[t_idx] = self.topo_col(term.topology_key)
+        self._used_tks.add(int(tk[t_idx]))
+        namespaces = term.namespaces or [pod.metadata.namespace]
+        if len(namespaces) > caps.aff_ns:
+            raise CapacityError("aff_ns", len(namespaces))
+        for i, n in enumerate(namespaces):
+            ns[t_idx, i] = self._i(n)
+        pairs = self._fold_selector(term.label_selector, pod,
+                                    term.match_label_keys)
         if len(pairs) > caps.aff_sel:
             raise CapacityError("aff_sel", len(pairs))
+        if term.label_selector is None and not pairs:
+            # nil selector = labels.Nothing() in the reference: matches no pod
+            sel_v[t_idx, 0] = F.IMPOSSIBLE
         for i, (k, v) in enumerate(pairs.items()):
-            sel_k[t_idx, i] = self._i(k)
+            sel_c[t_idx, i] = self.pod_label_col(k)
             sel_v[t_idx, i] = self._i(v)
+
+    def term_matches_pod(self, term: PodAffinityTerm, owner: Pod,
+                         target: Pod) -> bool:
+        """Host oracle: does `term` (owned by `owner`) select `target`?
+        (AffinityTerm.Matches, framework/types.go) under the folded-pair
+        selector semantics."""
+        namespaces = term.namespaces or [owner.metadata.namespace]
+        if target.metadata.namespace not in namespaces:
+            return False
+        pairs = self._fold_selector(term.label_selector, owner,
+                                    term.match_label_keys)
+        return all(target.metadata.labels.get(k) == v
+                   for k, v in pairs.items())
 
     def _release_pod_slot(self, uid: str) -> None:
         slot = self._pod_slot.pop(uid, None)
@@ -327,7 +452,9 @@ class Mirror:
             return
         self.pods_i32[slot] = 0  # pod_valid -> False, rest zeroed
         self._free_slots.append(slot)
+        self._dirty_slots.add(slot)
         self._pod_obj.pop(uid, None)
+        self._uids_with_terms.discard(uid)
         node = self._node_of_pod.pop(uid, None)
         if node is not None:
             self._node_pods.get(node, {}).pop(uid, None)
@@ -338,6 +465,8 @@ class Mirror:
         self._row_names[row] = None
         self.node_f32[row] = 0.0
         self.node_i32[row] = 0  # node_valid -> False
+        self._dirty_rows.add(row)
+        self._row_node_labels.pop(row, None)
         for uid in list(self._node_pods.get(name, {})):
             self._release_pod_slot(uid)
         self._node_pods.pop(name, None)
@@ -368,21 +497,44 @@ class Mirror:
                 self._pack_node_row(row, info)
                 self._row_gen[name] = info.generation
                 repacked += 1
-        if repacked:
-            self._dirty["node"] = True
-            self._dirty["pods"] = True
         return repacked
 
+    def _push(self, key: str, host_buf: np.ndarray, dirty: set[int],
+              full: bool) -> None:
+        """Refresh one device buffer: full upload on first use / bulk change,
+        otherwise a row-scatter of only the dirty rows into the resident
+        (donated) HBM buffer — the device half of the incremental
+        UpdateSnapshot (a few hundred KB per cycle instead of the whole
+        multi-MB mirror over the host<->TPU link)."""
+        dev = self._dev.get(key)
+        if dev is None or full or len(dirty) > max(64, host_buf.shape[0] // 4):
+            self._dev[key] = jnp.asarray(host_buf)
+            return
+        if not dirty:
+            return
+        idx = sorted(dirty)
+        k = 1
+        while k < len(idx):
+            k *= 2
+        # pad with duplicates of the last row: same index + same data is an
+        # idempotent write, and keeps the scatter shape in pow2 buckets so
+        # XLA compiles one kernel per bucket, not per row-count
+        idx = idx + [idx[-1]] * (k - len(idx))
+        arr = np.asarray(idx, np.int32)
+        self._dev[key] = _scatter_rows_jit(dev, jnp.asarray(arr),
+                                           jnp.asarray(host_buf[arr]))
+
     def to_blobs(self) -> ClusterBlobs:
-        """Upload changed buffers (at most 3 transfers) and return the
-        device-side ClusterBlobs."""
-        if self._dirty["node"] or "node_f32" not in self._dev:
-            self._dev["node_f32"] = jnp.asarray(self.node_f32)
-            self._dev["node_i32"] = jnp.asarray(self.node_i32)
-            self._dirty["node"] = False
-        if self._dirty["pods"] or "pods_i32" not in self._dev:
-            self._dev["pods_i32"] = jnp.asarray(self.pods_i32)
-            self._dirty["pods"] = False
+        """Refresh the device-resident mirror (incremental row scatter or
+        full upload) and return the ClusterBlobs handles."""
+        full_node = self._dirty_full["node"]
+        self._push("node_f32", self.node_f32, self._dirty_rows, full_node)
+        self._push("node_i32", self.node_i32, self._dirty_rows, full_node)
+        self._push("pods_i32", self.pods_i32, self._dirty_slots,
+                   self._dirty_full["pods"])
+        self._dirty_full = {"node": False, "pods": False}
+        self._dirty_rows.clear()
+        self._dirty_slots.clear()
         return ClusterBlobs(node_f32=self._dev["node_f32"],
                             node_i32=self._dev["node_i32"],
                             pods_i32=self._dev["pods_i32"])
@@ -391,6 +543,37 @@ class Mirror:
         """ClusterTensors view (single jitted unpack dispatch) — test/tooling
         convenience; the scheduling pipeline unpacks blobs inside its own jit."""
         return _unpack_cluster_jit(self.to_blobs(), self.caps)
+
+    def domain_bucket(self) -> int:
+        """Static scatter-space size for the next launch: power-of-two over
+        the max domain count among topology keys any packed term/constraint
+        references (>= 8 to limit recompiles). The device analog of sizing
+        the reference's topologyPair hash maps to what the workload touches."""
+        need = max((len(self._tk_domains[tk]) for tk in self._used_tks),
+                   default=1)
+        d = 8
+        while d < need:
+            d *= 2
+        return min(d, self.caps.domain_cap)
+
+    @staticmethod
+    def batch_has_topology(pods: list[Pod]) -> bool:
+        """Host-side PreFilter-Skip: does any pod in the batch carry
+        (anti)affinity terms or topology spread constraints?"""
+        for p in pods:
+            a = p.spec.affinity
+            if a is not None and (a.pod_affinity is not None
+                                  or a.pod_anti_affinity is not None):
+                return True
+            if p.spec.topology_spread_constraints:
+                return True
+        return False
+
+    def table_has_topology(self) -> bool:
+        """True if any scheduled pod in the table carries (anti)affinity
+        terms — those reject (existing anti-affinity) or score (existing
+        required/preferred terms) even a constraint-free incoming batch."""
+        return bool(self._uids_with_terms)
 
     def reserve_batch_slots(self, n: int) -> np.ndarray:
         """Pod-table slots the batched commit scan will fill on device; host
@@ -416,8 +599,7 @@ class Mirror:
         out["priority"] = np.int32(pod.priority())
         out["ns"] = np.int32(self._i(pod.metadata.namespace))
         out["name_id"] = np.int32(self._i(pod.metadata.name))
-        out["labels_keys"], out["labels_vals"] = self._pairs(
-            pod.metadata.labels, caps.pod_labels, "pod_labels")
+        out["plabel_vals"] = self.pod_labels_row(pod.metadata.labels)
         if len(pod.spec.node_selector) > caps.pod_labels:
             raise CapacityError("pod_labels", len(pod.spec.node_selector))
         ns_cols = np.full((caps.pod_labels,), NONE, np.int32)
@@ -429,7 +611,7 @@ class Mirror:
         self._pack_node_affinity(pod, out)
         self._pack_tolerations(pod, out)
         self._pack_host_ports(pod, out)
-        self._pack_pod_affinity(pod, out)
+        self._pack_pod_affinity(pod, pi, out)
         self._pack_spread(pod, out)
         out["image_ids"] = np.full((caps.pod_images,), NONE, np.int32)
         idx = 0
@@ -535,50 +717,33 @@ class Mirror:
             out["hp_proto"][i] = self._i(proto or "TCP")
             out["hp_port"][i] = port
 
-    def _pack_aff_group(self, pod: Pod, terms: list[PodAffinityTerm],
-                        weights: list[int] | None,
-                        prefix: str, out: dict[str, np.ndarray]) -> None:
-        caps = self.caps
-        A, NS, MS = caps.aff_terms, caps.aff_ns, caps.aff_sel
-        topo = np.full((A,), NONE, np.int32)
-        ns = np.full((A, NS), NONE, np.int32)
-        sk = np.full((A, MS), NONE, np.int32)
-        sv = np.full((A, MS), NONE, np.int32)
-        if len(terms) > A:
-            raise CapacityError("aff_terms", len(terms))
-        for ti, term in enumerate(terms):
-            self._pack_aff_term(term, pod, topo, ns, sk, sv, ti)
-        out[f"{prefix}_topo"] = topo
-        out[f"{prefix}_ns"] = ns
-        out[f"{prefix}_sel_keys"] = sk
-        out[f"{prefix}_sel_vals"] = sv
-        if weights is not None:
-            w = np.zeros((A,), np.int32)
-            for ti in range(len(terms)):
-                w[ti] = weights[ti]
-            out[f"{prefix}_weight"] = w
-
-    def _pack_pod_affinity(self, pod: Pod, out: dict[str, np.ndarray]) -> None:
-        aff = pod.spec.affinity or Affinity()
-        pa = aff.pod_affinity
-        paa = aff.pod_anti_affinity
-        self._pack_aff_group(pod, pa.required if pa else [], None, "aff", out)
-        self._pack_aff_group(pod, paa.required if paa else [], None, "anti", out)
-        pref = pa.preferred if pa else []
-        self._pack_aff_group(pod, [w.pod_affinity_term for w in pref],
-                             [w.weight for w in pref], "paff", out)
-        prefa = paa.preferred if paa else []
-        self._pack_aff_group(pod, [w.pod_affinity_term for w in prefa],
-                             [w.weight for w in prefa], "panti", out)
+    def _pack_pod_affinity(self, pod: Pod, pi: PodInfo,
+                           out: dict[str, np.ndarray]) -> None:
+        self._pack_term_group(pi.required_affinity_terms, None, pod, "aff", out)
+        self._pack_term_group(pi.required_anti_affinity_terms, None, pod,
+                              "anti", out)
+        self._pack_term_group(
+            [w.pod_affinity_term for w in pi.preferred_affinity_terms],
+            [w.weight for w in pi.preferred_affinity_terms], pod, "paff", out)
+        self._pack_term_group(
+            [w.pod_affinity_term for w in pi.preferred_anti_affinity_terms],
+            [w.weight for w in pi.preferred_anti_affinity_terms], pod,
+            "panti", out)
+        # first-pod-of-group rule (satisfyPodAffinity, filtering.go): does the
+        # pod match ALL of its own required affinity terms?
+        out["aff_self_match"] = np.bool_(
+            bool(pi.required_affinity_terms)
+            and all(self.term_matches_pod(t, pod, pod)
+                    for t in pi.required_affinity_terms))
 
     def _pack_spread(self, pod: Pod, out: dict[str, np.ndarray]) -> None:
         caps = self.caps
         C, MS = caps.spread_constraints, caps.aff_sel
-        out["tsc_topo"] = np.full((C,), NONE, np.int32)
+        out["tsc_tk"] = np.full((C,), NONE, np.int32)
         out["tsc_max_skew"] = np.zeros((C,), np.int32)
         out["tsc_hard"] = np.zeros((C,), bool)
         out["tsc_min_domains"] = np.zeros((C,), np.int32)
-        out["tsc_sel_keys"] = np.full((C, MS), NONE, np.int32)
+        out["tsc_sel_cols"] = np.full((C, MS), NONE, np.int32)
         out["tsc_sel_vals"] = np.full((C, MS), NONE, np.int32)
         out["tsc_honor_affinity"] = np.ones((C,), bool)
         out["tsc_honor_taints"] = np.zeros((C,), bool)
@@ -586,27 +751,21 @@ class Mirror:
         if len(tscs) > C:
             raise CapacityError("spread_constraints", len(tscs))
         for i, t in enumerate(tscs):
-            out["tsc_topo"][i] = self._i(t.topology_key)
+            out["tsc_tk"][i] = self.topo_col(t.topology_key)
+            self._used_tks.add(int(out["tsc_tk"][i]))
             out["tsc_max_skew"][i] = t.max_skew
             out["tsc_hard"][i] = t.when_unsatisfiable == "DoNotSchedule"
             out["tsc_min_domains"][i] = t.min_domains or 0
-            pairs: dict[str, str] = {}
-            if t.label_selector is not None:
-                pairs.update(t.label_selector.match_labels)
-                for expr in t.label_selector.match_expressions:
-                    if expr.operator == "In" and len(expr.values) == 1:
-                        pairs[expr.key] = expr.values[0]
-                    else:
-                        raise UnsupportedFeatureError(
-                            f"spread selector operator {expr.operator} needs "
-                            "the host fallback")
-            for k in t.match_label_keys:
-                if k in pod.metadata.labels:
-                    pairs[k] = pod.metadata.labels[k]
+            pairs = self._fold_selector(t.label_selector, pod,
+                                        t.match_label_keys)
             if len(pairs) > MS:
                 raise CapacityError("aff_sel", len(pairs))
+            if t.label_selector is None and not pairs:
+                # nil selector = labels.Nothing(): matches no pod, and
+                # selfMatchNum is 0 (filtering.go:311)
+                out["tsc_sel_vals"][i, 0] = F.IMPOSSIBLE
             for j, (k, v) in enumerate(pairs.items()):
-                out["tsc_sel_keys"][i, j] = self._i(k)
+                out["tsc_sel_cols"][i, j] = self.pod_label_col(k)
                 out["tsc_sel_vals"][i, j] = self._i(v)
             out["tsc_honor_affinity"][i] = t.node_affinity_policy == "Honor"
             out["tsc_honor_taints"][i] = t.node_taints_policy == "Honor"
@@ -618,6 +777,11 @@ class Mirror:
             raise ValueError("empty batch")
         if len(pods) > batch_size:
             raise ValueError(f"{len(pods)} pods exceed batch_size {batch_size}")
+        # prepass: register every batch pod's label keys so a term packed for
+        # pod i can reference a column pod j>i carries
+        for pod in pods:
+            for k in pod.metadata.labels:
+                self.pod_label_col(k)
         f32, i32 = self.pod_codec.alloc(batch_size)
         for b, pod in enumerate(pods):
             self.pod_codec.pack_into(f32[b], i32[b], self.pack_pod(pod))
@@ -627,3 +791,15 @@ class Mirror:
     def pack_batch(self, pods: list[Pod], batch_size: int) -> PodFeatures:
         """PodFeatures view of a packed batch (jitted unpack; test/tooling)."""
         return _unpack_pods_jit(self.pack_batch_blobs(pods, batch_size), self.caps)
+
+    def prepare_launch(self, pods: list[Pod], batch_size: int
+                       ) -> tuple[ClusterBlobs, PodBlobs, bool, int]:
+        """Everything one schedule_batch launch needs, in the right order:
+        pods are packed BEFORE the cluster blobs are fetched, so a topology
+        key first referenced by this batch has its backfilled topo_dom
+        column on device for this very launch (not the next one).
+
+        Returns (cluster_blobs, pod_blobs, enable_topology, d_cap)."""
+        pblobs = self.pack_batch_blobs(pods, batch_size)
+        enable = self.batch_has_topology(pods) or self.table_has_topology()
+        return self.to_blobs(), pblobs, enable, self.domain_bucket()

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from kubernetes_tpu.ops import common as C
 from kubernetes_tpu.ops import filters as FL
 from kubernetes_tpu.ops import scores as SC
+from kubernetes_tpu.ops import topology as T
 from kubernetes_tpu.ops.features import (
     Capacities,
     ClusterBlobs,
@@ -59,6 +60,8 @@ FILTER_PLUGINS = (
     "NodeAffinity",
     "NodePorts",
     "NodeResourcesFit",
+    "PodTopologySpread",
+    "InterPodAffinity",
 )
 NUM_FILTER_PLUGINS = len(FILTER_PLUGINS)
 
@@ -70,7 +73,12 @@ SCORE_PLUGINS = (
     "NodeResourcesFit",           # w=1, least-allocated 0..100
     "NodeResourcesBalancedAllocation",  # w=1, 0..100
     "ImageLocality",              # w=1, 0..100
+    "PodTopologySpread",          # w=2, spread-normalized
+    "InterPodAffinity",           # w=2, max-min-normalized
 )
+
+# default HardPodAffinityWeight (apis/config/v1/defaults.go)
+HARD_POD_AFFINITY_WEIGHT = 1.0
 
 
 @jax.tree_util.register_dataclass
@@ -84,6 +92,8 @@ class ScoreWeights:
     resources_fit: jax.Array
     balanced_allocation: jax.Array
     image_locality: jax.Array
+    pod_topology_spread: jax.Array
+    inter_pod_affinity: jax.Array
 
 
 def default_weights() -> ScoreWeights:
@@ -93,6 +103,8 @@ def default_weights() -> ScoreWeights:
         resources_fit=jnp.float32(1.0),
         balanced_allocation=jnp.float32(1.0),
         image_locality=jnp.float32(1.0),
+        pod_topology_spread=jnp.float32(2.0),
+        inter_pod_affinity=jnp.float32(2.0),
     )
 
 
@@ -127,13 +139,24 @@ def static_filters(ct: ClusterTensors, pod: PodFeatures,
 
 def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                    wk: dict[str, jnp.ndarray], weights: ScoreWeights,
-                   caps: Capacities) -> BatchResult:
+                   caps: Capacities, enable_topology: bool = True,
+                   d_cap: int | None = None) -> BatchResult:
     """Schedule a whole pod batch in one launch, as-if-serial (see module
-    docstring for the two-phase structure)."""
+    docstring for the two-phase structure).
+
+    ``enable_topology`` and ``d_cap`` are STATIC, host-derived launch args —
+    the device analog of PreFilter returning Skip (framework/interface.go):
+    a batch with no (anti)affinity terms or spread constraints compiles to a
+    program with the topology kernels dead-code-eliminated, and ``d_cap``
+    bounds the domain scatter space to the batch's actually-used topology
+    keys (Mirror.domain_bucket) instead of the worst-case node count."""
     ct = unpack_cluster(cblobs, caps)
     pods = unpack_pods(pblobs, caps)  # leaves [B, ...]
     num_valid = jnp.sum(ct.node_valid)
     valid = ct.node_valid
+    if d_cap is None:
+        d_cap = caps.domain_cap
+    tds = T.slot_topo_dom(ct)  # [PT, TK], shared across the batch
 
     # ---- phase 1: parallel over the batch ----
     def per_pod(pod: PodFeatures):
@@ -149,12 +172,38 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
         taint_raw = SC.taint_toleration_score(ct, pod)         # [N]
         aff_raw = SC.node_affinity_score(ct, pod)              # [N]
         img = SC.image_locality(ct, pod, num_valid)            # [N]
+        if enable_topology:
+            # topology plugins (commit-invariant vs the pre-batch pod table;
+            # in-batch commit effects are layered on in the commit scan)
+            taint_ok, nodeaff_ok = masks[2], masks[3]
+            used_c = pod.tsc_tk != jnp.int32(-1)
+            el_hard = T.spread_eligible(ct, pod, nodeaff_ok, taint_ok,
+                                        used_c & pod.tsc_hard)
+            el_soft = T.spread_eligible(ct, pod, nodeaff_ok, taint_ok,
+                                        used_c & ~pod.tsc_hard)
+            m_spread = T.spread_filter(ct, pod, tds, el_hard, d_cap)   # [N]
+            m_ipa = T.inter_pod_affinity_filter(ct, pod, tds, d_cap)   # [N]
+            ipa_raw = T.inter_pod_affinity_score(
+                ct, pod, tds, d_cap, jnp.float32(HARD_POD_AFFINITY_WEIGHT))
+            spread_raw, spread_ignored = T.spread_score(
+                ct, pod, tds, el_soft, static_ok & m_spread & m_ipa, d_cap)
+            has_soft = jnp.any(used_c & ~pod.tsc_hard)
+        else:
+            ones = jnp.ones_like(static_ok)
+            zeros = jnp.zeros_like(taint_raw)
+            m_spread = m_ipa = ones
+            ipa_raw = spread_raw = zeros
+            spread_ignored = ~ones
+            has_soft = jnp.bool_(False)
         # fit can never succeed: request exceeds allocatable (Unresolvable)
         unresolvable = jnp.any(pod.req[None] > ct.allocatable, axis=-1)
         unres_count = jnp.sum(unresolvable & valid).astype(jnp.int32)
-        return static_ok, static_rejects, taint_raw, aff_raw, img, unres_count
+        return (static_ok, static_rejects, taint_raw, aff_raw, img,
+                m_spread, m_ipa, ipa_raw, spread_raw, spread_ignored,
+                has_soft, unres_count)
 
-    static_ok, static_rejects, taint_raw, aff_raw, img, unres = jax.vmap(
+    (static_ok, static_rejects, taint_raw, aff_raw, img, m_spread, m_ipa,
+     ipa_raw, spread_raw, spread_ignored, has_soft, unres) = jax.vmap(
         per_pod)(pods)
 
     # ---- phase 2: sequential commit scan (tiny per-step work) ----
@@ -166,24 +215,30 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
 
     def body(carry, xs):
         free, nzr, committed_rows = carry
-        b, ok_s, t_raw, a_raw, im, req, nzreq = xs
+        (b, ok_s, t_raw, a_raw, im, sp_ok, ipa_ok, ipa_r, sp_r, sp_ign,
+         soft, req, nzreq) = xs
         fit_ok = jnp.all(req[None] <= free, axis=-1)            # [N]
         # nodes holding an earlier batch commit that clashes on hostPort
         clash = port_conf[b] & (committed_rows >= 0)            # [B]
         forbidden = jnp.zeros_like(fit_ok).at[
             jnp.maximum(committed_rows, 0)].max(clash)          # [N]
         ports_ok = ~forbidden
-        feasible = ok_s & ports_ok & fit_ok
+        feasible = ok_s & ports_ok & fit_ok & sp_ok & ipa_ok
         frac = SC.utilization_fractions(alloc2, nzr, nzreq)
         least = SC.least_allocated_from_fractions(frac)
         bal = SC.balanced_allocation_from_fractions(frac)
         taint = SC.normalize_inverse(t_raw, feasible)
         aff = SC.normalize_max(a_raw, feasible)
+        ipa = SC.normalize_maxmin(ipa_r, feasible)
+        spread = jnp.where(soft, SC.normalize_spread(sp_r, feasible, sp_ign),
+                           0.0)
         total = (weights.taint_toleration * taint
                  + weights.node_affinity * aff
                  + weights.resources_fit * least
                  + weights.balanced_allocation * bal
-                 + weights.image_locality * im)
+                 + weights.image_locality * im
+                 + weights.pod_topology_spread * spread
+                 + weights.inter_pod_affinity * ipa)
         row = C.masked_argmax_first(total, feasible)
         # commit the winner (the "assume"): free -= request, nonzero += request
         do = row >= 0
@@ -191,28 +246,37 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
         free = free.at[r].add(jnp.where(do, -req, 0.0))
         nzr = nzr.at[r].add(jnp.where(do, nzreq, 0.0))
         committed_rows = committed_rows.at[b].set(row)
-        # first-fail order: NodePorts (in-batch) before NodeResourcesFit
+        # first-fail order: NodePorts (in-batch), Fit, Spread, InterPod
+        ok_ports = ok_s & ports_ok
+        ok_fit = ok_ports & fit_ok
+        ok_sp = ok_fit & sp_ok
         port_rejects = jnp.sum(ok_s & ~ports_ok).astype(jnp.int32)
-        fit_rejects = jnp.sum(ok_s & ports_ok & ~fit_ok).astype(jnp.int32)
+        fit_rejects = jnp.sum(ok_ports & ~fit_ok).astype(jnp.int32)
+        sp_rejects = jnp.sum(ok_fit & ~sp_ok).astype(jnp.int32)
+        ipa_rejects = jnp.sum(ok_sp & ~ipa_ok).astype(jnp.int32)
         win = jnp.where(do, total[r], 0.0)
         return (free, nzr, committed_rows), (
             row, win, jnp.sum(feasible).astype(jnp.int32),
-            port_rejects, fit_rejects)
+            port_rejects, fit_rejects, sp_rejects, ipa_rejects)
 
-    xs = (jnp.arange(B), static_ok, taint_raw, aff_raw, img,
+    xs = (jnp.arange(B), static_ok, taint_raw, aff_raw, img, m_spread, m_ipa,
+          ipa_raw, spread_raw, spread_ignored, has_soft,
           pods.req, pods.nonzero_req)
     init = (ct.free, ct.nonzero_requested, jnp.full((B,), -1, jnp.int32))
-    _, (rows, win_scores, feas, port_rejects, fit_rejects) = jax.lax.scan(
-        body, init, xs)
+    _, (rows, win_scores, feas, port_rejects, fit_rejects, sp_rejects,
+        ipa_rejects) = jax.lax.scan(body, init, xs)
 
     ports_idx = FILTER_PLUGINS.index("NodePorts")
     static_rejects = static_rejects.at[:, ports_idx].add(port_rejects)
     reject_counts = jnp.concatenate(
-        [static_rejects, fit_rejects[:, None]], axis=1)
+        [static_rejects, fit_rejects[:, None], sp_rejects[:, None],
+         ipa_rejects[:, None]], axis=1)
     return BatchResult(node_row=rows, score=win_scores, feasible_count=feas,
                        reject_counts=reject_counts, unresolvable_count=unres)
 
 
-@partial(jax.jit, static_argnames=("caps",))
-def schedule_batch_jit(cblobs, pblobs, wk, weights, caps):
-    return schedule_batch(cblobs, pblobs, wk, weights, caps)
+@partial(jax.jit, static_argnames=("caps", "enable_topology", "d_cap"))
+def schedule_batch_jit(cblobs, pblobs, wk, weights, caps,
+                       enable_topology=True, d_cap=None):
+    return schedule_batch(cblobs, pblobs, wk, weights, caps,
+                          enable_topology, d_cap)

@@ -63,6 +63,10 @@ def masked_max(x: jnp.ndarray, mask: jnp.ndarray, axis=None) -> jnp.ndarray:
     return jnp.max(jnp.where(mask, x, -jnp.inf), axis=axis)
 
 
+def masked_min(x: jnp.ndarray, mask: jnp.ndarray, axis=None) -> jnp.ndarray:
+    return jnp.min(jnp.where(mask, x, jnp.inf), axis=axis)
+
+
 def masked_argmax_first(score: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     """Index of the max score among masked-in entries (first on ties);
     -1 if mask is empty."""

@@ -29,6 +29,11 @@ import numpy as np
 
 from kubernetes_tpu.utils.interner import NONE
 
+# Packed as a required selector value for a nil LabelSelector
+# (labels.Nothing() in the reference): no real id equals it, so the term
+# matches no pod. Distinct from NONE (-1), which marks an unused slot.
+IMPOSSIBLE = -2
+
 # --- resource column layout ---
 
 COL_CPU = 0       # milli-cores
@@ -70,7 +75,12 @@ class Capacities:
                                  # Labels are columnized: one dense value
                                  # column per key (TPU-native: no per-node
                                  # key-value pair scans in the kernels)
-    domains: int = 0             # per-column compact domain-id space for
+    pod_label_cols: int = 32     # Kp: distinct POD-label keys cluster-wide
+                                 # (pod labels are columnized the same way for
+                                 # inter-pod affinity / spread selector kernels)
+    topo_cols: int = 8           # TK: topology keys in use by any pod's
+                                 # (anti)affinity terms or spread constraints
+    domains: int = 0             # per-topo-key compact domain-id space for
                                  # topology aggregation; 0 = same as nodes
     node_taints: int = 8         # T
     node_ports: int = 64         # P: occupied host ports per node
@@ -126,11 +136,15 @@ class ClusterTensors:
     # labels, columnized: one column per distinct label KEY cluster-wide.
     # label_col_vals[n, k] = value id of key k on node n (NONE if absent);
     # label_col_nums = numeric parse of the value (NaN if absent/non-int,
-    # for Gt/Lt without a vocab gather); label_col_dom = compact per-column
-    # domain id (stable, dense) for topology-domain scatter/aggregation.
+    # for Gt/Lt without a vocab gather).
     label_col_vals: jax.Array    # [N, K] i32
     label_col_nums: jax.Array    # [N, K] f32
-    label_col_dom: jax.Array     # [N, K] i32
+    # topology domains: for each registered topology key tk, the compact
+    # per-key domain id of the node's label value (NONE = label absent).
+    # Two nodes are in the same topology domain under tk iff their ids match.
+    # This is the scatter/gather substrate for InterPodAffinity and
+    # PodTopologySpread (SURVEY.md §7.1 step 5).
+    topo_dom: jax.Array          # [N, TK] i32
     # taints
     taint_keys: jax.Array        # [N, T] i32
     taint_vals: jax.Array        # [N, T] i32
@@ -142,17 +156,35 @@ class ClusterTensors:
     # images present on node
     image_ids: jax.Array         # [N, I] i32
     image_sizes: jax.Array       # [N, I] f32 MiB
-    # pod table (scheduled pods, for inter-pod affinity / topology spread)
+    # pod table (scheduled pods, for inter-pod affinity / topology spread).
+    # Labels columnized over pod-label columns [Kp]; each term group stores
+    # (topo tk-index, selected namespaces, selector (col,val) pairs); the
+    # preferred groups add weights. Term slots with tk = NONE are unused.
     pod_valid: jax.Array         # [PT] bool
     pod_node: jax.Array          # [PT] i32 node row index
     pod_ns: jax.Array            # [PT] i32 namespace id
-    pod_label_keys: jax.Array    # [PT, PL] i32
-    pod_label_vals: jax.Array    # [PT, PL] i32
-    # existing pods' REQUIRED anti-affinity terms (satisfyExistingPodsAntiAffinity)
-    pod_anti_topo: jax.Array     # [PT, A] i32 topology key id (-1 = unused term)
+    pt_label_vals: jax.Array     # [PT, Kp] i32 label value per pod-label column
+    # REQUIRED anti-affinity terms (satisfyExistingPodsAntiAffinity)
+    pod_anti_tk: jax.Array       # [PT, A] i32 topo-key index (-1 = unused term)
     pod_anti_ns: jax.Array       # [PT, A, NS] i32 namespace ids the term selects
-    pod_anti_sel_keys: jax.Array  # [PT, A, MS] i32 matchLabels keys
-    pod_anti_sel_vals: jax.Array  # [PT, A, MS] i32 matchLabels values
+    pod_anti_sel_cols: jax.Array  # [PT, A, MS] i32 pod-label column
+    pod_anti_sel_vals: jax.Array  # [PT, A, MS] i32 required value id
+    # REQUIRED affinity terms (hardPodAffinityWeight scoring)
+    pod_aff_tk: jax.Array        # [PT, A] i32
+    pod_aff_ns: jax.Array        # [PT, A, NS] i32
+    pod_aff_sel_cols: jax.Array  # [PT, A, MS] i32
+    pod_aff_sel_vals: jax.Array  # [PT, A, MS] i32
+    # PREFERRED affinity / anti-affinity terms (scoring)
+    pod_paff_tk: jax.Array       # [PT, A] i32
+    pod_paff_weight: jax.Array   # [PT, A] i32
+    pod_paff_ns: jax.Array       # [PT, A, NS] i32
+    pod_paff_sel_cols: jax.Array  # [PT, A, MS] i32
+    pod_paff_sel_vals: jax.Array  # [PT, A, MS] i32
+    pod_panti_tk: jax.Array      # [PT, A] i32
+    pod_panti_weight: jax.Array  # [PT, A] i32
+    pod_panti_ns: jax.Array      # [PT, A, NS] i32
+    pod_panti_sel_cols: jax.Array  # [PT, A, MS] i32
+    pod_panti_sel_vals: jax.Array  # [PT, A, MS] i32
 
 
 def node_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
@@ -168,7 +200,7 @@ def node_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
         "unschedulable": ((), "bool"),
         "node_name_id": ((), "i32"),
         "label_col_vals": ((caps.label_cols,), "i32"),
-        "label_col_dom": ((caps.label_cols,), "i32"),
+        "topo_dom": ((caps.topo_cols,), "i32"),
         "taint_keys": ((caps.node_taints,), "i32"),
         "taint_vals": ((caps.node_taints,), "i32"),
         "taint_effects": ((caps.node_taints,), "i32"),
@@ -182,17 +214,20 @@ def node_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
 def pod_table_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
     """Per-pod-slot schema for the scheduled-pod table (leading PT axis implied)."""
     a, ns, ms = caps.aff_terms, caps.aff_ns, caps.aff_sel
-    return {
+    d = {
         "pod_valid": ((), "bool"),
         "pod_node": ((), "i32"),
         "pod_ns": ((), "i32"),
-        "pod_label_keys": ((caps.pod_labels,), "i32"),
-        "pod_label_vals": ((caps.pod_labels,), "i32"),
-        "pod_anti_topo": ((a,), "i32"),
-        "pod_anti_ns": ((a, ns), "i32"),
-        "pod_anti_sel_keys": ((a, ms), "i32"),
-        "pod_anti_sel_vals": ((a, ms), "i32"),
+        "pt_label_vals": ((caps.pod_label_cols,), "i32"),
     }
+    for g in ("anti", "aff", "paff", "panti"):
+        d[f"pod_{g}_tk"] = ((a,), "i32")
+        if g in ("paff", "panti"):
+            d[f"pod_{g}_weight"] = ((a,), "i32")
+        d[f"pod_{g}_ns"] = ((a, ns), "i32")
+        d[f"pod_{g}_sel_cols"] = ((a, ms), "i32")
+        d[f"pod_{g}_sel_vals"] = ((a, ms), "i32")
+    return d
 
 
 def pod_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
@@ -202,7 +237,7 @@ def pod_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
     PW, TO, HP = caps.pref_terms, caps.tolerations, caps.pod_ports
     A, NS, MS, C = caps.aff_terms, caps.aff_ns, caps.aff_sel, caps.spread_constraints
     PL, IM = caps.pod_labels, caps.pod_images
-    return {
+    d = {
         "req": ((r,), "f32"),
         "nonzero_req": ((2,), "f32"),
         "num_containers": ((), "f32"),
@@ -211,8 +246,7 @@ def pod_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
         "priority": ((), "i32"),
         "ns": ((), "i32"),
         "name_id": ((), "i32"),
-        "labels_keys": ((PL,), "i32"),
-        "labels_vals": ((PL,), "i32"),
+        "plabel_vals": ((caps.pod_label_cols,), "i32"),
         "nodesel_cols": ((PL,), "i32"),
         "nodesel_vals": ((PL,), "i32"),
         "sel_term_valid": ((T,), "bool"),
@@ -233,29 +267,12 @@ def pod_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
         "hp_ip": ((HP,), "i32"),
         "hp_proto": ((HP,), "i32"),
         "hp_port": ((HP,), "i32"),
-        "aff_topo": ((A,), "i32"),
-        "aff_ns": ((A, NS), "i32"),
-        "aff_sel_keys": ((A, MS), "i32"),
-        "aff_sel_vals": ((A, MS), "i32"),
-        "anti_topo": ((A,), "i32"),
-        "anti_ns": ((A, NS), "i32"),
-        "anti_sel_keys": ((A, MS), "i32"),
-        "anti_sel_vals": ((A, MS), "i32"),
-        "paff_topo": ((A,), "i32"),
-        "paff_weight": ((A,), "i32"),
-        "paff_ns": ((A, NS), "i32"),
-        "paff_sel_keys": ((A, MS), "i32"),
-        "paff_sel_vals": ((A, MS), "i32"),
-        "panti_topo": ((A,), "i32"),
-        "panti_weight": ((A,), "i32"),
-        "panti_ns": ((A, NS), "i32"),
-        "panti_sel_keys": ((A, MS), "i32"),
-        "panti_sel_vals": ((A, MS), "i32"),
-        "tsc_topo": ((C,), "i32"),
+        "aff_self_match": ((), "bool"),
+        "tsc_tk": ((C,), "i32"),
         "tsc_max_skew": ((C,), "i32"),
         "tsc_hard": ((C,), "bool"),
         "tsc_min_domains": ((C,), "i32"),
-        "tsc_sel_keys": ((C, MS), "i32"),
+        "tsc_sel_cols": ((C, MS), "i32"),
         "tsc_sel_vals": ((C, MS), "i32"),
         "tsc_honor_affinity": ((C,), "bool"),
         "tsc_honor_taints": ((C,), "bool"),
@@ -263,6 +280,14 @@ def pod_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
         "node_name_id": ((), "i32"),
         "valid": ((), "bool"),
     }
+    for g in ("aff", "anti", "paff", "panti"):
+        d[f"{g}_tk"] = ((A,), "i32")
+        if g in ("paff", "panti"):
+            d[f"{g}_weight"] = ((A,), "i32")
+        d[f"{g}_ns"] = ((A, NS), "i32")
+        d[f"{g}_sel_cols"] = ((A, MS), "i32")
+        d[f"{g}_sel_vals"] = ((A, MS), "i32")
+    return d
 
 
 @_register
@@ -277,8 +302,7 @@ class PodFeatures:
     priority: jax.Array          # i32 scalar
     ns: jax.Array                # i32 scalar namespace id
     name_id: jax.Array           # i32 scalar (pod name, for debugging)
-    labels_keys: jax.Array       # [PL] i32
-    labels_vals: jax.Array       # [PL] i32
+    plabel_vals: jax.Array       # [Kp] i32 own labels over pod-label columns
     # spec.nodeSelector: exact (label-column, value) pairs, ANDed; a pair on a
     # key no node carries packs col=NONE (matches nothing). Unused slots have
     # val=NONE.
@@ -309,31 +333,36 @@ class PodFeatures:
     hp_ip: jax.Array             # [HP] i32
     hp_proto: jax.Array          # [HP] i32
     hp_port: jax.Array           # [HP] i32 (-1 unused)
-    # pod (anti)affinity terms — required and preferred, both directions
-    aff_topo: jax.Array          # [A] i32 (-1 unused) required affinity
+    # pod (anti)affinity terms — required and preferred, both directions.
+    # *_tk is the registered topology-key index (NONE = unused term slot);
+    # selectors are (pod-label column, value) pairs.
+    aff_self_match: jax.Array    # bool: pod matches ALL its own required
+                                 # affinity terms (first-pod-of-group rule,
+                                 # filtering.go satisfyPodAffinity)
+    aff_tk: jax.Array            # [A] i32 required affinity
     aff_ns: jax.Array            # [A, NS] i32
-    aff_sel_keys: jax.Array      # [A, MS] i32
+    aff_sel_cols: jax.Array      # [A, MS] i32
     aff_sel_vals: jax.Array      # [A, MS] i32
-    anti_topo: jax.Array         # [A] i32 required anti-affinity
+    anti_tk: jax.Array           # [A] i32 required anti-affinity
     anti_ns: jax.Array           # [A, NS] i32
-    anti_sel_keys: jax.Array     # [A, MS] i32
+    anti_sel_cols: jax.Array     # [A, MS] i32
     anti_sel_vals: jax.Array     # [A, MS] i32
-    paff_topo: jax.Array         # [A] i32 preferred affinity
+    paff_tk: jax.Array           # [A] i32 preferred affinity
     paff_weight: jax.Array       # [A] i32
     paff_ns: jax.Array           # [A, NS] i32
-    paff_sel_keys: jax.Array     # [A, MS] i32
+    paff_sel_cols: jax.Array     # [A, MS] i32
     paff_sel_vals: jax.Array     # [A, MS] i32
-    panti_topo: jax.Array        # [A] i32 preferred anti-affinity
+    panti_tk: jax.Array          # [A] i32 preferred anti-affinity
     panti_weight: jax.Array      # [A] i32
     panti_ns: jax.Array          # [A, NS] i32
-    panti_sel_keys: jax.Array    # [A, MS] i32
+    panti_sel_cols: jax.Array    # [A, MS] i32
     panti_sel_vals: jax.Array    # [A, MS] i32
     # topology spread constraints
-    tsc_topo: jax.Array          # [C] i32 (-1 unused)
+    tsc_tk: jax.Array            # [C] i32 (-1 unused)
     tsc_max_skew: jax.Array      # [C] i32
     tsc_hard: jax.Array          # [C] bool (DoNotSchedule)
     tsc_min_domains: jax.Array   # [C] i32 (0 = unset)
-    tsc_sel_keys: jax.Array      # [C, MS] i32
+    tsc_sel_cols: jax.Array      # [C, MS] i32
     tsc_sel_vals: jax.Array      # [C, MS] i32
     tsc_honor_affinity: jax.Array  # [C] bool (nodeAffinityPolicy == Honor)
     tsc_honor_taints: jax.Array    # [C] bool (nodeTaintsPolicy == Honor)

@@ -140,3 +140,27 @@ def normalize_inverse(scores: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     top = C.masked_max(scores, mask)
     top = jnp.where(jnp.isfinite(top) & (top > 0), top, 1.0)
     return (1.0 - scores / top) * MAX_NODE_SCORE
+
+
+def normalize_maxmin(scores: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """InterPodAffinity NormalizeScore (scoring.go:258):
+    100 * (score - min) / (max - min); all-equal -> 0."""
+    mn = C.masked_min(scores, mask)
+    mx = C.masked_max(scores, mask)
+    diff = mx - mn
+    ok = jnp.isfinite(diff) & (diff > 0)
+    return jnp.where(ok, MAX_NODE_SCORE * (scores - mn)
+                     / jnp.where(ok, diff, 1.0), 0.0)
+
+
+def normalize_spread(scores: jnp.ndarray, mask: jnp.ndarray,
+                     ignored: jnp.ndarray) -> jnp.ndarray:
+    """PodTopologySpread NormalizeScore (scoring.go:226): lower raw count is
+    better: 100 * (max + min - s) / max; max == 0 -> 100; ignored -> 0."""
+    live = mask & ~ignored
+    mn = C.masked_min(scores, live)
+    mx = C.masked_max(scores, live)
+    ok = jnp.isfinite(mx) & (mx > 0)
+    out = jnp.where(ok, MAX_NODE_SCORE * (mx + mn - scores)
+                    / jnp.where(ok, mx, 1.0), MAX_NODE_SCORE)
+    return jnp.where(ignored, 0.0, out)

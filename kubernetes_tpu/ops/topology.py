@@ -1,0 +1,317 @@
+"""InterPodAffinity + PodTopologySpread as topology-domain tensor kernels.
+
+The reference computes per-pod PreFilter state by scanning all pods on all
+nodes into `(topologyKey, topologyValue) -> count` hash maps
+(interpodaffinity/filtering.go:204-272, podtopologyspread/filtering.go:235+)
+and then does per-node map lookups. The TPU-native formulation replaces the
+hash maps with dense per-topology-key domain arrays:
+
+- every registered topology key tk has a compact domain-id space [0, D);
+  a node's domain under tk is ``ct.topo_dom[n, tk]`` (NONE = label absent);
+- "existing pod p affects all nodes in its domain" becomes a scatter of
+  per-(pod-slot, term) matches into a ``[TK or A or C, D]`` map;
+- "node n looks up its (key, value) pair" becomes a gather of that map at
+  ``topo_dom[n, tk]``.
+
+Scatter + gather over dense domain ids is exactly the XLA-friendly shape of
+the reference's two-phase build/lookup — one launch, no hashing, vmappable
+over the pod batch.
+
+Reference semantics implemented here:
+- interpodaffinity/filtering.go: satisfyExistingPodsAntiAffinity (:352),
+  satisfyPodAntiAffinity (:367), satisfyPodAffinity (:382) including the
+  first-pod-of-a-group rule.
+- interpodaffinity/scoring.go: processExistingPod (:81-123) — incoming
+  preferred terms both directions, existing pods' required terms at
+  hardPodAffinityWeight, existing pods' preferred terms.
+- podtopologyspread/filtering.go: skew = matchNum + selfMatchNum -
+  minMatchNum > maxSkew (:311), minDomains (:300), node-inclusion policies.
+- podtopologyspread/scoring.go: scoreForCount (:300) with
+  topologyNormalizingWeight = log(size + 2) (:292).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from kubernetes_tpu.ops import common as C
+from kubernetes_tpu.ops.features import (  # noqa: F401  (IMPOSSIBLE re-export)
+    IMPOSSIBLE,
+    ClusterTensors,
+    PodFeatures,
+)
+from kubernetes_tpu.utils.interner import NONE
+
+
+def take_cols(table: jnp.ndarray, cols: jnp.ndarray, fill) -> jnp.ndarray:
+    """table: [R, K]; cols: [...] i32 (NONE allowed). -> [R, *cols.shape]."""
+    k = table.shape[1]
+    safe = jnp.clip(cols, 0, k - 1)
+    out = jnp.take(table, safe.reshape(-1), axis=1)
+    out = out.reshape((table.shape[0],) + cols.shape)
+    return jnp.where(cols[None] >= 0, out, fill)
+
+
+def slot_topo_dom(ct: ClusterTensors) -> jnp.ndarray:
+    """[PT, TK]: topology domain of each table pod's node per topo key.
+    Shared across the whole batch — compute once per launch."""
+    tds = ct.topo_dom[jnp.maximum(ct.pod_node, 0)]
+    return jnp.where(ct.pod_valid[:, None], tds, NONE)
+
+
+def incoming_terms_vs_table(ct: ClusterTensors, tk: jnp.ndarray,
+                            ns: jnp.ndarray, sel_cols: jnp.ndarray,
+                            sel_vals: jnp.ndarray) -> jnp.ndarray:
+    """[PT, A]: does table pod s satisfy the incoming pod's term a?
+    (term.Matches: s.ns in term.namespaces and selector matches s's labels)"""
+    ns_ok = C.isin(ct.pod_ns[:, None], ns[None])               # [PT, A]
+    tv = take_cols(ct.pt_label_vals, sel_cols, NONE)           # [PT, A, MS]
+    used = sel_vals != NONE
+    sel_ok = jnp.all((tv == sel_vals[None]) | ~used[None], axis=-1)
+    return ns_ok & sel_ok & ct.pod_valid[:, None] & (tk[None] != NONE)
+
+
+def table_terms_vs_incoming(ct: ClusterTensors, grp_tk: jnp.ndarray,
+                            grp_ns: jnp.ndarray, grp_cols: jnp.ndarray,
+                            grp_vals: jnp.ndarray,
+                            pod: PodFeatures) -> jnp.ndarray:
+    """[PT, A]: does the incoming pod satisfy table pod s's term a?"""
+    ns_ok = jnp.any((grp_ns == pod.ns) & (grp_ns != NONE), axis=-1)  # [PT, A]
+    kp = pod.plabel_vals.shape[0]
+    pv = pod.plabel_vals[jnp.clip(grp_cols, 0, kp - 1)]        # [PT, A, MS]
+    pv = jnp.where(grp_cols >= 0, pv, NONE)
+    sel_ok = jnp.all((pv == grp_vals) | (grp_vals == NONE), axis=-1)
+    return ns_ok & sel_ok & (grp_tk != NONE) & ct.pod_valid[:, None]
+
+
+def scatter_or(tk2d: jnp.ndarray, dom2d: jnp.ndarray, hit2d: jnp.ndarray,
+               num_rows: int, d_cap: int) -> jnp.ndarray:
+    """[num_rows, d_cap] bool: OR of hits at (row=tk2d, col=dom2d)."""
+    ok = hit2d & (tk2d != NONE) & (dom2d != NONE)
+    flat = jnp.clip(tk2d, 0) * d_cap + jnp.clip(dom2d, 0)
+    m = jnp.zeros((num_rows * d_cap,), bool)
+    m = m.at[flat.reshape(-1)].max(ok.reshape(-1))
+    return m.reshape(num_rows, d_cap)
+
+
+def gather_rows(m: jnp.ndarray, dom: jnp.ndarray):
+    """m: [R, D]; dom: [N, R] domain per node per row -> m[r, dom[n, r]]
+    masked where dom is NONE (False/0)."""
+    r = m.shape[0]
+    vals = m[jnp.arange(r)[None, :], jnp.clip(dom, 0)]
+    zero = jnp.zeros((), m.dtype)
+    return jnp.where(dom != NONE, vals, zero)
+
+
+# --------------------------- InterPodAffinity ---------------------------
+
+
+def inter_pod_affinity_filter(ct: ClusterTensors, pod: PodFeatures,
+                              tds: jnp.ndarray, d_cap: int) -> jnp.ndarray:
+    """[N] accept mask for one pod (filtering.go Filter)."""
+    tk_cap = ct.topo_dom.shape[1]
+
+    # 1. existing pods' required anti-affinity vs incoming pod
+    m1 = table_terms_vs_incoming(ct, ct.pod_anti_tk, ct.pod_anti_ns,
+                                 ct.pod_anti_sel_cols, ct.pod_anti_sel_vals,
+                                 pod)                              # [PT, A]
+    dom1 = jnp.take_along_axis(tds, jnp.clip(ct.pod_anti_tk, 0, tk_cap - 1),
+                               axis=1)
+    dom1 = jnp.where(ct.pod_anti_tk != NONE, dom1, NONE)
+    f1 = scatter_or(ct.pod_anti_tk, dom1, m1, tk_cap, d_cap)       # [TK, D]
+    fail1 = jnp.any(gather_rows(f1, ct.topo_dom), axis=1)    # [N]
+
+    # 2. incoming pod's required anti-affinity vs existing pods
+    m2 = incoming_terms_vs_table(ct, pod.anti_tk, pod.anti_ns,
+                                 pod.anti_sel_cols, pod.anti_sel_vals)
+    dom2 = tds[:, jnp.clip(pod.anti_tk, 0, tk_cap - 1)]            # [PT, A]
+    dom2 = jnp.where(pod.anti_tk[None] != NONE, dom2, NONE)
+    tk2 = jnp.broadcast_to(pod.anti_tk[None], m2.shape)
+    f2 = scatter_or(tk2, dom2, m2, tk_cap, d_cap)
+    fail2 = jnp.any(gather_rows(f2, ct.topo_dom), axis=1)
+
+    # 3. incoming pod's required affinity: every term needs a matching pod
+    #    in the node's domain (node must carry every term's topology label)
+    a_cap = pod.aff_tk.shape[0]
+    m3 = incoming_terms_vs_table(ct, pod.aff_tk, pod.aff_ns,
+                                 pod.aff_sel_cols, pod.aff_sel_vals)
+    dom3 = tds[:, jnp.clip(pod.aff_tk, 0, tk_cap - 1)]             # [PT, A]
+    dom3 = jnp.where(pod.aff_tk[None] != NONE, dom3, NONE)
+    rows3 = jnp.broadcast_to(jnp.arange(a_cap)[None], m3.shape)
+    present = scatter_or(rows3, dom3, m3, a_cap, d_cap)            # [A, D]
+    term_used = pod.aff_tk != NONE                                 # [A]
+    node_dom = take_cols(ct.topo_dom, pod.aff_tk, NONE)            # [N, A]
+    has_lbl = node_dom != NONE
+    cnt_ok = gather_rows(present, node_dom)                  # [N, A]
+    term_ok = has_lbl & cnt_ok
+    pods_exist = jnp.all(term_ok | ~term_used[None], axis=1)       # [N]
+    all_lbl = jnp.all(has_lbl | ~term_used[None], axis=1)
+    # first-pod-of-a-group: no term matched ANY existing pod anywhere, the
+    # pod matches its own terms, and the node has all requested topologies
+    any_match = jnp.any(m3 & (dom3 != NONE) & term_used[None])
+    self_ok = pod.aff_self_match & ~any_match & all_lbl
+    aff_ok = jnp.where(jnp.any(term_used), pods_exist | self_ok, True)
+
+    return ~fail1 & ~fail2 & aff_ok
+
+
+def inter_pod_affinity_score(ct: ClusterTensors, pod: PodFeatures,
+                             tds: jnp.ndarray, d_cap: int,
+                             hard_weight: jnp.ndarray) -> jnp.ndarray:
+    """[N] raw score (scoring.go processExistingPod); normalized max-min at
+    aggregation (NormalizeScore :258)."""
+    tk_cap = ct.topo_dom.shape[1]
+    score = jnp.zeros((tk_cap * d_cap,), jnp.float32)
+
+    def add_incoming(score, tk, ns, cols, vals, w, sign):
+        m = incoming_terms_vs_table(ct, tk, ns, cols, vals)        # [PT, A]
+        dom = tds[:, jnp.clip(tk, 0, tk_cap - 1)]
+        ok = m & (dom != NONE) & (tk[None] != NONE)
+        flat = jnp.clip(tk[None], 0) * d_cap + jnp.clip(dom, 0)
+        upd = jnp.where(ok, sign * w[None].astype(jnp.float32), 0.0)
+        return score.at[flat.reshape(-1)].add(upd.reshape(-1))
+
+    def add_table(score, tk, ns, cols, vals, w, sign):
+        m = table_terms_vs_incoming(ct, tk, ns, cols, vals, pod)   # [PT, A]
+        dom = jnp.take_along_axis(tds, jnp.clip(tk, 0, tk_cap - 1), axis=1)
+        ok = m & (dom != NONE) & (tk != NONE)
+        flat = jnp.clip(tk, 0) * d_cap + jnp.clip(dom, 0)
+        upd = jnp.where(ok, sign * w.astype(jnp.float32), 0.0)
+        return score.at[flat.reshape(-1)].add(upd.reshape(-1))
+
+    score = add_incoming(score, pod.paff_tk, pod.paff_ns, pod.paff_sel_cols,
+                         pod.paff_sel_vals, pod.paff_weight, 1.0)
+    score = add_incoming(score, pod.panti_tk, pod.panti_ns,
+                         pod.panti_sel_cols, pod.panti_sel_vals,
+                         pod.panti_weight, -1.0)
+    hw = jnp.broadcast_to(hard_weight, ct.pod_aff_tk.shape)
+    score = add_table(score, ct.pod_aff_tk, ct.pod_aff_ns,
+                      ct.pod_aff_sel_cols, ct.pod_aff_sel_vals, hw, 1.0)
+    score = add_table(score, ct.pod_paff_tk, ct.pod_paff_ns,
+                      ct.pod_paff_sel_cols, ct.pod_paff_sel_vals,
+                      ct.pod_paff_weight, 1.0)
+    score = add_table(score, ct.pod_panti_tk, ct.pod_panti_ns,
+                      ct.pod_panti_sel_cols, ct.pod_panti_sel_vals,
+                      ct.pod_panti_weight, -1.0)
+
+    per_tk = gather_rows(score.reshape(tk_cap, d_cap), ct.topo_dom)
+    return jnp.sum(per_tk, axis=1)                                 # [N]
+
+
+# --------------------------- PodTopologySpread ---------------------------
+
+
+def _tsc_self_match(pod: PodFeatures) -> jnp.ndarray:
+    """[C]: does the pod match its own constraint selector? (selfMatchNum)"""
+    kp = pod.plabel_vals.shape[0]
+    pv = pod.plabel_vals[jnp.clip(pod.tsc_sel_cols, 0, kp - 1)]    # [C, MS]
+    pv = jnp.where(pod.tsc_sel_cols >= 0, pv, NONE)
+    return jnp.all((pv == pod.tsc_sel_vals) | (pod.tsc_sel_vals == NONE),
+                   axis=-1)
+
+
+def _tsc_matches(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
+    """[PT, C]: table pod s matches constraint c's selector in pod's ns."""
+    ns_ok = ct.pod_ns[:, None] == pod.ns                           # [PT, 1]
+    tv = take_cols(ct.pt_label_vals, pod.tsc_sel_cols, NONE)       # [PT, C, MS]
+    used = pod.tsc_sel_vals != NONE
+    sel_ok = jnp.all((tv == pod.tsc_sel_vals[None]) | ~used[None], axis=-1)
+    return sel_ok & ns_ok & ct.pod_valid[:, None] & (pod.tsc_tk[None] != NONE)
+
+
+def spread_eligible(ct: ClusterTensors, pod: PodFeatures,
+                    nodeaff_ok: jnp.ndarray, taint_ok: jnp.ndarray,
+                    consider: jnp.ndarray) -> jnp.ndarray:
+    """[N, C] node-inclusion eligibility per constraint
+    (matchNodeInclusionPolicies, common.go:33-127), plus the
+    requireAllTopologies rule: a node missing ANY considered constraint's
+    topology label is ignored entirely (filtering.go calPreFilterState).
+
+    ``consider`` [C] selects the constraint set: the Filter path evaluates
+    only DoNotSchedule constraints, the Score path only ScheduleAnyway —
+    mixing them would let a soft constraint on an unlabeled key disable
+    hard filtering."""
+    node_dom = take_cols(ct.topo_dom, pod.tsc_tk, NONE)            # [N, C]
+    all_topo = jnp.all((node_dom != NONE) | ~consider[None], axis=1)  # [N]
+    base = ct.node_valid & all_topo                                # [N]
+    ok = jnp.where(pod.tsc_honor_affinity[None], nodeaff_ok[:, None], True)
+    ok = ok & jnp.where(pod.tsc_honor_taints[None], taint_ok[:, None], True)
+    return base[:, None] & ok & consider[None]                     # [N, C]
+
+
+def spread_filter(ct: ClusterTensors, pod: PodFeatures, tds: jnp.ndarray,
+                  eligible: jnp.ndarray, d_cap: int) -> jnp.ndarray:
+    """[N] accept mask for DoNotSchedule constraints (filtering.go:311)."""
+    tk_cap = ct.topo_dom.shape[1]
+    c_cap = pod.tsc_tk.shape[0]
+    # counts: matching pods on ELIGIBLE nodes, per (constraint, domain)
+    m = _tsc_matches(ct, pod)                                      # [PT, C]
+    m = m & eligible[jnp.maximum(ct.pod_node, 0)]                  # [PT, C]
+    dom = tds[:, jnp.clip(pod.tsc_tk, 0, tk_cap - 1)]              # [PT, C]
+    dom = jnp.where(pod.tsc_tk[None] != NONE, dom, NONE)
+    ok = m & (dom != NONE)
+    flat = jnp.broadcast_to(jnp.arange(c_cap)[None], m.shape) * d_cap \
+        + jnp.clip(dom, 0)
+    cnt = jnp.zeros((c_cap * d_cap,), jnp.float32)
+    cnt = cnt.at[flat.reshape(-1)].add(ok.reshape(-1).astype(jnp.float32))
+    cnt = cnt.reshape(c_cap, d_cap)                                # [C, D]
+
+    node_dom = take_cols(ct.topo_dom, pod.tsc_tk, NONE)            # [N, C]
+    exists = scatter_or(jnp.broadcast_to(jnp.arange(c_cap)[None],
+                                         node_dom.shape),
+                        node_dom, eligible, c_cap, d_cap)          # [C, D]
+    num_domains = jnp.sum(exists, axis=1)                          # [C]
+    min_cnt = jnp.min(jnp.where(exists, cnt, jnp.inf), axis=1)     # [C]
+    min_cnt = jnp.where(jnp.isfinite(min_cnt), min_cnt, 0.0)
+    # minDomains: fewer eligible domains than required -> global min is 0
+    min_cnt = jnp.where((pod.tsc_min_domains > 0)
+                        & (num_domains < pod.tsc_min_domains), 0.0, min_cnt)
+
+    self_m = _tsc_self_match(pod).astype(jnp.float32)              # [C]
+    match_num = gather_rows(cnt, node_dom)                   # [N, C]
+    skew = match_num + self_m[None] - min_cnt[None]
+    used_hard = (pod.tsc_tk != NONE) & pod.tsc_hard                # [C]
+    ok_c = (node_dom != NONE) & (skew <= pod.tsc_max_skew[None])
+    return jnp.all(ok_c | ~used_hard[None], axis=1)                # [N]
+
+
+def spread_score(ct: ClusterTensors, pod: PodFeatures, tds: jnp.ndarray,
+                 eligible: jnp.ndarray, filtered: jnp.ndarray,
+                 d_cap: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Raw spread score + ignored mask (scoring.go).
+
+    score[n] = sum over SOFT constraints of
+        cnt(domain of n) * log(topoSize + 2) + (maxSkew - 1)
+    where topoSize counts domains among `filtered` nodes. Lower is better —
+    normalized at aggregation as 100 * (max + min - s) / max, ignored -> 0.
+    """
+    tk_cap = ct.topo_dom.shape[1]
+    c_cap = pod.tsc_tk.shape[0]
+    used_soft = (pod.tsc_tk != NONE) & ~pod.tsc_hard               # [C]
+
+    m = _tsc_matches(ct, pod) & eligible[jnp.maximum(ct.pod_node, 0)]
+    dom = tds[:, jnp.clip(pod.tsc_tk, 0, tk_cap - 1)]              # [PT, C]
+    dom = jnp.where(pod.tsc_tk[None] != NONE, dom, NONE)
+    ok = m & (dom != NONE)
+    flat = jnp.broadcast_to(jnp.arange(c_cap)[None], m.shape) * d_cap \
+        + jnp.clip(dom, 0)
+    cnt = jnp.zeros((c_cap * d_cap,), jnp.float32)
+    cnt = cnt.at[flat.reshape(-1)].add(ok.reshape(-1).astype(jnp.float32))
+    cnt = cnt.reshape(c_cap, d_cap)
+
+    node_dom = take_cols(ct.topo_dom, pod.tsc_tk, NONE)            # [N, C]
+    has = node_dom != NONE
+    ignored = jnp.any(~has & used_soft[None], axis=1)              # [N]
+
+    exists = scatter_or(jnp.broadcast_to(jnp.arange(c_cap)[None],
+                                         node_dom.shape),
+                        node_dom, filtered[:, None] & ~ignored[:, None],
+                        c_cap, d_cap)                              # [C, D]
+    topo_size = jnp.sum(exists, axis=1).astype(jnp.float32)        # [C]
+    tp_weight = jnp.log(topo_size + 2.0)
+
+    match_num = gather_rows(cnt, node_dom)                   # [N, C]
+    per_c = match_num * tp_weight[None] \
+        + (pod.tsc_max_skew[None].astype(jnp.float32) - 1.0)
+    per_c = jnp.where(used_soft[None] & has, per_c, 0.0)
+    return jnp.sum(per_c, axis=1), ignored

@@ -172,3 +172,31 @@ def test_host_port_conflicts():
     assert not h2.conflicts("10.0.0.2", "TCP", 443)
     h2.remove("10.0.0.1", "TCP", 443)
     assert not h2.conflicts("0.0.0.0", "TCP", 443)
+
+
+def test_incremental_device_push_matches_full_upload():
+    """After incremental syncs, the scattered device buffers must equal a
+    fresh full pack (the device half of UpdateSnapshot integrity,
+    cache.go:266-277 snapshot-recovery invariant)."""
+    import numpy as np
+
+    from kubernetes_tpu.backend.mirror import Mirror
+    from kubernetes_tpu.models.testbed import build_cluster, make_node, make_pod
+    from kubernetes_tpu.ops.features import Capacities
+
+    caps = Capacities(nodes=32, pods=64)
+    cache, snap, mirror = build_cluster(10, caps=caps)
+    _ = mirror.to_blobs()  # first full upload
+    # churn: add pods, remove a node, add a node
+    for i in range(5):
+        p = make_pod(i)
+        p.spec.node_name = f"node-{i}"
+        cache.add_pod(p)
+    cache.remove_node(cache._nodes["node-7"].info.node)
+    cache.add_node(make_node(20))
+    cache.update_snapshot(snap)
+    mirror.sync(snap)
+    blobs = mirror.to_blobs()  # incremental scatter path
+    np.testing.assert_array_equal(np.asarray(blobs.node_f32), mirror.node_f32)
+    np.testing.assert_array_equal(np.asarray(blobs.node_i32), mirror.node_i32)
+    np.testing.assert_array_equal(np.asarray(blobs.pods_i32), mirror.pods_i32)
