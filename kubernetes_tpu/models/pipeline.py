@@ -41,6 +41,7 @@ from kubernetes_tpu.ops import common as C
 from kubernetes_tpu.ops import filters as FL
 from kubernetes_tpu.ops import scores as SC
 from kubernetes_tpu.ops import topology as T
+from kubernetes_tpu.utils.interner import NONE
 from kubernetes_tpu.ops.features import (
     Capacities,
     ClusterBlobs,
@@ -172,39 +173,66 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
         taint_raw = SC.taint_toleration_score(ct, pod)         # [N]
         aff_raw = SC.node_affinity_score(ct, pod)              # [N]
         img = SC.image_locality(ct, pod, num_valid)            # [N]
-        if enable_topology:
-            # topology plugins (commit-invariant vs the pre-batch pod table;
-            # in-batch commit effects are layered on in the commit scan)
-            taint_ok, nodeaff_ok = masks[2], masks[3]
-            used_c = pod.tsc_tk != jnp.int32(-1)
-            el_hard = T.spread_eligible(ct, pod, nodeaff_ok, taint_ok,
-                                        used_c & pod.tsc_hard)
-            el_soft = T.spread_eligible(ct, pod, nodeaff_ok, taint_ok,
-                                        used_c & ~pod.tsc_hard)
-            m_spread = T.spread_filter(ct, pod, tds, el_hard, d_cap)   # [N]
-            m_ipa = T.inter_pod_affinity_filter(ct, pod, tds, d_cap)   # [N]
-            ipa_raw = T.inter_pod_affinity_score(
-                ct, pod, tds, d_cap, jnp.float32(HARD_POD_AFFINITY_WEIGHT))
-            spread_raw, spread_ignored = T.spread_score(
-                ct, pod, tds, el_soft, static_ok & m_spread & m_ipa, d_cap)
-            has_soft = jnp.any(used_c & ~pod.tsc_hard)
-        else:
-            ones = jnp.ones_like(static_ok)
-            zeros = jnp.zeros_like(taint_raw)
-            m_spread = m_ipa = ones
-            ipa_raw = spread_raw = zeros
-            spread_ignored = ~ones
-            has_soft = jnp.bool_(False)
         # fit can never succeed: request exceeds allocatable (Unresolvable)
         unresolvable = jnp.any(pod.req[None] > ct.allocatable, axis=-1)
         unres_count = jnp.sum(unresolvable & valid).astype(jnp.int32)
+        if not enable_topology:
+            return (static_ok, static_rejects, taint_raw, aff_raw, img,
+                    unres_count)
+        # topology plugins: pre-batch-table statics here; the commit scan
+        # layers in-batch deltas for full as-if-serial semantics
+        taint_ok, nodeaff_ok = masks[2], masks[3]
+        used_c = pod.tsc_tk != jnp.int32(-1)
+        used_soft = used_c & ~pod.tsc_hard
+        el_hard = T.spread_eligible(ct, pod, nodeaff_ok, taint_ok,
+                                    used_c & pod.tsc_hard)
+        el_soft = T.spread_eligible(ct, pod, nodeaff_ok, taint_ok, used_soft)
+        el_mixed = jnp.where(pod.tsc_hard[None], el_hard, el_soft)
+        cnt = T.spread_cnt(ct, pod, tds, el_mixed, d_cap)      # [C, D]
+        exists_hard = T.spread_exists(ct, pod, el_hard, d_cap)  # [C, D]
+        node_dom = T.take_cols(ct.topo_dom, pod.tsc_tk, jnp.int32(-1))
+        spread_ignored = jnp.any((node_dom == jnp.int32(-1))
+                                 & used_soft[None], axis=1)     # [N]
+        # topoSize over (approximately) filtered nodes: static filters only,
+        # matching PreScore's filteredNodes modulo in-batch effects
+        exists_score = T.spread_exists(
+            ct, pod, (static_ok & ~spread_ignored)[:, None] & used_soft[None],
+            d_cap)
+        tp_weight = jnp.log(jnp.sum(exists_score, axis=1)
+                            .astype(jnp.float32) + 2.0)         # [C]
+        tsc_self = T._tsc_self_match(pod).astype(jnp.float32)   # [C]
+        ipa_anti_ok, aff_present, aff_any = T.inter_pod_affinity_static(
+            ct, pod, tds, d_cap)
+        ipa_raw = T.inter_pod_affinity_score(
+            ct, pod, tds, d_cap, jnp.float32(HARD_POD_AFFINITY_WEIGHT))
+        has_soft = jnp.any(used_soft)
+        nodeaff_v = nodeaff_ok & valid
+        taint_v = taint_ok & valid
         return (static_ok, static_rejects, taint_raw, aff_raw, img,
-                m_spread, m_ipa, ipa_raw, spread_raw, spread_ignored,
-                has_soft, unres_count)
+                unres_count, cnt, exists_hard, spread_ignored, tp_weight,
+                tsc_self, ipa_anti_ok, aff_present, aff_any, ipa_raw,
+                has_soft, nodeaff_v, taint_v)
 
-    (static_ok, static_rejects, taint_raw, aff_raw, img, m_spread, m_ipa,
-     ipa_raw, spread_raw, spread_ignored, has_soft, unres) = jax.vmap(
-        per_pod)(pods)
+    outs = jax.vmap(per_pod)(pods)
+    (static_ok, static_rejects, taint_raw, aff_raw, img, unres) = outs[:6]
+    if enable_topology:
+        (cnt_s, exists_hard, spread_ignored, tp_weight, tsc_self,
+         ipa_anti_ok, aff_present, aff_any, ipa_raw, has_soft,
+         nodeaff_v, taint_v) = outs[6:]
+        # pairwise pod<->pod term matches (placement-independent)
+        M_anti = T.pair_term_match(pods.anti_tk, pods.anti_ns,
+                                   pods.anti_sel_cols, pods.anti_sel_vals,
+                                   pods.plabel_vals, pods.ns, pods.valid)
+        M_aff = T.pair_term_match(pods.aff_tk, pods.aff_ns,
+                                  pods.aff_sel_cols, pods.aff_sel_vals,
+                                  pods.plabel_vals, pods.ns, pods.valid)
+        M_paff = T.pair_term_match(pods.paff_tk, pods.paff_ns,
+                                   pods.paff_sel_cols, pods.paff_sel_vals,
+                                   pods.plabel_vals, pods.ns, pods.valid)
+        M_panti = T.pair_term_match(pods.panti_tk, pods.panti_ns,
+                                    pods.panti_sel_cols, pods.panti_sel_vals,
+                                    pods.plabel_vals, pods.ns, pods.valid)
+        M_tsc = T.pair_tsc_match(pods)                          # [B, C, B]
 
     # ---- phase 2: sequential commit scan (tiny per-step work) ----
     alloc2 = SC.alloc_cpu_mem(ct)                               # [N, 2]
@@ -213,10 +241,98 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
     # conflicting batch pod was committed (as-if-serial NodePorts)
     port_conf = FL.pod_pair_port_conflict(pods, wk["wildcard_ip"])  # [B, B]
 
+    topo_dom = ct.topo_dom
+    tk_cap = topo_dom.shape[1]
+
     def body(carry, xs):
         free, nzr, committed_rows = carry
-        (b, ok_s, t_raw, a_raw, im, sp_ok, ipa_ok, ipa_r, sp_r, sp_ign,
-         soft, req, nzreq) = xs
+        if enable_topology:
+            (b, ok_s, t_raw, a_raw, im, req, nzreq, cnt_b, exh_b, ign_b,
+             tpw_b, self_b, ipa_anti_b, pres_b, any_b, ipa_r, soft_b,
+             naff_b, tnt_b) = xs
+            act = committed_rows >= 0                            # [B]
+            dom_commit = topo_dom[jnp.maximum(committed_rows, 0)]  # [B, TK]
+            # InterPodAffinity with in-batch commits:
+            # committed pods' anti terms vs this pod
+            hits1 = M_anti[:, :, b] & act[:, None]               # [B, A]
+            fail1 = T.step_terms_forbid(pods.anti_tk, dom_commit, hits1,
+                                        topo_dom, d_cap)
+            # this pod's anti terms vs committed pods
+            hits2 = M_anti[b] & act[None]                        # [A, B]
+            fail2 = T.step_own_terms_forbid(pods.anti_tk[b], dom_commit,
+                                            hits2, topo_dom, d_cap)
+            # this pod's required affinity incl. committed pods
+            hits3 = M_aff[b] & act[None]                         # [A, B]
+            aff_ok = T.step_affinity_ok(pods.aff_tk[b],
+                                        pods.aff_self_match[b], pres_b,
+                                        any_b, hits3, dom_commit, topo_dom,
+                                        d_cap)
+            ipa_ok = ipa_anti_b & ~fail1 & ~fail2 & aff_ok
+            # spread with in-batch commits: eligibility of committed nodes
+            r_c = jnp.maximum(committed_rows, 0)
+            av, tv = naff_b[r_c], tnt_b[r_c]                     # [B]
+            dom_jc = dom_commit[:, jnp.clip(pods.tsc_tk[b], 0, tk_cap - 1)]
+            dom_jc = jnp.where(pods.tsc_tk[b][None] != NONE, dom_jc, NONE)
+            used_c = pods.tsc_tk[b] != NONE
+            hard_c = used_c & pods.tsc_hard[b]
+            soft_c = used_c & ~pods.tsc_hard[b]
+            all_h = jnp.all((dom_jc != NONE) | ~hard_c[None], axis=1)  # [B]
+            all_s = jnp.all((dom_jc != NONE) | ~soft_c[None], axis=1)
+            pol = (jnp.where(pods.tsc_honor_affinity[b][None], av[:, None],
+                             True)
+                   & jnp.where(pods.tsc_honor_taints[b][None], tv[:, None],
+                               True))                            # [B, C]
+            el_c = (act[:, None] & pol
+                    & jnp.where(hard_c[None], all_h[:, None], all_s[:, None])
+                    & used_c[None])                              # [B, C]
+            hits_t = M_tsc[b] & el_c.T                           # [C, B]
+            cnt_live = cnt_b + T.step_spread_delta(
+                pods.tsc_tk[b], hits_t, dom_commit, tk_cap, d_cap)
+            sp_ok, sp_r = T.step_spread(
+                topo_dom, pods.tsc_tk[b], pods.tsc_hard[b],
+                pods.tsc_max_skew[b], pods.tsc_min_domains[b], self_b,
+                cnt_live, exh_b, tpw_b, ign_b)
+            # InterPodAffinity score delta from committed pods
+            def own_dom(tk_all):  # [B, A]: committed pod's dom under own term
+                d = jnp.take_along_axis(dom_commit,
+                                        jnp.clip(tk_all, 0, tk_cap - 1),
+                                        axis=1)
+                return jnp.where(tk_all != NONE, d, NONE)
+
+            def tgt_dom(tk_i):    # [A, B]: committed pod's dom under b's term
+                d = dom_commit[:, jnp.clip(tk_i, 0, tk_cap - 1)].T
+                return jnp.where(tk_i[:, None] != NONE, d, NONE)
+
+            hw = jnp.full(pods.aff_tk.shape, HARD_POD_AFFINITY_WEIGHT,
+                          jnp.float32)
+            groups = [
+                (jnp.broadcast_to(pods.paff_tk[b][:, None], M_paff[b].shape),
+                 tgt_dom(pods.paff_tk[b]), M_paff[b] & act[None],
+                 jnp.broadcast_to(pods.paff_weight[b][:, None],
+                                  M_paff[b].shape), 1.0),
+                (jnp.broadcast_to(pods.panti_tk[b][:, None],
+                                  M_panti[b].shape),
+                 tgt_dom(pods.panti_tk[b]), M_panti[b] & act[None],
+                 jnp.broadcast_to(pods.panti_weight[b][:, None],
+                                  M_panti[b].shape), -1.0),
+                (pods.aff_tk, own_dom(pods.aff_tk),
+                 M_aff[:, :, b] & act[:, None], hw, 1.0),
+                (pods.paff_tk, own_dom(pods.paff_tk),
+                 M_paff[:, :, b] & act[:, None],
+                 pods.paff_weight.astype(jnp.float32), 1.0),
+                (pods.panti_tk, own_dom(pods.panti_tk),
+                 M_panti[:, :, b] & act[:, None],
+                 pods.panti_weight.astype(jnp.float32), -1.0),
+            ]
+            ipa_live = ipa_r + T.step_ipa_score_delta(topo_dom, dom_commit,
+                                                      d_cap, groups)
+        else:
+            (b, ok_s, t_raw, a_raw, im, req, nzreq) = xs
+            ones = jnp.ones_like(ok_s)
+            sp_ok = ipa_ok = ones
+            sp_r = ipa_live = jnp.zeros_like(t_raw)
+            ign_b = ~ones
+            soft_b = jnp.bool_(False)
         fit_ok = jnp.all(req[None] <= free, axis=-1)            # [N]
         # nodes holding an earlier batch commit that clashes on hostPort
         clash = port_conf[b] & (committed_rows >= 0)            # [B]
@@ -229,9 +345,9 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
         bal = SC.balanced_allocation_from_fractions(frac)
         taint = SC.normalize_inverse(t_raw, feasible)
         aff = SC.normalize_max(a_raw, feasible)
-        ipa = SC.normalize_maxmin(ipa_r, feasible)
-        spread = jnp.where(soft, SC.normalize_spread(sp_r, feasible, sp_ign),
-                           0.0)
+        ipa = SC.normalize_maxmin(ipa_live, feasible)
+        spread = jnp.where(soft_b,
+                           SC.normalize_spread(sp_r, feasible, ign_b), 0.0)
         total = (weights.taint_toleration * taint
                  + weights.node_affinity * aff
                  + weights.resources_fit * least
@@ -259,9 +375,12 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
             row, win, jnp.sum(feasible).astype(jnp.int32),
             port_rejects, fit_rejects, sp_rejects, ipa_rejects)
 
-    xs = (jnp.arange(B), static_ok, taint_raw, aff_raw, img, m_spread, m_ipa,
-          ipa_raw, spread_raw, spread_ignored, has_soft,
+    xs = (jnp.arange(B), static_ok, taint_raw, aff_raw, img,
           pods.req, pods.nonzero_req)
+    if enable_topology:
+        xs = xs + (cnt_s, exists_hard, spread_ignored, tp_weight, tsc_self,
+                   ipa_anti_ok, aff_present, aff_any, ipa_raw, has_soft,
+                   nodeaff_v, taint_v)
     init = (ct.free, ct.nonzero_requested, jnp.full((B,), -1, jnp.int32))
     _, (rows, win_scores, feas, port_rejects, fit_rejects, sp_rejects,
         ipa_rejects) = jax.lax.scan(body, init, xs)

@@ -103,12 +103,130 @@ def gather_rows(m: jnp.ndarray, dom: jnp.ndarray):
     return jnp.where(dom != NONE, vals, zero)
 
 
+# ----------------- in-batch (committed pods) machinery -----------------
+#
+# The batched commit scan must preserve as-if-serial semantics: pod b has to
+# see pods 0..b-1's placements exactly as the serial loop's assume step
+# would provide (schedule_one.go:938). For the topology plugins that means
+# pairwise pod<->pod term matches are precomputed OUTSIDE the scan (labels
+# and terms don't depend on placement), and each scan step only scatters the
+# already-committed pods' domains into small [rows, D] maps.
+
+
+def pair_term_match(tk: jnp.ndarray, ns: jnp.ndarray, cols: jnp.ndarray,
+                    vals: jnp.ndarray, tgt_labels: jnp.ndarray,
+                    tgt_ns: jnp.ndarray,
+                    tgt_valid: jnp.ndarray) -> jnp.ndarray:
+    """[Bx, A, By]: does batch pod y satisfy batch pod x's term a?
+
+    tk [Bx, A]; ns [Bx, A, NS]; cols/vals [Bx, A, MS];
+    tgt_labels [By, Kp]; tgt_ns/tgt_valid [By]."""
+    kp = tgt_labels.shape[1]
+    pv = tgt_labels.T[jnp.clip(cols, 0, kp - 1)]       # [Bx, A, MS, By]
+    pv = jnp.where(cols[..., None] >= 0, pv, NONE)
+    sel_ok = jnp.all((pv == vals[..., None]) | (vals[..., None] == NONE),
+                     axis=2)                            # [Bx, A, By]
+    ns_ok = jnp.any((ns[..., :, None] == tgt_ns[None, None, None, :])
+                    & (ns[..., :, None] != NONE), axis=2)  # [Bx, A, By]
+    return (ns_ok & sel_ok & (tk[..., None] != NONE)
+            & tgt_valid[None, None, :])
+
+
+def pair_tsc_match(pods: PodFeatures) -> jnp.ndarray:
+    """[Bx, C, By]: does batch pod y match batch pod x's spread constraint c?
+    (same namespace + folded selector over y's labels)"""
+    kp = pods.plabel_vals.shape[1]
+    pv = pods.plabel_vals.T[jnp.clip(pods.tsc_sel_cols, 0, kp - 1)]
+    pv = jnp.where(pods.tsc_sel_cols[..., None] >= 0, pv, NONE)
+    sel_ok = jnp.all((pv == pods.tsc_sel_vals[..., None])
+                     | (pods.tsc_sel_vals[..., None] == NONE), axis=2)
+    ns_ok = pods.ns[:, None, None] == pods.ns[None, None, :]
+    return (sel_ok & ns_ok & (pods.tsc_tk[..., None] != NONE)
+            & pods.valid[None, None, :])
+
+
+def step_terms_forbid(tk_terms: jnp.ndarray, dom_commit: jnp.ndarray,
+                      hits: jnp.ndarray, topo_dom: jnp.ndarray,
+                      d_cap: int) -> jnp.ndarray:
+    """[N]: nodes forbidden by committed pods' terms.
+
+    tk_terms [B, A] (term owner = committed pod j); dom_commit [B, TK]
+    (domains of each committed pod's node); hits [B, A] (term matched the
+    current pod AND owner is committed)."""
+    tk_cap = topo_dom.shape[1]
+    dom = jnp.take_along_axis(dom_commit, jnp.clip(tk_terms, 0, tk_cap - 1),
+                              axis=1)
+    dom = jnp.where(tk_terms != NONE, dom, NONE)
+    f = scatter_or(tk_terms, dom, hits, tk_cap, d_cap)
+    return jnp.any(gather_rows(f, topo_dom), axis=1)
+
+
+def step_own_terms_forbid(tk_i: jnp.ndarray, dom_commit: jnp.ndarray,
+                          hits: jnp.ndarray, topo_dom: jnp.ndarray,
+                          d_cap: int) -> jnp.ndarray:
+    """[N]: nodes forbidden by the CURRENT pod's own anti terms matching
+    committed pods. tk_i [A]; hits [A, B]; dom_commit [B, TK]."""
+    tk_cap = topo_dom.shape[1]
+    dom = dom_commit[:, jnp.clip(tk_i, 0, tk_cap - 1)].T       # [A, B]
+    dom = jnp.where(tk_i[:, None] != NONE, dom, NONE)
+    tk2 = jnp.broadcast_to(tk_i[:, None], hits.shape)
+    f = scatter_or(tk2, dom, hits, tk_cap, d_cap)
+    return jnp.any(gather_rows(f, topo_dom), axis=1)
+
+
+def step_affinity_ok(aff_tk_i: jnp.ndarray, self_match_i: jnp.ndarray,
+                     present_static: jnp.ndarray, any_match_static,
+                     hits: jnp.ndarray, dom_commit: jnp.ndarray,
+                     topo_dom: jnp.ndarray, d_cap: int) -> jnp.ndarray:
+    """[N]: required-affinity verdict including committed batch pods.
+
+    present_static [A, D] (from the pre-batch table); hits [A, B] (current
+    pod's affinity term a matches committed pod j)."""
+    tk_cap = topo_dom.shape[1]
+    a_cap = aff_tk_i.shape[0]
+    dom = dom_commit[:, jnp.clip(aff_tk_i, 0, tk_cap - 1)].T   # [A, B]
+    dom = jnp.where(aff_tk_i[:, None] != NONE, dom, NONE)
+    rows = jnp.broadcast_to(jnp.arange(a_cap)[:, None], hits.shape)
+    present = present_static | scatter_or(rows, dom, hits, a_cap, d_cap)
+    term_used = aff_tk_i != NONE
+    node_dom = take_cols(topo_dom, aff_tk_i, NONE)             # [N, A]
+    has_lbl = node_dom != NONE
+    term_ok = has_lbl & gather_rows(present, node_dom)
+    pods_exist = jnp.all(term_ok | ~term_used[None], axis=1)
+    all_lbl = jnp.all(has_lbl | ~term_used[None], axis=1)
+    any_match = any_match_static | jnp.any(hits & (dom != NONE))
+    self_ok = self_match_i & ~any_match & all_lbl
+    return jnp.where(jnp.any(term_used), pods_exist | self_ok, True)
+
+
+def step_ipa_score_delta(topo_dom: jnp.ndarray, dom_commit: jnp.ndarray,
+                         d_cap: int, groups) -> jnp.ndarray:
+    """[N] score delta from committed batch pods.
+
+    groups: iterable of (tk, dom, hits, weight, sign) with aligned shapes —
+    see the pipeline for the five scoring directions. Each entry scatters
+    weight*sign at (tk, dom) for its hits."""
+    tk_cap = topo_dom.shape[1]
+    dmap = jnp.zeros((tk_cap * d_cap,), jnp.float32)
+    for tk2d, dom2d, hits, w, sign in groups:
+        ok = hits & (tk2d != NONE) & (dom2d != NONE)
+        flat = jnp.clip(tk2d, 0) * d_cap + jnp.clip(dom2d, 0)
+        upd = jnp.where(ok, sign * w.astype(jnp.float32), 0.0)
+        dmap = dmap.at[flat.reshape(-1)].add(upd.reshape(-1))
+    per_tk = gather_rows(dmap.reshape(tk_cap, d_cap), topo_dom)
+    return jnp.sum(per_tk, axis=1)
+
+
 # --------------------------- InterPodAffinity ---------------------------
 
 
-def inter_pod_affinity_filter(ct: ClusterTensors, pod: PodFeatures,
-                              tds: jnp.ndarray, d_cap: int) -> jnp.ndarray:
-    """[N] accept mask for one pod (filtering.go Filter)."""
+def inter_pod_affinity_static(ct: ClusterTensors, pod: PodFeatures,
+                              tds: jnp.ndarray, d_cap: int):
+    """Pre-batch-table part of the Filter (filtering.go): returns
+    (anti_ok [N] — rules 1+2 vs the table, present [A, D] — affinity
+    presence map from the table, any_match — scalar). The commit scan layers
+    in-batch deltas on top (step_terms_forbid/step_own_terms_forbid/
+    step_affinity_ok)."""
     tk_cap = ct.topo_dom.shape[1]
 
     # 1. existing pods' required anti-affinity vs incoming pod
@@ -140,19 +258,8 @@ def inter_pod_affinity_filter(ct: ClusterTensors, pod: PodFeatures,
     rows3 = jnp.broadcast_to(jnp.arange(a_cap)[None], m3.shape)
     present = scatter_or(rows3, dom3, m3, a_cap, d_cap)            # [A, D]
     term_used = pod.aff_tk != NONE                                 # [A]
-    node_dom = take_cols(ct.topo_dom, pod.aff_tk, NONE)            # [N, A]
-    has_lbl = node_dom != NONE
-    cnt_ok = gather_rows(present, node_dom)                  # [N, A]
-    term_ok = has_lbl & cnt_ok
-    pods_exist = jnp.all(term_ok | ~term_used[None], axis=1)       # [N]
-    all_lbl = jnp.all(has_lbl | ~term_used[None], axis=1)
-    # first-pod-of-a-group: no term matched ANY existing pod anywhere, the
-    # pod matches its own terms, and the node has all requested topologies
     any_match = jnp.any(m3 & (dom3 != NONE) & term_used[None])
-    self_ok = pod.aff_self_match & ~any_match & all_lbl
-    aff_ok = jnp.where(jnp.any(term_used), pods_exist | self_ok, True)
-
-    return ~fail1 & ~fail2 & aff_ok
+    return ~fail1 & ~fail2, present, any_match
 
 
 def inter_pod_affinity_score(ct: ClusterTensors, pod: PodFeatures,
@@ -239,12 +346,12 @@ def spread_eligible(ct: ClusterTensors, pod: PodFeatures,
     return base[:, None] & ok & consider[None]                     # [N, C]
 
 
-def spread_filter(ct: ClusterTensors, pod: PodFeatures, tds: jnp.ndarray,
-                  eligible: jnp.ndarray, d_cap: int) -> jnp.ndarray:
-    """[N] accept mask for DoNotSchedule constraints (filtering.go:311)."""
+def spread_cnt(ct: ClusterTensors, pod: PodFeatures, tds: jnp.ndarray,
+               eligible: jnp.ndarray, d_cap: int) -> jnp.ndarray:
+    """[C, D] f32: matching pods per (constraint, domain), counting only
+    pods on nodes eligible for that constraint (TpPairToMatchNum)."""
     tk_cap = ct.topo_dom.shape[1]
     c_cap = pod.tsc_tk.shape[0]
-    # counts: matching pods on ELIGIBLE nodes, per (constraint, domain)
     m = _tsc_matches(ct, pod)                                      # [PT, C]
     m = m & eligible[jnp.maximum(ct.pod_node, 0)]                  # [PT, C]
     dom = tds[:, jnp.clip(pod.tsc_tk, 0, tk_cap - 1)]              # [PT, C]
@@ -254,64 +361,67 @@ def spread_filter(ct: ClusterTensors, pod: PodFeatures, tds: jnp.ndarray,
         + jnp.clip(dom, 0)
     cnt = jnp.zeros((c_cap * d_cap,), jnp.float32)
     cnt = cnt.at[flat.reshape(-1)].add(ok.reshape(-1).astype(jnp.float32))
-    cnt = cnt.reshape(c_cap, d_cap)                                # [C, D]
-
-    node_dom = take_cols(ct.topo_dom, pod.tsc_tk, NONE)            # [N, C]
-    exists = scatter_or(jnp.broadcast_to(jnp.arange(c_cap)[None],
-                                         node_dom.shape),
-                        node_dom, eligible, c_cap, d_cap)          # [C, D]
-    num_domains = jnp.sum(exists, axis=1)                          # [C]
-    min_cnt = jnp.min(jnp.where(exists, cnt, jnp.inf), axis=1)     # [C]
-    min_cnt = jnp.where(jnp.isfinite(min_cnt), min_cnt, 0.0)
-    # minDomains: fewer eligible domains than required -> global min is 0
-    min_cnt = jnp.where((pod.tsc_min_domains > 0)
-                        & (num_domains < pod.tsc_min_domains), 0.0, min_cnt)
-
-    self_m = _tsc_self_match(pod).astype(jnp.float32)              # [C]
-    match_num = gather_rows(cnt, node_dom)                   # [N, C]
-    skew = match_num + self_m[None] - min_cnt[None]
-    used_hard = (pod.tsc_tk != NONE) & pod.tsc_hard                # [C]
-    ok_c = (node_dom != NONE) & (skew <= pod.tsc_max_skew[None])
-    return jnp.all(ok_c | ~used_hard[None], axis=1)                # [N]
+    return cnt.reshape(c_cap, d_cap)
 
 
-def spread_score(ct: ClusterTensors, pod: PodFeatures, tds: jnp.ndarray,
-                 eligible: jnp.ndarray, filtered: jnp.ndarray,
-                 d_cap: int) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Raw spread score + ignored mask (scoring.go).
-
-    score[n] = sum over SOFT constraints of
-        cnt(domain of n) * log(topoSize + 2) + (maxSkew - 1)
-    where topoSize counts domains among `filtered` nodes. Lower is better —
-    normalized at aggregation as 100 * (max + min - s) / max, ignored -> 0.
-    """
-    tk_cap = ct.topo_dom.shape[1]
+def spread_exists(ct: ClusterTensors, pod: PodFeatures,
+                  node_mask: jnp.ndarray, d_cap: int) -> jnp.ndarray:
+    """[C, D] bool: domains present among masked-in nodes per constraint.
+    node_mask: [N, C]."""
     c_cap = pod.tsc_tk.shape[0]
-    used_soft = (pod.tsc_tk != NONE) & ~pod.tsc_hard               # [C]
+    node_dom = take_cols(ct.topo_dom, pod.tsc_tk, NONE)            # [N, C]
+    return scatter_or(jnp.broadcast_to(jnp.arange(c_cap)[None],
+                                       node_dom.shape),
+                      node_dom, node_mask, c_cap, d_cap)
 
-    m = _tsc_matches(ct, pod) & eligible[jnp.maximum(ct.pod_node, 0)]
-    dom = tds[:, jnp.clip(pod.tsc_tk, 0, tk_cap - 1)]              # [PT, C]
-    dom = jnp.where(pod.tsc_tk[None] != NONE, dom, NONE)
-    ok = m & (dom != NONE)
-    flat = jnp.broadcast_to(jnp.arange(c_cap)[None], m.shape) * d_cap \
+
+def step_spread_delta(tsc_tk_i: jnp.ndarray, hits: jnp.ndarray,
+                      dom_commit: jnp.ndarray, tk_cap: int,
+                      d_cap: int) -> jnp.ndarray:
+    """[C, D] f32 count delta from committed batch pods.
+    tsc_tk_i [C]; hits [C, B] (pod j matches constraint c AND is committed
+    on an eligible node); dom_commit [B, TK]."""
+    c_cap = tsc_tk_i.shape[0]
+    dom = dom_commit[:, jnp.clip(tsc_tk_i, 0, tk_cap - 1)].T       # [C, B]
+    dom = jnp.where(tsc_tk_i[:, None] != NONE, dom, NONE)
+    ok = hits & (dom != NONE)
+    flat = jnp.broadcast_to(jnp.arange(c_cap)[:, None], hits.shape) * d_cap \
         + jnp.clip(dom, 0)
     cnt = jnp.zeros((c_cap * d_cap,), jnp.float32)
     cnt = cnt.at[flat.reshape(-1)].add(ok.reshape(-1).astype(jnp.float32))
-    cnt = cnt.reshape(c_cap, d_cap)
+    return cnt.reshape(c_cap, d_cap)
 
-    node_dom = take_cols(ct.topo_dom, pod.tsc_tk, NONE)            # [N, C]
-    has = node_dom != NONE
-    ignored = jnp.any(~has & used_soft[None], axis=1)              # [N]
 
-    exists = scatter_or(jnp.broadcast_to(jnp.arange(c_cap)[None],
-                                         node_dom.shape),
-                        node_dom, filtered[:, None] & ~ignored[:, None],
-                        c_cap, d_cap)                              # [C, D]
-    topo_size = jnp.sum(exists, axis=1).astype(jnp.float32)        # [C]
-    tp_weight = jnp.log(topo_size + 2.0)
+def step_spread(topo_dom: jnp.ndarray, tsc_tk: jnp.ndarray,
+                tsc_hard: jnp.ndarray, tsc_max_skew: jnp.ndarray,
+                tsc_min_domains: jnp.ndarray, self_match: jnp.ndarray,
+                cnt: jnp.ndarray, exists_hard: jnp.ndarray,
+                tp_weight: jnp.ndarray, ignored: jnp.ndarray
+                ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(accept mask [N], raw soft score [N]) from live counts.
 
-    match_num = gather_rows(cnt, node_dom)                   # [N, C]
+    Runs inside the commit scan with cnt = static + in-batch delta, so the
+    skew check and the score both see earlier batch commits (as-if-serial).
+    Filter: skew = matchNum + selfMatch - minMatchNum > maxSkew rejects
+    (filtering.go:311, minDomains :300); score: cnt * log(size+2) +
+    (maxSkew-1) over soft constraints (scoring.go)."""
+    node_dom = take_cols(topo_dom, tsc_tk, NONE)                   # [N, C]
+    used = tsc_tk != NONE
+    used_hard = used & tsc_hard
+    used_soft = used & ~tsc_hard
+
+    num_domains = jnp.sum(exists_hard, axis=1)                     # [C]
+    min_cnt = jnp.min(jnp.where(exists_hard, cnt, jnp.inf), axis=1)
+    min_cnt = jnp.where(jnp.isfinite(min_cnt), min_cnt, 0.0)
+    min_cnt = jnp.where((tsc_min_domains > 0)
+                        & (num_domains < tsc_min_domains), 0.0, min_cnt)
+
+    match_num = gather_rows(cnt, node_dom)                         # [N, C]
+    skew = match_num + self_match[None] - min_cnt[None]
+    ok_c = (node_dom != NONE) & (skew <= tsc_max_skew[None])
+    mask = jnp.all(ok_c | ~used_hard[None], axis=1)                # [N]
+
     per_c = match_num * tp_weight[None] \
-        + (pod.tsc_max_skew[None].astype(jnp.float32) - 1.0)
-    per_c = jnp.where(used_soft[None] & has, per_c, 0.0)
-    return jnp.sum(per_c, axis=1), ignored
+        + (tsc_max_skew[None].astype(jnp.float32) - 1.0)
+    per_c = jnp.where(used_soft[None] & (node_dom != NONE), per_c, 0.0)
+    return mask, jnp.where(ignored, 0.0, jnp.sum(per_c, axis=1))

@@ -139,23 +139,63 @@ def test_required_affinity_unsatisfiable_when_not_self_matching():
     assert names == [None]
 
 
-def test_in_batch_anti_affinity_is_deferred():
-    """v0 limitation (full in-batch commit semantics are the next milestone):
-    two anti-affine pods in ONE batch don't yet see each other — they only
-    see the pre-batch table. Placed sequentially they do."""
+def test_in_batch_anti_affinity():
+    """As-if-serial: two self-anti-affine pods in ONE batch must land in
+    different zones, and a third must be unschedulable (2 zones)."""
     cl = Cluster(ZONES)
-    first, _ = cl.run([mkpod("p1", {"app": "web"},
-                             affinity=anti(LABEL_ZONE, app="web"))])
-    assert first[0] is not None
-    committed = mkpod("p1", {"app": "web"}, node=first[0],
-                      affinity=anti(LABEL_ZONE, app="web"))
-    cl.cache.add_pod(committed)
-    cl.cache.update_snapshot(cl.snap)
-    cl.mirror.sync(cl.snap)
-    second, _ = cl.run([mkpod("p2", {"app": "web"},
-                              affinity=anti(LABEL_ZONE, app="web"))])
+    pods = [mkpod(f"p{i}", {"app": "web"},
+                  affinity=anti(LABEL_ZONE, app="web")) for i in range(3)]
+    names, out = cl.run(pods)
     z = {"n1": "z1", "n2": "z1", "n3": "z2"}
-    assert z[second[0]] != z[first[0]]
+    assert names[0] is not None and names[1] is not None
+    assert z[names[0]] != z[names[1]]
+    assert names[2] is None, "only two zones exist"
+
+
+def test_in_batch_anti_affinity_matches_sequential():
+    """One batch == sequential single-pod batches with host resync between."""
+    def run_seq(cl, pods):
+        placed = []
+        for p in pods:
+            names, _ = cl.run([p])
+            placed.append(names[0])
+            if names[0] is not None:
+                bound = p.clone()
+                bound.spec.node_name = names[0]
+                cl.cache.add_pod(bound)
+                cl.cache.update_snapshot(cl.snap)
+                cl.mirror.sync(cl.snap)
+        return placed
+
+    mk = lambda i: mkpod(f"p{i}", {"app": "web"},
+                         affinity=anti(LABEL_HOSTNAME, app="web"))
+    batched, _ = Cluster(ZONES).run([mk(i) for i in range(4)])
+    sequential = run_seq(Cluster(ZONES), [mk(i) for i in range(4)])
+    assert batched == sequential
+
+
+def test_in_batch_affinity_follows_batch_commit():
+    """Pod 2's required affinity is satisfied by pod 1's in-batch commit."""
+    cl = Cluster(ZONES)
+    leader = mkpod("leader", {"app": "grp"},
+                   affinity=aff(LABEL_ZONE, app="grp"))  # self-match rule
+    follower = mkpod("follower", affinity=aff(LABEL_ZONE, app="grp"))
+    names, _ = cl.run([leader, follower])
+    z = {"n1": "z1", "n2": "z1", "n3": "z2"}
+    assert names[0] is not None and names[1] is not None
+    assert z[names[0]] == z[names[1]]
+
+
+def test_in_batch_spread_counts():
+    """Hard hostname spread within one batch: 3 pods, 3 nodes, one each."""
+    cl = Cluster(ZONES)
+    pods = [mkpod(f"p{i}", {"app": "s"},
+                  tsc=[hard_spread(LABEL_HOSTNAME, app="s")])
+            for i in range(4)]
+    names, _ = cl.run(pods)
+    assert sorted(names[:3]) == ["n1", "n2", "n3"]
+    # 4th pod: every node at count 1, min 1 -> skew 1+1-1 = 1 <= 1: fits
+    assert names[3] is not None
 
 
 def hard_spread(key, max_skew=1, **sel):
