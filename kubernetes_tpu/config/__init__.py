@@ -1,0 +1,10 @@
+from kubernetes_tpu.config.types import (  # noqa: F401
+    Plugin,
+    PluginSet,
+    Plugins,
+    SchedulerConfiguration,
+    SchedulerProfile,
+    default_config,
+    default_plugins,
+)
+from kubernetes_tpu.config.validation import validate_config  # noqa: F401

@@ -1,0 +1,124 @@
+"""Scheduler component configuration.
+
+From-scratch equivalent of KubeSchedulerConfiguration
+(/root/reference/pkg/scheduler/apis/config/types.go:37-190) with the same
+semantics for profiles, per-extension-point plugin enable/disable sets, the
+MultiPoint shorthand, and score weights — plus the TPU-build's own knobs
+(batch size, capacity bucket hints).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+DEFAULT_SCHEDULER_NAME = "default-scheduler"
+
+EXTENSION_POINTS = (
+    "pre_enqueue", "queue_sort", "pre_filter", "filter", "post_filter",
+    "pre_score", "score", "reserve", "permit", "pre_bind", "bind",
+    "post_bind",
+)
+
+
+@dataclass
+class Plugin:
+    """One enabled/disabled plugin entry (types.go Plugin): name + Score
+    weight (only meaningful on the score / multi_point sets)."""
+
+    name: str
+    weight: float = 0.0
+
+
+@dataclass
+class PluginSet:
+    """enabled extends defaults; disabled removes them ("*" wipes all)
+    (types.go PluginSet)."""
+
+    enabled: list[Plugin] = field(default_factory=list)
+    disabled: list[Plugin] = field(default_factory=list)
+
+
+def _ps() -> PluginSet:
+    return PluginSet()
+
+
+@dataclass
+class Plugins:
+    """Plugin sets per extension point + the MultiPoint shorthand
+    (types.go:133-190)."""
+
+    pre_enqueue: PluginSet = field(default_factory=_ps)
+    queue_sort: PluginSet = field(default_factory=_ps)
+    pre_filter: PluginSet = field(default_factory=_ps)
+    filter: PluginSet = field(default_factory=_ps)
+    post_filter: PluginSet = field(default_factory=_ps)
+    pre_score: PluginSet = field(default_factory=_ps)
+    score: PluginSet = field(default_factory=_ps)
+    reserve: PluginSet = field(default_factory=_ps)
+    permit: PluginSet = field(default_factory=_ps)
+    pre_bind: PluginSet = field(default_factory=_ps)
+    bind: PluginSet = field(default_factory=_ps)
+    post_bind: PluginSet = field(default_factory=_ps)
+    multi_point: PluginSet = field(default_factory=_ps)
+
+
+@dataclass
+class SchedulerProfile:
+    """One named scheduler within the process (types.go:100)."""
+
+    scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    plugins: Plugins = field(default_factory=Plugins)
+    # plugin name -> args object (types_pluginargs.go); plain dicts here
+    plugin_config: dict[str, dict[str, Any]] = field(default_factory=dict)
+
+
+@dataclass
+class SchedulerConfiguration:
+    """Top-level component config (types.go:37-97)."""
+
+    parallelism: int = 16
+    profiles: list[SchedulerProfile] = field(default_factory=list)
+    percentage_of_nodes_to_score: Optional[int] = None  # 0/None = adaptive
+    pod_initial_backoff_seconds: float = 1.0
+    pod_max_backoff_seconds: float = 10.0
+    # TPU-build knobs
+    batch_size: int = 256       # pods scored per XLA launch
+    node_capacity: int = 1024   # initial mirror bucket (grows by pow2)
+    pod_table_capacity: int = 4096
+
+    def profile(self, scheduler_name: str) -> Optional[SchedulerProfile]:
+        for p in self.profiles:
+            if p.scheduler_name == scheduler_name:
+                return p
+        return None
+
+
+# default enablement + weights: apis/config/v1/default_plugins.go:30-58,
+# expressed through MultiPoint exactly like the reference
+DEFAULT_MULTI_POINT = (
+    ("SchedulingGates", 0),
+    ("PrioritySort", 0),
+    ("NodeUnschedulable", 0),
+    ("NodeName", 0),
+    ("TaintToleration", 3),
+    ("NodeAffinity", 2),
+    ("NodePorts", 0),
+    ("NodeResourcesFit", 1),
+    ("PodTopologySpread", 2),
+    ("InterPodAffinity", 2),
+    ("DefaultPreemption", 0),
+    ("NodeResourcesBalancedAllocation", 1),
+    ("ImageLocality", 1),
+    ("DefaultBinder", 0),
+)
+
+
+def default_plugins() -> Plugins:
+    return Plugins(multi_point=PluginSet(
+        enabled=[Plugin(name, weight) for name, weight in DEFAULT_MULTI_POINT]))
+
+
+def default_config() -> SchedulerConfiguration:
+    return SchedulerConfiguration(profiles=[
+        SchedulerProfile(plugins=default_plugins())])

@@ -1,0 +1,42 @@
+"""Configuration validation (apis/config/validation in the reference)."""
+
+from __future__ import annotations
+
+from kubernetes_tpu.config.types import SchedulerConfiguration
+
+
+def validate_config(cfg: SchedulerConfiguration,
+                    registry: dict | None = None) -> list[str]:
+    """Returns a list of error strings (empty = valid)."""
+    errs: list[str] = []
+    if cfg.parallelism <= 0:
+        errs.append("parallelism must be positive")
+    if cfg.batch_size <= 0:
+        errs.append("batch_size must be positive")
+    if cfg.pod_initial_backoff_seconds <= 0:
+        errs.append("pod_initial_backoff_seconds must be positive")
+    if cfg.pod_max_backoff_seconds < cfg.pod_initial_backoff_seconds:
+        errs.append("pod_max_backoff_seconds must be >= initial backoff")
+    if (cfg.percentage_of_nodes_to_score is not None
+            and not 0 <= cfg.percentage_of_nodes_to_score <= 100):
+        errs.append("percentage_of_nodes_to_score must be in [0, 100]")
+    if not cfg.profiles:
+        errs.append("at least one profile is required")
+    names = [p.scheduler_name for p in cfg.profiles]
+    if len(set(names)) != len(names):
+        errs.append("duplicate profile schedulerName")
+    if registry is not None:
+        for prof in cfg.profiles:
+            sets = [getattr(prof.plugins, pt) for pt in (
+                "pre_enqueue", "queue_sort", "pre_filter", "filter",
+                "post_filter", "pre_score", "score", "reserve", "permit",
+                "pre_bind", "bind", "post_bind", "multi_point")]
+            for ps in sets:
+                for pl in ps.enabled:
+                    if pl.name not in registry:
+                        errs.append(
+                            f"profile {prof.scheduler_name}: unknown plugin "
+                            f"{pl.name}")
+                    if pl.weight < 0:
+                        errs.append(f"plugin {pl.name}: negative weight")
+    return errs

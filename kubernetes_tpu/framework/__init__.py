@@ -1,0 +1,9 @@
+from kubernetes_tpu.framework.interface import (  # noqa: F401
+    ActionType,
+    ClusterEvent,
+    Code,
+    EventResource,
+    QueueingHint,
+    Status,
+)
+from kubernetes_tpu.framework.cycle_state import CycleState  # noqa: F401

@@ -1,0 +1,236 @@
+"""Framework runtime: resolves a profile into runnable extension points.
+
+Equivalent of the reference's frameworkImpl
+(/root/reference/pkg/scheduler/framework/runtime/framework.go:53,268):
+instantiates plugins from the registry, expands the MultiPoint shorthand
+with override semantics (expandMultiPointPlugins :523), resolves score
+weights (scorePluginWeight :57), and exposes per-point runners.
+
+The structural difference from the reference: RunFilterPlugins /
+RunScorePlugins for the device plugin set are NOT virtual calls per
+(plugin, node) — they are one fused launch of models.pipeline. The runtime
+therefore exposes the launch configuration (enabled filter slots, the
+ScoreWeights vector) instead, and runs only host plugins procedurally.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kubernetes_tpu.api.objects import Pod
+from kubernetes_tpu.config.types import SchedulerProfile
+from kubernetes_tpu.framework.cycle_state import CycleState
+from kubernetes_tpu.framework.interface import (
+    BindPlugin,
+    ClusterEventWithHint,
+    PermitPlugin,
+    PostBindPlugin,
+    PostFilterPlugin,
+    PreBindPlugin,
+    PreEnqueuePlugin,
+    QueueSortPlugin,
+    ReservePlugin,
+    Status,
+)
+from kubernetes_tpu.models.pipeline import (
+    FILTER_PLUGINS,
+    SCORE_PLUGINS,
+    ScoreWeights,
+)
+from kubernetes_tpu.plugins.registry import PluginDescriptor, in_tree_registry
+
+import jax.numpy as jnp
+
+# pipeline ScoreWeights field per SCORE_PLUGINS entry
+_WEIGHT_FIELD = {
+    "TaintToleration": "taint_toleration",
+    "NodeAffinity": "node_affinity",
+    "NodeResourcesFit": "resources_fit",
+    "NodeResourcesBalancedAllocation": "balanced_allocation",
+    "ImageLocality": "image_locality",
+    "PodTopologySpread": "pod_topology_spread",
+    "InterPodAffinity": "inter_pod_affinity",
+}
+
+
+class Framework:
+    """One profile's resolved plugin configuration + host-plugin instances."""
+
+    def __init__(self, profile: SchedulerProfile,
+                 registry: Optional[dict[str, PluginDescriptor]] = None,
+                 extra_args: Optional[dict] = None):
+        self.profile = profile
+        self.registry = dict(in_tree_registry() if registry is None
+                             else registry)
+        self._extra_args = extra_args or {}
+        # point -> ordered list of (name, weight)
+        self.points: dict[str, list[tuple[str, float]]] = {}
+        for point in ("pre_enqueue", "queue_sort", "filter", "post_filter",
+                      "score", "reserve", "permit", "pre_bind", "bind",
+                      "post_bind"):
+            self.points[point] = self._expand(point)
+        self._instances: dict[str, object] = {}
+        for point, entries in self.points.items():
+            for name, _ in entries:
+                d = self.registry.get(name)
+                if d is not None and d.factory is not None \
+                        and name not in self._instances:
+                    args = dict(profile.plugin_config.get(name, {}))
+                    args.update(self._extra_args)
+                    self._instances[name] = d.factory(args)
+
+    # ------------- MultiPoint expansion (framework.go:523) -------------
+
+    def _expand(self, point: str) -> list[tuple[str, float]]:
+        plugins = self.profile.plugins
+        ps = getattr(plugins, point)
+        mp = plugins.multi_point
+        disabled = {p.name for p in ps.disabled}
+        wipe = "*" in disabled
+        mp_disabled = {p.name for p in mp.disabled}
+        mp_wipe = "*" in mp_disabled
+        explicit = {p.name: p for p in ps.enabled}
+        out: list[tuple[str, float]] = []
+        consumed: set[str] = set()
+        for p in mp.enabled:
+            d = self.registry.get(p.name)
+            if d is None or point not in d.points:
+                continue
+            if mp_wipe or p.name in mp_disabled:
+                continue
+            if wipe or p.name in disabled:
+                continue
+            if p.name in explicit:
+                # specific-point config overrides weight, keeps MP order
+                out.append((p.name, self._weight(point, explicit[p.name].weight,
+                                                 p.weight, d)))
+                consumed.add(p.name)
+            else:
+                out.append((p.name, self._weight(point, 0.0, p.weight, d)))
+        for p in ps.enabled:
+            if p.name in consumed:
+                continue
+            d = self.registry.get(p.name)
+            if d is None or point not in d.points:
+                continue
+            out.append((p.name, self._weight(point, p.weight, 0.0, d)))
+        return out
+
+    @staticmethod
+    def _weight(point: str, explicit: float, multipoint: float,
+                d: PluginDescriptor) -> float:
+        if point != "score":
+            return 0.0
+        # scorePluginWeight: explicit > multipoint > default > 1
+        return explicit or multipoint or d.default_weight or 1.0
+
+    # ------------- device launch configuration -------------
+
+    def enabled_filters(self) -> tuple[bool, ...]:
+        """Static per-slot enable flags for pipeline.FILTER_PLUGINS."""
+        on = {name for name, _ in self.points["filter"]}
+        return tuple(name in on for name in FILTER_PLUGINS)
+
+    def score_weights(self) -> ScoreWeights:
+        """Dynamic ScoreWeights vector from resolved config weights."""
+        w = {name: weight for name, weight in self.points["score"]}
+        fields = {}
+        for plugin in SCORE_PLUGINS:
+            fields[_WEIGHT_FIELD[plugin]] = jnp.float32(w.get(plugin, 0.0))
+        return ScoreWeights(**fields)
+
+    # ------------- host extension-point runners -------------
+
+    def instance(self, name: str):
+        return self._instances.get(name)
+
+    def _iter(self, point: str, cls):
+        for name, _ in self.points[point]:
+            inst = self._instances.get(name)
+            if isinstance(inst, cls):
+                yield inst
+
+    def run_pre_enqueue_plugins(self, pod: Pod) -> Status:
+        """interface.go PreEnqueuePlugin; gate failures keep the pod in
+        unschedulablePods (scheduling_queue.go:538 runPreEnqueuePlugins)."""
+        for pl in self._iter("pre_enqueue", PreEnqueuePlugin):
+            s = pl.pre_enqueue(pod)
+            if not s.is_success():
+                s.plugin = s.plugin or pl.name()
+                return s
+        return Status()
+
+    def queue_sort_less(self, a, b) -> bool:
+        for pl in self._iter("queue_sort", QueueSortPlugin):
+            return pl.less(a, b)
+        # fallback: PrioritySort semantics
+        if a.pod.priority() != b.pod.priority():
+            return a.pod.priority() > b.pod.priority()
+        return a.timestamp < b.timestamp
+
+    def run_reserve_plugins(self, state: CycleState, pod: Pod,
+                            node_name: str) -> Status:
+        for pl in self._iter("reserve", ReservePlugin):
+            s = pl.reserve(state, pod, node_name)
+            if not s.is_success():
+                return s
+        return Status()
+
+    def run_unreserve_plugins(self, state: CycleState, pod: Pod,
+                              node_name: str) -> None:
+        for pl in self._iter("reserve", ReservePlugin):
+            pl.unreserve(state, pod, node_name)
+
+    def run_permit_plugins(self, state: CycleState, pod: Pod,
+                           node_name: str) -> Status:
+        for pl in self._iter("permit", PermitPlugin):
+            s, _timeout = pl.permit(state, pod, node_name)
+            if not s.is_success():
+                return s
+        return Status()
+
+    def run_pre_bind_plugins(self, state: CycleState, pod: Pod,
+                             node_name: str) -> Status:
+        for pl in self._iter("pre_bind", PreBindPlugin):
+            s = pl.pre_bind(state, pod, node_name)
+            if not s.is_success():
+                return s
+        return Status()
+
+    def run_bind_plugins(self, state: CycleState, pod: Pod,
+                         node_name: str) -> Status:
+        for pl in self._iter("bind", BindPlugin):
+            s = pl.bind(state, pod, node_name)
+            if not s.is_skip():
+                return s
+        return Status.error("no bind plugin handled the pod")
+
+    def run_post_bind_plugins(self, state: CycleState, pod: Pod,
+                              node_name: str) -> None:
+        for pl in self._iter("post_bind", PostBindPlugin):
+            pl.post_bind(state, pod, node_name)
+
+    def run_post_filter_plugins(self, state: CycleState, pod: Pod,
+                                diagnosis) -> tuple[Optional[str], Status]:
+        """Returns (nominated_node_name, status)."""
+        for pl in self._iter("post_filter", PostFilterPlugin):
+            result, s = pl.post_filter(state, pod, diagnosis)
+            if s.is_success() or s.code.name == "ERROR":
+                return result, s
+        return None, Status.unschedulable("no postFilter plugin helped")
+
+    # ------------- queueing hints (scheduler.go:428) -------------
+
+    def events_to_register(self) -> dict[str, list[ClusterEventWithHint]]:
+        """plugin name -> cluster events that may unstick its rejections."""
+        out: dict[str, list[ClusterEventWithHint]] = {}
+        seen: set[str] = set()
+        for entries in self.points.values():
+            for name, _ in entries:
+                if name in seen:
+                    continue
+                seen.add(name)
+                d = self.registry.get(name)
+                if d is not None and d.events:
+                    out[name] = list(d.events)
+        return out

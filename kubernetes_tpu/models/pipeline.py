@@ -125,23 +125,30 @@ class BatchResult:
 
 
 def static_filters(ct: ClusterTensors, pod: PodFeatures,
-                   wk: dict[str, jnp.ndarray]) -> jnp.ndarray:
-    """Commit-invariant Filter plugins for one pod over all nodes: [P-1, N]
-    masks in FILTER_PLUGINS order (NodeResourcesFit runs in the commit scan).
-    """
-    return jnp.stack([
-        FL.node_unschedulable(ct, pod, wk["unschedulable_taint_key"]),
-        FL.node_name(ct, pod),
-        FL.taint_toleration(ct, pod),
-        FL.node_affinity(ct, pod),
-        FL.node_ports(ct, pod, wk["wildcard_ip"]),
-    ])
+                   wk: dict[str, jnp.ndarray],
+                   enabled: tuple[bool, ...]) -> jnp.ndarray:
+    """Commit-invariant Filter plugins for one pod over all nodes: [5, N]
+    masks in FILTER_PLUGINS order (the rest run in the commit scan).
+    ``enabled`` (static, from the framework's resolved config) replaces a
+    disabled plugin's mask with all-True — XLA dead-code-eliminates it."""
+    fns = (
+        lambda: FL.node_unschedulable(ct, pod, wk["unschedulable_taint_key"]),
+        lambda: FL.node_name(ct, pod),
+        lambda: FL.taint_toleration(ct, pod),
+        lambda: FL.node_affinity(ct, pod),
+        lambda: FL.node_ports(ct, pod, wk["wildcard_ip"]),
+    )
+    n = ct.node_valid.shape[0]
+    return jnp.stack([fn() if enabled[i] else jnp.ones((n,), bool)
+                      for i, fn in enumerate(fns)])
 
 
 def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                    wk: dict[str, jnp.ndarray], weights: ScoreWeights,
                    caps: Capacities, enable_topology: bool = True,
-                   d_cap: int | None = None) -> BatchResult:
+                   d_cap: int | None = None,
+                   enabled_filters: tuple[bool, ...] | None = None
+                   ) -> BatchResult:
     """Schedule a whole pod batch in one launch, as-if-serial (see module
     docstring for the two-phase structure).
 
@@ -157,11 +164,18 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
     valid = ct.node_valid
     if d_cap is None:
         d_cap = caps.domain_cap
+    if enabled_filters is None:
+        enabled_filters = (True,) * NUM_FILTER_PLUGINS
+    fit_on = enabled_filters[FILTER_PLUGINS.index("NodeResourcesFit")]
+    spread_on = (enable_topology
+                 and enabled_filters[FILTER_PLUGINS.index("PodTopologySpread")])
+    ipa_on = (enable_topology
+              and enabled_filters[FILTER_PLUGINS.index("InterPodAffinity")])
     tds = T.slot_topo_dom(ct)  # [PT, TK], shared across the batch
 
     # ---- phase 1: parallel over the batch ----
     def per_pod(pod: PodFeatures):
-        masks = static_filters(ct, pod, wk)                    # [P-1, N]
+        masks = static_filters(ct, pod, wk, enabled_filters)   # [5, N]
         static_ok = jnp.all(masks, axis=0) & valid & pod.valid  # [N]
         # first-fail attribution among the static plugins
         prev_ok = jnp.cumprod(
@@ -292,6 +306,10 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                 topo_dom, pods.tsc_tk[b], pods.tsc_hard[b],
                 pods.tsc_max_skew[b], pods.tsc_min_domains[b], self_b,
                 cnt_live, exh_b, tpw_b, ign_b)
+            if not spread_on:   # filter disabled by config (score may stay)
+                sp_ok = jnp.ones_like(sp_ok)
+            if not ipa_on:
+                ipa_ok = jnp.ones_like(sp_ok)
             # InterPodAffinity score delta from committed pods
             def own_dom(tk_all):  # [B, A]: committed pod's dom under own term
                 d = jnp.take_along_axis(dom_commit,
@@ -333,7 +351,10 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
             sp_r = ipa_live = jnp.zeros_like(t_raw)
             ign_b = ~ones
             soft_b = jnp.bool_(False)
-        fit_ok = jnp.all(req[None] <= free, axis=-1)            # [N]
+        if fit_on:
+            fit_ok = jnp.all(req[None] <= free, axis=-1)        # [N]
+        else:
+            fit_ok = jnp.ones(free.shape[0], bool)
         # nodes holding an earlier batch commit that clashes on hostPort
         clash = port_conf[b] & (committed_rows >= 0)            # [B]
         forbidden = jnp.zeros_like(fit_ok).at[
@@ -394,8 +415,10 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                        reject_counts=reject_counts, unresolvable_count=unres)
 
 
-@partial(jax.jit, static_argnames=("caps", "enable_topology", "d_cap"))
+@partial(jax.jit, static_argnames=("caps", "enable_topology", "d_cap",
+                                   "enabled_filters"))
 def schedule_batch_jit(cblobs, pblobs, wk, weights, caps,
-                       enable_topology=True, d_cap=None):
+                       enable_topology=True, d_cap=None,
+                       enabled_filters=None):
     return schedule_batch(cblobs, pblobs, wk, weights, caps,
-                          enable_topology, d_cap)
+                          enable_topology, d_cap, enabled_filters)

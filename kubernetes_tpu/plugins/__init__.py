@@ -1,0 +1,6 @@
+from kubernetes_tpu.plugins.registry import (  # noqa: F401
+    DEVICE_FILTER_PLUGINS,
+    DEVICE_SCORE_PLUGINS,
+    PluginDescriptor,
+    in_tree_registry,
+)

@@ -1,0 +1,173 @@
+"""In-tree plugin set: descriptors binding names to extension points,
+device-kernel slots, events-to-register, and host implementations.
+
+Equivalent of the reference's plugin registry
+(/root/reference/pkg/scheduler/framework/plugins/registry.go:48-92), with
+one structural difference: plugins whose Filter/Score is fused into the
+device pipeline (models.pipeline) are DESCRIPTORS — their per-node logic
+lives in ops/* kernels keyed by their FILTER_PLUGINS / SCORE_PLUGINS slot —
+while queue/bind/lifecycle plugins are ordinary host classes implementing
+the framework interfaces.
+
+EventsToRegister sets mirror each reference plugin's EventsToRegister
+(e.g. noderesources/fit.go:265, interpodaffinity/plugin.go:62,
+podtopologyspread/plugin.go:139, nodeaffinity/node_affinity.go:89,
+tainttoleration, nodeports, nodename, nodeunschedulable,
+schedulinggates.go, defaultbinder/default_binder.go:52,
+queuesort/priority_sort.go:44).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from kubernetes_tpu.api.objects import Pod
+from kubernetes_tpu.framework.interface import (
+    ActionType,
+    BindPlugin,
+    ClusterEvent,
+    ClusterEventWithHint,
+    EventResource,
+    PreEnqueuePlugin,
+    QueueSortPlugin,
+    Status,
+)
+
+A = ActionType
+R = EventResource
+
+
+def _ev(resource: R, action: A) -> ClusterEventWithHint:
+    return ClusterEventWithHint(event=ClusterEvent(resource, action))
+
+
+@dataclass
+class PluginDescriptor:
+    """Metadata for one in-tree plugin."""
+
+    name: str
+    points: tuple[str, ...]
+    default_weight: float = 0.0
+    # slot names into pipeline.FILTER_PLUGINS / SCORE_PLUGINS when the
+    # plugin's Filter/Score math runs on device
+    device_filter: bool = False
+    device_score: bool = False
+    events: list[ClusterEventWithHint] = field(default_factory=list)
+    # factory for plugins with host-side behavior (queue sort, gates, bind…)
+    factory: Optional[Callable[[dict], object]] = None
+
+
+class SchedulingGates(PreEnqueuePlugin):
+    """Holds pods with non-empty spec.schedulingGates out of the activeQ
+    (plugins/schedulinggates/scheduling_gates.go)."""
+
+    NAME = "SchedulingGates"
+
+    def pre_enqueue(self, pod: Pod) -> Status:
+        if not pod.spec.scheduling_gates:
+            return Status()
+        gates = ", ".join(g.name for g in pod.spec.scheduling_gates)
+        return Status.unschedulable(
+            f"waiting for scheduling gates: {gates}",
+            plugin=self.NAME, resolvable=False)
+
+
+class PrioritySort(QueueSortPlugin):
+    """(priority desc, queue-time asc) (queuesort/priority_sort.go:44)."""
+
+    NAME = "PrioritySort"
+
+    def less(self, a, b) -> bool:
+        pa, pb = a.pod.priority(), b.pod.priority()
+        if pa != pb:
+            return pa > pb
+        return a.timestamp < b.timestamp
+
+
+class DefaultBinder(BindPlugin):
+    """POSTs the Binding (defaultbinder/default_binder.go:52); the hub/client
+    is injected by the scheduler."""
+
+    NAME = "DefaultBinder"
+
+    def __init__(self, binder: Optional[Callable[[Pod, str], None]] = None):
+        self._binder = binder
+
+    def bind(self, state, pod: Pod, node_name: str) -> Status:
+        if self._binder is None:
+            return Status.error("no binder client configured", self.NAME)
+        try:
+            self._binder(pod, node_name)
+        except Exception as e:  # noqa: BLE001 — surfaced as Status
+            return Status.error(str(e), self.NAME)
+        return Status()
+
+
+def in_tree_registry() -> dict[str, PluginDescriptor]:
+    """name -> descriptor for every in-tree plugin (registry.go:48)."""
+    pod_del = _ev(R.ASSIGNED_POD, A.DELETE | A.UPDATE_POD_SCALE_DOWN)
+    node_alloc = _ev(R.NODE, A.ADD | A.UPDATE_NODE_ALLOCATABLE)
+    descriptors = [
+        PluginDescriptor(
+            name="SchedulingGates", points=("pre_enqueue",),
+            factory=lambda args: SchedulingGates(),
+            events=[_ev(R.POD,
+                        A.UPDATE_POD_SCHEDULING_GATES_ELIMINATED)]),
+        PluginDescriptor(
+            name="PrioritySort", points=("queue_sort",),
+            factory=lambda args: PrioritySort()),
+        PluginDescriptor(
+            name="NodeUnschedulable", points=("filter",), device_filter=True,
+            events=[_ev(R.NODE, A.ADD | A.UPDATE_NODE_TAINT)]),
+        PluginDescriptor(
+            name="NodeName", points=("filter",), device_filter=True,
+            events=[_ev(R.NODE, A.ADD)]),
+        PluginDescriptor(
+            name="TaintToleration", points=("filter", "score"),
+            device_filter=True, device_score=True, default_weight=3,
+            events=[_ev(R.NODE, A.ADD | A.UPDATE_NODE_TAINT)]),
+        PluginDescriptor(
+            name="NodeAffinity", points=("filter", "score"),
+            device_filter=True, device_score=True, default_weight=2,
+            events=[_ev(R.NODE, A.ADD | A.UPDATE_NODE_LABEL)]),
+        PluginDescriptor(
+            name="NodePorts", points=("filter",), device_filter=True,
+            events=[_ev(R.ASSIGNED_POD, A.DELETE), node_alloc]),
+        PluginDescriptor(
+            name="NodeResourcesFit", points=("filter", "score"),
+            device_filter=True, device_score=True, default_weight=1,
+            events=[pod_del, node_alloc]),
+        PluginDescriptor(
+            name="PodTopologySpread", points=("filter", "score"),
+            device_filter=True, device_score=True, default_weight=2,
+            events=[_ev(R.ASSIGNED_POD, A.ADD | A.DELETE | A.UPDATE_POD_LABEL),
+                    _ev(R.NODE, A.ADD | A.DELETE | A.UPDATE_NODE_LABEL
+                        | A.UPDATE_NODE_TAINT)]),
+        PluginDescriptor(
+            name="InterPodAffinity", points=("filter", "score"),
+            device_filter=True, device_score=True, default_weight=2,
+            events=[_ev(R.ASSIGNED_POD, A.ADD | A.DELETE | A.UPDATE_POD_LABEL),
+                    _ev(R.NODE, A.ADD | A.UPDATE_NODE_LABEL)]),
+        PluginDescriptor(
+            name="NodeResourcesBalancedAllocation", points=("score",),
+            device_score=True, default_weight=1,
+            events=[pod_del, node_alloc]),
+        PluginDescriptor(
+            name="ImageLocality", points=("score",), device_score=True,
+            default_weight=1,
+            events=[_ev(R.NODE, A.ADD | A.UPDATE_NODE_LABEL)]),
+        PluginDescriptor(
+            name="DefaultPreemption", points=("post_filter",),
+            events=[_ev(R.ASSIGNED_POD, A.DELETE)]),
+        PluginDescriptor(
+            name="DefaultBinder", points=("bind",),
+            factory=lambda args: DefaultBinder(args.get("binder"))),
+    ]
+    return {d.name: d for d in descriptors}
+
+
+DEVICE_FILTER_PLUGINS = tuple(
+    d.name for d in in_tree_registry().values() if d.device_filter)
+DEVICE_SCORE_PLUGINS = tuple(
+    d.name for d in in_tree_registry().values() if d.device_score)

@@ -1,0 +1,151 @@
+"""Framework runtime: MultiPoint expansion, weights, gates, device config.
+
+Mirrors the reference's framework runtime tests (runtime/framework_test.go:
+multipoint expansion order, override semantics, scorePluginWeight) and
+schedulinggates/queuesort plugin tests."""
+
+import numpy as np
+
+from kubernetes_tpu.api.objects import (
+    ObjectMeta,
+    Pod,
+    PodSchedulingGate,
+    PodSpec,
+)
+from kubernetes_tpu.config.types import (
+    Plugin,
+    PluginSet,
+    default_config,
+    default_plugins,
+)
+from kubernetes_tpu.config.validation import validate_config
+from kubernetes_tpu.framework.runtime import Framework
+from kubernetes_tpu.models.pipeline import FILTER_PLUGINS
+from kubernetes_tpu.plugins.registry import in_tree_registry
+
+
+def mkfw(mutate=None) -> Framework:
+    cfg = default_config()
+    if mutate:
+        mutate(cfg.profiles[0])
+    return Framework(cfg.profiles[0])
+
+
+def test_default_expansion():
+    fw = mkfw()
+    assert [n for n, _ in fw.points["filter"]] == [
+        "NodeUnschedulable", "NodeName", "TaintToleration", "NodeAffinity",
+        "NodePorts", "NodeResourcesFit", "PodTopologySpread",
+        "InterPodAffinity"]
+    scores = dict(fw.points["score"])
+    assert scores["TaintToleration"] == 3
+    assert scores["NodeAffinity"] == 2
+    assert scores["NodeResourcesFit"] == 1
+    assert scores["PodTopologySpread"] == 2
+    assert [n for n, _ in fw.points["pre_enqueue"]] == ["SchedulingGates"]
+    assert [n for n, _ in fw.points["bind"]] == ["DefaultBinder"]
+
+
+def test_disable_star_wipes_point():
+    fw = mkfw(lambda p: setattr(p.plugins, "score",
+                                PluginSet(disabled=[Plugin("*")])))
+    assert fw.points["score"] == []
+    # filters untouched
+    assert len(fw.points["filter"]) == 8
+
+
+def test_disable_single_filter_reflected_in_device_flags():
+    fw = mkfw(lambda p: setattr(p.plugins, "filter",
+                                PluginSet(disabled=[Plugin("TaintToleration")])))
+    flags = fw.enabled_filters()
+    assert flags[FILTER_PLUGINS.index("TaintToleration")] is False
+    assert sum(flags) == len(FILTER_PLUGINS) - 1
+    # score for the same plugin remains enabled
+    assert dict(fw.points["score"])["TaintToleration"] == 3
+
+
+def test_explicit_weight_overrides_multipoint():
+    fw = mkfw(lambda p: setattr(p.plugins, "score", PluginSet(
+        enabled=[Plugin("NodeAffinity", 10)])))
+    assert dict(fw.points["score"])["NodeAffinity"] == 10
+    w = fw.score_weights()
+    assert float(w.node_affinity) == 10.0
+    assert float(w.taint_toleration) == 3.0
+
+
+def test_scheduling_gates_pre_enqueue():
+    fw = mkfw()
+    gated = Pod(metadata=ObjectMeta(name="g"),
+                spec=PodSpec(scheduling_gates=[PodSchedulingGate("corp/hold")]))
+    s = fw.run_pre_enqueue_plugins(gated)
+    assert s.is_rejected() and s.plugin == "SchedulingGates"
+    assert fw.run_pre_enqueue_plugins(Pod()).is_success()
+
+
+def test_queue_sort_priority_then_fifo():
+    from types import SimpleNamespace
+
+    fw = mkfw()
+    hi = SimpleNamespace(pod=Pod(spec=PodSpec(priority=10)), timestamp=2.0)
+    lo = SimpleNamespace(pod=Pod(spec=PodSpec(priority=1)), timestamp=1.0)
+    assert fw.queue_sort_less(hi, lo)
+    early = SimpleNamespace(pod=Pod(), timestamp=1.0)
+    late = SimpleNamespace(pod=Pod(), timestamp=2.0)
+    assert fw.queue_sort_less(early, late)
+
+
+def test_events_to_register_union():
+    fw = mkfw()
+    ev = fw.events_to_register()
+    assert "NodeResourcesFit" in ev and "InterPodAffinity" in ev
+    assert "PrioritySort" not in ev  # no events registered
+
+
+def test_validation():
+    cfg = default_config()
+    assert validate_config(cfg, in_tree_registry()) == []
+    cfg.batch_size = 0
+    cfg.profiles[0].plugins.filter.enabled.append(Plugin("NoSuchPlugin"))
+    errs = validate_config(cfg, in_tree_registry())
+    assert any("batch_size" in e for e in errs)
+    assert any("NoSuchPlugin" in e for e in errs)
+
+
+def test_disabled_filter_device_semantics():
+    """Disabling TaintToleration on device: tainted node becomes feasible."""
+    from kubernetes_tpu.api.objects import (
+        Container, Node, NodeSpec, NodeStatus, ResourceRequirements, Taint)
+    from kubernetes_tpu.backend.cache import Cache
+    from kubernetes_tpu.backend.mirror import Mirror
+    from kubernetes_tpu.backend.snapshot import Snapshot
+    from kubernetes_tpu.models.pipeline import schedule_batch_jit
+    from kubernetes_tpu.ops.features import Capacities
+
+    caps = Capacities(nodes=16, pods=32)
+    cache = Cache()
+    cache.add_node(Node(
+        metadata=ObjectMeta(name="t"),
+        spec=NodeSpec(taints=[Taint(key="k", value="v", effect="NoSchedule")]),
+        status=NodeStatus(allocatable={"cpu": "4", "memory": "8Gi",
+                                       "pods": "110"})))
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    mirror = Mirror(caps=caps)
+    mirror.sync(snap)
+    pod = Pod(metadata=ObjectMeta(name="p"), spec=PodSpec(containers=[
+        Container(name="c", resources=ResourceRequirements(
+            requests={"cpu": "1", "memory": "1Gi"}))]))
+
+    fw_off = mkfw(lambda p: setattr(p.plugins, "filter",
+                                    PluginSet(disabled=[Plugin("TaintToleration")])))
+    cblobs, pblobs, topo, d_cap = mirror.prepare_launch([pod], 4)
+    out = schedule_batch_jit(cblobs, pblobs, mirror.well_known(),
+                             fw_off.score_weights(), caps, topo, d_cap,
+                             fw_off.enabled_filters())
+    assert int(out.node_row[0]) == 0, "tainted node allowed when disabled"
+
+    fw_on = mkfw()
+    out2 = schedule_batch_jit(cblobs, pblobs, mirror.well_known(),
+                              fw_on.score_weights(), caps, topo, d_cap,
+                              fw_on.enabled_filters())
+    assert int(out2.node_row[0]) == -1
