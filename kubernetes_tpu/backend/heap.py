@@ -1,0 +1,106 @@
+"""Generic heap with a map index, as used by both activeQ and backoffQ.
+
+Equivalent of /root/reference/pkg/scheduler/backend/heap/heap.go: a
+binary heap keyed by an arbitrary less(a, b) with O(1) membership lookup,
+update-in-place, and delete-by-key.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Generic, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class Heap(Generic[T]):
+    def __init__(self, key_fn: Callable[[T], str],
+                 less_fn: Callable[[T, T], bool]):
+        self._key = key_fn
+        self._less = less_fn
+        self._items: list[T] = []
+        self._index: dict[str, int] = {}
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._index
+
+    def get(self, key: str) -> Optional[T]:
+        i = self._index.get(key)
+        return self._items[i] if i is not None else None
+
+    def add(self, item: T) -> None:
+        """Insert or update (re-heapify around the item)."""
+        key = self._key(item)
+        i = self._index.get(key)
+        if i is not None:
+            self._items[i] = item
+            self._down(self._up(i))
+        else:
+            self._items.append(item)
+            self._index[key] = len(self._items) - 1
+            self._up(len(self._items) - 1)
+
+    def delete(self, key: str) -> Optional[T]:
+        i = self._index.get(key)
+        if i is None:
+            return None
+        return self._remove_at(i)
+
+    def peek(self) -> Optional[T]:
+        return self._items[0] if self._items else None
+
+    def pop(self) -> Optional[T]:
+        if not self._items:
+            return None
+        return self._remove_at(0)
+
+    def list(self) -> list[T]:
+        return list(self._items)
+
+    # ---- internals ----
+
+    def _remove_at(self, i: int) -> T:
+        item = self._items[i]
+        last = len(self._items) - 1
+        self._swap(i, last)
+        self._items.pop()
+        del self._index[self._key(item)]
+        if i < len(self._items):
+            self._down(self._up(i))
+        return item
+
+    def _swap(self, i: int, j: int) -> None:
+        if i == j:
+            return
+        it, jt = self._items[i], self._items[j]
+        self._items[i], self._items[j] = jt, it
+        self._index[self._key(it)] = j
+        self._index[self._key(jt)] = i
+
+    def _up(self, i: int) -> int:
+        while i > 0:
+            parent = (i - 1) // 2
+            if self._less(self._items[i], self._items[parent]):
+                self._swap(i, parent)
+                i = parent
+            else:
+                break
+        return i
+
+    def _down(self, i: int) -> None:
+        n = len(self._items)
+        while True:
+            left, right = 2 * i + 1, 2 * i + 2
+            smallest = i
+            if left < n and self._less(self._items[left],
+                                       self._items[smallest]):
+                smallest = left
+            if right < n and self._less(self._items[right],
+                                        self._items[smallest]):
+                smallest = right
+            if smallest == i:
+                return
+            self._swap(i, smallest)
+            i = smallest

@@ -1,0 +1,335 @@
+"""The three-tier pending-pod queue with queueing hints.
+
+Equivalent of /root/reference/pkg/scheduler/backend/queue/
+scheduling_queue.go:147-198 (PriorityQueue), active_queue.go (in-flight
+pods + concurrent-event replay), backoff_queue.go (exponential per-pod
+backoff), and the event-driven requeue machinery
+(MoveAllToActiveOrBackoffQueue :1129, isPodWorthRequeuing :428).
+
+Tiers:
+- activeQ    — heap ordered by the profile's QueueSort (priority desc, FIFO)
+- backoffQ   — heap ordered by backoff expiry; error backoff is tracked
+               separately from unschedulable backoff (types.go:394-404)
+- unschedulablePods — map of pods waiting for a cluster event a QueueingHint
+               says could make them schedulable
+
+The TPU-build extension: ``pop_batch(n)`` drains up to n pods in one call —
+the batch axis of the device pipeline (SURVEY.md north star) — marking all
+of them in-flight with concurrent-event replay per pod.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from kubernetes_tpu.api.objects import Pod
+from kubernetes_tpu.backend.heap import Heap
+from kubernetes_tpu.framework.interface import (
+    ClusterEvent,
+    ClusterEventWithHint,
+    QueueingHint,
+    Status,
+)
+
+# reference defaults (scheduling_queue.go:63-80)
+DEFAULT_POD_INITIAL_BACKOFF = 1.0
+DEFAULT_POD_MAX_BACKOFF = 10.0
+DEFAULT_MAX_IN_UNSCHEDULABLE_DURATION = 5 * 60.0
+
+
+@dataclass
+class QueuedPodInfo:
+    """framework.QueuedPodInfo (types.go:377)."""
+
+    pod: Pod
+    timestamp: float = 0.0                 # last queue entry
+    initial_attempt_timestamp: Optional[float] = None
+    attempts: int = 0
+    unschedulable_count: int = 0
+    consecutive_errors_count: int = 0
+    unschedulable_plugins: set[str] = field(default_factory=set)
+    pending_plugins: set[str] = field(default_factory=set)
+    gated_plugin: str = ""
+
+    @property
+    def uid(self) -> str:
+        return self.pod.metadata.uid
+
+    def deep_copy(self) -> "QueuedPodInfo":
+        return QueuedPodInfo(
+            pod=self.pod, timestamp=self.timestamp,
+            initial_attempt_timestamp=self.initial_attempt_timestamp,
+            attempts=self.attempts,
+            unschedulable_count=self.unschedulable_count,
+            consecutive_errors_count=self.consecutive_errors_count,
+            unschedulable_plugins=set(self.unschedulable_plugins),
+            pending_plugins=set(self.pending_plugins),
+            gated_plugin=self.gated_plugin)
+
+
+class PriorityQueue:
+    def __init__(self,
+                 less_fn: Callable[[QueuedPodInfo, QueuedPodInfo], bool],
+                 pre_enqueue: Optional[Callable[[Pod], Status]] = None,
+                 queueing_hints: Optional[
+                     dict[str, list[ClusterEventWithHint]]] = None,
+                 initial_backoff: float = DEFAULT_POD_INITIAL_BACKOFF,
+                 max_backoff: float = DEFAULT_POD_MAX_BACKOFF,
+                 max_in_unschedulable: float =
+                 DEFAULT_MAX_IN_UNSCHEDULABLE_DURATION,
+                 now: Callable[[], float] = time.time):
+        self._now = now
+        self._less = less_fn
+        self._pre_enqueue = pre_enqueue or (lambda pod: Status())
+        # plugin name -> registered events+hints (buildQueueingHintMap)
+        self._hints = queueing_hints or {}
+        self._initial_backoff = initial_backoff
+        self._max_backoff = max_backoff
+        self._max_in_unschedulable = max_in_unschedulable
+
+        self._active: Heap[QueuedPodInfo] = Heap(
+            lambda qp: qp.uid, less_fn)
+        self._backoff: Heap[QueuedPodInfo] = Heap(
+            lambda qp: qp.uid,
+            lambda a, b: self._backoff_expiry(a) < self._backoff_expiry(b))
+        self._unschedulable: dict[str, QueuedPodInfo] = {}
+        # in-flight machinery (active_queue.go:147-169): events observed
+        # while a pod is being scheduled are replayed when it comes back
+        self._in_flight: dict[str, list[ClusterEvent]] = {}
+        self._event_seq = itertools.count()
+        self._moved_cycle = 0
+
+    # ------------- backoff (backoff_queue.go:248) -------------
+
+    def _backoff_duration(self, qp: QueuedPodInfo) -> float:
+        """initial * 2^(count-1), capped; error backoff counts separately to
+        protect the apiserver (types.go:394-404)."""
+        count = max(qp.consecutive_errors_count, qp.unschedulable_count)
+        if count == 0:
+            return 0.0
+        duration = self._initial_backoff * (2 ** (count - 1))
+        return min(duration, self._max_backoff)
+
+    def _backoff_expiry(self, qp: QueuedPodInfo) -> float:
+        return qp.timestamp + self._backoff_duration(qp)
+
+    def backoff_remaining(self, qp: QueuedPodInfo) -> float:
+        return max(0.0, self._backoff_expiry(qp) - self._now())
+
+    # ------------- add paths -------------
+
+    def add(self, pod: Pod) -> None:
+        """New pending pod from the informer (scheduling_queue.go Add)."""
+        qp = QueuedPodInfo(pod=pod, timestamp=self._now(),
+                           initial_attempt_timestamp=None)
+        self._enqueue(qp)
+
+    def _enqueue(self, qp: QueuedPodInfo) -> None:
+        """Run PreEnqueue gates; activeQ on success, unschedulable if gated
+        (scheduling_queue.go:538 runPreEnqueuePlugins)."""
+        s = self._pre_enqueue(qp.pod)
+        if s.is_success():
+            qp.gated_plugin = ""
+            self._active.add(qp)
+            self._unschedulable.pop(qp.uid, None)
+            self._backoff.delete(qp.uid)
+        else:
+            qp.gated_plugin = s.plugin
+            qp.unschedulable_plugins.add(s.plugin)
+            self._unschedulable[qp.uid] = qp
+
+    def update(self, old: Pod, new: Pod) -> None:
+        uid = new.metadata.uid
+        for heap in (self._active, self._backoff):
+            qp = heap.get(uid)
+            if qp is not None:
+                qp.pod = new
+                heap.add(qp)
+                return
+        qp = self._unschedulable.get(uid)
+        if qp is not None:
+            qp.pod = new
+            if qp.gated_plugin:
+                # gates may have been lifted by this update
+                qp.timestamp = self._now()
+                self._unschedulable.pop(uid)
+                self._enqueue(qp)
+            return
+        if uid not in self._in_flight:
+            self.add(new)
+
+    def delete(self, pod: Pod) -> None:
+        uid = pod.metadata.uid
+        self._active.delete(uid)
+        self._backoff.delete(uid)
+        self._unschedulable.pop(uid, None)
+
+    # ------------- pop / in-flight -------------
+
+    def pop(self) -> Optional[QueuedPodInfo]:
+        qp = self._active.pop()
+        if qp is None:
+            return None
+        qp.attempts += 1
+        if qp.initial_attempt_timestamp is None:
+            qp.initial_attempt_timestamp = self._now()
+        self._in_flight[qp.uid] = []
+        return qp
+
+    def pop_batch(self, n: int) -> list[QueuedPodInfo]:
+        """Drain up to n pods for one device launch (the batch axis)."""
+        out = []
+        for _ in range(n):
+            qp = self.pop()
+            if qp is None:
+                break
+            out.append(qp)
+        return out
+
+    def done(self, uid: str) -> None:
+        """Scheduling (+binding) finished; release in-flight events
+        (schedule_one.go:305 via active_queue.go done)."""
+        self._in_flight.pop(uid, None)
+
+    def in_flight_count(self) -> int:
+        return len(self._in_flight)
+
+    # ------------- unschedulable / requeue -------------
+
+    def add_unschedulable_if_not_present(self, qp: QueuedPodInfo,
+                                         pod_scheduling_cycle: int = 0
+                                         ) -> None:
+        """Back from a failed cycle (scheduling_queue.go:824): replay events
+        that arrived while in flight; if any hints QUEUE, skip the
+        unschedulable pool and go straight to backoff/active."""
+        uid = qp.uid
+        concurrent = self._in_flight.pop(uid, [])
+        qp.timestamp = self._now()
+        if uid in self._active or uid in self._backoff \
+                or uid in self._unschedulable:
+            return
+        for event in concurrent:
+            if self._worth_requeuing(qp, event, None, None):
+                self._requeue(qp)
+                return
+        self._unschedulable[uid] = qp
+
+    def activate(self, pods: list[Pod]) -> None:
+        """Plugin-requested activation (scheduling_queue.go:684)."""
+        for pod in pods:
+            qp = self._unschedulable.pop(pod.metadata.uid, None)
+            if qp is None:
+                qp = self._backoff.delete(pod.metadata.uid)
+            if qp is not None:
+                qp.timestamp = self._now()
+                self._enqueue(qp)
+
+    def _worth_requeuing(self, qp: QueuedPodInfo, event: ClusterEvent,
+                         old_obj, new_obj) -> bool:
+        """isPodWorthRequeuing (scheduling_queue.go:428): consult the hint
+        fns registered by the plugins that rejected this pod."""
+        if not qp.unschedulable_plugins:
+            return True  # rejected with no attribution: requeue on anything
+        for plugin in qp.unschedulable_plugins:
+            for reg in self._hints.get(plugin, []):
+                if not reg.event.match(event):
+                    continue
+                if reg.queueing_hint_fn is None:
+                    return True
+                if reg.queueing_hint_fn(qp.pod, old_obj,
+                                        new_obj) == QueueingHint.QUEUE:
+                    return True
+        return False
+
+    def _requeue(self, qp: QueuedPodInfo) -> None:
+        """To activeQ if backoff is over, else backoffQ
+        (scheduling_queue.go:1139-1210 movePodsToActiveOrBackoffQueue)."""
+        if qp.gated_plugin:
+            self._unschedulable[qp.uid] = qp
+            return
+        if self._backoff_expiry(qp) <= self._now():
+            self._enqueue(qp)
+        else:
+            s = self._pre_enqueue(qp.pod)
+            if s.is_success():
+                self._backoff.add(qp)
+            else:
+                qp.gated_plugin = s.plugin
+                self._unschedulable[qp.uid] = qp
+
+    def move_all_to_active_or_backoff(self, event: ClusterEvent,
+                                      old_obj=None, new_obj=None) -> int:
+        """A cluster event arrived (MoveAllToActiveOrBackoffQueue :1129).
+        Also records the event for every in-flight pod so it can be
+        replayed when that pod's cycle fails."""
+        for events in self._in_flight.values():
+            events.append(event)
+        self._moved_cycle += 1
+        moved = 0
+        for uid in list(self._unschedulable):
+            qp = self._unschedulable[uid]
+            if qp.gated_plugin:
+                # gated pods re-run PreEnqueue instead of hints
+                s = self._pre_enqueue(qp.pod)
+                if s.is_success():
+                    del self._unschedulable[uid]
+                    qp.gated_plugin = ""
+                    qp.timestamp = self._now()
+                    self._enqueue(qp)
+                    moved += 1
+                continue
+            if self._worth_requeuing(qp, event, old_obj, new_obj):
+                del self._unschedulable[uid]
+                self._requeue(qp)
+                moved += 1
+        return moved
+
+    # ------------- periodic flushes (scheduling_queue.go:378-386) -------------
+
+    def flush_backoff_completed(self) -> int:
+        """backoffQ -> activeQ for pods whose backoff expired (1s tick)."""
+        moved = 0
+        now = self._now()
+        while True:
+            head = self._backoff.peek()
+            if head is None or self._backoff_expiry(head) > now:
+                break
+            self._backoff.pop()
+            self._enqueue(head)
+            moved += 1
+        return moved
+
+    def flush_unschedulable_timeout(self) -> int:
+        """unschedulable pods stuck longer than the timeout requeue
+        unconditionally (30s tick; 5min default timeout)."""
+        now = self._now()
+        moved = 0
+        for uid in list(self._unschedulable):
+            qp = self._unschedulable[uid]
+            if qp.gated_plugin:
+                continue
+            if now - qp.timestamp >= self._max_in_unschedulable:
+                del self._unschedulable[uid]
+                self._requeue(qp)
+                moved += 1
+        return moved
+
+    # ------------- introspection -------------
+
+    def pending_counts(self) -> dict[str, int]:
+        """pending_pods gauge split by queue (metrics.go:201)."""
+        gated = sum(1 for qp in self._unschedulable.values()
+                    if qp.gated_plugin)
+        return {
+            "active": len(self._active),
+            "backoff": len(self._backoff),
+            "unschedulable": len(self._unschedulable) - gated,
+            "gated": gated,
+        }
+
+    def __len__(self) -> int:
+        return (len(self._active) + len(self._backoff)
+                + len(self._unschedulable))

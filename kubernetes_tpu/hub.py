@@ -1,0 +1,182 @@
+"""In-process API hub: the storage/watch/bind surface the scheduler talks to.
+
+The functional stand-in for the reference's apiserver+etcd+client-go stack
+(SURVEY.md §5.8): typed object store with resourceVersion bumps, LIST +
+WATCH-style event delivery to registered handlers (the informer contract,
+client-go tools/cache), the Binding subresource
+(pkg/registry/core/pod/rest/subresources.go semantics: set spec.nodeName),
+and pod status patches. Real-cluster integration would implement this same
+interface over HTTPS list/watch; tests and benchmarks run against this hub
+exactly like the reference's integration tests run against an in-process
+apiserver (test/integration/util/util.go:86 StartScheduler).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from kubernetes_tpu.api.objects import Node, Pod, PodCondition, PriorityClass
+
+
+@dataclass
+class EventHandlers:
+    """cache.ResourceEventHandler equivalent."""
+
+    on_add: Optional[Callable] = None
+    on_update: Optional[Callable] = None       # (old, new)
+    on_delete: Optional[Callable] = None
+
+
+class Conflict(Exception):
+    """resourceVersion conflict (optimistic concurrency)."""
+
+
+class NotFound(Exception):
+    pass
+
+
+class _Store:
+    def __init__(self, kind: str):
+        self.kind = kind
+        self.objects: dict[str, object] = {}   # uid -> object
+        self.handlers: list[EventHandlers] = []
+
+
+class Hub:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._rv = itertools.count(1)
+        self._nodes = _Store("Node")
+        self._pods = _Store("Pod")
+        self._priority_classes = _Store("PriorityClass")
+
+    # ------------- watch registration -------------
+
+    def watch_nodes(self, h: EventHandlers, replay: bool = True) -> None:
+        with self._lock:
+            self._nodes.handlers.append(h)
+            if replay and h.on_add:
+                for o in list(self._nodes.objects.values()):
+                    h.on_add(o)
+
+    def watch_pods(self, h: EventHandlers, replay: bool = True) -> None:
+        with self._lock:
+            self._pods.handlers.append(h)
+            if replay and h.on_add:
+                for o in list(self._pods.objects.values()):
+                    h.on_add(o)
+
+    @staticmethod
+    def _dispatch(store: _Store, kind: str, old, new) -> None:
+        for h in store.handlers:
+            if kind == "add" and h.on_add:
+                h.on_add(new)
+            elif kind == "update" and h.on_update:
+                h.on_update(old, new)
+            elif kind == "delete" and h.on_delete:
+                h.on_delete(old)
+
+    # ------------- generic CRUD -------------
+
+    def _create(self, store: _Store, obj) -> None:
+        with self._lock:
+            uid = obj.metadata.uid
+            if uid in store.objects:
+                raise Conflict(f"{store.kind} {uid} already exists")
+            obj.metadata.resource_version = next(self._rv)
+            store.objects[uid] = obj
+            self._dispatch(store, "add", None, obj)
+
+    def _update(self, store: _Store, obj) -> None:
+        with self._lock:
+            uid = obj.metadata.uid
+            old = store.objects.get(uid)
+            if old is None:
+                raise NotFound(f"{store.kind} {uid}")
+            obj.metadata.resource_version = next(self._rv)
+            store.objects[uid] = obj
+            self._dispatch(store, "update", old, obj)
+
+    def _delete(self, store: _Store, uid: str) -> None:
+        with self._lock:
+            old = store.objects.pop(uid, None)
+            if old is None:
+                raise NotFound(f"{store.kind} {uid}")
+            self._dispatch(store, "delete", old, None)
+
+    # ------------- nodes -------------
+
+    def create_node(self, node: Node) -> None:
+        self._create(self._nodes, node)
+
+    def update_node(self, node: Node) -> None:
+        self._update(self._nodes, node)
+
+    def delete_node(self, uid: str) -> None:
+        self._delete(self._nodes, uid)
+
+    def list_nodes(self) -> list[Node]:
+        with self._lock:
+            return list(self._nodes.objects.values())
+
+    # ------------- pods -------------
+
+    def create_pod(self, pod: Pod) -> None:
+        self._create(self._pods, pod)
+
+    def update_pod(self, pod: Pod) -> None:
+        self._update(self._pods, pod)
+
+    def delete_pod(self, uid: str) -> None:
+        self._delete(self._pods, uid)
+
+    def get_pod(self, uid: str) -> Optional[Pod]:
+        with self._lock:
+            return self._pods.objects.get(uid)
+
+    def list_pods(self) -> list[Pod]:
+        with self._lock:
+            return list(self._pods.objects.values())
+
+    # ------------- the scheduler's write paths -------------
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        """The Binding subresource: sets spec.nodeName exactly once
+        (defaultbinder POST target). Conflict if already bound."""
+        with self._lock:
+            stored = self._pods.objects.get(pod.metadata.uid)
+            if stored is None:
+                raise NotFound(f"pod {pod.key()}")
+            if stored.spec.node_name:
+                raise Conflict(f"pod {pod.key()} already bound to "
+                               f"{stored.spec.node_name}")
+            new = stored.clone()
+            new.spec.node_name = node_name
+            self._update(self._pods, new)
+
+    def patch_pod_condition(self, pod: Pod, condition: PodCondition,
+                            nominated_node: str | None = None) -> None:
+        """util.PatchPodStatus equivalent (schedule_one.go:1092)."""
+        with self._lock:
+            stored = self._pods.objects.get(pod.metadata.uid)
+            if stored is None:
+                return
+            new = stored.clone()
+            new.status.conditions = [
+                c for c in new.status.conditions if c.type != condition.type
+            ] + [condition]
+            if nominated_node is not None:
+                new.status.nominated_node_name = nominated_node
+            self._update(self._pods, new)
+
+    # ------------- priority classes -------------
+
+    def create_priority_class(self, pc: PriorityClass) -> None:
+        self._create(self._priority_classes, pc)
+
+    def list_priority_classes(self) -> list[PriorityClass]:
+        with self._lock:
+            return list(self._priority_classes.objects.values())

@@ -1,0 +1,321 @@
+"""The Scheduler: event handlers + the batched scheduling loop.
+
+Equivalent of /root/reference/pkg/scheduler/scheduler.go (Scheduler struct,
+New, Run) + eventhandlers.go:366 (addAllEventHandlers) + the hot path of
+schedule_one.go — with the per-pod serial cycle replaced by the batched
+device pipeline: pop a BATCH from the activeQ, refresh the incremental HBM
+mirror, run ONE fused filter+score+select launch for the whole batch
+(as-if-serial commit scan on device), then assume/reserve/permit/bind each
+winner on host and requeue the losers with plugin-attributed diagnoses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+from kubernetes_tpu.api.objects import (
+    Node,
+    Pod,
+    PodCondition,
+)
+from kubernetes_tpu.backend.cache import Cache
+from kubernetes_tpu.backend.mirror import (
+    CapacityError,
+    Mirror,
+    UnsupportedFeatureError,
+)
+from kubernetes_tpu.backend.queue import PriorityQueue, QueuedPodInfo
+from kubernetes_tpu.backend.snapshot import Snapshot
+from kubernetes_tpu.config.types import (
+    SchedulerConfiguration,
+    default_config,
+)
+from kubernetes_tpu.framework.cycle_state import CycleState
+from kubernetes_tpu.framework.interface import (
+    ActionType,
+    ClusterEvent,
+    EventResource,
+)
+from kubernetes_tpu.framework.runtime import Framework
+from kubernetes_tpu.hub import EventHandlers, Hub
+from kubernetes_tpu.models.pipeline import (
+    FILTER_PLUGINS,
+    BatchResult,
+    schedule_batch_jit,
+)
+from kubernetes_tpu.ops.features import Capacities
+
+A = ActionType
+R = EventResource
+
+
+def _node_update_action(old: Node, new: Node) -> ActionType:
+    """Which parts of the node changed (eventhandlers.go nodeSchedulingPropertiesChange)."""
+    action = ActionType(0)
+    if old.metadata.labels != new.metadata.labels:
+        action |= A.UPDATE_NODE_LABEL
+    if old.spec.taints != new.spec.taints \
+            or old.spec.unschedulable != new.spec.unschedulable:
+        action |= A.UPDATE_NODE_TAINT
+    if old.status.allocatable != new.status.allocatable:
+        action |= A.UPDATE_NODE_ALLOCATABLE
+    return action or A.UPDATE_NODE_CONDITION
+
+
+class Scheduler:
+    def __init__(self, hub: Hub,
+                 config: Optional[SchedulerConfiguration] = None,
+                 caps: Optional[Capacities] = None,
+                 now=time.time):
+        self.hub = hub
+        self.config = config or default_config()
+        self.now = now
+        profile = self.config.profiles[0]
+        self.framework = Framework(profile,
+                                   extra_args={"binder": hub.bind})
+        self.cache = Cache(now=now)
+        self.snapshot = Snapshot()
+        self.caps = caps or Capacities(
+            nodes=self.config.node_capacity,
+            pods=self.config.pod_table_capacity)
+        self.mirror = Mirror(caps=self.caps)
+        self.queue = PriorityQueue(
+            less_fn=self.framework.queue_sort_less,
+            pre_enqueue=self.framework.run_pre_enqueue_plugins,
+            queueing_hints=self.framework.events_to_register(),
+            initial_backoff=self.config.pod_initial_backoff_seconds,
+            max_backoff=self.config.pod_max_backoff_seconds,
+            now=now)
+        self._enabled_filters = self.framework.enabled_filters()
+        self._weights = self.framework.score_weights()
+        self.stats = {"scheduled": 0, "unschedulable": 0, "errors": 0,
+                      "batches": 0, "attempts": 0}
+        self._register_handlers()
+
+    # ------------- event handlers (eventhandlers.go:366) -------------
+
+    def _register_handlers(self) -> None:
+        self.hub.watch_nodes(EventHandlers(
+            on_add=self._on_node_add,
+            on_update=self._on_node_update,
+            on_delete=self._on_node_delete))
+        self.hub.watch_pods(EventHandlers(
+            on_add=self._on_pod_add,
+            on_update=self._on_pod_update,
+            on_delete=self._on_pod_delete))
+
+    def _on_node_add(self, node: Node) -> None:
+        self.cache.add_node(node)
+        self.queue.move_all_to_active_or_backoff(
+            ClusterEvent(R.NODE, A.ADD), None, node)
+
+    def _on_node_update(self, old: Node, new: Node) -> None:
+        self.cache.update_node(old, new)
+        self.queue.move_all_to_active_or_backoff(
+            ClusterEvent(R.NODE, _node_update_action(old, new)), old, new)
+
+    def _on_node_delete(self, node: Node) -> None:
+        self.cache.remove_node(node)
+        self.queue.move_all_to_active_or_backoff(
+            ClusterEvent(R.NODE, A.DELETE), node, None)
+
+    @staticmethod
+    def _terminal(pod: Pod) -> bool:
+        return pod.status.phase in ("Succeeded", "Failed")
+
+    def _on_pod_add(self, pod: Pod) -> None:
+        if pod.spec.node_name:
+            self.cache.add_pod(pod)
+            self.queue.move_all_to_active_or_backoff(
+                ClusterEvent(R.ASSIGNED_POD, A.ADD), None, pod)
+        elif not self._terminal(pod):
+            self.queue.add(pod)
+
+    def _on_pod_update(self, old: Pod, new: Pod) -> None:
+        if new.spec.node_name:
+            if old.spec.node_name:
+                self.cache.update_pod(old, new)
+                action = (A.UPDATE_POD_LABEL
+                          if old.metadata.labels != new.metadata.labels
+                          else A.UPDATE_POD_SCALE_DOWN)
+                self.queue.move_all_to_active_or_backoff(
+                    ClusterEvent(R.ASSIGNED_POD, action), old, new)
+            else:
+                # freshly bound (possibly by us): informer truth confirms
+                self.cache.add_pod(new)
+                self.queue.delete(new)
+                self.queue.move_all_to_active_or_backoff(
+                    ClusterEvent(R.ASSIGNED_POD, A.ADD), old, new)
+        elif not self._terminal(new):
+            self.queue.update(old, new)
+
+    def _on_pod_delete(self, pod: Pod) -> None:
+        if pod.spec.node_name:
+            self.cache.remove_pod(pod)
+            self.queue.move_all_to_active_or_backoff(
+                ClusterEvent(R.ASSIGNED_POD, A.DELETE), pod, None)
+        else:
+            self.queue.delete(pod)
+
+    # ------------- capacity re-bucketing -------------
+
+    def _grow(self, err: CapacityError) -> None:
+        """Double the exceeded capacity and rebuild the mirror (the
+        re-bucketing strategy from the Mirror docstring; kernels recompile
+        once per bucket)."""
+        field = {"ext_resources": "ext_resources"}.get(err.field, err.field)
+        if not hasattr(self.caps, field):
+            raise err
+        cur = getattr(self.caps, field)
+        new = max(cur * 2, 8)
+        while new < err.needed:
+            new *= 2
+        self.caps = dataclasses.replace(self.caps, **{field: new})
+        self.mirror = Mirror(caps=self.caps)
+        self.snapshot = Snapshot()
+        self.cache.update_snapshot(self.snapshot)
+        self.mirror.sync(self.snapshot)
+
+    # ------------- the batched scheduling cycle -------------
+
+    def schedule_one_batch(self) -> int:
+        """Pop up to batch_size pods, run one device launch, commit results.
+        Returns the number of pods attempted (0 = queue idle)."""
+        batch = self.queue.pop_batch(self.config.batch_size)
+        if not batch:
+            return 0
+        # skipPodSchedule (schedule_one.go:380): deleted or already assumed
+        runnable: list[QueuedPodInfo] = []
+        for qp in batch:
+            stored = self.hub.get_pod(qp.uid)
+            if stored is None or stored.metadata.deletion_timestamp:
+                self.queue.done(qp.uid)
+                continue
+            if self.cache.is_assumed_pod(qp.pod):
+                self.queue.done(qp.uid)
+                continue
+            runnable.append(qp)
+        if not runnable:
+            return len(batch)
+        self.stats["batches"] += 1
+        self.stats["attempts"] += len(runnable)
+
+        self.cache.update_snapshot(self.snapshot)
+        for attempt in range(8):
+            try:
+                self.mirror.sync(self.snapshot)
+                cblobs, pblobs, topo, d_cap = self.mirror.prepare_launch(
+                    [qp.pod for qp in runnable], self.config.batch_size)
+                break
+            except CapacityError as e:
+                self._grow(e)
+            except UnsupportedFeatureError:
+                runnable = self._split_unsupported(runnable)
+                if not runnable:
+                    return len(batch)
+        else:
+            raise RuntimeError("mirror re-bucketing did not converge")
+
+        out: BatchResult = schedule_batch_jit(
+            cblobs, pblobs, self.mirror.well_known(), self._weights,
+            self.caps, topo, d_cap, self._enabled_filters)
+        rows = out.node_row[: len(runnable)].tolist()
+        rejects = out.reject_counts[: len(runnable)].tolist()
+        for qp, row, rej in zip(runnable, rows, rejects):
+            if row >= 0:
+                self._commit(qp, self.mirror.name_of_row(row))
+            else:
+                self._fail(qp, rej)
+        return len(batch)
+
+    def _split_unsupported(self, runnable):
+        """A pod uses a construct the device encoding can't express: route it
+        to the failure path, keep the rest."""
+        ok = []
+        for qp in runnable:
+            try:
+                self.mirror.pack_pod(qp.pod)
+                ok.append(qp)
+            except UnsupportedFeatureError as e:
+                self._error(qp, str(e))
+            except CapacityError:
+                ok.append(qp)  # handled by the caller's _grow loop
+        return ok
+
+    def _commit(self, qp: QueuedPodInfo, node_name: str) -> None:
+        """assume -> reserve -> permit -> bind (schedule_one.go:142,270)."""
+        pod = qp.pod
+        assumed = pod.clone()
+        assumed.spec.node_name = node_name
+        self.cache.assume_pod(assumed)
+        state = CycleState()
+        fw = self.framework
+
+        def undo(msg: str) -> None:
+            fw.run_unreserve_plugins(state, pod, node_name)
+            self.cache.forget_pod(assumed)
+            self._error(qp, msg)
+
+        s = fw.run_reserve_plugins(state, pod, node_name)
+        if not s.is_success():
+            undo(f"reserve: {s.message()}")
+            return
+        s = fw.run_permit_plugins(state, pod, node_name)
+        if not s.is_success():
+            undo(f"permit: {s.message()}")
+            return
+        s = fw.run_pre_bind_plugins(state, pod, node_name)
+        if not s.is_success():
+            undo(f"prebind: {s.message()}")
+            return
+        s = fw.run_bind_plugins(state, pod, node_name)
+        if not s.is_success():
+            undo(f"bind: {s.message()}")
+            return
+        self.cache.finish_binding(assumed)
+        self.queue.done(qp.uid)
+        fw.run_post_bind_plugins(state, pod, node_name)
+        qp.consecutive_errors_count = 0
+        self.stats["scheduled"] += 1
+
+    def _fail(self, qp: QueuedPodInfo, reject_counts: list[int]) -> None:
+        """handleSchedulingFailure (schedule_one.go:1015): record the
+        rejecting plugins for queueing hints, patch the PodScheduled
+        condition, park in unschedulable."""
+        plugins = {FILTER_PLUGINS[i] for i, c in enumerate(reject_counts)
+                   if c > 0}
+        qp.unschedulable_plugins = plugins or {"NodeResourcesFit"}
+        qp.unschedulable_count += 1
+        qp.consecutive_errors_count = 0
+        self.stats["unschedulable"] += 1
+        self.hub.patch_pod_condition(qp.pod, PodCondition(
+            type="PodScheduled", status="False", reason="Unschedulable",
+            message=f"rejected by {sorted(plugins)}"))
+        self.queue.add_unschedulable_if_not_present(qp)
+
+    def _error(self, qp: QueuedPodInfo, msg: str) -> None:
+        """Error-class failure: separate backoff counter
+        (types.go:394-404) so apiserver-error storms back off."""
+        qp.consecutive_errors_count += 1
+        qp.unschedulable_plugins = set()
+        self.stats["errors"] += 1
+        self.hub.patch_pod_condition(qp.pod, PodCondition(
+            type="PodScheduled", status="False", reason="SchedulerError",
+            message=msg))
+        self.queue.add_unschedulable_if_not_present(qp)
+
+    # ------------- driving -------------
+
+    def run_until_idle(self, max_batches: int = 1000) -> int:
+        """Drain the activeQ (tests/bench); returns pods attempted."""
+        total = 0
+        for _ in range(max_batches):
+            n = self.schedule_one_batch()
+            if n == 0:
+                self.queue.flush_backoff_completed()
+                if self.queue.pending_counts()["active"] == 0:
+                    break
+            total += n
+        return total

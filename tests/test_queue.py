@@ -1,0 +1,173 @@
+"""PriorityQueue semantics vs the reference's queue tests
+(backend/queue/scheduling_queue_test.go): tier transitions, backoff math,
+queueing hints, in-flight event replay, gates, flush timers. Virtual clock
+throughout (the reference uses testingclock the same way)."""
+
+from kubernetes_tpu.api.objects import (
+    ObjectMeta,
+    Pod,
+    PodSchedulingGate,
+    PodSpec,
+)
+from kubernetes_tpu.backend.queue import PriorityQueue, QueuedPodInfo
+from kubernetes_tpu.framework.interface import (
+    ActionType,
+    ClusterEvent,
+    ClusterEventWithHint,
+    EventResource,
+    QueueingHint,
+    Status,
+)
+
+
+class Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def now(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+def less(a, b):
+    if a.pod.priority() != b.pod.priority():
+        return a.pod.priority() > b.pod.priority()
+    return a.timestamp < b.timestamp
+
+
+def mkpod(name, priority=0, gates=()):
+    return Pod(metadata=ObjectMeta(name=name),
+               spec=PodSpec(priority=priority,
+                            scheduling_gates=[PodSchedulingGate(g)
+                                              for g in gates]))
+
+
+NODE_ADD = ClusterEvent(EventResource.NODE, ActionType.ADD)
+POD_DELETE = ClusterEvent(EventResource.ASSIGNED_POD, ActionType.DELETE)
+
+
+def gate_fn(pod):
+    if pod.spec.scheduling_gates:
+        return Status.unschedulable("gated", plugin="SchedulingGates",
+                                    resolvable=False)
+    return Status()
+
+
+def mkq(clock=None, hints=None):
+    clock = clock or Clock()
+    q = PriorityQueue(less_fn=less, pre_enqueue=gate_fn,
+                      queueing_hints=hints or {}, now=clock.now)
+    return q, clock
+
+
+def test_priority_then_fifo_order():
+    q, _ = mkq()
+    q.add(mkpod("low", 1))
+    q.add(mkpod("high", 10))
+    q.add(mkpod("mid", 5))
+    assert [q.pop().pod.name for _ in range(3)] == ["high", "mid", "low"]
+
+
+def test_unschedulable_then_event_requeues_with_backoff():
+    hints = {"NodeResourcesFit": [ClusterEventWithHint(NODE_ADD)]}
+    q, clock = mkq(hints=hints)
+    q.add(mkpod("p"))
+    qp = q.pop()
+    qp.unschedulable_count += 1
+    qp.unschedulable_plugins = {"NodeResourcesFit"}
+    q.add_unschedulable_if_not_present(qp)
+    assert q.pending_counts()["unschedulable"] == 1
+    # an unrelated event must not move it
+    q.move_all_to_active_or_backoff(POD_DELETE)
+    assert q.pending_counts()["unschedulable"] == 1
+    # the registered event moves it to backoff (1s not yet elapsed)
+    q.move_all_to_active_or_backoff(NODE_ADD)
+    assert q.pending_counts()["backoff"] == 1
+    # backoff expires -> flush to active
+    clock.tick(1.1)
+    assert q.flush_backoff_completed() == 1
+    assert q.pending_counts()["active"] == 1
+
+
+def test_backoff_is_exponential_and_capped():
+    q, clock = mkq()
+    qp = QueuedPodInfo(pod=mkpod("p"), timestamp=clock.now())
+    for attempts, want in ((1, 1.0), (2, 2.0), (3, 4.0), (5, 10.0),
+                           (10, 10.0)):
+        qp.unschedulable_count = attempts
+        assert q.backoff_remaining(qp) == want
+
+
+def test_queueing_hint_fn_skip_blocks_requeue():
+    def hint(pod, old, new):
+        return QueueingHint.SKIP
+
+    hints = {"NodeResourcesFit": [ClusterEventWithHint(NODE_ADD, hint)]}
+    q, _ = mkq(hints=hints)
+    q.add(mkpod("p"))
+    qp = q.pop()
+    qp.unschedulable_plugins = {"NodeResourcesFit"}
+    q.add_unschedulable_if_not_present(qp)
+    q.move_all_to_active_or_backoff(NODE_ADD)
+    assert q.pending_counts()["unschedulable"] == 1
+
+
+def test_in_flight_event_replay():
+    """An event arriving DURING a pod's failed cycle requeues it immediately
+    instead of parking it in unschedulable (active_queue.go:147-169)."""
+    hints = {"NodeResourcesFit": [ClusterEventWithHint(NODE_ADD)]}
+    q, clock = mkq(hints=hints)
+    q.add(mkpod("p"))
+    qp = q.pop()
+    q.move_all_to_active_or_backoff(NODE_ADD)  # concurrent with the cycle
+    qp.unschedulable_count += 1
+    qp.unschedulable_plugins = {"NodeResourcesFit"}
+    q.add_unschedulable_if_not_present(qp)
+    assert q.pending_counts()["unschedulable"] == 0
+    assert q.pending_counts()["backoff"] == 1
+
+
+def test_gated_pod_held_until_gates_removed():
+    q, _ = mkq()
+    q.add(mkpod("g", gates=("corp/hold",)))
+    assert q.pending_counts()["gated"] == 1
+    assert q.pop() is None
+    # gates removed (spec update): the next event re-runs PreEnqueue
+    for qp in list(q._unschedulable.values()):
+        qp.pod = Pod(metadata=qp.pod.metadata, spec=PodSpec())
+    q.move_all_to_active_or_backoff(NODE_ADD)
+    assert q.pending_counts()["gated"] == 0
+    assert q.pop().pod.name == "g"
+
+
+def test_unschedulable_timeout_flush():
+    q, clock = mkq()
+    q.add(mkpod("p"))
+    qp = q.pop()
+    qp.unschedulable_plugins = {"NodeResourcesFit"}
+    q.add_unschedulable_if_not_present(qp)
+    assert q.flush_unschedulable_timeout() == 0
+    clock.tick(301)
+    assert q.flush_unschedulable_timeout() == 1
+    assert q.pending_counts()["active"] == 1
+
+
+def test_pop_batch_drains_in_order():
+    q, _ = mkq()
+    for i in range(5):
+        q.add(mkpod(f"p{i}", priority=i))
+    batch = q.pop_batch(3)
+    assert [qp.pod.name for qp in batch] == ["p4", "p3", "p2"]
+    assert q.in_flight_count() == 3
+    for qp in batch:
+        q.done(qp.uid)
+    assert q.in_flight_count() == 0
+
+
+def test_error_backoff_separate_counter():
+    q, clock = mkq()
+    qp = QueuedPodInfo(pod=mkpod("p"), timestamp=clock.now())
+    qp.consecutive_errors_count = 3
+    assert q.backoff_remaining(qp) == 4.0
