@@ -70,7 +70,9 @@ def label_selector_matches(sel: Optional[LabelSelector], labels: dict[str, str])
             if present:
                 return False
         else:
-            raise ValueError(f"invalid label selector operator {req.operator}")
+            # unrecognized operator: selector-parse-error -> no-match, same
+            # as the device kernels' OP_UNKNOWN (ops/features.py op_id)
+            return False
     return True
 
 
@@ -98,7 +100,8 @@ def _node_selector_requirement_matches(
         if lhs is None or rhs is None:
             return False
         return lhs > rhs if req.operator == OP_GT else lhs < rhs
-    raise ValueError(f"invalid node selector operator {req.operator}")
+    # unrecognized operator: no-match (device parity via OP_UNKNOWN)
+    return False
 
 
 def _match_fields_matches(req: NodeSelectorRequirement, node_name: str) -> bool:

@@ -173,9 +173,10 @@ class Affinity:
 
 @dataclass
 class Taint:
+    # Field order matches v1.Taint (types.go): Key, Value, Effect.
     key: str
-    effect: str  # NoSchedule / PreferNoSchedule / NoExecute
     value: str = ""
+    effect: str = ""  # NoSchedule / PreferNoSchedule / NoExecute
 
 
 @dataclass

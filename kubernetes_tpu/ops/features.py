@@ -46,6 +46,7 @@ NUM_NATIVE_COLS = 4
 EFFECT_NO_SCHEDULE = 0
 EFFECT_PREFER_NO_SCHEDULE = 1
 EFFECT_NO_EXECUTE = 2
+EFFECT_UNKNOWN = 3  # unrecognized effect string: ignored by every kernel
 _EFFECTS = {"NoSchedule": EFFECT_NO_SCHEDULE,
             "PreferNoSchedule": EFFECT_PREFER_NO_SCHEDULE,
             "NoExecute": EFFECT_NO_EXECUTE}
@@ -57,6 +58,7 @@ OP_EXISTS = 2
 OP_DOES_NOT_EXIST = 3
 OP_GT = 4
 OP_LT = 5
+OP_UNKNOWN = 6  # unrecognized operator: requirement matches nothing
 _OPS = {"In": OP_IN, "NotIn": OP_NOT_IN, "Exists": OP_EXISTS,
         "DoesNotExist": OP_DOES_NOT_EXIST, "Gt": OP_GT, "Lt": OP_LT}
 
@@ -428,11 +430,18 @@ def unpack_pods(blobs: PodBlobs, caps: Capacities) -> PodFeatures:
 
 
 def effect_id(effect: str) -> int:
-    return _EFFECTS[effect]
+    """Unknown effect strings map to EFFECT_UNKNOWN: the taint filter only
+    acts on NoSchedule/NoExecute, so a malformed node object degrades to
+    "effect ignored" instead of killing the pack (the reference tolerates
+    arbitrary effect strings)."""
+    return _EFFECTS.get(effect, EFFECT_UNKNOWN)
 
 
 def op_id(op: str) -> int:
-    return _OPS[op]
+    """Unknown operators map to OP_UNKNOWN, which matches nothing in
+    _selector_match — the device analog of the reference's
+    selector-parse-error → no-match behavior."""
+    return _OPS.get(op, OP_UNKNOWN)
 
 
 # nodesel/PodFeatures helpers live in backend.mirror (the packer); this module

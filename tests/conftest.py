@@ -9,12 +9,22 @@ floor: the first full test run pays the compiles, subsequent runs hit disk.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# Hard-set (not setdefault): the harness environment pre-sets
+# JAX_PLATFORMS=axon, which would silently route the whole suite through the
+# tunneled single TPU chip — slow, and no 8-device mesh for sharding tests.
+# The axon plugin ignores the env var, so the config API below is the one
+# that actually sticks; the env var is set too for subprocesses.
+os.environ["JAX_PLATFORMS"] = "cpu"
+import re as _re
+
+_flags = os.environ.get("XLA_FLAGS", "")
+_m = _re.search(r"--xla_force_host_platform_device_count=(\d+)", _flags)
+if _m is None:
+    _flags += " --xla_force_host_platform_device_count=8"
+elif int(_m.group(1)) < 8:  # replace a pre-set smaller count
+    _flags = (_flags[:_m.start()]
+              + "--xla_force_host_platform_device_count=8" + _flags[_m.end():])
+os.environ["XLA_FLAGS"] = _flags.strip()
 
 # persistent compile cache: the JAX_* env vars are not honored by this JAX
 # build (verified: cache stays "disabled/not initialized"), so use the config
@@ -26,3 +36,7 @@ sys.path.insert(0, _repo)
 from kubernetes_tpu.utils.jaxsetup import setup as _jax_setup  # noqa: E402
 
 _jax_setup(os.path.join(_repo, ".jax_cache"))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -166,20 +166,23 @@ def test_unschedulable_parity():
 
 TAINT_CASES = [
     ([], [], True),
-    ([Taint("gpu", "NoSchedule", "true")], [], False),
-    ([Taint("gpu", "NoSchedule", "true")],
+    ([Taint("gpu", "true", "NoSchedule")], [], False),
+    ([Taint("gpu", "true", "NoSchedule")],
      [Toleration(key="gpu", operator="Equal", value="true", effect="NoSchedule")],
      True),
-    ([Taint("gpu", "NoSchedule", "true")],
+    ([Taint("gpu", "true", "NoSchedule")],
      [Toleration(key="gpu", operator="Equal", value="false", effect="NoSchedule")],
      False),
-    ([Taint("gpu", "NoSchedule", "true")],
+    ([Taint("gpu", "true", "NoSchedule")],
      [Toleration(key="gpu", operator="Exists")], True),
-    ([Taint("gpu", "NoSchedule", "true")], [Toleration(operator="Exists")], True),
-    ([Taint("soft", "PreferNoSchedule")], [], True),  # soft taint passes filter
-    ([Taint("evict", "NoExecute", "x")], [], False),
-    ([Taint("a", "NoSchedule"), Taint("b", "NoSchedule")],
+    ([Taint("gpu", "true", "NoSchedule")], [Toleration(operator="Exists")], True),
+    ([Taint("soft", effect="PreferNoSchedule")], [], True),  # soft taint passes filter
+    ([Taint("evict", "x", "NoExecute")], [], False),
+    ([Taint("a", effect="NoSchedule"), Taint("b", effect="NoSchedule")],
      [Toleration(key="a", operator="Exists", effect="NoSchedule")], False),
+    # malformed object: unrecognized effect string must pack without error
+    # and be ignored by the filter (the reference tolerates arbitrary strings)
+    ([Taint("weird", "x", "SomeFutureEffect")], [], True),
 ]
 
 
@@ -312,7 +315,7 @@ def test_preferred_node_affinity_score():
 
 def test_taint_toleration_score():
     rig = Rig([mknode("clean"), mknode("soft", taints=[
-        Taint("a", "PreferNoSchedule"), Taint("b", "PreferNoSchedule")])])
+        Taint("a", effect="PreferNoSchedule"), Taint("b", effect="PreferNoSchedule")])])
     pf = unbatch(rig.pod_features(mkpod("p")))
     s = np.asarray(OS.taint_toleration_score(rig.ct, pf))
     by = {n: s[r] for n, r in zip(rig.names, rig.rows)}

@@ -1,0 +1,66 @@
+"""Multi-chip sharding parity: the batched scheduling step under an 8-device
+mesh with the node axis sharded must produce bit-identical placements to the
+unsharded run (SURVEY.md §5.8: node rows are the data-parallel axis; argmax
+and score normalizations become XLA collectives over the mesh).
+
+Runs on the virtual 8-device CPU platform forced by conftest.py — the same
+configuration the driver uses for `__graft_entry__.dryrun_multichip`.
+"""
+
+from functools import partial
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from kubernetes_tpu.models.pipeline import default_weights, schedule_batch
+from kubernetes_tpu.models.testbed import build_cluster, make_pod
+from kubernetes_tpu.ops.features import Capacities
+
+
+N_DEV = 8
+
+
+@pytest.fixture(scope="module")
+def example():
+    caps = Capacities(nodes=16 * N_DEV, pods=256)
+    _, _, mirror = build_cluster(12 * N_DEV, caps=caps)
+    cblobs, pblobs, _, _ = mirror.prepare_launch(
+        [make_pod(i) for i in range(8)], 8)
+    return caps, cblobs, pblobs, mirror.well_known(), default_weights()
+
+
+def test_devices_available():
+    assert len(jax.devices()) >= N_DEV
+
+
+def test_sharded_matches_unsharded(example):
+    caps, cblobs, pblobs, wk, weights = example
+    fn = partial(schedule_batch, caps=caps)
+
+    base = jax.jit(fn)(cblobs, pblobs, wk, weights)
+
+    import __graft_entry__ as g
+
+    mesh = Mesh(np.asarray(jax.devices()[:N_DEV]), ("nodes",))
+    in_sh = g.mesh_shardings(mesh, pblobs, wk, weights)
+    sharded = jax.jit(fn, in_shardings=in_sh)(cblobs, pblobs, wk, weights)
+    jax.block_until_ready(sharded)
+
+    np.testing.assert_array_equal(np.asarray(base.node_row),
+                                  np.asarray(sharded.node_row))
+    np.testing.assert_array_equal(np.asarray(base.feasible_count),
+                                  np.asarray(sharded.feasible_count))
+    np.testing.assert_array_equal(np.asarray(base.reject_counts),
+                                  np.asarray(sharded.reject_counts))
+    np.testing.assert_allclose(np.asarray(base.score),
+                               np.asarray(sharded.score), rtol=1e-5)
+    assert int((np.asarray(sharded.node_row) >= 0).sum()) == 8
+
+
+def test_graft_dryrun_entrypoint():
+    """The exact function the driver invokes must succeed in-process."""
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(N_DEV)
