@@ -46,34 +46,44 @@ def parse_strict_int(s: str) -> Optional[int]:
     return int(s)
 
 
+def requirements_match(exprs, labels: dict[str, str]) -> bool:
+    """Evaluate (key, operator, values) requirement tuples against a label
+    set — apimachinery labels.Requirement semantics (the host oracle twin of
+    the device kernel ops.topology.sel_match). exprs=None (nil selector)
+    matches nothing; empty list matches everything."""
+    if exprs is None:
+        return False
+    for key, op, values in exprs:
+        present = key in labels
+        val = labels.get(key)
+        if op == OP_IN:
+            ok = present and val in values
+        elif op == OP_NOT_IN:
+            ok = not present or val not in values
+        elif op == OP_EXISTS:
+            ok = present
+        elif op == OP_DOES_NOT_EXIST:
+            ok = not present
+        else:
+            ok = False  # unrecognized operator: no-match
+        if not ok:
+            return False
+    return True
+
+
+def selector_requirements(sel: LabelSelector):
+    """A LabelSelector as (key, operator, values) requirement tuples."""
+    return ([(k, OP_IN, [v]) for k, v in sel.match_labels.items()]
+            + [(e.key, e.operator, list(e.values))
+               for e in sel.match_expressions])
+
+
 def label_selector_matches(sel: Optional[LabelSelector], labels: dict[str, str]) -> bool:
     """metav1.LabelSelector semantics. A nil selector matches nothing; an empty
     selector matches everything (apimachinery LabelSelectorAsSelector)."""
     if sel is None:
         return False
-    for k, v in sel.match_labels.items():
-        if labels.get(k) != v:
-            return False
-    for req in sel.match_expressions:
-        val = labels.get(req.key)
-        present = req.key in labels
-        if req.operator == OP_IN:
-            if not present or val not in req.values:
-                return False
-        elif req.operator == OP_NOT_IN:
-            if present and val in req.values:
-                return False
-        elif req.operator == OP_EXISTS:
-            if not present:
-                return False
-        elif req.operator == OP_DOES_NOT_EXIST:
-            if present:
-                return False
-        else:
-            # unrecognized operator: selector-parse-error -> no-match, same
-            # as the device kernels' OP_UNKNOWN (ops/features.py op_id)
-            return False
-    return True
+    return requirements_match(selector_requirements(sel), labels)
 
 
 def _node_selector_requirement_matches(

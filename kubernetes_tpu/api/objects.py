@@ -363,6 +363,21 @@ class Node:
         )
 
 
+# --- namespace -----------------------------------------------------------------------
+
+
+@dataclass
+class Namespace:
+    """v1.Namespace (labels are what the scheduler consumes: affinity
+    namespaceSelector unrolling, interpodaffinity/plugin.go:123)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
 # --- priority class ------------------------------------------------------------------
 
 

@@ -55,6 +55,8 @@ class Cache:
         self._node_tree = NodeTree()
         self._pod_states: dict[str, _PodState] = {}  # uid -> state
         self._assumed_pods: set[str] = set()
+        self._namespaces: dict[str, dict[str, str]] = {}  # name -> labels
+        self._ns_generation = 0
 
     # ---------------- internal list maintenance ----------------
 
@@ -121,6 +123,21 @@ class Cache:
             else:
                 self._remove_from_list(item)
                 del self._nodes[node.metadata.name]
+
+    # ---------------- namespace ops ----------------
+
+    def set_namespace(self, name: str, labels: dict[str, str]) -> None:
+        """Add or update a namespace's labels (nsLister feed for affinity
+        namespaceSelector unrolling)."""
+        with self._lock:
+            if self._namespaces.get(name) != labels:
+                self._namespaces[name] = dict(labels)
+                self._ns_generation = next_generation()
+
+    def remove_namespace(self, name: str) -> None:
+        with self._lock:
+            if self._namespaces.pop(name, None) is not None:
+                self._ns_generation = next_generation()
 
     # ---------------- pod ops ----------------
 
@@ -264,6 +281,11 @@ class Cache:
             removed = [n for n in snapshot.node_info_map if n not in live]
             for n in removed:
                 del snapshot.node_info_map[n]
+
+            if snapshot.ns_generation != self._ns_generation:
+                snapshot.namespaces = {n: dict(l)
+                                       for n, l in self._namespaces.items()}
+                snapshot.ns_generation = self._ns_generation
 
             if removed or len(snapshot.node_info_list) != len(live) or updated_affinity:
                 self._rebuild_lists(snapshot)

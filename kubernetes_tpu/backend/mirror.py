@@ -21,6 +21,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from kubernetes_tpu.api.labels import (
+    label_selector_matches,
+    requirements_match,
+    selector_requirements,
+)
 from kubernetes_tpu.api.objects import (
     Affinity,
     Pod,
@@ -107,6 +112,17 @@ class Mirror:
         # bounds the domain scatter space a launch actually needs
         self._used_tks: set[int] = set()
         self._uids_with_terms: set[str] = set()  # table pods carrying terms
+        # namespace store (name -> labels) for unrolling namespaceSelectors;
+        # table pods whose terms carry a non-empty namespaceSelector repack
+        # when the namespace set changes (sync checks ns_generation)
+        self._namespaces: dict[str, dict[str, str]] = {}
+        self._ns_gen = 0
+        self._uids_with_nssel: set[str] = set()
+        # every namespace any packed pod lives in: selectors are evaluated
+        # over store ∪ pod namespaces (labels default {}), matching the
+        # reference's nil-nsLabels behavior for namespaces that have no
+        # Namespace object (AffinityTerm.Matches with empty labels.Set)
+        self._known_pod_ns: set[str] = set()
         self._pod_slot: dict[str, int] = {}      # pod uid -> pod-table slot
         self._node_pods: dict[str, dict[str, int]] = {}  # node -> uid -> slot
         # uid -> packed Pod object, held strongly so identity comparison is a
@@ -336,21 +352,26 @@ class Mirror:
 
     def _pack_term_group(self, pi_terms, weights, pod: Pod, prefix: str,
                          f: dict[str, np.ndarray]) -> None:
-        """One (anti)affinity term group -> tk/ns/sel_cols/sel_vals arrays
-        (+ weight for preferred groups)."""
+        """One (anti)affinity term group -> tk/ns/ns_all/sel_cols/sel_ops/
+        sel_vals arrays (+ weight for preferred groups)."""
         caps = self.caps
-        A, NS, MS = caps.aff_terms, caps.aff_ns, caps.aff_sel
+        A, NS, MS, V2 = (caps.aff_terms, caps.aff_ns, caps.aff_sel,
+                         caps.aff_sel_vals)
         tk = np.full((A,), NONE, np.int32)
         ns = np.full((A, NS), NONE, np.int32)
+        nall = np.zeros((A,), bool)
         sc = np.full((A, MS), NONE, np.int32)
-        sv = np.full((A, MS), NONE, np.int32)
+        so = np.full((A, MS), NONE, np.int32)
+        sv = np.full((A, MS, V2), NONE, np.int32)
         if len(pi_terms) > A:
             raise CapacityError("aff_terms", len(pi_terms))
         for t_idx, term in enumerate(pi_terms):
-            self._pack_aff_term(term, pod, tk, ns, sc, sv, t_idx)
+            self._pack_aff_term(term, pod, tk, ns, nall, sc, so, sv, t_idx)
         f[f"{prefix}_tk"] = tk
         f[f"{prefix}_ns"] = ns
+        f[f"{prefix}_ns_all"] = nall
         f[f"{prefix}_sel_cols"] = sc
+        f[f"{prefix}_sel_ops"] = so
         f[f"{prefix}_sel_vals"] = sv
         if weights is not None:
             w = np.zeros((A,), np.int32)
@@ -358,6 +379,7 @@ class Mirror:
             f[f"{prefix}_weight"] = w
 
     def _pack_pod_slot(self, uid: str, pi: PodInfo, row: int, node_name: str) -> None:
+        self._note_namespace(pi.pod.metadata.namespace)
         if not self._free_slots:
             raise CapacityError("pods", self.caps.pods + 1)
         slot = self._free_slots.pop()
@@ -385,66 +407,146 @@ class Mirror:
         self._node_pods[node_name][uid] = slot
         self._pod_obj[uid] = pod
         self._node_of_pod[uid] = node_name
-        if (pi.required_anti_affinity_terms or pi.required_affinity_terms
-                or pi.preferred_affinity_terms
-                or pi.preferred_anti_affinity_terms):
+        all_terms = (pi.required_anti_affinity_terms
+                     + pi.required_affinity_terms
+                     + [w.pod_affinity_term for w in pi.preferred_affinity_terms]
+                     + [w.pod_affinity_term
+                        for w in pi.preferred_anti_affinity_terms])
+        if all_terms:
             self._uids_with_terms.add(uid)
+        if any(t.namespace_selector is not None
+               and (t.namespace_selector.match_labels
+                    or t.namespace_selector.match_expressions)
+               for t in all_terms):
+            self._uids_with_nssel.add(uid)
 
-    def _fold_selector(self, sel, pod: Pod, match_label_keys) -> dict[str, str]:
-        """Fold a LabelSelector to exact (key, value) pairs: matchLabels plus
-        single-value In expressions; richer expressions raise (host-plugin
-        fallback). matchLabelKeys copy the pod's own values."""
-        pairs: dict[str, str] = {}
-        if sel is not None:
-            pairs.update(sel.match_labels)
-            for expr in sel.match_expressions:
-                if expr.operator == "In" and len(expr.values) == 1:
-                    pairs[expr.key] = expr.values[0]
-                else:
-                    raise UnsupportedFeatureError(
-                        f"affinity selector operator {expr.operator} with "
-                        f"{len(expr.values)} values needs the host fallback")
+    @staticmethod
+    def _effective_exprs(sel, owner_labels: dict[str, str],
+                         match_label_keys, mismatch_label_keys):
+        """A LabelSelector as (key, operator, values) requirement tuples,
+        with match/mismatchLabelKeys merged as In/NotIn requirements copying
+        the owner pod's values (strategy.go
+        applyMatchLabelKeysAndMismatchLabelKeys: keys absent from the owner's
+        labels are skipped; nil selector skips the merge and matches nothing).
+        Returns None for a nil selector."""
+        if sel is None:
+            return None
+        exprs = selector_requirements(sel)
         for k in match_label_keys:
-            if k in pod.metadata.labels:
-                pairs[k] = pod.metadata.labels[k]
-        return pairs
+            if k in owner_labels:
+                exprs.append((k, "In", [owner_labels[k]]))
+        for k in mismatch_label_keys:
+            if k in owner_labels:
+                exprs.append((k, "NotIn", [owner_labels[k]]))
+        return exprs
+
+    def _pack_exprs(self, exprs, sel_c: np.ndarray, sel_o: np.ndarray,
+                    sel_v: np.ndarray, t_idx: int) -> None:
+        """Requirement tuples -> op-coded expression rows at term t_idx.
+        exprs=None (nil selector, labels.Nothing()) packs a sentinel In
+        expression no real value can satisfy."""
+        caps = self.caps
+        if exprs is None:
+            sel_c[t_idx, 0] = 0
+            sel_o[t_idx, 0] = F.op_id("In")
+            sel_v[t_idx, 0, 0] = F.IMPOSSIBLE
+            return
+        if len(exprs) > caps.aff_sel:
+            raise CapacityError("aff_sel", len(exprs))
+        for i, (k, op, values) in enumerate(exprs):
+            sel_c[t_idx, i] = self.pod_label_col(k)
+            sel_o[t_idx, i] = F.op_id(op)
+            if len(values) > caps.aff_sel_vals:
+                raise CapacityError("aff_sel_vals", len(values))
+            for j, v in enumerate(values):
+                sel_v[t_idx, i, j] = self._i(v)
+
+    def _note_namespace(self, ns_name: str) -> None:
+        """Record a pod's namespace. A namespace first seen AFTER table pods
+        with namespaceSelector terms were packed invalidates their unrolled
+        lists (a DoesNotExist/NotIn selector can match the new namespace's
+        empty/absent labels) — repack them."""
+        if ns_name in self._known_pod_ns:
+            return
+        self._known_pod_ns.add(ns_name)
+        if self._uids_with_nssel:
+            self._repack_nssel_pods()
+
+    def _repack_nssel_pods(self) -> None:
+        for uid in list(self._uids_with_nssel):
+            node_name = self._node_of_pod.get(uid)
+            pod = self._pod_obj.get(uid)
+            row = self._row_of.get(node_name or "")
+            if node_name is None or pod is None or row is None:
+                continue
+            self._release_pod_slot(uid)
+            self._pack_pod_slot(uid, PodInfo(pod), row, node_name)
+
+    def _resolve_term_namespaces(self, term: PodAffinityTerm, owner: Pod
+                                 ) -> tuple[list[str], bool]:
+        """(explicit namespace list, all-namespaces flag) for a term.
+
+        The pack-time analog of the reference's
+        mergeAffinityTermNamespacesIfNotEmpty (interpodaffinity/plugin.go:123):
+        a non-empty namespaceSelector unrolls into explicit names over the
+        namespace store PLUS every namespace a packed pod lives in (labels
+        default to {} when no Namespace object exists — the reference's nil
+        nsLabels, so DoesNotExist/NotIn selectors match them). If the
+        selector matches every known namespace, the all-namespaces flag is
+        packed instead of the list — exact under the repack-on-new-namespace
+        rule (_note_namespace) and immune to aff_ns capacity blowup for
+        broad selectors. The EMPTY selector ({}) always matches everything;
+        nil selector + no explicit namespaces defaults to the owner's
+        namespace (getNamespacesFromPodAffinityTerm, types.go:749)."""
+        explicit = list(term.namespaces)
+        nssel = term.namespace_selector
+        if nssel is not None:
+            if not nssel.match_labels and not nssel.match_expressions:
+                return sorted(set(explicit)), True
+            universe = set(self._namespaces) | self._known_pod_ns
+            matched = [name for name in universe
+                       if label_selector_matches(
+                           nssel, self._namespaces.get(name, {}))]
+            if universe and len(matched) == len(universe):
+                return sorted(set(explicit)), True
+            explicit.extend(matched)
+        elif not explicit:
+            explicit = [owner.metadata.namespace]
+        return sorted(set(explicit)), False
 
     def _pack_aff_term(self, term: PodAffinityTerm, pod: Pod,
-                       tk: np.ndarray, ns: np.ndarray,
-                       sel_c: np.ndarray, sel_v: np.ndarray, t_idx: int) -> None:
+                       tk: np.ndarray, ns: np.ndarray, ns_all: np.ndarray,
+                       sel_c: np.ndarray, sel_o: np.ndarray,
+                       sel_v: np.ndarray, t_idx: int) -> None:
         """Shared (anti)affinity term encoding: topology key -> tk index,
-        selector -> (pod-label column, value id) pairs."""
+        namespaces resolved/unrolled, selector -> op-coded expressions."""
         caps = self.caps
         tk[t_idx] = self.topo_col(term.topology_key)
         self._used_tks.add(int(tk[t_idx]))
-        namespaces = term.namespaces or [pod.metadata.namespace]
+        namespaces, all_flag = self._resolve_term_namespaces(term, pod)
         if len(namespaces) > caps.aff_ns:
             raise CapacityError("aff_ns", len(namespaces))
         for i, n in enumerate(namespaces):
             ns[t_idx, i] = self._i(n)
-        pairs = self._fold_selector(term.label_selector, pod,
-                                    term.match_label_keys)
-        if len(pairs) > caps.aff_sel:
-            raise CapacityError("aff_sel", len(pairs))
-        if term.label_selector is None and not pairs:
-            # nil selector = labels.Nothing() in the reference: matches no pod
-            sel_v[t_idx, 0] = F.IMPOSSIBLE
-        for i, (k, v) in enumerate(pairs.items()):
-            sel_c[t_idx, i] = self.pod_label_col(k)
-            sel_v[t_idx, i] = self._i(v)
+        ns_all[t_idx] = all_flag
+        exprs = self._effective_exprs(term.label_selector, pod.metadata.labels,
+                                      term.match_label_keys,
+                                      term.mismatch_label_keys)
+        self._pack_exprs(exprs, sel_c, sel_o, sel_v, t_idx)
 
     def term_matches_pod(self, term: PodAffinityTerm, owner: Pod,
                          target: Pod) -> bool:
         """Host oracle: does `term` (owned by `owner`) select `target`?
-        (AffinityTerm.Matches, framework/types.go) under the folded-pair
-        selector semantics."""
-        namespaces = term.namespaces or [owner.metadata.namespace]
-        if target.metadata.namespace not in namespaces:
+        (AffinityTerm.Matches, framework/types.go:545) — full LabelSelector
+        + namespaceSelector + match/mismatchLabelKeys semantics."""
+        namespaces, ns_all = self._resolve_term_namespaces(term, owner)
+        if not ns_all and target.metadata.namespace not in namespaces:
             return False
-        pairs = self._fold_selector(term.label_selector, owner,
-                                    term.match_label_keys)
-        return all(target.metadata.labels.get(k) == v
-                   for k, v in pairs.items())
+        exprs = self._effective_exprs(term.label_selector,
+                                      owner.metadata.labels,
+                                      term.match_label_keys,
+                                      term.mismatch_label_keys)
+        return requirements_match(exprs, target.metadata.labels)
 
     def _release_pod_slot(self, uid: str) -> None:
         slot = self._pod_slot.pop(uid, None)
@@ -455,6 +557,7 @@ class Mirror:
         self._dirty_slots.add(slot)
         self._pod_obj.pop(uid, None)
         self._uids_with_terms.discard(uid)
+        self._uids_with_nssel.discard(uid)
         node = self._node_of_pod.pop(uid, None)
         if node is not None:
             self._node_pods.get(node, {}).pop(uid, None)
@@ -477,6 +580,14 @@ class Mirror:
     def sync(self, snapshot: Snapshot) -> int:
         """Incrementally repack rows for nodes whose generation advanced.
         Returns the number of rows repacked."""
+        # namespace set changed: refresh the store and repack every table pod
+        # whose terms carry a namespaceSelector (their unrolled ns lists are
+        # stale) — the incremental analog of the reference resolving
+        # namespaceSelectors freshly each cycle
+        if snapshot.ns_generation != self._ns_gen:
+            self._ns_gen = snapshot.ns_generation
+            self._namespaces = snapshot.namespaces
+            self._repack_nssel_pods()
         live = {info.name for info in snapshot.node_info_list}
         repacked = 0
         # removals first so a same-sync node swap can reuse the freed row
@@ -744,7 +855,9 @@ class Mirror:
         out["tsc_hard"] = np.zeros((C,), bool)
         out["tsc_min_domains"] = np.zeros((C,), np.int32)
         out["tsc_sel_cols"] = np.full((C, MS), NONE, np.int32)
-        out["tsc_sel_vals"] = np.full((C, MS), NONE, np.int32)
+        out["tsc_sel_ops"] = np.full((C, MS), NONE, np.int32)
+        out["tsc_sel_vals"] = np.full((C, MS, self.caps.aff_sel_vals), NONE,
+                                      np.int32)
         out["tsc_honor_affinity"] = np.ones((C,), bool)
         out["tsc_honor_taints"] = np.zeros((C,), bool)
         tscs = pod.spec.topology_spread_constraints
@@ -756,17 +869,14 @@ class Mirror:
             out["tsc_max_skew"][i] = t.max_skew
             out["tsc_hard"][i] = t.when_unsatisfiable == "DoNotSchedule"
             out["tsc_min_domains"][i] = t.min_domains or 0
-            pairs = self._fold_selector(t.label_selector, pod,
-                                        t.match_label_keys)
-            if len(pairs) > MS:
-                raise CapacityError("aff_sel", len(pairs))
-            if t.label_selector is None and not pairs:
-                # nil selector = labels.Nothing(): matches no pod, and
-                # selfMatchNum is 0 (filtering.go:311)
-                out["tsc_sel_vals"][i, 0] = F.IMPOSSIBLE
-            for j, (k, v) in enumerate(pairs.items()):
-                out["tsc_sel_cols"][i, j] = self.pod_label_col(k)
-                out["tsc_sel_vals"][i, j] = self._i(v)
+            # nil selector = labels.Nothing(): matches no pod, selfMatchNum 0
+            # (filtering.go:311); matchLabelKeys merge as In requirements
+            # (strategy.go applyMatchLabelKeys — spread has no mismatch keys)
+            exprs = self._effective_exprs(t.label_selector,
+                                          pod.metadata.labels,
+                                          t.match_label_keys, [])
+            self._pack_exprs(exprs, out["tsc_sel_cols"], out["tsc_sel_ops"],
+                             out["tsc_sel_vals"], i)
             out["tsc_honor_affinity"][i] = t.node_affinity_policy == "Honor"
             out["tsc_honor_taints"][i] = t.node_taints_policy == "Honor"
 
@@ -778,8 +888,10 @@ class Mirror:
         if len(pods) > batch_size:
             raise ValueError(f"{len(pods)} pods exceed batch_size {batch_size}")
         # prepass: register every batch pod's label keys so a term packed for
-        # pod i can reference a column pod j>i carries
+        # pod i can reference a column pod j>i carries, and note every batch
+        # namespace so term nsSelector unrolls see all of them
         for pod in pods:
+            self._note_namespace(pod.metadata.namespace)
             for k in pod.metadata.labels:
                 self.pod_label_col(k)
         f32, i32 = self.pod_codec.alloc(batch_size)

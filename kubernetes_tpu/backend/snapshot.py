@@ -24,6 +24,10 @@ class Snapshot:
         self.have_pods_with_affinity_list: list[NodeInfo] = []
         self.have_pods_with_required_anti_affinity_list: list[NodeInfo] = []
         self.generation: int = 0
+        # namespace name -> labels, for affinity namespaceSelector unrolling
+        # (the nsLister surface of interpodaffinity/plugin.go:123)
+        self.namespaces: dict[str, dict[str, str]] = {}
+        self.ns_generation: int = 0
 
     # --- lister surface (snapshot.go:158-199) ---
 

@@ -18,7 +18,13 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from kubernetes_tpu.api.objects import Node, Pod, PodCondition, PriorityClass
+from kubernetes_tpu.api.objects import (
+    Namespace,
+    Node,
+    Pod,
+    PodCondition,
+    PriorityClass,
+)
 
 
 @dataclass
@@ -52,6 +58,7 @@ class Hub:
         self._nodes = _Store("Node")
         self._pods = _Store("Pod")
         self._priority_classes = _Store("PriorityClass")
+        self._namespaces = _Store("Namespace")
 
     # ------------- watch registration -------------
 
@@ -171,6 +178,28 @@ class Hub:
             if nominated_node is not None:
                 new.status.nominated_node_name = nominated_node
             self._update(self._pods, new)
+
+    # ------------- namespaces -------------
+
+    def watch_namespaces(self, h: EventHandlers, replay: bool = True) -> None:
+        with self._lock:
+            self._namespaces.handlers.append(h)
+            if replay and h.on_add:
+                for o in list(self._namespaces.objects.values()):
+                    h.on_add(o)
+
+    def create_namespace(self, ns: Namespace) -> None:
+        self._create(self._namespaces, ns)
+
+    def update_namespace(self, ns: Namespace) -> None:
+        self._update(self._namespaces, ns)
+
+    def delete_namespace(self, uid: str) -> None:
+        self._delete(self._namespaces, uid)
+
+    def list_namespaces(self) -> list[Namespace]:
+        with self._lock:
+            return list(self._namespaces.objects.values())
 
     # ------------- priority classes -------------
 

@@ -235,16 +235,20 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
          nodeaff_v, taint_v) = outs[6:]
         # pairwise pod<->pod term matches (placement-independent)
         M_anti = T.pair_term_match(pods.anti_tk, pods.anti_ns,
-                                   pods.anti_sel_cols, pods.anti_sel_vals,
+                                   pods.anti_ns_all, pods.anti_sel_cols,
+                                   pods.anti_sel_ops, pods.anti_sel_vals,
                                    pods.plabel_vals, pods.ns, pods.valid)
         M_aff = T.pair_term_match(pods.aff_tk, pods.aff_ns,
-                                  pods.aff_sel_cols, pods.aff_sel_vals,
+                                  pods.aff_ns_all, pods.aff_sel_cols,
+                                  pods.aff_sel_ops, pods.aff_sel_vals,
                                   pods.plabel_vals, pods.ns, pods.valid)
         M_paff = T.pair_term_match(pods.paff_tk, pods.paff_ns,
-                                   pods.paff_sel_cols, pods.paff_sel_vals,
+                                   pods.paff_ns_all, pods.paff_sel_cols,
+                                   pods.paff_sel_ops, pods.paff_sel_vals,
                                    pods.plabel_vals, pods.ns, pods.valid)
         M_panti = T.pair_term_match(pods.panti_tk, pods.panti_ns,
-                                    pods.panti_sel_cols, pods.panti_sel_vals,
+                                    pods.panti_ns_all, pods.panti_sel_cols,
+                                    pods.panti_sel_ops, pods.panti_sel_vals,
                                     pods.plabel_vals, pods.ns, pods.valid)
         M_tsc = T.pair_tsc_match(pods)                          # [B, C, B]
 

@@ -96,8 +96,16 @@ class Capacities:
     tolerations: int = 8
     pod_ports: int = 8
     aff_terms: int = 4           # pod (anti)affinity terms per kind
-    aff_ns: int = 4              # namespaces per affinity term
-    aff_sel: int = 4             # matchLabels pairs per affinity selector
+    aff_ns: int = 4              # namespaces per affinity term (incl. the
+                                 # pack-time namespaceSelector unroll, the
+                                 # device analog of the reference's
+                                 # mergeAffinityTermNamespacesIfNotEmpty,
+                                 # interpodaffinity/plugin.go:123)
+    aff_sel: int = 6             # selector EXPRESSIONS per affinity/spread
+                                 # selector (matchLabels pairs + op-coded
+                                 # matchExpressions + merged match/mismatch
+                                 # LabelKeys requirements)
+    aff_sel_vals: int = 4        # value ids per selector expression
     spread_constraints: int = 4
     pod_images: int = 8
     vocab: int = 65536           # interner id space mirrored to device
@@ -160,8 +168,15 @@ class ClusterTensors:
     image_sizes: jax.Array       # [N, I] f32 MiB
     # pod table (scheduled pods, for inter-pod affinity / topology spread).
     # Labels columnized over pod-label columns [Kp]; each term group stores
-    # (topo tk-index, selected namespaces, selector (col,val) pairs); the
-    # preferred groups add weights. Term slots with tk = NONE are unused.
+    # (topo tk-index, selected namespaces + all-namespaces flag, op-coded
+    # selector expressions); the preferred groups add weights. Term slots
+    # with tk = NONE are unused; expression slots with op = NONE are unused.
+    # Full LabelSelector semantics (framework/types.go:537 AffinityTerm):
+    # matchLabels pairs pack as In exprs, matchExpressions pack op-coded
+    # (In/NotIn/Exists/DoesNotExist), match/mismatchLabelKeys merge as
+    # In/NotIn exprs (strategy.go applyMatchLabelKeysAndMismatchLabelKeys),
+    # namespaceSelector unrolls into the ns list at pack time (empty
+    # selector => ns_all).
     pod_valid: jax.Array         # [PT] bool
     pod_node: jax.Array          # [PT] i32 node row index
     pod_ns: jax.Array            # [PT] i32 namespace id
@@ -169,24 +184,32 @@ class ClusterTensors:
     # REQUIRED anti-affinity terms (satisfyExistingPodsAntiAffinity)
     pod_anti_tk: jax.Array       # [PT, A] i32 topo-key index (-1 = unused term)
     pod_anti_ns: jax.Array       # [PT, A, NS] i32 namespace ids the term selects
+    pod_anti_ns_all: jax.Array   # [PT, A] bool: empty namespaceSelector
     pod_anti_sel_cols: jax.Array  # [PT, A, MS] i32 pod-label column
-    pod_anti_sel_vals: jax.Array  # [PT, A, MS] i32 required value id
+    pod_anti_sel_ops: jax.Array   # [PT, A, MS] i32 op id (-1 = unused expr)
+    pod_anti_sel_vals: jax.Array  # [PT, A, MS, V2] i32 value ids
     # REQUIRED affinity terms (hardPodAffinityWeight scoring)
     pod_aff_tk: jax.Array        # [PT, A] i32
     pod_aff_ns: jax.Array        # [PT, A, NS] i32
+    pod_aff_ns_all: jax.Array    # [PT, A] bool
     pod_aff_sel_cols: jax.Array  # [PT, A, MS] i32
-    pod_aff_sel_vals: jax.Array  # [PT, A, MS] i32
+    pod_aff_sel_ops: jax.Array   # [PT, A, MS] i32
+    pod_aff_sel_vals: jax.Array  # [PT, A, MS, V2] i32
     # PREFERRED affinity / anti-affinity terms (scoring)
     pod_paff_tk: jax.Array       # [PT, A] i32
     pod_paff_weight: jax.Array   # [PT, A] i32
     pod_paff_ns: jax.Array       # [PT, A, NS] i32
+    pod_paff_ns_all: jax.Array   # [PT, A] bool
     pod_paff_sel_cols: jax.Array  # [PT, A, MS] i32
-    pod_paff_sel_vals: jax.Array  # [PT, A, MS] i32
+    pod_paff_sel_ops: jax.Array   # [PT, A, MS] i32
+    pod_paff_sel_vals: jax.Array  # [PT, A, MS, V2] i32
     pod_panti_tk: jax.Array      # [PT, A] i32
     pod_panti_weight: jax.Array  # [PT, A] i32
     pod_panti_ns: jax.Array      # [PT, A, NS] i32
+    pod_panti_ns_all: jax.Array  # [PT, A] bool
     pod_panti_sel_cols: jax.Array  # [PT, A, MS] i32
-    pod_panti_sel_vals: jax.Array  # [PT, A, MS] i32
+    pod_panti_sel_ops: jax.Array   # [PT, A, MS] i32
+    pod_panti_sel_vals: jax.Array  # [PT, A, MS, V2] i32
 
 
 def node_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
@@ -215,7 +238,7 @@ def node_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
 
 def pod_table_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
     """Per-pod-slot schema for the scheduled-pod table (leading PT axis implied)."""
-    a, ns, ms = caps.aff_terms, caps.aff_ns, caps.aff_sel
+    a, ns, ms, v2 = caps.aff_terms, caps.aff_ns, caps.aff_sel, caps.aff_sel_vals
     d = {
         "pod_valid": ((), "bool"),
         "pod_node": ((), "i32"),
@@ -227,8 +250,10 @@ def pod_table_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]
         if g in ("paff", "panti"):
             d[f"pod_{g}_weight"] = ((a,), "i32")
         d[f"pod_{g}_ns"] = ((a, ns), "i32")
+        d[f"pod_{g}_ns_all"] = ((a,), "bool")
         d[f"pod_{g}_sel_cols"] = ((a, ms), "i32")
-        d[f"pod_{g}_sel_vals"] = ((a, ms), "i32")
+        d[f"pod_{g}_sel_ops"] = ((a, ms), "i32")
+        d[f"pod_{g}_sel_vals"] = ((a, ms, v2), "i32")
     return d
 
 
@@ -238,6 +263,7 @@ def pod_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
     T, E, V = caps.sel_terms, caps.sel_exprs, caps.sel_vals
     PW, TO, HP = caps.pref_terms, caps.tolerations, caps.pod_ports
     A, NS, MS, C = caps.aff_terms, caps.aff_ns, caps.aff_sel, caps.spread_constraints
+    V2 = caps.aff_sel_vals
     PL, IM = caps.pod_labels, caps.pod_images
     d = {
         "req": ((r,), "f32"),
@@ -275,7 +301,8 @@ def pod_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
         "tsc_hard": ((C,), "bool"),
         "tsc_min_domains": ((C,), "i32"),
         "tsc_sel_cols": ((C, MS), "i32"),
-        "tsc_sel_vals": ((C, MS), "i32"),
+        "tsc_sel_ops": ((C, MS), "i32"),
+        "tsc_sel_vals": ((C, MS, V2), "i32"),
         "tsc_honor_affinity": ((C,), "bool"),
         "tsc_honor_taints": ((C,), "bool"),
         "image_ids": ((IM,), "i32"),
@@ -287,8 +314,10 @@ def pod_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
         if g in ("paff", "panti"):
             d[f"{g}_weight"] = ((A,), "i32")
         d[f"{g}_ns"] = ((A, NS), "i32")
+        d[f"{g}_ns_all"] = ((A,), "bool")
         d[f"{g}_sel_cols"] = ((A, MS), "i32")
-        d[f"{g}_sel_vals"] = ((A, MS), "i32")
+        d[f"{g}_sel_ops"] = ((A, MS), "i32")
+        d[f"{g}_sel_vals"] = ((A, MS, V2), "i32")
     return d
 
 
@@ -337,35 +366,47 @@ class PodFeatures:
     hp_port: jax.Array           # [HP] i32 (-1 unused)
     # pod (anti)affinity terms — required and preferred, both directions.
     # *_tk is the registered topology-key index (NONE = unused term slot);
-    # selectors are (pod-label column, value) pairs.
+    # selectors are op-coded expressions over pod-label columns (full
+    # LabelSelector semantics; op NONE = unused expr slot); namespaces are
+    # an explicit id list (namespaceSelector unrolled at pack time) plus an
+    # all-namespaces flag for the empty selector.
     aff_self_match: jax.Array    # bool: pod matches ALL its own required
                                  # affinity terms (first-pod-of-group rule,
                                  # filtering.go satisfyPodAffinity)
     aff_tk: jax.Array            # [A] i32 required affinity
     aff_ns: jax.Array            # [A, NS] i32
+    aff_ns_all: jax.Array        # [A] bool
     aff_sel_cols: jax.Array      # [A, MS] i32
-    aff_sel_vals: jax.Array      # [A, MS] i32
+    aff_sel_ops: jax.Array       # [A, MS] i32
+    aff_sel_vals: jax.Array      # [A, MS, V2] i32
     anti_tk: jax.Array           # [A] i32 required anti-affinity
     anti_ns: jax.Array           # [A, NS] i32
+    anti_ns_all: jax.Array       # [A] bool
     anti_sel_cols: jax.Array     # [A, MS] i32
-    anti_sel_vals: jax.Array     # [A, MS] i32
+    anti_sel_ops: jax.Array      # [A, MS] i32
+    anti_sel_vals: jax.Array     # [A, MS, V2] i32
     paff_tk: jax.Array           # [A] i32 preferred affinity
     paff_weight: jax.Array       # [A] i32
     paff_ns: jax.Array           # [A, NS] i32
+    paff_ns_all: jax.Array       # [A] bool
     paff_sel_cols: jax.Array     # [A, MS] i32
-    paff_sel_vals: jax.Array     # [A, MS] i32
+    paff_sel_ops: jax.Array      # [A, MS] i32
+    paff_sel_vals: jax.Array     # [A, MS, V2] i32
     panti_tk: jax.Array          # [A] i32 preferred anti-affinity
     panti_weight: jax.Array      # [A] i32
     panti_ns: jax.Array          # [A, NS] i32
+    panti_ns_all: jax.Array      # [A] bool
     panti_sel_cols: jax.Array    # [A, MS] i32
-    panti_sel_vals: jax.Array    # [A, MS] i32
+    panti_sel_ops: jax.Array     # [A, MS] i32
+    panti_sel_vals: jax.Array    # [A, MS, V2] i32
     # topology spread constraints
     tsc_tk: jax.Array            # [C] i32 (-1 unused)
     tsc_max_skew: jax.Array      # [C] i32
     tsc_hard: jax.Array          # [C] bool (DoNotSchedule)
     tsc_min_domains: jax.Array   # [C] i32 (0 = unset)
     tsc_sel_cols: jax.Array      # [C, MS] i32
-    tsc_sel_vals: jax.Array      # [C, MS] i32
+    tsc_sel_ops: jax.Array       # [C, MS] i32
+    tsc_sel_vals: jax.Array      # [C, MS, V2] i32
     tsc_honor_affinity: jax.Array  # [C] bool (nodeAffinityPolicy == Honor)
     tsc_honor_taints: jax.Array    # [C] bool (nodeTaintsPolicy == Honor)
     # images referenced by containers

@@ -59,28 +59,53 @@ def slot_topo_dom(ct: ClusterTensors) -> jnp.ndarray:
     return jnp.where(ct.pod_valid[:, None], tds, NONE)
 
 
+def sel_match(ops: jnp.ndarray, vals: jnp.ndarray,
+              tgt_vals: jnp.ndarray) -> jnp.ndarray:
+    """Full LabelSelector match over op-coded expressions.
+
+    ops: [..., MS] (NONE = unused slot); vals: [..., MS, V]; tgt_vals:
+    [..., MS] = target's label value gathered at each expression's column
+    (NONE = label absent). Semantics follow apimachinery labels.Requirement:
+    In = present & value in set; NotIn = !present | value not in set;
+    Exists = present; DoesNotExist = !present; unknown op matches nothing.
+    Returns [...] bool: AND over used expressions."""
+    from kubernetes_tpu.ops.features import (
+        OP_DOES_NOT_EXIST, OP_EXISTS, OP_IN, OP_NOT_IN)
+
+    present = tgt_vals != NONE
+    inin = present & C.isin(tgt_vals, vals)
+    m = jnp.where(ops == OP_IN, inin,
+        jnp.where(ops == OP_NOT_IN, ~inin,
+        jnp.where(ops == OP_EXISTS, present,
+        jnp.where(ops == OP_DOES_NOT_EXIST, ~present, False))))
+    return jnp.all(m | (ops == NONE), axis=-1)
+
+
 def incoming_terms_vs_table(ct: ClusterTensors, tk: jnp.ndarray,
-                            ns: jnp.ndarray, sel_cols: jnp.ndarray,
+                            ns: jnp.ndarray, ns_all: jnp.ndarray,
+                            sel_cols: jnp.ndarray, sel_ops: jnp.ndarray,
                             sel_vals: jnp.ndarray) -> jnp.ndarray:
     """[PT, A]: does table pod s satisfy the incoming pod's term a?
-    (term.Matches: s.ns in term.namespaces and selector matches s's labels)"""
-    ns_ok = C.isin(ct.pod_ns[:, None], ns[None])               # [PT, A]
+    (AffinityTerm.Matches: s.ns in term.namespaces (or all-ns) and the
+    selector expressions match s's labels)"""
+    ns_ok = C.isin(ct.pod_ns[:, None], ns[None]) | ns_all[None]  # [PT, A]
     tv = take_cols(ct.pt_label_vals, sel_cols, NONE)           # [PT, A, MS]
-    used = sel_vals != NONE
-    sel_ok = jnp.all((tv == sel_vals[None]) | ~used[None], axis=-1)
+    sel_ok = sel_match(sel_ops[None], sel_vals[None], tv)      # [PT, A]
     return ns_ok & sel_ok & ct.pod_valid[:, None] & (tk[None] != NONE)
 
 
 def table_terms_vs_incoming(ct: ClusterTensors, grp_tk: jnp.ndarray,
-                            grp_ns: jnp.ndarray, grp_cols: jnp.ndarray,
+                            grp_ns: jnp.ndarray, grp_ns_all: jnp.ndarray,
+                            grp_cols: jnp.ndarray, grp_ops: jnp.ndarray,
                             grp_vals: jnp.ndarray,
                             pod: PodFeatures) -> jnp.ndarray:
     """[PT, A]: does the incoming pod satisfy table pod s's term a?"""
-    ns_ok = jnp.any((grp_ns == pod.ns) & (grp_ns != NONE), axis=-1)  # [PT, A]
+    ns_ok = (jnp.any((grp_ns == pod.ns) & (grp_ns != NONE), axis=-1)
+             | grp_ns_all)                                     # [PT, A]
     kp = pod.plabel_vals.shape[0]
     pv = pod.plabel_vals[jnp.clip(grp_cols, 0, kp - 1)]        # [PT, A, MS]
     pv = jnp.where(grp_cols >= 0, pv, NONE)
-    sel_ok = jnp.all((pv == grp_vals) | (grp_vals == NONE), axis=-1)
+    sel_ok = sel_match(grp_ops, grp_vals, pv)                  # [PT, A]
     return ns_ok & sel_ok & (grp_tk != NONE) & ct.pod_valid[:, None]
 
 
@@ -113,33 +138,37 @@ def gather_rows(m: jnp.ndarray, dom: jnp.ndarray):
 # already-committed pods' domains into small [rows, D] maps.
 
 
-def pair_term_match(tk: jnp.ndarray, ns: jnp.ndarray, cols: jnp.ndarray,
-                    vals: jnp.ndarray, tgt_labels: jnp.ndarray,
-                    tgt_ns: jnp.ndarray,
+def pair_term_match(tk: jnp.ndarray, ns: jnp.ndarray, ns_all: jnp.ndarray,
+                    cols: jnp.ndarray, ops: jnp.ndarray, vals: jnp.ndarray,
+                    tgt_labels: jnp.ndarray, tgt_ns: jnp.ndarray,
                     tgt_valid: jnp.ndarray) -> jnp.ndarray:
     """[Bx, A, By]: does batch pod y satisfy batch pod x's term a?
 
-    tk [Bx, A]; ns [Bx, A, NS]; cols/vals [Bx, A, MS];
-    tgt_labels [By, Kp]; tgt_ns/tgt_valid [By]."""
+    tk [Bx, A]; ns [Bx, A, NS]; ns_all [Bx, A]; cols/ops [Bx, A, MS];
+    vals [Bx, A, MS, V]; tgt_labels [By, Kp]; tgt_ns/tgt_valid [By]."""
     kp = tgt_labels.shape[1]
     pv = tgt_labels.T[jnp.clip(cols, 0, kp - 1)]       # [Bx, A, MS, By]
     pv = jnp.where(cols[..., None] >= 0, pv, NONE)
-    sel_ok = jnp.all((pv == vals[..., None]) | (vals[..., None] == NONE),
-                     axis=2)                            # [Bx, A, By]
-    ns_ok = jnp.any((ns[..., :, None] == tgt_ns[None, None, None, :])
-                    & (ns[..., :, None] != NONE), axis=2)  # [Bx, A, By]
+    # move By before MS so sel_match reduces over its last-but-one layout:
+    # [Bx, A, By, MS] vs vals broadcast [Bx, A, 1, MS, V]
+    pv = jnp.moveaxis(pv, -1, -2)                       # [Bx, A, By, MS]
+    sel_ok = sel_match(ops[..., None, :], vals[..., None, :, :], pv)
+    ns_ok = (jnp.any((ns[..., :, None] == tgt_ns[None, None, None, :])
+                     & (ns[..., :, None] != NONE), axis=2)
+             | ns_all[..., None])                       # [Bx, A, By]
     return (ns_ok & sel_ok & (tk[..., None] != NONE)
             & tgt_valid[None, None, :])
 
 
 def pair_tsc_match(pods: PodFeatures) -> jnp.ndarray:
     """[Bx, C, By]: does batch pod y match batch pod x's spread constraint c?
-    (same namespace + folded selector over y's labels)"""
+    (same namespace + selector expressions over y's labels)"""
     kp = pods.plabel_vals.shape[1]
     pv = pods.plabel_vals.T[jnp.clip(pods.tsc_sel_cols, 0, kp - 1)]
     pv = jnp.where(pods.tsc_sel_cols[..., None] >= 0, pv, NONE)
-    sel_ok = jnp.all((pv == pods.tsc_sel_vals[..., None])
-                     | (pods.tsc_sel_vals[..., None] == NONE), axis=2)
+    pv = jnp.moveaxis(pv, -1, -2)                       # [Bx, C, By, MS]
+    sel_ok = sel_match(pods.tsc_sel_ops[..., None, :],
+                       pods.tsc_sel_vals[..., None, :, :], pv)
     ns_ok = pods.ns[:, None, None] == pods.ns[None, None, :]
     return (sel_ok & ns_ok & (pods.tsc_tk[..., None] != NONE)
             & pods.valid[None, None, :])
@@ -231,7 +260,8 @@ def inter_pod_affinity_static(ct: ClusterTensors, pod: PodFeatures,
 
     # 1. existing pods' required anti-affinity vs incoming pod
     m1 = table_terms_vs_incoming(ct, ct.pod_anti_tk, ct.pod_anti_ns,
-                                 ct.pod_anti_sel_cols, ct.pod_anti_sel_vals,
+                                 ct.pod_anti_ns_all, ct.pod_anti_sel_cols,
+                                 ct.pod_anti_sel_ops, ct.pod_anti_sel_vals,
                                  pod)                              # [PT, A]
     dom1 = jnp.take_along_axis(tds, jnp.clip(ct.pod_anti_tk, 0, tk_cap - 1),
                                axis=1)
@@ -241,7 +271,8 @@ def inter_pod_affinity_static(ct: ClusterTensors, pod: PodFeatures,
 
     # 2. incoming pod's required anti-affinity vs existing pods
     m2 = incoming_terms_vs_table(ct, pod.anti_tk, pod.anti_ns,
-                                 pod.anti_sel_cols, pod.anti_sel_vals)
+                                 pod.anti_ns_all, pod.anti_sel_cols,
+                                 pod.anti_sel_ops, pod.anti_sel_vals)
     dom2 = tds[:, jnp.clip(pod.anti_tk, 0, tk_cap - 1)]            # [PT, A]
     dom2 = jnp.where(pod.anti_tk[None] != NONE, dom2, NONE)
     tk2 = jnp.broadcast_to(pod.anti_tk[None], m2.shape)
@@ -252,7 +283,8 @@ def inter_pod_affinity_static(ct: ClusterTensors, pod: PodFeatures,
     #    in the node's domain (node must carry every term's topology label)
     a_cap = pod.aff_tk.shape[0]
     m3 = incoming_terms_vs_table(ct, pod.aff_tk, pod.aff_ns,
-                                 pod.aff_sel_cols, pod.aff_sel_vals)
+                                 pod.aff_ns_all, pod.aff_sel_cols,
+                                 pod.aff_sel_ops, pod.aff_sel_vals)
     dom3 = tds[:, jnp.clip(pod.aff_tk, 0, tk_cap - 1)]             # [PT, A]
     dom3 = jnp.where(pod.aff_tk[None] != NONE, dom3, NONE)
     rows3 = jnp.broadcast_to(jnp.arange(a_cap)[None], m3.shape)
@@ -270,35 +302,39 @@ def inter_pod_affinity_score(ct: ClusterTensors, pod: PodFeatures,
     tk_cap = ct.topo_dom.shape[1]
     score = jnp.zeros((tk_cap * d_cap,), jnp.float32)
 
-    def add_incoming(score, tk, ns, cols, vals, w, sign):
-        m = incoming_terms_vs_table(ct, tk, ns, cols, vals)        # [PT, A]
+    def add_incoming(score, tk, ns, ns_all, cols, ops, vals, w, sign):
+        m = incoming_terms_vs_table(ct, tk, ns, ns_all, cols, ops, vals)
         dom = tds[:, jnp.clip(tk, 0, tk_cap - 1)]
         ok = m & (dom != NONE) & (tk[None] != NONE)
         flat = jnp.clip(tk[None], 0) * d_cap + jnp.clip(dom, 0)
         upd = jnp.where(ok, sign * w[None].astype(jnp.float32), 0.0)
         return score.at[flat.reshape(-1)].add(upd.reshape(-1))
 
-    def add_table(score, tk, ns, cols, vals, w, sign):
-        m = table_terms_vs_incoming(ct, tk, ns, cols, vals, pod)   # [PT, A]
+    def add_table(score, tk, ns, ns_all, cols, ops, vals, w, sign):
+        m = table_terms_vs_incoming(ct, tk, ns, ns_all, cols, ops, vals, pod)
         dom = jnp.take_along_axis(tds, jnp.clip(tk, 0, tk_cap - 1), axis=1)
         ok = m & (dom != NONE) & (tk != NONE)
         flat = jnp.clip(tk, 0) * d_cap + jnp.clip(dom, 0)
         upd = jnp.where(ok, sign * w.astype(jnp.float32), 0.0)
         return score.at[flat.reshape(-1)].add(upd.reshape(-1))
 
-    score = add_incoming(score, pod.paff_tk, pod.paff_ns, pod.paff_sel_cols,
+    score = add_incoming(score, pod.paff_tk, pod.paff_ns, pod.paff_ns_all,
+                         pod.paff_sel_cols, pod.paff_sel_ops,
                          pod.paff_sel_vals, pod.paff_weight, 1.0)
-    score = add_incoming(score, pod.panti_tk, pod.panti_ns,
-                         pod.panti_sel_cols, pod.panti_sel_vals,
-                         pod.panti_weight, -1.0)
+    score = add_incoming(score, pod.panti_tk, pod.panti_ns, pod.panti_ns_all,
+                         pod.panti_sel_cols, pod.panti_sel_ops,
+                         pod.panti_sel_vals, pod.panti_weight, -1.0)
     hw = jnp.broadcast_to(hard_weight, ct.pod_aff_tk.shape)
-    score = add_table(score, ct.pod_aff_tk, ct.pod_aff_ns,
-                      ct.pod_aff_sel_cols, ct.pod_aff_sel_vals, hw, 1.0)
+    score = add_table(score, ct.pod_aff_tk, ct.pod_aff_ns, ct.pod_aff_ns_all,
+                      ct.pod_aff_sel_cols, ct.pod_aff_sel_ops,
+                      ct.pod_aff_sel_vals, hw, 1.0)
     score = add_table(score, ct.pod_paff_tk, ct.pod_paff_ns,
-                      ct.pod_paff_sel_cols, ct.pod_paff_sel_vals,
+                      ct.pod_paff_ns_all, ct.pod_paff_sel_cols,
+                      ct.pod_paff_sel_ops, ct.pod_paff_sel_vals,
                       ct.pod_paff_weight, 1.0)
     score = add_table(score, ct.pod_panti_tk, ct.pod_panti_ns,
-                      ct.pod_panti_sel_cols, ct.pod_panti_sel_vals,
+                      ct.pod_panti_ns_all, ct.pod_panti_sel_cols,
+                      ct.pod_panti_sel_ops, ct.pod_panti_sel_vals,
                       ct.pod_panti_weight, -1.0)
 
     per_tk = gather_rows(score.reshape(tk_cap, d_cap), ct.topo_dom)
@@ -313,16 +349,14 @@ def _tsc_self_match(pod: PodFeatures) -> jnp.ndarray:
     kp = pod.plabel_vals.shape[0]
     pv = pod.plabel_vals[jnp.clip(pod.tsc_sel_cols, 0, kp - 1)]    # [C, MS]
     pv = jnp.where(pod.tsc_sel_cols >= 0, pv, NONE)
-    return jnp.all((pv == pod.tsc_sel_vals) | (pod.tsc_sel_vals == NONE),
-                   axis=-1)
+    return sel_match(pod.tsc_sel_ops, pod.tsc_sel_vals, pv)
 
 
 def _tsc_matches(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
     """[PT, C]: table pod s matches constraint c's selector in pod's ns."""
     ns_ok = ct.pod_ns[:, None] == pod.ns                           # [PT, 1]
     tv = take_cols(ct.pt_label_vals, pod.tsc_sel_cols, NONE)       # [PT, C, MS]
-    used = pod.tsc_sel_vals != NONE
-    sel_ok = jnp.all((tv == pod.tsc_sel_vals[None]) | ~used[None], axis=-1)
+    sel_ok = sel_match(pod.tsc_sel_ops[None], pod.tsc_sel_vals[None], tv)
     return sel_ok & ns_ok & ct.pod_valid[:, None] & (pod.tsc_tk[None] != NONE)
 
 

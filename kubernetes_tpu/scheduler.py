@@ -105,6 +105,13 @@ class Scheduler:
             on_add=self._on_pod_add,
             on_update=self._on_pod_update,
             on_delete=self._on_pod_delete))
+        self.hub.watch_namespaces(EventHandlers(
+            on_add=lambda ns: self.cache.set_namespace(
+                ns.metadata.name, ns.metadata.labels),
+            on_update=lambda old, new: self.cache.set_namespace(
+                new.metadata.name, new.metadata.labels),
+            on_delete=lambda ns: self.cache.remove_namespace(
+                ns.metadata.name)))
 
     def _on_node_add(self, node: Node) -> None:
         self.cache.add_node(node)
@@ -176,7 +183,9 @@ class Scheduler:
         self.mirror = Mirror(caps=self.caps)
         self.snapshot = Snapshot()
         self.cache.update_snapshot(self.snapshot)
-        self.mirror.sync(self.snapshot)
+        # NO sync here: the caller's retry loop re-syncs, so a second field
+        # overflowing during the rebuild raises inside the try (and grows
+        # again) instead of escaping the loop from this except-handler.
 
     # ------------- the batched scheduling cycle -------------
 
@@ -203,7 +212,7 @@ class Scheduler:
         self.stats["attempts"] += len(runnable)
 
         self.cache.update_snapshot(self.snapshot)
-        for attempt in range(8):
+        for attempt in range(16):  # one capacity field may grow per attempt
             try:
                 self.mirror.sync(self.snapshot)
                 cblobs, pblobs, topo, d_cap = self.mirror.prepare_launch(

@@ -1,0 +1,289 @@
+"""Full LabelSelector / namespaceSelector / mismatchLabelKeys semantics for
+pod-(anti)affinity terms and topology-spread constraints.
+
+Reference semantics: framework/types.go:537 (AffinityTerm.Matches),
+interpodaffinity/plugin.go:123 (mergeAffinityTermNamespacesIfNotEmpty),
+registry/core/pod/strategy.go:846-903 (match/mismatchLabelKeys merged as
+In/NotIn requirements). Built with real objects through the
+Cache -> Snapshot -> Mirror path, evaluated via the batched pipeline."""
+
+import numpy as np
+
+from kubernetes_tpu.api.objects import (
+    Affinity,
+    Container,
+    LABEL_HOSTNAME,
+    LABEL_ZONE,
+    LabelSelector,
+    LabelSelectorRequirement,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PodSpec,
+    ResourceRequirements,
+    TopologySpreadConstraint,
+)
+from kubernetes_tpu.backend.cache import Cache
+from kubernetes_tpu.backend.mirror import Mirror
+from kubernetes_tpu.backend.snapshot import Snapshot
+from kubernetes_tpu.models.pipeline import default_weights, schedule_batch_jit
+from kubernetes_tpu.ops.features import Capacities
+
+CAPS = Capacities(nodes=16, pods=64, domains=16)
+
+
+def mknode(name, zone):
+    return Node(metadata=ObjectMeta(name=name, labels={
+        LABEL_HOSTNAME: name, LABEL_ZONE: zone}),
+        status=NodeStatus(allocatable={"cpu": "32", "memory": "64Gi",
+                                       "pods": "110"}))
+
+
+def mkpod(name, labels=None, node=None, affinity=None, tsc=None, ns="default"):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns, labels=labels or {}),
+        spec=PodSpec(
+            node_name=node or "",
+            containers=[Container(name="c", resources=ResourceRequirements(
+                requests={"cpu": "100m", "memory": "64Mi"}))],
+            affinity=affinity,
+            topology_spread_constraints=tsc or [],
+        ))
+
+
+def expr(key, op, *values):
+    return LabelSelectorRequirement(key=key, operator=op, values=list(values))
+
+
+def anti_term(topokey, selector=None, **kw):
+    return Affinity(pod_anti_affinity=PodAntiAffinity(required=[
+        PodAffinityTerm(topology_key=topokey, label_selector=selector, **kw)]))
+
+
+def aff_term(topokey, selector=None, **kw):
+    return Affinity(pod_affinity=PodAffinity(required=[
+        PodAffinityTerm(topology_key=topokey, label_selector=selector, **kw)]))
+
+
+class Cluster:
+    def __init__(self, nodes, scheduled=(), namespaces=None):
+        self.cache = Cache()
+        for n in nodes:
+            self.cache.add_node(n)
+        for name, labels in (namespaces or {}).items():
+            self.cache.set_namespace(name, labels)
+        for p in scheduled:
+            self.cache.add_pod(p)
+        self.snap = Snapshot()
+        self.cache.update_snapshot(self.snap)
+        self.mirror = Mirror(caps=CAPS)
+        self.mirror.sync(self.snap)
+
+    def resync(self):
+        self.cache.update_snapshot(self.snap)
+        self.mirror.sync(self.snap)
+
+    def run(self, pods):
+        cblobs, pblobs, topo, d_cap = self.mirror.prepare_launch(pods, 8)
+        out = schedule_batch_jit(cblobs, pblobs, self.mirror.well_known(),
+                                 default_weights(), CAPS, topo, d_cap)
+        names = [self.mirror.name_of_row(int(r)) if r >= 0 else None
+                 for r in np.asarray(out.node_row)[: len(pods)]]
+        return names, out
+
+
+ZONES = [mknode("n1", "z1"), mknode("n2", "z1"), mknode("n3", "z2")]
+
+
+# --------------- full selector operators in affinity terms ---------------
+
+
+def test_anti_affinity_notin_expression():
+    """NotIn: the term matches pods whose label is NOT in the set — the
+    incoming pod must avoid the zone of every pod with env != prod."""
+    cl = Cluster(ZONES, [
+        mkpod("a", {"env": "dev"}, node="n1"),
+        mkpod("b", {"env": "prod"}, node="n3"),
+    ])
+    sel = LabelSelector(match_expressions=[expr("env", "NotIn", "prod")])
+    names, _ = cl.run([mkpod("p", affinity=anti_term(LABEL_ZONE, sel))])
+    assert names == ["n3"]  # z1 hosts the env=dev pod (matched by NotIn)
+
+
+def test_anti_affinity_exists_expression():
+    cl = Cluster(ZONES, [mkpod("a", {"gpu": "yes"}, node="n3")])
+    sel = LabelSelector(match_expressions=[expr("gpu", "Exists")])
+    names, _ = cl.run([mkpod("p", affinity=anti_term(LABEL_ZONE, sel))])
+    assert names[0] in ("n1", "n2")
+
+
+def test_anti_affinity_multi_value_in():
+    cl = Cluster(ZONES, [
+        mkpod("a", {"app": "web"}, node="n1"),
+        mkpod("b", {"app": "api"}, node="n3"),
+    ])
+    sel = LabelSelector(match_expressions=[expr("app", "In", "web", "api")])
+    names, _ = cl.run([mkpod("p", affinity=anti_term(LABEL_ZONE, sel))])
+    assert names == [None]  # both zones blocked
+
+
+def test_affinity_doesnotexist_expression():
+    """Required affinity whose selector matches pods lacking a label."""
+    cl = Cluster(ZONES, [
+        mkpod("plain", {}, node="n3"),
+        mkpod("labeled", {"special": "1"}, node="n1"),
+    ])
+    sel = LabelSelector(match_expressions=[expr("special", "DoesNotExist")])
+    names, _ = cl.run([mkpod("p", affinity=aff_term(LABEL_ZONE, sel))])
+    assert names == ["n3"]
+
+
+def test_unknown_operator_matches_nothing():
+    """Malformed operator: the requirement matches no pod (parse-error ->
+    no-match), so a required-affinity term can never be satisfied."""
+    cl = Cluster(ZONES, [mkpod("a", {"app": "web"}, node="n1")])
+    sel = LabelSelector(match_expressions=[expr("app", "Bogus", "web")])
+    names, _ = cl.run([mkpod("p", affinity=aff_term(LABEL_ZONE, sel))])
+    assert names == [None]
+
+
+# --------------- namespaceSelector ---------------
+
+
+def test_namespace_selector_unrolled():
+    """Anti-affinity with a namespaceSelector applies across the selected
+    namespaces (term owner in 'default', victim pod in 'team-a')."""
+    other = mkpod("o", {"app": "web"}, node="n1", ns="team-a")
+    cl = Cluster(ZONES, [other],
+                 namespaces={"team-a": {"tier": "x"}, "team-b": {}})
+    sel = LabelSelector(match_labels={"app": "web"})
+    nssel = LabelSelector(match_labels={"tier": "x"})
+    names, _ = cl.run([mkpod("p", affinity=anti_term(
+        LABEL_ZONE, sel, namespace_selector=nssel))])
+    assert names == ["n3"]
+    # without the nsSelector the term only covers the owner's namespace
+    names2, _ = cl.run([mkpod("q", affinity=anti_term(LABEL_ZONE, sel))])
+    assert names2[0] in ("n1", "n2", "n3")  # team-a pod not matched
+
+
+def test_empty_namespace_selector_matches_all():
+    other = mkpod("o", {"app": "web"}, node="n1", ns="anywhere")
+    cl = Cluster(ZONES, [other], namespaces={"anywhere": {}})
+    sel = LabelSelector(match_labels={"app": "web"})
+    names, _ = cl.run([mkpod("p", affinity=anti_term(
+        LABEL_ZONE, sel, namespace_selector=LabelSelector()))])
+    assert names == ["n3"]
+
+
+def test_table_pod_ns_selector_repacks_on_namespace_change():
+    """An existing pod's anti-affinity with namespaceSelector must see
+    namespaces created AFTER it was packed (mirror repacks on ns change)."""
+    sel = LabelSelector(match_labels={"app": "web"})
+    nssel = LabelSelector(match_labels={"tier": "x"})
+    guard = mkpod("guard", {}, node="n1", affinity=anti_term(
+        LABEL_ZONE, sel, namespace_selector=nssel))
+    cl = Cluster(ZONES, [guard])
+    # incoming web pod from team-a: no namespace labeled tier=x yet
+    p1 = mkpod("p1", {"app": "web"}, ns="team-a")
+    names, _ = cl.run([p1])
+    assert names[0] in ("n1", "n2", "n3")
+    # label team-a as tier=x -> the guard's unrolled term now covers it
+    cl.cache.set_namespace("team-a", {"tier": "x"})
+    cl.resync()
+    names2, _ = cl.run([mkpod("p2", {"app": "web"}, ns="team-a")])
+    assert names2 == ["n3"]
+
+
+def test_ns_selector_matches_namespace_without_object():
+    """A namespace with no Namespace object has nil labels; a DoesNotExist
+    namespaceSelector requirement must match it (AffinityTerm.Matches with
+    empty nsLabels) even though it never appears in the store."""
+    other = mkpod("o", {"app": "web"}, node="n1", ns="no-object-ns")
+    cl = Cluster(ZONES, [other])  # note: no namespaces fed at all
+    sel = LabelSelector(match_labels={"app": "web"})
+    nssel = LabelSelector(match_expressions=[expr("restricted",
+                                                  "DoesNotExist")])
+    names, _ = cl.run([mkpod("p", affinity=anti_term(
+        LABEL_ZONE, sel, namespace_selector=nssel))])
+    assert names == ["n3"]
+
+
+# --------------- match/mismatchLabelKeys ---------------
+
+
+def test_mismatch_label_keys_anti_affinity():
+    """mismatchLabelKeys merges 'key NotIn (own value)': anti-affinity to
+    other apps' pods but not to the pod's own app group."""
+    cl = Cluster(ZONES, [
+        mkpod("same", {"app": "me", "kind": "w"}, node="n3"),
+        mkpod("other", {"app": "you", "kind": "w"}, node="n1"),
+    ])
+    sel = LabelSelector(match_labels={"kind": "w"})
+    p = mkpod("p", {"app": "me"}, affinity=anti_term(
+        LABEL_ZONE, sel, mismatch_label_keys=["app"]))
+    names, _ = cl.run([p])
+    # z1 blocked (app=you, kind=w matches); z3's pod shares app=me -> excluded
+    assert names == ["n3"]
+
+
+def test_match_label_keys_affinity():
+    """matchLabelKeys merges 'key In (own value)': co-locate only with the
+    same version group."""
+    cl = Cluster(ZONES, [
+        mkpod("v1", {"app": "w", "ver": "1"}, node="n1"),
+        mkpod("v2", {"app": "w", "ver": "2"}, node="n3"),
+    ])
+    sel = LabelSelector(match_labels={"app": "w"})
+    p = mkpod("p", {"ver": "2"}, affinity=aff_term(
+        LABEL_ZONE, sel, match_label_keys=["ver"]))
+    names, _ = cl.run([p])
+    assert names == ["n3"]
+
+
+# --------------- spread constraints with full selectors ---------------
+
+
+def test_spread_selector_expressions():
+    """Spread counts pods via matchExpressions (In with two values)."""
+    tsc = TopologySpreadConstraint(
+        max_skew=1, topology_key=LABEL_ZONE, when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_expressions=[
+            expr("app", "In", "web", "api")]))
+    cl = Cluster(ZONES, [
+        mkpod("a", {"app": "web"}, node="n1"),
+        mkpod("b", {"app": "api"}, node="n1"),
+    ])
+    p = mkpod("p", {"app": "web"}, tsc=[tsc])
+    names, _ = cl.run([p])
+    assert names == ["n3"]  # z1 has 2 matches, z2 has 0: skew forces z2
+
+
+# --------------- host oracle parity ---------------
+
+
+def test_host_oracle_matches_device_semantics():
+    m = Mirror(caps=CAPS)
+    owner = mkpod("o", {"app": "me"})
+    term = PodAffinityTerm(
+        topology_key=LABEL_ZONE,
+        label_selector=LabelSelector(match_expressions=[
+            expr("env", "NotIn", "prod")]),
+        mismatch_label_keys=["app"])
+    # env=dev matches NotIn; app differs -> mismatch NotIn passes
+    assert m.term_matches_pod(term, owner, mkpod("t1", {"env": "dev",
+                                                        "app": "you"}))
+    # same app -> excluded by mismatchLabelKeys
+    assert not m.term_matches_pod(term, owner, mkpod("t2", {"env": "dev",
+                                                            "app": "me"}))
+    # env=prod -> NotIn fails
+    assert not m.term_matches_pod(term, owner, mkpod("t3", {"env": "prod",
+                                                            "app": "you"}))
+    # label absent -> NotIn passes
+    assert m.term_matches_pod(term, owner, mkpod("t4", {"app": "you"}))
+    # nil selector matches nothing
+    nil_term = PodAffinityTerm(topology_key=LABEL_ZONE)
+    assert not m.term_matches_pod(nil_term, owner, mkpod("t5", {}))
