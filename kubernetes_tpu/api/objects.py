@@ -378,6 +378,22 @@ class Namespace:
         return self.metadata.name
 
 
+# --- pod disruption budget ------------------------------------------------------------
+
+
+@dataclass
+class PodDisruptionBudget:
+    """policy/v1 PodDisruptionBudget — the slice preemption consumes:
+    selector + status.disruptionsAllowed (preemption.go filterPodsWithPDB
+    reads DisruptionsAllowed to rank candidates by violation count)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+    min_available: Optional[int] = None
+    max_unavailable: Optional[int] = None
+    disruptions_allowed: int = 0  # status.disruptionsAllowed
+
+
 # --- priority class ------------------------------------------------------------------
 
 

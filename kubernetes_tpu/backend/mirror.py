@@ -118,6 +118,10 @@ class Mirror:
         self._namespaces: dict[str, dict[str, str]] = {}
         self._ns_gen = 0
         self._uids_with_nssel: set[str] = set()
+        # nominated (preemptor) pods: packed per-cycle by set_nominated under
+        # "nominated:<uid>" keys; per-row reserved request sums
+        self._nominated_uids: set[str] = set()
+        self._nominated_req_of_row: dict[int, np.ndarray] = {}
         # every namespace any packed pod lives in: selectors are evaluated
         # over store ∪ pod namespaces (labels default {}), matching the
         # reference's nil-nsLabels behavior for namespaces that have no
@@ -272,6 +276,8 @@ class Mirror:
         f["nonzero_requested"] = np.asarray(
             [info.non_zero_requested.milli_cpu,
              info.non_zero_requested.memory / MI], np.float32)
+        f["nominated_req"] = self._nominated_req_of_row.get(
+            row, np.zeros((caps.res_cols,), np.float32))
         f["node_valid"] = np.bool_(True)
         f["unschedulable"] = np.bool_(node.spec.unschedulable)
         f["node_name_id"] = np.int32(self._i(node.metadata.name))
@@ -331,7 +337,8 @@ class Mirror:
         current = self._node_pods.setdefault(name, {})
         live_uids = {p.pod.metadata.uid for p in info.pods}
         for uid in list(current):
-            if uid not in live_uids:
+            # nominated slots are owned by set_nominated, not the node diff
+            if uid not in live_uids and not uid.startswith("nominated:"):
                 self._release_pod_slot(uid)
         for pi in info.pods:
             uid = pi.pod.metadata.uid
@@ -378,7 +385,8 @@ class Mirror:
             w[: len(weights)] = weights
             f[f"{prefix}_weight"] = w
 
-    def _pack_pod_slot(self, uid: str, pi: PodInfo, row: int, node_name: str) -> None:
+    def _pack_pod_slot(self, uid: str, pi: PodInfo, row: int, node_name: str,
+                       nominated: bool = False) -> None:
         self._note_namespace(pi.pod.metadata.namespace)
         if not self._free_slots:
             raise CapacityError("pods", self.caps.pods + 1)
@@ -388,6 +396,8 @@ class Mirror:
         f["pod_valid"] = np.bool_(True)
         f["pod_node"] = np.int32(row)
         f["pod_ns"] = np.int32(self._i(pod.metadata.namespace))
+        f["pod_uid"] = np.int32(self._i(pod.metadata.uid))
+        f["pod_nominated"] = np.bool_(nominated)
         f["pt_label_vals"] = self.pod_labels_row(pod.metadata.labels)
         self._pack_term_group(pi.required_anti_affinity_terms, None, pod,
                               "pod_anti", f)
@@ -686,6 +696,37 @@ class Mirror:
         required/preferred terms) even a constraint-free incoming batch."""
         return bool(self._uids_with_terms)
 
+    def set_nominated(self, by_node: dict[str, list[Pod]]) -> None:
+        """Refresh the nominated-pod overlay: pending preemptors with a
+        NominatedNodeName occupy pod-table slots on their nominated row
+        (anti-affinity counts them; required-affinity presence and scoring
+        exclude them via pod_nominated — the device analog of the dual pass
+        in RunFilterPluginsWithNominatedPods, runtime/framework.go:989) and
+        reserve their resource requests in the node row's nominated_req."""
+        for uid in list(self._nominated_uids):
+            self._release_pod_slot(uid)
+        self._nominated_uids.clear()
+        off, size = self.node_codec._f32_off["nominated_req"]
+        for row in list(self._nominated_req_of_row):
+            self.node_f32[row, off:off + size] = 0.0
+            self._dirty_rows.add(row)
+        self._nominated_req_of_row.clear()
+        for node_name, pods in by_node.items():
+            row = self._row_of.get(node_name)
+            if row is None or not pods:
+                continue
+            req_sum = np.zeros((self.caps.res_cols,), np.float32)
+            for pod in pods:
+                pi = PodInfo(pod)
+                key = "nominated:" + pod.metadata.uid
+                self._pack_pod_slot(key, pi, row, node_name, nominated=True)
+                self._nominated_uids.add(key)
+                req_sum += self._res_row(pi.request)
+                req_sum[F.COL_PODS] += 1.0
+            self._nominated_req_of_row[row] = req_sum
+            self.node_f32[row, off:off + size] = req_sum
+            self._dirty_rows.add(row)
+
     def reserve_batch_slots(self, n: int) -> np.ndarray:
         """Pod-table slots the batched commit scan will fill on device; host
         confirms/repacks them on the next sync after binding."""
@@ -710,6 +751,14 @@ class Mirror:
         out["priority"] = np.int32(pod.priority())
         out["ns"] = np.int32(self._i(pod.metadata.namespace))
         out["name_id"] = np.int32(self._i(pod.metadata.name))
+        out["uid_id"] = np.int32(self._i(pod.metadata.uid))
+        # own-reservation add-back is only sound if this pod's reservation is
+        # actually inside nominated_req (set_nominated ran with it); a stale
+        # status.nominatedNodeName must NOT inflate free
+        nom = pod.status.nominated_node_name
+        reserved = ("nominated:" + pod.metadata.uid) in self._nominated_uids
+        out["nominated_row"] = np.int32(
+            self._row_of.get(nom, NONE) if nom and reserved else NONE)
         out["plabel_vals"] = self.pod_labels_row(pod.metadata.labels)
         if len(pod.spec.node_selector) > caps.pod_labels:
             raise CapacityError("pod_labels", len(pod.spec.node_selector))

@@ -1,0 +1,251 @@
+"""Preemption: the generic Evaluator + the DefaultPreemption PostFilter.
+
+Host orchestration mirrors /root/reference/pkg/scheduler/framework/
+preemption/preemption.go (Evaluator.Preempt :232, findCandidates :307,
+SelectCandidate/pickOneNodeForPreemption :395,:565, prepareCandidate :428)
+and plugins/defaultpreemption/default_preemption.go (PostFilter :133,
+SelectVictimsOnNode :219, PodEligibleToPreemptOthers :327,
+GetOffsetAndNumCandidates :186) — with the per-node dry-run replaced by ONE
+device sweep over victim prefixes (ops.preempt.preempt_sweep).
+
+Victim ordering: pods on a node sort ascending by importance
+(util.MoreImportantPod: priority, then start time) so the minimal feasible
+prefix evicts the least-important pods first — the resource-space fixed
+point of the reference's remove-all-then-reprieve loop.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+import numpy as np
+
+from kubernetes_tpu.api.labels import label_selector_matches
+from kubernetes_tpu.api.objects import Pod
+from kubernetes_tpu.framework.interface import PostFilterPlugin, Status
+from kubernetes_tpu.ops import features as F
+from kubernetes_tpu.ops.preempt import preempt_sweep_jit
+from kubernetes_tpu.utils.interner import NONE
+
+MI = 1024 * 1024
+
+# default_preemption.go:40-44 (DefaultPreemptionArgs defaults)
+MIN_CANDIDATE_NODES_PERCENTAGE = 10
+MIN_CANDIDATE_NODES_ABSOLUTE = 100
+
+
+@dataclass
+class Candidate:
+    """One preemption candidate (candidate.go): a node + its victims."""
+
+    node_name: str
+    row: int
+    victims: list[Pod]
+    pdb_violations: int
+
+
+class Evaluator:
+    """Generic preemption evaluator over the device mirror."""
+
+    def __init__(self, hub, get_mirror, get_caps, get_enabled_filters,
+                 nominator, rng: random.Random | None = None):
+        self.hub = hub
+        # callables: the scheduler re-buckets the mirror/caps, and the
+        # framework (which owns the filter config) is built after us
+        self._get_mirror = get_mirror
+        self._get_caps = get_caps
+        self._get_enabled_filters = get_enabled_filters
+        self.nominator = nominator
+        self._rng = rng or random.Random(0)
+
+    # ---------------- eligibility (default_preemption.go:327) -------------
+
+    def pod_eligible_to_preempt_others(self, pod: Pod) -> tuple[bool, str]:
+        if pod.spec.preemption_policy == "Never":
+            return False, "preemptionPolicy=Never"
+        nom = pod.status.nominated_node_name
+        if nom:
+            # if the nominated node has a terminating lower-priority pod, the
+            # previous preemption is still in flight: wait for it
+            mirror = self._get_mirror()
+            row = mirror.row_of(nom)
+            if row >= 0:
+                snap_pods = self._pods_on_node(nom)
+                for p in snap_pods:
+                    if (p.metadata.deletion_timestamp is not None
+                            and p.priority() < pod.priority()):
+                        return False, "previous victims still terminating"
+        return True, ""
+
+    # ---------------- candidate discovery ----------------
+
+    def _pods_on_node(self, node_name: str) -> list[Pod]:
+        info = self.cache_snapshot.get(node_name)
+        return [pi.pod for pi in info.pods] if info is not None else []
+
+    def find_candidates(self, pod: Pod, snapshot) -> list[Candidate]:
+        """Device sweep + host assembly of (node, victims) candidates."""
+        self.cache_snapshot = snapshot.node_info_map
+        mirror = self._get_mirror()
+        caps = self._get_caps()
+        prio = pod.priority()
+
+        # per-node victims ascending by importance (evict least-important
+        # first): priority asc, then start time desc (younger first)
+        victims_by_row: dict[int, list] = {}
+        k_max = 0
+        for info in snapshot.node_info_list:
+            row = mirror.row_of(info.name)
+            if row < 0:
+                continue
+            vs = [pi for pi in info.pods if pi.pod.priority() < prio]
+            vs.sort(key=lambda pi: (pi.pod.priority(),
+                                    -pi.pod.metadata.creation_timestamp))
+            victims_by_row[row] = vs
+            k_max = max(k_max, len(vs))
+        if k_max == 0:
+            return []
+        k_cap = 1
+        while k_cap < k_max:
+            k_cap *= 2
+
+        # cumulative freed request per victim prefix
+        n = caps.nodes
+        r = caps.res_cols
+        cumsum = np.zeros((n, k_cap + 1, r), np.float32)
+        for row, vs in victims_by_row.items():
+            acc = np.zeros((r,), np.float32)
+            for k, pi in enumerate(vs):
+                acc = acc + mirror._res_row(pi.request)
+                acc[F.COL_PODS] = k + 1.0
+                cumsum[row, k + 1] = acc
+            if len(vs) < k_cap:
+                cumsum[row, len(vs) + 1:] = acc  # padding: no extra victims
+
+        pblobs = mirror.pack_batch_blobs([pod], 1)
+        cblobs = mirror.to_blobs()
+        kmin = np.asarray(preempt_sweep_jit(
+            cblobs, pblobs, mirror.well_known(), cumsum, caps,
+            self._get_enabled_filters()))
+
+        rows = [row for row, vs in victims_by_row.items()
+                if kmin[row] != NONE and 1 <= kmin[row] <= len(vs)]
+        if not rows:
+            return []
+
+        # candidate subset: random offset + bounded count (preemption.go:307
+        # GetOffsetAndNumCandidates)
+        num_nodes = len(snapshot.node_info_list)
+        want = max(num_nodes * MIN_CANDIDATE_NODES_PERCENTAGE // 100,
+                   MIN_CANDIDATE_NODES_ABSOLUTE)
+        rows.sort()
+        off = self._rng.randrange(len(rows))
+        picked = [rows[(off + i) % len(rows)]
+                  for i in range(min(want, len(rows)))]
+
+        pdbs = self.hub.list_pdbs()
+        out = []
+        for row in picked:
+            vs = victims_by_row[row][: int(kmin[row])]
+            victims = [pi.pod for pi in vs]
+            out.append(Candidate(
+                node_name=mirror.name_of_row(row) or "",
+                row=row, victims=victims,
+                pdb_violations=self._pdb_violations(victims, pdbs)))
+        return out
+
+    @staticmethod
+    def _pdb_violations(victims: list[Pod], pdbs) -> int:
+        """How many VICTIMS violate some PDB's disruptionsAllowed — each pod
+        counts at most once even if it matches several exhausted PDBs
+        (preemption.go filterPodsWithPDBViolation classifies per pod); every
+        eviction still draws down each matching PDB's budget."""
+        budget = {pdb.metadata.uid: pdb.disruptions_allowed for pdb in pdbs}
+        violations = 0
+        for v in victims:
+            matched = [pdb for pdb in pdbs
+                       if pdb.metadata.namespace == v.metadata.namespace
+                       and pdb.selector is not None
+                       and label_selector_matches(pdb.selector,
+                                                  v.metadata.labels)]
+            if any(budget[pdb.metadata.uid] <= 0 for pdb in matched):
+                violations += 1
+            for pdb in matched:
+                budget[pdb.metadata.uid] -= 1
+        return violations
+
+    # ---------------- selection (preemption.go:565 pickOneNode) -----------
+
+    @staticmethod
+    def select_candidate(candidates: list[Candidate]) -> Candidate | None:
+        if not candidates:
+            return None
+
+        def key(c: Candidate):
+            prios = [v.priority() for v in c.victims]
+            high = max(prios) if prios else -(2 ** 31)
+            # latest start of the highest-priority victim: prefer evicting
+            # the youngest important pod
+            starts = [v.metadata.creation_timestamp for v in c.victims
+                      if v.priority() == high]
+            latest = max(starts) if starts else 0.0
+            return (c.pdb_violations, high, sum(prios), len(c.victims),
+                    -latest, c.node_name)
+
+        return min(candidates, key=key)
+
+    # ---------------- execution (preemption.go:428 prepareCandidate) ------
+
+    def prepare_candidate(self, candidate: Candidate, pod: Pod) -> None:
+        for victim in candidate.victims:
+            try:
+                self.hub.delete_pod(victim.metadata.uid)
+            except Exception:  # noqa: BLE001 — already gone is fine
+                pass
+        # lower-priority nominees on this node must re-evaluate: drop the
+        # nomination AND clear the API status (the stale nominatedNodeName
+        # would otherwise keep feeding the pipeline's own-reservation
+        # add-back); the status update event re-activates them
+        dropped = self.nominator.clear_for_node_below_priority(
+            candidate.node_name, pod.priority())
+        for nominee in dropped:
+            self.hub.clear_nominated_node(nominee.metadata.uid)
+
+    # ---------------- the whole PostFilter flow ----------------
+
+    def preempt(self, pod: Pod, snapshot) -> tuple[str | None, Status]:
+        self.cache_snapshot = snapshot.node_info_map
+        ok, why = self.pod_eligible_to_preempt_others(pod)
+        if not ok:
+            return None, Status.unschedulable(
+                f"not eligible for preemption: {why}",
+                plugin="DefaultPreemption")
+        candidates = self.find_candidates(pod, snapshot)
+        best = self.select_candidate(candidates)
+        if best is None:
+            return None, Status.unschedulable(
+                "no preemption candidates", plugin="DefaultPreemption")
+        self.prepare_candidate(best, pod)
+        self.nominator.add(pod, best.node_name)
+        return best.node_name, Status()
+
+
+class DefaultPreemption(PostFilterPlugin):
+    """PostFilter plugin wrapper (default_preemption.go:133)."""
+
+    NAME = "DefaultPreemption"
+
+    def __init__(self, evaluator: Evaluator):
+        self.evaluator = evaluator
+
+    def name(self) -> str:
+        return self.NAME
+
+    def post_filter(self, state, pod: Pod, diagnosis
+                    ) -> tuple[str | None, Status]:
+        snapshot = diagnosis.get("snapshot") if diagnosis else None
+        if snapshot is None:
+            return None, Status.unschedulable("no snapshot in diagnosis",
+                                              plugin=self.NAME)
+        return self.evaluator.preempt(pod, snapshot)

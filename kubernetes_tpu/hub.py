@@ -23,6 +23,7 @@ from kubernetes_tpu.api.objects import (
     Node,
     Pod,
     PodCondition,
+    PodDisruptionBudget,
     PriorityClass,
 )
 
@@ -59,6 +60,7 @@ class Hub:
         self._pods = _Store("Pod")
         self._priority_classes = _Store("PriorityClass")
         self._namespaces = _Store("Namespace")
+        self._pdbs = _Store("PodDisruptionBudget")
 
     # ------------- watch registration -------------
 
@@ -179,6 +181,17 @@ class Hub:
                 new.status.nominated_node_name = nominated_node
             self._update(self._pods, new)
 
+    def clear_nominated_node(self, uid: str) -> None:
+        """Clear status.nominatedNodeName (preemption.go prepareCandidate
+        clears lower nominations via API so they re-evaluate)."""
+        with self._lock:
+            stored = self._pods.objects.get(uid)
+            if stored is None or not stored.status.nominated_node_name:
+                return
+            new = stored.clone()
+            new.status.nominated_node_name = ""
+            self._update(self._pods, new)
+
     # ------------- namespaces -------------
 
     def watch_namespaces(self, h: EventHandlers, replay: bool = True) -> None:
@@ -200,6 +213,21 @@ class Hub:
     def list_namespaces(self) -> list[Namespace]:
         with self._lock:
             return list(self._namespaces.objects.values())
+
+    # ------------- pod disruption budgets -------------
+
+    def create_pdb(self, pdb: PodDisruptionBudget) -> None:
+        self._create(self._pdbs, pdb)
+
+    def update_pdb(self, pdb: PodDisruptionBudget) -> None:
+        self._update(self._pdbs, pdb)
+
+    def delete_pdb(self, uid: str) -> None:
+        self._delete(self._pdbs, uid)
+
+    def list_pdbs(self) -> list[PodDisruptionBudget]:
+        with self._lock:
+            return list(self._pdbs.objects.values())
 
     # ------------- priority classes -------------
 

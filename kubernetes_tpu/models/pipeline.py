@@ -356,7 +356,13 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
             ign_b = ~ones
             soft_b = jnp.bool_(False)
         if fit_on:
-            fit_ok = jnp.all(req[None] <= free, axis=-1)        # [N]
+            # nominated preemptors reserve their requests on their nominated
+            # node (framework.go:989 AddPod pass); a pod's OWN nomination is
+            # handed back so it can claim the room its victims vacated
+            own = (jnp.arange(free.shape[0]) == pods.nominated_row[b])
+            eff = free - ct.nominated_req + jnp.where(own[:, None], req[None],
+                                                      0.0)
+            fit_ok = jnp.all(req[None] <= eff, axis=-1)         # [N]
         else:
             fit_ok = jnp.ones(free.shape[0], bool)
         # nodes holding an earlier batch commit that clashes on hostPort

@@ -139,6 +139,10 @@ class ClusterTensors:
     allocatable: jax.Array       # [N, R]
     free: jax.Array              # [N, R]
     nonzero_requested: jax.Array  # [N, 2] cpu/mem with 100m/200Mi defaults
+    # resources reserved by nominated (preemptor) pods awaiting their victims
+    # to exit — the fit check subtracts this (the device analog of
+    # RunFilterPluginsWithNominatedPods' AddPod pass, runtime/framework.go:989)
+    nominated_req: jax.Array     # [N, R]
     # validity + flags
     node_valid: jax.Array        # [N] bool
     unschedulable: jax.Array     # [N] bool
@@ -180,6 +184,12 @@ class ClusterTensors:
     pod_valid: jax.Array         # [PT] bool
     pod_node: jax.Array          # [PT] i32 node row index
     pod_ns: jax.Array            # [PT] i32 namespace id
+    pod_uid: jax.Array           # [PT] i32 interned pod uid (self-exclusion:
+                                 # a pod never matches its own table entry)
+    pod_nominated: jax.Array     # [PT] bool: nominated-not-yet-bound pod —
+                                 # counts for anti-affinity, excluded from
+                                 # required-affinity presence and scoring
+                                 # (the dual-pass rule of framework.go:989)
     pt_label_vals: jax.Array     # [PT, Kp] i32 label value per pod-label column
     # REQUIRED anti-affinity terms (satisfyExistingPodsAntiAffinity)
     pod_anti_tk: jax.Array       # [PT, A] i32 topo-key index (-1 = unused term)
@@ -219,6 +229,7 @@ def node_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
         "allocatable": ((r,), "f32"),
         "free": ((r,), "f32"),
         "nonzero_requested": ((2,), "f32"),
+        "nominated_req": ((r,), "f32"),
         "label_col_nums": ((caps.label_cols,), "f32"),
         "image_sizes": ((caps.node_images,), "f32"),
         "node_valid": ((), "bool"),
@@ -243,6 +254,8 @@ def pod_table_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]
         "pod_valid": ((), "bool"),
         "pod_node": ((), "i32"),
         "pod_ns": ((), "i32"),
+        "pod_uid": ((), "i32"),
+        "pod_nominated": ((), "bool"),
         "pt_label_vals": ((caps.pod_label_cols,), "i32"),
     }
     for g in ("anti", "aff", "paff", "panti"):
@@ -274,6 +287,8 @@ def pod_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
         "priority": ((), "i32"),
         "ns": ((), "i32"),
         "name_id": ((), "i32"),
+        "uid_id": ((), "i32"),
+        "nominated_row": ((), "i32"),
         "plabel_vals": ((caps.pod_label_cols,), "i32"),
         "nodesel_cols": ((PL,), "i32"),
         "nodesel_vals": ((PL,), "i32"),
@@ -333,6 +348,11 @@ class PodFeatures:
     priority: jax.Array          # i32 scalar
     ns: jax.Array                # i32 scalar namespace id
     name_id: jax.Array           # i32 scalar (pod name, for debugging)
+    uid_id: jax.Array            # i32 scalar interned uid (self-exclusion
+                                 # vs the pod table, incl. own nomination)
+    nominated_row: jax.Array     # i32 scalar: node row this pod is nominated
+                                 # on (-1 none); its own reservation is added
+                                 # back to free on that row
     plabel_vals: jax.Array       # [Kp] i32 own labels over pod-label columns
     # spec.nodeSelector: exact (label-column, value) pairs, ANDed; a pair on a
     # key no node carries packs col=NONE (matches nothing). Unused slots have

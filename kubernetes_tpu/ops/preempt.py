@@ -1,0 +1,83 @@
+"""Preemption dry-run as a device sweep over victim-prefix removals.
+
+The reference dry-runs preemption per candidate node: remove all
+lower-priority pods, re-run filters, then reprieve victims highest-priority
+first (preemption/preemption.go:682 DryRunPreemption,
+defaultpreemption/default_preemption.go:219 SelectVictimsOnNode). The
+TPU-native formulation evaluates EVERY node's every victim-prefix in one
+launch: the host supplies, per node, the priority-ascending victims'
+cumulative freed-resource sums ``vic_cumsum [N, K+1, R]`` (k=0 means no
+eviction), and the kernel returns the minimal k per node that makes the pod
+fit alongside the commit-invariant static filters. Because victims are
+removed in ascending-importance order, the minimal resource-feasible prefix
+is exactly the reprieve loop's fixed point for resource-driven preemption.
+
+Topology effects of victim removal (an anti-affinity term owned by a victim)
+are not modeled in the sweep: the preemptor is re-scheduled through the full
+pipeline after its victims exit, so an over-optimistic candidate costs one
+extra cycle, never a wrong placement.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_tpu.models.pipeline import (
+    FILTER_PLUGINS,
+    NUM_FILTER_PLUGINS,
+    static_filters,
+)
+from kubernetes_tpu.ops.features import (
+    Capacities,
+    ClusterBlobs,
+    PodBlobs,
+    unpack_cluster,
+    unpack_pods,
+)
+from kubernetes_tpu.utils.interner import NONE
+
+
+def preempt_sweep(cblobs: ClusterBlobs, pblobs: PodBlobs,
+                  wk: dict[str, jnp.ndarray], vic_cumsum: jnp.ndarray,
+                  caps: Capacities,
+                  enabled_filters: tuple[bool, ...] | None = None
+                  ) -> jnp.ndarray:
+    """[N] i32: minimal victim count k (1..K) making the pod fit on each
+    node; NONE where preemption cannot help (static filter fails, request
+    exceeds allocatable, or even evicting every victim is not enough).
+
+    pblobs carries ONE pod (batch axis 1); vic_cumsum [N, K+1, R] f32 is the
+    cumulative freed request of the first k victims (k=0 row is zero)."""
+    if enabled_filters is None:
+        enabled_filters = (True,) * NUM_FILTER_PLUGINS
+    ct = unpack_cluster(cblobs, caps)
+    pod = jax.tree_util.tree_map(lambda x: x[0], unpack_pods(pblobs, caps))
+
+    masks = static_filters(ct, pod, wk, enabled_filters)       # [5, N]
+    static_ok = jnp.all(masks, axis=0) & ct.node_valid
+    unresolvable = jnp.any(pod.req[None] > ct.allocatable, axis=-1)
+
+    # fit after evicting the first k victims, against the same effective
+    # free as the pipeline's fit check (nominated reservations subtracted,
+    # the pod's own nomination handed back): [N, K+1]
+    own = (jnp.arange(ct.free.shape[0]) == pod.nominated_row)
+    base = (ct.free - ct.nominated_req
+            + jnp.where(own[:, None], pod.req[None], 0.0))
+    eff = base[:, None, :] + vic_cumsum
+    fit = jnp.all(pod.req[None, None] <= eff, axis=-1)
+    # minimal k with a fit (k=0 would mean it already fits — the caller only
+    # sweeps pods the pipeline rejected, but guard anyway)
+    kmin = jnp.argmax(fit, axis=1).astype(jnp.int32)           # first True
+    any_fit = jnp.any(fit, axis=1)
+    ok = static_ok & ~unresolvable & any_fit
+    return jnp.where(ok, kmin, jnp.int32(NONE))
+
+
+@partial(jax.jit, static_argnames=("caps", "enabled_filters"))
+def preempt_sweep_jit(cblobs, pblobs, wk, vic_cumsum, caps,
+                      enabled_filters=None):
+    return preempt_sweep(cblobs, pblobs, wk, vic_cumsum, caps,
+                         enabled_filters)

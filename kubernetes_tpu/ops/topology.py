@@ -81,20 +81,35 @@ def sel_match(ops: jnp.ndarray, vals: jnp.ndarray,
     return jnp.all(m | (ops == NONE), axis=-1)
 
 
-def incoming_terms_vs_table(ct: ClusterTensors, tk: jnp.ndarray,
+def table_mask(ct: ClusterTensors, pod: PodFeatures,
+               include_nominated: bool) -> jnp.ndarray:
+    """[PT]: which table pods count for this incoming pod. Always excludes
+    the pod's own entry (incl. its own nomination); nominated pods count
+    only for anti-affinity constraints, not for required-affinity presence,
+    scoring, or spread counts (the dual-pass rule of
+    RunFilterPluginsWithNominatedPods, runtime/framework.go:989)."""
+    m = ct.pod_valid & (ct.pod_uid != pod.uid_id)
+    if not include_nominated:
+        m = m & ~ct.pod_nominated
+    return m
+
+
+def incoming_terms_vs_table(ct: ClusterTensors, tbl_ok: jnp.ndarray,
+                            tk: jnp.ndarray,
                             ns: jnp.ndarray, ns_all: jnp.ndarray,
                             sel_cols: jnp.ndarray, sel_ops: jnp.ndarray,
                             sel_vals: jnp.ndarray) -> jnp.ndarray:
     """[PT, A]: does table pod s satisfy the incoming pod's term a?
     (AffinityTerm.Matches: s.ns in term.namespaces (or all-ns) and the
-    selector expressions match s's labels)"""
+    selector expressions match s's labels). tbl_ok: [PT] from table_mask."""
     ns_ok = C.isin(ct.pod_ns[:, None], ns[None]) | ns_all[None]  # [PT, A]
     tv = take_cols(ct.pt_label_vals, sel_cols, NONE)           # [PT, A, MS]
     sel_ok = sel_match(sel_ops[None], sel_vals[None], tv)      # [PT, A]
-    return ns_ok & sel_ok & ct.pod_valid[:, None] & (tk[None] != NONE)
+    return ns_ok & sel_ok & tbl_ok[:, None] & (tk[None] != NONE)
 
 
-def table_terms_vs_incoming(ct: ClusterTensors, grp_tk: jnp.ndarray,
+def table_terms_vs_incoming(ct: ClusterTensors, tbl_ok: jnp.ndarray,
+                            grp_tk: jnp.ndarray,
                             grp_ns: jnp.ndarray, grp_ns_all: jnp.ndarray,
                             grp_cols: jnp.ndarray, grp_ops: jnp.ndarray,
                             grp_vals: jnp.ndarray,
@@ -106,7 +121,7 @@ def table_terms_vs_incoming(ct: ClusterTensors, grp_tk: jnp.ndarray,
     pv = pod.plabel_vals[jnp.clip(grp_cols, 0, kp - 1)]        # [PT, A, MS]
     pv = jnp.where(grp_cols >= 0, pv, NONE)
     sel_ok = sel_match(grp_ops, grp_vals, pv)                  # [PT, A]
-    return ns_ok & sel_ok & (grp_tk != NONE) & ct.pod_valid[:, None]
+    return ns_ok & sel_ok & (grp_tk != NONE) & tbl_ok[:, None]
 
 
 def scatter_or(tk2d: jnp.ndarray, dom2d: jnp.ndarray, hit2d: jnp.ndarray,
@@ -257,9 +272,12 @@ def inter_pod_affinity_static(ct: ClusterTensors, pod: PodFeatures,
     in-batch deltas on top (step_terms_forbid/step_own_terms_forbid/
     step_affinity_ok)."""
     tk_cap = ct.topo_dom.shape[1]
+    anti_ok_tbl = table_mask(ct, pod, include_nominated=True)
+    pres_tbl = table_mask(ct, pod, include_nominated=False)
 
     # 1. existing pods' required anti-affinity vs incoming pod
-    m1 = table_terms_vs_incoming(ct, ct.pod_anti_tk, ct.pod_anti_ns,
+    m1 = table_terms_vs_incoming(ct, anti_ok_tbl, ct.pod_anti_tk,
+                                 ct.pod_anti_ns,
                                  ct.pod_anti_ns_all, ct.pod_anti_sel_cols,
                                  ct.pod_anti_sel_ops, ct.pod_anti_sel_vals,
                                  pod)                              # [PT, A]
@@ -270,7 +288,7 @@ def inter_pod_affinity_static(ct: ClusterTensors, pod: PodFeatures,
     fail1 = jnp.any(gather_rows(f1, ct.topo_dom), axis=1)    # [N]
 
     # 2. incoming pod's required anti-affinity vs existing pods
-    m2 = incoming_terms_vs_table(ct, pod.anti_tk, pod.anti_ns,
+    m2 = incoming_terms_vs_table(ct, anti_ok_tbl, pod.anti_tk, pod.anti_ns,
                                  pod.anti_ns_all, pod.anti_sel_cols,
                                  pod.anti_sel_ops, pod.anti_sel_vals)
     dom2 = tds[:, jnp.clip(pod.anti_tk, 0, tk_cap - 1)]            # [PT, A]
@@ -282,7 +300,7 @@ def inter_pod_affinity_static(ct: ClusterTensors, pod: PodFeatures,
     # 3. incoming pod's required affinity: every term needs a matching pod
     #    in the node's domain (node must carry every term's topology label)
     a_cap = pod.aff_tk.shape[0]
-    m3 = incoming_terms_vs_table(ct, pod.aff_tk, pod.aff_ns,
+    m3 = incoming_terms_vs_table(ct, pres_tbl, pod.aff_tk, pod.aff_ns,
                                  pod.aff_ns_all, pod.aff_sel_cols,
                                  pod.aff_sel_ops, pod.aff_sel_vals)
     dom3 = tds[:, jnp.clip(pod.aff_tk, 0, tk_cap - 1)]             # [PT, A]
@@ -301,9 +319,11 @@ def inter_pod_affinity_score(ct: ClusterTensors, pod: PodFeatures,
     aggregation (NormalizeScore :258)."""
     tk_cap = ct.topo_dom.shape[1]
     score = jnp.zeros((tk_cap * d_cap,), jnp.float32)
+    tbl_ok = table_mask(ct, pod, include_nominated=False)
 
     def add_incoming(score, tk, ns, ns_all, cols, ops, vals, w, sign):
-        m = incoming_terms_vs_table(ct, tk, ns, ns_all, cols, ops, vals)
+        m = incoming_terms_vs_table(ct, tbl_ok, tk, ns, ns_all, cols, ops,
+                                    vals)
         dom = tds[:, jnp.clip(tk, 0, tk_cap - 1)]
         ok = m & (dom != NONE) & (tk[None] != NONE)
         flat = jnp.clip(tk[None], 0) * d_cap + jnp.clip(dom, 0)
@@ -311,7 +331,8 @@ def inter_pod_affinity_score(ct: ClusterTensors, pod: PodFeatures,
         return score.at[flat.reshape(-1)].add(upd.reshape(-1))
 
     def add_table(score, tk, ns, ns_all, cols, ops, vals, w, sign):
-        m = table_terms_vs_incoming(ct, tk, ns, ns_all, cols, ops, vals, pod)
+        m = table_terms_vs_incoming(ct, tbl_ok, tk, ns, ns_all, cols, ops,
+                                    vals, pod)
         dom = jnp.take_along_axis(tds, jnp.clip(tk, 0, tk_cap - 1), axis=1)
         ok = m & (dom != NONE) & (tk != NONE)
         flat = jnp.clip(tk, 0) * d_cap + jnp.clip(dom, 0)
@@ -353,11 +374,14 @@ def _tsc_self_match(pod: PodFeatures) -> jnp.ndarray:
 
 
 def _tsc_matches(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
-    """[PT, C]: table pod s matches constraint c's selector in pod's ns."""
+    """[PT, C]: table pod s matches constraint c's selector in pod's ns.
+    Nominated pods and the pod's own entry are excluded from spread counts
+    (shouldn't double-count itself; nominated pods may never run)."""
     ns_ok = ct.pod_ns[:, None] == pod.ns                           # [PT, 1]
     tv = take_cols(ct.pt_label_vals, pod.tsc_sel_cols, NONE)       # [PT, C, MS]
     sel_ok = sel_match(pod.tsc_sel_ops[None], pod.tsc_sel_vals[None], tv)
-    return sel_ok & ns_ok & ct.pod_valid[:, None] & (pod.tsc_tk[None] != NONE)
+    tbl = table_mask(ct, pod, include_nominated=False)
+    return sel_ok & ns_ok & tbl[:, None] & (pod.tsc_tk[None] != NONE)
 
 
 def spread_eligible(ct: ClusterTensors, pod: PodFeatures,

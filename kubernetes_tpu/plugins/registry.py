@@ -104,6 +104,17 @@ class DefaultBinder(BindPlugin):
         return Status()
 
 
+def _default_preemption_factory(args: dict):
+    """Binds the PostFilter to the scheduler's Evaluator (injected via
+    extra_args); absent outside a full scheduler (kernel tests)."""
+    ev = args.get("preemption_evaluator")
+    if ev is None:
+        return None
+    from kubernetes_tpu.framework.preemption import DefaultPreemption
+
+    return DefaultPreemption(ev)
+
+
 def in_tree_registry() -> dict[str, PluginDescriptor]:
     """name -> descriptor for every in-tree plugin (registry.go:48)."""
     pod_del = _ev(R.ASSIGNED_POD, A.DELETE | A.UPDATE_POD_SCALE_DOWN)
@@ -159,6 +170,7 @@ def in_tree_registry() -> dict[str, PluginDescriptor]:
             events=[_ev(R.NODE, A.ADD | A.UPDATE_NODE_LABEL)]),
         PluginDescriptor(
             name="DefaultPreemption", points=("post_filter",),
+            factory=_default_preemption_factory,
             events=[_ev(R.ASSIGNED_POD, A.DELETE)]),
         PluginDescriptor(
             name="DefaultBinder", points=("bind",),

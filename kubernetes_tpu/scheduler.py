@@ -26,8 +26,10 @@ from kubernetes_tpu.backend.mirror import (
     Mirror,
     UnsupportedFeatureError,
 )
+from kubernetes_tpu.backend.nominator import Nominator
 from kubernetes_tpu.backend.queue import PriorityQueue, QueuedPodInfo
 from kubernetes_tpu.backend.snapshot import Snapshot
+from kubernetes_tpu.framework.preemption import Evaluator
 from kubernetes_tpu.config.types import (
     SchedulerConfiguration,
     default_config,
@@ -73,14 +75,19 @@ class Scheduler:
         self.config = config or default_config()
         self.now = now
         profile = self.config.profiles[0]
-        self.framework = Framework(profile,
-                                   extra_args={"binder": hub.bind})
         self.cache = Cache(now=now)
         self.snapshot = Snapshot()
         self.caps = caps or Capacities(
             nodes=self.config.node_capacity,
             pods=self.config.pod_table_capacity)
         self.mirror = Mirror(caps=self.caps)
+        self.nominator = Nominator()
+        self.preemption = Evaluator(
+            hub, lambda: self.mirror, lambda: self.caps,
+            lambda: self._enabled_filters, self.nominator)
+        self.framework = Framework(profile, extra_args={
+            "binder": hub.bind,
+            "preemption_evaluator": self.preemption})
         self.queue = PriorityQueue(
             less_fn=self.framework.queue_sort_less,
             pre_enqueue=self.framework.run_pre_enqueue_plugins,
@@ -138,10 +145,15 @@ class Scheduler:
             self.queue.move_all_to_active_or_backoff(
                 ClusterEvent(R.ASSIGNED_POD, A.ADD), None, pod)
         elif not self._terminal(pod):
+            # restart/replay: re-seed nominations from status so reservations
+            # survive a scheduler restart (stateless-by-design, SURVEY §5.4)
+            if pod.status.nominated_node_name:
+                self.nominator.add(pod, pod.status.nominated_node_name)
             self.queue.add(pod)
 
     def _on_pod_update(self, old: Pod, new: Pod) -> None:
         if new.spec.node_name:
+            self.nominator.delete(new.metadata.uid)
             if old.spec.node_name:
                 self.cache.update_pod(old, new)
                 action = (A.UPDATE_POD_LABEL
@@ -156,9 +168,11 @@ class Scheduler:
                 self.queue.move_all_to_active_or_backoff(
                     ClusterEvent(R.ASSIGNED_POD, A.ADD), old, new)
         elif not self._terminal(new):
+            self.nominator.update(new)
             self.queue.update(old, new)
 
     def _on_pod_delete(self, pod: Pod) -> None:
+        self.nominator.delete(pod.metadata.uid)
         if pod.spec.node_name:
             self.cache.remove_pod(pod)
             self.queue.move_all_to_active_or_backoff(
@@ -215,6 +229,7 @@ class Scheduler:
         for attempt in range(16):  # one capacity field may grow per attempt
             try:
                 self.mirror.sync(self.snapshot)
+                self.mirror.set_nominated(self.nominator.by_node())
                 cblobs, pblobs, topo, d_cap = self.mirror.prepare_launch(
                     [qp.pod for qp in runnable], self.config.batch_size)
                 break
@@ -284,24 +299,42 @@ class Scheduler:
             undo(f"bind: {s.message()}")
             return
         self.cache.finish_binding(assumed)
+        self.nominator.delete(qp.uid)
         self.queue.done(qp.uid)
         fw.run_post_bind_plugins(state, pod, node_name)
         qp.consecutive_errors_count = 0
         self.stats["scheduled"] += 1
 
     def _fail(self, qp: QueuedPodInfo, reject_counts: list[int]) -> None:
-        """handleSchedulingFailure (schedule_one.go:1015): record the
-        rejecting plugins for queueing hints, patch the PodScheduled
-        condition, park in unschedulable."""
+        """handleSchedulingFailure (schedule_one.go:1015): run PostFilter
+        (preemption) first, record the rejecting plugins for queueing hints,
+        patch the PodScheduled condition (+ NominatedNodeName), park in
+        unschedulable."""
         plugins = {FILTER_PLUGINS[i] for i, c in enumerate(reject_counts)
                    if c > 0}
         qp.unschedulable_plugins = plugins or {"NodeResourcesFit"}
         qp.unschedulable_count += 1
         qp.consecutive_errors_count = 0
         self.stats["unschedulable"] += 1
+        nominated = None
+        if self.framework.points["post_filter"]:
+            state = CycleState()
+            nominated, _s = self.framework.run_post_filter_plugins(
+                state, qp.pod, {"snapshot": self.snapshot,
+                                "reject_counts": reject_counts})
+            if nominated:
+                self.stats["preemptions"] = self.stats.get("preemptions",
+                                                           0) + 1
         self.hub.patch_pod_condition(qp.pod, PodCondition(
             type="PodScheduled", status="False", reason="Unschedulable",
-            message=f"rejected by {sorted(plugins)}"))
+            message=f"rejected by {sorted(plugins)}"),
+            nominated_node=nominated)
+        # the patch fired while this pod was in-flight (the queue ignores
+        # updates for in-flight pods), so park the FRESH object — the packed
+        # nominated_row must see status.nominatedNodeName next attempt
+        stored = self.hub.get_pod(qp.uid)
+        if stored is not None:
+            qp.pod = stored
         self.queue.add_unschedulable_if_not_present(qp)
 
     def _error(self, qp: QueuedPodInfo, msg: str) -> None:

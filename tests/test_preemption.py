@@ -1,0 +1,202 @@
+"""Preemption end-to-end: Evaluator + DefaultPreemption PostFilter +
+nominator + device victim sweep.
+
+Mirrors the reference's preemption integration tests
+(test/integration/scheduler/preemption) against the in-process hub:
+high-priority pods evict lower-priority victims, get a NominatedNodeName,
+and bind once the victims vacate."""
+
+from kubernetes_tpu.api.objects import (
+    Container,
+    LABEL_HOSTNAME,
+    LABEL_ZONE,
+    LabelSelector,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodDisruptionBudget,
+    PodSpec,
+    ResourceRequirements,
+)
+from kubernetes_tpu.config.types import default_config
+from kubernetes_tpu.hub import Hub
+from kubernetes_tpu.ops.features import Capacities
+from kubernetes_tpu.scheduler import Scheduler
+
+
+class Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def now(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+def mknode(i, cpu="4"):
+    name = f"node-{i}"
+    return Node(metadata=ObjectMeta(name=name, labels={
+        LABEL_HOSTNAME: name, LABEL_ZONE: "z1"}),
+        status=NodeStatus(allocatable={"cpu": cpu, "memory": "32Gi",
+                                       "pods": "110"}))
+
+
+def mkpod(name, cpu="500m", priority=0, labels=None, policy=None):
+    spec = PodSpec(
+        containers=[Container(name="c", resources=ResourceRequirements(
+            requests={"cpu": cpu, "memory": "256Mi"}))],
+        priority=priority)
+    if policy:
+        spec.preemption_policy = policy
+    return Pod(metadata=ObjectMeta(name=name, labels=labels or {}), spec=spec)
+
+
+def mksched(hub, clock=None, batch=16):
+    cfg = default_config()
+    cfg.batch_size = batch
+    clock = clock or Clock()
+    return Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64),
+                     now=clock.now), clock
+
+
+def drain(sched, clock, rounds=6):
+    for _ in range(rounds):
+        sched.run_until_idle()
+        clock.tick(3.0)
+        sched.queue.flush_backoff_completed()
+    sched.run_until_idle()
+
+
+def bound_node(hub, pod):
+    p = hub.get_pod(pod.metadata.uid)
+    return p.spec.node_name if p else None
+
+
+def test_basic_preemption_evicts_and_binds():
+    """Cluster full of low-priority pods; a high-priority pod evicts enough
+    victims on one node and binds there."""
+    hub = Hub()
+    sched, clock = mksched(hub)
+    for i in range(2):
+        hub.create_node(mknode(i, cpu="2"))
+    low = [mkpod(f"low-{i}", cpu="1", priority=0) for i in range(4)]
+    for p in low:
+        hub.create_pod(p)
+    drain(sched, clock)
+    assert sched.stats["scheduled"] == 4  # both nodes full
+
+    high = mkpod("high", cpu="1500m", priority=100)
+    hub.create_pod(high)
+    drain(sched, clock)
+    assert bound_node(hub, high) in ("node-0", "node-1")
+    assert sched.stats["preemptions"] == 1
+    # exactly 2 victims evicted on the chosen node (each frees 1 cpu)
+    gone = [p for p in low if hub.get_pod(p.metadata.uid) is None]
+    assert len(gone) == 2
+    assert {bound_node(hub, p) for p in low if hub.get_pod(p.metadata.uid)} \
+        != {None}
+
+
+def test_no_preemption_of_equal_or_higher_priority():
+    hub = Hub()
+    sched, clock = mksched(hub)
+    hub.create_node(mknode(0, cpu="2"))
+    incumbent = mkpod("incumbent", cpu="2", priority=100)
+    hub.create_pod(incumbent)
+    drain(sched, clock)
+    assert bound_node(hub, incumbent) == "node-0"
+
+    challenger = mkpod("challenger", cpu="1", priority=100)
+    hub.create_pod(challenger)
+    drain(sched, clock)
+    assert hub.get_pod(incumbent.metadata.uid) is not None
+    assert bound_node(hub, challenger) == ""
+
+
+def test_preemption_policy_never():
+    hub = Hub()
+    sched, clock = mksched(hub)
+    hub.create_node(mknode(0, cpu="2"))
+    low = mkpod("low", cpu="2", priority=0)
+    hub.create_pod(low)
+    drain(sched, clock)
+
+    never = mkpod("never", cpu="1", priority=100, policy="Never")
+    hub.create_pod(never)
+    drain(sched, clock)
+    assert hub.get_pod(low.metadata.uid) is not None  # not evicted
+    assert bound_node(hub, never) == ""
+
+
+def test_minimal_victims_lowest_priority_first():
+    """Victims are the least-important prefix: evicting the single prio-1
+    pod suffices; the prio-5 pod survives."""
+    hub = Hub()
+    sched, clock = mksched(hub)
+    hub.create_node(mknode(0, cpu="2"))
+    p1 = mkpod("p1", cpu="1", priority=1)
+    p5 = mkpod("p5", cpu="1", priority=5)
+    hub.create_pod(p1)
+    hub.create_pod(p5)
+    drain(sched, clock)
+
+    high = mkpod("high", cpu="1", priority=100)
+    hub.create_pod(high)
+    drain(sched, clock)
+    assert bound_node(hub, high) == "node-0"
+    assert hub.get_pod(p1.metadata.uid) is None      # evicted
+    assert hub.get_pod(p5.metadata.uid) is not None  # reprieved
+
+
+def test_pdb_violations_steer_candidate_choice():
+    """Two viable nodes; victims on node-0 are PDB-protected with no
+    disruptions left -> node-1 is preferred (fewest PDB violations)."""
+    hub = Hub()
+    sched, clock = mksched(hub)
+    hub.create_node(mknode(0, cpu="2"))
+    hub.create_node(mknode(1, cpu="2"))
+    a = mkpod("a", cpu="2", priority=0, labels={"app": "guarded"})
+    b = mkpod("b", cpu="2", priority=0, labels={"app": "free"})
+    hub.create_pod(a)
+    hub.create_pod(b)
+    drain(sched, clock)
+    node_of_a = bound_node(hub, a)
+    hub.create_pdb(PodDisruptionBudget(
+        metadata=ObjectMeta(name="pdb"),
+        selector=LabelSelector(match_labels={"app": "guarded"}),
+        disruptions_allowed=0))
+
+    high = mkpod("high", cpu="1", priority=100)
+    hub.create_pod(high)
+    drain(sched, clock)
+    assert hub.get_pod(a.metadata.uid) is not None   # protected pod survives
+    assert hub.get_pod(b.metadata.uid) is None       # unprotected evicted
+    assert bound_node(hub, high) is not None
+    assert bound_node(hub, high) != node_of_a
+
+
+def test_nominated_reservation_not_stolen():
+    """After preemption the preemptor's NominatedNodeName reserves the
+    vacated room: a later lower-priority pod must not steal it."""
+    hub = Hub()
+    sched, clock = mksched(hub)
+    hub.create_node(mknode(0, cpu="2"))
+    low = mkpod("low", cpu="2", priority=0)
+    hub.create_pod(low)
+    drain(sched, clock)
+
+    high = mkpod("high", cpu="2", priority=100)
+    hub.create_pod(high)
+    # one batch: preempt + nominate, victim deleted, high parked
+    sched.run_until_idle()
+    nominated = hub.get_pod(high.metadata.uid).status.nominated_node_name
+    assert nominated == "node-0"
+    # an opportunist shows up before high re-schedules
+    opportunist = mkpod("opportunist", cpu="2", priority=0)
+    hub.create_pod(opportunist)
+    drain(sched, clock)
+    assert bound_node(hub, high) == "node-0"
+    assert bound_node(hub, opportunist) == ""
