@@ -143,11 +143,120 @@ def static_filters(ct: ClusterTensors, pod: PodFeatures,
                       for i, fn in enumerate(fns)])
 
 
+def tie_perturb(b, n: int) -> jnp.ndarray:
+    """[n] pseudo-random f32 in [0,1) keyed by (pod index b, node index):
+    the stateless device analog of selectHost's reservoir sampling
+    (schedule_one.go:865) — equal-score nodes pick uniformly instead of
+    hotspotting the lowest row. Cheap integer hash; fuses, no RNG state."""
+    x = jnp.arange(n, dtype=jnp.uint32) * jnp.uint32(2654435761)
+    x = x ^ (jnp.asarray(b).astype(jnp.uint32) * jnp.uint32(40503))
+    x = (x ^ (x >> 15)) * jnp.uint32(2246822519)
+    x = x ^ (x >> 13)
+    return (x >> 8).astype(jnp.float32) / jnp.float32(1 << 24)
+
+
+def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
+                   img, unres, weights):
+    """Parallel auction replacing the per-pod commit scan when the batch has
+    no topology constraints and no host ports: every round, all unplaced
+    pods score+argmax in parallel; per node, pods are accepted in BATCH
+    INDEX order while their cumulative requests fit (the as-if-serial
+    feasibility invariant — no node is ever overcommitted relative to the
+    serial order); losers re-score against the updated cluster next round.
+
+    Placement CHOICES may differ from the serial scan (a pod scores against
+    round-start state, not the exact post-predecessor state) but every
+    placement satisfies the same constraints the serial loop enforces. The
+    scan path remains the exact-parity mode for topology/port batches.
+
+    Wall-clock: O(rounds) of [B, N] work instead of B sequential steps —
+    rounds ≈ a few with random tie-breaking."""
+    B, N = static_ok.shape
+    alloc2 = SC.alloc_cpu_mem(ct)
+    own = jnp.arange(N)[None, :] == pods.nominated_row[:, None]    # [B, N]
+    perturb = jax.vmap(lambda b: tie_perturb(b, N))(jnp.arange(B))
+    idx_b = jnp.arange(B)
+
+    def fit_all(free):
+        eff = (free[None] - ct.nominated_req[None]
+               + jnp.where(own[..., None], pods.req[:, None, :], 0.0))
+        return jnp.all(pods.req[:, None, :] <= eff, axis=-1)       # [B, N]
+
+    def totals(nzr, feasible):
+        def per_pod(nzreq, t_raw, a_raw, im, feas):
+            frac = SC.utilization_fractions(alloc2, nzr, nzreq)
+            least = SC.least_allocated_from_fractions(frac)
+            bal = SC.balanced_allocation_from_fractions(frac)
+            taint = SC.normalize_inverse(t_raw, feas)
+            aff = SC.normalize_max(a_raw, feas)
+            return (weights.taint_toleration * taint
+                    + weights.node_affinity * aff
+                    + weights.resources_fit * least
+                    + weights.balanced_allocation * bal
+                    + weights.image_locality * im)
+        return jax.vmap(per_pod)(pods.nonzero_req, taint_raw, aff_raw, img,
+                                 feasible)
+
+    def cond(state):
+        _free, _nzr, _placed, _win, progress = state
+        return progress
+
+    def body(state):
+        free, nzr, placed, win, _ = state
+        fit = fit_all(free)
+        feasible = static_ok & fit & (placed < 0)[:, None]
+        total = totals(nzr, feasible)
+        choice = jax.vmap(C.masked_argmax_random)(total, feasible, perturb)
+        # per-node acceptance in batch-index order under cumulative fit
+        key = jnp.where(choice >= 0, choice, N) * (B + 1) + idx_b
+        order = jnp.argsort(key)
+        sc = choice[order]                                         # [B]
+        sreq = pods.req[order]                                     # [B, R]
+        pre = jnp.cumsum(sreq, axis=0) - sreq
+        first = jnp.concatenate([jnp.ones((1,), bool),
+                                 sc[1:] != sc[:-1]])
+        start = jax.lax.cummax(jnp.where(first, idx_b, -1))
+        seg_pre = pre - pre[start]                                 # [B, R]
+        scn = jnp.clip(sc, 0, N - 1)
+        own_s = own[order, scn]
+        base = (free[scn] - ct.nominated_req[scn]
+                + jnp.where(own_s[:, None], sreq, 0.0))
+        fits = jnp.all(sreq + seg_pre <= base, axis=-1) & (sc >= 0)
+        accept = jnp.zeros((B,), bool).at[order].set(fits)
+        rows_ = jnp.clip(choice, 0, N - 1)
+        free = free.at[rows_].add(
+            jnp.where(accept[:, None], -pods.req, 0.0))
+        nzr = nzr.at[rows_].add(
+            jnp.where(accept[:, None], pods.nonzero_req, 0.0))
+        placed = jnp.where(accept, choice, placed)
+        win = jnp.where(accept, total[idx_b, rows_], win)
+        return free, nzr, placed, win, jnp.any(fits)
+
+    init = (ct.free, ct.nonzero_requested, jnp.full((B,), -1, jnp.int32),
+            jnp.zeros((B,), jnp.float32), jnp.bool_(True))
+    free, nzr, placed, win, _ = jax.lax.while_loop(cond, body, init)
+
+    # diagnostics from the final state (unplaced pods' reject attribution)
+    fit = fit_all(free)
+    feas = jnp.sum(static_ok & fit, axis=1).astype(jnp.int32)
+    fit_rejects = jnp.sum(static_ok & ~fit, axis=1).astype(jnp.int32)
+    zeros = jnp.zeros((B,), jnp.int32)
+    ports_idx = FILTER_PLUGINS.index("NodePorts")
+    static_rejects = static_rejects.at[:, ports_idx].add(zeros)
+    reject_counts = jnp.concatenate(
+        [static_rejects, fit_rejects[:, None], zeros[:, None],
+         zeros[:, None]], axis=1)
+    return BatchResult(node_row=placed, score=win, feasible_count=feas,
+                       reject_counts=reject_counts,
+                       unresolvable_count=unres)
+
+
 def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                    wk: dict[str, jnp.ndarray], weights: ScoreWeights,
                    caps: Capacities, enable_topology: bool = True,
                    d_cap: int | None = None,
-                   enabled_filters: tuple[bool, ...] | None = None
+                   enabled_filters: tuple[bool, ...] | None = None,
+                   serial_scan: bool = True
                    ) -> BatchResult:
     """Schedule a whole pod batch in one launch, as-if-serial (see module
     docstring for the two-phase structure).
