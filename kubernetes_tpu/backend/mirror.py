@@ -53,6 +53,35 @@ MI = 1024 * 1024
 # NodeUnschedulable plugin simulates tolerating it (plugins/nodeunschedulable)
 TAINT_UNSCHEDULABLE = "node.kubernetes.io/unschedulable"
 
+# ---- PodFeatures field groups for subset transfers (pod_fields) ----
+# fields every launch reads (fit, tie-break, unschedulable-taint simulation,
+# NodeName, the commit scan/auction carries)
+POD_CORE_FIELDS = (
+    "valid", "req", "nonzero_req", "num_containers", "priority",
+    "ns", "name_id", "uid_id", "nominated_row", "node_name_id",
+    "tol_valid", "tol_key", "tol_op", "tol_val", "tol_effect",
+)
+# per active-feature additions (Mirror.launch_features)
+POD_FEATURE_FIELDS = {
+    "images": ("image_ids",),
+    "ports": ("hp_ip", "hp_proto", "hp_port"),
+    "nodeaffinity": (
+        "nodesel_cols", "nodesel_vals", "sel_term_valid", "sel_col",
+        "sel_op", "sel_is_field", "sel_vals", "sel_num", "pref_weight",
+        "pref_col", "pref_op", "pref_is_field", "pref_vals", "pref_num"),
+}
+# everything the topology kernels read (enable_topology launches)
+POD_TOPO_FIELDS = (
+    "plabel_vals", "aff_self_match",
+    "tsc_tk", "tsc_max_skew", "tsc_hard", "tsc_min_domains",
+    "tsc_sel_cols", "tsc_sel_ops", "tsc_sel_vals",
+    "tsc_honor_affinity", "tsc_honor_taints",
+) + tuple(
+    f"{g}_{suffix}"
+    for g in ("aff", "anti", "paff", "panti")
+    for suffix in ("tk", "ns", "ns_all", "sel_cols", "sel_ops", "sel_vals")
+) + ("paff_weight", "panti_weight")
+
 _unpack_cluster_jit = jax.jit(unpack_cluster, static_argnums=1)
 _unpack_pods_jit = jax.jit(unpack_pods, static_argnums=1)
 
@@ -63,6 +92,25 @@ def _scatter_rows(buf, idx, rows):
 
 # donate the resident buffer: the update happens in place on device
 _scatter_rows_jit = jax.jit(_scatter_rows, donate_argnums=(0,))
+
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class LaunchSpec:
+    """Everything one schedule_batch launch needs (Mirror.prepare_launch).
+    ``enable_topology``/``d_cap``/``active``/``pfields`` are the STATIC
+    launch args; ``ptmpl`` is the device-resident template backing the
+    subset pod blobs."""
+
+    cblobs: ClusterBlobs
+    pblobs: PodBlobs
+    enable_topology: bool
+    d_cap: int
+    active: tuple[str, ...]
+    pfields: tuple[str, ...]
+    ptmpl: PodBlobs
 
 
 class CapacityError(Exception):
@@ -123,7 +171,16 @@ class Mirror:
         self._nominated_uids: set[str] = set()
         self._nominated_req_of_row: dict[int, np.ndarray] = {}
         self._pod_tmpl: tuple[np.ndarray, np.ndarray] | None = None
+        self._pod_tmpl_dev = None          # device push of _pod_template
+        self._subset_tmpl: dict[tuple, tuple[np.ndarray, np.ndarray]] = {}
+        self._table_i32_tmpl: np.ndarray | None = None
         self._row_node_obj: dict[int, object] = {}  # row -> packed Node obj
+        # workload-activity tracking for launch_features(): which rows carry
+        # taints / used host ports / images — a feature absent cluster-wide
+        # AND batch-wide compiles out of the launch entirely
+        self._rows_with_taints: set[int] = set()
+        self._rows_with_ports: set[int] = set()
+        self._rows_with_images: set[int] = set()
         # every namespace any packed pod lives in: selectors are evaluated
         # over store ∪ pod namespaces (labels default {}), matching the
         # reference's nil-nsLabels behavior for namespaces that have no
@@ -277,13 +334,17 @@ class Mirror:
              info.non_zero_requested.memory / MI], np.float32)
         return free, nzr
 
-    def _pack_ports(self, info: NodeInfo, f: dict[str, np.ndarray]) -> None:
+    def _pack_ports(self, info: NodeInfo, f: dict[str, np.ndarray],
+                    row: int | None = None) -> None:
         caps = self.caps
         entries = [(ip, proto, port)
                    for ip, s in info.used_ports.ports.items()
                    for (proto, port) in s]
         if len(entries) > caps.node_ports:
             raise CapacityError("node_ports", len(entries))
+        if row is not None:
+            (self._rows_with_ports.add(row) if entries
+             else self._rows_with_ports.discard(row))
         pi = np.full((caps.node_ports,), NONE, np.int32)
         pp = np.full((caps.node_ports,), NONE, np.int32)
         pn = np.full((caps.node_ports,), NONE, np.int32)
@@ -300,7 +361,7 @@ class Mirror:
         full row repack."""
         f: dict[str, np.ndarray] = {}
         f["free"], f["nonzero_requested"] = self._free_nzr_of(info)
-        self._pack_ports(info, f)
+        self._pack_ports(info, f, row)
         nc = self.node_codec
         for name, arr in f.items():
             kind_off = nc._f32_off.get(name)
@@ -353,8 +414,12 @@ class Mirror:
             tv[i] = self._i(t.value)
             te[i] = F.effect_id(t.effect)
         f["taint_keys"], f["taint_vals"], f["taint_effects"] = tk, tv, te
-        self._pack_ports(info, f)
+        (self._rows_with_taints.add(row) if node.spec.taints
+         else self._rows_with_taints.discard(row))
+        self._pack_ports(info, f, row)
         imgs = list(info.image_sizes.items())
+        (self._rows_with_images.add(row) if imgs
+         else self._rows_with_images.discard(row))
         if len(imgs) > caps.node_images:
             imgs = imgs[: caps.node_images]  # best-effort: scoring-only signal
         ii = np.full((caps.node_images,), NONE, np.int32)
@@ -420,6 +485,30 @@ class Mirror:
             w[: len(weights)] = weights
             f[f"{prefix}_weight"] = w
 
+    def _table_template(self) -> np.ndarray:
+        """Packed pods_i32 row of a term-free table pod (pod_valid=True,
+        everything else at defaults): the fast-path base every no-affinity
+        bound pod copies instead of re-deriving ~30 padded term arrays
+        (the dominant host cost of committing constraint-free workloads)."""
+        if self._table_i32_tmpl is None:
+            tf32, ti32 = self.table_codec.alloc(1)
+            pi = PodInfo(Pod())
+            f: dict[str, np.ndarray] = {}
+            f["pod_valid"] = np.bool_(True)
+            f["pod_node"] = np.int32(0)
+            f["pod_ns"] = np.int32(NONE)
+            f["pod_uid"] = np.int32(NONE)
+            f["pod_nominated"] = np.bool_(False)
+            f["pt_label_vals"] = np.full((self.caps.pod_label_cols,), NONE,
+                                         np.int32)
+            self._pack_term_group([], None, pi.pod, "pod_anti", f)
+            self._pack_term_group([], None, pi.pod, "pod_aff", f)
+            self._pack_term_group([], [], pi.pod, "pod_paff", f)
+            self._pack_term_group([], [], pi.pod, "pod_panti", f)
+            self.table_codec.pack_into(tf32[0], ti32[0], f)
+            self._table_i32_tmpl = ti32[0]
+        return self._table_i32_tmpl
+
     def _pack_pod_slot(self, uid: str, pi: PodInfo, row: int, node_name: str,
                        nominated: bool = False) -> None:
         self._note_namespace(pi.pod.metadata.namespace)
@@ -427,6 +516,28 @@ class Mirror:
             raise CapacityError("pods", self.caps.pods + 1)
         slot = self._free_slots.pop()
         pod = pi.pod
+        has_terms = bool(pi.required_anti_affinity_terms
+                         or pi.required_affinity_terms
+                         or pi.preferred_affinity_terms
+                         or pi.preferred_anti_affinity_terms)
+        if not has_terms:
+            # template fast path: copy + patch the 5 scalar fields + labels
+            dst = self.pods_i32[slot]
+            dst[:] = self._table_template()
+            tc = self.table_codec
+            dst[tc._i32_off["pod_node"][0]] = row
+            dst[tc._i32_off["pod_ns"][0]] = self._i(pod.metadata.namespace)
+            dst[tc._i32_off["pod_uid"][0]] = self._i(pod.metadata.uid)
+            dst[tc._i32_off["pod_nominated"][0]] = 1 if nominated else 0
+            if pod.metadata.labels:
+                off, size = tc._i32_off["pt_label_vals"]
+                dst[off:off + size] = self.pod_labels_row(pod.metadata.labels)
+            self._dirty_slots.add(slot)
+            self._pod_slot[uid] = slot
+            self._node_pods[node_name][uid] = slot
+            self._pod_obj[uid] = pod
+            self._node_of_pod[uid] = node_name
+            return
         f: dict[str, np.ndarray] = {}
         f["pod_valid"] = np.bool_(True)
         f["pod_node"] = np.int32(row)
@@ -525,7 +636,11 @@ class Mirror:
             if node_name is None or pod is None or row is None:
                 continue
             self._release_pod_slot(uid)
-            self._pack_pod_slot(uid, PodInfo(pod), row, node_name)
+            # a "nominated:<uid>" overlay slot must keep its pod_nominated
+            # flag through the repack, or the dual-pass rule
+            # (RunFilterPluginsWithNominatedPods) breaks for it
+            self._pack_pod_slot(uid, PodInfo(pod), row, node_name,
+                                nominated=uid in self._nominated_uids)
 
     def _resolve_term_namespaces(self, term: PodAffinityTerm, owner: Pod
                                  ) -> tuple[list[str], bool]:
@@ -617,6 +732,9 @@ class Mirror:
         self._row_node_labels.pop(row, None)
         self._row_node_obj.pop(row, None)
         self._nominated_req_of_row.pop(row, None)
+        self._rows_with_taints.discard(row)
+        self._rows_with_ports.discard(row)
+        self._rows_with_images.discard(row)
         for uid in list(self._node_pods.get(name, {})):
             self._release_pod_slot(uid)
         self._node_pods.pop(name, None)
@@ -998,9 +1116,14 @@ class Mirror:
             out["tsc_honor_affinity"][i] = t.node_affinity_policy == "Honor"
             out["tsc_honor_taints"][i] = t.node_taints_policy == "Honor"
 
-    def pack_batch_blobs(self, pods: list[Pod], batch_size: int) -> PodBlobs:
+    def pack_batch_blobs(self, pods: list[Pod], batch_size: int,
+                         fields: tuple[str, ...] | None = None) -> PodBlobs:
         """Pack pods into a [B]-batched PodBlobs (2 device transfers), padding
-        to batch_size with invalid rows."""
+        to batch_size with invalid rows. With ``fields`` the blobs carry only
+        that subset (BlobCodec.subset_layout) — the launch splices the rest
+        from the device-resident template (pod_template_blobs), keeping the
+        per-batch host->device transfer proportional to what the workload
+        uses instead of the full schema."""
         if not pods:
             raise ValueError("empty batch")
         if len(pods) > batch_size:
@@ -1012,28 +1135,91 @@ class Mirror:
             self._note_namespace(pod.metadata.namespace)
             for k in pod.metadata.labels:
                 self.pod_label_col(k)
-        f32, i32 = self.pod_codec.alloc(batch_size)
-        tf32, ti32 = self._pod_template()
-        f32[: len(pods)] = tf32
-        i32[: len(pods)] = ti32
+        if fields is None:
+            f32, i32 = self.pod_codec.alloc(batch_size)
+            tf32, ti32 = self._pod_template()
+            f32[: len(pods)] = tf32
+            i32[: len(pods)] = ti32
+            for b, pod in enumerate(pods):
+                self.pod_codec.pack_into(f32[b], i32[b],
+                                         self.pack_pod(pod, active_only=True))
+            # padding rows stay zeroed => valid False
+            return PodBlobs(f32=jnp.asarray(f32), i32=jnp.asarray(i32))
+        tmpl = self._subset_tmpl.get(fields)
+        if tmpl is None:
+            tf32, ti32 = self._pod_template()
+            tmpl = self.pod_codec.subset_template(fields, tf32, ti32)
+            self._subset_tmpl[fields] = tmpl
+        f32, i32 = self.pod_codec.alloc_subset(fields, batch_size)
+        f32[: len(pods)] = tmpl[0]
+        i32[: len(pods)] = tmpl[1]
         for b, pod in enumerate(pods):
-            self.pod_codec.pack_into(f32[b], i32[b],
-                                     self.pack_pod(pod, active_only=True))
-        # padding rows stay zeroed => valid False
+            self.pod_codec.pack_into_subset(
+                fields, f32[b], i32[b], self.pack_pod(pod, active_only=True))
         return PodBlobs(f32=jnp.asarray(f32), i32=jnp.asarray(i32))
 
     def pack_batch(self, pods: list[Pod], batch_size: int) -> PodFeatures:
         """PodFeatures view of a packed batch (jitted unpack; test/tooling)."""
         return _unpack_pods_jit(self.pack_batch_blobs(pods, batch_size), self.caps)
 
+    @staticmethod
+    def batch_has_host_ports(pods: list[Pod]) -> bool:
+        return any(p.host_port > 0 for pod in pods
+                   for c in pod.spec.containers for p in c.ports)
+
+    def pod_fields(self, active: tuple[str, ...],
+                   topo: bool) -> tuple[str, ...]:
+        """The PodFeatures fields this launch's kernels can read, given its
+        active features — everything else rides the device-resident template
+        instead of the (slow) host->device link. Sorted for a stable jit
+        static-arg key."""
+        fields = set(POD_CORE_FIELDS)
+        for feat in active:
+            fields.update(POD_FEATURE_FIELDS.get(feat, ()))
+        if topo:
+            fields.update(POD_TOPO_FIELDS)
+        return tuple(sorted(fields))
+
+    def pod_template_blobs(self) -> PodBlobs:
+        """Device-resident 1-row full-schema template (pushed once)."""
+        if self._pod_tmpl_dev is None:
+            f32, i32 = self._pod_template()
+            self._pod_tmpl_dev = PodBlobs(f32=jnp.asarray(f32),
+                                          i32=jnp.asarray(i32))
+        return self._pod_tmpl_dev
+
+    def launch_features(self, pods: list[Pod]) -> tuple[str, ...]:
+        """STATIC activity flags for one launch (schedule_batch ``active``):
+        a feature used by neither the batch nor any mirrored node compiles
+        out of the launch program entirely — the workload-shaped analog of
+        PreFilter-Skip, and the reason a constraint-free drain runs just the
+        fit/utilization kernels."""
+        feats = []
+        if any(pod.spec.node_selector
+               or (pod.spec.affinity is not None
+                   and pod.spec.affinity.node_affinity is not None)
+               for pod in pods):
+            feats.append("nodeaffinity")
+        if self._rows_with_taints:
+            feats.append("taints")
+        if self._rows_with_ports or self.batch_has_host_ports(pods):
+            feats.append("ports")
+        if self._rows_with_images and any(
+                c.image for pod in pods for c in pod.spec.containers):
+            feats.append("images")
+        return tuple(feats)
+
     def prepare_launch(self, pods: list[Pod], batch_size: int
-                       ) -> tuple[ClusterBlobs, PodBlobs, bool, int]:
+                       ) -> LaunchSpec:
         """Everything one schedule_batch launch needs, in the right order:
         pods are packed BEFORE the cluster blobs are fetched, so a topology
         key first referenced by this batch has its backfilled topo_dom
-        column on device for this very launch (not the next one).
-
-        Returns (cluster_blobs, pod_blobs, enable_topology, d_cap)."""
-        pblobs = self.pack_batch_blobs(pods, batch_size)
+        column on device for this very launch (not the next one)."""
+        feats = self.launch_features(pods)
         enable = self.batch_has_topology(pods) or self.table_has_topology()
-        return self.to_blobs(), pblobs, enable, self.domain_bucket()
+        pfields = self.pod_fields(feats, enable)
+        pblobs = self.pack_batch_blobs(pods, batch_size, pfields)
+        return LaunchSpec(cblobs=self.to_blobs(), pblobs=pblobs,
+                          enable_topology=enable, d_cap=self.domain_bucket(),
+                          active=feats, pfields=pfields,
+                          ptmpl=self.pod_template_blobs())

@@ -81,6 +81,10 @@ SCORE_PLUGINS = (
 # default HardPodAffinityWeight (apis/config/v1/defaults.go)
 HARD_POD_AFFINITY_WEIGHT = 1.0
 
+# phase-1 (parallel Filter/Score) sub-batch size: bounds the transient
+# [chunk, selector-capacity, N] gather footprint for giant drain batches
+PHASE1_CHUNK = 1024
+
 
 @jax.tree_util.register_dataclass
 @dataclass
@@ -115,32 +119,56 @@ DEFAULT_WEIGHTS = default_weights
 @jax.tree_util.register_dataclass
 @dataclass
 class BatchResult:
-    """Per-pod outcome of one batched launch."""
+    """Per-pod outcome of one batched launch.
+
+    ``free``/``nzr`` are the post-batch cluster usage state ([N, R] and
+    [N, 2]): the device-resident "assume" ledger. Feeding them to the next
+    launch's ``state`` arg chains batches without a host->device mirror
+    re-sync round trip in between (the batched analog of the assume step
+    keeping the cache hot between cycles, cache.go:361)."""
 
     node_row: jax.Array        # [B] i32: chosen node row, -1 = unschedulable
     score: jax.Array           # [B] f32: winning aggregate score
     feasible_count: jax.Array  # [B] i32: nodes passing all filters
     reject_counts: jax.Array   # [B, P] i32: nodes rejected per plugin (first-fail)
     unresolvable_count: jax.Array  # [B] i32: nodes where fit can never succeed
+    free: jax.Array            # [N, R] f32: post-batch free resources
+    nzr: jax.Array             # [N, 2] f32: post-batch nonzero-requested
+
+
+# workload-activity flags (STATIC, host-derived per launch by
+# Mirror.launch_features): a feature absent from both the batch and the
+# cluster mirror compiles to an all-pass mask / zero score — XLA dead-code-
+# eliminates the whole kernel. The device analog of PreFilter returning
+# Skip for a pod that doesn't use the plugin (framework/interface.go:518).
+ALL_FEATURES = ("nodeaffinity", "taints", "ports", "images")
 
 
 def static_filters(ct: ClusterTensors, pod: PodFeatures,
                    wk: dict[str, jnp.ndarray],
-                   enabled: tuple[bool, ...]) -> jnp.ndarray:
+                   enabled: tuple[bool, ...],
+                   active: frozenset[str]) -> jnp.ndarray:
     """Commit-invariant Filter plugins for one pod over all nodes: [5, N]
     masks in FILTER_PLUGINS order (the rest run in the commit scan).
     ``enabled`` (static, from the framework's resolved config) replaces a
-    disabled plugin's mask with all-True — XLA dead-code-eliminates it."""
+    disabled plugin's mask with all-True — XLA dead-code-eliminates it;
+    ``active`` does the same for features the workload doesn't use."""
     fns = (
         lambda: FL.node_unschedulable(ct, pod, wk["unschedulable_taint_key"]),
         lambda: FL.node_name(ct, pod),
-        lambda: FL.taint_toleration(ct, pod),
-        lambda: FL.node_affinity(ct, pod),
-        lambda: FL.node_ports(ct, pod, wk["wildcard_ip"]),
+        lambda: (FL.taint_toleration(ct, pod)
+                 if "taints" in active else None),
+        lambda: (FL.node_affinity(ct, pod)
+                 if "nodeaffinity" in active else None),
+        lambda: (FL.node_ports(ct, pod, wk["wildcard_ip"])
+                 if "ports" in active else None),
     )
     n = ct.node_valid.shape[0]
-    return jnp.stack([fn() if enabled[i] else jnp.ones((n,), bool)
-                      for i, fn in enumerate(fns)])
+    masks = []
+    for i, fn in enumerate(fns):
+        m = fn() if enabled[i] else None
+        masks.append(m if m is not None else jnp.ones((n,), bool))
+    return jnp.stack(masks)
 
 
 def tie_perturb(b, n: int) -> jnp.ndarray:
@@ -156,7 +184,7 @@ def tie_perturb(b, n: int) -> jnp.ndarray:
 
 
 def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
-                   img, unres, weights):
+                   img, unres, weights, free0, nzr0):
     """Parallel auction replacing the per-pod commit scan when the batch has
     no topology constraints and no host ports: every round, all unplaced
     pods score+argmax in parallel; per node, pods are accepted in BATCH
@@ -170,11 +198,13 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
     scan path remains the exact-parity mode for topology/port batches.
 
     Wall-clock: O(rounds) of [B, N] work instead of B sequential steps —
-    rounds ≈ a few with random tie-breaking."""
+    rounds ≈ a few with random tie-breaking. This is what makes the batched
+    design faster than the reference's per-pod loop on TPU: the MXU-friendly
+    [B, N] score matrix replaces B round trips through tiny kernels."""
     B, N = static_ok.shape
     alloc2 = SC.alloc_cpu_mem(ct)
     own = jnp.arange(N)[None, :] == pods.nominated_row[:, None]    # [B, N]
-    perturb = jax.vmap(lambda b: tie_perturb(b, N))(jnp.arange(B))
+    perturb = jax.vmap(lambda u: tie_perturb(u, N))(pods.uid_id)
     idx_b = jnp.arange(B)
 
     def fit_all(free):
@@ -207,32 +237,28 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
         feasible = static_ok & fit & (placed < 0)[:, None]
         total = totals(nzr, feasible)
         choice = jax.vmap(C.masked_argmax_random)(total, feasible, perturb)
-        # per-node acceptance in batch-index order under cumulative fit
-        key = jnp.where(choice >= 0, choice, N) * (B + 1) + idx_b
-        order = jnp.argsort(key)
-        sc = choice[order]                                         # [B]
-        sreq = pods.req[order]                                     # [B, R]
-        pre = jnp.cumsum(sreq, axis=0) - sreq
-        first = jnp.concatenate([jnp.ones((1,), bool),
-                                 sc[1:] != sc[:-1]])
-        start = jax.lax.cummax(jnp.where(first, idx_b, -1))
-        seg_pre = pre - pre[start]                                 # [B, R]
-        scn = jnp.clip(sc, 0, N - 1)
-        own_s = own[order, scn]
-        base = (free[scn] - ct.nominated_req[scn]
-                + jnp.where(own_s[:, None], sreq, 0.0))
-        fits = jnp.all(sreq + seg_pre <= base, axis=-1) & (sc >= 0)
-        accept = jnp.zeros((B,), bool).at[order].set(fits)
-        rows_ = jnp.clip(choice, 0, N - 1)
-        free = free.at[rows_].add(
-            jnp.where(accept[:, None], -pods.req, 0.0))
-        nzr = nzr.at[rows_].add(
-            jnp.where(accept[:, None], pods.nonzero_req, 0.0))
+        # per-node acceptance: ONE pod per node per round (first in batch
+        # index order); colliding losers re-score against the updated
+        # cluster next round, so utilization scores steer them away from
+        # just-filled nodes and the final balance tracks the serial loop's.
+        # Everything is dense [B, N] reductions / one-hot matmuls — no
+        # scatters, which TPU would serialize per update.
+        chosen = choice[:, None] == jnp.arange(N)[None, :]         # [B, N]
+        cand_idx = jnp.where(chosen, idx_b[:, None], B)
+        first_idx = jnp.min(cand_idx, axis=0)                      # [N]
+        accept = ((choice >= 0)
+                  & (jnp.take(first_idx, jnp.clip(choice, 0, N - 1))
+                     == idx_b))                                    # [B]
+        onehot = (accept[:, None] & chosen).astype(free.dtype)     # [B, N]
+        free = free - onehot.T @ pods.req                          # [N, R]
+        nzr = nzr + onehot.T @ pods.nonzero_req                    # [N, 2]
         placed = jnp.where(accept, choice, placed)
-        win = jnp.where(accept, total[idx_b, rows_], win)
-        return free, nzr, placed, win, jnp.any(fits)
+        win_now = jnp.take_along_axis(
+            total, jnp.clip(choice, 0, N - 1)[:, None], axis=1)[:, 0]
+        win = jnp.where(accept, win_now, win)
+        return free, nzr, placed, win, jnp.any(accept)
 
-    init = (ct.free, ct.nonzero_requested, jnp.full((B,), -1, jnp.int32),
+    init = (free0, nzr0, jnp.full((B,), -1, jnp.int32),
             jnp.zeros((B,), jnp.float32), jnp.bool_(True))
     free, nzr, placed, win, _ = jax.lax.while_loop(cond, body, init)
 
@@ -241,14 +267,12 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
     feas = jnp.sum(static_ok & fit, axis=1).astype(jnp.int32)
     fit_rejects = jnp.sum(static_ok & ~fit, axis=1).astype(jnp.int32)
     zeros = jnp.zeros((B,), jnp.int32)
-    ports_idx = FILTER_PLUGINS.index("NodePorts")
-    static_rejects = static_rejects.at[:, ports_idx].add(zeros)
     reject_counts = jnp.concatenate(
         [static_rejects, fit_rejects[:, None], zeros[:, None],
          zeros[:, None]], axis=1)
     return BatchResult(node_row=placed, score=win, feasible_count=feas,
                        reject_counts=reject_counts,
-                       unresolvable_count=unres)
+                       unresolvable_count=unres, free=free, nzr=nzr)
 
 
 def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
@@ -256,7 +280,11 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                    caps: Capacities, enable_topology: bool = True,
                    d_cap: int | None = None,
                    enabled_filters: tuple[bool, ...] | None = None,
-                   serial_scan: bool = True
+                   serial_scan: bool = True,
+                   state: tuple[jnp.ndarray, jnp.ndarray] | None = None,
+                   active: tuple[str, ...] | None = None,
+                   pfields: tuple[str, ...] | None = None,
+                   ptmpl: PodBlobs | None = None
                    ) -> BatchResult:
     """Schedule a whole pod batch in one launch, as-if-serial (see module
     docstring for the two-phase structure).
@@ -266,9 +294,23 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
     a batch with no (anti)affinity terms or spread constraints compiles to a
     program with the topology kernels dead-code-eliminated, and ``d_cap``
     bounds the domain scatter space to the batch's actually-used topology
-    keys (Mirror.domain_bucket) instead of the worst-case node count."""
+    keys (Mirror.domain_bucket) instead of the worst-case node count.
+
+    ``serial_scan=False`` (STATIC) selects the parallel-rounds auction
+    (_rounds_commit) instead of the exact-parity commit scan. Only valid
+    when the launch has no topology work and no batch pod carries host
+    ports — the host gates this (Scheduler/bench), mirroring the
+    reference's own "skip what the pod doesn't use" PreFilter returns.
+
+    ``state`` optionally overrides the cluster's (free, nonzero_requested)
+    usage tensors with the previous launch's BatchResult.free/.nzr — the
+    device-resident chain that lets a multi-batch drain run without host
+    mirror re-syncs in between."""
     ct = unpack_cluster(cblobs, caps)
-    pods = unpack_pods(pblobs, caps)  # leaves [B, ...]
+    pods = unpack_pods(pblobs, caps, pfields, ptmpl)  # leaves [B, ...]
+    free0 = ct.free if state is None else state[0]
+    nzr0 = ct.nonzero_requested if state is None else state[1]
+    act = frozenset(ALL_FEATURES if active is None else active)
     num_valid = jnp.sum(ct.node_valid)
     valid = ct.node_valid
     if d_cap is None:
@@ -284,7 +326,7 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
 
     # ---- phase 1: parallel over the batch ----
     def per_pod(pod: PodFeatures):
-        masks = static_filters(ct, pod, wk, enabled_filters)   # [5, N]
+        masks = static_filters(ct, pod, wk, enabled_filters, act)  # [5, N]
         static_ok = jnp.all(masks, axis=0) & valid & pod.valid  # [N]
         # first-fail attribution among the static plugins
         prev_ok = jnp.cumprod(
@@ -292,10 +334,15 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                              masks[:-1]], axis=0), axis=0).astype(bool)
         first_fail = prev_ok & ~masks & valid[None]
         static_rejects = jnp.sum(first_fail, axis=1).astype(jnp.int32)  # [P-1]
-        # raw commit-invariant scores
-        taint_raw = SC.taint_toleration_score(ct, pod)         # [N]
-        aff_raw = SC.node_affinity_score(ct, pod)              # [N]
-        img = SC.image_locality(ct, pod, num_valid)            # [N]
+        # raw commit-invariant scores (inactive feature -> zero, DCE'd)
+        n = valid.shape[0]
+        zeros_n = jnp.zeros((n,), jnp.float32)
+        taint_raw = (SC.taint_toleration_score(ct, pod)
+                     if "taints" in act else zeros_n)           # [N]
+        aff_raw = (SC.node_affinity_score(ct, pod)
+                   if "nodeaffinity" in act else zeros_n)       # [N]
+        img = (SC.image_locality(ct, pod, num_valid)
+               if "images" in act else zeros_n)                 # [N]
         # fit can never succeed: request exceeds allocatable (Unresolvable)
         unresolvable = jnp.any(pod.req[None] > ct.allocatable, axis=-1)
         unres_count = jnp.sum(unresolvable & valid).astype(jnp.int32)
@@ -336,8 +383,29 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                 tsc_self, ipa_anti_ok, aff_present, aff_any, ipa_raw,
                 has_soft, nodeaff_v, taint_v)
 
-    outs = jax.vmap(per_pod)(pods)
+    # phase-1 memory scales with B × selector-capacity × N (the label/term
+    # gathers); chunk the vmap through lax.map so giant drain batches stay
+    # inside HBM — per-chunk peak is what a PHASE1_CHUNK-sized batch needs
+    B_all = pblobs.f32.shape[0]
+    if B_all > PHASE1_CHUNK:
+        pad = (-B_all) % PHASE1_CHUNK
+        pods_p = pods if pad == 0 else jax.tree.map(
+            lambda x: jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1)), pods)
+        groups = (B_all + pad) // PHASE1_CHUNK
+        pods_g = jax.tree.map(
+            lambda x: x.reshape((groups, PHASE1_CHUNK) + x.shape[1:]), pods_p)
+        outs = jax.lax.map(lambda p: jax.vmap(per_pod)(p), pods_g)
+        outs = jax.tree.map(
+            lambda x: x.reshape((groups * PHASE1_CHUNK,)
+                                + x.shape[2:])[:B_all], outs)
+    else:
+        outs = jax.vmap(per_pod)(pods)
     (static_ok, static_rejects, taint_raw, aff_raw, img, unres) = outs[:6]
+    if not serial_scan:
+        if enable_topology:
+            raise ValueError("auction commit requires a no-topology launch")
+        return _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw,
+                              aff_raw, img, unres, weights, free0, nzr0)
     if enable_topology:
         (cnt_s, exists_hard, spread_ignored, tp_weight, tsc_self,
          ipa_anti_ok, aff_present, aff_any, ipa_raw, has_soft,
@@ -364,9 +432,16 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
     # ---- phase 2: sequential commit scan (tiny per-step work) ----
     alloc2 = SC.alloc_cpu_mem(ct)                               # [N, 2]
     B = pblobs.f32.shape[0]
+    # per-pod tie perturbation keyed by uid: equal-score nodes pick
+    # uniformly instead of hotspotting the lowest row (selectHost's
+    # reservoir sample, schedule_one.go:865)
+    perturb_rows = jax.vmap(
+        lambda u: tie_perturb(u, cblobs.node_f32.shape[0]))(pods.uid_id)
     # pairwise hostPort conflicts: pod j can't join a node where an earlier
     # conflicting batch pod was committed (as-if-serial NodePorts)
-    port_conf = FL.pod_pair_port_conflict(pods, wk["wildcard_ip"])  # [B, B]
+    port_conf = (FL.pod_pair_port_conflict(pods, wk["wildcard_ip"])
+                 if "ports" in act
+                 else jnp.zeros((B, B), bool))                  # [B, B]
 
     topo_dom = ct.topo_dom
     tk_cap = topo_dom.shape[1]
@@ -374,7 +449,7 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
     def body(carry, xs):
         free, nzr, committed_rows = carry
         if enable_topology:
-            (b, ok_s, t_raw, a_raw, im, req, nzreq, cnt_b, exh_b, ign_b,
+            (b, ok_s, t_raw, a_raw, im, req, nzreq, ptb, cnt_b, exh_b, ign_b,
              tpw_b, self_b, ipa_anti_b, pres_b, any_b, ipa_r, soft_b,
              naff_b, tnt_b) = xs
             act = committed_rows >= 0                            # [B]
@@ -458,7 +533,7 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
             ipa_live = ipa_r + T.step_ipa_score_delta(topo_dom, dom_commit,
                                                       d_cap, groups)
         else:
-            (b, ok_s, t_raw, a_raw, im, req, nzreq) = xs
+            (b, ok_s, t_raw, a_raw, im, req, nzreq, ptb) = xs
             ones = jnp.ones_like(ok_s)
             sp_ok = ipa_ok = ones
             sp_r = ipa_live = jnp.zeros_like(t_raw)
@@ -495,7 +570,7 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                  + weights.image_locality * im
                  + weights.pod_topology_spread * spread
                  + weights.inter_pod_affinity * ipa)
-        row = C.masked_argmax_first(total, feasible)
+        row = C.masked_argmax_random(total, feasible, ptb)
         # commit the winner (the "assume"): free -= request, nonzero += request
         do = row >= 0
         r = jnp.maximum(row, 0)
@@ -516,14 +591,15 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
             port_rejects, fit_rejects, sp_rejects, ipa_rejects)
 
     xs = (jnp.arange(B), static_ok, taint_raw, aff_raw, img,
-          pods.req, pods.nonzero_req)
+          pods.req, pods.nonzero_req, perturb_rows)
     if enable_topology:
         xs = xs + (cnt_s, exists_hard, spread_ignored, tp_weight, tsc_self,
                    ipa_anti_ok, aff_present, aff_any, ipa_raw, has_soft,
                    nodeaff_v, taint_v)
-    init = (ct.free, ct.nonzero_requested, jnp.full((B,), -1, jnp.int32))
-    _, (rows, win_scores, feas, port_rejects, fit_rejects, sp_rejects,
-        ipa_rejects) = jax.lax.scan(body, init, xs)
+    init = (free0, nzr0, jnp.full((B,), -1, jnp.int32))
+    (free_out, nzr_out, _), (rows, win_scores, feas, port_rejects,
+                             fit_rejects, sp_rejects,
+                             ipa_rejects) = jax.lax.scan(body, init, xs)
 
     ports_idx = FILTER_PLUGINS.index("NodePorts")
     static_rejects = static_rejects.at[:, ports_idx].add(port_rejects)
@@ -531,13 +607,27 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
         [static_rejects, fit_rejects[:, None], sp_rejects[:, None],
          ipa_rejects[:, None]], axis=1)
     return BatchResult(node_row=rows, score=win_scores, feasible_count=feas,
-                       reject_counts=reject_counts, unresolvable_count=unres)
+                       reject_counts=reject_counts, unresolvable_count=unres,
+                       free=free_out, nzr=nzr_out)
 
 
 @partial(jax.jit, static_argnames=("caps", "enable_topology", "d_cap",
-                                   "enabled_filters"))
+                                   "enabled_filters", "serial_scan",
+                                   "active", "pfields"))
 def schedule_batch_jit(cblobs, pblobs, wk, weights, caps,
                        enable_topology=True, d_cap=None,
-                       enabled_filters=None):
+                       enabled_filters=None, serial_scan=True, state=None,
+                       active=None, pfields=None, ptmpl=None):
     return schedule_batch(cblobs, pblobs, wk, weights, caps,
-                          enable_topology, d_cap, enabled_filters)
+                          enable_topology, d_cap, enabled_filters,
+                          serial_scan, state, active, pfields, ptmpl)
+
+
+def launch_batch(spec, wk, weights, caps, enabled_filters=None,
+                 serial_scan=True, state=None) -> BatchResult:
+    """schedule_batch_jit driven by a Mirror.prepare_launch LaunchSpec."""
+    return schedule_batch_jit(
+        spec.cblobs, spec.pblobs, wk, weights, caps,
+        spec.enable_topology, spec.d_cap, enabled_filters,
+        serial_scan=serial_scan, state=state, active=spec.active,
+        pfields=spec.pfields, ptmpl=spec.ptmpl)

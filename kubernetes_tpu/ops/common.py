@@ -67,14 +67,6 @@ def masked_min(x: jnp.ndarray, mask: jnp.ndarray, axis=None) -> jnp.ndarray:
     return jnp.min(jnp.where(mask, x, jnp.inf), axis=axis)
 
 
-def masked_argmax_first(score: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
-    """Index of the max score among masked-in entries (first on ties);
-    -1 if mask is empty."""
-    s = jnp.where(mask, score, -jnp.inf)
-    idx = jnp.argmax(s)
-    return jnp.where(jnp.any(mask), idx.astype(jnp.int32), jnp.int32(-1))
-
-
 def masked_argmax_random(score: jnp.ndarray, mask: jnp.ndarray,
                          perturb: jnp.ndarray) -> jnp.ndarray:
     """Tie-broken argmax: equal top scores pick uniformly via a pre-drawn

@@ -483,11 +483,21 @@ def unpack_cluster(blobs: ClusterBlobs, caps: Capacities) -> ClusterTensors:
     return ClusterTensors(**fields)
 
 
-def unpack_pods(blobs: PodBlobs, caps: Capacities) -> PodFeatures:
+def unpack_pods(blobs: PodBlobs, caps: Capacities,
+                fields: tuple[str, ...] | None = None,
+                template: PodBlobs | None = None) -> PodFeatures:
+    """Full-schema unpack, or — when ``fields`` is given — a subset unpack
+    where absent fields broadcast from the 1-row ``template`` blob (see
+    BlobCodec.unpack_subset; the transfer-thrift path)."""
     from kubernetes_tpu.ops.blobs import Blobs
 
     _, _, pod_codec = codecs(caps)
-    return pod_codec.unpack(Blobs(f32=blobs.f32, i32=blobs.i32), PodFeatures)
+    if fields is None:
+        return pod_codec.unpack(Blobs(f32=blobs.f32, i32=blobs.i32),
+                                PodFeatures)
+    return pod_codec.unpack_subset(
+        Blobs(f32=blobs.f32, i32=blobs.i32), fields,
+        Blobs(f32=template.f32, i32=template.i32), PodFeatures)
 
 
 def effect_id(effect: str) -> int:

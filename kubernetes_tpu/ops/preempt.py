@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from kubernetes_tpu.models.pipeline import (
+    ALL_FEATURES,
     FILTER_PLUGINS,
     NUM_FILTER_PLUGINS,
     static_filters,
@@ -56,7 +57,10 @@ def preempt_sweep(cblobs: ClusterBlobs, pblobs: PodBlobs,
     ct = unpack_cluster(cblobs, caps)
     pod = jax.tree_util.tree_map(lambda x: x[0], unpack_pods(pblobs, caps))
 
-    masks = static_filters(ct, pod, wk, enabled_filters)       # [5, N]
+    # the sweep runs off the hot path: evaluate every static filter (no
+    # workload-activity DCE)
+    masks = static_filters(ct, pod, wk, enabled_filters,
+                           frozenset(ALL_FEATURES))            # [5, N]
     static_ok = jnp.all(masks, axis=0) & ct.node_valid
     unresolvable = jnp.any(pod.req[None] > ct.allocatable, axis=-1)
 

@@ -45,7 +45,7 @@ from kubernetes_tpu.hub import EventHandlers, Hub
 from kubernetes_tpu.models.pipeline import (
     FILTER_PLUGINS,
     BatchResult,
-    schedule_batch_jit,
+    launch_batch,
 )
 from kubernetes_tpu.ops.features import Capacities
 
@@ -230,7 +230,7 @@ class Scheduler:
             try:
                 self.mirror.sync(self.snapshot)
                 self.mirror.set_nominated(self.nominator.by_node())
-                cblobs, pblobs, topo, d_cap = self.mirror.prepare_launch(
+                spec = self.mirror.prepare_launch(
                     [qp.pod for qp in runnable], self.config.batch_size)
                 break
             except CapacityError as e:
@@ -242,9 +242,16 @@ class Scheduler:
         else:
             raise RuntimeError("mirror re-bucketing did not converge")
 
-        out: BatchResult = schedule_batch_jit(
-            cblobs, pblobs, self.mirror.well_known(), self._weights,
-            self.caps, topo, d_cap, self._enabled_filters)
+        # commit mode: the parallel-rounds auction whenever the launch has
+        # no topology work and no host ports in play; the exact as-if-serial
+        # scan otherwise (see pipeline._rounds_commit)
+        use_auction = (not spec.enable_topology
+                       and "ports" not in spec.active
+                       and self._enabled_filters[FILTER_PLUGINS.index(
+                           "NodeResourcesFit")])
+        out: BatchResult = launch_batch(
+            spec, self.mirror.well_known(), self._weights, self.caps,
+            self._enabled_filters, serial_scan=not use_auction)
         rows = out.node_row[: len(runnable)].tolist()
         rejects = out.reject_counts[: len(runnable)].tolist()
         for qp, row, rej in zip(runnable, rows, rejects):

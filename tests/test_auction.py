@@ -1,0 +1,166 @@
+"""The parallel-rounds auction commit (pipeline._rounds_commit), the
+device-state chain, random tie-breaking, and subset pod-blob transfers.
+
+The auction replaces the reference's serial per-pod assume loop
+(schedule_one.go:66) for constraint-free batches: placement CHOICES may
+differ from the as-if-serial scan, but every placement must satisfy the same
+feasibility invariants (no node ever overcommitted), and the final balance
+must track the serial loop's (selectHost's reservoir-sampled tie-break,
+schedule_one.go:865)."""
+
+import collections
+
+import numpy as np
+
+from kubernetes_tpu.models.pipeline import (
+    default_weights,
+    launch_batch,
+    schedule_batch_jit,
+)
+from kubernetes_tpu.models.testbed import build_cluster, make_pod
+from kubernetes_tpu.ops.features import Capacities
+
+CAPS = Capacities(nodes=64, pods=256)
+
+
+def _drive(num_nodes, pods, serial_scan, batch=64):
+    cache, snap, mirror = build_cluster(num_nodes, caps=CAPS)
+    spec = mirror.prepare_launch(pods, batch)
+    out = launch_batch(spec, mirror.well_known(), default_weights(), CAPS,
+                       serial_scan=serial_scan)
+    return mirror, out
+
+
+def test_auction_places_all_when_feasible():
+    pods = [make_pod(i) for i in range(48)]
+    _, out = _drive(16, pods, serial_scan=False)
+    rows = np.asarray(out.node_row)[:48]
+    assert (rows >= 0).all()
+
+
+def test_auction_never_overcommits():
+    """Tight capacity: each node fits exactly 2 of these pods by CPU; the
+    auction must not place a 3rd anywhere, exactly like the serial scan."""
+    pods = [make_pod(i, cpu="14000m") for i in range(20)]  # 2×14 < 32 < 3×14
+    _, out_a = _drive(8, pods, serial_scan=False, batch=32)
+    _, out_s = _drive(8, pods, serial_scan=True, batch=32)
+    for out in (out_a, out_s):
+        rows = [r for r in np.asarray(out.node_row)[:20].tolist() if r >= 0]
+        assert len(rows) == 16, "8 nodes x 2 pods"
+        counts = collections.Counter(rows)
+        assert max(counts.values()) == 2
+    # the 4 unplaced pods are rejected by NodeResourcesFit
+    from kubernetes_tpu.models.pipeline import FILTER_PLUGINS
+    fit_idx = FILTER_PLUGINS.index("NodeResourcesFit")
+    rej = np.asarray(out_a.reject_counts)
+    unplaced = np.asarray(out_a.node_row)[:20] < 0
+    assert (rej[:20][unplaced, fit_idx] > 0).all()
+
+
+def test_auction_balance_tracks_serial():
+    """Equal-score nodes: the auction's one-accept-per-node rounds + random
+    tie-break must spread like the serial loop (no hotspotting)."""
+    pods = [make_pod(i) for i in range(40)]
+    _, out = _drive(40, pods, serial_scan=False)
+    rows = np.asarray(out.node_row)[:40].tolist()
+    counts = collections.Counter(rows)
+    assert max(counts.values()) <= 2
+    assert len(counts) >= 30, "ties must spread, not hotspot the lowest row"
+
+
+def test_scan_tie_break_spreads():
+    """The scan path's perturbed argmax: equal-score nodes pick uniformly
+    (selectHost's reservoir sample), not first-index."""
+    pods = [make_pod(i, cpu="0m", mem="0Mi") for i in range(16)]
+    _, out = _drive(32, pods, serial_scan=True)
+    rows = np.asarray(out.node_row)[:16].tolist()
+    # zero-request pods never change utilization: every node always ties.
+    # first-index argmax would put ALL pods on one row.
+    assert len(set(rows)) >= 8
+
+
+def test_chained_state_sees_prior_batch():
+    """Launch 2 fed launch 1's (free, nzr) must respect its commitments
+    without any host mirror resync."""
+    cache, snap, mirror = build_cluster(4, caps=CAPS)
+    wk = mirror.well_known()
+    weights = default_weights()
+    # each node fits exactly one 20-cpu pod (32 allocatable)
+    first = [make_pod(i, cpu="20000m") for i in range(4)]
+    second = [make_pod(100 + i, cpu="20000m") for i in range(4)]
+    spec1 = mirror.prepare_launch(first, 8)
+    out1 = launch_batch(spec1, wk, weights, CAPS, serial_scan=False)
+    assert (np.asarray(out1.node_row)[:4] >= 0).all()
+    spec2 = mirror.prepare_launch(second, 8)
+    out2 = launch_batch(spec2, wk, weights, CAPS, serial_scan=False,
+                        state=(out1.free, out1.nzr))
+    rows2 = np.asarray(out2.node_row)[:4]
+    assert (rows2 < 0).all(), "chained state must carry batch 1's commits"
+    # without the chain the stale mirror would wrongly admit them
+    out_stale = launch_batch(spec2, wk, weights, CAPS, serial_scan=False)
+    assert (np.asarray(out_stale.node_row)[:4] >= 0).all()
+
+
+def test_subset_blobs_match_full_schema():
+    """prepare_launch ships only the active-feature fields; results must be
+    identical to the full-schema transfer (same pods, same cluster)."""
+    cache, snap, mirror = build_cluster(12, caps=CAPS)
+    pods = [make_pod(i) for i in range(10)]
+    spec = mirror.prepare_launch(pods, 16)
+    assert spec.pfields is not None
+    # the subset must be materially smaller than the full schema
+    full_i32 = mirror.pod_codec.i32_size
+    sub_i32 = spec.pblobs.i32.shape[-1]
+    assert sub_i32 < full_i32 // 4
+    out_sub = launch_batch(spec, mirror.well_known(), default_weights(), CAPS)
+    pblobs_full = mirror.pack_batch_blobs(pods, 16)
+    out_full = schedule_batch_jit(
+        mirror.to_blobs(), pblobs_full, mirror.well_known(),
+        default_weights(), CAPS, spec.enable_topology, spec.d_cap,
+        serial_scan=True)
+    # same launch mode for comparability: rerun subset through the scan
+    out_sub2 = launch_batch(spec, mirror.well_known(), default_weights(),
+                            CAPS, serial_scan=True)
+    assert (np.asarray(out_sub2.node_row)[:10]
+            == np.asarray(out_full.node_row)[:10]).all()
+    assert (np.asarray(out_sub2.reject_counts)
+            == np.asarray(out_full.reject_counts)).all()
+    assert (np.asarray(out_sub.node_row)[:10] >= 0).all()
+
+
+def test_subset_blobs_with_tolerations_and_affinity():
+    """A batch that activates nodeaffinity ships the selector fields and
+    matches the full-schema result."""
+    from kubernetes_tpu.api.objects import (
+        Affinity, Container, NodeAffinity, NodeSelector, NodeSelectorTerm,
+        LabelSelectorRequirement, ObjectMeta, Pod, PodSpec,
+        ResourceRequirements, Toleration,
+    )
+
+    def sel_pod(i, zone):
+        req = NodeSelector(node_selector_terms=[NodeSelectorTerm(
+            match_expressions=[LabelSelectorRequirement(
+                key="topology.kubernetes.io/zone", operator="In",
+                values=[zone])])])
+        return Pod(
+            metadata=ObjectMeta(name=f"sp-{i}"),
+            spec=PodSpec(
+                containers=[Container(name="c",
+                                      resources=ResourceRequirements(
+                                          requests={"cpu": "100m"}))],
+                affinity=Affinity(node_affinity=NodeAffinity(required=req)),
+                tolerations=[Toleration(key="k", operator="Exists")],
+            ))
+
+    cache, snap, mirror = build_cluster(8, caps=CAPS, zones=2)
+    pods = [sel_pod(i, f"zone-{i % 2}") for i in range(6)]
+    spec = mirror.prepare_launch(pods, 8)
+    assert "nodeaffinity" in spec.active
+    assert "sel_col" in spec.pfields
+    out = launch_batch(spec, mirror.well_known(), default_weights(), CAPS)
+    rows = np.asarray(out.node_row)[:6]
+    assert (rows >= 0).all()
+    for i, r in enumerate(rows.tolist()):
+        name = mirror.name_of_row(r)
+        node_zone = int(name.split("-")[1]) % 2
+        assert node_zone == i % 2, "nodeSelector zone must be honored"

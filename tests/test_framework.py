@@ -118,7 +118,7 @@ def test_disabled_filter_device_semantics():
     from kubernetes_tpu.backend.cache import Cache
     from kubernetes_tpu.backend.mirror import Mirror
     from kubernetes_tpu.backend.snapshot import Snapshot
-    from kubernetes_tpu.models.pipeline import schedule_batch_jit
+    from kubernetes_tpu.models.pipeline import launch_batch
     from kubernetes_tpu.ops.features import Capacities
 
     caps = Capacities(nodes=16, pods=32)
@@ -138,14 +138,12 @@ def test_disabled_filter_device_semantics():
 
     fw_off = mkfw(lambda p: setattr(p.plugins, "filter",
                                     PluginSet(disabled=[Plugin("TaintToleration")])))
-    cblobs, pblobs, topo, d_cap = mirror.prepare_launch([pod], 4)
-    out = schedule_batch_jit(cblobs, pblobs, mirror.well_known(),
-                             fw_off.score_weights(), caps, topo, d_cap,
-                             fw_off.enabled_filters())
+    spec = mirror.prepare_launch([pod], 4)
+    out = launch_batch(spec, mirror.well_known(), fw_off.score_weights(),
+                       caps, fw_off.enabled_filters())
     assert int(out.node_row[0]) == 0, "tainted node allowed when disabled"
 
     fw_on = mkfw()
-    out2 = schedule_batch_jit(cblobs, pblobs, mirror.well_known(),
-                              fw_on.score_weights(), caps, topo, d_cap,
-                              fw_on.enabled_filters())
+    out2 = launch_batch(spec, mirror.well_known(), fw_on.score_weights(),
+                        caps, fw_on.enabled_filters())
     assert int(out2.node_row[0]) == -1

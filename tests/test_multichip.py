@@ -26,8 +26,10 @@ N_DEV = 8
 def example():
     caps = Capacities(nodes=16 * N_DEV, pods=256)
     _, _, mirror = build_cluster(12 * N_DEV, caps=caps)
-    cblobs, pblobs, _, _ = mirror.prepare_launch(
-        [make_pod(i) for i in range(8)], 8)
+    # full-schema pod blobs: the sharded parity check runs the default
+    # (subset-free) unpack path
+    pblobs = mirror.pack_batch_blobs([make_pod(i) for i in range(8)], 8)
+    cblobs = mirror.to_blobs()
     return caps, cblobs, pblobs, mirror.well_known(), default_weights()
 
 

@@ -30,7 +30,7 @@ from kubernetes_tpu.api.objects import (
 from kubernetes_tpu.backend.cache import Cache
 from kubernetes_tpu.backend.mirror import Mirror
 from kubernetes_tpu.backend.snapshot import Snapshot
-from kubernetes_tpu.models.pipeline import default_weights, schedule_batch_jit
+from kubernetes_tpu.models.pipeline import default_weights, launch_batch
 from kubernetes_tpu.ops.features import Capacities
 
 CAPS = Capacities(nodes=16, pods=64, domains=16)
@@ -88,9 +88,9 @@ class Cluster:
         self.mirror.sync(self.snap)
 
     def run(self, pods):
-        cblobs, pblobs, topo, d_cap = self.mirror.prepare_launch(pods, 8)
-        out = schedule_batch_jit(cblobs, pblobs, self.mirror.well_known(),
-                                 default_weights(), CAPS, topo, d_cap)
+        spec = self.mirror.prepare_launch(pods, 8)
+        out = launch_batch(spec, self.mirror.well_known(),
+                           default_weights(), CAPS)
         names = [self.mirror.name_of_row(int(r)) if r >= 0 else None
                  for r in np.asarray(out.node_row)[: len(pods)]]
         return names, out
