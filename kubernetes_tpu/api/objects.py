@@ -312,12 +312,18 @@ class Pod:
         only mutations the scheduler performs: nodeName, conditions,
         nominatedNodeName, labels). Deeper structures (containers, affinity,
         tolerations...) are shared and must never be mutated in place."""
-        return replace(
+        c = replace(
             self,
             metadata=replace(self.metadata, labels=dict(self.metadata.labels)),
             spec=replace(self.spec),
             status=replace(self.status, conditions=list(self.status.conditions)),
         )
+        # containers/overhead are shared, so the parsed resource-request memo
+        # (api.resources.pod_request) stays valid for the copy
+        memo = self.__dict__.get("_request_memo")
+        if memo is not None:
+            c._request_memo = memo
+        return c
 
 
 # --- node -------------------------------------------------------------------------

@@ -105,7 +105,18 @@ def pod_request(pod: Pod, *, non_zero: bool = False) -> Resource:
 
     With ``non_zero=True``, cpu/memory of request-less containers default to
     100m / 200Mi — the scoring-path semantics of NonZeroRequested.
+
+    The result is memoized on the pod object (specs are treated as immutable
+    by the hub/cache copy-on-write contract, api.objects module docstring);
+    callers must NOT mutate the returned Resource. Quantity-string parsing
+    otherwise dominates the per-pod host cost of the scheduling hot path.
     """
+    cache = pod.__dict__.get("_request_memo")
+    if cache is None:
+        cache = pod._request_memo = [None, None]
+    memo = cache[1 if non_zero else 0]
+    if memo is not None:
+        return memo
     total = Resource()
     for c in pod.spec.containers:
         total.add(_container_request(c, non_zero))
@@ -129,4 +140,5 @@ def pod_request(pod: Pod, *, non_zero: bool = False) -> Resource:
 
     if pod.spec.overhead:
         total.add(Resource.from_map(pod.spec.overhead))
+    cache[1 if non_zero else 0] = total
     return total

@@ -57,6 +57,9 @@ class Cache:
         self._assumed_pods: set[str] = set()
         self._namespaces: dict[str, dict[str, str]] = {}  # name -> labels
         self._ns_generation = 0
+        # bumped when the set of nodes (or node-less nodeinfos) changes, so
+        # update_snapshot's no-change fast path can skip the removal scan
+        self._node_set_version = 0
 
     # ---------------- internal list maintenance ----------------
 
@@ -101,6 +104,7 @@ class Cache:
             item = self._get_or_create(node.metadata.name)
             self._node_tree.add_node(node)
             item.info.set_node(node)
+            self._node_set_version += 1
             self._move_to_head(item)
 
     def update_node(self, old: Node, new: Node) -> None:
@@ -108,6 +112,7 @@ class Cache:
             item = self._get_or_create(new.metadata.name)
             self._node_tree.update_node(old, new)
             item.info.set_node(new)
+            self._node_set_version += 1
             self._move_to_head(item)
 
     def remove_node(self, node: Node) -> None:
@@ -115,6 +120,7 @@ class Cache:
             item = self._nodes.get(node.metadata.name)
             if item is None:
                 return
+            self._node_set_version += 1
             self._node_tree.remove_node(node)
             if item.info.pods:
                 # pods still assigned: keep the nodeinfo, drop the node object
@@ -196,10 +202,25 @@ class Cache:
         uid = pod.metadata.uid
         with self._lock:
             st = self._pod_states.get(uid)
+            if (st is not None and st.assumed
+                    and st.pod.spec.node_name == pod.spec.node_name):
+                # confirm on the assumed node: the NodeInfo aggregates are
+                # already right — swap the pod object in place WITHOUT
+                # bumping the node generation, so the bind confirmation does
+                # not force a second mirror row repack (the assume already
+                # did one)
+                item = self._nodes.get(pod.spec.node_name)
+                if item is not None:
+                    for pi in item.info.pods:
+                        if pi.pod.metadata.uid == uid:
+                            pi.pod = pod
+                            break
+                self._pod_states[uid] = _PodState(pod=pod)
+                self._assumed_pods.discard(uid)
+                return
             if st is not None:
-                # confirm an assumed pod (informer truth wins, even if the
-                # node differs from what we assumed) or re-add of a confirmed
-                # pod (treat as update)
+                # informer truth wins, even if the node differs from what we
+                # assumed; re-add of a confirmed pod is treated as an update
                 self._remove_pod_from_node(st.pod)
             self._add_pod_to_node(pod)
             self._pod_states[uid] = _PodState(pod=pod)
@@ -257,6 +278,14 @@ class Cache:
         Rebuilds the zone-interleaved list only when nodes were added/removed
         or an affinity-relevant change occurred, like the reference."""
         with self._lock:
+            # no-change fast path: the MRU head carries the max generation,
+            # so a clean cache makes the whole refresh O(1) — _ensure_synced
+            # style callers (preemption mid-drain) can call this per pod
+            if ((self._head is None
+                 or self._head.info.generation <= snapshot.generation)
+                    and snapshot.node_set_version == self._node_set_version
+                    and snapshot.ns_generation == self._ns_generation):
+                return
             snap_gen = snapshot.generation
             updated_affinity = False
             item = self._head
@@ -296,6 +325,8 @@ class Cache:
                 ]
                 self._rebuild_affinity_lists(snapshot)
             snapshot.generation = latest
+            snapshot.node_set_version = self._node_set_version
+            snapshot.version += 1
 
     def _rebuild_lists(self, snapshot: Snapshot) -> None:
         snapshot.node_info_list = []

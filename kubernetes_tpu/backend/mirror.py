@@ -202,6 +202,7 @@ class Mirror:
         self._dirty_rows: set[int] = set()
         self._dirty_slots: set[int] = set()
         self._dev: dict[str, jax.Array] = {}
+        self._last_sync: tuple[int, int] | None = None
         # stable well-known ids, interned up front
         self.wk_unschedulable_key = self._i(TAINT_UNSCHEDULABLE)
         self.wk_wildcard_ip = self._i("0.0.0.0")
@@ -745,6 +746,12 @@ class Mirror:
     def sync(self, snapshot: Snapshot) -> int:
         """Incrementally repack rows for nodes whose generation advanced.
         Returns the number of rows repacked."""
+        # O(1) no-op when the snapshot hasn't changed since the last sync of
+        # this same snapshot object (Snapshot.version is bumped by every
+        # mutating Cache.update_snapshot)
+        if self._last_sync == (id(snapshot), snapshot.version):
+            return 0
+        self._last_sync = (id(snapshot), snapshot.version)
         # namespace set changed: refresh the store and repack every table pod
         # whose terms carry a namespaceSelector (their unrolled ns lists are
         # stale) — the incremental analog of the reference resolving

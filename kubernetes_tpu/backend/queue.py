@@ -20,7 +20,6 @@ of them in-flight with concurrent-event replay per pod.
 
 from __future__ import annotations
 
-import itertools
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -96,10 +95,13 @@ class PriorityQueue:
             lambda qp: qp.uid,
             lambda a, b: self._backoff_expiry(a) < self._backoff_expiry(b))
         self._unschedulable: dict[str, QueuedPodInfo] = {}
-        # in-flight machinery (active_queue.go:147-169): events observed
-        # while a pod is being scheduled are replayed when it comes back
-        self._in_flight: dict[str, list[ClusterEvent]] = {}
-        self._event_seq = itertools.count()
+        # in-flight machinery (active_queue.go:147-169): ONE shared event log
+        # (seq, event, old, new) + per-pod start seq — appending an event is
+        # O(1) regardless of how many pods are in flight (the reference's
+        # shared inFlightEvents list, not a per-pod copy)
+        self._in_flight: dict[str, int] = {}        # uid -> start seq
+        self._events: list[tuple[int, ClusterEvent, object, object]] = []
+        self._next_seq = 0
         self._moved_cycle = 0
 
     # ------------- backoff (backoff_queue.go:248) -------------
@@ -176,7 +178,7 @@ class PriorityQueue:
         qp.attempts += 1
         if qp.initial_attempt_timestamp is None:
             qp.initial_attempt_timestamp = self._now()
-        self._in_flight[qp.uid] = []
+        self._in_flight[qp.uid] = self._next_seq
         return qp
 
     def pop_batch(self, n: int) -> list[QueuedPodInfo]:
@@ -193,6 +195,18 @@ class PriorityQueue:
         """Scheduling (+binding) finished; release in-flight events
         (schedule_one.go:305 via active_queue.go done)."""
         self._in_flight.pop(uid, None)
+        self._trim_events()
+
+    def _trim_events(self) -> None:
+        """Drop log entries no in-flight pod can still replay. The min() scan
+        is amortized: only when the log is empty-able or has grown past the
+        trim threshold."""
+        if not self._in_flight:
+            self._events.clear()
+        elif len(self._events) > 8192:
+            low = min(self._in_flight.values())
+            keep = [e for e in self._events if e[0] >= low]
+            self._events = keep
 
     def in_flight_count(self) -> int:
         return len(self._in_flight)
@@ -206,15 +220,20 @@ class PriorityQueue:
         that arrived while in flight; if any hints QUEUE, skip the
         unschedulable pool and go straight to backoff/active."""
         uid = qp.uid
-        concurrent = self._in_flight.pop(uid, [])
+        start = self._in_flight.pop(uid, None)
         qp.timestamp = self._now()
         if uid in self._active or uid in self._backoff \
                 or uid in self._unschedulable:
+            self._trim_events()
             return
-        for event in concurrent:
-            if self._worth_requeuing(qp, event, None, None):
-                self._requeue(qp)
-                return
+        if start is not None:
+            for seq, event, old_obj, new_obj in self._events:
+                if seq >= start and self._worth_requeuing(qp, event, old_obj,
+                                                          new_obj):
+                    self._trim_events()
+                    self._requeue(qp)
+                    return
+        self._trim_events()
         self._unschedulable[uid] = qp
 
     def activate(self, pods: list[Pod]) -> None:
@@ -263,10 +282,11 @@ class PriorityQueue:
     def move_all_to_active_or_backoff(self, event: ClusterEvent,
                                       old_obj=None, new_obj=None) -> int:
         """A cluster event arrived (MoveAllToActiveOrBackoffQueue :1129).
-        Also records the event for every in-flight pod so it can be
-        replayed when that pod's cycle fails."""
-        for events in self._in_flight.values():
-            events.append(event)
+        Also records the event in the shared in-flight log so any pod whose
+        cycle fails can replay it."""
+        if self._in_flight:
+            self._events.append((self._next_seq, event, old_obj, new_obj))
+            self._next_seq += 1
         self._moved_cycle += 1
         moved = 0
         for uid in list(self._unschedulable):

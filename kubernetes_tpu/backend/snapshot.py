@@ -28,6 +28,11 @@ class Snapshot:
         # (the nsLister surface of interpodaffinity/plugin.go:123)
         self.namespaces: dict[str, dict[str, str]] = {}
         self.ns_generation: int = 0
+        # monotonically bumped by Cache.update_snapshot whenever anything in
+        # the snapshot changed — lets downstream consumers (Mirror.sync) be
+        # O(1) no-ops between changes
+        self.version: int = 0
+        self.node_set_version: int = -1
 
     # --- lister surface (snapshot.go:158-199) ---
 

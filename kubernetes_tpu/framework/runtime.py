@@ -160,13 +160,26 @@ class Framework:
                 return s
         return Status()
 
-    def queue_sort_less(self, a, b) -> bool:
-        for pl in self._iter("queue_sort", QueueSortPlugin):
-            return pl.less(a, b)
+    @staticmethod
+    def _priority_sort_less(a, b) -> bool:
         # fallback: PrioritySort semantics
-        if a.pod.priority() != b.pod.priority():
-            return a.pod.priority() > b.pod.priority()
+        pa, pb = a.pod.priority(), b.pod.priority()
+        if pa != pb:
+            return pa > pb
         return a.timestamp < b.timestamp
+
+    @property
+    def queue_sort_less(self):
+        """The resolved QueueSort comparator, bound once — the heap calls it
+        O(pods log pods) times per drain, so no per-compare plugin walk."""
+        fn = self.__dict__.get("_queue_sort_fn")
+        if fn is None:
+            fn = self._priority_sort_less
+            for pl in self._iter("queue_sort", QueueSortPlugin):
+                fn = pl.less
+                break
+            self._queue_sort_fn = fn
+        return fn
 
     def run_reserve_plugins(self, state: CycleState, pod: Pod,
                             node_name: str) -> Status:

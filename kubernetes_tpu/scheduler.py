@@ -15,6 +15,9 @@ import dataclasses
 import time
 from typing import Optional
 
+import jax
+import numpy as np
+
 from kubernetes_tpu.api.objects import (
     Node,
     Pod,
@@ -99,6 +102,15 @@ class Scheduler:
         self._weights = self.framework.score_weights()
         self.stats = {"scheduled": 0, "unschedulable": 0, "errors": 0,
                       "batches": 0, "attempts": 0}
+        # device-resident (free, nonzero_requested) chain: the post-launch
+        # usage state of the NEWEST dispatched launch. While no external
+        # event has touched the cluster state, the next no-topology batch can
+        # launch against this chain WITHOUT a host snapshot/mirror re-sync —
+        # the batched analog of the cache staying hot between cycles
+        # (cache.go:361 assume). Any event not caused by our own commits
+        # invalidates it (set to None) and forces a full re-sync.
+        self._chain: Optional[tuple] = None
+        self._in_commit = False     # our own bind/patch events are expected
         self._register_handlers()
 
     # ------------- event handlers (eventhandlers.go:366) -------------
@@ -113,24 +125,32 @@ class Scheduler:
             on_update=self._on_pod_update,
             on_delete=self._on_pod_delete))
         self.hub.watch_namespaces(EventHandlers(
-            on_add=lambda ns: self.cache.set_namespace(
-                ns.metadata.name, ns.metadata.labels),
-            on_update=lambda old, new: self.cache.set_namespace(
-                new.metadata.name, new.metadata.labels),
-            on_delete=lambda ns: self.cache.remove_namespace(
-                ns.metadata.name)))
+            on_add=lambda ns: self._on_ns_set(ns),
+            on_update=lambda old, new: self._on_ns_set(new),
+            on_delete=lambda ns: self._on_ns_delete(ns)))
+
+    def _on_ns_set(self, ns) -> None:
+        self._chain = None
+        self.cache.set_namespace(ns.metadata.name, ns.metadata.labels)
+
+    def _on_ns_delete(self, ns) -> None:
+        self._chain = None
+        self.cache.remove_namespace(ns.metadata.name)
 
     def _on_node_add(self, node: Node) -> None:
+        self._chain = None
         self.cache.add_node(node)
         self.queue.move_all_to_active_or_backoff(
             ClusterEvent(R.NODE, A.ADD), None, node)
 
     def _on_node_update(self, old: Node, new: Node) -> None:
+        self._chain = None
         self.cache.update_node(old, new)
         self.queue.move_all_to_active_or_backoff(
             ClusterEvent(R.NODE, _node_update_action(old, new)), old, new)
 
     def _on_node_delete(self, node: Node) -> None:
+        self._chain = None
         self.cache.remove_node(node)
         self.queue.move_all_to_active_or_backoff(
             ClusterEvent(R.NODE, A.DELETE), node, None)
@@ -141,6 +161,8 @@ class Scheduler:
 
     def _on_pod_add(self, pod: Pod) -> None:
         if pod.spec.node_name:
+            if not self._in_commit:
+                self._chain = None
             self.cache.add_pod(pod)
             self.queue.move_all_to_active_or_backoff(
                 ClusterEvent(R.ASSIGNED_POD, A.ADD), None, pod)
@@ -153,6 +175,8 @@ class Scheduler:
 
     def _on_pod_update(self, old: Pod, new: Pod) -> None:
         if new.spec.node_name:
+            if not self._in_commit:
+                self._chain = None
             self.nominator.delete(new.metadata.uid)
             if old.spec.node_name:
                 self.cache.update_pod(old, new)
@@ -174,6 +198,7 @@ class Scheduler:
     def _on_pod_delete(self, pod: Pod) -> None:
         self.nominator.delete(pod.metadata.uid)
         if pod.spec.node_name:
+            self._chain = None
             self.cache.remove_pod(pod)
             self.queue.move_all_to_active_or_backoff(
                 ClusterEvent(R.ASSIGNED_POD, A.DELETE), pod, None)
@@ -196,6 +221,7 @@ class Scheduler:
         self.caps = dataclasses.replace(self.caps, **{field: new})
         self.mirror = Mirror(caps=self.caps)
         self.snapshot = Snapshot()
+        self._chain = None
         self.cache.update_snapshot(self.snapshot)
         # NO sync here: the caller's retry loop re-syncs, so a second field
         # overflowing during the rebuild raises inside the try (and grows
@@ -203,13 +229,10 @@ class Scheduler:
 
     # ------------- the batched scheduling cycle -------------
 
-    def schedule_one_batch(self) -> int:
-        """Pop up to batch_size pods, run one device launch, commit results.
-        Returns the number of pods attempted (0 = queue idle)."""
+    def _pop_runnable(self) -> tuple[int, list[QueuedPodInfo]]:
+        """Pop up to batch_size pods and apply skipPodSchedule
+        (schedule_one.go:380: deleted or already assumed)."""
         batch = self.queue.pop_batch(self.config.batch_size)
-        if not batch:
-            return 0
-        # skipPodSchedule (schedule_one.go:380): deleted or already assumed
         runnable: list[QueuedPodInfo] = []
         for qp in batch:
             stored = self.hub.get_pod(qp.uid)
@@ -220,46 +243,98 @@ class Scheduler:
                 self.queue.done(qp.uid)
                 continue
             runnable.append(qp)
-        if not runnable:
-            return len(batch)
+        return len(batch), runnable
+
+    def _chain_eligible(self, pods: list[Pod]) -> bool:
+        """Can this batch launch against the device-resident usage chain
+        without a host snapshot/mirror re-sync? Requires: a live chain (no
+        external event since the newest dispatch) and a launch that reads
+        nothing the skipped sync would refresh — no topology kernels (pod
+        table) and no batch host ports (port tables)."""
+        return (self._chain is not None
+                and not self.mirror.table_has_topology()
+                and not self.mirror.batch_has_topology(pods)
+                and not self.mirror.batch_has_host_ports(pods))
+
+    def _dispatch(self, runnable: list[QueuedPodInfo], chained: bool,
+                  flush_pending=None) -> Optional[tuple]:
+        """Pack + launch one batch (async dispatch; no host<->device block).
+        Returns (runnable, BatchResult) or None if every pod was routed to
+        the failure path during packing. ``flush_pending`` commits a
+        still-in-flight previous launch before any fallback re-sync, so a
+        chained dispatch that has to re-bucket never syncs a cache missing
+        the previous batch's placements."""
         self.stats["batches"] += 1
         self.stats["attempts"] += len(runnable)
-
-        self.cache.update_snapshot(self.snapshot)
+        state = self._chain if chained else None
+        need_sync = not chained
         for attempt in range(16):  # one capacity field may grow per attempt
             try:
-                self.mirror.sync(self.snapshot)
+                if need_sync:
+                    if flush_pending is not None:
+                        flush_pending()
+                        flush_pending = None
+                    self.cache.update_snapshot(self.snapshot)
+                    self.mirror.sync(self.snapshot)
                 self.mirror.set_nominated(self.nominator.by_node())
                 spec = self.mirror.prepare_launch(
                     [qp.pod for qp in runnable], self.config.batch_size)
                 break
             except CapacityError as e:
-                self._grow(e)
+                self._grow(e)          # invalidates the chain
+                state = None
+                need_sync = True
             except UnsupportedFeatureError:
                 runnable = self._split_unsupported(runnable)
                 if not runnable:
-                    return len(batch)
+                    return None
         else:
             raise RuntimeError("mirror re-bucketing did not converge")
 
         # commit mode: the parallel-rounds auction whenever the launch has
-        # no topology work and no host ports in play; the exact as-if-serial
-        # scan otherwise (see pipeline._rounds_commit)
+        # no topology work and no batch pod carries host ports (in-batch
+        # port conflicts are impossible without batch host ports; node-side
+        # conflicts are in the static masks the auction honors); the exact
+        # as-if-serial scan otherwise (see pipeline._rounds_commit)
         use_auction = (not spec.enable_topology
-                       and "ports" not in spec.active
+                       and not self.mirror.batch_has_host_ports(
+                           [qp.pod for qp in runnable])
                        and self._enabled_filters[FILTER_PLUGINS.index(
                            "NodeResourcesFit")])
         out: BatchResult = launch_batch(
             spec, self.mirror.well_known(), self._weights, self.caps,
-            self._enabled_filters, serial_scan=not use_auction)
-        rows = out.node_row[: len(runnable)].tolist()
-        rejects = out.reject_counts[: len(runnable)].tolist()
+            self._enabled_filters, serial_scan=not use_auction, state=state)
+        # the chain advances to this launch's post-batch state; later
+        # external events reset it to None via the handlers
+        self._chain = (out.free, out.nzr)
+        return runnable, out
+
+    def _finish(self, inflight: tuple) -> None:
+        """Pull one dispatched launch's results and commit/fail each pod."""
+        runnable, out = inflight
+        n = len(runnable)
+        rows, rejects = jax.device_get((out.node_row, out.reject_counts))
+        rows = np.asarray(rows)[:n].tolist()
+        rejects = np.asarray(rejects)[:n].tolist()
         for qp, row, rej in zip(runnable, rows, rejects):
             if row >= 0:
                 self._commit(qp, self.mirror.name_of_row(row))
             else:
                 self._fail(qp, rej)
-        return len(batch)
+
+    def schedule_one_batch(self) -> int:
+        """Pop up to batch_size pods, run one device launch, commit results.
+        Returns the number of pods attempted (0 = queue idle)."""
+        popped, runnable = self._pop_runnable()
+        if popped == 0:
+            return 0
+        if not runnable:
+            return popped
+        inflight = self._dispatch(runnable, self._chain_eligible(
+            [qp.pod for qp in runnable]))
+        if inflight is not None:
+            self._finish(inflight)
+        return popped
 
     def _split_unsupported(self, runnable):
         """A pod uses a construct the device encoding can't express: route it
@@ -283,10 +358,16 @@ class Scheduler:
         self.cache.assume_pod(assumed)
         state = CycleState()
         fw = self.framework
+        # binding a pod with (anti)affinity terms makes the mirror's pod
+        # table stale: the chain must not skip the sync that packs it
+        if self.mirror.batch_has_topology([pod]):
+            self._chain = None
 
         def undo(msg: str) -> None:
             fw.run_unreserve_plugins(state, pod, node_name)
             self.cache.forget_pod(assumed)
+            # the device chain assumed this placement; force a re-sync
+            self._chain = None
             self._error(qp, msg)
 
         s = fw.run_reserve_plugins(state, pod, node_name)
@@ -301,7 +382,11 @@ class Scheduler:
         if not s.is_success():
             undo(f"prebind: {s.message()}")
             return
-        s = fw.run_bind_plugins(state, pod, node_name)
+        self._in_commit = True
+        try:
+            s = fw.run_bind_plugins(state, pod, node_name)
+        finally:
+            self._in_commit = False
         if not s.is_success():
             undo(f"bind: {s.message()}")
             return
@@ -325,6 +410,11 @@ class Scheduler:
         self.stats["unschedulable"] += 1
         nominated = None
         if self.framework.points["post_filter"]:
+            # chained launches skip the per-batch sync; the preemption
+            # dry-run reads the host snapshot + mirror, so refresh them
+            # (O(1) when already clean)
+            self.cache.update_snapshot(self.snapshot)
+            self.mirror.sync(self.snapshot)
             state = CycleState()
             nominated, _s = self.framework.run_post_filter_plugins(
                 state, qp.pod, {"snapshot": self.snapshot,
@@ -358,13 +448,39 @@ class Scheduler:
     # ------------- driving -------------
 
     def run_until_idle(self, max_batches: int = 1000) -> int:
-        """Drain the activeQ (tests/bench); returns pods attempted."""
+        """Drain the activeQ (tests/bench); returns pods attempted.
+
+        Pipelined: while launch k computes on device, batch k+1 is popped,
+        packed, and dispatched against the device-resident usage chain
+        (BatchResult.free/.nzr); batch k's host-side commits then overlap
+        launch k+1's device time. Falls back to strict launch->commit
+        alternation whenever the next batch cannot chain (topology or host
+        ports in play, or an external event invalidated the chain)."""
         total = 0
+        pending: Optional[tuple] = None
+
+        def flush() -> None:
+            nonlocal pending
+            if pending is not None:
+                p, pending = pending, None
+                self._finish(p)
+
         for _ in range(max_batches):
-            n = self.schedule_one_batch()
-            if n == 0:
+            popped, runnable = self._pop_runnable()
+            if popped == 0:
+                flush()
                 self.queue.flush_backoff_completed()
-                if self.queue.pending_counts()["active"] == 0:
+                popped, runnable = self._pop_runnable()
+                if popped == 0:
                     break
-            total += n
+            total += popped
+            nxt = None
+            if runnable:
+                chained = self._chain_eligible([qp.pod for qp in runnable])
+                if not chained:
+                    flush()   # next launch needs the synced cache
+                nxt = self._dispatch(runnable, chained, flush_pending=flush)
+            flush()
+            pending = nxt
+        flush()
         return total
