@@ -1,0 +1,32 @@
+"""scheduler_perf-equivalent benchmark harness (SURVEY §2.8).
+
+TPU-native port of /root/reference/test/integration/scheduler_perf: an op
+DSL (createNodes/createNamespaces/createPods/churn/barrier), a 1s-window
+throughput collector with percentiles, per-workload thresholds, and the
+BASELINE workload definitions — all driven through the production
+Scheduler + Hub path (pods created via hub.create_pod, bindings observed
+from the hub's watch stream, exactly how the reference harness observes
+them via the informer).
+"""
+
+from kubernetes_tpu.perf.collector import ThroughputCollector
+from kubernetes_tpu.perf.harness import (
+    Barrier,
+    Churn,
+    CreateNamespaces,
+    CreateNodes,
+    CreatePods,
+    Workload,
+    run_workload,
+)
+
+__all__ = [
+    "Barrier",
+    "Churn",
+    "CreateNamespaces",
+    "CreateNodes",
+    "CreatePods",
+    "ThroughputCollector",
+    "Workload",
+    "run_workload",
+]

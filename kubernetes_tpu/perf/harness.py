@@ -1,0 +1,235 @@
+"""The perf-harness op DSL + workload runner.
+
+TPU-native equivalent of the reference's scheduler_perf test driver
+(test/integration/scheduler_perf/scheduler_perf.go:82-97 op registry,
+:819+ churnOp; util.go:442-630 collector wiring). A Workload is a list of
+ops executed in order against a fresh Hub + production Scheduler:
+
+- CreateNodes / CreateNamespaces: populate the cluster.
+- CreatePods: create pods through hub.create_pod and drain the scheduler
+  until every pod of the op is bound (the reference's
+  waitUntilPodsScheduled); with collect_metrics=True the drain is timed
+  by a ThroughputCollector observing the hub watch stream.
+- Churn: from this point on, create pods from the given templates at a
+  fixed interval while later ops drain (scheduler_perf.go:819 churnOp,
+  mode=create).
+- Barrier: wait for all currently-pending pods to schedule.
+
+The drain drives Scheduler.run_until_idle — the production batched loop
+(queue pop -> mirror pack -> device launch -> framework commit -> hub
+bind) — NOT a raw launch_batch drain, so measured pods/s is
+production-path throughput.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from kubernetes_tpu.api.objects import Namespace, ObjectMeta, Pod
+from kubernetes_tpu.config.types import default_config
+from kubernetes_tpu.hub import EventHandlers, Hub
+from kubernetes_tpu.ops.features import Capacities
+from kubernetes_tpu.perf.collector import ThroughputCollector
+from kubernetes_tpu.scheduler import Scheduler
+
+# ---------------------------------------------------------------- op DSL
+
+
+@dataclass
+class CreateNodes:
+    """createNodes op. ``make_node(i)`` -> Node; zones (if set) are applied
+    by the factory (labelNodePrepareStrategy equivalent is the factory's
+    business — the DSL just counts)."""
+
+    count: int
+    make_node: Callable[[int], object]
+
+
+@dataclass
+class CreateNamespaces:
+    prefix: str
+    count: int
+    labels: Optional[Callable[[int], dict]] = None
+
+
+@dataclass
+class CreatePods:
+    """createPods op: create ``count`` pods via ``make_pod(i)`` and wait
+    for all of them to schedule (waitUntilPodsScheduled). When
+    ``collect_metrics`` the phase is timed."""
+
+    count: int
+    make_pod: Callable[[int], Pod]
+    collect_metrics: bool = False
+    # maximum wall-clock seconds to wait for the phase to finish before
+    # declaring the workload stuck (the reference fails the test case)
+    timeout_s: float = 600.0
+
+
+@dataclass
+class Churn:
+    """churnOp mode=create: once reached, inject one pod per template
+    every ``interval_ms`` while subsequent ops drain."""
+
+    templates: list[Callable[[int], Pod]]
+    interval_ms: int = 200
+
+
+@dataclass
+class Barrier:
+    timeout_s: float = 600.0
+
+
+@dataclass
+class Workload:
+    name: str
+    ops: list
+    threshold: float = 0.0      # reference CI floor, pods/s
+    baseline: float = 0.0       # same as threshold unless overridden
+    node_capacity: int = 8192   # mirror bucket hints (pow2; fixed up front
+    pod_capacity: int = 16384   # so warmup compiles the full-size programs)
+    batch_size: int = 2048
+
+    def __post_init__(self) -> None:
+        if not self.baseline:
+            self.baseline = self.threshold
+
+
+class _ChurnState:
+    def __init__(self, op: Churn, now: Callable[[], float]) -> None:
+        self.op = op
+        self.t0 = now()
+        self.created = 0
+
+    def due(self, t: float) -> int:
+        return int((t - self.t0) * 1000.0 / self.op.interval_ms)
+
+    def inject(self, hub: Hub, t: float) -> None:
+        want = self.due(t)
+        while self.created < want:
+            i = self.created
+            tmpl = self.op.templates[i % len(self.op.templates)]
+            pod = tmpl(i)
+            pod.metadata.name = f"churn-{pod.metadata.name}-{i}"
+            hub.create_pod(pod)
+            self.created += 1
+
+
+# ---------------------------------------------------------------- runner
+
+
+class WorkloadStuck(Exception):
+    """A phase did not finish within its timeout (pods stayed pending)."""
+
+
+def run_workload(w: Workload, now: Callable[[], float] = time.time,
+                 sleep: Callable[[float], None] = time.sleep,
+                 scale: float = 1.0,
+                 config=None) -> dict:
+    """Execute one workload; returns the result dict (throughput summary,
+    threshold verdict, scheduler stats).
+
+    ``scale`` shrinks every op count (for warmup/compile passes and unit
+    tests) while keeping capacities — and therefore every jitted program
+    shape — identical to the full-size run, so a scale=0.01 pass populates
+    the XLA compile cache for the real one.
+    """
+    hub = Hub()
+    cfg = copy.deepcopy(config) if config is not None else default_config()
+    cfg.batch_size = w.batch_size
+    sched = Scheduler(hub, cfg, caps=Capacities(
+        nodes=w.node_capacity, pods=w.pod_capacity), now=now)
+    churns: list[_ChurnState] = []
+    summary = None
+    phases: list[dict] = []
+
+    def scaled(n: int) -> int:
+        return max(1, int(n * scale)) if scale != 1.0 else n
+
+    def pump() -> None:
+        for ch in churns:
+            ch.inject(hub, now())
+
+    def drain(done_fn: Callable[[], bool], timeout_s: float) -> None:
+        """Run the production loop until done_fn(); churn pods are injected
+        between batches; idle waits advance backoff."""
+        deadline = now() + timeout_s
+
+        def step() -> bool:
+            pump()
+            return done_fn()
+
+        while not done_fn():
+            pump()
+            sched.run_until_idle(on_step=step)
+            if done_fn():
+                return
+            if now() > deadline:
+                raise WorkloadStuck(
+                    f"{w.name}: phase timed out after {timeout_s}s "
+                    f"(pending={sched.queue.pending_counts()})")
+            # queue idle but phase incomplete: pods are parked in backoff /
+            # unschedulable (e.g. waiting on preemption victims) or the
+            # next churn pod isn't due yet — let time pass, flush, retry
+            sleep(0.05)
+            sched.queue.flush_backoff_completed()
+
+    for op in w.ops:
+        if isinstance(op, CreateNodes):
+            for i in range(scaled(op.count)):
+                hub.create_node(op.make_node(i))
+        elif isinstance(op, CreateNamespaces):
+            for i in range(op.count):
+                hub.create_namespace(Namespace(metadata=ObjectMeta(
+                    name=f"{op.prefix}-{i}",
+                    labels=op.labels(i) if op.labels else {})))
+        elif isinstance(op, Churn):
+            churns.append(_ChurnState(op, now))
+        elif isinstance(op, Barrier):
+            drain(lambda: len(sched.queue) == 0, op.timeout_s)
+        elif isinstance(op, CreatePods):
+            n = scaled(op.count)
+            pods = [op.make_pod(i) for i in range(n)]
+            uids = {p.metadata.uid for p in pods}
+            collector = None
+            if op.collect_metrics:
+                collector = ThroughputCollector(uids, now)
+                hub.watch_pods(EventHandlers(
+                    on_add=collector.on_add,
+                    on_update=collector.on_update), replay=False)
+                collector.begin()
+            for p in pods:
+                hub.create_pod(p)
+            if collector is not None:
+                drain(collector.done, op.timeout_s)
+                summary = collector.summarize()
+                phases.append({"op": "createPods", "count": n,
+                               "measured": True})
+            else:
+                def all_bound() -> bool:
+                    for u in uids:
+                        p = hub.get_pod(u)
+                        if p is not None and not p.spec.node_name:
+                            return False
+                    return True
+
+                drain(all_bound, op.timeout_s)
+                phases.append({"op": "createPods", "count": n,
+                               "measured": False})
+        else:
+            raise TypeError(f"unknown op {op!r}")
+
+    result = {
+        "name": w.name,
+        "threshold": w.threshold,
+        "stats": dict(sched.stats),
+    }
+    if summary is not None:
+        result.update(summary.to_dict())
+        result["vs_baseline"] = (
+            round(summary.pods_per_sec / w.baseline, 2) if w.baseline else 0)
+        result["passed"] = summary.pods_per_sec >= w.threshold
+    return result

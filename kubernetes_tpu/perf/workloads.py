@@ -1,0 +1,209 @@
+"""The 5 BASELINE workloads, mirroring the reference's performance-config
+shapes (node/pod templates from test/integration/scheduler_perf/templates;
+op sequences and thresholds from the per-suite performance-config.yaml).
+
+Node template (node-default.yaml): cpu 4, memory 32Gi, pods 110.
+Pod template (pod-default.yaml): requests cpu 100m, memory 500Mi.
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api.objects import (
+    Affinity,
+    Container,
+    LABEL_HOSTNAME,
+    LABEL_ZONE,
+    LabelSelector,
+    Node,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PodSpec,
+    ResourceRequirements,
+    TopologySpreadConstraint,
+)
+from kubernetes_tpu.perf.harness import (
+    Churn,
+    CreateNamespaces,
+    CreateNodes,
+    CreatePods,
+    Workload,
+)
+
+
+def _node(i: int, zones: list[str] | None = None) -> Node:
+    """node-default.yaml + labelNodePrepareStrategy zone labels."""
+    name = f"node-{i}"
+    labels = {LABEL_HOSTNAME: name}
+    if zones:
+        labels[LABEL_ZONE] = zones[i % len(zones)]
+    return Node(
+        metadata=ObjectMeta(name=name, labels=labels),
+        spec=NodeSpec(),
+        status=NodeStatus(allocatable={
+            "cpu": "4", "memory": "32Gi", "pods": "110"}))
+
+
+def _pod(name: str, cpu: str = "100m", mem: str = "500Mi",
+         namespace: str = "default", labels: dict | None = None,
+         affinity: Affinity | None = None, tsc: list | None = None,
+         priority: int | None = None) -> Pod:
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=namespace,
+                            labels=labels or {}),
+        spec=PodSpec(
+            containers=[Container(
+                name="pause",
+                resources=ResourceRequirements(
+                    requests={"cpu": cpu, "memory": mem}))],
+            affinity=affinity,
+            topology_spread_constraints=tsc or [],
+            priority=priority))
+
+
+# ------------------------------------------------- 1. SchedulingBasic
+# misc/performance-config.yaml:40-66 (5000Nodes_10000Pods, threshold 270)
+
+def scheduling_basic(init_nodes=5000, init_pods=1000,
+                     measure_pods=10000) -> Workload:
+    return Workload(
+        name="SchedulingBasic/5000Nodes_10000Pods",
+        threshold=270,
+        ops=[
+            CreateNodes(init_nodes, _node),
+            CreatePods(init_pods, lambda i: _pod(f"init-{i}")),
+            CreatePods(measure_pods, lambda i: _pod(f"measure-{i}"),
+                       collect_metrics=True),
+        ])
+
+
+# ------------------------------------------- 2. SchedulingNodeAffinity
+# affinity/performance-config.yaml:280-330 (5000Nodes_10000Pods, 220):
+# nodes labeled zone1; measured pods require zone In [zone1, zone2]
+# (pod-with-node-affinity.yaml); scoring includes BalancedAllocation via
+# the default plugin set.
+
+def _node_affinity_pod(i: int) -> Pod:
+    aff = Affinity(node_affinity=NodeAffinity(required=NodeSelector(
+        node_selector_terms=[NodeSelectorTerm(match_expressions=[
+            NodeSelectorRequirement(key=LABEL_ZONE, operator="In",
+                                    values=["zone1", "zone2"])])])))
+    return _pod(f"na-{i}", affinity=aff)
+
+
+def scheduling_node_affinity(init_nodes=5000, init_pods=5000,
+                             measure_pods=10000) -> Workload:
+    return Workload(
+        name="SchedulingNodeAffinity/5000Nodes_10000Pods",
+        threshold=220,
+        pod_capacity=32768,
+        ops=[
+            CreateNodes(init_nodes, lambda i: _node(i, zones=["zone1"])),
+            CreatePods(init_pods, lambda i: _pod(f"init-{i}")),
+            CreatePods(measure_pods, _node_affinity_pod,
+                       collect_metrics=True),
+        ])
+
+
+# --------------------------------------- 3. SchedulingPodAntiAffinity
+# affinity/performance-config.yaml:20-70 (5000Nodes_2000Pods, 60):
+# 2 namespaces; pods labeled color=green with required hostname
+# anti-affinity across both namespaces
+# (pod-with-pod-anti-affinity.yaml).
+
+def _anti_affinity_pod(i: int, ns: str) -> Pod:
+    aff = Affinity(pod_anti_affinity=PodAntiAffinity(required=[
+        PodAffinityTerm(
+            topology_key=LABEL_HOSTNAME,
+            label_selector=LabelSelector(match_labels={"color": "green"}),
+            namespaces=["sched-1", "sched-0"])]))
+    return _pod(f"anti-{ns}-{i}", namespace=ns,
+                labels={"color": "green"}, affinity=aff)
+
+
+def scheduling_pod_anti_affinity(init_nodes=5000, init_pods=1000,
+                                 measure_pods=2000) -> Workload:
+    return Workload(
+        name="SchedulingPodAntiAffinity/5000Nodes_2000Pods",
+        threshold=60,
+        ops=[
+            CreateNodes(init_nodes, _node),
+            CreateNamespaces("sched", 2),
+            CreatePods(init_pods,
+                       lambda i: _anti_affinity_pod(i, "sched-0")),
+            CreatePods(measure_pods,
+                       lambda i: _anti_affinity_pod(i, "sched-1"),
+                       collect_metrics=True),
+        ])
+
+
+# ------------------------------------------- 4. TopologySpreading
+# topology_spreading/performance-config.yaml:21-70 (5000Nodes_5000Pods,
+# 85): nodes across 3 zones; measured pods spread maxSkew=5 on zone
+# (pod-with-topology-spreading.yaml).
+
+def _spreading_pod(i: int) -> Pod:
+    tsc = [TopologySpreadConstraint(
+        max_skew=5, topology_key=LABEL_ZONE,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"color": "blue"}))]
+    return _pod(f"spread-{i}", labels={"color": "blue"}, tsc=tsc)
+
+
+def topology_spreading(init_nodes=5000, init_pods=5000,
+                       measure_pods=5000) -> Workload:
+    return Workload(
+        name="TopologySpreading/5000Nodes_5000Pods",
+        threshold=85,
+        pod_capacity=32768,
+        ops=[
+            CreateNodes(init_nodes, lambda i: _node(
+                i, zones=["moon-1", "moon-2", "moon-3"])),
+            CreatePods(init_pods, lambda i: _pod(f"init-{i}")),
+            CreatePods(measure_pods, _spreading_pod, collect_metrics=True),
+        ])
+
+
+# ------------------------------------------- 5. PreemptionAsync
+# misc/performance-config.yaml:195-250 (5000Nodes, 160): 20k low-priority
+# 900m fillers (4 per 4-CPU node), churn creating a 3000m priority-10 pod
+# every 200ms (each must preempt 3 fillers), 5000 always-schedulable
+# 100m measured pods.
+
+def _low_priority_pod(i: int) -> Pod:
+    return _pod(f"low-{i}", cpu="900m", mem="500Mi")
+
+
+def _high_priority_pod(i: int) -> Pod:
+    return _pod(f"high-{i}", cpu="3000m", mem="500Mi", priority=10)
+
+
+def preemption_async(init_nodes=5000, init_pods=20000,
+                     measure_pods=5000) -> Workload:
+    return Workload(
+        name="PreemptionAsync/5000Nodes",
+        threshold=160,
+        pod_capacity=32768,
+        ops=[
+            CreateNodes(init_nodes, _node),
+            CreatePods(init_pods, _low_priority_pod),
+            Churn([_high_priority_pod], interval_ms=200),
+            CreatePods(measure_pods, lambda i: _pod(f"measure-{i}"),
+                       collect_metrics=True),
+        ])
+
+
+ALL_WORKLOADS = (
+    scheduling_basic,
+    scheduling_node_affinity,
+    scheduling_pod_anti_affinity,
+    topology_spreading,
+    preemption_async,
+)

@@ -447,7 +447,8 @@ class Scheduler:
 
     # ------------- driving -------------
 
-    def run_until_idle(self, max_batches: int = 1000) -> int:
+    def run_until_idle(self, max_batches: int = 1000,
+                       on_step=None) -> int:
         """Drain the activeQ (tests/bench); returns pods attempted.
 
         Pipelined: while launch k computes on device, batch k+1 is popped,
@@ -455,7 +456,13 @@ class Scheduler:
         (BatchResult.free/.nzr); batch k's host-side commits then overlap
         launch k+1's device time. Falls back to strict launch->commit
         alternation whenever the next batch cannot chain (topology or host
-        ports in play, or an external event invalidated the chain)."""
+        ports in play, or an external event invalidated the chain).
+
+        ``on_step`` (if given) runs once per loop iteration before the pop —
+        the perf harness injects churn pods through it
+        (scheduler_perf.go:819 churnOp). A truthy return stops the drain
+        (pending work is still committed): with a churn feed the queue may
+        never go idle, so the harness signals "measured phase done" here."""
         total = 0
         pending: Optional[tuple] = None
 
@@ -466,6 +473,8 @@ class Scheduler:
                 self._finish(p)
 
         for _ in range(max_batches):
+            if on_step is not None and on_step():
+                break
             popped, runnable = self._pop_runnable()
             if popped == 0:
                 flush()
