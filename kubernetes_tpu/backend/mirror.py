@@ -111,6 +111,12 @@ class LaunchSpec:
     active: tuple[str, ...]
     pfields: tuple[str, ...]
     ptmpl: PodBlobs
+    # topology dedup groups (see pipeline: group-level topology statics).
+    # gid [B] i32: per-pod group id; rep [G_cap] i32: representative pod row
+    # per group (padded); g_cap: static pow2 group-count bucket.
+    gid: jnp.ndarray | None = None
+    rep: jnp.ndarray | None = None
+    g_cap: int = 0
 
 
 class CapacityError(Exception):
@@ -1131,18 +1137,8 @@ class Mirror:
         from the device-resident template (pod_template_blobs), keeping the
         per-batch host->device transfer proportional to what the workload
         uses instead of the full schema."""
-        if not pods:
-            raise ValueError("empty batch")
-        if len(pods) > batch_size:
-            raise ValueError(f"{len(pods)} pods exceed batch_size {batch_size}")
-        # prepass: register every batch pod's label keys so a term packed for
-        # pod i can reference a column pod j>i carries, and note every batch
-        # namespace so term nsSelector unrolls see all of them
-        for pod in pods:
-            self._note_namespace(pod.metadata.namespace)
-            for k in pod.metadata.labels:
-                self.pod_label_col(k)
         if fields is None:
+            self._batch_prepass(pods, batch_size)
             f32, i32 = self.pod_codec.alloc(batch_size)
             tf32, ti32 = self._pod_template()
             f32[: len(pods)] = tf32
@@ -1152,6 +1148,28 @@ class Mirror:
                                          self.pack_pod(pod, active_only=True))
             # padding rows stay zeroed => valid False
             return PodBlobs(f32=jnp.asarray(f32), i32=jnp.asarray(i32))
+        f32, i32 = self._pack_batch_np(pods, batch_size, fields)
+        return PodBlobs(f32=jnp.asarray(f32), i32=jnp.asarray(i32))
+
+    def _batch_prepass(self, pods: list[Pod], batch_size: int) -> None:
+        """Validate + register every batch pod's label keys so a term packed
+        for pod i can reference a column pod j>i carries, and note every
+        batch namespace so term nsSelector unrolls see all of them."""
+        if not pods:
+            raise ValueError("empty batch")
+        if len(pods) > batch_size:
+            raise ValueError(f"{len(pods)} pods exceed batch_size {batch_size}")
+        for pod in pods:
+            self._note_namespace(pod.metadata.namespace)
+            for k in pod.metadata.labels:
+                self.pod_label_col(k)
+
+    def _pack_batch_np(self, pods: list[Pod], batch_size: int,
+                       fields: tuple[str, ...]
+                       ) -> tuple[np.ndarray, np.ndarray]:
+        """Subset-packed batch rows as host arrays (pack_batch_blobs body;
+        prepare_launch also hashes these rows for topology-group dedup)."""
+        self._batch_prepass(pods, batch_size)
         tmpl = self._subset_tmpl.get(fields)
         if tmpl is None:
             tf32, ti32 = self._pod_template()
@@ -1163,7 +1181,63 @@ class Mirror:
         for b, pod in enumerate(pods):
             self.pod_codec.pack_into_subset(
                 fields, f32[b], i32[b], self.pack_pod(pod, active_only=True))
-        return PodBlobs(f32=jnp.asarray(f32), i32=jnp.asarray(i32))
+        return f32, i32
+
+    # identity fields excluded from the topology-group signature: two pods
+    # differing ONLY in these compute identical topology statics (name/uid
+    # feed tie-breaking and diagnostics, which stay per-pod). Exception:
+    # NOMINATED pods keep their uid in the signature — the pod table's
+    # self-exclusion (topology.table_mask) compares table-entry uids against
+    # the scheduled pod's uid, so a nominated pod sharing a group with
+    # another pod would inherit the representative's self-exclusion.
+    GROUP_IGNORED_FIELDS = ("name_id", "uid_id")
+
+    def _batch_groups(self, f32: np.ndarray, i32: np.ndarray, n_pods: int,
+                      fields: tuple[str, ...]
+                      ) -> tuple[np.ndarray, np.ndarray, int]:
+        """Dedup batch rows into topology groups: (gid [B], rep [G_cap],
+        g_cap). Pods with byte-identical packed rows (minus identity fields)
+        share all topology statics and pairwise term matches, so the device
+        computes them once per GROUP (pipeline phase-1/scan); padding rows
+        form their own group."""
+        batch_size = f32.shape[0]
+        f_off, i_off, _, _ = self.pod_codec.subset_layout(fields)
+        fh = f32[:n_pods]
+        ih = i32[:n_pods].copy()
+        nominated = None
+        if "nominated_row" in i_off:
+            noff, _ = i_off["nominated_row"]
+            nominated = ih[:, noff] != NONE
+        for name in self.GROUP_IGNORED_FIELDS:
+            if name in i_off:
+                off, size = i_off[name]
+                if nominated is None:
+                    ih[:, off:off + size] = 0
+                else:   # keep identity for nominated pods (see above)
+                    ih[~nominated, off:off + size] = 0
+        gid = np.zeros((batch_size,), np.int32)
+        seen: dict[bytes, int] = {}
+        reps: list[int] = []
+        for b in range(n_pods):
+            key = fh[b].tobytes() + ih[b].tobytes()
+            g = seen.get(key)
+            if g is None:
+                g = len(reps)
+                seen[key] = g
+                reps.append(b)
+            gid[b] = g
+        if n_pods < batch_size:          # padding rows: one shared group
+            gid[n_pods:] = len(reps)
+            reps.append(n_pods)
+        # min 2: a full homogeneous batch (no padding group) would otherwise
+        # bucket to g_cap=1 while partial batches of the same workload get 2,
+        # flapping the static arg and recompiling between them
+        g_cap = 2
+        while g_cap < len(reps):
+            g_cap *= 2
+        rep = np.full((g_cap,), reps[0], np.int32)
+        rep[: len(reps)] = reps
+        return gid, rep, g_cap
 
     def pack_batch(self, pods: list[Pod], batch_size: int) -> PodFeatures:
         """PodFeatures view of a packed batch (jitted unpack; test/tooling)."""
@@ -1225,8 +1299,17 @@ class Mirror:
         feats = self.launch_features(pods)
         enable = self.batch_has_topology(pods) or self.table_has_topology()
         pfields = self.pod_fields(feats, enable)
-        pblobs = self.pack_batch_blobs(pods, batch_size, pfields)
+        f32, i32 = self._pack_batch_np(pods, batch_size, pfields)
+        pblobs = PodBlobs(f32=jnp.asarray(f32), i32=jnp.asarray(i32))
+        gid = rep = None
+        g_cap = 0
+        if enable:
+            gid_np, rep_np, g_cap = self._batch_groups(
+                f32, i32, len(pods), pfields)
+            gid = jnp.asarray(gid_np)
+            rep = jnp.asarray(rep_np)
         return LaunchSpec(cblobs=self.to_blobs(), pblobs=pblobs,
                           enable_topology=enable, d_cap=self.domain_bucket(),
                           active=feats, pfields=pfields,
-                          ptmpl=self.pod_template_blobs())
+                          ptmpl=self.pod_template_blobs(),
+                          gid=gid, rep=rep, g_cap=g_cap)

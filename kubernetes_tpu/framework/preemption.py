@@ -58,6 +58,12 @@ class Evaluator:
         self._get_enabled_filters = get_enabled_filters
         self.nominator = nominator
         self._rng = rng or random.Random(0)
+        # request-row cache: a victim's packed resource row is immutable per
+        # uid FOR A GIVEN MIRROR — a re-bucketed mirror changes res_cols and
+        # ext-resource column order, so the cache is tied to the mirror
+        # object and dropped when the scheduler rebuilds it
+        self._res_rows: dict[str, np.ndarray] = {}
+        self._res_rows_mirror: object = None
 
     # ---------------- eligibility (default_preemption.go:327) -------------
 
@@ -92,14 +98,18 @@ class Evaluator:
         prio = pod.priority()
 
         # per-node victims ascending by importance (evict least-important
-        # first): priority asc, then start time desc (younger first)
+        # first): priority asc, then start time desc (younger first).
+        # Nodes with no victims are skipped: the sweep only selects rows
+        # with 1 <= kmin <= len(victims), and an empty row can never win.
         victims_by_row: dict[int, list] = {}
         k_max = 0
         for info in snapshot.node_info_list:
+            vs = [pi for pi in info.pods if pi.pod.priority() < prio]
+            if not vs:
+                continue
             row = mirror.row_of(info.name)
             if row < 0:
                 continue
-            vs = [pi for pi in info.pods if pi.pod.priority() < prio]
             vs.sort(key=lambda pi: (pi.pod.priority(),
                                     -pi.pod.metadata.creation_timestamp))
             victims_by_row[row] = vs
@@ -110,18 +120,32 @@ class Evaluator:
         while k_cap < k_max:
             k_cap *= 2
 
-        # cumulative freed request per victim prefix
+        # cumulative freed request per victim prefix (vectorized: the per-
+        # victim python accumulation was the preemption hot spot at 20k
+        # victims — one np.cumsum per node + a uid-keyed res-row cache)
         n = caps.nodes
         r = caps.res_cols
+        if self._res_rows_mirror is not mirror:
+            self._res_rows.clear()
+            self._res_rows_mirror = mirror
+        res_rows = self._res_rows
+        if len(res_rows) > 200_000:
+            res_rows.clear()
         cumsum = np.zeros((n, k_cap + 1, r), np.float32)
         for row, vs in victims_by_row.items():
-            acc = np.zeros((r,), np.float32)
-            for k, pi in enumerate(vs):
-                acc = acc + mirror._res_row(pi.request)
-                acc[F.COL_PODS] = k + 1.0
-                cumsum[row, k + 1] = acc
+            rows_k = []
+            for pi in vs:
+                uid = pi.pod.metadata.uid
+                rr = res_rows.get(uid)
+                if rr is None:
+                    rr = np.asarray(mirror._res_row(pi.request), np.float32)
+                    res_rows[uid] = rr
+                rows_k.append(rr)
+            acc = np.cumsum(np.stack(rows_k), axis=0)          # [k, R]
+            acc[:, F.COL_PODS] = np.arange(1, len(vs) + 1, dtype=np.float32)
+            cumsum[row, 1: len(vs) + 1] = acc
             if len(vs) < k_cap:
-                cumsum[row, len(vs) + 1:] = acc  # padding: no extra victims
+                cumsum[row, len(vs) + 1:] = acc[-1]  # pad: no extra victims
 
         pblobs = mirror.pack_batch_blobs([pod], 1)
         cblobs = mirror.to_blobs()

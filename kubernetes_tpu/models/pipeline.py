@@ -85,6 +85,10 @@ HARD_POD_AFFINITY_WEIGHT = 1.0
 # [chunk, selector-capacity, N] gather footprint for giant drain batches
 PHASE1_CHUNK = 1024
 
+# commit-scan unroll factor (see the lax.scan call): amortizes per-iteration
+# dispatch overhead, which dominates the topology scan at these shapes
+SCAN_UNROLL = 8
+
 
 @jax.tree_util.register_dataclass
 @dataclass
@@ -284,7 +288,10 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                    state: tuple[jnp.ndarray, jnp.ndarray] | None = None,
                    active: tuple[str, ...] | None = None,
                    pfields: tuple[str, ...] | None = None,
-                   ptmpl: PodBlobs | None = None
+                   ptmpl: PodBlobs | None = None,
+                   gid: jnp.ndarray | None = None,
+                   rep: jnp.ndarray | None = None,
+                   g_cap: int = 0
                    ) -> BatchResult:
     """Schedule a whole pod batch in one launch, as-if-serial (see module
     docstring for the two-phase structure).
@@ -305,7 +312,17 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
     ``state`` optionally overrides the cluster's (free, nonzero_requested)
     usage tensors with the previous launch's BatchResult.free/.nzr — the
     device-resident chain that lets a multi-batch drain run without host
-    mirror re-syncs in between."""
+    mirror re-syncs in between.
+
+    ``gid``/``rep``/``g_cap`` (Mirror._batch_groups) dedup the batch into
+    TOPOLOGY GROUPS: pods whose packed rows differ only in identity fields
+    compute identical topology statics and pairwise term matches, so both
+    the phase-1 statics and the commit scan's in-batch maps are computed per
+    GROUP, not per pod. Real workloads are deployment-shaped (few distinct
+    specs per batch), which turns the former per-pod scatter storm — TPU
+    scatters run ~100x below bandwidth — into a handful of small dense
+    updates. g_cap is a static pow2 bucket; a fully heterogeneous batch
+    (g_cap == B) is still exact, just back to per-pod cost."""
     ct = unpack_cluster(cblobs, caps)
     pods = unpack_pods(pblobs, caps, pfields, ptmpl)  # leaves [B, ...]
     free0 = ct.free if state is None else state[0]
@@ -323,8 +340,17 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
     ipa_on = (enable_topology
               and enabled_filters[FILTER_PLUGINS.index("InterPodAffinity")])
     tds = T.slot_topo_dom(ct)  # [PT, TK], shared across the batch
+    if enable_topology and gid is None:
+        # direct callers without host grouping: every pod its own group.
+        # NOTE: at large B this materializes O(B*N)-sized scan-carry maps —
+        # production callers go through Mirror.prepare_launch, whose host
+        # dedup keeps g_cap at the number of DISTINCT pod specs
+        nb = pblobs.f32.shape[0]
+        gid = jnp.arange(nb, dtype=jnp.int32)
+        rep = jnp.arange(nb, dtype=jnp.int32)
+        g_cap = nb
 
-    # ---- phase 1: parallel over the batch ----
+    # ---- phase 1: parallel over the batch (per-pod base statics) ----
     def per_pod(pod: PodFeatures):
         masks = static_filters(ct, pod, wk, enabled_filters, act)  # [5, N]
         static_ok = jnp.all(masks, axis=0) & valid & pod.valid  # [N]
@@ -346,88 +372,133 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
         # fit can never succeed: request exceeds allocatable (Unresolvable)
         unresolvable = jnp.any(pod.req[None] > ct.allocatable, axis=-1)
         unres_count = jnp.sum(unresolvable & valid).astype(jnp.int32)
-        if not enable_topology:
-            return (static_ok, static_rejects, taint_raw, aff_raw, img,
-                    unres_count)
-        # topology plugins: pre-batch-table statics here; the commit scan
-        # layers in-batch deltas for full as-if-serial semantics
-        taint_ok, nodeaff_ok = masks[2], masks[3]
-        used_c = pod.tsc_tk != jnp.int32(-1)
-        used_soft = used_c & ~pod.tsc_hard
-        el_hard = T.spread_eligible(ct, pod, nodeaff_ok, taint_ok,
-                                    used_c & pod.tsc_hard)
-        el_soft = T.spread_eligible(ct, pod, nodeaff_ok, taint_ok, used_soft)
-        el_mixed = jnp.where(pod.tsc_hard[None], el_hard, el_soft)
-        cnt = T.spread_cnt(ct, pod, tds, el_mixed, d_cap)      # [C, D]
-        exists_hard = T.spread_exists(ct, pod, el_hard, d_cap)  # [C, D]
-        node_dom = T.take_cols(ct.topo_dom, pod.tsc_tk, jnp.int32(-1))
-        spread_ignored = jnp.any((node_dom == jnp.int32(-1))
-                                 & used_soft[None], axis=1)     # [N]
-        # topoSize over (approximately) filtered nodes: static filters only,
-        # matching PreScore's filteredNodes modulo in-batch effects
-        exists_score = T.spread_exists(
-            ct, pod, (static_ok & ~spread_ignored)[:, None] & used_soft[None],
-            d_cap)
-        tp_weight = jnp.log(jnp.sum(exists_score, axis=1)
-                            .astype(jnp.float32) + 2.0)         # [C]
-        tsc_self = T._tsc_self_match(pod).astype(jnp.float32)   # [C]
-        ipa_anti_ok, aff_present, aff_any = T.inter_pod_affinity_static(
-            ct, pod, tds, d_cap)
-        ipa_raw = T.inter_pod_affinity_score(
-            ct, pod, tds, d_cap, jnp.float32(HARD_POD_AFFINITY_WEIGHT))
-        has_soft = jnp.any(used_soft)
-        nodeaff_v = nodeaff_ok & valid
-        taint_v = taint_ok & valid
         return (static_ok, static_rejects, taint_raw, aff_raw, img,
-                unres_count, cnt, exists_hard, spread_ignored, tp_weight,
-                tsc_self, ipa_anti_ok, aff_present, aff_any, ipa_raw,
-                has_soft, nodeaff_v, taint_v)
+                unres_count)
 
-    # phase-1 memory scales with B × selector-capacity × N (the label/term
-    # gathers); chunk the vmap through lax.map so giant drain batches stay
-    # inside HBM — per-chunk peak is what a PHASE1_CHUNK-sized batch needs
-    B_all = pblobs.f32.shape[0]
-    if B_all > PHASE1_CHUNK:
-        pad = (-B_all) % PHASE1_CHUNK
-        pods_p = pods if pad == 0 else jax.tree.map(
-            lambda x: jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1)), pods)
-        groups = (B_all + pad) // PHASE1_CHUNK
-        pods_g = jax.tree.map(
-            lambda x: x.reshape((groups, PHASE1_CHUNK) + x.shape[1:]), pods_p)
-        outs = jax.lax.map(lambda p: jax.vmap(per_pod)(p), pods_g)
-        outs = jax.tree.map(
+    def chunked_vmap(fn, tree, n_rows):
+        """vmap chunked through lax.map so giant batches stay inside HBM —
+        per-chunk peak is what a PHASE1_CHUNK-sized batch needs."""
+        if n_rows <= PHASE1_CHUNK:
+            return jax.vmap(fn)(tree)
+        pad = (-n_rows) % PHASE1_CHUNK
+        tree_p = tree if pad == 0 else jax.tree.map(
+            lambda x: jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1)), tree)
+        groups = (n_rows + pad) // PHASE1_CHUNK
+        tree_g = jax.tree.map(
+            lambda x: x.reshape((groups, PHASE1_CHUNK) + x.shape[1:]), tree_p)
+        outs = jax.lax.map(lambda p: jax.vmap(fn)(p), tree_g)
+        return jax.tree.map(
             lambda x: x.reshape((groups * PHASE1_CHUNK,)
-                                + x.shape[2:])[:B_all], outs)
-    else:
-        outs = jax.vmap(per_pod)(pods)
-    (static_ok, static_rejects, taint_raw, aff_raw, img, unres) = outs[:6]
+                                + x.shape[2:])[:n_rows], outs)
+
+    B_all = pblobs.f32.shape[0]
+    outs = chunked_vmap(per_pod, pods, B_all)
+    (static_ok, static_rejects, taint_raw, aff_raw, img, unres) = outs
     if not serial_scan:
         if enable_topology:
             raise ValueError("auction commit requires a no-topology launch")
         return _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw,
                               aff_raw, img, unres, weights, free0, nzr0)
     if enable_topology:
-        (cnt_s, exists_hard, spread_ignored, tp_weight, tsc_self,
-         ipa_anti_ok, aff_present, aff_any, ipa_raw, has_soft,
-         nodeaff_v, taint_v) = outs[6:]
-        # pairwise pod<->pod term matches (placement-independent)
-        M_anti = T.pair_term_match(pods.anti_tk, pods.anti_ns,
-                                   pods.anti_ns_all, pods.anti_sel_cols,
-                                   pods.anti_sel_ops, pods.anti_sel_vals,
-                                   pods.plabel_vals, pods.ns, pods.valid)
-        M_aff = T.pair_term_match(pods.aff_tk, pods.aff_ns,
-                                  pods.aff_ns_all, pods.aff_sel_cols,
-                                  pods.aff_sel_ops, pods.aff_sel_vals,
-                                  pods.plabel_vals, pods.ns, pods.valid)
-        M_paff = T.pair_term_match(pods.paff_tk, pods.paff_ns,
-                                   pods.paff_ns_all, pods.paff_sel_cols,
-                                   pods.paff_sel_ops, pods.paff_sel_vals,
-                                   pods.plabel_vals, pods.ns, pods.valid)
-        M_panti = T.pair_term_match(pods.panti_tk, pods.panti_ns,
-                                    pods.panti_ns_all, pods.panti_sel_cols,
-                                    pods.panti_sel_ops, pods.panti_sel_vals,
-                                    pods.plabel_vals, pods.ns, pods.valid)
-        M_tsc = T.pair_tsc_match(pods)                          # [B, C, B]
+        # ---- phase 1b: topology statics per GROUP (representatives) ----
+        pods_rep = jax.tree.map(lambda x: x[rep], pods)  # leaves [G, ...]
+
+        def per_group(pod: PodFeatures):
+            masks = static_filters(ct, pod, wk, enabled_filters, act)
+            g_static_ok = jnp.all(masks, axis=0) & valid & pod.valid
+            taint_ok, nodeaff_ok = masks[2], masks[3]
+            used_c = pod.tsc_tk != jnp.int32(-1)
+            used_hard = used_c & pod.tsc_hard
+            used_soft = used_c & ~pod.tsc_hard
+            el_hard = T.spread_eligible(ct, pod, nodeaff_ok, taint_ok,
+                                        used_hard)
+            el_soft = T.spread_eligible(ct, pod, nodeaff_ok, taint_ok,
+                                        used_soft)
+            el_mixed = jnp.where(pod.tsc_hard[None], el_hard, el_soft)
+            cnt = T.spread_cnt(ct, pod, tds, el_mixed, d_cap)      # [C, D]
+            exists_hard = T.spread_exists(ct, pod, el_hard, d_cap)  # [C, D]
+            node_dom = T.take_cols(ct.topo_dom, pod.tsc_tk, jnp.int32(-1))
+            spread_ignored = jnp.any((node_dom == jnp.int32(-1))
+                                     & used_soft[None], axis=1)     # [N]
+            # topoSize over (approximately) filtered nodes: static filters
+            # only, matching PreScore's filteredNodes modulo in-batch effects
+            exists_score = T.spread_exists(
+                ct, pod,
+                (g_static_ok & ~spread_ignored)[:, None] & used_soft[None],
+                d_cap)
+            tp_weight = jnp.log(jnp.sum(exists_score, axis=1)
+                                .astype(jnp.float32) + 2.0)         # [C]
+            tsc_self = T._tsc_self_match(pod).astype(jnp.float32)   # [C]
+            ipa_anti_ok, aff_present, aff_any = T.inter_pod_affinity_static(
+                ct, pod, tds, d_cap)
+            ipa_raw = T.inter_pod_affinity_score(
+                ct, pod, tds, d_cap, jnp.float32(HARD_POD_AFFINITY_WEIGHT))
+            has_soft = jnp.any(used_soft)
+            # in-batch spread eligibility of ANY node as a commit target for
+            # this group's constraints (policies + topology-label presence;
+            # the commit scan gathers it at each committed node)
+            pol = (jnp.where(pod.tsc_honor_affinity[None],
+                             (nodeaff_ok & valid)[:, None], True)
+                   & jnp.where(pod.tsc_honor_taints[None],
+                               (taint_ok & valid)[:, None], True))  # [N, C]
+            dom_ok = node_dom != jnp.int32(-1)                      # [N, C]
+            all_h = jnp.all(dom_ok | ~used_hard[None], axis=1)      # [N]
+            all_s = jnp.all(dom_ok | ~used_soft[None], axis=1)      # [N]
+            el_node = (pol & jnp.where(used_hard[None], all_h[:, None],
+                                       all_s[:, None]) & used_c[None])
+            # node-space statics so the commit scan never gathers by domain:
+            # required-affinity term satisfaction from the PRE-batch table,
+            # spread match counts at each node's domain, domain presence
+            aff_node_dom = T.take_cols(ct.topo_dom, pod.aff_tk, NONE)  # [N, A]
+            has_lbl = aff_node_dom != NONE
+            term_static = has_lbl & T.gather_rows(aff_present, aff_node_dom)
+            match_static = T.gather_rows(cnt, node_dom)              # [N, C]
+            num_domains = jnp.sum(exists_hard, axis=1)               # [C]
+            return (cnt, exists_hard, spread_ignored, tp_weight, tsc_self,
+                    ipa_anti_ok, aff_any, ipa_raw, has_soft,
+                    el_node, term_static, has_lbl, match_static, dom_ok,
+                    num_domains)
+
+        (cnt_g, exists_hard_g, ign_g, tpw_g, self_g, ipa_anti_g,
+         aff_any_g, ipa_raw_g, has_soft_g, el_node_g, term_static_g,
+         has_lbl_g, match_static_g, dom_ok_g,
+         num_domains_g) = chunked_vmap(per_group, pods_rep, g_cap)
+        # [N, G, C] so the scan dynamic-slices a committed node's row
+        el_node_nr = jnp.transpose(el_node_g, (1, 0, 2))
+        # group-level term tables (the scan indexes these by group id)
+        anti_tk_g = pods_rep.anti_tk                        # [G, A]
+        aff_tk_g = pods_rep.aff_tk
+        paff_tk_g = pods_rep.paff_tk
+        panti_tk_g = pods_rep.panti_tk
+        paff_w_g = pods_rep.paff_weight.astype(jnp.float32)
+        panti_w_g = pods_rep.panti_weight.astype(jnp.float32)
+        tsc_tk_g = pods_rep.tsc_tk                          # [G, C]
+        tsc_hard_g = pods_rep.tsc_hard
+        tsc_skew_g = pods_rep.tsc_max_skew
+        tsc_mind_g = pods_rep.tsc_min_domains
+        aff_self_g = pods_rep.aff_self_match                # [G]
+        # pairwise GROUP<->GROUP term matches (placement-independent)
+        M_anti_gg = T.pair_term_match(
+            pods_rep.anti_tk, pods_rep.anti_ns, pods_rep.anti_ns_all,
+            pods_rep.anti_sel_cols, pods_rep.anti_sel_ops,
+            pods_rep.anti_sel_vals, pods_rep.plabel_vals, pods_rep.ns,
+            pods_rep.valid)                                 # [G, A, G]
+        M_aff_gg = T.pair_term_match(
+            pods_rep.aff_tk, pods_rep.aff_ns, pods_rep.aff_ns_all,
+            pods_rep.aff_sel_cols, pods_rep.aff_sel_ops,
+            pods_rep.aff_sel_vals, pods_rep.plabel_vals, pods_rep.ns,
+            pods_rep.valid)
+        M_paff_gg = T.pair_term_match(
+            pods_rep.paff_tk, pods_rep.paff_ns, pods_rep.paff_ns_all,
+            pods_rep.paff_sel_cols, pods_rep.paff_sel_ops,
+            pods_rep.paff_sel_vals, pods_rep.plabel_vals, pods_rep.ns,
+            pods_rep.valid)
+        M_panti_gg = T.pair_term_match(
+            pods_rep.panti_tk, pods_rep.panti_ns, pods_rep.panti_ns_all,
+            pods_rep.panti_sel_cols, pods_rep.panti_sel_ops,
+            pods_rep.panti_sel_vals, pods_rep.plabel_vals, pods_rep.ns,
+            pods_rep.valid)
+        M_tsc_gg = T.pair_tsc_match(pods_rep)               # [G, C, G]
 
     # ---- phase 2: sequential commit scan (tiny per-step work) ----
     alloc2 = SC.alloc_cpu_mem(ct)                               # [N, 2]
@@ -446,93 +517,145 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
     topo_dom = ct.topo_dom
     tk_cap = topo_dom.shape[1]
 
+    def queries(g, forbid1_n, map2_n, pres_n, any3, wscore_n, cntmap,
+                cnt_match_n):
+        """Per-step topology verdicts for a group-g pod from the carry maps
+        (committed pods 0..b-1 already folded in). Node-space maps make
+        every query a dynamic-slice by group id — no device gathers."""
+        fail1 = forbid1_n[g]                                      # [N]
+        fail2 = map2_n[g]                                         # [N]
+        # required affinity incl. committed pods (step_affinity_ok)
+        term_used = aff_tk_g[g] != NONE                           # [A]
+        term_ok = term_static_g[g] | pres_n[g].T                  # [N, A]
+        pods_exist = jnp.all(term_ok | ~term_used[None], axis=1)
+        all_lbl = jnp.all(has_lbl_g[g] | ~term_used[None], axis=1)
+        any_match = aff_any_g[g] | any3[g]
+        self_ok = aff_self_g[g] & ~any_match & all_lbl
+        aff_ok = jnp.where(jnp.any(term_used), pods_exist | self_ok, True)
+        ipa_ok = ipa_anti_g[g] & ~fail1 & ~fail2 & aff_ok
+        # spread with live counts (step_spread semantics, gather-free:
+        # domain-space counts feed the min, node-space counts the match)
+        used = tsc_tk_g[g] != NONE                                # [C]
+        used_hard = used & tsc_hard_g[g]
+        used_soft = used & ~tsc_hard_g[g]
+        cnt_live = cnt_g[g] + cntmap[g]                           # [C, D]
+        exists = exists_hard_g[g]
+        min_cnt = jnp.min(jnp.where(exists, cnt_live, jnp.inf), axis=1)
+        min_cnt = jnp.where(jnp.isfinite(min_cnt), min_cnt, 0.0)
+        min_cnt = jnp.where((tsc_mind_g[g] > 0)
+                            & (num_domains_g[g] < tsc_mind_g[g]),
+                            0.0, min_cnt)                         # [C]
+        match_num = match_static_g[g] + cnt_match_n[g].T          # [N, C]
+        skew = match_num + self_g[g][None] - min_cnt[None]
+        ok_c = dom_ok_g[g] & (skew <= tsc_skew_g[g][None])
+        sp_ok = jnp.all(ok_c | ~used_hard[None], axis=1)          # [N]
+        per_c = match_num * tpw_g[g][None] \
+            + (tsc_skew_g[g][None].astype(jnp.float32) - 1.0)
+        per_c = jnp.where(used_soft[None] & dom_ok_g[g], per_c, 0.0)
+        sp_r = jnp.where(ign_g[g], 0.0, jnp.sum(per_c, axis=1))
+        # ipa score with committed-pod weighted deltas
+        ipa_live = ipa_raw_g[g] + wscore_n[g]
+        return ipa_ok, sp_ok, sp_r, ipa_live
+
+    arange_tk_f = jnp.arange(tk_cap)
+    arange_d = jnp.arange(d_cap)
+
+    def tk_onehot(tk):
+        """[..., TK] f32 one-hot of term keys (NONE -> zero row): turns every
+        per-step key lookup into a tiny matmul instead of a device gather."""
+        return ((tk[..., None] == arange_tk_f) & (tk[..., None] != NONE)
+                ).astype(jnp.float32)
+
+    if enable_topology:
+        oh_anti_own = tk_onehot(anti_tk_g)  # [G, A, TK] (each group's terms)
+        oh_aff_own = tk_onehot(aff_tk_g)
+        oh_paff_own = tk_onehot(paff_tk_g)
+        oh_panti_own = tk_onehot(panti_tk_g)
+        oh_tsc_own = tk_onehot(tsc_tk_g)    # [G, C, TK]
+
+    def map_updates(g, r, do, forbid1_n, map2_n, pres_n, any3, wscore_n,
+                    cntmap, cnt_match_n):
+        """Fold ONE commit (group-g pod on node row r) into the carry maps.
+        Everything is dense compares / tiny matmuls against the committed
+        node's domain row — no scatters, no gathers (TPU runs both ~100x
+        below bandwidth)."""
+        dom_row = topo_dom[r]                                     # [TK]
+        # same_dom[n, t]: node n shares the committed node's domain under
+        # topology key t (the ONE [N, TK] compare all updates contract with)
+        same_dom = ((topo_dom == dom_row[None]) & (dom_row[None] != NONE)
+                    & do).astype(jnp.float32)                     # [N, TK]
+        dom_row_f = dom_row.astype(jnp.float32)
+        nonef = jnp.float32(NONE)
+
+        # j-side (committed pod's own terms, keys [A]): [N, A] same-domain
+        oh_j_anti = oh_anti_own[g]                                # [A, TK]
+        oh_j_aff = oh_aff_own[g]
+        oh_j_paff = oh_paff_own[g]
+        oh_j_panti = oh_panti_own[g]
+        nd_j_anti = same_dom @ oh_j_anti.T                        # [N, A]
+        nd_j_aff = same_dom @ oh_j_aff.T
+        # forbid1_n: j's anti terms forbid same-domain nodes for groups they
+        # match
+        m1 = M_anti_gg[g].astype(jnp.float32)                     # [A, G]
+        forbid1_n = forbid1_n | ((nd_j_anti @ m1).T > 0)          # [G, N]
+        # b-side (each group's own terms vs the committed pod)
+        nd_gb_anti = jnp.einsum("nt,gat->nga", same_dom, oh_anti_own)
+        m2 = M_anti_gg[:, :, g].astype(jnp.float32)               # [G, A]
+        map2_n = map2_n | (jnp.einsum("nga,ga->gn", nd_gb_anti, m2) > 0)
+        nd_gb_aff = jnp.einsum("nt,gat->nga", same_dom, oh_aff_own)
+        m3 = M_aff_gg[:, :, g]                                    # [G, A]
+        pres_n = pres_n | (jnp.einsum("nga,ga->gan", nd_gb_aff,
+                                      m3.astype(jnp.float32)) > 0)
+        d3 = oh_aff_own @ dom_row_f                               # [G, A]
+        dv3 = (d3 != nonef) & (jnp.sum(oh_aff_own, -1) > 0)
+        any3 = any3 | (jnp.any(m3 & dv3, axis=1) & do)
+        # weighted ipa score deltas (scoring.go processExistingPod, all five
+        # directions of the old per-step scatter groups)
+        hw = jnp.full(aff_tk_g.shape[1], HARD_POD_AFFINITY_WEIGHT,
+                      jnp.float32)
+        j_side = (nd_j_aff @ (M_aff_gg[g].astype(jnp.float32) * hw[:, None])
+                  + (same_dom @ oh_j_paff.T)
+                  @ (M_paff_gg[g].astype(jnp.float32)
+                     * paff_w_g[g][:, None])
+                  - (same_dom @ oh_j_panti.T)
+                  @ (M_panti_gg[g].astype(jnp.float32)
+                     * panti_w_g[g][:, None]))                    # [N, G]
+        nd_gb_paff = jnp.einsum("nt,gat->nga", same_dom, oh_paff_own)
+        nd_gb_panti = jnp.einsum("nt,gat->nga", same_dom, oh_panti_own)
+        b_side = (jnp.einsum("nga,ga->gn", nd_gb_paff,
+                             M_paff_gg[:, :, g] * paff_w_g)
+                  - jnp.einsum("nga,ga->gn", nd_gb_panti,
+                               M_panti_gg[:, :, g] * panti_w_g))
+        wscore_n = wscore_n + j_side.T + b_side
+        # spread counts: domain-space (for the min) + node-space (for match)
+        el_r = el_node_nr[r]                                      # [G, C]
+        hits_c = M_tsc_gg[:, :, g] & el_r                         # [G, C]
+        d_c = oh_tsc_own @ dom_row_f                              # [G, C]
+        dv_c = hits_c & (d_c != nonef) & (jnp.sum(oh_tsc_own, -1) > 0) & do
+        cntmap = cntmap + (dv_c[..., None]
+                           & (d_c[..., None] == arange_d)
+                           ).astype(jnp.float32)                  # [G, C, D]
+        nd_gb_tsc = jnp.einsum("nt,gct->ngc", same_dom, oh_tsc_own)
+        cnt_match_n = cnt_match_n + jnp.einsum(
+            "ngc,gc->gcn", nd_gb_tsc, hits_c.astype(jnp.float32))
+        return forbid1_n, map2_n, pres_n, any3, wscore_n, cntmap, cnt_match_n
+
     def body(carry, xs):
-        free, nzr, committed_rows = carry
         if enable_topology:
-            (b, ok_s, t_raw, a_raw, im, req, nzreq, ptb, cnt_b, exh_b, ign_b,
-             tpw_b, self_b, ipa_anti_b, pres_b, any_b, ipa_r, soft_b,
-             naff_b, tnt_b) = xs
-            act = committed_rows >= 0                            # [B]
-            dom_commit = topo_dom[jnp.maximum(committed_rows, 0)]  # [B, TK]
-            # InterPodAffinity with in-batch commits:
-            # committed pods' anti terms vs this pod
-            hits1 = M_anti[:, :, b] & act[:, None]               # [B, A]
-            fail1 = T.step_terms_forbid(pods.anti_tk, dom_commit, hits1,
-                                        topo_dom, d_cap)
-            # this pod's anti terms vs committed pods
-            hits2 = M_anti[b] & act[None]                        # [A, B]
-            fail2 = T.step_own_terms_forbid(pods.anti_tk[b], dom_commit,
-                                            hits2, topo_dom, d_cap)
-            # this pod's required affinity incl. committed pods
-            hits3 = M_aff[b] & act[None]                         # [A, B]
-            aff_ok = T.step_affinity_ok(pods.aff_tk[b],
-                                        pods.aff_self_match[b], pres_b,
-                                        any_b, hits3, dom_commit, topo_dom,
-                                        d_cap)
-            ipa_ok = ipa_anti_b & ~fail1 & ~fail2 & aff_ok
-            # spread with in-batch commits: eligibility of committed nodes
-            r_c = jnp.maximum(committed_rows, 0)
-            av, tv = naff_b[r_c], tnt_b[r_c]                     # [B]
-            dom_jc = dom_commit[:, jnp.clip(pods.tsc_tk[b], 0, tk_cap - 1)]
-            dom_jc = jnp.where(pods.tsc_tk[b][None] != NONE, dom_jc, NONE)
-            used_c = pods.tsc_tk[b] != NONE
-            hard_c = used_c & pods.tsc_hard[b]
-            soft_c = used_c & ~pods.tsc_hard[b]
-            all_h = jnp.all((dom_jc != NONE) | ~hard_c[None], axis=1)  # [B]
-            all_s = jnp.all((dom_jc != NONE) | ~soft_c[None], axis=1)
-            pol = (jnp.where(pods.tsc_honor_affinity[b][None], av[:, None],
-                             True)
-                   & jnp.where(pods.tsc_honor_taints[b][None], tv[:, None],
-                               True))                            # [B, C]
-            el_c = (act[:, None] & pol
-                    & jnp.where(hard_c[None], all_h[:, None], all_s[:, None])
-                    & used_c[None])                              # [B, C]
-            hits_t = M_tsc[b] & el_c.T                           # [C, B]
-            cnt_live = cnt_b + T.step_spread_delta(
-                pods.tsc_tk[b], hits_t, dom_commit, tk_cap, d_cap)
-            sp_ok, sp_r = T.step_spread(
-                topo_dom, pods.tsc_tk[b], pods.tsc_hard[b],
-                pods.tsc_max_skew[b], pods.tsc_min_domains[b], self_b,
-                cnt_live, exh_b, tpw_b, ign_b)
+            (free, nzr, committed_rows, forbid1_n, map2_n, pres_n, any3,
+             wscore_n, cntmap, cnt_match_n) = carry
+            (b, ok_s, t_raw, a_raw, im, req, nzreq, ptb, g) = xs
+            ipa_ok, sp_ok, sp_r, ipa_live = queries(
+                g, forbid1_n, map2_n, pres_n, any3, wscore_n, cntmap,
+                cnt_match_n)
             if not spread_on:   # filter disabled by config (score may stay)
                 sp_ok = jnp.ones_like(sp_ok)
             if not ipa_on:
                 ipa_ok = jnp.ones_like(sp_ok)
-            # InterPodAffinity score delta from committed pods
-            def own_dom(tk_all):  # [B, A]: committed pod's dom under own term
-                d = jnp.take_along_axis(dom_commit,
-                                        jnp.clip(tk_all, 0, tk_cap - 1),
-                                        axis=1)
-                return jnp.where(tk_all != NONE, d, NONE)
-
-            def tgt_dom(tk_i):    # [A, B]: committed pod's dom under b's term
-                d = dom_commit[:, jnp.clip(tk_i, 0, tk_cap - 1)].T
-                return jnp.where(tk_i[:, None] != NONE, d, NONE)
-
-            hw = jnp.full(pods.aff_tk.shape, HARD_POD_AFFINITY_WEIGHT,
-                          jnp.float32)
-            groups = [
-                (jnp.broadcast_to(pods.paff_tk[b][:, None], M_paff[b].shape),
-                 tgt_dom(pods.paff_tk[b]), M_paff[b] & act[None],
-                 jnp.broadcast_to(pods.paff_weight[b][:, None],
-                                  M_paff[b].shape), 1.0),
-                (jnp.broadcast_to(pods.panti_tk[b][:, None],
-                                  M_panti[b].shape),
-                 tgt_dom(pods.panti_tk[b]), M_panti[b] & act[None],
-                 jnp.broadcast_to(pods.panti_weight[b][:, None],
-                                  M_panti[b].shape), -1.0),
-                (pods.aff_tk, own_dom(pods.aff_tk),
-                 M_aff[:, :, b] & act[:, None], hw, 1.0),
-                (pods.paff_tk, own_dom(pods.paff_tk),
-                 M_paff[:, :, b] & act[:, None],
-                 pods.paff_weight.astype(jnp.float32), 1.0),
-                (pods.panti_tk, own_dom(pods.panti_tk),
-                 M_panti[:, :, b] & act[:, None],
-                 pods.panti_weight.astype(jnp.float32), -1.0),
-            ]
-            ipa_live = ipa_r + T.step_ipa_score_delta(topo_dom, dom_commit,
-                                                      d_cap, groups)
+            ign_b = ign_g[g]
+            soft_b = has_soft_g[g]
         else:
+            (free, nzr, committed_rows) = carry
             (b, ok_s, t_raw, a_raw, im, req, nzreq, ptb) = xs
             ones = jnp.ones_like(ok_s)
             sp_ok = ipa_ok = ones
@@ -586,20 +709,44 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
         sp_rejects = jnp.sum(ok_fit & ~sp_ok).astype(jnp.int32)
         ipa_rejects = jnp.sum(ok_sp & ~ipa_ok).astype(jnp.int32)
         win = jnp.where(do, total[r], 0.0)
-        return (free, nzr, committed_rows), (
+        if enable_topology:
+            (forbid1_n, map2_n, pres_n, any3, wscore_n, cntmap,
+             cnt_match_n) = map_updates(
+                g, r, do, forbid1_n, map2_n, pres_n, any3, wscore_n,
+                cntmap, cnt_match_n)
+            out_carry = (free, nzr, committed_rows, forbid1_n, map2_n,
+                         pres_n, any3, wscore_n, cntmap, cnt_match_n)
+        else:
+            out_carry = (free, nzr, committed_rows)
+        return out_carry, (
             row, win, jnp.sum(feasible).astype(jnp.int32),
             port_rejects, fit_rejects, sp_rejects, ipa_rejects)
 
     xs = (jnp.arange(B), static_ok, taint_raw, aff_raw, img,
           pods.req, pods.nonzero_req, perturb_rows)
-    if enable_topology:
-        xs = xs + (cnt_s, exists_hard, spread_ignored, tp_weight, tsc_self,
-                   ipa_anti_ok, aff_present, aff_any, ipa_raw, has_soft,
-                   nodeaff_v, taint_v)
     init = (free0, nzr0, jnp.full((B,), -1, jnp.int32))
-    (free_out, nzr_out, _), (rows, win_scores, feas, port_rejects,
-                             fit_rejects, sp_rejects,
-                             ipa_rejects) = jax.lax.scan(body, init, xs)
+    if enable_topology:
+        xs = xs + (gid,)
+        A_cap = anti_tk_g.shape[1]
+        C_cap = tsc_tk_g.shape[1]
+        n_cap = free0.shape[0]
+        init = init + (
+            jnp.zeros((g_cap, n_cap), bool),              # forbid1_n
+            jnp.zeros((g_cap, n_cap), bool),              # map2_n (own anti)
+            jnp.zeros((g_cap, A_cap, n_cap), bool),       # pres_n (affinity)
+            jnp.zeros((g_cap,), bool),                    # any3
+            jnp.zeros((g_cap, n_cap), jnp.float32),       # wscore_n
+            jnp.zeros((g_cap, C_cap, d_cap), jnp.float32),   # cntmap
+            jnp.zeros((g_cap, C_cap, n_cap), jnp.float32),   # cnt_match_n
+        )
+    # unroll: the body is many small fused kernels; per-iteration dispatch
+    # overhead (not FLOPs) is a real cost at these shapes, so unrolling
+    # amortizes it
+    (carry_out, (rows, win_scores, feas, port_rejects,
+                 fit_rejects, sp_rejects,
+                 ipa_rejects)) = jax.lax.scan(body, init, xs,
+                                              unroll=SCAN_UNROLL)
+    free_out, nzr_out = carry_out[0], carry_out[1]
 
     ports_idx = FILTER_PLUGINS.index("NodePorts")
     static_rejects = static_rejects.at[:, ports_idx].add(port_rejects)
@@ -613,14 +760,16 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
 
 @partial(jax.jit, static_argnames=("caps", "enable_topology", "d_cap",
                                    "enabled_filters", "serial_scan",
-                                   "active", "pfields"))
+                                   "active", "pfields", "g_cap"))
 def schedule_batch_jit(cblobs, pblobs, wk, weights, caps,
                        enable_topology=True, d_cap=None,
                        enabled_filters=None, serial_scan=True, state=None,
-                       active=None, pfields=None, ptmpl=None):
+                       active=None, pfields=None, ptmpl=None,
+                       gid=None, rep=None, g_cap=0):
     return schedule_batch(cblobs, pblobs, wk, weights, caps,
                           enable_topology, d_cap, enabled_filters,
-                          serial_scan, state, active, pfields, ptmpl)
+                          serial_scan, state, active, pfields, ptmpl,
+                          gid, rep, g_cap)
 
 
 def launch_batch(spec, wk, weights, caps, enabled_filters=None,
@@ -630,4 +779,5 @@ def launch_batch(spec, wk, weights, caps, enabled_filters=None,
         spec.cblobs, spec.pblobs, wk, weights, caps,
         spec.enable_topology, spec.d_cap, enabled_filters,
         serial_scan=serial_scan, state=state, active=spec.active,
-        pfields=spec.pfields, ptmpl=spec.ptmpl)
+        pfields=spec.pfields, ptmpl=spec.ptmpl,
+        gid=spec.gid, rep=spec.rep, g_cap=spec.g_cap)

@@ -148,9 +148,12 @@ def gather_rows(m: jnp.ndarray, dom: jnp.ndarray):
 # The batched commit scan must preserve as-if-serial semantics: pod b has to
 # see pods 0..b-1's placements exactly as the serial loop's assume step
 # would provide (schedule_one.go:938). For the topology plugins that means
-# pairwise pod<->pod term matches are precomputed OUTSIDE the scan (labels
-# and terms don't depend on placement), and each scan step only scatters the
-# already-committed pods' domains into small [rows, D] maps.
+# pairwise GROUP<->GROUP term matches are precomputed OUTSIDE the scan
+# (labels and terms don't depend on placement; pods dedup into groups,
+# Mirror._batch_groups), and the scan folds each commit into small node-
+# space carry maps with dense compares — see pipeline.map_updates. TPU
+# scatters/gathers run ~100x below bandwidth, so nothing in the per-step
+# path scatters or gathers by domain.
 
 
 def pair_term_match(tk: jnp.ndarray, ns: jnp.ndarray, ns_all: jnp.ndarray,
@@ -189,76 +192,8 @@ def pair_tsc_match(pods: PodFeatures) -> jnp.ndarray:
             & pods.valid[None, None, :])
 
 
-def step_terms_forbid(tk_terms: jnp.ndarray, dom_commit: jnp.ndarray,
-                      hits: jnp.ndarray, topo_dom: jnp.ndarray,
-                      d_cap: int) -> jnp.ndarray:
-    """[N]: nodes forbidden by committed pods' terms.
-
-    tk_terms [B, A] (term owner = committed pod j); dom_commit [B, TK]
-    (domains of each committed pod's node); hits [B, A] (term matched the
-    current pod AND owner is committed)."""
-    tk_cap = topo_dom.shape[1]
-    dom = jnp.take_along_axis(dom_commit, jnp.clip(tk_terms, 0, tk_cap - 1),
-                              axis=1)
-    dom = jnp.where(tk_terms != NONE, dom, NONE)
-    f = scatter_or(tk_terms, dom, hits, tk_cap, d_cap)
-    return jnp.any(gather_rows(f, topo_dom), axis=1)
 
 
-def step_own_terms_forbid(tk_i: jnp.ndarray, dom_commit: jnp.ndarray,
-                          hits: jnp.ndarray, topo_dom: jnp.ndarray,
-                          d_cap: int) -> jnp.ndarray:
-    """[N]: nodes forbidden by the CURRENT pod's own anti terms matching
-    committed pods. tk_i [A]; hits [A, B]; dom_commit [B, TK]."""
-    tk_cap = topo_dom.shape[1]
-    dom = dom_commit[:, jnp.clip(tk_i, 0, tk_cap - 1)].T       # [A, B]
-    dom = jnp.where(tk_i[:, None] != NONE, dom, NONE)
-    tk2 = jnp.broadcast_to(tk_i[:, None], hits.shape)
-    f = scatter_or(tk2, dom, hits, tk_cap, d_cap)
-    return jnp.any(gather_rows(f, topo_dom), axis=1)
-
-
-def step_affinity_ok(aff_tk_i: jnp.ndarray, self_match_i: jnp.ndarray,
-                     present_static: jnp.ndarray, any_match_static,
-                     hits: jnp.ndarray, dom_commit: jnp.ndarray,
-                     topo_dom: jnp.ndarray, d_cap: int) -> jnp.ndarray:
-    """[N]: required-affinity verdict including committed batch pods.
-
-    present_static [A, D] (from the pre-batch table); hits [A, B] (current
-    pod's affinity term a matches committed pod j)."""
-    tk_cap = topo_dom.shape[1]
-    a_cap = aff_tk_i.shape[0]
-    dom = dom_commit[:, jnp.clip(aff_tk_i, 0, tk_cap - 1)].T   # [A, B]
-    dom = jnp.where(aff_tk_i[:, None] != NONE, dom, NONE)
-    rows = jnp.broadcast_to(jnp.arange(a_cap)[:, None], hits.shape)
-    present = present_static | scatter_or(rows, dom, hits, a_cap, d_cap)
-    term_used = aff_tk_i != NONE
-    node_dom = take_cols(topo_dom, aff_tk_i, NONE)             # [N, A]
-    has_lbl = node_dom != NONE
-    term_ok = has_lbl & gather_rows(present, node_dom)
-    pods_exist = jnp.all(term_ok | ~term_used[None], axis=1)
-    all_lbl = jnp.all(has_lbl | ~term_used[None], axis=1)
-    any_match = any_match_static | jnp.any(hits & (dom != NONE))
-    self_ok = self_match_i & ~any_match & all_lbl
-    return jnp.where(jnp.any(term_used), pods_exist | self_ok, True)
-
-
-def step_ipa_score_delta(topo_dom: jnp.ndarray, dom_commit: jnp.ndarray,
-                         d_cap: int, groups) -> jnp.ndarray:
-    """[N] score delta from committed batch pods.
-
-    groups: iterable of (tk, dom, hits, weight, sign) with aligned shapes —
-    see the pipeline for the five scoring directions. Each entry scatters
-    weight*sign at (tk, dom) for its hits."""
-    tk_cap = topo_dom.shape[1]
-    dmap = jnp.zeros((tk_cap * d_cap,), jnp.float32)
-    for tk2d, dom2d, hits, w, sign in groups:
-        ok = hits & (tk2d != NONE) & (dom2d != NONE)
-        flat = jnp.clip(tk2d, 0) * d_cap + jnp.clip(dom2d, 0)
-        upd = jnp.where(ok, sign * w.astype(jnp.float32), 0.0)
-        dmap = dmap.at[flat.reshape(-1)].add(upd.reshape(-1))
-    per_tk = gather_rows(dmap.reshape(tk_cap, d_cap), topo_dom)
-    return jnp.sum(per_tk, axis=1)
 
 
 # --------------------------- InterPodAffinity ---------------------------
@@ -433,53 +368,3 @@ def spread_exists(ct: ClusterTensors, pod: PodFeatures,
                       node_dom, node_mask, c_cap, d_cap)
 
 
-def step_spread_delta(tsc_tk_i: jnp.ndarray, hits: jnp.ndarray,
-                      dom_commit: jnp.ndarray, tk_cap: int,
-                      d_cap: int) -> jnp.ndarray:
-    """[C, D] f32 count delta from committed batch pods.
-    tsc_tk_i [C]; hits [C, B] (pod j matches constraint c AND is committed
-    on an eligible node); dom_commit [B, TK]."""
-    c_cap = tsc_tk_i.shape[0]
-    dom = dom_commit[:, jnp.clip(tsc_tk_i, 0, tk_cap - 1)].T       # [C, B]
-    dom = jnp.where(tsc_tk_i[:, None] != NONE, dom, NONE)
-    ok = hits & (dom != NONE)
-    flat = jnp.broadcast_to(jnp.arange(c_cap)[:, None], hits.shape) * d_cap \
-        + jnp.clip(dom, 0)
-    cnt = jnp.zeros((c_cap * d_cap,), jnp.float32)
-    cnt = cnt.at[flat.reshape(-1)].add(ok.reshape(-1).astype(jnp.float32))
-    return cnt.reshape(c_cap, d_cap)
-
-
-def step_spread(topo_dom: jnp.ndarray, tsc_tk: jnp.ndarray,
-                tsc_hard: jnp.ndarray, tsc_max_skew: jnp.ndarray,
-                tsc_min_domains: jnp.ndarray, self_match: jnp.ndarray,
-                cnt: jnp.ndarray, exists_hard: jnp.ndarray,
-                tp_weight: jnp.ndarray, ignored: jnp.ndarray
-                ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """(accept mask [N], raw soft score [N]) from live counts.
-
-    Runs inside the commit scan with cnt = static + in-batch delta, so the
-    skew check and the score both see earlier batch commits (as-if-serial).
-    Filter: skew = matchNum + selfMatch - minMatchNum > maxSkew rejects
-    (filtering.go:311, minDomains :300); score: cnt * log(size+2) +
-    (maxSkew-1) over soft constraints (scoring.go)."""
-    node_dom = take_cols(topo_dom, tsc_tk, NONE)                   # [N, C]
-    used = tsc_tk != NONE
-    used_hard = used & tsc_hard
-    used_soft = used & ~tsc_hard
-
-    num_domains = jnp.sum(exists_hard, axis=1)                     # [C]
-    min_cnt = jnp.min(jnp.where(exists_hard, cnt, jnp.inf), axis=1)
-    min_cnt = jnp.where(jnp.isfinite(min_cnt), min_cnt, 0.0)
-    min_cnt = jnp.where((tsc_min_domains > 0)
-                        & (num_domains < tsc_min_domains), 0.0, min_cnt)
-
-    match_num = gather_rows(cnt, node_dom)                         # [N, C]
-    skew = match_num + self_match[None] - min_cnt[None]
-    ok_c = (node_dom != NONE) & (skew <= tsc_max_skew[None])
-    mask = jnp.all(ok_c | ~used_hard[None], axis=1)                # [N]
-
-    per_c = match_num * tp_weight[None] \
-        + (tsc_max_skew[None].astype(jnp.float32) - 1.0)
-    per_c = jnp.where(used_soft[None] & (node_dom != NONE), per_c, 0.0)
-    return mask, jnp.where(ignored, 0.0, jnp.sum(per_c, axis=1))

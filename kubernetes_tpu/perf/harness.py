@@ -92,6 +92,10 @@ class Workload:
     node_capacity: int = 8192   # mirror bucket hints (pow2; fixed up front
     pod_capacity: int = 16384   # so warmup compiles the full-size programs)
     batch_size: int = 2048
+    # hostname-keyed topology workloads: the domain bucket (a STATIC jit
+    # arg) tracks the number of distinct domains = nodes, so a scaled-down
+    # warmup would compile the wrong program; keep CreateNodes unscaled
+    warm_full_nodes: bool = False
 
     def __post_init__(self) -> None:
         if not self.baseline:
@@ -179,7 +183,8 @@ def run_workload(w: Workload, now: Callable[[], float] = time.time,
 
     for op in w.ops:
         if isinstance(op, CreateNodes):
-            for i in range(scaled(op.count)):
+            n_nodes = op.count if w.warm_full_nodes else scaled(op.count)
+            for i in range(n_nodes):
                 hub.create_node(op.make_node(i))
         elif isinstance(op, CreateNamespaces):
             for i in range(op.count):

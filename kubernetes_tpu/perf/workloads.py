@@ -133,6 +133,7 @@ def scheduling_pod_anti_affinity(init_nodes=5000, init_pods=1000,
     return Workload(
         name="SchedulingPodAntiAffinity/5000Nodes_2000Pods",
         threshold=60,
+        warm_full_nodes=True,   # hostname anti-affinity: domains = nodes
         ops=[
             CreateNodes(init_nodes, _node),
             CreateNamespaces("sched", 2),
