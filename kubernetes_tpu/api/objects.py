@@ -400,6 +400,114 @@ class PodDisruptionBudget:
     disruptions_allowed: int = 0  # status.disruptionsAllowed
 
 
+# --- volumes (the slices the volume plugin family consumes) --------------------------
+
+
+@dataclass
+class PersistentVolumeClaimVolumeSource:
+    claim_name: str
+    read_only: bool = False
+
+
+@dataclass
+class Volume:
+    """v1.Volume — the sources the scheduler's volume plugins inspect:
+    PVC references (zone/limits/binding) and the directly-attached disk
+    types VolumeRestrictions guards (volume_restrictions.go:77-120)."""
+
+    name: str = ""
+    persistent_volume_claim: Optional[PersistentVolumeClaimVolumeSource] = None
+    gce_pd_name: str = ""        # GCEPersistentDisk.PDName
+    aws_ebs_volume_id: str = ""  # AWSElasticBlockStore.VolumeID
+    iscsi_iqn: str = ""          # ISCSI.IQN + lun as "iqn:lun"
+    rbd_image: str = ""          # RBD "pool:image"
+    read_only: bool = False
+
+
+# access modes (core/types.go)
+READ_WRITE_ONCE = "ReadWriteOnce"
+READ_ONLY_MANY = "ReadOnlyMany"
+READ_WRITE_MANY = "ReadWriteMany"
+READ_WRITE_ONCE_POD = "ReadWriteOncePod"
+
+
+@dataclass
+class PersistentVolumeClaimSpec:
+    access_modes: list[str] = field(default_factory=list)
+    storage_class_name: str = ""
+    volume_name: str = ""            # bound PV name ("" = unbound)
+    requests: dict[str, str] = field(default_factory=dict)  # {"storage": ...}
+
+
+@dataclass
+class PersistentVolumeClaimStatus:
+    phase: str = "Pending"           # Pending / Bound / Lost
+
+
+@dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PersistentVolumeClaimSpec = field(
+        default_factory=PersistentVolumeClaimSpec)
+    status: PersistentVolumeClaimStatus = field(
+        default_factory=PersistentVolumeClaimStatus)
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def clone(self) -> "PersistentVolumeClaim":
+        import copy
+
+        return copy.deepcopy(self)
+
+
+@dataclass
+class ClaimRef:
+    namespace: str = ""
+    name: str = ""
+    uid: str = ""
+
+
+@dataclass
+class PersistentVolumeSpec:
+    capacity: dict[str, str] = field(default_factory=dict)  # {"storage": ..}
+    access_modes: list[str] = field(default_factory=list)
+    storage_class_name: str = ""
+    claim_ref: Optional[ClaimRef] = None
+    # volume_binding.go checks PV.Spec.NodeAffinity.Required against node
+    node_affinity: Optional["NodeSelector"] = None
+    csi_driver: str = ""             # CSI.Driver (NodeVolumeLimits)
+
+
+@dataclass
+class PersistentVolumeStatus:
+    phase: str = "Available"         # Available / Bound / Released
+
+
+@dataclass
+class PersistentVolume:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PersistentVolumeSpec = field(default_factory=PersistentVolumeSpec)
+    status: PersistentVolumeStatus = field(
+        default_factory=PersistentVolumeStatus)
+
+    def clone(self) -> "PersistentVolume":
+        import copy
+
+        return copy.deepcopy(self)
+
+
+VOLUME_BINDING_IMMEDIATE = "Immediate"
+VOLUME_BINDING_WAIT = "WaitForFirstConsumer"
+
+
+@dataclass
+class StorageClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    provisioner: str = ""
+    volume_binding_mode: str = VOLUME_BINDING_IMMEDIATE
+
+
 # --- priority class ------------------------------------------------------------------
 
 

@@ -52,6 +52,9 @@ class QueuedPodInfo:
     unschedulable_plugins: set[str] = field(default_factory=set)
     pending_plugins: set[str] = field(default_factory=set)
     gated_plugin: str = ""
+    # host Filter rejects from the last attempt (plugin -> node count);
+    # merged into the failure diagnosis alongside device reject_counts
+    host_reject_counts: dict[str, int] = field(default_factory=dict)
 
     @property
     def uid(self) -> str:

@@ -23,13 +23,17 @@ from kubernetes_tpu.framework.cycle_state import CycleState
 from kubernetes_tpu.framework.interface import (
     BindPlugin,
     ClusterEventWithHint,
+    FilterPlugin,
     PermitPlugin,
     PostBindPlugin,
     PostFilterPlugin,
     PreBindPlugin,
     PreEnqueuePlugin,
+    PreFilterPlugin,
+    PreScorePlugin,
     QueueSortPlugin,
     ReservePlugin,
+    ScorePlugin,
     Status,
 )
 from kubernetes_tpu.models.pipeline import (
@@ -149,6 +153,93 @@ class Framework:
             inst = self._instances.get(name)
             if isinstance(inst, cls):
                 yield inst
+
+    def has_host_filters(self) -> bool:
+        """Any instantiated host FilterPlugin in the filter point? (device
+        plugins are descriptors with no instance)."""
+        for _pl in self._iter("filter", FilterPlugin):
+            return True
+        return False
+
+    def host_filters_volume_gated(self) -> bool:
+        """True when every host filter declares VOLUME_GATED — the
+        scheduler then skips the per-pod host pass for pods without
+        spec.volumes (the default profile's host set is the volume family,
+        so plain workloads pay nothing)."""
+        return all(getattr(pl, "VOLUME_GATED", False)
+                   for pl in self._iter("filter", FilterPlugin))
+
+    def has_host_scores(self) -> bool:
+        return any(isinstance(self._instances.get(name), ScorePlugin)
+                   for name, _ in self.points["score"])
+
+    def run_host_filters(self, state: CycleState, pod: Pod, node_infos
+                         ) -> tuple[Optional[list[bool]], dict[str, int],
+                                    Optional[Status]]:
+        """Host PreFilter + Filter for one pod over the snapshot's nodes —
+        the host half of the mixed framework (runtime/framework.go:877-922
+        RunFilterPlugins, with the device plugin set already fused into the
+        launch). Returns (per-node mask | None if every plugin skipped,
+        reject counts by plugin name, early terminal status).
+
+        An early status (a PreFilter rejecting outright) means the pod is
+        unschedulable everywhere; the caller masks every node and attributes
+        the failure to the returned plugin."""
+        plugins = self.__dict__.get("_host_filter_list")
+        if plugins is None:
+            plugins = self._host_filter_list = list(
+                self._iter("filter", FilterPlugin))
+        if not plugins:
+            return None, {}, None
+        active = []
+        for pl in plugins:
+            if isinstance(pl, PreFilterPlugin):
+                s = pl.pre_filter(state, pod, node_infos)
+                if s.is_skip():
+                    continue
+                if not s.is_success():
+                    s.plugin = s.plugin or pl.name()
+                    return None, {s.plugin: len(node_infos)}, s
+            active.append(pl)
+        if not active:
+            return None, {}, None
+        mask = [True] * len(node_infos)
+        counts: dict[str, int] = {}
+        for i, ni in enumerate(node_infos):
+            for pl in active:
+                s = pl.filter(state, pod, ni)
+                if not s.is_success():
+                    mask[i] = False
+                    name = s.plugin or pl.name()
+                    counts[name] = counts.get(name, 0) + 1
+                    break           # first-fail attribution, like the device
+        return mask, counts, None
+
+    def run_host_scores(self, state: CycleState, pod: Pod, node_infos
+                        ) -> Optional[list[float]]:
+        """Host PreScore + Score, weight-aggregated per node; None when no
+        host ScorePlugin is configured (the common case — the default score
+        set runs on device)."""
+        entries = [(self._instances.get(name), weight)
+                   for name, weight in self.points["score"]
+                   if isinstance(self._instances.get(name), ScorePlugin)]
+        if not entries:
+            return None
+        total = [0.0] * len(node_infos)
+        for pl, weight in entries:
+            if isinstance(pl, PreScorePlugin):
+                s = pl.pre_score(state, pod, node_infos)
+                if s.is_skip():
+                    continue
+            scores = []
+            for ni in node_infos:
+                val, s = pl.score(state, pod, ni)
+                scores.append(val if s.is_success() else 0.0)
+            pl.normalize_scores(state, pod, scores)
+            w = weight or 1.0
+            for i, v in enumerate(scores):
+                total[i] += w * v
+        return total
 
     def run_pre_enqueue_plugins(self, pod: Pod) -> Status:
         """interface.go PreEnqueuePlugin; gate failures keep the pod in

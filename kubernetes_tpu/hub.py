@@ -21,10 +21,13 @@ from typing import Callable, Optional
 from kubernetes_tpu.api.objects import (
     Namespace,
     Node,
+    PersistentVolume,
+    PersistentVolumeClaim,
     Pod,
     PodCondition,
     PodDisruptionBudget,
     PriorityClass,
+    StorageClass,
 )
 
 
@@ -61,6 +64,13 @@ class Hub:
         self._priority_classes = _Store("PriorityClass")
         self._namespaces = _Store("Namespace")
         self._pdbs = _Store("PodDisruptionBudget")
+        self._pvcs = _Store("PersistentVolumeClaim")
+        self._pvs = _Store("PersistentVolume")
+        self._storage_classes = _Store("StorageClass")
+        self._pvc_by_key: dict[str, str] = {}   # "ns/name" -> uid
+        self._pv_by_name: dict[str, str] = {}   # name -> uid
+        self._sc_by_name: dict[str, str] = {}
+        self._node_by_name: dict[str, str] = {}
 
     # ------------- watch registration -------------
 
@@ -119,13 +129,24 @@ class Hub:
     # ------------- nodes -------------
 
     def create_node(self, node: Node) -> None:
-        self._create(self._nodes, node)
+        with self._lock:
+            self._create(self._nodes, node)
+            self._node_by_name[node.metadata.name] = node.metadata.uid
 
     def update_node(self, node: Node) -> None:
         self._update(self._nodes, node)
 
     def delete_node(self, uid: str) -> None:
-        self._delete(self._nodes, uid)
+        with self._lock:
+            old = self._nodes.objects.get(uid)
+            self._delete(self._nodes, uid)
+            if old is not None:
+                self._node_by_name.pop(old.metadata.name, None)
+
+    def get_node(self, name: str) -> Optional[Node]:
+        with self._lock:
+            uid = self._node_by_name.get(name)
+            return self._nodes.objects.get(uid) if uid else None
 
     def list_nodes(self) -> list[Node]:
         with self._lock:
@@ -228,6 +249,81 @@ class Hub:
     def list_pdbs(self) -> list[PodDisruptionBudget]:
         with self._lock:
             return list(self._pdbs.objects.values())
+
+    # ------------- volumes (PVC / PV / StorageClass) -------------
+
+    def watch_pvcs(self, h: EventHandlers, replay: bool = True) -> None:
+        with self._lock:
+            self._pvcs.handlers.append(h)
+            if replay and h.on_add:
+                for o in list(self._pvcs.objects.values()):
+                    h.on_add(o)
+
+    def watch_pvs(self, h: EventHandlers, replay: bool = True) -> None:
+        with self._lock:
+            self._pvs.handlers.append(h)
+            if replay and h.on_add:
+                for o in list(self._pvs.objects.values()):
+                    h.on_add(o)
+
+    def create_pvc(self, pvc: PersistentVolumeClaim) -> None:
+        with self._lock:
+            self._create(self._pvcs, pvc)
+            self._pvc_by_key[pvc.key()] = pvc.metadata.uid
+
+    def update_pvc(self, pvc: PersistentVolumeClaim) -> None:
+        self._update(self._pvcs, pvc)
+
+    def delete_pvc(self, uid: str) -> None:
+        with self._lock:
+            old = self._pvcs.objects.get(uid)
+            self._delete(self._pvcs, uid)
+            if old is not None:
+                self._pvc_by_key.pop(old.key(), None)
+
+    def get_pvc(self, namespace: str, name: str
+                ) -> Optional[PersistentVolumeClaim]:
+        with self._lock:
+            uid = self._pvc_by_key.get(f"{namespace}/{name}")
+            return self._pvcs.objects.get(uid) if uid else None
+
+    def list_pvcs(self) -> list[PersistentVolumeClaim]:
+        with self._lock:
+            return list(self._pvcs.objects.values())
+
+    def create_pv(self, pv: PersistentVolume) -> None:
+        with self._lock:
+            self._create(self._pvs, pv)
+            self._pv_by_name[pv.metadata.name] = pv.metadata.uid
+
+    def update_pv(self, pv: PersistentVolume) -> None:
+        self._update(self._pvs, pv)
+
+    def delete_pv(self, uid: str) -> None:
+        with self._lock:
+            old = self._pvs.objects.get(uid)
+            self._delete(self._pvs, uid)
+            if old is not None:
+                self._pv_by_name.pop(old.metadata.name, None)
+
+    def get_pv(self, name: str) -> Optional[PersistentVolume]:
+        with self._lock:
+            uid = self._pv_by_name.get(name)
+            return self._pvs.objects.get(uid) if uid else None
+
+    def list_pvs(self) -> list[PersistentVolume]:
+        with self._lock:
+            return list(self._pvs.objects.values())
+
+    def create_storage_class(self, sc: StorageClass) -> None:
+        with self._lock:
+            self._create(self._storage_classes, sc)
+            self._sc_by_name[sc.metadata.name] = sc.metadata.uid
+
+    def get_storage_class(self, name: str) -> Optional[StorageClass]:
+        with self._lock:
+            uid = self._sc_by_name.get(name)
+            return self._storage_classes.objects.get(uid) if uid else None
 
     # ------------- priority classes -------------
 

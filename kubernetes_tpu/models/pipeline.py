@@ -188,7 +188,7 @@ def tie_perturb(b, n: int) -> jnp.ndarray:
 
 
 def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
-                   img, unres, weights, free0, nzr0):
+                   img, unres, weights, free0, nzr0, host_score=None):
     """Parallel auction replacing the per-pod commit scan when the batch has
     no topology constraints and no host ports: every round, all unplaced
     pods score+argmax in parallel; per node, pods are accepted in BATCH
@@ -228,8 +228,9 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
                     + weights.resources_fit * least
                     + weights.balanced_allocation * bal
                     + weights.image_locality * im)
-        return jax.vmap(per_pod)(pods.nonzero_req, taint_raw, aff_raw, img,
-                                 feasible)
+        out = jax.vmap(per_pod)(pods.nonzero_req, taint_raw, aff_raw, img,
+                                feasible)
+        return out if host_score is None else out + host_score
 
     def cond(state):
         _free, _nzr, _placed, _win, progress = state
@@ -291,7 +292,9 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                    ptmpl: PodBlobs | None = None,
                    gid: jnp.ndarray | None = None,
                    rep: jnp.ndarray | None = None,
-                   g_cap: int = 0
+                   g_cap: int = 0,
+                   host_ok: jnp.ndarray | None = None,
+                   host_score: jnp.ndarray | None = None
                    ) -> BatchResult:
     """Schedule a whole pod batch in one launch, as-if-serial (see module
     docstring for the two-phase structure).
@@ -322,7 +325,12 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
     specs per batch), which turns the former per-pod scatter storm — TPU
     scatters run ~100x below bandwidth — into a handful of small dense
     updates. g_cap is a static pow2 bucket; a fully heterogeneous batch
-    (g_cap == B) is still exact, just back to per-pod cost."""
+    (g_cap == B) is still exact, just back to per-pod cost.
+
+    ``host_ok``/``host_score`` ([B, N] bool / f32) carry HOST plugin
+    verdicts (volume family, custom plugins): the host filter mask is ANDed
+    into every pod's feasible set, the host score added to the aggregate —
+    the mixed host/device framework's seam (runtime.run_host_filters)."""
     ct = unpack_cluster(cblobs, caps)
     pods = unpack_pods(pblobs, caps, pfields, ptmpl)  # leaves [B, ...]
     free0 = ct.free if state is None else state[0]
@@ -394,11 +402,16 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
     B_all = pblobs.f32.shape[0]
     outs = chunked_vmap(per_pod, pods, B_all)
     (static_ok, static_rejects, taint_raw, aff_raw, img, unres) = outs
+    if host_ok is not None:
+        # host Filter verdicts AND in here; host rejects are attributed by
+        # the Scheduler from its own counts (they never reach reject_counts)
+        static_ok = static_ok & host_ok
     if not serial_scan:
         if enable_topology:
             raise ValueError("auction commit requires a no-topology launch")
         return _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw,
-                              aff_raw, img, unres, weights, free0, nzr0)
+                              aff_raw, img, unres, weights, free0, nzr0,
+                              host_score)
     if enable_topology:
         # ---- phase 1b: topology statics per GROUP (representatives) ----
         pods_rep = jax.tree.map(lambda x: x[rep], pods)  # leaves [G, ...]
@@ -693,6 +706,8 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                  + weights.image_locality * im
                  + weights.pod_topology_spread * spread
                  + weights.inter_pod_affinity * ipa)
+        if host_score is not None:
+            total = total + host_score[b]
         row = C.masked_argmax_random(total, feasible, ptb)
         # commit the winner (the "assume"): free -= request, nonzero += request
         do = row >= 0
@@ -765,19 +780,22 @@ def schedule_batch_jit(cblobs, pblobs, wk, weights, caps,
                        enable_topology=True, d_cap=None,
                        enabled_filters=None, serial_scan=True, state=None,
                        active=None, pfields=None, ptmpl=None,
-                       gid=None, rep=None, g_cap=0):
+                       gid=None, rep=None, g_cap=0, host_ok=None,
+                       host_score=None):
     return schedule_batch(cblobs, pblobs, wk, weights, caps,
                           enable_topology, d_cap, enabled_filters,
                           serial_scan, state, active, pfields, ptmpl,
-                          gid, rep, g_cap)
+                          gid, rep, g_cap, host_ok, host_score)
 
 
 def launch_batch(spec, wk, weights, caps, enabled_filters=None,
-                 serial_scan=True, state=None) -> BatchResult:
+                 serial_scan=True, state=None, host_ok=None,
+                 host_score=None) -> BatchResult:
     """schedule_batch_jit driven by a Mirror.prepare_launch LaunchSpec."""
     return schedule_batch_jit(
         spec.cblobs, spec.pblobs, wk, weights, caps,
         spec.enable_topology, spec.d_cap, enabled_filters,
         serial_scan=serial_scan, state=state, active=spec.active,
         pfields=spec.pfields, ptmpl=spec.ptmpl,
-        gid=spec.gid, rep=spec.rep, g_cap=spec.g_cap)
+        gid=spec.gid, rep=spec.rep, g_cap=spec.g_cap,
+        host_ok=host_ok, host_score=host_score)

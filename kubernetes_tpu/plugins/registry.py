@@ -175,8 +175,51 @@ def in_tree_registry() -> dict[str, PluginDescriptor]:
         PluginDescriptor(
             name="DefaultBinder", points=("bind",),
             factory=lambda args: DefaultBinder(args.get("binder"))),
+        # --- volume family: host Filter plugins (plugins/volume.py) ---
+        PluginDescriptor(
+            name="VolumeZone", points=("filter",),
+            factory=_volume_factory("VolumeZone"),
+            events=[_ev(R.PV, A.ADD | A.UPDATE),
+                    _ev(R.PVC, A.ADD | A.UPDATE),
+                    _ev(R.NODE, A.ADD | A.UPDATE_NODE_LABEL),
+                    _ev(R.STORAGE_CLASS, A.ADD)]),
+        PluginDescriptor(
+            name="VolumeRestrictions", points=("filter",),
+            factory=_volume_factory("VolumeRestrictions"),
+            events=[_ev(R.ASSIGNED_POD, A.DELETE),
+                    _ev(R.PVC, A.ADD | A.UPDATE)]),
+        PluginDescriptor(
+            name="NodeVolumeLimits", points=("filter",),
+            factory=_volume_factory("NodeVolumeLimits"),
+            events=[_ev(R.CSI_NODE, A.ADD | A.UPDATE),
+                    _ev(R.ASSIGNED_POD, A.DELETE),
+                    _ev(R.PVC, A.ADD),
+                    _ev(R.PV, A.ADD)]),
+        PluginDescriptor(
+            name="VolumeBinding",
+            points=("filter", "reserve", "pre_bind"),
+            factory=_volume_factory("VolumeBinding"),
+            events=[_ev(R.PVC, A.ADD | A.UPDATE),
+                    _ev(R.PV, A.ADD | A.UPDATE),
+                    _ev(R.NODE, A.ADD | A.UPDATE_NODE_LABEL
+                        | A.UPDATE_NODE_TAINT),
+                    _ev(R.STORAGE_CLASS, A.ADD),
+                    _ev(R.ASSIGNED_POD, A.DELETE)]),
     ]
     return {d.name: d for d in descriptors}
+
+
+def _volume_factory(name: str):
+    """Volume plugins need the hub (API views); absent outside a full
+    scheduler (kernel tests) the plugin is skipped."""
+    def make(args: dict):
+        hub = args.get("hub")
+        if hub is None:
+            return None
+        from kubernetes_tpu.plugins import volume
+
+        return getattr(volume, name)(hub)
+    return make
 
 
 DEVICE_FILTER_PLUGINS = tuple(

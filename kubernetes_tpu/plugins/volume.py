@@ -1,0 +1,495 @@
+"""The volume plugin family: host Filter/Reserve/PreBind plugins.
+
+From-scratch equivalents of the reference's volume plugins, run on host
+around the device launch (the mixed host/device framework, SURVEY §7.0 —
+volume state is small, pointer-chasing, and API-coupled: exactly the work
+that does NOT belong on the TPU):
+
+- VolumeZone       (plugins/volumezone/volume_zone.go): a bound PVC's PV
+  carries zone/region labels; the node must match them.
+- VolumeRestrictions (plugins/volumerestrictions/volume_restrictions.go):
+  GCE-PD / AWS-EBS / iSCSI / RBD read-write conflicts on a node, and the
+  ReadWriteOncePod access-mode conflict (:77-199).
+- NodeVolumeLimits (plugins/nodevolumelimits/csi.go): attachable CSI
+  volume count per node vs the node's allocatable limit.
+- VolumeBinding    (plugins/volumebinding/volume_binding.go +
+  scheduler_binder.go): bound-PV node affinity at Filter; unbound
+  WaitForFirstConsumer PVCs matched to available PVs (or provisionable
+  classes) at Filter, assumed at Reserve via an AssumeCache
+  (util/assumecache/assume_cache.go), written to the API at PreBind.
+
+Host filters evaluate per (pod, node_info) and their verdicts are ANDed
+into the device result as a host mask (Framework.run_host_filters →
+Scheduler._dispatch → pipeline host_ok).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kubernetes_tpu.api.labels import node_selector_matches
+from kubernetes_tpu.api.objects import (
+    LABEL_REGION,
+    LABEL_ZONE,
+    READ_WRITE_ONCE_POD,
+    VOLUME_BINDING_WAIT,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Pod,
+    Volume,
+)
+from kubernetes_tpu.utils.quantity import parse_bytes, parse_int
+from kubernetes_tpu.framework.interface import (
+    FilterPlugin,
+    PreBindPlugin,
+    PreFilterPlugin,
+    ReservePlugin,
+    Status,
+)
+
+# legacy + GA zone/region label keys (volume_zone.go:55-60)
+ZONE_LABELS = (
+    LABEL_ZONE,
+    LABEL_REGION,
+    "failure-domain.beta.kubernetes.io/zone",
+    "failure-domain.beta.kubernetes.io/region",
+)
+
+
+def _pod_pvcs(hub, pod: Pod):
+    """Yield (volume, pvc_or_None) for each PVC-backed volume."""
+    for v in pod.spec.volumes:
+        if isinstance(v, Volume) and v.persistent_volume_claim is not None:
+            pvc = hub.get_pvc(pod.metadata.namespace,
+                              v.persistent_volume_claim.claim_name)
+            yield v, pvc
+
+
+def _restricted_key(v: Volume) -> Optional[str]:
+    """Conflict-domain identity of a directly-attached restricted volume."""
+    if v.gce_pd_name:
+        return f"gce:{v.gce_pd_name}"
+    if v.aws_ebs_volume_id:
+        return f"ebs:{v.aws_ebs_volume_id}"
+    if v.iscsi_iqn:
+        return f"iscsi:{v.iscsi_iqn}"
+    if v.rbd_image:
+        return f"rbd:{v.rbd_image}"
+    return None
+
+
+def host_serial_keys(hub, pod: Pod) -> set[str]:
+    """Conflict-domain keys that force as-if-serial batching on the HOST
+    side: two pods sharing a key must not be filtered within one batch,
+    because the first one's placement changes the second one's verdict
+    (Scheduler defers the second to the next batch)."""
+    keys: set[str] = set()
+    for v in pod.spec.volumes:
+        if not isinstance(v, Volume):
+            continue
+        k = _restricted_key(v)
+        if k is not None:
+            keys.add(k)
+        if v.persistent_volume_claim is not None:
+            pvc = hub.get_pvc(pod.metadata.namespace,
+                              v.persistent_volume_claim.claim_name)
+            if pvc is not None:
+                if READ_WRITE_ONCE_POD in pvc.spec.access_modes:
+                    keys.add(f"rwop:{pvc.key()}")
+                if not pvc.spec.volume_name:
+                    # unbound PVCs of one storage class compete for the
+                    # same PV pool — serialize per class, not per claim
+                    keys.add(f"bindsc:{pvc.spec.storage_class_name}")
+                else:
+                    pv = hub.get_pv(pvc.spec.volume_name)
+                    if pv is not None and pv.spec.csi_driver:
+                        # attach-limit accounting is per (node, driver):
+                        # a second same-driver pod in the batch would see
+                        # stale counts (NodeVolumeLimits)
+                        keys.add(f"csi:{pv.spec.csi_driver}")
+    return keys
+
+
+class VolumeZone(PreFilterPlugin, FilterPlugin):
+    """volume_zone.go:77 (Filter), :191 (PreFilter Skip without PVCs)."""
+
+    NAME = "VolumeZone"
+    VOLUME_GATED = True  # irrelevant to pods without spec.volumes
+
+    def __init__(self, hub):
+        self.hub = hub
+
+    def pre_filter(self, state, pod: Pod, nodes) -> Status:
+        for _v, _pvc in _pod_pvcs(self.hub, pod):
+            return Status()
+        return Status.skip()
+
+    def filter(self, state, pod: Pod, node_info) -> Status:
+        node = node_info.node
+        for v, pvc in _pod_pvcs(self.hub, pod):
+            if pvc is None:
+                return Status.unschedulable(
+                    f'persistentvolumeclaim "'
+                    f'{v.persistent_volume_claim.claim_name}" not found',
+                    plugin=self.NAME, resolvable=False)
+            if not pvc.spec.volume_name:
+                continue            # unbound: VolumeBinding's business
+            pv = self.hub.get_pv(pvc.spec.volume_name)
+            if pv is None:
+                continue
+            for key in ZONE_LABELS:
+                want = pv.metadata.labels.get(key)
+                if want is None:
+                    continue
+                # PV zone labels may hold a __ separated set (volume_zone.go
+                # uses LabelZonesToSet)
+                allowed = set(want.split("__"))
+                got = node.metadata.labels.get(key)
+                if got not in allowed:
+                    return Status.unschedulable(
+                        "node(s) had no available volume zone",
+                        plugin=self.NAME)
+        return Status()
+
+
+class VolumeRestrictions(PreFilterPlugin, FilterPlugin):
+    """volume_restrictions.go: disk write conflicts on the node (:77-120)
+    + ReadWriteOncePod conflicts (:126-199, cluster-wide at PreFilter)."""
+
+    NAME = "VolumeRestrictions"
+    VOLUME_GATED = True  # irrelevant to pods without spec.volumes
+
+    def __init__(self, hub):
+        self.hub = hub
+
+    def _relevant(self, pod: Pod) -> bool:
+        for v in pod.spec.volumes:
+            if not isinstance(v, Volume):
+                continue
+            if _restricted_key(v) is not None:
+                return True
+            if v.persistent_volume_claim is not None:
+                pvc = self.hub.get_pvc(
+                    pod.metadata.namespace,
+                    v.persistent_volume_claim.claim_name)
+                if pvc is not None \
+                        and READ_WRITE_ONCE_POD in pvc.spec.access_modes:
+                    return True
+        return False
+
+    def pre_filter(self, state, pod: Pod, nodes) -> Status:
+        if not self._relevant(pod):
+            return Status.skip()
+        # ReadWriteOncePod: at most one pod cluster-wide may use the claim
+        for v, pvc in _pod_pvcs(self.hub, pod):
+            if pvc is None or READ_WRITE_ONCE_POD not in pvc.spec.access_modes:
+                continue
+            for other in self.hub.list_pods():
+                if other.metadata.uid == pod.metadata.uid \
+                        or not other.spec.node_name \
+                        or other.metadata.namespace != pod.metadata.namespace:
+                    continue
+                for ov in other.spec.volumes:
+                    if (isinstance(ov, Volume)
+                            and ov.persistent_volume_claim is not None
+                            and ov.persistent_volume_claim.claim_name
+                            == pvc.metadata.name):
+                        return Status.unschedulable(
+                            "pod uses a ReadWriteOncePod volume already in "
+                            "use by another pod", plugin=self.NAME,
+                            resolvable=False)
+        return Status()
+
+    def filter(self, state, pod: Pod, node_info) -> Status:
+        mine = {}
+        for v in pod.spec.volumes:
+            if isinstance(v, Volume):
+                k = _restricted_key(v)
+                if k is not None:
+                    mine[k] = v.read_only
+        if not mine:
+            return Status()
+        for pi in node_info.pods:
+            for ov in pi.pod.spec.volumes:
+                if not isinstance(ov, Volume):
+                    continue
+                k = _restricted_key(ov)
+                if k in mine:
+                    # iSCSI/RBD allow read-only sharing; GCE/EBS never share
+                    both_ro = mine[k] and ov.read_only
+                    sharable = k.startswith(("iscsi:", "rbd:")) and both_ro
+                    if not sharable:
+                        return Status.unschedulable(
+                            "node has a volume conflict", plugin=self.NAME)
+        return Status()
+
+
+class NodeVolumeLimits(PreFilterPlugin, FilterPlugin):
+    """nodevolumelimits/csi.go: #attached CSI volumes per driver vs the
+    node's allocatable `attachable-volumes-csi-<driver>` limit."""
+
+    NAME = "NodeVolumeLimits"
+    VOLUME_GATED = True  # irrelevant to pods without spec.volumes
+
+    def __init__(self, hub):
+        self.hub = hub
+
+    def _csi_drivers(self, pod: Pod) -> list[str]:
+        out = []
+        for _v, pvc in _pod_pvcs(self.hub, pod):
+            if pvc is None or not pvc.spec.volume_name:
+                continue
+            pv = self.hub.get_pv(pvc.spec.volume_name)
+            if pv is not None and pv.spec.csi_driver:
+                out.append(pv.spec.csi_driver)
+        return out
+
+    STATE_KEY = "NodeVolumeLimits/drivers"
+
+    def pre_filter(self, state, pod: Pod, nodes) -> Status:
+        # the pod's own per-driver counts: once per pod, not per node
+        counts: dict[str, int] = {}
+        for d in self._csi_drivers(pod):
+            counts[d] = counts.get(d, 0) + 1
+        if not counts:
+            return Status.skip()
+        state.write(self.STATE_KEY, counts)
+        return Status()
+
+    def filter(self, state, pod: Pod, node_info) -> Status:
+        counts = state.read(self.STATE_KEY) or {}
+        node = node_info.node
+        limits = {d: node.status.allocatable.get(
+            f"attachable-volumes-csi-{d}") for d in counts}
+        if not any(v is not None for v in limits.values()):
+            return Status()
+        used: dict[str, int] = {}
+        for pi in node_info.pods:           # one pass over node pods
+            for d in self._csi_drivers(pi.pod):
+                used[d] = used.get(d, 0) + 1
+        for driver, new in counts.items():
+            limit_s = limits[driver]
+            if limit_s is None:
+                continue
+            if used.get(driver, 0) + new > parse_int(limit_s):
+                return Status.unschedulable(
+                    "node(s) exceed max volume count", plugin=self.NAME)
+        return Status()
+
+
+# --------------------------- VolumeBinding ---------------------------
+
+
+@dataclass
+class AssumeCache:
+    """util/assumecache/assume_cache.go, reduced to what the binder needs:
+    optimistic PV/PVC views layered over the hub until the API writes land
+    or the assume is reverted."""
+
+    pvs: dict[str, PersistentVolume] = field(default_factory=dict)
+    pvcs: dict[str, PersistentVolumeClaim] = field(default_factory=dict)
+
+    def assume_pv(self, pv: PersistentVolume) -> None:
+        self.pvs[pv.metadata.name] = pv
+
+    def assume_pvc(self, pvc: PersistentVolumeClaim) -> None:
+        self.pvcs[pvc.key()] = pvc
+
+    def restore(self, pv_name: str = "", pvc_key: str = "") -> None:
+        if pv_name:
+            self.pvs.pop(pv_name, None)
+        if pvc_key:
+            self.pvcs.pop(pvc_key, None)
+
+
+class VolumeBinding(PreFilterPlugin, FilterPlugin, ReservePlugin,
+                    PreBindPlugin):
+    """volume_binding.go Filter (:268) + Reserve (:318 AssumePodVolumes) +
+    PreBind (:346 BindPodVolumes) + Unreserve (:334 revert)."""
+
+    NAME = "VolumeBinding"
+    VOLUME_GATED = True  # irrelevant to pods without spec.volumes
+    STATE_KEY = "VolumeBinding/assumed"
+    PLAN_KEY = "VolumeBinding/plan"
+
+    def __init__(self, hub):
+        self.hub = hub
+        self.assume = AssumeCache()
+
+    # --- hub views through the assume overlay ---
+
+    def _pv(self, name: str) -> Optional[PersistentVolume]:
+        return self.assume.pvs.get(name) or self.hub.get_pv(name)
+
+    def _pvc(self, ns: str, name: str) -> Optional[PersistentVolumeClaim]:
+        return (self.assume.pvcs.get(f"{ns}/{name}")
+                or self.hub.get_pvc(ns, name))
+
+    def _pod_claims(self, pod: Pod):
+        for v in pod.spec.volumes:
+            if isinstance(v, Volume) and v.persistent_volume_claim is not None:
+                yield self._pvc(pod.metadata.namespace,
+                                v.persistent_volume_claim.claim_name)
+
+    def pre_filter(self, state, pod: Pod, nodes) -> Status:
+        claims = list(self._pod_claims(pod))
+        if not any(c is not None for c in claims):
+            if any(v.persistent_volume_claim is not None
+                   for v in pod.spec.volumes if isinstance(v, Volume)):
+                return Status.unschedulable(
+                    "persistentvolumeclaim not found", plugin=self.NAME,
+                    resolvable=False)
+            return Status.skip()
+        for pvc in claims:
+            if pvc is None:
+                return Status.unschedulable(
+                    "persistentvolumeclaim not found", plugin=self.NAME,
+                    resolvable=False)
+            if pvc.spec.volume_name:
+                continue
+            sc = self.hub.get_storage_class(pvc.spec.storage_class_name)
+            mode = sc.volume_binding_mode if sc is not None else ""
+            if mode != VOLUME_BINDING_WAIT:
+                # unbound Immediate-mode claim: the PV controller must bind
+                # it first (volume_binding.go:243)
+                return Status.unschedulable(
+                    "pod has unbound immediate PersistentVolumeClaims",
+                    plugin=self.NAME, resolvable=False)
+        # per-claim Filter work, computed once per pod (the reference's
+        # PreFilter builds podVolumeClaims the same way): bound claims ->
+        # their PV; unbound claims -> (class/access/size-matched candidate
+        # PVs, provisionable flag). Filter then only checks per-node
+        # affinity against these.
+        plan = []
+        for pvc in claims:
+            if pvc.spec.volume_name:
+                plan.append(("bound", self._pv(pvc.spec.volume_name)))
+            else:
+                cands = [pv for pv in
+                         (self._pv(p.metadata.name) or p
+                          for p in self.hub.list_pvs())
+                         if self._pv_fits_claim(pv, pvc)]
+                cands.sort(key=lambda pv: parse_bytes(
+                    pv.spec.capacity.get("storage", "0")))
+                sc2 = self.hub.get_storage_class(pvc.spec.storage_class_name)
+                provisionable = sc2 is not None and bool(sc2.provisioner)
+                plan.append(("unbound", (cands, provisionable)))
+        state.write(self.PLAN_KEY, plan)
+        return Status()
+
+    # --- matching (scheduler_binder.go findMatchingVolumes) ---
+
+    def _pv_fits_claim(self, pv: PersistentVolume,
+                       pvc: PersistentVolumeClaim) -> bool:
+        if pv.spec.claim_ref is not None:
+            return False
+        if pv.spec.storage_class_name != pvc.spec.storage_class_name:
+            return False
+        if not set(pvc.spec.access_modes) <= set(pv.spec.access_modes):
+            return False
+        want = parse_bytes(pvc.spec.requests.get("storage", "0"))
+        got = parse_bytes(pv.spec.capacity.get("storage", "0"))
+        return got >= want
+
+    def _find_pv_for(self, pvc: PersistentVolumeClaim, node) -> Optional[
+            PersistentVolume]:
+        best = None
+        best_cap = None
+        for pv in self.hub.list_pvs():
+            pv = self._pv(pv.metadata.name) or pv
+            if not self._pv_fits_claim(pv, pvc):
+                continue
+            if not node_selector_matches(pv.spec.node_affinity, node):
+                continue
+            cap = parse_bytes(pv.spec.capacity.get("storage", "0"))
+            if best is None or cap < best_cap:   # smallest fitting PV
+                best, best_cap = pv, cap
+        return best
+
+    def filter(self, state, pod: Pod, node_info) -> Status:
+        node = node_info.node
+        for kind, data in state.read(self.PLAN_KEY) or []:
+            if kind == "bound":
+                pv = data
+                if pv is not None and not node_selector_matches(
+                        pv.spec.node_affinity, node):
+                    return Status.unschedulable(
+                        "node(s) had volume node affinity conflict",
+                        plugin=self.NAME)
+                continue
+            cands, provisionable = data
+            if provisionable:
+                continue            # dynamic provisioning will cover it
+            if any(node_selector_matches(pv.spec.node_affinity, node)
+                   for pv in cands):
+                continue
+            return Status.unschedulable(
+                "node(s) didn't find available persistent volumes to bind",
+                plugin=self.NAME)
+        return Status()
+
+    # --- Reserve: AssumePodVolumes ---
+
+    def reserve(self, state, pod: Pod, node_name: str) -> Status:
+        unbound = [pvc for pvc in self._pod_claims(pod)
+                   if pvc is not None and not pvc.spec.volume_name]
+        if not unbound:
+            return Status()     # nothing to assume (the hot-path exit)
+        node = self.hub.get_node(node_name)
+        assumed = []
+        for pvc in unbound:
+            pv = self._find_pv_for(pvc, node) if node is not None else None
+            if pv is None:
+                sc = self.hub.get_storage_class(pvc.spec.storage_class_name)
+                if sc is not None and sc.provisioner:
+                    continue        # provisioned at PreBind in a real cluster
+                for _pv_name, _pvc_key in assumed:
+                    self.assume.restore(_pv_name, _pvc_key)
+                return Status.unschedulable(
+                    "no persistent volume to bind", plugin=self.NAME)
+            new_pv = pv.clone()
+            from kubernetes_tpu.api.objects import ClaimRef
+
+            new_pv.spec.claim_ref = ClaimRef(
+                namespace=pvc.metadata.namespace, name=pvc.metadata.name,
+                uid=pvc.metadata.uid)
+            new_pvc = pvc.clone()
+            new_pvc.spec.volume_name = pv.metadata.name
+            self.assume.assume_pv(new_pv)
+            self.assume.assume_pvc(new_pvc)
+            assumed.append((pv.metadata.name, new_pvc.key()))
+        state.write(self.STATE_KEY, assumed)
+        return Status()
+
+    def unreserve(self, state, pod: Pod, node_name: str) -> None:
+        for pv_name, pvc_key in state.read(self.STATE_KEY) or []:
+            self.assume.restore(pv_name, pvc_key)
+
+    # --- PreBind: BindPodVolumes (API writes) ---
+
+    def pre_bind(self, state, pod: Pod, node_name: str) -> Status:
+        for pv_name, pvc_key in state.read(self.STATE_KEY) or []:
+            pv = self.assume.pvs.get(pv_name)
+            pvc = self.assume.pvcs.get(pvc_key)
+            try:
+                if pv is not None:
+                    stored = self.hub.get_pv(pv_name)
+                    if stored is not None:
+                        new = stored.clone()
+                        new.spec.claim_ref = pv.spec.claim_ref
+                        new.status.phase = "Bound"
+                        self.hub.update_pv(new)
+                if pvc is not None:
+                    ns, name = pvc_key.split("/", 1)
+                    stored_c = self.hub.get_pvc(ns, name)
+                    if stored_c is not None:
+                        new_c = stored_c.clone()
+                        new_c.spec.volume_name = pv_name
+                        new_c.status.phase = "Bound"
+                        self.hub.update_pvc(new_c)
+            except Exception as e:  # noqa: BLE001 — surfaced as Status
+                return Status.error(str(e), plugin=self.NAME)
+            # API truth now holds the binding; drop the assumed overlay
+            self.assume.restore(pv_name, pvc_key)
+        return Status()

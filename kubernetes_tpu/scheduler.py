@@ -16,6 +16,7 @@ import time
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from kubernetes_tpu.api.objects import (
@@ -90,6 +91,7 @@ class Scheduler:
             lambda: self._enabled_filters, self.nominator)
         self.framework = Framework(profile, extra_args={
             "binder": hub.bind,
+            "hub": hub,
             "preemption_evaluator": self.preemption})
         self.queue = PriorityQueue(
             less_fn=self.framework.queue_sort_less,
@@ -100,6 +102,12 @@ class Scheduler:
             now=now)
         self._enabled_filters = self.framework.enabled_filters()
         self._weights = self.framework.score_weights()
+        self._has_host_filters = self.framework.has_host_filters()
+        self._host_volume_only = self.framework.host_filters_volume_gated()
+        self._has_host_scores = self.framework.has_host_scores()
+        # pods popped but deferred to a later batch (host-serial volume
+        # conflicts — see _defer_host_conflicts); still in-flight queue-wise
+        self._deferred: list[QueuedPodInfo] = []
         self.stats = {"scheduled": 0, "unschedulable": 0, "errors": 0,
                       "batches": 0, "attempts": 0}
         # device-resident (free, nonzero_requested) chain: the post-launch
@@ -128,6 +136,17 @@ class Scheduler:
             on_add=lambda ns: self._on_ns_set(ns),
             on_update=lambda old, new: self._on_ns_set(new),
             on_delete=lambda ns: self._on_ns_delete(ns)))
+        # volume objects: pure requeue signals (no device state involved)
+        self.hub.watch_pvcs(EventHandlers(
+            on_add=lambda o: self.queue.move_all_to_active_or_backoff(
+                ClusterEvent(R.PVC, A.ADD), None, o),
+            on_update=lambda old, new: self.queue.move_all_to_active_or_backoff(
+                ClusterEvent(R.PVC, A.UPDATE), old, new)))
+        self.hub.watch_pvs(EventHandlers(
+            on_add=lambda o: self.queue.move_all_to_active_or_backoff(
+                ClusterEvent(R.PV, A.ADD), None, o),
+            on_update=lambda old, new: self.queue.move_all_to_active_or_backoff(
+                ClusterEvent(R.PV, A.UPDATE), old, new)))
 
     def _on_ns_set(self, ns) -> None:
         self._chain = None
@@ -231,8 +250,12 @@ class Scheduler:
 
     def _pop_runnable(self) -> tuple[int, list[QueuedPodInfo]]:
         """Pop up to batch_size pods and apply skipPodSchedule
-        (schedule_one.go:380: deleted or already assumed)."""
-        batch = self.queue.pop_batch(self.config.batch_size)
+        (schedule_one.go:380: deleted or already assumed). Pods deferred
+        from the previous batch (host-serial volume conflicts) go first —
+        they are still in flight from their original pop."""
+        deferred, self._deferred = self._deferred, []
+        batch = deferred + self.queue.pop_batch(
+            self.config.batch_size - len(deferred))
         runnable: list[QueuedPodInfo] = []
         for qp in batch:
             stored = self.hub.get_pod(qp.uid)
@@ -250,11 +273,15 @@ class Scheduler:
         without a host snapshot/mirror re-sync? Requires: a live chain (no
         external event since the newest dispatch) and a launch that reads
         nothing the skipped sync would refresh — no topology kernels (pod
-        table) and no batch host ports (port tables)."""
+        table), no batch host ports (port tables), and no host-filter work
+        (host plugins read the snapshot, so it must be fresh)."""
         return (self._chain is not None
                 and not self.mirror.table_has_topology()
                 and not self.mirror.batch_has_topology(pods)
-                and not self.mirror.batch_has_host_ports(pods))
+                and not self.mirror.batch_has_host_ports(pods)
+                and not (self._has_host_filters
+                         and (not self._host_volume_only
+                              or any(p.spec.volumes for p in pods))))
 
     def _dispatch(self, runnable: list[QueuedPodInfo], chained: bool,
                   flush_pending=None) -> Optional[tuple]:
@@ -264,6 +291,10 @@ class Scheduler:
         still-in-flight previous launch before any fallback re-sync, so a
         chained dispatch that has to re-bucket never syncs a cache missing
         the previous batch's placements."""
+        if self._has_host_filters:
+            runnable = self._defer_host_conflicts(runnable)
+            if not runnable:
+                return None
         self.stats["batches"] += 1
         self.stats["attempts"] += len(runnable)
         state = self._chain if chained else None
@@ -301,13 +332,91 @@ class Scheduler:
                            [qp.pod for qp in runnable])
                        and self._enabled_filters[FILTER_PLUGINS.index(
                            "NodeResourcesFit")])
+        host_ok = host_score = None
+        if self._has_host_filters:
+            host_ok, host_score = self._run_host_plugins(runnable)
         out: BatchResult = launch_batch(
             spec, self.mirror.well_known(), self._weights, self.caps,
-            self._enabled_filters, serial_scan=not use_auction, state=state)
+            self._enabled_filters, serial_scan=not use_auction, state=state,
+            host_ok=host_ok, host_score=host_score)
         # the chain advances to this launch's post-batch state; later
         # external events reset it to None via the handlers
         self._chain = (out.free, out.nzr)
         return runnable, out
+
+    def _defer_host_conflicts(self, runnable: list[QueuedPodInfo]
+                              ) -> list[QueuedPodInfo]:
+        """Host plugins can't see in-batch commits (their filters run once
+        per batch against the snapshot), so two pods whose host verdicts
+        can influence each other — a shared write-restricted volume, a
+        ReadWriteOncePod claim, an unbound PVC both want — must not share a
+        batch: keep the first, defer the rest to the next batch."""
+        from kubernetes_tpu.plugins.volume import host_serial_keys
+
+        seen: set[str] = set()
+        keep: list[QueuedPodInfo] = []
+        for qp in runnable:
+            if not qp.pod.spec.volumes:
+                keep.append(qp)
+                continue
+            keys = host_serial_keys(self.hub, qp.pod)
+            if keys & seen:
+                self._deferred.append(qp)
+            else:
+                seen |= keys
+                keep.append(qp)
+        return keep
+
+    def _run_host_plugins(self, runnable: list[QueuedPodInfo]):
+        """Host Filter (and Score) plugins per pod over the synced snapshot;
+        returns (host_ok [B, N] | None, host_score [B, N] | None) aligned to
+        mirror rows. Plugins PreFilter-Skip irrelevant pods, so this is a
+        few dict probes per pod for volume-less workloads."""
+        infos = self.snapshot.node_info_list
+        host_ok = None
+        host_score = None
+        rows = None
+        b_cap = self.config.batch_size
+        n_cap = self.caps.nodes
+
+        def node_rows():
+            nonlocal rows
+            if rows is None:
+                rows = np.array([self.mirror.row_of(ni.name)
+                                 for ni in infos], np.int64)
+            return rows
+
+        for i, qp in enumerate(runnable):
+            qp.host_reject_counts = {}
+            if self._host_volume_only and not qp.pod.spec.volumes \
+                    and not self._has_host_scores:
+                continue
+            state = CycleState()
+            mask, counts, early = self.framework.run_host_filters(
+                state, qp.pod, infos)
+            if counts:
+                qp.host_reject_counts = counts
+            if early is not None:
+                if host_ok is None:
+                    host_ok = np.ones((b_cap, n_cap), bool)
+                host_ok[i, :] = False
+                continue
+            if mask is not None and not all(mask):
+                if host_ok is None:
+                    host_ok = np.ones((b_cap, n_cap), bool)
+                r = node_rows()
+                bad = r[~np.asarray(mask, bool)]
+                host_ok[i, bad[bad >= 0]] = False
+            scores = (self.framework.run_host_scores(state, qp.pod, infos)
+                      if self._has_host_scores else None)
+            if scores is not None:
+                if host_score is None:
+                    host_score = np.zeros((b_cap, n_cap), np.float32)
+                r = node_rows()
+                ok = r >= 0
+                host_score[i, r[ok]] = np.asarray(scores, np.float32)[ok]
+        return (jnp.asarray(host_ok) if host_ok is not None else None,
+                jnp.asarray(host_score) if host_score is not None else None)
 
     def _finish(self, inflight: tuple) -> None:
         """Pull one dispatched launch's results and commit/fail each pod."""
@@ -404,6 +513,7 @@ class Scheduler:
         unschedulable."""
         plugins = {FILTER_PLUGINS[i] for i, c in enumerate(reject_counts)
                    if c > 0}
+        plugins |= set(qp.host_reject_counts)
         qp.unschedulable_plugins = plugins or {"NodeResourcesFit"}
         qp.unschedulable_count += 1
         qp.consecutive_errors_count = 0

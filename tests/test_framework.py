@@ -35,8 +35,9 @@ def test_default_expansion():
     fw = mkfw()
     assert [n for n, _ in fw.points["filter"]] == [
         "NodeUnschedulable", "NodeName", "TaintToleration", "NodeAffinity",
-        "NodePorts", "NodeResourcesFit", "PodTopologySpread",
-        "InterPodAffinity"]
+        "NodePorts", "NodeResourcesFit", "VolumeRestrictions",
+        "NodeVolumeLimits", "VolumeBinding", "VolumeZone",
+        "PodTopologySpread", "InterPodAffinity"]
     scores = dict(fw.points["score"])
     assert scores["TaintToleration"] == 3
     assert scores["NodeAffinity"] == 2
@@ -50,8 +51,8 @@ def test_disable_star_wipes_point():
     fw = mkfw(lambda p: setattr(p.plugins, "score",
                                 PluginSet(disabled=[Plugin("*")])))
     assert fw.points["score"] == []
-    # filters untouched
-    assert len(fw.points["filter"]) == 8
+    # filters untouched (8 device + 4 host volume plugins)
+    assert len(fw.points["filter"]) == 12
 
 
 def test_disable_single_filter_reflected_in_device_flags():

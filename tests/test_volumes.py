@@ -1,0 +1,288 @@
+"""Volume plugin family end-to-end: host filters ANDed into the device
+result with per-plugin attribution, VolumeBinding assume/bind lifecycle
+(reference: plugins/volumezone, volumerestrictions, nodevolumelimits,
+volumebinding + util/assumecache)."""
+
+from kubernetes_tpu.api.objects import (
+    LABEL_HOSTNAME,
+    LABEL_ZONE,
+    READ_WRITE_ONCE,
+    READ_WRITE_ONCE_POD,
+    VOLUME_BINDING_WAIT,
+    ClaimRef,
+    Container,
+    Node,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PersistentVolumeClaimSpec,
+    PersistentVolumeClaimVolumeSource,
+    PersistentVolumeSpec,
+    Pod,
+    PodSpec,
+    ResourceRequirements,
+    StorageClass,
+    Volume,
+)
+from kubernetes_tpu.config.types import default_config
+from kubernetes_tpu.hub import Hub
+from kubernetes_tpu.ops.features import Capacities
+from kubernetes_tpu.scheduler import Scheduler
+
+
+def mknode(i, zone="z1", extra=None):
+    name = f"node-{i}"
+    alloc = {"cpu": "16", "memory": "32Gi", "pods": "110"}
+    alloc.update(extra or {})
+    return Node(metadata=ObjectMeta(name=name, labels={
+        LABEL_HOSTNAME: name, LABEL_ZONE: zone}),
+        spec=NodeSpec(), status=NodeStatus(allocatable=alloc))
+
+
+def mkpod(name, volumes=None, ns="default"):
+    return Pod(metadata=ObjectMeta(name=name, namespace=ns),
+               spec=PodSpec(
+                   containers=[Container(name="c",
+                                         resources=ResourceRequirements(
+                                             requests={"cpu": "100m"}))],
+                   volumes=volumes or []))
+
+
+def pvc_vol(claim):
+    return Volume(name=claim, persistent_volume_claim=(
+        PersistentVolumeClaimVolumeSource(claim_name=claim)))
+
+
+def mkpvc(name, volume_name="", access=None, sc="", ns="default",
+          storage="1Gi"):
+    return PersistentVolumeClaim(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PersistentVolumeClaimSpec(
+            access_modes=access or [READ_WRITE_ONCE],
+            storage_class_name=sc, volume_name=volume_name,
+            requests={"storage": storage}))
+
+
+def mkpv(name, zone=None, sc="", access=None, storage="10Gi",
+         node_affinity=None, csi_driver=""):
+    labels = {}
+    if zone:
+        labels[LABEL_ZONE] = zone
+    return PersistentVolume(
+        metadata=ObjectMeta(name=name, labels=labels),
+        spec=PersistentVolumeSpec(
+            capacity={"storage": storage},
+            access_modes=access or [READ_WRITE_ONCE],
+            storage_class_name=sc,
+            node_affinity=node_affinity,
+            csi_driver=csi_driver))
+
+
+def mksched(hub, batch=16):
+    cfg = default_config()
+    cfg.batch_size = batch
+    return Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64))
+
+
+def bound_node(hub, pod):
+    return hub.get_pod(pod.metadata.uid).spec.node_name
+
+
+def cond_message(hub, pod):
+    conds = hub.get_pod(pod.metadata.uid).status.conditions
+    return conds[0].message if conds else ""
+
+
+def test_volume_zone_mismatch_rejects_with_plugin_name():
+    hub = Hub()
+    sched = mksched(hub)
+    hub.create_node(mknode(0, zone="east"))
+    hub.create_pv(mkpv("pv-west", zone="west"))
+    hub.create_pvc(mkpvc("claim", volume_name="pv-west"))
+    p = mkpod("p", volumes=[pvc_vol("claim")])
+    hub.create_pod(p)
+    sched.run_until_idle()
+    assert bound_node(hub, p) == ""
+    assert "VolumeZone" in cond_message(hub, p)
+
+
+def test_volume_zone_match_schedules_on_matching_node():
+    hub = Hub()
+    sched = mksched(hub)
+    hub.create_node(mknode(0, zone="east"))
+    hub.create_node(mknode(1, zone="west"))
+    hub.create_pv(mkpv("pv-west", zone="west"))
+    hub.create_pvc(mkpvc("claim", volume_name="pv-west"))
+    p = mkpod("p", volumes=[pvc_vol("claim")])
+    hub.create_pod(p)
+    sched.run_until_idle()
+    assert bound_node(hub, p) == "node-1"
+
+
+def test_volume_restrictions_gce_pd_conflict():
+    hub = Hub()
+    sched = mksched(hub)
+    hub.create_node(mknode(0))
+    hub.create_node(mknode(1))
+    disk = Volume(name="d", gce_pd_name="pd-1")
+    a, b = mkpod("a", volumes=[disk]), mkpod("b", volumes=[disk])
+    hub.create_pod(a)
+    hub.create_pod(b)
+    sched.run_until_idle()
+    na, nb = bound_node(hub, a), bound_node(hub, b)
+    assert na and nb and na != nb, "same disk never shares a node"
+
+
+def test_volume_restrictions_single_node_unschedulable():
+    hub = Hub()
+    sched = mksched(hub)
+    hub.create_node(mknode(0))
+    disk = Volume(name="d", gce_pd_name="pd-1")
+    a, b = mkpod("a", volumes=[disk]), mkpod("b", volumes=[disk])
+    hub.create_pod(a)
+    hub.create_pod(b)
+    sched.run_until_idle()
+    placed = [p for p in (a, b) if bound_node(hub, p)]
+    assert len(placed) == 1
+    loser = a if bound_node(hub, a) == "" else b
+    assert "VolumeRestrictions" in cond_message(hub, loser)
+
+
+def test_read_write_once_pod_conflict():
+    hub = Hub()
+    sched = mksched(hub)
+    hub.create_node(mknode(0))
+    hub.create_node(mknode(1))
+    hub.create_pv(mkpv("pv1"))
+    hub.create_pvc(mkpvc("rwop", volume_name="pv1",
+                         access=[READ_WRITE_ONCE_POD]))
+    a, b = (mkpod("a", volumes=[pvc_vol("rwop")]),
+            mkpod("b", volumes=[pvc_vol("rwop")]))
+    hub.create_pod(a)
+    hub.create_pod(b)
+    sched.run_until_idle()
+    placed = [p for p in (a, b) if bound_node(hub, p)]
+    assert len(placed) == 1, "ReadWriteOncePod is cluster-exclusive"
+    loser = a if bound_node(hub, a) == "" else b
+    assert "VolumeRestrictions" in cond_message(hub, loser)
+
+
+def test_node_volume_limits():
+    hub = Hub()
+    sched = mksched(hub)
+    hub.create_node(mknode(0, extra={"attachable-volumes-csi-x": "1"}))
+    hub.create_node(mknode(1, extra={"attachable-volumes-csi-x": "1"}))
+    for i in range(2):
+        hub.create_pv(mkpv(f"pv{i}", csi_driver="x"))
+        hub.create_pvc(mkpvc(f"c{i}", volume_name=f"pv{i}"))
+    a, b = (mkpod("a", volumes=[pvc_vol("c0")]),
+            mkpod("b", volumes=[pvc_vol("c1")]))
+    hub.create_pod(a)
+    hub.create_pod(b)
+    sched.run_until_idle()
+    na, nb = bound_node(hub, a), bound_node(hub, b)
+    assert na and nb and na != nb, "limit 1 per node forces a spread"
+
+
+def test_unbound_immediate_claim_is_unresolvable():
+    hub = Hub()
+    sched = mksched(hub)
+    hub.create_node(mknode(0))
+    hub.create_pvc(mkpvc("claim"))      # no storage class => Immediate
+    p = mkpod("p", volumes=[pvc_vol("claim")])
+    hub.create_pod(p)
+    sched.run_until_idle()
+    assert bound_node(hub, p) == ""
+    assert "VolumeBinding" in cond_message(hub, p)
+
+
+def test_wait_for_first_consumer_binds_pv_at_prebind():
+    hub = Hub()
+    sched = mksched(hub)
+    hub.create_node(mknode(0))
+    hub.create_node(mknode(1))
+    hub.create_storage_class(StorageClass(
+        metadata=ObjectMeta(name="wffc"),
+        volume_binding_mode=VOLUME_BINDING_WAIT))
+    # PV restricted to node-1 via node affinity
+    aff = NodeSelector(node_selector_terms=[NodeSelectorTerm(
+        match_expressions=[NodeSelectorRequirement(
+            key=LABEL_HOSTNAME, operator="In", values=["node-1"])])])
+    hub.create_pv(mkpv("pv1", sc="wffc", node_affinity=aff))
+    hub.create_pvc(mkpvc("claim", sc="wffc"))
+    p = mkpod("p", volumes=[pvc_vol("claim")])
+    hub.create_pod(p)
+    sched.run_until_idle()
+    assert bound_node(hub, p) == "node-1", "only node-1 matches the PV"
+    pv = hub.get_pv("pv1")
+    pvc = hub.get_pvc("default", "claim")
+    assert pv.spec.claim_ref is not None
+    assert pv.spec.claim_ref.name == "claim"
+    assert pv.status.phase == "Bound"
+    assert pvc.spec.volume_name == "pv1"
+    assert pvc.status.phase == "Bound"
+
+
+def test_wffc_no_matching_pv_no_provisioner_rejects():
+    hub = Hub()
+    sched = mksched(hub)
+    hub.create_node(mknode(0))
+    hub.create_storage_class(StorageClass(
+        metadata=ObjectMeta(name="wffc"),
+        volume_binding_mode=VOLUME_BINDING_WAIT))
+    hub.create_pvc(mkpvc("claim", sc="wffc", storage="100Gi"))
+    hub.create_pv(mkpv("small", sc="wffc", storage="1Gi"))  # too small
+    p = mkpod("p", volumes=[pvc_vol("claim")])
+    hub.create_pod(p)
+    sched.run_until_idle()
+    assert bound_node(hub, p) == ""
+    assert "VolumeBinding" in cond_message(hub, p)
+
+
+def test_two_pods_one_pv_serialized():
+    """Two pods wanting the same unbound claim family: host-serial deferral
+    keeps them in separate batches; only one PV exists, so only one claim
+    binds and the other pod parks."""
+    hub = Hub()
+    sched = mksched(hub)
+    hub.create_node(mknode(0))
+    hub.create_node(mknode(1))
+    hub.create_storage_class(StorageClass(
+        metadata=ObjectMeta(name="wffc"),
+        volume_binding_mode=VOLUME_BINDING_WAIT))
+    hub.create_pv(mkpv("pv1", sc="wffc"))
+    hub.create_pvc(mkpvc("c1", sc="wffc"))
+    hub.create_pvc(mkpvc("c2", sc="wffc"))
+    a = mkpod("a", volumes=[pvc_vol("c1")])
+    b = mkpod("b", volumes=[pvc_vol("c2")])
+    hub.create_pod(a)
+    hub.create_pod(b)
+    sched.run_until_idle()
+    bound = [p for p in (a, b) if bound_node(hub, p)]
+    assert len(bound) == 1
+    pv = hub.get_pv("pv1")
+    assert pv.spec.claim_ref is not None
+
+
+def test_volume_pod_and_plain_pods_mix():
+    """Volume-less pods ride the normal fast path in the same batch."""
+    hub = Hub()
+    sched = mksched(hub)
+    hub.create_node(mknode(0, zone="east"))
+    hub.create_node(mknode(1, zone="west"))
+    hub.create_pv(mkpv("pv-east", zone="east"))
+    hub.create_pvc(mkpvc("claim", volume_name="pv-east"))
+    vol_pod = mkpod("vp", volumes=[pvc_vol("claim")])
+    plain = [mkpod(f"p{i}") for i in range(5)]
+    hub.create_pod(vol_pod)
+    for p in plain:
+        hub.create_pod(p)
+    sched.run_until_idle()
+    assert bound_node(hub, vol_pod) == "node-0"
+    assert all(bound_node(hub, p) for p in plain)
+    assert sched.stats["scheduled"] == 6
