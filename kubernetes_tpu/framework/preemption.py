@@ -19,13 +19,18 @@ from __future__ import annotations
 import random
 from dataclasses import dataclass
 
+import jax.numpy as jnp
 import numpy as np
 
 from kubernetes_tpu.api.labels import label_selector_matches
 from kubernetes_tpu.api.objects import Pod
-from kubernetes_tpu.framework.interface import PostFilterPlugin, Status
+from kubernetes_tpu.framework.interface import (
+    PostFilterPlugin,
+    PreEnqueuePlugin,
+    Status,
+)
 from kubernetes_tpu.ops import features as F
-from kubernetes_tpu.ops.preempt import preempt_sweep_jit
+from kubernetes_tpu.ops.preempt import preempt_feasible_jit, preempt_sweep_jit
 from kubernetes_tpu.utils.interner import NONE
 
 MI = 1024 * 1024
@@ -33,6 +38,11 @@ MI = 1024 * 1024
 # default_preemption.go:40-44 (DefaultPreemptionArgs defaults)
 MIN_CANDIDATE_NODES_PERCENTAGE = 10
 MIN_CANDIDATE_NODES_ABSOLUTE = 100
+
+# bound on exact dry-run launches per preemption attempt: candidates tried
+# (verification) + reprieve steps on the winner
+MAX_VERIFY_CANDIDATES = 8
+MAX_REPRIEVE_STEPS = 16
 
 
 @dataclass
@@ -64,6 +74,11 @@ class Evaluator:
         # object and dropped when the scheduler rebuilds it
         self._res_rows: dict[str, np.ndarray] = {}
         self._res_rows_mirror: object = None
+        # async preemption (preemption.go:460 prepareCandidateAsync +
+        # kep 4832): pods whose victims are still being evicted, and the
+        # eviction work queue the scheduler drains between cycles
+        self.preempting: set[str] = set()
+        self._pending: list[tuple[Candidate, Pod]] = []
 
     # ---------------- eligibility (default_preemption.go:327) -------------
 
@@ -152,9 +167,47 @@ class Evaluator:
         kmin = np.asarray(preempt_sweep_jit(
             cblobs, pblobs, mirror.well_known(), cumsum, caps,
             self._get_enabled_filters()))
+        self._kmin = kmin                     # reused by _minimize_victims
+        self._victims_by_row = victims_by_row
 
-        rows = [row for row, vs in victims_by_row.items()
-                if kmin[row] != NONE and 1 <= kmin[row] <= len(vs)]
+        # candidate rows: full-filter feasibility with EVERY victim evicted
+        # (the reference's remove-all first step, default_preemption.go:219,
+        # evaluated for all nodes in one launch). This is the exact superset
+        # of per-node-eviction feasibility for monotone filters; the chosen
+        # candidate is re-verified with per-node masking before any eviction
+        # happens, so an optimistic row costs one extra launch, never a
+        # wrong eviction. Topology-blocked preemptors (a victim's
+        # anti-affinity, a hard spread violation) find candidates here even
+        # though they "fit" resource-wise — the gap the resource-only sweep
+        # could not cover.
+        all_uids = {pi.pod.metadata.uid
+                    for vs in victims_by_row.values() for pi in vs}
+        # keep victims that could SATISFY the preemptor's required affinity
+        # visible: masking them cluster-wide would under-approximate
+        # feasibility (the reference only ever removes the candidate node's
+        # own pods). A provider-victim on the chosen node itself is caught
+        # by the exact per-node verification.
+        aff = pod.spec.affinity
+        aff_terms = (aff.pod_affinity.required
+                     if aff is not None and aff.pod_affinity is not None
+                     else [])
+        if aff_terms:
+            for vs in victims_by_row.values():
+                for pi in vs:
+                    v = pi.pod
+                    for term in aff_terms:
+                        ns_ok = (v.metadata.namespace
+                                 == pod.metadata.namespace
+                                 if not term.namespaces
+                                 else v.metadata.namespace in term.namespaces)
+                        if ns_ok and label_selector_matches(
+                                term.label_selector, v.metadata.labels):
+                            all_uids.discard(v.metadata.uid)
+                            break
+        freed = {row: cumsum[row, len(vs)]
+                 for row, vs in victims_by_row.items()}
+        feas = self._dryrun_feasible(pod, all_uids, freed)
+        rows = [row for row in victims_by_row if feas[row]]
         if not rows:
             return []
 
@@ -171,13 +224,135 @@ class Evaluator:
         pdbs = self.hub.list_pdbs()
         out = []
         for row in picked:
-            vs = victims_by_row[row][: int(kmin[row])]
+            vs = victims_by_row[row]
+            # rank candidates by their minimal-victim ESTIMATE: the kmin
+            # prefix when the resource sweep found one (exact for
+            # resource-blocked preemptors), the full list otherwise —
+            # select_candidate's pdb/priority/count keys would regress if
+            # computed over pods that will never be evicted
+            k = int(kmin[row])
+            if k != NONE and 1 <= k <= len(vs):
+                vs = vs[:k]
             victims = [pi.pod for pi in vs]
             out.append(Candidate(
                 node_name=mirror.name_of_row(row) or "",
                 row=row, victims=victims,
                 pdb_violations=self._pdb_violations(victims, pdbs)))
         return out
+
+    def _dryrun_feasible(self, pod: Pod, exclude_uids, freed_by_row
+                         ) -> np.ndarray:
+        """[N] bool: FULL filter set for ``pod`` with ``exclude_uids``
+        masked out of the device pod table and each row's free resources
+        raised by its freed vector (ops.preempt.preempt_feasible)."""
+        mirror = self._get_mirror()
+        caps = self._get_caps()
+        tval = mirror.table_valid_mask(exclude_uids)
+        free = mirror.free_matrix()
+        for row, vec in freed_by_row.items():
+            free[row] = free[row] + vec
+        pblobs = mirror.pack_batch_blobs([pod], 1)
+        enable = (mirror.table_has_topology()
+                  or mirror.batch_has_topology([pod]))
+        return np.asarray(preempt_feasible_jit(
+            mirror.to_blobs(), pblobs, mirror.well_known(), caps,
+            jnp.asarray(tval), jnp.asarray(free), enable,
+            mirror.domain_bucket(), self._get_enabled_filters()))
+
+    def _res_row_cached(self, pod: Pod) -> np.ndarray:
+        from kubernetes_tpu.api.resources import pod_request
+
+        uid = pod.metadata.uid
+        rr = self._res_rows.get(uid)
+        if rr is None:
+            rr = np.asarray(self._get_mirror()._res_row(pod_request(pod)),
+                            np.float32)
+            self._res_rows[uid] = rr
+        return rr
+
+    def _minimize_victims(self, pod: Pod, cand: Candidate,
+                          pdbs) -> Candidate | None:
+        """Exact verification + reprieve for one candidate (the
+        reference's per-node reprieve loop, default_preemption.go:219):
+
+        1. Verify the pod actually fits with ONLY this node's victims
+           evicted (full filters). A candidate from the optimistic
+           all-evicted pass that fails here is discarded — no eviction ever
+           happens on an unverified candidate.
+        2. If the resource sweep found a feasible prefix, try it first: the
+           prefix (least-important victims) is the resource-space reprieve
+           fixed point, one launch to confirm.
+        3. Otherwise reprieve victims one at a time — PDB-violating victims
+           first, then most-important-first — keeping each reprieve that
+           leaves the pod feasible (bounded by MAX_REPRIEVE_STEPS).
+        """
+        row = cand.row
+        victims = list(cand.victims)        # ascending importance
+
+        def feasible_with(vset: list[Pod]) -> bool:
+            if not vset:
+                return False
+            freed = np.zeros_like(self._res_row_cached(vset[0]))
+            for v in vset:
+                freed = freed + self._res_row_cached(v)
+            feas = self._dryrun_feasible(
+                pod, {v.metadata.uid for v in vset}, {row: freed})
+            return bool(feas[row])
+
+        if not feasible_with(victims):
+            # the candidate carried the kmin-trimmed ranking estimate; try
+            # the node's full victim set before giving up (topology-blocked
+            # preemptors may need more than the resource prefix)
+            full = [pi.pod for pi in self._victims_by_row.get(row, [])]
+            if len(full) > len(victims) and feasible_with(full):
+                victims = full
+            else:
+                return None                 # unverifiable candidate: discard
+        kmin = getattr(self, "_kmin", None)
+        k = int(kmin[row]) if kmin is not None else NONE
+        if k != NONE and 1 <= k < len(victims):
+            prefix = victims[:k]
+            if feasible_with(prefix):
+                victims = prefix
+        if len(victims) > 1:
+            flags = self._pdb_violation_flags(victims, pdbs)
+            # reprieve order: PDB-violating first, then priority desc,
+            # then older first (filterPodsWithPDBViolation + reprievePod)
+            order = sorted(
+                range(len(victims)),
+                key=lambda i: (not flags[i], -victims[i].priority(),
+                               victims[i].metadata.creation_timestamp))
+            kept = set()
+            steps = 0
+            for i in order:
+                if steps >= MAX_REPRIEVE_STEPS or len(victims) - len(kept) <= 1:
+                    break
+                trial = [v for j, v in enumerate(victims)
+                         if j != i and j not in kept]
+                steps += 1
+                if feasible_with(trial):
+                    kept.add(i)
+            victims = [v for j, v in enumerate(victims) if j not in kept]
+        return Candidate(
+            node_name=cand.node_name, row=row, victims=victims,
+            pdb_violations=self._pdb_violations(victims, pdbs))
+
+    @staticmethod
+    def _pdb_violation_flags(victims: list[Pod], pdbs) -> list[bool]:
+        """Per-victim: does evicting it violate some exhausted PDB?"""
+        budget = {pdb.metadata.uid: pdb.disruptions_allowed for pdb in pdbs}
+        flags = []
+        for v in victims:
+            matched = [pdb for pdb in pdbs
+                       if pdb.metadata.namespace == v.metadata.namespace
+                       and pdb.selector is not None
+                       and label_selector_matches(pdb.selector,
+                                                  v.metadata.labels)]
+            flags.append(any(budget[pdb.metadata.uid] <= 0
+                             for pdb in matched))
+            for pdb in matched:
+                budget[pdb.metadata.uid] -= 1
+        return flags
 
     @staticmethod
     def _pdb_violations(victims: list[Pod], pdbs) -> int:
@@ -222,19 +397,40 @@ class Evaluator:
     # ---------------- execution (preemption.go:428 prepareCandidate) ------
 
     def prepare_candidate(self, candidate: Candidate, pod: Pod) -> None:
-        for victim in candidate.victims:
-            try:
-                self.hub.delete_pod(victim.metadata.uid)
-            except Exception:  # noqa: BLE001 — already gone is fine
-                pass
-        # lower-priority nominees on this node must re-evaluate: drop the
-        # nomination AND clear the API status (the stale nominatedNodeName
-        # would otherwise keep feeding the pipeline's own-reservation
-        # add-back); the status update event re-activates them
-        dropped = self.nominator.clear_for_node_below_priority(
-            candidate.node_name, pod.priority())
-        for nominee in dropped:
-            self.hub.clear_nominated_node(nominee.metadata.uid)
+        """Queue the eviction work (prepareCandidateAsync, kep 4832): the
+        scheduler drains it via flush_evictions OUTSIDE the scheduling
+        cycle, and the DefaultPreemption PreEnqueue gate keeps the
+        preemptor parked until its victims are gone."""
+        self.preempting.add(pod.metadata.uid)
+        self._pending.append((candidate, pod))
+
+    def flush_evictions(self) -> int:
+        """Execute queued evictions; returns the number of preparations
+        run. The preemptor leaves ``preempting`` BEFORE the last victim
+        deletion so that deletion's cluster event finds the gate open and
+        requeues it (preemption.go:528's ordering)."""
+        work, self._pending = self._pending, []
+        for candidate, pod in work:
+            # lower-priority nominees on this node must re-evaluate: drop
+            # the nomination AND clear the API status; the update event
+            # re-activates them
+            dropped = self.nominator.clear_for_node_below_priority(
+                candidate.node_name, pod.priority())
+            for nominee in dropped:
+                self.hub.clear_nominated_node(nominee.metadata.uid)
+            victims = candidate.victims
+            for victim in victims[:-1]:
+                try:
+                    self.hub.delete_pod(victim.metadata.uid)
+                except Exception:  # noqa: BLE001 — already gone is fine
+                    pass
+            self.preempting.discard(pod.metadata.uid)
+            if victims:
+                try:
+                    self.hub.delete_pod(victims[-1].metadata.uid)
+                except Exception:  # noqa: BLE001
+                    pass
+        return len(work)
 
     # ---------------- the whole PostFilter flow ----------------
 
@@ -246,17 +442,26 @@ class Evaluator:
                 f"not eligible for preemption: {why}",
                 plugin="DefaultPreemption")
         candidates = self.find_candidates(pod, snapshot)
-        best = self.select_candidate(candidates)
-        if best is None:
-            return None, Status.unschedulable(
-                "no preemption candidates", plugin="DefaultPreemption")
-        self.prepare_candidate(best, pod)
-        self.nominator.add(pod, best.node_name)
-        return best.node_name, Status()
+        pdbs = self.hub.list_pdbs()
+        for _ in range(min(len(candidates), MAX_VERIFY_CANDIDATES)):
+            best = self.select_candidate(candidates)
+            if best is None:
+                break
+            final = self._minimize_victims(pod, best, pdbs)
+            if final is not None:
+                self.prepare_candidate(final, pod)
+                self.nominator.add(pod, final.node_name)
+                return final.node_name, Status()
+            candidates = [c for c in candidates if c is not best]
+        return None, Status.unschedulable(
+            "no preemption candidates", plugin="DefaultPreemption")
 
 
-class DefaultPreemption(PostFilterPlugin):
-    """PostFilter plugin wrapper (default_preemption.go:133)."""
+class DefaultPreemption(PostFilterPlugin, PreEnqueuePlugin):
+    """PostFilter plugin wrapper (default_preemption.go:133) + the
+    PreEnqueue gate (:146): while a pod's async preemption is in flight it
+    must not re-enter the activeQ — it would just fail again against a
+    node whose victims haven't finished going away."""
 
     NAME = "DefaultPreemption"
 
@@ -265,6 +470,13 @@ class DefaultPreemption(PostFilterPlugin):
 
     def name(self) -> str:
         return self.NAME
+
+    def pre_enqueue(self, pod: Pod) -> Status:
+        if pod.metadata.uid in self.evaluator.preempting:
+            return Status.unschedulable(
+                "waiting for the preemption for this pod to be finished",
+                plugin=self.NAME, resolvable=False)
+        return Status()
 
     def post_filter(self, state, pod: Pod, diagnosis
                     ) -> tuple[str | None, Status]:

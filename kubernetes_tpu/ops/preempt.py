@@ -85,3 +85,91 @@ def preempt_sweep_jit(cblobs, pblobs, wk, vic_cumsum, caps,
                       enabled_filters=None):
     return preempt_sweep(cblobs, pblobs, wk, vic_cumsum, caps,
                          enabled_filters)
+
+
+def preempt_feasible(cblobs: ClusterBlobs, pblobs: PodBlobs,
+                     wk: dict[str, jnp.ndarray], caps: Capacities,
+                     table_valid: jnp.ndarray, free: jnp.ndarray,
+                     enable_topology: bool = True, d_cap: int | None = None,
+                     enabled_filters: tuple[bool, ...] | None = None
+                     ) -> jnp.ndarray:
+    """[N] bool: does ONE pod pass the FULL filter set on each node, with
+    ``table_valid`` masking out victim pods and ``free`` overriding the
+    per-node free resources?
+
+    This is the exact dry-run the reference runs per candidate node
+    (defaultpreemption SelectVictimsOnNode :219: remove victims, re-run
+    RunFilterPluginsWithNominatedPods) — evaluated for EVERY node in one
+    launch. The host encodes an eviction set as (table mask, freed
+    resources); topology filters (anti-affinity, required affinity, hard
+    spread) see the post-eviction world because every count/presence map is
+    built from the masked table.
+    """
+    import dataclasses as _dc
+
+    from kubernetes_tpu.ops import topology as T
+
+    if enabled_filters is None:
+        enabled_filters = (True,) * NUM_FILTER_PLUGINS
+    if d_cap is None:
+        d_cap = caps.domain_cap
+    ct = unpack_cluster(cblobs, caps)
+    ct = _dc.replace(ct, pod_valid=ct.pod_valid & table_valid)
+    pod = jax.tree_util.tree_map(lambda x: x[0], unpack_pods(pblobs, caps))
+    valid = ct.node_valid
+    masks = static_filters(ct, pod, wk, enabled_filters,
+                           frozenset(ALL_FEATURES))
+    ok = jnp.all(masks, axis=0) & valid & pod.valid
+    # resource fit against the evicted free state
+    if enabled_filters[FILTER_PLUGINS.index("NodeResourcesFit")]:
+        own = jnp.arange(free.shape[0]) == pod.nominated_row
+        eff = free - ct.nominated_req + jnp.where(own[:, None],
+                                                  pod.req[None], 0.0)
+        ok = ok & jnp.all(pod.req[None] <= eff, axis=-1)
+    if not enable_topology:
+        return ok
+    tds = T.slot_topo_dom(ct)
+    taint_ok, nodeaff_ok = masks[2], masks[3]
+    spread_on = enabled_filters[FILTER_PLUGINS.index("PodTopologySpread")]
+    ipa_on = enabled_filters[FILTER_PLUGINS.index("InterPodAffinity")]
+    if spread_on:
+        used_c = pod.tsc_tk != jnp.int32(-1)
+        used_hard = used_c & pod.tsc_hard
+        el_hard = T.spread_eligible(ct, pod, nodeaff_ok, taint_ok, used_hard)
+        cnt = T.spread_cnt(ct, pod, tds, el_hard, d_cap)        # [C, D]
+        exists_hard = T.spread_exists(ct, pod, el_hard, d_cap)
+        min_cnt = jnp.min(jnp.where(exists_hard, cnt, jnp.inf), axis=1)
+        min_cnt = jnp.where(jnp.isfinite(min_cnt), min_cnt, 0.0)
+        num_domains = jnp.sum(exists_hard, axis=1)
+        min_cnt = jnp.where((pod.tsc_min_domains > 0)
+                            & (num_domains < pod.tsc_min_domains),
+                            0.0, min_cnt)
+        node_dom = T.take_cols(ct.topo_dom, pod.tsc_tk, jnp.int32(-1))
+        self_m = T._tsc_self_match(pod).astype(jnp.float32)
+        match_num = T.gather_rows(cnt, node_dom)                # [N, C]
+        skew = match_num + self_m[None] - min_cnt[None]
+        ok_c = (node_dom != jnp.int32(-1)) \
+            & (skew <= pod.tsc_max_skew[None])
+        ok = ok & jnp.all(ok_c | ~used_hard[None], axis=1)
+    if ipa_on:
+        anti_ok, present, any_match = T.inter_pod_affinity_static(
+            ct, pod, tds, d_cap)
+        term_used = pod.aff_tk != NONE
+        node_dom3 = T.take_cols(ct.topo_dom, pod.aff_tk, NONE)
+        has_lbl = node_dom3 != NONE
+        term_ok = has_lbl & T.gather_rows(present, node_dom3)
+        pods_exist = jnp.all(term_ok | ~term_used[None], axis=1)
+        all_lbl = jnp.all(has_lbl | ~term_used[None], axis=1)
+        self_ok = pod.aff_self_match & ~any_match & all_lbl
+        aff_ok = jnp.where(jnp.any(term_used), pods_exist | self_ok, True)
+        ok = ok & anti_ok & aff_ok
+    return ok
+
+
+@partial(jax.jit, static_argnames=("caps", "enable_topology", "d_cap",
+                                   "enabled_filters"))
+def preempt_feasible_jit(cblobs, pblobs, wk, caps, table_valid, free,
+                         enable_topology=True, d_cap=None,
+                         enabled_filters=None):
+    return preempt_feasible(cblobs, pblobs, wk, caps, table_valid, free,
+                            enable_topology, d_cap, enabled_filters)

@@ -169,7 +169,7 @@ def in_tree_registry() -> dict[str, PluginDescriptor]:
             default_weight=1,
             events=[_ev(R.NODE, A.ADD | A.UPDATE_NODE_LABEL)]),
         PluginDescriptor(
-            name="DefaultPreemption", points=("post_filter",),
+            name="DefaultPreemption", points=("post_filter", "pre_enqueue"),
             factory=_default_preemption_factory,
             events=[_ev(R.ASSIGNED_POD, A.DELETE)]),
         PluginDescriptor(

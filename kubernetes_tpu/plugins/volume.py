@@ -235,44 +235,47 @@ class NodeVolumeLimits(PreFilterPlugin, FilterPlugin):
     def __init__(self, hub):
         self.hub = hub
 
-    def _csi_drivers(self, pod: Pod) -> list[str]:
-        out = []
+    def _csi_volumes(self, pod: Pod) -> set[tuple[str, str]]:
+        """Unique (driver, pv_name) attachments the pod needs — attachments
+        are per VOLUME, not per claim reference (csi.go dedupes by the
+        volume's unique handle)."""
+        out: set[tuple[str, str]] = set()
         for _v, pvc in _pod_pvcs(self.hub, pod):
             if pvc is None or not pvc.spec.volume_name:
                 continue
             pv = self.hub.get_pv(pvc.spec.volume_name)
             if pv is not None and pv.spec.csi_driver:
-                out.append(pv.spec.csi_driver)
+                out.add((pv.spec.csi_driver, pv.metadata.name))
         return out
 
-    STATE_KEY = "NodeVolumeLimits/drivers"
+    STATE_KEY = "NodeVolumeLimits/volumes"
 
     def pre_filter(self, state, pod: Pod, nodes) -> Status:
-        # the pod's own per-driver counts: once per pod, not per node
-        counts: dict[str, int] = {}
-        for d in self._csi_drivers(pod):
-            counts[d] = counts.get(d, 0) + 1
-        if not counts:
+        vols = self._csi_volumes(pod)
+        if not vols:
             return Status.skip()
-        state.write(self.STATE_KEY, counts)
+        state.write(self.STATE_KEY, vols)
         return Status()
 
     def filter(self, state, pod: Pod, node_info) -> Status:
-        counts = state.read(self.STATE_KEY) or {}
+        vols: set = state.read(self.STATE_KEY) or set()
         node = node_info.node
+        drivers = {d for d, _ in vols}
         limits = {d: node.status.allocatable.get(
-            f"attachable-volumes-csi-{d}") for d in counts}
+            f"attachable-volumes-csi-{d}") for d in drivers}
         if not any(v is not None for v in limits.values()):
             return Status()
-        used: dict[str, int] = {}
+        attached: set[tuple[str, str]] = set()
         for pi in node_info.pods:           # one pass over node pods
-            for d in self._csi_drivers(pi.pod):
-                used[d] = used.get(d, 0) + 1
-        for driver, new in counts.items():
+            attached |= self._csi_volumes(pi.pod)
+        new_vols = vols - attached          # already-attached PVs are free
+        for driver in drivers:
             limit_s = limits[driver]
             if limit_s is None:
                 continue
-            if used.get(driver, 0) + new > parse_int(limit_s):
+            used = sum(1 for d, _ in attached if d == driver)
+            new = sum(1 for d, _ in new_vols if d == driver)
+            if used + new > parse_int(limit_s):
                 return Status.unschedulable(
                     "node(s) exceed max volume count", plugin=self.NAME)
         return Status()
@@ -364,7 +367,12 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ReservePlugin,
         plan = []
         for pvc in claims:
             if pvc.spec.volume_name:
-                plan.append(("bound", self._pv(pvc.spec.volume_name)))
+                pv = self._pv(pvc.spec.volume_name)
+                if pv is None:
+                    return Status.unschedulable(
+                        f'persistentvolume "{pvc.spec.volume_name}" '
+                        "not found", plugin=self.NAME, resolvable=False)
+                plan.append(("bound", pv))
             else:
                 cands = [pv for pv in
                          (self._pv(p.metadata.name) or p
@@ -412,8 +420,7 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ReservePlugin,
         for kind, data in state.read(self.PLAN_KEY) or []:
             if kind == "bound":
                 pv = data
-                if pv is not None and not node_selector_matches(
-                        pv.spec.node_affinity, node):
+                if not node_selector_matches(pv.spec.node_affinity, node):
                     return Status.unschedulable(
                         "node(s) had volume node affinity conflict",
                         plugin=self.NAME)

@@ -333,7 +333,7 @@ class Scheduler:
                        and self._enabled_filters[FILTER_PLUGINS.index(
                            "NodeResourcesFit")])
         host_ok = host_score = None
-        if self._has_host_filters:
+        if self._has_host_filters or self._has_host_scores:
             host_ok, host_score = self._run_host_plugins(runnable)
         out: BatchResult = launch_batch(
             spec, self.mirror.well_known(), self._weights, self.caps,
@@ -436,6 +436,7 @@ class Scheduler:
         Returns the number of pods attempted (0 = queue idle)."""
         popped, runnable = self._pop_runnable()
         if popped == 0:
+            self.preemption.flush_evictions()
             return 0
         if not runnable:
             return popped
@@ -443,6 +444,9 @@ class Scheduler:
             [qp.pod for qp in runnable]))
         if inflight is not None:
             self._finish(inflight)
+        # async preemption: victims queued by PostFilter are evicted here,
+        # OUTSIDE the cycle (prepareCandidateAsync's goroutine analog)
+        self.preemption.flush_evictions()
         return popped
 
     def _split_unsupported(self, runnable):
@@ -601,5 +605,8 @@ class Scheduler:
                 nxt = self._dispatch(runnable, chained, flush_pending=flush)
             flush()
             pending = nxt
+            # async preemption evictions run between cycles (kep 4832)
+            self.preemption.flush_evictions()
         flush()
+        self.preemption.flush_evictions()
         return total

@@ -43,7 +43,8 @@ def test_default_expansion():
     assert scores["NodeAffinity"] == 2
     assert scores["NodeResourcesFit"] == 1
     assert scores["PodTopologySpread"] == 2
-    assert [n for n, _ in fw.points["pre_enqueue"]] == ["SchedulingGates"]
+    assert [n for n, _ in fw.points["pre_enqueue"]] == [
+        "SchedulingGates", "DefaultPreemption"]
     assert [n for n, _ in fw.points["bind"]] == ["DefaultBinder"]
 
 

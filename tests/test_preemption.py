@@ -200,3 +200,121 @@ def test_nominated_reservation_not_stolen():
     drain(sched, clock)
     assert bound_node(hub, high) == "node-0"
     assert bound_node(hub, opportunist) == ""
+
+
+def test_preemption_for_anti_affinity_blocked_pod():
+    """The pod FITS resource-wise everywhere, but a low-priority victim's
+    presence violates the preemptor's required anti-affinity on every node;
+    evicting the victim (not freeing resources) is what helps — the
+    full-pipeline dry-run finds it, the resource-only sweep could not
+    (default_preemption.go:219 removes victims then re-runs ALL filters)."""
+    from kubernetes_tpu.api.objects import (
+        Affinity,
+        PodAffinityTerm,
+        PodAntiAffinity,
+    )
+
+    hub = Hub()
+    sched, clock = mksched(hub)
+    hub.create_node(mknode(0, cpu="8"))
+    # a low-priority pod labeled app=red sits on the only node
+    blocker = mkpod("blocker", cpu="100m", priority=0,
+                    labels={"app": "red"})
+    hub.create_pod(blocker)
+    drain(sched, clock)
+    assert bound_node(hub, blocker) == "node-0"
+
+    # high-priority pod with required anti-affinity against app=red:
+    # resources are plentiful; only the blocker's eviction helps
+    anti = Affinity(pod_anti_affinity=PodAntiAffinity(required=[
+        PodAffinityTerm(topology_key=LABEL_HOSTNAME,
+                        label_selector=LabelSelector(
+                            match_labels={"app": "red"}))]))
+    high = mkpod("high", cpu="100m", priority=100)
+    high.spec.affinity = anti
+    hub.create_pod(high)
+    drain(sched, clock)
+    assert hub.get_pod(blocker.metadata.uid) is None, "blocker evicted"
+    assert bound_node(hub, high) == "node-0"
+    assert sched.stats["preemptions"] >= 1
+
+
+def test_no_useless_eviction_when_anti_affinity_unresolvable():
+    """The preemptor's anti-affinity blocker is a HIGHER-priority pod: no
+    victim set can help, so nothing must be evicted even though plenty of
+    lower-priority victims exist (the exact dry-run discards the node)."""
+    from kubernetes_tpu.api.objects import (
+        Affinity,
+        PodAffinityTerm,
+        PodAntiAffinity,
+    )
+
+    hub = Hub()
+    sched, clock = mksched(hub)
+    hub.create_node(mknode(0, cpu="8"))
+    blocker = mkpod("blocker", cpu="100m", priority=200,
+                    labels={"app": "red"})
+    filler = mkpod("filler", cpu="100m", priority=0)
+    hub.create_pod(blocker)
+    hub.create_pod(filler)
+    drain(sched, clock)
+
+    anti = Affinity(pod_anti_affinity=PodAntiAffinity(required=[
+        PodAffinityTerm(topology_key=LABEL_HOSTNAME,
+                        label_selector=LabelSelector(
+                            match_labels={"app": "red"}))]))
+    high = mkpod("high", cpu="100m", priority=100)
+    high.spec.affinity = anti
+    hub.create_pod(high)
+    drain(sched, clock)
+    assert bound_node(hub, high) == ""
+    assert hub.get_pod(filler.metadata.uid) is not None, \
+        "no useless eviction of the unrelated filler"
+    assert sched.stats.get("preemptions", 0) == 0
+
+
+def test_pdb_violating_victims_reprieved_first():
+    """Two equal candidates for reprieve; the PDB-protected victim must be
+    the one KEPT when either alone would satisfy the preemptor."""
+    hub = Hub()
+    sched, clock = mksched(hub)
+    hub.create_node(mknode(0, cpu="2"))
+    protected = mkpod("protected", cpu="1", priority=0,
+                      labels={"app": "guarded"})
+    plain = mkpod("plain", cpu="1", priority=0)
+    hub.create_pod(protected)
+    hub.create_pod(plain)
+    hub.create_pdb(PodDisruptionBudget(
+        metadata=ObjectMeta(name="pdb"),
+        selector=LabelSelector(match_labels={"app": "guarded"}),
+        disruptions_allowed=0))
+    drain(sched, clock)
+    assert sched.stats["scheduled"] == 2
+
+    high = mkpod("high", cpu="1", priority=100)
+    hub.create_pod(high)
+    drain(sched, clock)
+    assert bound_node(hub, high) == "node-0"
+    assert hub.get_pod(protected.metadata.uid) is not None, \
+        "PDB-protected victim reprieved"
+    assert hub.get_pod(plain.metadata.uid) is None
+
+
+def test_async_gate_holds_preemptor_until_victims_gone():
+    """While the eviction work is queued, the preemptor is gated out of the
+    activeQ (DefaultPreemption PreEnqueue); once flush_evictions runs, the
+    deletion events requeue and it binds."""
+    hub = Hub()
+    sched, clock = mksched(hub)
+    hub.create_node(mknode(0, cpu="2"))
+    low = [mkpod(f"low-{i}", cpu="1", priority=0) for i in range(2)]
+    for p in low:
+        hub.create_pod(p)
+    drain(sched, clock)
+
+    high = mkpod("high", cpu="2", priority=100)
+    hub.create_pod(high)
+    drain(sched, clock)
+    assert bound_node(hub, high) == "node-0"
+    assert all(hub.get_pod(p.metadata.uid) is None for p in low)
+    assert not sched.preemption.preempting, "gate cleared after evictions"
