@@ -237,6 +237,12 @@ class PriorityQueue:
                     self._requeue(qp)
                     return
         self._trim_events()
+        if qp.consecutive_errors_count > 0 and not qp.unschedulable_plugins:
+            # error-class failure (apiserver hiccup, bind conflict): no
+            # cluster event will "fix" it — retry after backoff
+            # (scheduling_queue.go:861 rejectedByError -> backoffQ)
+            self._requeue(qp)
+            return
         self._unschedulable[uid] = qp
 
     def activate(self, pods: list[Pod]) -> None:

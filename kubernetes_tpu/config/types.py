@@ -82,6 +82,10 @@ class SchedulerConfiguration:
     percentage_of_nodes_to_score: Optional[int] = None  # 0/None = adaptive
     pod_initial_backoff_seconds: float = 1.0
     pod_max_backoff_seconds: float = 10.0
+    # binding cycle: runs on a worker pool after assume+permit
+    # (schedule_one.go:124's per-pod goroutine)
+    async_binding: bool = True
+    binding_workers: int = 4
     # TPU-build knobs
     batch_size: int = 256       # pods scored per XLA launch
     node_capacity: int = 1024   # initial mirror bucket (grows by pow2)

@@ -23,6 +23,7 @@ from kubernetes_tpu.framework.cycle_state import CycleState
 from kubernetes_tpu.framework.interface import (
     BindPlugin,
     ClusterEventWithHint,
+    Code,
     FilterPlugin,
     PermitPlugin,
     PostBindPlugin,
@@ -73,6 +74,11 @@ class Framework:
                       "score", "reserve", "permit", "pre_bind", "bind",
                       "post_bind"):
             self.points[point] = self._expand(point)
+        # permit WAIT registry (waiting_pods_map.go); plugins reach running
+        # waiting pods through the framework handle (interface.go:684)
+        from kubernetes_tpu.framework.waiting import WaitingPodsMap
+
+        self.waiting_pods = WaitingPodsMap()
         self._instances: dict[str, object] = {}
         for point, entries in self.points.items():
             for name, _ in entries:
@@ -286,12 +292,26 @@ class Framework:
             pl.unreserve(state, pod, node_name)
 
     def run_permit_plugins(self, state: CycleState, pod: Pod,
-                           node_name: str) -> Status:
+                           node_name: str
+                           ) -> tuple[Status, dict[str, float]]:
+        """RunPermitPlugins (runtime/framework.go:1480): a rejecting plugin
+        fails the pod; WAIT verdicts aggregate into (WAIT status,
+        {plugin: timeout}) — the scheduler parks the pod in the
+        waitingPodsMap until allowed/rejected/timed out."""
+        waits: dict[str, float] = {}
         for pl in self._iter("permit", PermitPlugin):
-            s, _timeout = pl.permit(state, pod, node_name)
+            s, timeout = pl.permit(state, pod, node_name)
+            if s.is_skip():
+                continue
+            if s.code == Code.WAIT:
+                waits[s.plugin or pl.name()] = timeout or 0.0
+                continue
             if not s.is_success():
-                return s
-        return Status()
+                s.plugin = s.plugin or pl.name()
+                return s, {}
+        if waits:
+            return Status(code=Code.WAIT), waits
+        return Status(), {}
 
     def run_pre_bind_plugins(self, state: CycleState, pod: Pod,
                              node_name: str) -> Status:

@@ -90,7 +90,11 @@ class Hub:
 
     @staticmethod
     def _dispatch(store: _Store, kind: str, old, new) -> None:
-        for h in store.handlers:
+        """Deliver one event. NEVER called holding the hub lock: handlers
+        take their own locks (the scheduler's loop lock), and a watcher
+        blocked there must not hold up other API callers — the cycle
+        hub-lock -> handler-lock -> (binder) -> hub-lock would deadlock."""
+        for h in list(store.handlers):
             if kind == "add" and h.on_add:
                 h.on_add(new)
             elif kind == "update" and h.on_update:
@@ -107,7 +111,7 @@ class Hub:
                 raise Conflict(f"{store.kind} {uid} already exists")
             obj.metadata.resource_version = next(self._rv)
             store.objects[uid] = obj
-            self._dispatch(store, "add", None, obj)
+        self._dispatch(store, "add", None, obj)
 
     def _update(self, store: _Store, obj) -> None:
         with self._lock:
@@ -117,21 +121,21 @@ class Hub:
                 raise NotFound(f"{store.kind} {uid}")
             obj.metadata.resource_version = next(self._rv)
             store.objects[uid] = obj
-            self._dispatch(store, "update", old, obj)
+        self._dispatch(store, "update", old, obj)
 
     def _delete(self, store: _Store, uid: str) -> None:
         with self._lock:
             old = store.objects.pop(uid, None)
             if old is None:
                 raise NotFound(f"{store.kind} {uid}")
-            self._dispatch(store, "delete", old, None)
+        self._dispatch(store, "delete", old, None)
 
     # ------------- nodes -------------
 
     def create_node(self, node: Node) -> None:
         with self._lock:
-            self._create(self._nodes, node)
             self._node_by_name[node.metadata.name] = node.metadata.uid
+        self._create(self._nodes, node)
 
     def update_node(self, node: Node) -> None:
         self._update(self._nodes, node)
@@ -139,9 +143,9 @@ class Hub:
     def delete_node(self, uid: str) -> None:
         with self._lock:
             old = self._nodes.objects.get(uid)
-            self._delete(self._nodes, uid)
             if old is not None:
                 self._node_by_name.pop(old.metadata.name, None)
+        self._delete(self._nodes, uid)
 
     def get_node(self, name: str) -> Optional[Node]:
         with self._lock:
@@ -173,6 +177,11 @@ class Hub:
 
     # ------------- the scheduler's write paths -------------
 
+    def _swap_pod(self, old: Pod, new: Pod) -> None:
+        """Commit a prepared pod revision under the lock, dispatch outside."""
+        new.metadata.resource_version = next(self._rv)
+        self._pods.objects[new.metadata.uid] = new
+
     def bind(self, pod: Pod, node_name: str) -> None:
         """The Binding subresource: sets spec.nodeName exactly once
         (defaultbinder POST target). Conflict if already bound."""
@@ -185,7 +194,8 @@ class Hub:
                                f"{stored.spec.node_name}")
             new = stored.clone()
             new.spec.node_name = node_name
-            self._update(self._pods, new)
+            self._swap_pod(stored, new)
+        self._dispatch(self._pods, "update", stored, new)
 
     def patch_pod_condition(self, pod: Pod, condition: PodCondition,
                             nominated_node: str | None = None) -> None:
@@ -200,7 +210,8 @@ class Hub:
             ] + [condition]
             if nominated_node is not None:
                 new.status.nominated_node_name = nominated_node
-            self._update(self._pods, new)
+            self._swap_pod(stored, new)
+        self._dispatch(self._pods, "update", stored, new)
 
     def clear_nominated_node(self, uid: str) -> None:
         """Clear status.nominatedNodeName (preemption.go prepareCandidate
@@ -211,7 +222,8 @@ class Hub:
                 return
             new = stored.clone()
             new.status.nominated_node_name = ""
-            self._update(self._pods, new)
+            self._swap_pod(stored, new)
+        self._dispatch(self._pods, "update", stored, new)
 
     # ------------- namespaces -------------
 
@@ -268,8 +280,8 @@ class Hub:
 
     def create_pvc(self, pvc: PersistentVolumeClaim) -> None:
         with self._lock:
-            self._create(self._pvcs, pvc)
             self._pvc_by_key[pvc.key()] = pvc.metadata.uid
+        self._create(self._pvcs, pvc)
 
     def update_pvc(self, pvc: PersistentVolumeClaim) -> None:
         self._update(self._pvcs, pvc)
@@ -277,9 +289,9 @@ class Hub:
     def delete_pvc(self, uid: str) -> None:
         with self._lock:
             old = self._pvcs.objects.get(uid)
-            self._delete(self._pvcs, uid)
             if old is not None:
                 self._pvc_by_key.pop(old.key(), None)
+        self._delete(self._pvcs, uid)
 
     def get_pvc(self, namespace: str, name: str
                 ) -> Optional[PersistentVolumeClaim]:
@@ -293,8 +305,8 @@ class Hub:
 
     def create_pv(self, pv: PersistentVolume) -> None:
         with self._lock:
-            self._create(self._pvs, pv)
             self._pv_by_name[pv.metadata.name] = pv.metadata.uid
+        self._create(self._pvs, pv)
 
     def update_pv(self, pv: PersistentVolume) -> None:
         self._update(self._pvs, pv)
@@ -302,9 +314,9 @@ class Hub:
     def delete_pv(self, uid: str) -> None:
         with self._lock:
             old = self._pvs.objects.get(uid)
-            self._delete(self._pvs, uid)
             if old is not None:
                 self._pv_by_name.pop(old.metadata.name, None)
+        self._delete(self._pvs, uid)
 
     def get_pv(self, name: str) -> Optional[PersistentVolume]:
         with self._lock:
@@ -317,8 +329,8 @@ class Hub:
 
     def create_storage_class(self, sc: StorageClass) -> None:
         with self._lock:
-            self._create(self._storage_classes, sc)
             self._sc_by_name[sc.metadata.name] = sc.metadata.uid
+        self._create(self._storage_classes, sc)
 
     def get_storage_class(self, name: str) -> Optional[StorageClass]:
         with self._lock:

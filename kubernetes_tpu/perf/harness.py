@@ -227,6 +227,7 @@ def run_workload(w: Workload, now: Callable[[], float] = time.time,
         else:
             raise TypeError(f"unknown op {op!r}")
 
+    sched.close()     # release binder worker threads between workloads
     result = {
         "name": w.name,
         "threshold": w.threshold,

@@ -12,7 +12,10 @@ winner on host and requeue the losers with plugin-attributed diagnoses.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 import jax
@@ -45,6 +48,8 @@ from kubernetes_tpu.framework.interface import (
     EventResource,
 )
 from kubernetes_tpu.framework.runtime import Framework
+from kubernetes_tpu.framework.interface import Code
+from kubernetes_tpu.framework.waiting import WaitingPod
 from kubernetes_tpu.hub import EventHandlers, Hub
 from kubernetes_tpu.models.pipeline import (
     FILTER_PLUGINS,
@@ -74,7 +79,7 @@ class Scheduler:
     def __init__(self, hub: Hub,
                  config: Optional[SchedulerConfiguration] = None,
                  caps: Optional[Capacities] = None,
-                 now=time.time):
+                 now=time.time, registry=None):
         self.hub = hub
         self.config = config or default_config()
         self.now = now
@@ -89,7 +94,7 @@ class Scheduler:
         self.preemption = Evaluator(
             hub, lambda: self.mirror, lambda: self.caps,
             lambda: self._enabled_filters, self.nominator)
-        self.framework = Framework(profile, extra_args={
+        self.framework = Framework(profile, registry=registry, extra_args={
             "binder": hub.bind,
             "hub": hub,
             "preemption_evaluator": self.preemption})
@@ -118,35 +123,90 @@ class Scheduler:
         # (cache.go:361 assume). Any event not caused by our own commits
         # invalidates it (set to None) and forces a full re-sync.
         self._chain: Optional[tuple] = None
-        self._in_commit = False     # our own bind/patch events are expected
+        # threading model: ONE mutator thread at a time. The coarse lock
+        # serializes the scheduling loop against event handlers invoked from
+        # foreign threads; the binder pool's own hub writes dispatch events
+        # back into _deferred_events instead (processed on the loop thread),
+        # so waiting on a bind future while holding the lock cannot deadlock.
+        self._lock = threading.RLock()
+        self._binder: Optional[ThreadPoolExecutor] = None
+        self._binder_tids: set[int] = set()
+        if self.config.async_binding:
+            self._binder = ThreadPoolExecutor(
+                max_workers=self.config.binding_workers,
+                thread_name_prefix="binder",
+                initializer=lambda: self._binder_tids.add(
+                    threading.get_ident()))
+        self._inflight_binds: list[tuple] = []
+        self._bind_backlog: list[tuple] = []
+        self._pod_rv: dict[str, int] = {}   # newest applied pod revision
+        self._deferred_events: deque = deque()
+        self._last_backoff_flush = 0.0
+        self._last_unsched_flush = 0.0
+        self._daemon: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
         self._register_handlers()
 
     # ------------- event handlers (eventhandlers.go:366) -------------
 
+    def _wrap(self, fn):
+        """Route events raised by the binder pool's own API writes to the
+        deferred queue (replayed on the loop thread); take the scheduler
+        lock for every other caller — the informer-thread contract."""
+        def handler(*args):
+            if threading.get_ident() in self._binder_tids:
+                self._deferred_events.append((fn, args))
+                return
+            with self._lock:
+                fn(*args)
+        return handler
+
+    def _process_deferred_events(self) -> None:
+        while self._deferred_events:
+            fn, args = self._deferred_events.popleft()
+            fn(*args)
+
+    def _pod_event_stale(self, pod: Pod) -> bool:
+        """Hub dispatch happens outside the hub lock, so two threads'
+        events for one pod can arrive out of commit order (the binder's
+        deferred bind-update vs the loop's own later patch). Drop any
+        event older than the newest revision already applied."""
+        uid = pod.metadata.uid
+        rv = pod.metadata.resource_version
+        if rv <= self._pod_rv.get(uid, -1):
+            return True
+        if len(self._pod_rv) > 1_000_000:
+            self._pod_rv.clear()
+        self._pod_rv[uid] = rv
+        return False
+
     def _register_handlers(self) -> None:
+        w = self._wrap
         self.hub.watch_nodes(EventHandlers(
-            on_add=self._on_node_add,
-            on_update=self._on_node_update,
-            on_delete=self._on_node_delete))
+            on_add=w(self._on_node_add),
+            on_update=w(self._on_node_update),
+            on_delete=w(self._on_node_delete)))
         self.hub.watch_pods(EventHandlers(
-            on_add=self._on_pod_add,
-            on_update=self._on_pod_update,
-            on_delete=self._on_pod_delete))
+            on_add=w(self._on_pod_add),
+            on_update=w(self._on_pod_update),
+            on_delete=w(self._on_pod_delete)))
         self.hub.watch_namespaces(EventHandlers(
-            on_add=lambda ns: self._on_ns_set(ns),
-            on_update=lambda old, new: self._on_ns_set(new),
-            on_delete=lambda ns: self._on_ns_delete(ns)))
+            on_add=w(self._on_ns_set),
+            on_update=w(lambda old, new: self._on_ns_set(new)),
+            on_delete=w(self._on_ns_delete)))
         # volume objects: pure requeue signals (no device state involved)
         self.hub.watch_pvcs(EventHandlers(
-            on_add=lambda o: self.queue.move_all_to_active_or_backoff(
-                ClusterEvent(R.PVC, A.ADD), None, o),
-            on_update=lambda old, new: self.queue.move_all_to_active_or_backoff(
-                ClusterEvent(R.PVC, A.UPDATE), old, new)))
+            on_add=w(lambda o: self.queue.move_all_to_active_or_backoff(
+                ClusterEvent(R.PVC, A.ADD), None, o)),
+            on_update=w(lambda old, new:
+                        self.queue.move_all_to_active_or_backoff(
+                            ClusterEvent(R.PVC, A.UPDATE), old, new))))
         self.hub.watch_pvs(EventHandlers(
-            on_add=lambda o: self.queue.move_all_to_active_or_backoff(
-                ClusterEvent(R.PV, A.ADD), None, o),
-            on_update=lambda old, new: self.queue.move_all_to_active_or_backoff(
-                ClusterEvent(R.PV, A.UPDATE), old, new)))
+            on_add=w(lambda o: self.queue.move_all_to_active_or_backoff(
+                ClusterEvent(R.PV, A.ADD), None, o)),
+            on_update=w(lambda old, new:
+                        self.queue.move_all_to_active_or_backoff(
+                            ClusterEvent(R.PV, A.UPDATE), old, new))))
 
     def _on_ns_set(self, ns) -> None:
         self._chain = None
@@ -179,8 +239,10 @@ class Scheduler:
         return pod.status.phase in ("Succeeded", "Failed")
 
     def _on_pod_add(self, pod: Pod) -> None:
+        if self._pod_event_stale(pod):
+            return
         if pod.spec.node_name:
-            if not self._in_commit:
+            if not self.cache.is_assumed_pod(pod):
                 self._chain = None
             self.cache.add_pod(pod)
             self.queue.move_all_to_active_or_backoff(
@@ -193,8 +255,10 @@ class Scheduler:
             self.queue.add(pod)
 
     def _on_pod_update(self, old: Pod, new: Pod) -> None:
+        if self._pod_event_stale(new):
+            return
         if new.spec.node_name:
-            if not self._in_commit:
+            if not self.cache.is_assumed_pod(new):
                 self._chain = None
             self.nominator.delete(new.metadata.uid)
             if old.spec.node_name:
@@ -215,6 +279,9 @@ class Scheduler:
             self.queue.update(old, new)
 
     def _on_pod_delete(self, pod: Pod) -> None:
+        # deletes always win: tombstone at max rv so a straggling update
+        # for the dead pod can't resurrect it in the cache
+        self._pod_rv[pod.metadata.uid] = 2 ** 62
         self.nominator.delete(pod.metadata.uid)
         if pod.spec.node_name:
             self._chain = None
@@ -372,6 +439,16 @@ class Scheduler:
         returns (host_ok [B, N] | None, host_score [B, N] | None) aligned to
         mirror rows. Plugins PreFilter-Skip irrelevant pods, so this is a
         few dict probes per pod for volume-less workloads."""
+        relevant = [
+            (i, qp) for i, qp in enumerate(runnable)
+            if not (self._host_volume_only and not qp.pod.spec.volumes
+                    and not self._has_host_scores)]
+        if not relevant:
+            return None, None
+        # host plugins read the HUB (claims, pod placements): every
+        # outstanding binding cycle must land first or a conflict check
+        # could miss a just-bound pod
+        self._drain_bind_results(wait=True)
         infos = self.snapshot.node_info_list
         host_ok = None
         host_score = None
@@ -386,11 +463,8 @@ class Scheduler:
                                  for ni in infos], np.int64)
             return rows
 
-        for i, qp in enumerate(runnable):
+        for i, qp in relevant:
             qp.host_reject_counts = {}
-            if self._host_volume_only and not qp.pod.spec.volumes \
-                    and not self._has_host_scores:
-                continue
             state = CycleState()
             mask, counts, early = self.framework.run_host_filters(
                 state, qp.pod, infos)
@@ -434,20 +508,26 @@ class Scheduler:
     def schedule_one_batch(self) -> int:
         """Pop up to batch_size pods, run one device launch, commit results.
         Returns the number of pods attempted (0 = queue idle)."""
-        popped, runnable = self._pop_runnable()
-        if popped == 0:
+        with self._lock:
+            self._process_deferred_events()
+            self._process_waiting()
+            popped, runnable = self._pop_runnable()
+            if popped == 0:
+                self._drain_bind_results(wait=True)
+                self.preemption.flush_evictions()
+                self._process_deferred_events()
+                return 0
+            if runnable:
+                inflight = self._dispatch(runnable, self._chain_eligible(
+                    [qp.pod for qp in runnable]))
+                if inflight is not None:
+                    self._finish(inflight)
+            self._drain_bind_results(wait=True)
+            # async preemption: victims queued by PostFilter are evicted
+            # here, OUTSIDE the cycle (prepareCandidateAsync's analog)
             self.preemption.flush_evictions()
-            return 0
-        if not runnable:
+            self._process_deferred_events()
             return popped
-        inflight = self._dispatch(runnable, self._chain_eligible(
-            [qp.pod for qp in runnable]))
-        if inflight is not None:
-            self._finish(inflight)
-        # async preemption: victims queued by PostFilter are evicted here,
-        # OUTSIDE the cycle (prepareCandidateAsync's goroutine analog)
-        self.preemption.flush_evictions()
-        return popped
 
     def _split_unsupported(self, runnable):
         """A pod uses a construct the device encoding can't express: route it
@@ -464,7 +544,11 @@ class Scheduler:
         return ok
 
     def _commit(self, qp: QueuedPodInfo, node_name: str) -> None:
-        """assume -> reserve -> permit -> bind (schedule_one.go:142,270)."""
+        """assume -> reserve -> permit (schedule_one.go:142); the binding
+        cycle (prebind/bind) then runs on the binder pool
+        (schedule_one.go:124's per-pod goroutine) and completes via
+        _drain_bind_results. A WAIT permit parks the pod in the
+        waitingPodsMap with its reservation held."""
         pod = qp.pod
         assumed = pod.clone()
         assumed.spec.node_name = node_name
@@ -475,40 +559,126 @@ class Scheduler:
         # table stale: the chain must not skip the sync that packs it
         if self.mirror.batch_has_topology([pod]):
             self._chain = None
-
-        def undo(msg: str) -> None:
-            fw.run_unreserve_plugins(state, pod, node_name)
-            self.cache.forget_pod(assumed)
-            # the device chain assumed this placement; force a re-sync
-            self._chain = None
-            self._error(qp, msg)
-
         s = fw.run_reserve_plugins(state, pod, node_name)
         if not s.is_success():
-            undo(f"reserve: {s.message()}")
+            self._undo_commit(qp, state, assumed, node_name,
+                              f"reserve: {s.message()}")
             return
-        s = fw.run_permit_plugins(state, pod, node_name)
+        s, waits = fw.run_permit_plugins(state, pod, node_name)
+        if s.code == Code.WAIT:
+            fw.waiting_pods.add(WaitingPod(qp, node_name, state, waits,
+                                           self.now()))
+            return
         if not s.is_success():
-            undo(f"permit: {s.message()}")
+            self._undo_commit(qp, state, assumed, node_name,
+                              f"permit: {s.message()}",
+                              rejected_by=(s.plugin if s.is_rejected()
+                                           else ""))
             return
+        self._start_binding(qp, state, assumed, node_name)
+
+    def _undo_commit(self, qp: QueuedPodInfo, state: CycleState,
+                     assumed: Pod, node_name: str, msg: str,
+                     rejected_by: str = "") -> None:
+        """Unreserve + Forget, then requeue: error-class for infrastructure
+        failures (schedule_one.go:337's bind-failure path), unschedulable
+        with plugin attribution when a plugin REJECTED the pod (permit
+        reject/timeout goes through handleSchedulingFailure as
+        Unschedulable, schedule_one.go:270)."""
+        self.framework.run_unreserve_plugins(state, qp.pod, node_name)
+        self.cache.forget_pod(assumed)
+        # the device chain assumed this placement; force a re-sync
+        self._chain = None
+        if rejected_by:
+            qp.unschedulable_plugins = {rejected_by}
+            qp.unschedulable_count += 1
+            qp.consecutive_errors_count = 0
+            self.stats["unschedulable"] += 1
+            self.hub.patch_pod_condition(qp.pod, PodCondition(
+                type="PodScheduled", status="False", reason="Unschedulable",
+                message=msg))
+            self.queue.add_unschedulable_if_not_present(qp)
+        else:
+            self._error(qp, msg)
+
+    def _bind_task(self, state: CycleState, pod: Pod, node_name: str):
+        fw = self.framework
         s = fw.run_pre_bind_plugins(state, pod, node_name)
-        if not s.is_success():
-            undo(f"prebind: {s.message()}")
-            return
-        self._in_commit = True
-        try:
+        if s.is_success():
             s = fw.run_bind_plugins(state, pod, node_name)
-        finally:
-            self._in_commit = False
+        return s
+
+    def _start_binding(self, qp: QueuedPodInfo, state: CycleState,
+                       assumed: Pod, node_name: str) -> None:
+        if self._binder is None:
+            self._finish_binding(qp, state, assumed, node_name,
+                                 self._bind_task(state, qp.pod, node_name))
+            self._process_deferred_events()
+        else:
+            # per-pod futures are too fine for python threads; the backlog
+            # is chunked across the pool by _submit_bind_backlog
+            self._bind_backlog.append((qp, state, assumed, node_name))
+
+    def _submit_bind_backlog(self) -> None:
+        backlog, self._bind_backlog = self._bind_backlog, []
+        if not backlog:
+            return
+        workers = max(1, self.config.binding_workers)
+        chunk = max(1, -(-len(backlog) // workers))
+
+        def run_chunk(items):
+            return [self._bind_task(state, qp.pod, node_name)
+                    for qp, state, assumed, node_name in items]
+
+        for i in range(0, len(backlog), chunk):
+            items = backlog[i:i + chunk]
+            self._inflight_binds.append(
+                (items, self._binder.submit(run_chunk, items)))
+
+    def _drain_bind_results(self, wait: bool = False) -> None:
+        """Collect finished binding cycles (all of them when ``wait``);
+        the binder thread's own hub events replay here, on the loop
+        thread, right after each completion."""
+        self._submit_bind_backlog()
+        still: list[tuple] = []
+        for item in self._inflight_binds:
+            items, fut = item
+            if wait or fut.done():
+                for (qp, state, assumed, node_name), s in zip(items,
+                                                              fut.result()):
+                    self._finish_binding(qp, state, assumed, node_name, s)
+                self._process_deferred_events()
+            else:
+                still.append(item)
+        self._inflight_binds = still
+
+    def _finish_binding(self, qp: QueuedPodInfo, state: CycleState,
+                        assumed: Pod, node_name: str, s) -> None:
         if not s.is_success():
-            undo(f"bind: {s.message()}")
+            self._undo_commit(qp, state, assumed, node_name,
+                              f"bind: {s.message()}")
             return
         self.cache.finish_binding(assumed)
         self.nominator.delete(qp.uid)
         self.queue.done(qp.uid)
-        fw.run_post_bind_plugins(state, pod, node_name)
+        self.framework.run_post_bind_plugins(state, qp.pod, node_name)
         qp.consecutive_errors_count = 0
         self.stats["scheduled"] += 1
+
+    def _process_waiting(self) -> None:
+        """Harvest the waitingPodsMap: fully-allowed pods proceed to the
+        binding cycle; rejected/timed-out pods unreserve and requeue
+        (waiting_pods_map.go semantics)."""
+        ready, failed = self.framework.waiting_pods.harvest(self.now())
+        for wp in ready:
+            assumed = wp.qp.pod.clone()
+            assumed.spec.node_name = wp.node_name
+            self._start_binding(wp.qp, wp.state, assumed, wp.node_name)
+        for wp, s in failed:
+            assumed = wp.qp.pod.clone()
+            assumed.spec.node_name = wp.node_name
+            self._undo_commit(wp.qp, wp.state, assumed, wp.node_name,
+                              s.message(), rejected_by=s.plugin or "Permit")
 
     def _fail(self, qp: QueuedPodInfo, reject_counts: list[int]) -> None:
         """handleSchedulingFailure (schedule_one.go:1015): run PostFilter
@@ -559,6 +729,68 @@ class Scheduler:
             message=msg))
         self.queue.add_unschedulable_if_not_present(qp)
 
+    # ------------- the daemon (scheduler.go Run + queue flush loops) ----
+
+    def run_maintenance(self) -> None:
+        """The background timers the reference runs as goroutines: 1s
+        backoff flush, 30s unschedulable-timeout flush (5min park cap,
+        scheduling_queue.go:378-386), assumed-pod expiry
+        (cache.go:730 cleanupAssumedPods), permit-wait harvesting, bind
+        completion, queued evictions."""
+        with self._lock:
+            self._process_deferred_events()
+            now = self.now()
+            if now - self._last_backoff_flush >= 1.0:
+                self._last_backoff_flush = now
+                self.queue.flush_backoff_completed()
+            if now - self._last_unsched_flush >= 30.0:
+                self._last_unsched_flush = now
+                self.queue.flush_unschedulable_timeout()
+                for pod in self.cache.cleanup_assumed_pods():
+                    stored = self.hub.get_pod(pod.metadata.uid)
+                    if stored is not None and not stored.spec.node_name:
+                        self.queue.add(stored)
+            self._process_waiting()
+            self._drain_bind_results()
+            self.preemption.flush_evictions()
+            self._process_deferred_events()
+
+    def run(self, stop: threading.Event, idle_sleep: float = 0.02) -> None:
+        """Blocking daemon loop (scheduler.go:452 Run): maintenance timers
+        + scheduling cycles until ``stop`` is set."""
+        while not stop.is_set():
+            self.run_maintenance()
+            if self.run_until_idle() == 0:
+                stop.wait(idle_sleep)
+
+    def start(self) -> None:
+        """Run the daemon on its own thread (tests/embedding)."""
+        if self._daemon is not None:
+            return
+        self._stop = threading.Event()
+        self._daemon = threading.Thread(
+            target=self.run, args=(self._stop,), daemon=True,
+            name="kubernetes-tpu-scheduler")
+        self._daemon.start()
+
+    def stop(self) -> None:
+        if self._daemon is None:
+            return
+        self._stop.set()
+        self._daemon.join(timeout=30)
+        self._daemon = None
+        self._stop = None
+
+    def close(self) -> None:
+        """Stop the daemon (if running) and release the binder pool's
+        worker threads. The scheduler is unusable afterwards."""
+        self.stop()
+        if self._binder is not None:
+            self._drain_bind_results(wait=True)
+            self._process_deferred_events()
+            self._binder.shutdown(wait=True)
+            self._binder = None
+
     # ------------- driving -------------
 
     def run_until_idle(self, max_batches: int = 1000,
@@ -577,6 +809,10 @@ class Scheduler:
         (scheduler_perf.go:819 churnOp). A truthy return stops the drain
         (pending work is still committed): with a churn feed the queue may
         never go idle, so the harness signals "measured phase done" here."""
+        with self._lock:
+            return self._run_until_idle_locked(max_batches, on_step)
+
+    def _run_until_idle_locked(self, max_batches, on_step) -> int:
         total = 0
         pending: Optional[tuple] = None
 
@@ -587,6 +823,9 @@ class Scheduler:
                 self._finish(p)
 
         for _ in range(max_batches):
+            self._process_deferred_events()
+            self._process_waiting()
+            self._drain_bind_results()
             if on_step is not None and on_step():
                 break
             popped, runnable = self._pop_runnable()
@@ -608,5 +847,7 @@ class Scheduler:
             # async preemption evictions run between cycles (kep 4832)
             self.preemption.flush_evictions()
         flush()
+        self._drain_bind_results(wait=True)
         self.preemption.flush_evictions()
+        self._process_deferred_events()
         return total

@@ -1,0 +1,242 @@
+"""Run() daemon, maintenance timers, Permit WAIT, and the async binding
+cycle (reference: scheduler.go Run, scheduling_queue.go:378-386 flush
+goroutines, runtime/waiting_pods_map.go, schedule_one.go:124/270 binding
+goroutine + :337 bind-failure requeue)."""
+
+import threading
+import time
+
+from kubernetes_tpu.api.objects import (
+    Container,
+    LABEL_HOSTNAME,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResourceRequirements,
+)
+from kubernetes_tpu.config.types import default_config
+from kubernetes_tpu.framework.interface import Code, PermitPlugin, Status
+from kubernetes_tpu.hub import Hub
+from kubernetes_tpu.ops.features import Capacities
+from kubernetes_tpu.plugins.registry import PluginDescriptor, in_tree_registry
+from kubernetes_tpu.scheduler import Scheduler
+
+
+class Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def now(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+def mknode(i, cpu="16"):
+    name = f"node-{i}"
+    return Node(metadata=ObjectMeta(name=name, labels={LABEL_HOSTNAME: name}),
+                status=NodeStatus(allocatable={"cpu": cpu, "memory": "32Gi",
+                                               "pods": "110"}))
+
+
+def mkpod(name, cpu="100m"):
+    return Pod(metadata=ObjectMeta(name=name),
+               spec=PodSpec(containers=[Container(
+                   name="c", resources=ResourceRequirements(
+                       requests={"cpu": cpu, "memory": "64Mi"}))]))
+
+
+def mksched(hub, clock=None, registry=None, batch=16):
+    cfg = default_config()
+    cfg.batch_size = batch
+    clock = clock or Clock()
+    return Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64),
+                     now=clock.now, registry=registry), clock
+
+
+def bound_node(hub, pod):
+    p = hub.get_pod(pod.metadata.uid)
+    return p.spec.node_name if p else None
+
+
+class GatePermit(PermitPlugin):
+    """Test permit plugin: WAITs every pod until allowed externally."""
+
+    NAME = "GatePermit"
+
+    def __init__(self, timeout=60.0):
+        self.timeout = timeout
+        self.seen = []
+
+    def permit(self, state, pod, node_name):
+        self.seen.append(pod.metadata.name)
+        return Status(code=Code.WAIT, plugin=self.NAME), self.timeout
+
+
+def registry_with_permit(plugin):
+    reg = in_tree_registry()
+    reg["GatePermit"] = PluginDescriptor(
+        name="GatePermit", points=("permit",),
+        factory=lambda args: plugin)
+    return reg
+
+
+def enable_plugin(cfg, name):
+    from kubernetes_tpu.config.types import Plugin
+
+    cfg.profiles[0].plugins.multi_point.enabled.append(Plugin(name, 0))
+
+
+def test_permit_wait_then_allow_binds():
+    hub = Hub()
+    permit = GatePermit()
+    cfg = default_config()
+    cfg.batch_size = 16
+    enable_plugin(cfg, "GatePermit")
+    clock = Clock()
+    sched = Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64),
+                      now=clock.now,
+                      registry=registry_with_permit(permit))
+    hub.create_node(mknode(0))
+    p = mkpod("p")
+    hub.create_pod(p)
+    sched.run_until_idle()
+    # parked at permit: reservation held (assumed), not bound, not failed
+    assert bound_node(hub, p) == ""
+    assert len(sched.framework.waiting_pods) == 1
+    assert sched.cache.assumed_pod_count() == 1
+    assert sched.stats["scheduled"] == 0
+    # an approver allows it: next cycle binds
+    wp = sched.framework.waiting_pods.get(p.metadata.uid)
+    wp.allow("GatePermit")
+    sched.run_until_idle()
+    assert bound_node(hub, p) == "node-0"
+    assert sched.stats["scheduled"] == 1
+    assert sched.cache.assumed_pod_count() == 0
+
+
+def test_permit_wait_timeout_requeues():
+    hub = Hub()
+    permit = GatePermit(timeout=30.0)
+    cfg = default_config()
+    cfg.batch_size = 16
+    enable_plugin(cfg, "GatePermit")
+    clock = Clock()
+    sched = Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64),
+                      now=clock.now,
+                      registry=registry_with_permit(permit))
+    hub.create_node(mknode(0))
+    p = mkpod("p")
+    hub.create_pod(p)
+    sched.run_until_idle()
+    assert len(sched.framework.waiting_pods) == 1
+    # the timeout passes with no allow: unreserve + UNSCHEDULABLE requeue
+    # attributed to the timing-out plugin (schedule_one.go:270)
+    clock.tick(31.0)
+    sched.run_maintenance()
+    assert len(sched.framework.waiting_pods) == 0
+    assert sched.cache.assumed_pod_count() == 0
+    assert sched.stats["unschedulable"] == 1
+    assert sched.stats["errors"] == 0
+    cond = hub.get_pod(p.metadata.uid).status.conditions[0]
+    assert cond.reason == "Unschedulable"
+
+
+def test_permit_reject_while_waiting():
+    hub = Hub()
+    permit = GatePermit()
+    cfg = default_config()
+    cfg.batch_size = 16
+    enable_plugin(cfg, "GatePermit")
+    clock = Clock()
+    sched = Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64),
+                      now=clock.now,
+                      registry=registry_with_permit(permit))
+    hub.create_node(mknode(0))
+    p = mkpod("p")
+    hub.create_pod(p)
+    sched.run_until_idle()
+    wp = sched.framework.waiting_pods.get(p.metadata.uid)
+    wp.reject("GatePermit", "not today")
+    sched.run_until_idle()
+    assert bound_node(hub, p) == ""
+    assert sched.cache.assumed_pod_count() == 0
+
+
+def test_bind_failure_unreserves_and_requeues():
+    hub = Hub()
+    sched, clock = mksched(hub)
+    hub.create_node(mknode(0))
+    fails = {"n": 0}
+    orig_bind = hub.bind
+
+    def flaky_bind(pod, node_name):
+        if fails["n"] == 0:
+            fails["n"] += 1
+            raise RuntimeError("apiserver hiccup")
+        orig_bind(pod, node_name)
+
+    sched.framework.instance("DefaultBinder")._binder = flaky_bind
+    p = mkpod("p")
+    hub.create_pod(p)
+    sched.run_until_idle()
+    clock.tick(2.0)
+    sched.queue.flush_backoff_completed()
+    sched.run_until_idle()
+    # first attempt failed at bind (Forget + error-class requeue recorded);
+    # the retry then bound cleanly
+    assert sched.stats["errors"] == 1
+    assert fails["n"] == 1
+    assert bound_node(hub, p) == "node-0"
+    assert sched.stats["scheduled"] == 1
+    assert sched.cache.assumed_pod_count() == 0
+    cond_reasons = [c.reason for c in
+                    hub.get_pod(p.metadata.uid).status.conditions]
+    assert "SchedulerError" in cond_reasons or bound_node(hub, p)
+
+
+def test_unschedulable_timeout_flush_without_events():
+    """A pod whose rejecting plugin never sees a matching event escapes via
+    the 5min cap (scheduling_queue.go:378's flushUnschedulablePodsLeftover),
+    driven by run_maintenance's 30s tick."""
+    hub = Hub()
+    sched, clock = mksched(hub)
+    hub.create_node(mknode(0, cpu="1"))
+    big = mkpod("big", cpu="8")
+    hub.create_pod(big)
+    sched.run_until_idle()
+    assert sched.stats["unschedulable"] == 1
+    # grow the node quietly (no hub event => no requeue signal)
+    sched.queue._unschedulable[big.metadata.uid].unschedulable_plugins = set()
+    clock.tick(301.0)
+    sched.run_maintenance()
+    counts = sched.queue.pending_counts()
+    assert counts["unschedulable"] == 0, "flushed by the 5min cap"
+    assert counts["active"] + counts["backoff"] == 1
+
+
+def test_daemon_thread_schedules_and_stops():
+    """start()/stop(): pods created from a foreign thread while the daemon
+    runs are scheduled without explicit drains."""
+    hub = Hub()
+    cfg = default_config()
+    cfg.batch_size = 16
+    sched = Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64))
+    hub.create_node(mknode(0))
+    sched.start()
+    try:
+        pods = [mkpod(f"p{i}") for i in range(10)]
+        for p in pods:
+            hub.create_pod(p)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if all(bound_node(hub, p) for p in pods):
+                break
+            time.sleep(0.02)
+        assert all(bound_node(hub, p) for p in pods)
+    finally:
+        sched.stop()
+    assert sched._daemon is None
