@@ -60,7 +60,8 @@ def main() -> None:
         short = r["name"].split("/")[0]
         results[short] = {k: r[k] for k in (
             "name", "pods_per_sec", "threshold", "vs_baseline", "passed",
-            "pods_scheduled", "elapsed_s", "p50", "p90", "p95", "p99")
+            "pods_scheduled", "elapsed_s", "p50", "p90", "p95", "p99",
+            "metrics")
             if k in r}
         if short == "SchedulingBasic":
             headline = r

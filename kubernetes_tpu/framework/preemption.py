@@ -79,6 +79,7 @@ class Evaluator:
         # eviction work queue the scheduler drains between cycles
         self.preempting: set[str] = set()
         self._pending: list[tuple[Candidate, Pod]] = []
+        self.metrics = None     # SchedulerMetrics, set by the Scheduler
 
     # ---------------- eligibility (default_preemption.go:327) -------------
 
@@ -449,6 +450,10 @@ class Evaluator:
                 break
             final = self._minimize_victims(pod, best, pdbs)
             if final is not None:
+                if self.metrics is not None:
+                    self.metrics.preemption_attempts.inc()
+                    self.metrics.preemption_victims.observe(
+                        len(final.victims))
                 self.prepare_candidate(final, pod)
                 self.nominator.add(pod, final.node_name)
                 return final.node_name, Status()

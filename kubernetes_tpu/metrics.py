@@ -1,0 +1,262 @@
+"""Scheduler metrics: counters, gauges, histograms + the async recorder.
+
+From-scratch equivalent of /root/reference/pkg/scheduler/metrics/
+metrics.go:147-335 (the metric set) and metric_recorder.go (the buffered
+MetricAsyncRecorder that keeps observation off the hot path). Metric names
+and label sets mirror the reference so dashboards/thresholds port over;
+the registry snapshots to a dict and renders Prometheus text for the
+serving endpoint (kubernetes_tpu.serving).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from collections import deque
+from typing import Callable, Optional
+
+# k8s histogram buckets: exponential 0.001s..~16s (metrics.go power-of-2)
+DURATION_BUCKETS = tuple(0.001 * (2 ** i) for i in range(15))
+ATTEMPTS_BUCKETS = (1, 2, 4, 8, 16)
+VICTIMS_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+
+def _labels_key(labels: dict[str, str]) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = "",
+                 label_names: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        k = _labels_key(labels)
+        self._values[k] = self._values.get(k, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(_labels_key(labels), 0.0)
+
+    def snapshot(self):
+        return {str(dict(k)): v for k, v in self._values.items()}
+
+
+class Gauge:
+    """A gauge whose value may be pulled from a callback at snapshot time
+    (pending_pods reads the queue's live counts)."""
+
+    def __init__(self, name: str, help_: str = "",
+                 fn: Optional[Callable[[], dict[str, float]]] = None):
+        self.name = name
+        self.help = help_
+        self._fn = fn
+        self._values: dict[tuple, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        self._values[_labels_key(labels)] = value
+
+    def collect(self) -> dict[tuple, float]:
+        if self._fn is not None:
+            return {_labels_key({"queue": k}): float(v)
+                    for k, v in self._fn().items()}
+        return dict(self._values)
+
+    def snapshot(self):
+        return {str(dict(k)): v for k, v in self.collect().items()}
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str = "",
+                 buckets: tuple[float, ...] = DURATION_BUCKETS,
+                 label_names: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(buckets)
+        self.label_names = label_names
+        # per-label-set: (bucket counts [len+1], sum, count)
+        self._series: dict[tuple, list] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        k = _labels_key(labels)
+        s = self._series.get(k)
+        if s is None:
+            s = self._series[k] = [[0] * (len(self.buckets) + 1), 0.0, 0]
+        idx = bisect.bisect_left(self.buckets, value)
+        s[0][idx] += 1
+        s[1] += value
+        s[2] += 1
+
+    def count(self, **labels) -> int:
+        s = self._series.get(_labels_key(labels))
+        return s[2] if s else 0
+
+    def total_count(self) -> int:
+        return sum(s[2] for s in self._series.values())
+
+    def percentile(self, q: float, **labels) -> float:
+        """Bucket-resolution percentile (what perf-dash reads from the
+        histogram_quantile of these series)."""
+        if labels:
+            series = [self._series.get(_labels_key(labels))]
+            series = [s for s in series if s]
+        else:
+            series = list(self._series.values())
+        if not series:
+            return 0.0
+        counts = [0] * (len(self.buckets) + 1)
+        total = 0
+        for s in series:
+            total += s[2]
+            for i, c in enumerate(s[0]):
+                counts[i] += c
+        if total == 0:
+            return 0.0
+        rank = q / 100.0 * total
+        acc = 0
+        for i, c in enumerate(counts):
+            acc += c
+            if acc >= rank:
+                return self.buckets[i] if i < len(self.buckets) \
+                    else self.buckets[-1] * 2
+        return self.buckets[-1] * 2
+
+    def snapshot(self):
+        return {str(dict(k)): {"count": s[2], "sum": round(s[1], 6)}
+                for k, s in self._series.items()}
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: dict[str, object] = {}
+
+    def register(self, metric):
+        self._metrics[metric.name] = metric
+        return metric
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def snapshot(self) -> dict:
+        return {name: m.snapshot() for name, m in self._metrics.items()}
+
+    def render_text(self) -> str:
+        """Prometheus exposition format (the /metrics endpoint body)."""
+        out = []
+        for name, m in self._metrics.items():
+            if m.help:
+                out.append(f"# HELP {name} {m.help}")
+            if isinstance(m, Counter):
+                out.append(f"# TYPE {name} counter")
+                for k, v in m._values.items():
+                    out.append(f"{name}{_fmt_labels(dict(k))} {v}")
+            elif isinstance(m, Gauge):
+                out.append(f"# TYPE {name} gauge")
+                for k, v in m.collect().items():
+                    out.append(f"{name}{_fmt_labels(dict(k))} {v}")
+            elif isinstance(m, Histogram):
+                out.append(f"# TYPE {name} histogram")
+                for k, s in m._series.items():
+                    labels = dict(k)
+                    acc = 0
+                    for i, b in enumerate(m.buckets):
+                        acc += s[0][i]
+                        le = dict(labels, le=str(b))
+                        out.append(f"{name}_bucket{_fmt_labels(le)} {acc}")
+                    le = dict(labels, le="+Inf")
+                    out.append(f"{name}_bucket{_fmt_labels(le)} {s[2]}")
+                    out.append(f"{name}_sum{_fmt_labels(labels)} {s[1]}")
+                    out.append(f"{name}_count{_fmt_labels(labels)} {s[2]}")
+        return "\n".join(out) + "\n"
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class SchedulerMetrics:
+    """The reference's metric set (metrics.go:147-335), registered on one
+    registry and exposed as attributes."""
+
+    def __init__(self, pending_fn: Optional[Callable] = None):
+        r = self.registry = Registry()
+        self.schedule_attempts = r.register(Counter(
+            "schedule_attempts_total",
+            "Number of attempts to schedule pods, by result",
+            ("result", "profile")))
+        self.attempt_duration = r.register(Histogram(
+            "scheduling_attempt_duration_seconds",
+            "Scheduling attempt latency (per pod, amortized over its batch)",
+            DURATION_BUCKETS, ("result",)))
+        self.algorithm_duration = r.register(Histogram(
+            "scheduling_algorithm_duration_seconds",
+            "Scheduling algorithm latency (the device launch)"))
+        self.batch_duration = r.register(Histogram(
+            "scheduling_cycle_duration_seconds",
+            "One batched scheduling cycle end to end"))
+        self.extension_point_duration = r.register(Histogram(
+            "framework_extension_point_duration_seconds",
+            "Per extension point latency", DURATION_BUCKETS,
+            ("extension_point",)))
+        self.pod_scheduling_attempts = r.register(Histogram(
+            "pod_scheduling_attempts",
+            "Attempts needed to schedule a pod", ATTEMPTS_BUCKETS))
+        self.preemption_attempts = r.register(Counter(
+            "preemption_attempts_total", "Preemption attempts"))
+        self.preemption_victims = r.register(Histogram(
+            "preemption_victims", "Number of victims per preemption",
+            VICTIMS_BUCKETS))
+        self.pending_pods = r.register(Gauge(
+            "pending_pods", "Pending pods by queue", fn=pending_fn))
+        self.queue_incoming_pods = r.register(Counter(
+            "queue_incoming_pods_total",
+            "Pods added to scheduling queues by event/queue",
+            ("event", "queue")))
+        self.permit_wait_duration = r.register(Histogram(
+            "permit_wait_duration_seconds",
+            "Time spent waiting at permit", DURATION_BUCKETS, ("result",)))
+        self.cache_size = r.register(Gauge(
+            "cache_size", "Scheduler cache size by type"))
+
+
+class AsyncRecorder:
+    """metric_recorder.go MetricAsyncRecorder: observations buffer into a
+    lock-free-ish deque and flush off the hot path (the daemon's
+    maintenance tick, or an explicit flush)."""
+
+    def __init__(self, flush_interval: float = 1.0,
+                 now: Callable[[], float] = None):
+        import time as _time
+
+        self._buf: deque = deque()
+        self._interval = flush_interval
+        self._now = now or _time.time
+        self._last_flush = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, metric: Histogram, value: float, **labels) -> None:
+        self._buf.append((metric, value, labels))
+
+    def inc(self, metric: Counter, amount: float = 1.0, **labels) -> None:
+        self._buf.append((metric, ("inc", amount), labels))
+
+    def flush(self, force: bool = True) -> int:
+        now = self._now()
+        if not force and now - self._last_flush < self._interval:
+            return 0
+        self._last_flush = now
+        n = 0
+        with self._lock:
+            while self._buf:
+                metric, value, labels = self._buf.popleft()
+                if isinstance(value, tuple) and value[0] == "inc":
+                    metric.inc(value[1], **labels)
+                else:
+                    metric.observe(value, **labels)
+                n += 1
+        return n

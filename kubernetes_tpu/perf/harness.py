@@ -181,57 +181,72 @@ def run_workload(w: Workload, now: Callable[[], float] = time.time,
             sleep(0.05)
             sched.queue.flush_backoff_completed()
 
-    for op in w.ops:
-        if isinstance(op, CreateNodes):
-            n_nodes = op.count if w.warm_full_nodes else scaled(op.count)
-            for i in range(n_nodes):
-                hub.create_node(op.make_node(i))
-        elif isinstance(op, CreateNamespaces):
-            for i in range(op.count):
-                hub.create_namespace(Namespace(metadata=ObjectMeta(
-                    name=f"{op.prefix}-{i}",
-                    labels=op.labels(i) if op.labels else {})))
-        elif isinstance(op, Churn):
-            churns.append(_ChurnState(op, now))
-        elif isinstance(op, Barrier):
-            drain(lambda: len(sched.queue) == 0, op.timeout_s)
-        elif isinstance(op, CreatePods):
-            n = scaled(op.count)
-            pods = [op.make_pod(i) for i in range(n)]
-            uids = {p.metadata.uid for p in pods}
-            collector = None
-            if op.collect_metrics:
-                collector = ThroughputCollector(uids, now)
-                hub.watch_pods(EventHandlers(
-                    on_add=collector.on_add,
-                    on_update=collector.on_update), replay=False)
-                collector.begin()
-            for p in pods:
-                hub.create_pod(p)
-            if collector is not None:
-                drain(collector.done, op.timeout_s)
-                summary = collector.summarize()
-                phases.append({"op": "createPods", "count": n,
-                               "measured": True})
+    try:
+        for op in w.ops:
+            if isinstance(op, CreateNodes):
+                n_nodes = op.count if w.warm_full_nodes else scaled(op.count)
+                for i in range(n_nodes):
+                    hub.create_node(op.make_node(i))
+            elif isinstance(op, CreateNamespaces):
+                for i in range(op.count):
+                    hub.create_namespace(Namespace(metadata=ObjectMeta(
+                        name=f"{op.prefix}-{i}",
+                        labels=op.labels(i) if op.labels else {})))
+            elif isinstance(op, Churn):
+                churns.append(_ChurnState(op, now))
+            elif isinstance(op, Barrier):
+                drain(lambda: len(sched.queue) == 0, op.timeout_s)
+            elif isinstance(op, CreatePods):
+                n = scaled(op.count)
+                pods = [op.make_pod(i) for i in range(n)]
+                uids = {p.metadata.uid for p in pods}
+                collector = None
+                if op.collect_metrics:
+                    collector = ThroughputCollector(uids, now)
+                    hub.watch_pods(EventHandlers(
+                        on_add=collector.on_add,
+                        on_update=collector.on_update), replay=False)
+                    collector.begin()
+                for p in pods:
+                    hub.create_pod(p)
+                if collector is not None:
+                    drain(collector.done, op.timeout_s)
+                    summary = collector.summarize()
+                    phases.append({"op": "createPods", "count": n,
+                                   "measured": True})
+                else:
+                    def all_bound() -> bool:
+                        for u in uids:
+                            p = hub.get_pod(u)
+                            if p is not None and not p.spec.node_name:
+                                return False
+                        return True
+
+                    drain(all_bound, op.timeout_s)
+                    phases.append({"op": "createPods", "count": n,
+                                   "measured": False})
             else:
-                def all_bound() -> bool:
-                    for u in uids:
-                        p = hub.get_pod(u)
-                        if p is not None and not p.spec.node_name:
-                            return False
-                    return True
+                raise TypeError(f"unknown op {op!r}")
 
-                drain(all_bound, op.timeout_s)
-                phases.append({"op": "createPods", "count": n,
-                               "measured": False})
-        else:
-            raise TypeError(f"unknown op {op!r}")
-
-    sched.close()     # release binder worker threads between workloads
+    finally:
+        sched.close()  # binder threads released even on failure
+    m = sched.metrics
     result = {
         "name": w.name,
         "threshold": w.threshold,
         "stats": dict(sched.stats),
+        # the metric slices the reference harness scrapes
+        # (scheduler_perf.go:140-166): attempt latency percentiles + counts
+        "metrics": {
+            "attempt_p50_ms": round(
+                m.attempt_duration.percentile(50) * 1e3, 2),
+            "attempt_p99_ms": round(
+                m.attempt_duration.percentile(99) * 1e3, 2),
+            "cycle_p99_ms": round(
+                m.batch_duration.percentile(99) * 1e3, 2),
+            "attempts": int(sum(
+                m.schedule_attempts._values.values())),
+        },
     }
     if summary is not None:
         result.update(summary.to_dict())

@@ -12,6 +12,7 @@ winner on host and requeue the losers with plugin-attributed diagnoses.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 import time
 from collections import deque
@@ -56,7 +57,14 @@ from kubernetes_tpu.models.pipeline import (
     BatchResult,
     launch_batch,
 )
+from kubernetes_tpu.metrics import AsyncRecorder, SchedulerMetrics
 from kubernetes_tpu.ops.features import Capacities
+
+logger = logging.getLogger("kubernetes_tpu.scheduler")
+
+# a scheduling cycle slower than this logs a phase-by-phase trace
+# (schedule_one.go:404's 100ms slow-attempt threshold)
+SLOW_CYCLE_SECONDS = 0.1
 
 A = ActionType
 R = EventResource
@@ -84,6 +92,7 @@ class Scheduler:
         self.config = config or default_config()
         self.now = now
         profile = self.config.profiles[0]
+        self._profile_name = profile.scheduler_name
         self.cache = Cache(now=now)
         self.snapshot = Snapshot()
         self.caps = caps or Capacities(
@@ -105,6 +114,10 @@ class Scheduler:
             initial_backoff=self.config.pod_initial_backoff_seconds,
             max_backoff=self.config.pod_max_backoff_seconds,
             now=now)
+        self.metrics = SchedulerMetrics(
+            pending_fn=self.queue.pending_counts)
+        self.recorder = AsyncRecorder(now=now)
+        self.preemption.metrics = self.metrics
         self._enabled_filters = self.framework.enabled_filters()
         self._weights = self.framework.score_weights()
         self._has_host_filters = self.framework.has_host_filters()
@@ -123,6 +136,7 @@ class Scheduler:
         # (cache.go:361 assume). Any event not caused by our own commits
         # invalidates it (set to None) and forces a full re-sync.
         self._chain: Optional[tuple] = None
+        self._chain_epoch = 0
         # threading model: ONE mutator thread at a time. The coarse lock
         # serializes the scheduling loop against event handlers invoked from
         # foreign threads; the binder pool's own hub writes dispatch events
@@ -140,6 +154,7 @@ class Scheduler:
         self._inflight_binds: list[tuple] = []
         self._bind_backlog: list[tuple] = []
         self._pod_rv: dict[str, int] = {}   # newest applied pod revision
+        self._rv_tombstones: deque = deque()
         self._deferred_events: deque = deque()
         self._last_backoff_flush = 0.0
         self._last_unsched_flush = 0.0
@@ -175,8 +190,6 @@ class Scheduler:
         rv = pod.metadata.resource_version
         if rv <= self._pod_rv.get(uid, -1):
             return True
-        if len(self._pod_rv) > 1_000_000:
-            self._pod_rv.clear()
         self._pod_rv[uid] = rv
         return False
 
@@ -208,28 +221,35 @@ class Scheduler:
                         self.queue.move_all_to_active_or_backoff(
                             ClusterEvent(R.PV, A.UPDATE), old, new))))
 
-    def _on_ns_set(self, ns) -> None:
+    def _invalidate_chain(self) -> None:
+        """Drop the device-resident usage chain and bump the epoch so a
+        dispatch that raced with the invalidation (e.g. a bind failure
+        drained while packing) does not re-install a stale chain."""
         self._chain = None
+        self._chain_epoch += 1
+
+    def _on_ns_set(self, ns) -> None:
+        self._invalidate_chain()
         self.cache.set_namespace(ns.metadata.name, ns.metadata.labels)
 
     def _on_ns_delete(self, ns) -> None:
-        self._chain = None
+        self._invalidate_chain()
         self.cache.remove_namespace(ns.metadata.name)
 
     def _on_node_add(self, node: Node) -> None:
-        self._chain = None
+        self._invalidate_chain()
         self.cache.add_node(node)
         self.queue.move_all_to_active_or_backoff(
             ClusterEvent(R.NODE, A.ADD), None, node)
 
     def _on_node_update(self, old: Node, new: Node) -> None:
-        self._chain = None
+        self._invalidate_chain()
         self.cache.update_node(old, new)
         self.queue.move_all_to_active_or_backoff(
             ClusterEvent(R.NODE, _node_update_action(old, new)), old, new)
 
     def _on_node_delete(self, node: Node) -> None:
-        self._chain = None
+        self._invalidate_chain()
         self.cache.remove_node(node)
         self.queue.move_all_to_active_or_backoff(
             ClusterEvent(R.NODE, A.DELETE), node, None)
@@ -243,7 +263,7 @@ class Scheduler:
             return
         if pod.spec.node_name:
             if not self.cache.is_assumed_pod(pod):
-                self._chain = None
+                self._invalidate_chain()
             self.cache.add_pod(pod)
             self.queue.move_all_to_active_or_backoff(
                 ClusterEvent(R.ASSIGNED_POD, A.ADD), None, pod)
@@ -259,7 +279,7 @@ class Scheduler:
             return
         if new.spec.node_name:
             if not self.cache.is_assumed_pod(new):
-                self._chain = None
+                self._invalidate_chain()
             self.nominator.delete(new.metadata.uid)
             if old.spec.node_name:
                 self.cache.update_pod(old, new)
@@ -280,11 +300,27 @@ class Scheduler:
 
     def _on_pod_delete(self, pod: Pod) -> None:
         # deletes always win: tombstone at max rv so a straggling update
-        # for the dead pod can't resurrect it in the cache
-        self._pod_rv[pod.metadata.uid] = 2 ** 62
-        self.nominator.delete(pod.metadata.uid)
+        # for the dead pod can't resurrect it in the cache; tombstones age
+        # out of a bounded FIFO instead of a wholesale clear
+        uid = pod.metadata.uid
+        self._pod_rv[uid] = 2 ** 62
+        self._rv_tombstones.append(uid)
+        if len(self._rv_tombstones) > 50_000:
+            self._pod_rv.pop(self._rv_tombstones.popleft(), None)
+        # a pod parked at Permit WAIT holds an assumed reservation: free it
+        # now (the reference rejects waiting pods from the delete handler)
+        wp = self.framework.waiting_pods.remove(uid)
+        if wp is not None:
+            self.framework.run_unreserve_plugins(wp.state, wp.qp.pod,
+                                                 wp.node_name)
+            assumed = wp.qp.pod.clone()
+            assumed.spec.node_name = wp.node_name
+            self.cache.forget_pod(assumed)
+            self._invalidate_chain()
+            self.queue.done(uid)
+        self.nominator.delete(uid)
         if pod.spec.node_name:
-            self._chain = None
+            self._invalidate_chain()
             self.cache.remove_pod(pod)
             self.queue.move_all_to_active_or_backoff(
                 ClusterEvent(R.ASSIGNED_POD, A.DELETE), pod, None)
@@ -307,7 +343,7 @@ class Scheduler:
         self.caps = dataclasses.replace(self.caps, **{field: new})
         self.mirror = Mirror(caps=self.caps)
         self.snapshot = Snapshot()
-        self._chain = None
+        self._invalidate_chain()
         self.cache.update_snapshot(self.snapshot)
         # NO sync here: the caller's retry loop re-syncs, so a second field
         # overflowing during the rebuild raises inside the try (and grows
@@ -358,6 +394,8 @@ class Scheduler:
         still-in-flight previous launch before any fallback re-sync, so a
         chained dispatch that has to re-bucket never syncs a cache missing
         the previous batch's placements."""
+        t_cycle0 = self.now()
+        epoch = self._chain_epoch
         if self._has_host_filters:
             runnable = self._defer_host_conflicts(runnable)
             if not runnable:
@@ -406,10 +444,12 @@ class Scheduler:
             spec, self.mirror.well_known(), self._weights, self.caps,
             self._enabled_filters, serial_scan=not use_auction, state=state,
             host_ok=host_ok, host_score=host_score)
-        # the chain advances to this launch's post-batch state; later
-        # external events reset it to None via the handlers
-        self._chain = (out.free, out.nzr)
-        return runnable, out
+        # the chain advances to this launch's post-batch state UNLESS an
+        # invalidation raced in while we were packing (epoch check); later
+        # external events reset it via the handlers
+        if epoch == self._chain_epoch:
+            self._chain = (out.free, out.nzr)
+        return runnable, out, self.now(), self.now() - t_cycle0
 
     def _defer_host_conflicts(self, runnable: list[QueuedPodInfo]
                               ) -> list[QueuedPodInfo]:
@@ -494,16 +534,38 @@ class Scheduler:
 
     def _finish(self, inflight: tuple) -> None:
         """Pull one dispatched launch's results and commit/fail each pod."""
-        runnable, out = inflight
+        runnable, out, t_dispatched, pack_s = inflight
         n = len(runnable)
+        t0 = self.now()
         rows, rejects = jax.device_get((out.node_row, out.reject_counts))
+        launch_s = self.now() - t_dispatched
         rows = np.asarray(rows)[:n].tolist()
         rejects = np.asarray(rejects)[:n].tolist()
+        t1 = self.now()
         for qp, row, rej in zip(runnable, rows, rejects):
             if row >= 0:
                 self._commit(qp, self.mirror.name_of_row(row))
             else:
                 self._fail(qp, rej)
+        commit_s = self.now() - t1
+        cycle_s = pack_s + launch_s + commit_s
+        m = self.metrics
+        m.algorithm_duration.observe(launch_s)
+        m.batch_duration.observe(cycle_s)
+        m.extension_point_duration.observe(pack_s, extension_point="PreFilter")
+        m.extension_point_duration.observe(launch_s, extension_point="Filter")
+        m.extension_point_duration.observe(commit_s, extension_point="Reserve")
+        per_pod = cycle_s / max(n, 1)
+        for qp, row in zip(runnable, rows):
+            m.attempt_duration.observe(
+                per_pod, result="scheduled" if row >= 0 else "unschedulable")
+        if cycle_s > SLOW_CYCLE_SECONDS:
+            # schedule_one.go:404's slow-attempt trace, batch-shaped
+            logger.info(
+                "slow scheduling cycle: %.0fms for %d pods "
+                "(pack %.0fms, launch %.0fms, commit %.0fms)",
+                cycle_s * 1e3, n, pack_s * 1e3, launch_s * 1e3,
+                commit_s * 1e3)
 
     def schedule_one_batch(self) -> int:
         """Pop up to batch_size pods, run one device launch, commit results.
@@ -558,7 +620,7 @@ class Scheduler:
         # binding a pod with (anti)affinity terms makes the mirror's pod
         # table stale: the chain must not skip the sync that packs it
         if self.mirror.batch_has_topology([pod]):
-            self._chain = None
+            self._invalidate_chain()
         s = fw.run_reserve_plugins(state, pod, node_name)
         if not s.is_success():
             self._undo_commit(qp, state, assumed, node_name,
@@ -588,7 +650,7 @@ class Scheduler:
         self.framework.run_unreserve_plugins(state, qp.pod, node_name)
         self.cache.forget_pod(assumed)
         # the device chain assumed this placement; force a re-sync
-        self._chain = None
+        self._invalidate_chain()
         if rejected_by:
             qp.unschedulable_plugins = {rejected_by}
             qp.unschedulable_count += 1
@@ -603,9 +665,19 @@ class Scheduler:
 
     def _bind_task(self, state: CycleState, pod: Pod, node_name: str):
         fw = self.framework
-        s = fw.run_pre_bind_plugins(state, pod, node_name)
-        if s.is_success():
-            s = fw.run_bind_plugins(state, pod, node_name)
+        t0 = time.monotonic()
+        try:
+            s = fw.run_pre_bind_plugins(state, pod, node_name)
+            if s.is_success():
+                s = fw.run_bind_plugins(state, pod, node_name)
+        except Exception as e:  # noqa: BLE001 — a raising out-of-tree
+            # plugin must not poison the chunk/future (every other pod in
+            # it would stay assumed forever)
+            from kubernetes_tpu.framework.interface import Status
+
+            s = Status.error(f"bind cycle raised: {e!r}")
+        self.recorder.observe(self.metrics.extension_point_duration,
+                              time.monotonic() - t0, extension_point="Bind")
         return s
 
     def _start_binding(self, qp: QueuedPodInfo, state: CycleState,
@@ -664,6 +736,9 @@ class Scheduler:
         self.framework.run_post_bind_plugins(state, qp.pod, node_name)
         qp.consecutive_errors_count = 0
         self.stats["scheduled"] += 1
+        self.metrics.schedule_attempts.inc(result="scheduled",
+                                           profile=self._profile_name)
+        self.metrics.pod_scheduling_attempts.observe(qp.attempts)
 
     def _process_waiting(self) -> None:
         """Harvest the waitingPodsMap: fully-allowed pods proceed to the
@@ -692,6 +767,8 @@ class Scheduler:
         qp.unschedulable_count += 1
         qp.consecutive_errors_count = 0
         self.stats["unschedulable"] += 1
+        self.metrics.schedule_attempts.inc(result="unschedulable",
+                                           profile=self._profile_name)
         nominated = None
         if self.framework.points["post_filter"]:
             # chained launches skip the per-batch sync; the preemption
@@ -724,6 +801,8 @@ class Scheduler:
         qp.consecutive_errors_count += 1
         qp.unschedulable_plugins = set()
         self.stats["errors"] += 1
+        self.metrics.schedule_attempts.inc(result="error",
+                                           profile=self._profile_name)
         self.hub.patch_pod_condition(qp.pod, PodCondition(
             type="PodScheduled", status="False", reason="SchedulerError",
             message=msg))
@@ -754,14 +833,26 @@ class Scheduler:
             self._drain_bind_results()
             self.preemption.flush_evictions()
             self._process_deferred_events()
+            self.recorder.flush(force=False)
+            self.metrics.cache_size.set(self.cache.pod_count(), type="pods")
+            self.metrics.cache_size.set(self.cache.assumed_pod_count(),
+                                        type="assumed_pods")
 
     def run(self, stop: threading.Event, idle_sleep: float = 0.02) -> None:
         """Blocking daemon loop (scheduler.go:452 Run): maintenance timers
-        + scheduling cycles until ``stop`` is set."""
+        + scheduling cycles until ``stop`` is set. Exceptions are logged
+        and retained (daemon_error) instead of silently killing the
+        thread; the loop backs off and keeps serving."""
+        self.daemon_error: Optional[BaseException] = None
         while not stop.is_set():
-            self.run_maintenance()
-            if self.run_until_idle() == 0:
-                stop.wait(idle_sleep)
+            try:
+                self.run_maintenance()
+                if self.run_until_idle() == 0:
+                    stop.wait(idle_sleep)
+            except Exception as e:  # noqa: BLE001 — keep the daemon alive
+                logger.exception("scheduling loop error: %s", e)
+                self.daemon_error = e
+                stop.wait(0.5)
 
     def start(self) -> None:
         """Run the daemon on its own thread (tests/embedding)."""
@@ -850,4 +941,5 @@ class Scheduler:
         self._drain_bind_results(wait=True)
         self.preemption.flush_evictions()
         self._process_deferred_events()
+        self.recorder.flush()
         return total

@@ -1,0 +1,66 @@
+"""Serving endpoints: /metrics, /healthz, /configz.
+
+The slice of the reference's component HTTP surface the scheduler exposes
+(cmd/kube-scheduler/app/server.go:252 newHealthEndpointsAndMetricsHandler:
+healthz/livez/readyz + /metrics + /configz): a tiny threaded HTTP server
+over the metrics Registry and the component config.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import asdict, is_dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class ServingEndpoints:
+    def __init__(self, scheduler, host: str = "127.0.0.1", port: int = 0):
+        self.scheduler = scheduler
+        sched = scheduler
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # silence per-request stderr spam
+                pass
+
+            def _send(self, code: int, body: str,
+                      ctype: str = "text/plain; charset=utf-8") -> None:
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802 (stdlib API)
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    self._send(200, sched.metrics.registry.render_text())
+                elif path in ("/healthz", "/livez", "/readyz"):
+                    self._send(200, "ok")
+                elif path == "/configz":
+                    cfg = sched.config
+                    body = json.dumps(
+                        asdict(cfg) if is_dataclass(cfg) else str(cfg),
+                        indent=2, default=str)
+                    self._send(200, body, "application/json")
+                else:
+                    self._send(404, "not found")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="ktpu-serving")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

@@ -1,0 +1,150 @@
+"""Metrics + async recorder + serving endpoints (reference:
+metrics/metrics.go:147-335, metric_recorder.go, app/server.go:252)."""
+
+import json
+import urllib.request
+
+from kubernetes_tpu.metrics import (
+    AsyncRecorder,
+    Counter,
+    Histogram,
+    Registry,
+    SchedulerMetrics,
+)
+from kubernetes_tpu.serving import ServingEndpoints
+
+from kubernetes_tpu.api.objects import (
+    Container,
+    LABEL_HOSTNAME,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResourceRequirements,
+)
+from kubernetes_tpu.config.types import default_config
+from kubernetes_tpu.hub import Hub
+from kubernetes_tpu.ops.features import Capacities
+from kubernetes_tpu.scheduler import Scheduler
+
+
+def test_histogram_percentiles_and_text():
+    h = Histogram("h", "help", buckets=(0.01, 0.1, 1.0), label_names=("r",))
+    for _ in range(90):
+        h.observe(0.005, r="ok")
+    for _ in range(10):
+        h.observe(0.5, r="ok")
+    assert h.count(r="ok") == 100
+    assert h.percentile(50) == 0.01
+    assert h.percentile(95) == 1.0
+
+
+def test_counter_labels():
+    c = Counter("c", label_names=("result",))
+    c.inc(result="scheduled")
+    c.inc(result="scheduled")
+    c.inc(result="error")
+    assert c.value(result="scheduled") == 2
+    assert c.value(result="error") == 1
+
+
+def test_async_recorder_buffers_until_flush():
+    h = Histogram("h")
+    c = Counter("c")
+    t = [0.0]
+    rec = AsyncRecorder(flush_interval=1.0, now=lambda: t[0])
+    rec.observe(h, 0.25)
+    rec.inc(c, 2.0)
+    assert h.total_count() == 0 and c.value() == 0, "buffered"
+    n = rec.flush()
+    assert n == 2
+    assert h.total_count() == 1
+    assert c.value() == 2.0
+    # non-forced flush respects the interval
+    rec.observe(h, 0.25)
+    rec.flush(force=True)
+    rec.observe(h, 0.25)
+    assert rec.flush(force=False) == 0, "interval not elapsed"
+    t[0] = 2.0
+    assert rec.flush(force=False) == 1
+
+
+def mknode(i):
+    return Node(metadata=ObjectMeta(name=f"node-{i}",
+                                    labels={LABEL_HOSTNAME: f"node-{i}"}),
+                status=NodeStatus(allocatable={"cpu": "8", "memory": "16Gi",
+                                               "pods": "110"}))
+
+
+def mkpod(name, cpu="100m"):
+    return Pod(metadata=ObjectMeta(name=name),
+               spec=PodSpec(containers=[Container(
+                   name="c", resources=ResourceRequirements(
+                       requests={"cpu": cpu}))]))
+
+
+def _small_sched(hub):
+    cfg = default_config()
+    cfg.batch_size = 16
+    return Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64))
+
+
+def test_scheduler_records_attempts_and_durations():
+    hub = Hub()
+    sched = _small_sched(hub)
+    hub.create_node(mknode(0))
+    pods = [mkpod(f"p{i}") for i in range(5)]
+    for p in pods:
+        hub.create_pod(p)
+    big = mkpod("big", cpu="64")
+    hub.create_pod(big)
+    sched.run_until_idle()
+    m = sched.metrics
+    assert m.schedule_attempts.value(
+        result="scheduled", profile="default-scheduler") == 5
+    assert m.schedule_attempts.value(
+        result="unschedulable", profile="default-scheduler") >= 1
+    assert m.attempt_duration.count(result="scheduled") == 5
+    assert m.batch_duration.total_count() >= 1
+    assert m.algorithm_duration.total_count() >= 1
+    assert m.extension_point_duration.count(extension_point="Filter") >= 1
+    # binder-thread observations land after the recorder flush
+    assert m.extension_point_duration.count(extension_point="Bind") >= 1
+    assert m.pod_scheduling_attempts.total_count() == 5
+    snap = m.registry.snapshot()
+    assert "schedule_attempts_total" in snap
+    assert "pending_pods" in snap
+
+
+def test_serving_endpoints():
+    hub = Hub()
+    sched = _small_sched(hub)
+    hub.create_node(mknode(0))
+    hub.create_pod(mkpod("p"))
+    sched.run_until_idle()
+    srv = ServingEndpoints(sched, port=0)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        body = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        assert "schedule_attempts_total" in body
+        assert 'result="scheduled"' in body
+        assert "scheduling_attempt_duration_seconds_bucket" in body
+        assert urllib.request.urlopen(
+            f"{base}/healthz").read() == b"ok"
+        cfg = json.loads(urllib.request.urlopen(
+            f"{base}/configz").read().decode())
+        assert cfg["batch_size"] == 16
+    finally:
+        srv.stop()
+
+
+def test_pending_pods_gauge_live():
+    hub = Hub()
+    sched = _small_sched(hub)
+    # no nodes: the pod parks unschedulable
+    hub.create_pod(mkpod("p"))
+    sched.run_until_idle()
+    gauge = sched.metrics.pending_pods.snapshot()
+    assert gauge["{'queue': 'unschedulable'}"] == 1
