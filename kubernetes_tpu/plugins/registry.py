@@ -23,6 +23,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from kubernetes_tpu.api.objects import Pod
+from kubernetes_tpu.plugins import hints
 from kubernetes_tpu.framework.interface import (
     ActionType,
     BindPlugin,
@@ -38,8 +39,9 @@ A = ActionType
 R = EventResource
 
 
-def _ev(resource: R, action: A) -> ClusterEventWithHint:
-    return ClusterEventWithHint(event=ClusterEvent(resource, action))
+def _ev(resource: R, action: A, hint=None) -> ClusterEventWithHint:
+    return ClusterEventWithHint(event=ClusterEvent(resource, action),
+                                queueing_hint_fn=hint)
 
 
 @dataclass
@@ -137,29 +139,41 @@ def in_tree_registry() -> dict[str, PluginDescriptor]:
         PluginDescriptor(
             name="TaintToleration", points=("filter", "score"),
             device_filter=True, device_score=True, default_weight=3,
-            events=[_ev(R.NODE, A.ADD | A.UPDATE_NODE_TAINT)]),
+            events=[_ev(R.NODE, A.ADD | A.UPDATE_NODE_TAINT,
+                        hints.taint_toleration_hint)]),
         PluginDescriptor(
             name="NodeAffinity", points=("filter", "score"),
             device_filter=True, device_score=True, default_weight=2,
-            events=[_ev(R.NODE, A.ADD | A.UPDATE_NODE_LABEL)]),
+            events=[_ev(R.NODE, A.ADD | A.UPDATE_NODE_LABEL,
+                        hints.node_affinity_hint)]),
         PluginDescriptor(
             name="NodePorts", points=("filter",), device_filter=True,
             events=[_ev(R.ASSIGNED_POD, A.DELETE), node_alloc]),
         PluginDescriptor(
             name="NodeResourcesFit", points=("filter", "score"),
             device_filter=True, device_score=True, default_weight=1,
-            events=[pod_del, node_alloc]),
+            events=[_ev(R.ASSIGNED_POD,
+                        A.DELETE | A.UPDATE_POD_SCALE_DOWN,
+                        hints.fit_hint),
+                    _ev(R.NODE, A.ADD | A.UPDATE_NODE_ALLOCATABLE,
+                        hints.fit_hint)]),
         PluginDescriptor(
             name="PodTopologySpread", points=("filter", "score"),
             device_filter=True, device_score=True, default_weight=2,
-            events=[_ev(R.ASSIGNED_POD, A.ADD | A.DELETE | A.UPDATE_POD_LABEL),
+            events=[_ev(R.ASSIGNED_POD,
+                        A.ADD | A.DELETE | A.UPDATE_POD_LABEL,
+                        hints.topology_spread_hint),
                     _ev(R.NODE, A.ADD | A.DELETE | A.UPDATE_NODE_LABEL
-                        | A.UPDATE_NODE_TAINT)]),
+                        | A.UPDATE_NODE_TAINT,
+                        hints.topology_spread_hint)]),
         PluginDescriptor(
             name="InterPodAffinity", points=("filter", "score"),
             device_filter=True, device_score=True, default_weight=2,
-            events=[_ev(R.ASSIGNED_POD, A.ADD | A.DELETE | A.UPDATE_POD_LABEL),
-                    _ev(R.NODE, A.ADD | A.UPDATE_NODE_LABEL)]),
+            events=[_ev(R.ASSIGNED_POD,
+                        A.ADD | A.DELETE | A.UPDATE_POD_LABEL,
+                        hints.inter_pod_affinity_hint),
+                    _ev(R.NODE, A.ADD | A.UPDATE_NODE_LABEL,
+                        hints.inter_pod_affinity_hint)]),
         PluginDescriptor(
             name="NodeResourcesBalancedAllocation", points=("score",),
             device_score=True, default_weight=1,

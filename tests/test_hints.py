@@ -1,0 +1,184 @@
+"""QueueingHintFn unit tests: QUEUE vs SKIP per plugin on targeted events
+(reference: fit.go:265, node_affinity.go:95, taint_toleration.go:205,
+interpodaffinity/plugin.go:92, podtopologyspread/plugin.go:160) + the
+end-to-end effect: a non-helpful event leaves the pod parked."""
+
+from kubernetes_tpu.api.objects import (
+    Affinity,
+    Container,
+    LABEL_HOSTNAME,
+    LABEL_ZONE,
+    LabelSelector,
+    Node,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PodSpec,
+    ResourceRequirements,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from kubernetes_tpu.framework.interface import QueueingHint
+from kubernetes_tpu.plugins.hints import (
+    fit_hint,
+    inter_pod_affinity_hint,
+    node_affinity_hint,
+    taint_toleration_hint,
+    topology_spread_hint,
+)
+
+QUEUE, SKIP = QueueingHint.QUEUE, QueueingHint.SKIP
+
+
+def mknode(name="n", cpu="4", labels=None, taints=None):
+    return Node(metadata=ObjectMeta(name=name, labels=labels or {}),
+                spec=NodeSpec(taints=taints or []),
+                status=NodeStatus(allocatable={"cpu": cpu, "memory": "8Gi",
+                                               "pods": "110"}))
+
+
+def mkpod(name="p", cpu="1", labels=None, ns="default"):
+    return Pod(metadata=ObjectMeta(name=name, namespace=ns,
+                                   labels=labels or {}),
+               spec=PodSpec(containers=[Container(
+                   name="c", resources=ResourceRequirements(
+                       requests={"cpu": cpu}))]))
+
+
+def test_fit_hint_node_events():
+    pod = mkpod(cpu="8")
+    assert fit_hint(pod, None, mknode(cpu="16")) == QUEUE
+    assert fit_hint(pod, None, mknode(cpu="2")) == SKIP, \
+        "a too-small node cannot help"
+
+
+def test_fit_hint_pod_deletion():
+    pod = mkpod(cpu="2")
+    scheduled = mkpod("dead", cpu="4")
+    scheduled.spec.node_name = "n"
+    assert fit_hint(pod, scheduled, None) == QUEUE, \
+        "a scheduled pod's deletion frees capacity (incl. its pod slot)"
+    pending = mkpod("never-ran", cpu="4")
+    assert fit_hint(pod, pending, None) == SKIP, \
+        "an unscheduled pod's deletion frees nothing (fit.go:273)"
+
+
+def test_node_affinity_hint():
+    pod = mkpod()
+    pod.spec.affinity = Affinity(node_affinity=NodeAffinity(
+        required=NodeSelector(node_selector_terms=[NodeSelectorTerm(
+            match_expressions=[NodeSelectorRequirement(
+                key=LABEL_ZONE, operator="In", values=["east"])])])))
+    assert node_affinity_hint(
+        pod, None, mknode(labels={LABEL_ZONE: "east"})) == QUEUE
+    assert node_affinity_hint(
+        pod, None, mknode(labels={LABEL_ZONE: "west"})) == SKIP
+
+
+def test_taint_toleration_hint():
+    pod = mkpod()
+    tainted = mknode(taints=[Taint("dedicated", "infra", "NoSchedule")])
+    assert taint_toleration_hint(pod, None, tainted) == SKIP
+    assert taint_toleration_hint(pod, None, mknode()) == QUEUE
+    pod.spec.tolerations = [Toleration(key="dedicated", operator="Equal",
+                                       value="infra", effect="NoSchedule")]
+    assert taint_toleration_hint(pod, None, tainted) == QUEUE
+
+
+def test_inter_pod_affinity_hint():
+    pod = mkpod()
+    pod.spec.affinity = Affinity(pod_affinity=PodAffinity(required=[
+        PodAffinityTerm(topology_key=LABEL_ZONE,
+                        label_selector=LabelSelector(
+                            match_labels={"app": "db"}))]))
+    db = mkpod("db", labels={"app": "db"})
+    web = mkpod("web", labels={"app": "web"})
+    assert inter_pod_affinity_hint(pod, None, db) == QUEUE
+    assert inter_pod_affinity_hint(pod, None, web) == SKIP
+    # anti-affinity: only DELETIONS of matching pods help
+    anti = mkpod()
+    anti.spec.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(
+        required=[PodAffinityTerm(topology_key=LABEL_HOSTNAME,
+                                  label_selector=LabelSelector(
+                                      match_labels={"app": "db"}))]))
+    assert inter_pod_affinity_hint(anti, db, None) == QUEUE
+    assert inter_pod_affinity_hint(anti, web, None) == SKIP
+    assert inter_pod_affinity_hint(anti, None, db) == SKIP, \
+        "an ADDED matching pod cannot fix an anti-affinity rejection"
+    # relabel OUT of the anti selector: QUEUE
+    db2 = mkpod("db", labels={"app": "cache"})
+    assert inter_pod_affinity_hint(anti, db, db2) == QUEUE
+    # existing-pod anti-affinity relief: a term-less pending pod requeues
+    # when an anti-affinity-carrying pod departs
+    plain = mkpod("plain")
+    blocker = mkpod("blocker", labels={"x": "y"})
+    blocker.spec.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(
+        required=[PodAffinityTerm(topology_key=LABEL_HOSTNAME,
+                                  label_selector=LabelSelector(
+                                      match_labels={"any": "one"}))]))
+    assert inter_pod_affinity_hint(plain, blocker, None) == QUEUE
+    assert inter_pod_affinity_hint(plain, web, None) == SKIP
+
+
+def test_topology_spread_hint():
+    pod = mkpod()
+    pod.spec.topology_spread_constraints = [TopologySpreadConstraint(
+        max_skew=1, topology_key=LABEL_ZONE,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "s"}))]
+    match = mkpod("m", labels={"app": "s"})
+    other = mkpod("o", labels={"app": "x"})
+    foreign = mkpod("f", labels={"app": "s"}, ns="other")
+    assert topology_spread_hint(pod, match, None) == QUEUE
+    assert topology_spread_hint(pod, other, None) == SKIP
+    assert topology_spread_hint(pod, foreign, None) == SKIP
+    # node events: only nodes carrying the constraint's topology key matter
+    assert topology_spread_hint(
+        pod, None, mknode(labels={LABEL_ZONE: "z"})) == QUEUE
+    assert topology_spread_hint(pod, None, mknode(labels={})) == SKIP
+
+
+def test_end_to_end_unhelpful_node_stays_parked():
+    """A rejected pod stays parked when the arriving node cannot help, and
+    requeues when one can (the whole point of queueing hints)."""
+    from kubernetes_tpu.config.types import default_config
+    from kubernetes_tpu.hub import Hub
+    from kubernetes_tpu.ops.features import Capacities
+    from kubernetes_tpu.scheduler import Scheduler
+
+    class Clock:
+        t = 1000.0
+
+        def now(self):
+            return self.t
+
+    hub = Hub()
+    clock = Clock()
+    cfg = default_config()
+    cfg.batch_size = 16
+    sched = Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64),
+                      now=clock.now)
+    hub.create_node(mknode("small", cpu="1"))
+    big = mkpod("big", cpu="8")
+    hub.create_pod(big)
+    sched.run_until_idle()
+    assert sched.queue.pending_counts()["unschedulable"] == 1
+    # another too-small node arrives: fit_hint says SKIP -> still parked
+    hub.create_node(mknode("small2", cpu="1"))
+    assert sched.queue.pending_counts()["unschedulable"] == 1
+    # a big node arrives: QUEUE -> moved out of the unschedulable pool
+    hub.create_node(mknode("big-node", cpu="16"))
+    assert sched.queue.pending_counts()["unschedulable"] == 0
+    Clock.t += 2.0
+    sched.queue.flush_backoff_completed()
+    sched.run_until_idle()
+    assert hub.get_pod(big.metadata.uid).spec.node_name == "big-node"
