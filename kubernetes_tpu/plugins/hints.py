@@ -96,10 +96,15 @@ def _pod_matches_terms(terms, other: Pod, pending_ns: str) -> bool:
     return False
 
 
-def _has_required_anti(p: Pod) -> bool:
+def _anti_terms_could_block(p: Pod, pending: Pod) -> bool:
+    """Does p carry a required anti-affinity term whose selector could
+    actually select ``pending``? (The departed blocker must have been able
+    to block THIS pod, else its exit is noise.)"""
     a = p.spec.affinity
-    return (a is not None and a.pod_anti_affinity is not None
-            and bool(a.pod_anti_affinity.required))
+    if a is None or a.pod_anti_affinity is None:
+        return False
+    return _pod_matches_terms(a.pod_anti_affinity.required, pending,
+                              p.metadata.namespace)
 
 
 def inter_pod_affinity_hint(pod: Pod, old_obj, new_obj) -> QueueingHint:
@@ -129,8 +134,8 @@ def inter_pod_affinity_hint(pod: Pod, old_obj, new_obj) -> QueueingHint:
                         aff.pod_anti_affinity.required, new_pod,
                         pod.metadata.namespace):
                 return QUEUE
-            if _has_required_anti(old_pod) \
-                    and not _has_required_anti(new_pod):
+            if _anti_terms_could_block(old_pod, pod) \
+                    and not _anti_terms_could_block(new_pod, pod):
                 return QUEUE
         return SKIP
     # deletion
@@ -138,8 +143,8 @@ def inter_pod_affinity_hint(pod: Pod, old_obj, new_obj) -> QueueingHint:
             and _pod_matches_terms(aff.pod_anti_affinity.required, old_pod,
                                    pod.metadata.namespace):
         return QUEUE
-    if _has_required_anti(old_pod):
-        return QUEUE        # its own anti terms may have been the blocker
+    if _anti_terms_could_block(old_pod, pod):
+        return QUEUE        # its own anti terms could have blocked us
     return SKIP
 
 
@@ -148,13 +153,17 @@ def topology_spread_hint(pod: Pod, old_obj, new_obj) -> QueueingHint:
     constraint's selector in the pending pod's namespace move the skew."""
     other = _as_pod(new_obj) or _as_pod(old_obj)
     if other is None:
-        node = _as_node(new_obj) or _as_node(old_obj)
-        if node is None:
-            return QUEUE
         keys = {c.topology_key
                 for c in pod.spec.topology_spread_constraints}
-        return QUEUE if any(k in node.metadata.labels for k in keys) \
-            else SKIP
+        # a key appearing on the NEW node or leaving the OLD one both move
+        # the domain math (isSchedulableAfterNodeChange checks both sides)
+        for node in (_as_node(new_obj), _as_node(old_obj)):
+            if node is not None \
+                    and any(k in node.metadata.labels for k in keys):
+                return QUEUE
+        if _as_node(new_obj) is None and _as_node(old_obj) is None:
+            return QUEUE
+        return SKIP
     if other.metadata.namespace != pod.metadata.namespace:
         return SKIP
     for c in pod.spec.topology_spread_constraints:

@@ -1,0 +1,453 @@
+"""Golden parity tables ported from the reference's plugin unit tests
+(SURVEY §4 rung 1): case data re-expressed from
+- noderesources/fit_test.go TestEnoughRequests (node 10m cpu / 20Mi mem /
+  32 pods / 5 example.com/aaa),
+- podtopologyspread/filtering_test.go TestSingleConstraint /
+  TestMultipleConstraints (node-a/b in zone1, node-x/y in zone2),
+- interpodaffinity/filtering_test.go (zone/hostname terms, symmetry,
+  first-pod-of-a-group rule).
+
+Each case runs through the REAL device pipeline: per-node feasibility via
+ops.preempt.preempt_feasible (the full filter set for one pod over all
+nodes) and plugin attribution via a 1-pod launch's reject_counts.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from kubernetes_tpu.api.objects import (
+    Affinity,
+    Container,
+    LABEL_HOSTNAME,
+    LabelSelector,
+    LabelSelectorRequirement,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PodSpec,
+    ResourceRequirements,
+    TopologySpreadConstraint,
+)
+from kubernetes_tpu.backend.cache import Cache
+from kubernetes_tpu.backend.mirror import Mirror
+from kubernetes_tpu.backend.snapshot import Snapshot
+from kubernetes_tpu.models.pipeline import (
+    FILTER_PLUGINS,
+    default_weights,
+    launch_batch,
+)
+from kubernetes_tpu.ops.features import Capacities
+from kubernetes_tpu.ops.preempt import preempt_feasible_jit
+
+CAPS = Capacities(nodes=16, pods=64)
+WEIGHTS = default_weights()
+
+
+def _mknode(name, labels=None, cpu="100", mem="100Gi", pods="110",
+            ext=None):
+    alloc = {"cpu": cpu, "memory": mem, "pods": pods}
+    alloc.update(ext or {})
+    return Node(metadata=ObjectMeta(name=name, labels=labels or {}),
+                spec=NodeSpec(), status=NodeStatus(allocatable=alloc))
+
+
+def _mkpod(name, labels=None, ns="default", req=None, init=None,
+           affinity=None, tsc=None, node=""):
+    containers = [Container(name="c", resources=ResourceRequirements(
+        requests=req or {}))]
+    inits = [Container(name=f"i{j}", resources=ResourceRequirements(
+        requests=r)) for j, r in enumerate(init or [])]
+    return Pod(metadata=ObjectMeta(name=name, namespace=ns,
+                                   labels=labels or {}),
+               spec=PodSpec(containers=containers, init_containers=inits,
+                            affinity=affinity,
+                            topology_spread_constraints=tsc or [],
+                            node_name=node))
+
+
+def _build(nodes, existing):
+    cache = Cache()
+    for n in nodes:
+        cache.add_node(n)
+    for p in existing:
+        cache.add_pod(p)
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    mirror = Mirror(caps=CAPS)
+    mirror.sync(snap)
+    return mirror
+
+
+def feasible_set(pod, nodes, existing=()):
+    """Which nodes pass the FULL filter set for ``pod``."""
+    mirror = _build(nodes, list(existing))
+    pblobs = mirror.pack_batch_blobs([pod], 1)
+    tval = jnp.asarray(np.ones((CAPS.pods,), bool))
+    free = jnp.asarray(mirror.free_matrix())
+    enable = (mirror.table_has_topology()
+              or mirror.batch_has_topology([pod]))
+    feas = np.asarray(preempt_feasible_jit(
+        mirror.to_blobs(), pblobs, mirror.well_known(), CAPS, tval, free,
+        enable, mirror.domain_bucket()))
+    return {n.metadata.name for n in nodes
+            if feas[mirror.row_of(n.metadata.name)]}
+
+
+def reject_plugins(pod, nodes, existing=()):
+    """(scheduled_node | None, {plugin names with rejects})."""
+    mirror = _build(nodes, list(existing))
+    spec = mirror.prepare_launch([pod], 8)
+    out = launch_batch(spec, mirror.well_known(), WEIGHTS, CAPS)
+    row = int(np.asarray(out.node_row)[0])
+    rejects = np.asarray(out.reject_counts)[0]
+    plugins = {FILTER_PLUGINS[i] for i, c in enumerate(rejects.tolist())
+               if c > 0}
+    return (mirror.name_of_row(row) if row >= 0 else None), plugins
+
+
+# ---------------------------------------------------------------- fit ---
+# TestEnoughRequests: ONE node, allocatable cpu=10m mem=20Mi pods=32
+# example.com/aaa=5; `used` = requests of one existing bound pod.
+# want: None = fits, else the rejecting plugin.
+
+def R(cpu=0, mem=0, ext=0, storage=0):
+    req = {}
+    if cpu:
+        req["cpu"] = f"{cpu}m"
+    if mem:
+        req["memory"] = f"{mem}Mi"
+    if ext:
+        req["example.com/aaa"] = str(ext)
+    if storage:
+        req["ephemeral-storage"] = f"{storage}Mi"
+    return req
+
+
+FIT_CASES = [
+    # (name, request, init requests, existing usage, want rejecting plugin)
+    ("no resources requested always fits", R(), None, R(cpu=10, mem=20),
+     None),
+    ("too many resources fails", R(cpu=1, mem=1), None, R(cpu=10, mem=20),
+     "NodeResourcesFit"),
+    ("too many resources fails due to init container cpu",
+     R(cpu=1, mem=1), [R(cpu=3, mem=1)], R(cpu=8, mem=19),
+     "NodeResourcesFit"),
+    ("too many resources fails due to highest init container cpu",
+     R(cpu=1, mem=1), [R(cpu=3, mem=1), R(cpu=2, mem=1)], R(cpu=8, mem=19),
+     "NodeResourcesFit"),
+    ("too many resources fails due to init container memory",
+     R(cpu=1, mem=1), [R(cpu=1, mem=3)], R(cpu=9, mem=19),
+     "NodeResourcesFit"),
+    ("too many resources fails due to highest init container memory",
+     R(cpu=1, mem=1), [R(cpu=1, mem=3), R(cpu=1, mem=2)], R(cpu=9, mem=19),
+     "NodeResourcesFit"),
+    ("init container fits because it's the max, not sum",
+     R(cpu=1, mem=1), [R(cpu=1, mem=1)], R(cpu=9, mem=19), None),
+    ("multiple init containers fit (max, not sum)",
+     R(cpu=1, mem=1), [R(cpu=1, mem=1), R(cpu=1, mem=1)], R(cpu=9, mem=19),
+     None),
+    ("both resources fit", R(cpu=1, mem=1), None, R(cpu=5, mem=5), None),
+    ("one resource memory fits", R(cpu=2, mem=1), None, R(cpu=9, mem=5),
+     "NodeResourcesFit"),
+    ("one resource cpu fits", R(cpu=1, mem=2), None, R(cpu=5, mem=19),
+     "NodeResourcesFit"),
+    ("equal edge case", R(cpu=5, mem=1), None, R(cpu=5, mem=19), None),
+    ("equal edge case for init container", R(cpu=4, mem=1),
+     [R(cpu=5, mem=1)], R(cpu=5, mem=19), None),
+    ("extended resource fits", R(ext=1), None, R(), None),
+    ("extended resource fits for init container", R(), [R(ext=1)], R(),
+     None),
+    ("extended resource capacity enforced", R(ext=10), None, R(),
+     "NodeResourcesFit"),
+    ("extended resource capacity enforced for init container",
+     R(), [R(ext=10)], R(), "NodeResourcesFit"),
+    ("extended resource allocatable enforced", R(ext=1), None, R(ext=5),
+     "NodeResourcesFit"),
+    ("extended resource allocatable enforced for init container",
+     R(), [R(ext=1)], R(ext=5), "NodeResourcesFit"),
+    ("extended resource allocatable enforced vs existing usage",
+     R(ext=4), None, R(ext=2), "NodeResourcesFit"),
+    ("extended resource fits alongside existing usage",
+     R(ext=3), None, R(ext=2), None),
+    ("extended resource allocatable admits multiple init containers",
+     R(), [R(ext=3), R(ext=2)], R(ext=2), None),
+    ("extended resource allocatable enforced for multiple init containers",
+     R(), [R(ext=3), R(ext=4)], R(ext=2), "NodeResourcesFit"),
+    ("ephemeral-storage fits", R(storage=10), None, R(), None),
+    ("ephemeral-storage capacity enforced", R(storage=25000), None, R(),
+     "NodeResourcesFit"),
+    ("cpu fits exactly at the limit", R(cpu=10), None, R(), None),
+    ("memory fits exactly at the limit", R(mem=20), None, R(), None),
+    ("cpu over by one", R(cpu=11), None, R(), "NodeResourcesFit"),
+    ("memory over by one", R(mem=21), None, R(), "NodeResourcesFit"),
+    ("usage plus request over cpu", R(cpu=6), None, R(cpu=5), "NodeResourcesFit"),
+    ("usage plus request at cpu limit", R(cpu=5), None, R(cpu=5), None),
+]
+
+
+@pytest.mark.parametrize("name,req,init,used,want",
+                         FIT_CASES, ids=[c[0] for c in FIT_CASES])
+def test_fit_golden(name, req, init, used, want):
+    node = _mknode("node-0", cpu="10m", mem="20Mi", pods="32",
+                   ext={"example.com/aaa": "5",
+                        "ephemeral-storage": "20000Mi"})
+    existing = []
+    if any(used.values()):
+        existing.append(_mkpod("used", req=used, node="node-0"))
+    pod = _mkpod("p", req=req, init=init)
+    scheduled, plugins = reject_plugins(pod, [node], existing)
+    if want is None:
+        assert scheduled == "node-0", f"{name}: expected fit, got {plugins}"
+    else:
+        assert scheduled is None, f"{name}: expected rejection"
+        assert want in plugins, f"{name}: got {plugins}"
+
+
+# ------------------------------------------------------------- spread ---
+# TestSingleConstraint grid: node-a/node-b in zone1, node-x/node-y in
+# zone2 (all also labeled with their own hostname); existing pods by node.
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+def _grid(node_b_zone_key=ZONE):
+    return [
+        _mknode("node-a", {ZONE: "zone1", LABEL_HOSTNAME: "node-a"}),
+        _mknode("node-b", {node_b_zone_key: "zone1",
+                           LABEL_HOSTNAME: "node-b"}),
+        _mknode("node-x", {ZONE: "zone2", LABEL_HOSTNAME: "node-x"}),
+        _mknode("node-y", {ZONE: "zone2", LABEL_HOSTNAME: "node-y"}),
+    ]
+
+
+def _foo_pods(spec):
+    """spec: {node: count} of existing foo-labeled pods."""
+    out = []
+    for node, cnt in spec.items():
+        for i in range(cnt):
+            out.append(_mkpod(f"e-{node}-{i}", labels={"foo": ""},
+                              node=node))
+    return out
+
+
+def _sc(skew, key, sel="foo", min_domains=None):
+    selector = (LabelSelector(match_expressions=[LabelSelectorRequirement(
+        key=sel, operator="Exists")]) if sel else None)
+    return TopologySpreadConstraint(
+        max_skew=skew, topology_key=key, when_unsatisfiable="DoNotSchedule",
+        label_selector=selector, min_domains=min_domains)
+
+
+SPREAD_CASES = [
+    # (name, constraints, existing {node: n}, want feasible set)
+    ("no existing pods", [_sc(1, ZONE)], {},
+     {"node-a", "node-b", "node-x", "node-y"}),
+    ("no existing pods, incoming pod doesn't match itself",
+     [_sc(1, ZONE, sel="bar")], {},
+     {"node-a", "node-b", "node-x", "node-y"}),
+    ("existing pods do not match null selector",
+     [_sc(1, ZONE, sel=None)], {"node-x": 1, "node-y": 1},
+     {"node-a", "node-b", "node-x", "node-y"}),
+    ("pods spread across zones as 3/3, all nodes fit",
+     [_sc(1, ZONE)], {"node-a": 2, "node-b": 1, "node-y": 3},
+     {"node-a", "node-b", "node-x", "node-y"}),
+    ("pods spread across zones as 2/4, only zone1 fits",
+     [_sc(1, ZONE)], {"node-a": 1, "node-b": 1, "node-x": 2, "node-y": 2},
+     {"node-a", "node-b"}),
+    ("pod cannot be scheduled as all nodes don't have label 'rack'",
+     [_sc(1, "rack")], {}, set()),
+    ("pods spread across nodes as 2/1/0/3, only node-x fits",
+     [_sc(1, "kubernetes.io/hostname")],
+     {"node-a": 2, "node-b": 1, "node-y": 3}, {"node-x"}),
+    ("pods spread across nodes as 2/1/0/3, maxSkew is 2, node-b and node-x fit",
+     [_sc(2, "kubernetes.io/hostname")],
+     {"node-a": 2, "node-b": 1, "node-y": 3}, {"node-b", "node-x"}),
+    ("pods spread across nodes as 2/1/0/3 and 3/3 on zones, only node-x fits both",
+     [_sc(1, ZONE), _sc(1, "kubernetes.io/hostname")],
+     {"node-a": 2, "node-b": 1, "node-y": 3}, {"node-x"}),
+    ("zone skew 0/4 with maxSkew 1: only empty zone fits",
+     [_sc(1, ZONE)], {"node-x": 2, "node-y": 2}, {"node-a", "node-b"}),
+    ("maxSkew 4 still blocks the full side of a 0/4 split (4+1-0 > 4)",
+     [_sc(4, ZONE)], {"node-x": 2, "node-y": 2},
+     {"node-a", "node-b"}),
+    ("maxSkew 5 tolerates a 0/4 split everywhere",
+     [_sc(5, ZONE)], {"node-x": 2, "node-y": 2},
+     {"node-a", "node-b", "node-x", "node-y"}),
+    ("minDomains unsatisfied: global min treated as 0, 1/0 zone blocked",
+     [_sc(1, ZONE, min_domains=3)], {"node-a": 1},
+     {"node-x", "node-y"}),
+]
+
+
+@pytest.mark.parametrize("name,constraints,existing,want",
+                         SPREAD_CASES, ids=[c[0] for c in SPREAD_CASES])
+def test_spread_golden(name, constraints, existing, want):
+    pod = _mkpod("p", labels={"foo": ""}, tsc=constraints)
+    got = feasible_set(pod, _grid(), _foo_pods(existing))
+    assert got == want, f"{name}: got {got}"
+
+
+def test_spread_golden_missing_zone_label():
+    """'pods spread across zones as 1/2 due to absence of label zone on
+    node-b': node-b (no zone label) is filtered out; zone1 count=1 vs
+    zone2 count=2 -> only zone1's labeled node fits."""
+    nodes = _grid(node_b_zone_key="zon")
+    existing = _foo_pods({"node-a": 1, "node-b": 1, "node-x": 1,
+                          "node-y": 1})
+    pod = _mkpod("p", labels={"foo": ""}, tsc=[_sc(1, ZONE)])
+    got = feasible_set(pod, nodes, existing)
+    assert got == {"node-a"}
+
+
+def test_spread_golden_different_namespace_not_counted():
+    nodes = _grid()
+    existing = (_foo_pods({"node-x": 1, "node-y": 1})
+                + [_mkpod("o1", labels={"foo": ""}, ns="ns1",
+                          node="node-a"),
+                   _mkpod("o2", labels={"foo": ""}, ns="ns2",
+                          node="node-a")])
+    pod = _mkpod("p", labels={"foo": ""}, tsc=[_sc(1, ZONE)])
+    got = feasible_set(pod, nodes, existing)
+    assert got == {"node-a", "node-b"}, \
+        "zone1 has 0 same-ns matches vs zone2's 2"
+
+
+# --------------------------------------------------------- interpod -----
+
+def _aff(zone_sel=None, host_sel=None, anti_zone=None, anti_host=None,
+         ns=None):
+    def term(key, sel, namespaces):
+        return PodAffinityTerm(
+            topology_key=key,
+            label_selector=sel,
+            namespaces=namespaces or [])
+
+    aff_terms = []
+    anti_terms = []
+    if zone_sel is not None:
+        aff_terms.append(term(ZONE, zone_sel, ns))
+    if host_sel is not None:
+        aff_terms.append(term(LABEL_HOSTNAME, host_sel, ns))
+    if anti_zone is not None:
+        anti_terms.append(term(ZONE, anti_zone, ns))
+    if anti_host is not None:
+        anti_terms.append(term(LABEL_HOSTNAME, anti_host, ns))
+    return Affinity(
+        pod_affinity=PodAffinity(required=aff_terms) if aff_terms else None,
+        pod_anti_affinity=(PodAntiAffinity(required=anti_terms)
+                           if anti_terms else None))
+
+
+def SEL(**match):
+    return LabelSelector(match_labels=match)
+
+
+def SELX(key, op, *values):
+    return LabelSelector(match_expressions=[LabelSelectorRequirement(
+        key=key, operator=op, values=list(values))])
+
+
+AFFINITY_CASES = [
+    # (name, pod labels, affinity, existing [(node, labels)], want set)
+    ("affinity In matches existing pod in same zone",
+     {"app": "web"}, _aff(zone_sel=SELX("service", "In", "securityscan")),
+     [("node-a", {"service": "securityscan"})],
+     {"node-a", "node-b"}),           # whole zone1 satisfies the term
+    ("affinity mismatch leaves no feasible node",
+     {"app": "web"}, _aff(zone_sel=SELX("service", "In", "db")),
+     [("node-a", {"service": "securityscan"})],
+     set()),
+    ("affinity NotIn matches pods lacking the value",
+     {}, _aff(zone_sel=SELX("service", "NotIn", "db")),
+     [("node-x", {"service": "securityscan"})],
+     {"node-x", "node-y"}),
+    ("affinity Exists operator",
+     {}, _aff(zone_sel=SELX("service", "Exists")),
+     [("node-y", {"service": "anything"})],
+     {"node-x", "node-y"}),
+    ("affinity DoesNotExist: no match anywhere, but the label-less pod "
+     "matches its own selector (first-pod-of-group rule)",
+     {}, _aff(zone_sel=SELX("service", "DoesNotExist")),
+     [("node-a", {"service": "x"})],
+     {"node-a", "node-b", "node-x", "node-y"}),
+    ("affinity DoesNotExist satisfied by an unlabeled existing pod",
+     {"service": "x"}, _aff(zone_sel=SELX("service", "DoesNotExist")),
+     [("node-x", {"other": "y"})],
+     {"node-x", "node-y"}),
+    ("hostname-scoped affinity pins to the pod's node",
+     {}, _aff(host_sel=SEL(app="db")),
+     [("node-x", {"app": "db"})],
+     {"node-x"}),
+    ("first pod of a group may go anywhere (self-match rule)",
+     {"app": "db"}, _aff(host_sel=SEL(app="db")), [],
+     {"node-a", "node-b", "node-x", "node-y"}),
+    ("first-pod rule needs the pod to match its own selector",
+     {"app": "web"}, _aff(host_sel=SEL(app="db")), [],
+     set()),
+    ("anti-affinity forbids the matching pod's zone",
+     {}, _aff(anti_zone=SEL(app="web")),
+     [("node-a", {"app": "web"})],
+     {"node-x", "node-y"}),
+    ("anti-affinity hostname only forbids the node itself",
+     {}, _aff(anti_host=SEL(app="web")),
+     [("node-a", {"app": "web"})],
+     {"node-b", "node-x", "node-y"}),
+    ("anti-affinity with no matching pods allows everything",
+     {}, _aff(anti_zone=SEL(app="web")), [],
+     {"node-a", "node-b", "node-x", "node-y"}),
+    ("incoming pod matching its own anti selector still placeable",
+     {"app": "web"}, _aff(anti_zone=SEL(app="web")), [],
+     {"node-a", "node-b", "node-x", "node-y"}),
+    ("affinity AND anti-affinity together",
+     {}, _aff(zone_sel=SEL(app="db"), anti_host=SEL(app="db")),
+     [("node-a", {"app": "db"})],
+     {"node-b"}),                      # same zone, different host
+    ("multiple affinity terms must all be satisfied",
+     {}, _aff(zone_sel=SEL(app="db"), host_sel=SEL(app="db")),
+     [("node-a", {"app": "db"})],
+     {"node-a"}),
+]
+
+
+@pytest.mark.parametrize("name,labels,aff,existing,want",
+                         AFFINITY_CASES, ids=[c[0] for c in AFFINITY_CASES])
+def test_interpod_golden(name, labels, aff, existing, want):
+    nodes = _grid()
+    pods = [_mkpod(f"e{i}", labels=lab, node=node)
+            for i, (node, lab) in enumerate(existing)]
+    pod = _mkpod("p", labels=labels, affinity=aff)
+    got = feasible_set(pod, nodes, pods)
+    assert got == want, f"{name}: got {got}"
+
+
+def test_existing_pod_anti_affinity_symmetry():
+    """satisfyExistingPodsAntiAffinity: a RUNNING pod's required
+    anti-affinity forbids incoming pods matching it (filtering_test.go's
+    symmetry cases)."""
+    nodes = _grid()
+    blocker = _mkpod("blocker", labels={"team": "x"}, node="node-a",
+                     affinity=_aff(anti_zone=SEL(app="web")))
+    incoming = _mkpod("p", labels={"app": "web"})
+    got = feasible_set(incoming, nodes, [blocker])
+    assert got == {"node-x", "node-y"}, \
+        "the blocker's zone is forbidden for matching incomers"
+    unrelated = _mkpod("q", labels={"app": "batch"})
+    got2 = feasible_set(unrelated, nodes, [blocker])
+    assert got2 == {"node-a", "node-b", "node-x", "node-y"}
+
+
+def test_affinity_namespaces_respected():
+    nodes = _grid()
+    other_ns = _mkpod("e", labels={"app": "db"}, ns="other", node="node-a")
+    pod = _mkpod("p", affinity=_aff(zone_sel=SEL(app="db")))
+    assert feasible_set(pod, nodes, [other_ns]) == set(), \
+        "matches in another namespace don't count by default"
+    pod2 = _mkpod("p2", affinity=_aff(zone_sel=SEL(app="db"),
+                                      ns=["other"]))
+    assert feasible_set(pod2, nodes, [other_ns]) == {"node-a", "node-b"}

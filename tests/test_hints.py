@@ -118,14 +118,22 @@ def test_inter_pod_affinity_hint():
     db2 = mkpod("db", labels={"app": "cache"})
     assert inter_pod_affinity_hint(anti, db, db2) == QUEUE
     # existing-pod anti-affinity relief: a term-less pending pod requeues
-    # when an anti-affinity-carrying pod departs
-    plain = mkpod("plain")
+    # when a departing pod's anti selector could have matched IT
+    plain = mkpod("plain", labels={"tier": "web"})
     blocker = mkpod("blocker", labels={"x": "y"})
     blocker.spec.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(
         required=[PodAffinityTerm(topology_key=LABEL_HOSTNAME,
                                   label_selector=LabelSelector(
-                                      match_labels={"any": "one"}))]))
+                                      match_labels={"tier": "web"}))]))
     assert inter_pod_affinity_hint(plain, blocker, None) == QUEUE
+    # a departing blocker whose selector could NOT match us is noise
+    unrelated_blocker = mkpod("ub", labels={"x": "y"})
+    unrelated_blocker.spec.affinity = Affinity(
+        pod_anti_affinity=PodAntiAffinity(
+            required=[PodAffinityTerm(topology_key=LABEL_HOSTNAME,
+                                      label_selector=LabelSelector(
+                                          match_labels={"other": "app"}))]))
+    assert inter_pod_affinity_hint(plain, unrelated_blocker, None) == SKIP
     assert inter_pod_affinity_hint(plain, web, None) == SKIP
 
 

@@ -1,0 +1,302 @@
+"""Randomized equivalence suites (SURVEY §7.2 hard part 2):
+
+1. Serial-oracle replay: fuzzed workloads (resources, anti-affinity,
+   affinity, hard spread) run through the device commit scan; a plain-
+   python oracle replays the placements in batch order asserting every
+   commit was feasible AT ITS TURN, no node was ever overcommitted, and
+   every unschedulable verdict had no feasible node.
+2. Auction-vs-scan property: no-topology fuzzed workloads at 1k nodes run
+   through BOTH commit modes; placement counts must match, neither mode
+   may overcommit, and the load balance must agree within tolerance.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.objects import (
+    Affinity,
+    Container,
+    LABEL_HOSTNAME,
+    LABEL_ZONE,
+    LabelSelector,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PodSpec,
+    ResourceRequirements,
+    TopologySpreadConstraint,
+)
+from kubernetes_tpu.api.labels import label_selector_matches
+from kubernetes_tpu.api.resources import pod_request
+from kubernetes_tpu.backend.cache import Cache
+from kubernetes_tpu.backend.mirror import Mirror
+from kubernetes_tpu.backend.snapshot import Snapshot
+from kubernetes_tpu.models.pipeline import default_weights, launch_batch
+from kubernetes_tpu.ops.features import Capacities
+
+WEIGHTS = default_weights()
+
+
+def mknode(i, rng):
+    name = f"node-{i}"
+    return Node(
+        metadata=ObjectMeta(name=name, labels={
+            LABEL_HOSTNAME: name, LABEL_ZONE: f"z{i % 3}"}),
+        spec=NodeSpec(),
+        status=NodeStatus(allocatable={
+            "cpu": f"{rng.choice([2, 4, 8])}",
+            "memory": f"{rng.choice([4, 8, 16])}Gi",
+            "pods": "110"}))
+
+
+def fuzz_pod(i, rng):
+    labels = {}
+    if rng.random() < 0.5:
+        labels["app"] = f"a{rng.randrange(3)}"
+    affinity = None
+    tsc = []
+    r = rng.random()
+    sel = LabelSelector(match_labels={"app": f"a{rng.randrange(3)}"})
+    key = rng.choice([LABEL_HOSTNAME, LABEL_ZONE])
+    if r < 0.15:
+        affinity = Affinity(pod_anti_affinity=PodAntiAffinity(
+            required=[PodAffinityTerm(topology_key=key,
+                                      label_selector=sel)]))
+    elif r < 0.25:
+        affinity = Affinity(pod_affinity=PodAffinity(
+            required=[PodAffinityTerm(topology_key=key,
+                                      label_selector=sel)]))
+    elif r < 0.40:
+        tsc = [TopologySpreadConstraint(
+            max_skew=rng.choice([1, 2]), topology_key=key,
+            when_unsatisfiable="DoNotSchedule", label_selector=sel)]
+    return Pod(
+        metadata=ObjectMeta(name=f"pod-{i}", labels=labels),
+        spec=PodSpec(containers=[Container(
+            name="c", resources=ResourceRequirements(requests={
+                "cpu": f"{rng.choice([100, 250, 500, 1000])}m",
+                "memory": f"{rng.choice([128, 256, 512])}Mi"}))],
+            affinity=affinity,
+            topology_spread_constraints=tsc))
+
+
+# --------------------------- the host oracle ---------------------------
+
+
+def _dom(node, key):
+    return node.metadata.labels.get(key)
+
+
+def _matches_term(term, other: Pod, pending_ns="default"):
+    namespaces = term.namespaces or [pending_ns]
+    if other.metadata.namespace not in namespaces:
+        return False
+    return label_selector_matches(term.label_selector, other.metadata.labels)
+
+
+class Oracle:
+    """Plain-python as-if-serial state: nodes + (existing and committed)
+    pods, with the same filter semantics as the device kernels."""
+
+    def __init__(self, nodes):
+        self.nodes = {n.metadata.name: n for n in nodes}
+        self.free = {}
+        for n in nodes:
+            r = pod_request(Pod())  # zero
+            from kubernetes_tpu.api.resources import Resource
+
+            alloc = Resource.from_map(n.status.allocatable)
+            self.free[n.metadata.name] = [alloc.milli_cpu, alloc.memory]
+        self.placed: dict[str, list[Pod]] = {n.metadata.name: []
+                                             for n in nodes}
+
+    def all_pods(self):
+        for pods in self.placed.values():
+            yield from pods
+
+    def commit(self, pod, node_name):
+        req = pod_request(pod)
+        self.free[node_name][0] -= req.milli_cpu
+        self.free[node_name][1] -= req.memory
+        self.placed[node_name].append(pod)
+
+    def feasible(self, pod, node_name) -> bool:
+        node = self.nodes[node_name]
+        req = pod_request(pod)
+        if req.milli_cpu > self.free[node_name][0] \
+                or req.memory > self.free[node_name][1]:
+            return False
+        aff = pod.spec.affinity
+        # the pod's own required anti-affinity
+        if aff is not None and aff.pod_anti_affinity is not None:
+            for term in aff.pod_anti_affinity.required:
+                d = _dom(node, term.topology_key)
+                if d is None:
+                    continue
+                for other_name, pods in self.placed.items():
+                    if _dom(self.nodes[other_name],
+                            term.topology_key) != d:
+                        continue
+                    if any(_matches_term(term, q) for q in pods):
+                        return False
+        # existing pods' required anti-affinity vs the incoming pod
+        for other_name, pods in self.placed.items():
+            for q in pods:
+                qa = q.spec.affinity
+                if qa is None or qa.pod_anti_affinity is None:
+                    continue
+                for term in qa.pod_anti_affinity.required:
+                    if not _matches_term(term, pod,
+                                         q.metadata.namespace):
+                        continue
+                    dq = _dom(self.nodes[other_name], term.topology_key)
+                    if dq is not None \
+                            and dq == _dom(node, term.topology_key):
+                        return False
+        # required affinity (incl. the first-pod-of-a-group rule)
+        if aff is not None and aff.pod_affinity is not None:
+            terms = aff.pod_affinity.required
+            any_match = any(
+                _matches_term(t, q)
+                for t in terms for q in self.all_pods())
+            per_term_ok = True
+            for term in terms:
+                d = _dom(node, term.topology_key)
+                if d is None:
+                    per_term_ok = False
+                    break
+                found = False
+                for other_name, pods in self.placed.items():
+                    if _dom(self.nodes[other_name],
+                            term.topology_key) != d:
+                        continue
+                    if any(_matches_term(term, q) for q in pods):
+                        found = True
+                        break
+                if not found:
+                    per_term_ok = False
+                    break
+            if not per_term_ok:
+                self_ok = (not any_match
+                           and all(_dom(node, t.topology_key) is not None
+                                   for t in terms)
+                           and all(label_selector_matches(
+                               t.label_selector, pod.metadata.labels)
+                               for t in terms))
+                if not self_ok:
+                    return False
+        # hard topology spread
+        for c in pod.spec.topology_spread_constraints:
+            if c.when_unsatisfiable != "DoNotSchedule":
+                continue
+            d = _dom(node, c.topology_key)
+            if d is None:
+                return False
+            counts: dict[str, int] = {}
+            for other_name in self.nodes:
+                od = _dom(self.nodes[other_name], c.topology_key)
+                if od is None:
+                    continue
+                counts.setdefault(od, 0)
+                counts[od] += sum(
+                    1 for q in self.placed[other_name]
+                    if q.metadata.namespace == pod.metadata.namespace
+                    and label_selector_matches(c.label_selector,
+                                               q.metadata.labels))
+            if not counts:
+                return False
+            min_cnt = min(counts.values())
+            self_match = 1 if label_selector_matches(
+                c.label_selector, pod.metadata.labels) else 0
+            if counts[d] + self_match - min_cnt > c.max_skew:
+                return False
+        return True
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_serial_oracle_replay(seed):
+    rng = random.Random(seed)
+    caps = Capacities(nodes=16, pods=128)
+    nodes = [mknode(i, rng) for i in range(12)]
+    pods = [fuzz_pod(i, rng) for i in range(48)]
+    cache = Cache()
+    for n in nodes:
+        cache.add_node(n)
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    mirror = Mirror(caps=caps)
+    mirror.sync(snap)
+    spec = mirror.prepare_launch(pods, 64)
+    out = launch_batch(spec, mirror.well_known(), WEIGHTS, caps)
+    rows = np.asarray(out.node_row)[: len(pods)].tolist()
+
+    oracle = Oracle(nodes)
+    for pod, row in zip(pods, rows):
+        if row >= 0:
+            name = mirror.name_of_row(row)
+            assert oracle.feasible(pod, name), \
+                f"{pod.metadata.name} placed on infeasible {name}"
+            oracle.commit(pod, name)
+        else:
+            bad = [n for n in oracle.nodes
+                   if oracle.feasible(pod, n)]
+            assert not bad, \
+                f"{pod.metadata.name} unschedulable but {bad} feasible"
+    # no overcommit anywhere
+    for name, (cpu, mem) in oracle.free.items():
+        assert cpu >= 0 and mem >= 0, f"{name} overcommitted"
+
+
+# --------------------- auction vs scan at 1k nodes ---------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_auction_vs_scan_property_1k_nodes(seed):
+    rng = random.Random(100 + seed)
+    caps = Capacities(nodes=1024, pods=256)
+    nodes = [mknode(i, rng) for i in range(1000)]
+    pods = []
+    for i in range(128):
+        p = fuzz_pod(i, rng)
+        p.spec.affinity = None          # no-topology fuzz: auction domain
+        p.spec.topology_spread_constraints = []
+        pods.append(p)
+    cache = Cache()
+    for n in nodes:
+        cache.add_node(n)
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    mirror = Mirror(caps=caps)
+    mirror.sync(snap)
+    spec = mirror.prepare_launch(pods, 128)
+    assert not spec.enable_topology
+
+    results = {}
+    for mode in ("scan", "auction"):
+        out = launch_batch(spec, mirror.well_known(), WEIGHTS, caps,
+                           serial_scan=(mode == "scan"))
+        rows = np.asarray(out.node_row)[: len(pods)].tolist()
+        oracle = Oracle(nodes)
+        for pod, row in zip(pods, rows):
+            if row >= 0:
+                oracle.commit(pod, mirror.name_of_row(row))
+        for name, (cpu, mem) in oracle.free.items():
+            assert cpu >= 0 and mem >= 0, \
+                f"{mode}: {name} overcommitted"
+        placed = [r for r in rows if r >= 0]
+        results[mode] = {
+            "count": len(placed),
+            "per_node": np.bincount(placed, minlength=caps.nodes),
+        }
+    assert results["scan"]["count"] == results["auction"]["count"], \
+        "both commit modes must place the same number of pods"
+    # balance: neither mode may hotspot relative to the other
+    assert abs(int(results["scan"]["per_node"].max())
+               - int(results["auction"]["per_node"].max())) <= 3
