@@ -129,13 +129,6 @@ class CapacityError(Exception):
         self.needed = needed
 
 
-class UnsupportedFeatureError(Exception):
-    """The object uses a construct the device encoding cannot express (e.g.
-    NotIn/Exists label-selector expressions inside affinity terms). The caller
-    must route this pod/plugin through the host fallback path — re-bucketing
-    will not help."""
-
-
 
 class Mirror:
     def __init__(self, interner: Interner | None = None,
@@ -918,13 +911,6 @@ class Mirror:
             self._nominated_req_of_row[row] = req_sum
             self.node_f32[row, off:off + size] = req_sum
             self._dirty_rows.add(row)
-
-    def reserve_batch_slots(self, n: int) -> np.ndarray:
-        """Pod-table slots the batched commit scan will fill on device; host
-        confirms/repacks them on the next sync after binding."""
-        if len(self._free_slots) < n:
-            raise CapacityError("pods", self.caps.pods + n)
-        return np.asarray(self._free_slots[-n:][::-1], np.int32)
 
     # ------------- pod packing -------------
 

@@ -167,7 +167,7 @@ class Evaluator:
         cblobs = mirror.to_blobs()
         kmin = np.asarray(preempt_sweep_jit(
             cblobs, pblobs, mirror.well_known(), cumsum, caps,
-            self._get_enabled_filters()))
+            self._get_enabled_filters(pod)))
         self._kmin = kmin                     # reused by _minimize_victims
         self._victims_by_row = victims_by_row
 
@@ -258,7 +258,7 @@ class Evaluator:
         return np.asarray(preempt_feasible_jit(
             mirror.to_blobs(), pblobs, mirror.well_known(), caps,
             jnp.asarray(tval), jnp.asarray(free), enable,
-            mirror.domain_bucket(), self._get_enabled_filters()))
+            mirror.domain_bucket(), self._get_enabled_filters(pod)))
 
     def _res_row_cached(self, pod: Pod) -> np.ndarray:
         from kubernetes_tpu.api.resources import pod_request

@@ -141,6 +141,29 @@ class Framework:
         on = {name for name, _ in self.points["filter"]}
         return tuple(name in on for name in FILTER_PLUGINS)
 
+    def fit_scoring(self):
+        """(strategy, shape | None) from NodeResourcesFitArgs
+        (apis/config types.go ScoringStrategy: LeastAllocated default,
+        MostAllocated, RequestedToCapacityRatio with shape points
+        {utilization 0..100, score 0..10})."""
+        args = self.profile.plugin_config.get("NodeResourcesFit", {})
+        ss = args.get("scoring_strategy") or {}
+        strategy = ss.get("type", "LeastAllocated")
+        shape = None
+        pts = (ss.get("requested_to_capacity_ratio") or {}).get("shape")
+        if strategy == "RequestedToCapacityRatio":
+            if not pts:
+                raise ValueError(
+                    "NodeResourcesFit scoringStrategy "
+                    "RequestedToCapacityRatio requires a non-empty "
+                    "requested_to_capacity_ratio.shape")
+            pts = sorted(pts, key=lambda p: p["utilization"])
+            shape = (jnp.asarray([p["utilization"] / 100.0 for p in pts],
+                                 jnp.float32),
+                     jnp.asarray([p["score"] * 10.0 for p in pts],
+                                 jnp.float32))
+        return strategy, shape
+
     def score_weights(self) -> ScoreWeights:
         """Dynamic ScoreWeights vector from resolved config weights."""
         w = {name: weight for name, weight in self.points["score"]}

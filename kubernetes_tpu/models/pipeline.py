@@ -188,7 +188,8 @@ def tie_perturb(b, n: int) -> jnp.ndarray:
 
 
 def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
-                   img, unres, weights, free0, nzr0, host_score=None):
+                   img, unres, weights, free0, nzr0, host_score=None,
+                   fit_strategy="LeastAllocated", fit_shape=None):
     """Parallel auction replacing the per-pod commit scan when the batch has
     no topology constraints and no host ports: every round, all unplaced
     pods score+argmax in parallel; per node, pods are accepted in BATCH
@@ -219,7 +220,8 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
     def totals(nzr, feasible):
         def per_pod(nzreq, t_raw, a_raw, im, feas):
             frac = SC.utilization_fractions(alloc2, nzr, nzreq)
-            least = SC.least_allocated_from_fractions(frac)
+            least = SC.fit_score_from_fractions(frac, fit_strategy,
+                                                fit_shape)
             bal = SC.balanced_allocation_from_fractions(frac)
             taint = SC.normalize_inverse(t_raw, feas)
             aff = SC.normalize_max(a_raw, feas)
@@ -294,7 +296,9 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                    rep: jnp.ndarray | None = None,
                    g_cap: int = 0,
                    host_ok: jnp.ndarray | None = None,
-                   host_score: jnp.ndarray | None = None
+                   host_score: jnp.ndarray | None = None,
+                   fit_strategy: str = "LeastAllocated",
+                   fit_shape=None
                    ) -> BatchResult:
     """Schedule a whole pod batch in one launch, as-if-serial (see module
     docstring for the two-phase structure).
@@ -411,7 +415,7 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
             raise ValueError("auction commit requires a no-topology launch")
         return _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw,
                               aff_raw, img, unres, weights, free0, nzr0,
-                              host_score)
+                              host_score, fit_strategy, fit_shape)
     if enable_topology:
         # ---- phase 1b: topology statics per GROUP (representatives) ----
         pods_rep = jax.tree.map(lambda x: x[rep], pods)  # leaves [G, ...]
@@ -692,7 +696,7 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
         ports_ok = ~forbidden
         feasible = ok_s & ports_ok & fit_ok & sp_ok & ipa_ok
         frac = SC.utilization_fractions(alloc2, nzr, nzreq)
-        least = SC.least_allocated_from_fractions(frac)
+        least = SC.fit_score_from_fractions(frac, fit_strategy, fit_shape)
         bal = SC.balanced_allocation_from_fractions(frac)
         taint = SC.normalize_inverse(t_raw, feasible)
         aff = SC.normalize_max(a_raw, feasible)
@@ -775,22 +779,26 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
 
 @partial(jax.jit, static_argnames=("caps", "enable_topology", "d_cap",
                                    "enabled_filters", "serial_scan",
-                                   "active", "pfields", "g_cap"))
+                                   "active", "pfields", "g_cap",
+                                   "fit_strategy"))
 def schedule_batch_jit(cblobs, pblobs, wk, weights, caps,
                        enable_topology=True, d_cap=None,
                        enabled_filters=None, serial_scan=True, state=None,
                        active=None, pfields=None, ptmpl=None,
                        gid=None, rep=None, g_cap=0, host_ok=None,
-                       host_score=None):
+                       host_score=None, fit_strategy="LeastAllocated",
+                       fit_shape=None):
     return schedule_batch(cblobs, pblobs, wk, weights, caps,
                           enable_topology, d_cap, enabled_filters,
                           serial_scan, state, active, pfields, ptmpl,
-                          gid, rep, g_cap, host_ok, host_score)
+                          gid, rep, g_cap, host_ok, host_score,
+                          fit_strategy, fit_shape)
 
 
 def launch_batch(spec, wk, weights, caps, enabled_filters=None,
                  serial_scan=True, state=None, host_ok=None,
-                 host_score=None) -> BatchResult:
+                 host_score=None, fit_strategy="LeastAllocated",
+                 fit_shape=None) -> BatchResult:
     """schedule_batch_jit driven by a Mirror.prepare_launch LaunchSpec."""
     return schedule_batch_jit(
         spec.cblobs, spec.pblobs, wk, weights, caps,
@@ -798,4 +806,5 @@ def launch_batch(spec, wk, weights, caps, enabled_filters=None,
         serial_scan=serial_scan, state=state, active=spec.active,
         pfields=spec.pfields, ptmpl=spec.ptmpl,
         gid=spec.gid, rep=spec.rep, g_cap=spec.g_cap,
-        host_ok=host_ok, host_score=host_score)
+        host_ok=host_ok, host_score=host_score,
+        fit_strategy=fit_strategy, fit_shape=fit_shape)

@@ -49,6 +49,35 @@ def least_allocated_from_fractions(frac: jnp.ndarray) -> jnp.ndarray:
     return jnp.mean(1.0 - frac, axis=-1) * MAX_NODE_SCORE
 
 
+def most_allocated_from_fractions(frac: jnp.ndarray) -> jnp.ndarray:
+    """mean utilization * 100 (most_allocated.go:30): bin-packing bias."""
+    return jnp.mean(frac, axis=-1) * MAX_NODE_SCORE
+
+
+def requested_to_capacity_ratio_from_fractions(
+        frac: jnp.ndarray, shape_x: jnp.ndarray,
+        shape_y: jnp.ndarray) -> jnp.ndarray:
+    """Piecewise-linear utilization -> score per resource, averaged
+    (requested_to_capacity_ratio.go:60 buildRequestedToCapacityRatioScorer):
+    shape_x = utilization fractions 0..1 ascending, shape_y = scores
+    0..100."""
+    per_res = jnp.interp(frac, shape_x, shape_y)
+    return jnp.mean(per_res, axis=-1)
+
+
+def fit_score_from_fractions(frac: jnp.ndarray, strategy: str,
+                             shape) -> jnp.ndarray:
+    """NodeResourcesFit score under the configured ScoringStrategy
+    (apis/config types.go ScoringStrategyType). ``strategy`` is STATIC —
+    the launch compiles exactly one scorer."""
+    if strategy == "MostAllocated":
+        return most_allocated_from_fractions(frac)
+    if strategy == "RequestedToCapacityRatio":
+        return requested_to_capacity_ratio_from_fractions(
+            frac, shape[0], shape[1])
+    return least_allocated_from_fractions(frac)
+
+
 def balanced_allocation_from_fractions(frac: jnp.ndarray) -> jnp.ndarray:
     """(1 - std(fractions)) * 100 (balanced_allocation.go)."""
     mean = jnp.mean(frac, axis=-1, keepdims=True)

@@ -32,7 +32,6 @@ from kubernetes_tpu.backend.cache import Cache
 from kubernetes_tpu.backend.mirror import (
     CapacityError,
     Mirror,
-    UnsupportedFeatureError,
 )
 from kubernetes_tpu.backend.nominator import Nominator
 from kubernetes_tpu.backend.queue import PriorityQueue, QueuedPodInfo
@@ -102,15 +101,27 @@ class Scheduler:
         self.nominator = Nominator()
         self.preemption = Evaluator(
             hub, lambda: self.mirror, lambda: self.caps,
-            lambda: self._enabled_filters, self.nominator)
-        self.framework = Framework(profile, registry=registry, extra_args={
-            "binder": hub.bind,
-            "hub": hub,
-            "preemption_evaluator": self.preemption})
+            self._filters_for, self.nominator)
+        extra = {"binder": hub.bind, "hub": hub,
+                 "preemption_evaluator": self.preemption}
+        # one resolved framework per profile (profile/profile.go:47 Map);
+        # frameworkForPod routes each pod by spec.schedulerName
+        self.frameworks = {
+            p.scheduler_name: Framework(p, registry=registry,
+                                        extra_args=extra)
+            for p in self.config.profiles}
+        self.framework = self.frameworks[profile.scheduler_name]
+        # one shared queue: QueueSort must agree across profiles (the
+        # reference validates this); PreEnqueue gates run through the POD's
+        # profile, queueing-hint registrations merge across profiles
+        merged_hints = {}
+        for fw in self.frameworks.values():
+            merged_hints.update(fw.events_to_register())
         self.queue = PriorityQueue(
             less_fn=self.framework.queue_sort_less,
-            pre_enqueue=self.framework.run_pre_enqueue_plugins,
-            queueing_hints=self.framework.events_to_register(),
+            pre_enqueue=lambda pod: self._fw_for(
+                pod).run_pre_enqueue_plugins(pod),
+            queueing_hints=merged_hints,
             initial_backoff=self.config.pod_initial_backoff_seconds,
             max_backoff=self.config.pod_max_backoff_seconds,
             now=now)
@@ -118,11 +129,19 @@ class Scheduler:
             pending_fn=self.queue.pending_counts)
         self.recorder = AsyncRecorder(now=now)
         self.preemption.metrics = self.metrics
+        # per-profile launch configuration
+        self._profile_cfg = {
+            name: {"filters": fw.enabled_filters(),
+                   "weights": fw.score_weights(),
+                   "fit": fw.fit_scoring()}
+            for name, fw in self.frameworks.items()}
         self._enabled_filters = self.framework.enabled_filters()
-        self._weights = self.framework.score_weights()
-        self._has_host_filters = self.framework.has_host_filters()
-        self._host_volume_only = self.framework.host_filters_volume_gated()
-        self._has_host_scores = self.framework.has_host_scores()
+        self._has_host_filters = any(fw.has_host_filters()
+                                     for fw in self.frameworks.values())
+        self._host_volume_only = all(fw.host_filters_volume_gated()
+                                     for fw in self.frameworks.values())
+        self._has_host_scores = any(fw.has_host_scores()
+                                    for fw in self.frameworks.values())
         # pods popped but deferred to a later batch (host-serial volume
         # conflicts — see _defer_host_conflicts); still in-flight queue-wise
         self._deferred: list[QueuedPodInfo] = []
@@ -258,6 +277,23 @@ class Scheduler:
     def _terminal(pod: Pod) -> bool:
         return pod.status.phase in ("Succeeded", "Failed")
 
+    def _filters_for(self, pod: Pod | None = None) -> tuple[bool, ...]:
+        """Enabled device-filter slots for the pod's profile (the
+        preemption dry-run must see the same filter set the pod's own
+        scheduling cycle uses)."""
+        if pod is not None:
+            cfg = self._profile_cfg.get(pod.spec.scheduler_name)
+            if cfg is not None:
+                return cfg["filters"]
+        return self._enabled_filters
+
+    def _fw_for(self, pod: Pod) -> Framework:
+        """frameworkForPod (schedule_one.go:371): by spec.schedulerName."""
+        return self.frameworks.get(pod.spec.scheduler_name, self.framework)
+
+    def _ours(self, pod: Pod) -> bool:
+        return pod.spec.scheduler_name in self.frameworks
+
     def _on_pod_add(self, pod: Pod) -> None:
         if self._pod_event_stale(pod):
             return
@@ -267,9 +303,10 @@ class Scheduler:
             self.cache.add_pod(pod)
             self.queue.move_all_to_active_or_backoff(
                 ClusterEvent(R.ASSIGNED_POD, A.ADD), None, pod)
-        elif not self._terminal(pod):
-            # restart/replay: re-seed nominations from status so reservations
-            # survive a scheduler restart (stateless-by-design, SURVEY §5.4)
+        elif not self._terminal(pod) and self._ours(pod):
+            # foreign schedulerName pods are another scheduler's business
+            # (schedule_one.go:371); restart/replay: re-seed nominations
+            # from status so reservations survive a scheduler restart
             if pod.status.nominated_node_name:
                 self.nominator.add(pod, pod.status.nominated_node_name)
             self.queue.add(pod)
@@ -294,7 +331,7 @@ class Scheduler:
                 self.queue.delete(new)
                 self.queue.move_all_to_active_or_backoff(
                     ClusterEvent(R.ASSIGNED_POD, A.ADD), old, new)
-        elif not self._terminal(new):
+        elif not self._terminal(new) and self._ours(new):
             self.nominator.update(new)
             self.queue.update(old, new)
 
@@ -309,10 +346,14 @@ class Scheduler:
             self._pod_rv.pop(self._rv_tombstones.popleft(), None)
         # a pod parked at Permit WAIT holds an assumed reservation: free it
         # now (the reference rejects waiting pods from the delete handler)
-        wp = self.framework.waiting_pods.remove(uid)
+        wp = None
+        for fw in self.frameworks.values():
+            wp = fw.waiting_pods.remove(uid)
+            if wp is not None:
+                break
         if wp is not None:
-            self.framework.run_unreserve_plugins(wp.state, wp.qp.pod,
-                                                 wp.node_name)
+            self._fw_for(wp.qp.pod).run_unreserve_plugins(
+                wp.state, wp.qp.pod, wp.node_name)
             assumed = wp.qp.pod.clone()
             assumed.spec.node_name = wp.node_name
             self.cache.forget_pod(assumed)
@@ -396,6 +437,20 @@ class Scheduler:
         the previous batch's placements."""
         t_cycle0 = self.now()
         epoch = self._chain_epoch
+        if len(self.frameworks) > 1:
+            # one profile per launch: enabled filters / weights / scoring
+            # strategy are per-profile launch configuration
+            prof = runnable[0].pod.spec.scheduler_name
+            same = [qp for qp in runnable
+                    if qp.pod.spec.scheduler_name == prof]
+            if len(same) != len(runnable):
+                self._deferred.extend(
+                    qp for qp in runnable
+                    if qp.pod.spec.scheduler_name != prof)
+                runnable = same
+        else:
+            prof = self._profile_name
+        pcfg = self._profile_cfg[prof]
         if self._has_host_filters:
             runnable = self._defer_host_conflicts(runnable)
             if not runnable:
@@ -420,10 +475,6 @@ class Scheduler:
                 self._grow(e)          # invalidates the chain
                 state = None
                 need_sync = True
-            except UnsupportedFeatureError:
-                runnable = self._split_unsupported(runnable)
-                if not runnable:
-                    return None
         else:
             raise RuntimeError("mirror re-bucketing did not converge")
 
@@ -435,15 +486,17 @@ class Scheduler:
         use_auction = (not spec.enable_topology
                        and not self.mirror.batch_has_host_ports(
                            [qp.pod for qp in runnable])
-                       and self._enabled_filters[FILTER_PLUGINS.index(
+                       and pcfg["filters"][FILTER_PLUGINS.index(
                            "NodeResourcesFit")])
         host_ok = host_score = None
         if self._has_host_filters or self._has_host_scores:
             host_ok, host_score = self._run_host_plugins(runnable)
+        fit_strategy, fit_shape = pcfg["fit"]
         out: BatchResult = launch_batch(
-            spec, self.mirror.well_known(), self._weights, self.caps,
-            self._enabled_filters, serial_scan=not use_auction, state=state,
-            host_ok=host_ok, host_score=host_score)
+            spec, self.mirror.well_known(), pcfg["weights"], self.caps,
+            pcfg["filters"], serial_scan=not use_auction, state=state,
+            host_ok=host_ok, host_score=host_score,
+            fit_strategy=fit_strategy, fit_shape=fit_shape)
         # the chain advances to this launch's post-batch state UNLESS an
         # invalidation raced in while we were packing (epoch check); later
         # external events reset it via the handlers
@@ -506,8 +559,8 @@ class Scheduler:
         for i, qp in relevant:
             qp.host_reject_counts = {}
             state = CycleState()
-            mask, counts, early = self.framework.run_host_filters(
-                state, qp.pod, infos)
+            fw = self._fw_for(qp.pod)
+            mask, counts, early = fw.run_host_filters(state, qp.pod, infos)
             if counts:
                 qp.host_reject_counts = counts
             if early is not None:
@@ -521,7 +574,7 @@ class Scheduler:
                 r = node_rows()
                 bad = r[~np.asarray(mask, bool)]
                 host_ok[i, bad[bad >= 0]] = False
-            scores = (self.framework.run_host_scores(state, qp.pod, infos)
+            scores = (fw.run_host_scores(state, qp.pod, infos)
                       if self._has_host_scores else None)
             if scores is not None:
                 if host_score is None:
@@ -591,20 +644,6 @@ class Scheduler:
             self._process_deferred_events()
             return popped
 
-    def _split_unsupported(self, runnable):
-        """A pod uses a construct the device encoding can't express: route it
-        to the failure path, keep the rest."""
-        ok = []
-        for qp in runnable:
-            try:
-                self.mirror.pack_pod(qp.pod)
-                ok.append(qp)
-            except UnsupportedFeatureError as e:
-                self._error(qp, str(e))
-            except CapacityError:
-                ok.append(qp)  # handled by the caller's _grow loop
-        return ok
-
     def _commit(self, qp: QueuedPodInfo, node_name: str) -> None:
         """assume -> reserve -> permit (schedule_one.go:142); the binding
         cycle (prebind/bind) then runs on the binder pool
@@ -616,7 +655,7 @@ class Scheduler:
         assumed.spec.node_name = node_name
         self.cache.assume_pod(assumed)
         state = CycleState()
-        fw = self.framework
+        fw = self._fw_for(pod)
         # binding a pod with (anti)affinity terms makes the mirror's pod
         # table stale: the chain must not skip the sync that packs it
         if self.mirror.batch_has_topology([pod]):
@@ -647,7 +686,7 @@ class Scheduler:
         with plugin attribution when a plugin REJECTED the pod (permit
         reject/timeout goes through handleSchedulingFailure as
         Unschedulable, schedule_one.go:270)."""
-        self.framework.run_unreserve_plugins(state, qp.pod, node_name)
+        self._fw_for(qp.pod).run_unreserve_plugins(state, qp.pod, node_name)
         self.cache.forget_pod(assumed)
         # the device chain assumed this placement; force a re-sync
         self._invalidate_chain()
@@ -664,7 +703,7 @@ class Scheduler:
             self._error(qp, msg)
 
     def _bind_task(self, state: CycleState, pod: Pod, node_name: str):
-        fw = self.framework
+        fw = self._fw_for(pod)
         t0 = time.monotonic()
         try:
             s = fw.run_pre_bind_plugins(state, pod, node_name)
@@ -733,18 +772,23 @@ class Scheduler:
         self.cache.finish_binding(assumed)
         self.nominator.delete(qp.uid)
         self.queue.done(qp.uid)
-        self.framework.run_post_bind_plugins(state, qp.pod, node_name)
+        self._fw_for(qp.pod).run_post_bind_plugins(state, qp.pod, node_name)
         qp.consecutive_errors_count = 0
         self.stats["scheduled"] += 1
-        self.metrics.schedule_attempts.inc(result="scheduled",
-                                           profile=self._profile_name)
+        self.metrics.schedule_attempts.inc(
+            result="scheduled", profile=qp.pod.spec.scheduler_name)
         self.metrics.pod_scheduling_attempts.observe(qp.attempts)
 
     def _process_waiting(self) -> None:
         """Harvest the waitingPodsMap: fully-allowed pods proceed to the
         binding cycle; rejected/timed-out pods unreserve and requeue
         (waiting_pods_map.go semantics)."""
-        ready, failed = self.framework.waiting_pods.harvest(self.now())
+        ready: list = []
+        failed: list = []
+        for fw in self.frameworks.values():
+            r, f = fw.waiting_pods.harvest(self.now())
+            ready.extend(r)
+            failed.extend(f)
         for wp in ready:
             assumed = wp.qp.pod.clone()
             assumed.spec.node_name = wp.node_name
@@ -767,17 +811,17 @@ class Scheduler:
         qp.unschedulable_count += 1
         qp.consecutive_errors_count = 0
         self.stats["unschedulable"] += 1
-        self.metrics.schedule_attempts.inc(result="unschedulable",
-                                           profile=self._profile_name)
+        self.metrics.schedule_attempts.inc(
+            result="unschedulable", profile=qp.pod.spec.scheduler_name)
         nominated = None
-        if self.framework.points["post_filter"]:
+        if self._fw_for(qp.pod).points["post_filter"]:
             # chained launches skip the per-batch sync; the preemption
             # dry-run reads the host snapshot + mirror, so refresh them
             # (O(1) when already clean)
             self.cache.update_snapshot(self.snapshot)
             self.mirror.sync(self.snapshot)
             state = CycleState()
-            nominated, _s = self.framework.run_post_filter_plugins(
+            nominated, _s = self._fw_for(qp.pod).run_post_filter_plugins(
                 state, qp.pod, {"snapshot": self.snapshot,
                                 "reject_counts": reject_counts})
             if nominated:
@@ -801,8 +845,8 @@ class Scheduler:
         qp.consecutive_errors_count += 1
         qp.unschedulable_plugins = set()
         self.stats["errors"] += 1
-        self.metrics.schedule_attempts.inc(result="error",
-                                           profile=self._profile_name)
+        self.metrics.schedule_attempts.inc(
+            result="error", profile=qp.pod.spec.scheduler_name)
         self.hub.patch_pod_condition(qp.pod, PodCondition(
             type="PodScheduled", status="False", reason="SchedulerError",
             message=msg))

@@ -1,0 +1,175 @@
+"""NodeResourcesFit scoring strategies + multi-profile routing
+(reference: most_allocated.go, requested_to_capacity_ratio.go,
+profile/profile.go:47-66 frameworkForPod)."""
+
+from kubernetes_tpu.api.objects import (
+    Container,
+    LABEL_HOSTNAME,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResourceRequirements,
+)
+from kubernetes_tpu.config.types import (
+    SchedulerProfile,
+    default_config,
+    default_plugins,
+)
+from kubernetes_tpu.hub import Hub
+from kubernetes_tpu.ops.features import Capacities
+from kubernetes_tpu.scheduler import Scheduler
+
+
+def mknode(name, cpu="10"):
+    return Node(metadata=ObjectMeta(name=name,
+                                    labels={LABEL_HOSTNAME: name}),
+                status=NodeStatus(allocatable={"cpu": cpu,
+                                               "memory": "32Gi",
+                                               "pods": "110"}))
+
+
+def mkpod(name, cpu="1", scheduler=None):
+    spec = PodSpec(containers=[Container(
+        name="c", resources=ResourceRequirements(
+            requests={"cpu": cpu, "memory": "1Gi"}))])
+    if scheduler:
+        spec.scheduler_name = scheduler
+    return Pod(metadata=ObjectMeta(name=name), spec=spec)
+
+
+def mksched(hub, cfg=None):
+    cfg = cfg or default_config()
+    cfg.batch_size = 16
+    return Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64))
+
+
+def _fit_only(cfg):
+    """Score only by NodeResourcesFit so the strategy decides the node."""
+    from kubernetes_tpu.config.types import Plugin, PluginSet
+
+    cfg.profiles[0].plugins.score = PluginSet(disabled=[
+        Plugin("TaintToleration"), Plugin("NodeAffinity"),
+        Plugin("NodeResourcesBalancedAllocation"), Plugin("ImageLocality")])
+
+
+def test_least_allocated_default_prefers_empty_node():
+    hub = Hub()
+    cfg = default_config()
+    _fit_only(cfg)
+    sched = mksched(hub, cfg)
+    hub.create_node(mknode("busy"))
+    hub.create_node(mknode("idle"))
+    filler = mkpod("filler", cpu="6")
+    hub.create_pod(filler)
+    sched.run_until_idle()
+    busy_node = hub.get_pod(filler.metadata.uid).spec.node_name
+    p = mkpod("p")
+    hub.create_pod(p)
+    sched.run_until_idle()
+    assert hub.get_pod(p.metadata.uid).spec.node_name != busy_node
+
+
+def test_most_allocated_prefers_packed_node():
+    hub = Hub()
+    cfg = default_config()
+    _fit_only(cfg)
+    cfg.profiles[0].plugin_config["NodeResourcesFit"] = {
+        "scoring_strategy": {"type": "MostAllocated"}}
+    sched = mksched(hub, cfg)
+    hub.create_node(mknode("busy"))
+    hub.create_node(mknode("idle"))
+    filler = mkpod("filler", cpu="6")
+    hub.create_pod(filler)
+    sched.run_until_idle()
+    busy_node = hub.get_pod(filler.metadata.uid).spec.node_name
+    p = mkpod("p")
+    hub.create_pod(p)
+    sched.run_until_idle()
+    assert hub.get_pod(p.metadata.uid).spec.node_name == busy_node, \
+        "MostAllocated bin-packs onto the busy node"
+
+
+def test_requested_to_capacity_ratio_shape():
+    """A bin-packing shape (score rises with utilization) behaves like
+    MostAllocated; requested_to_capacity_ratio.go:60."""
+    hub = Hub()
+    cfg = default_config()
+    _fit_only(cfg)
+    cfg.profiles[0].plugin_config["NodeResourcesFit"] = {
+        "scoring_strategy": {
+            "type": "RequestedToCapacityRatio",
+            "requested_to_capacity_ratio": {"shape": [
+                {"utilization": 0, "score": 0},
+                {"utilization": 100, "score": 10},
+            ]}}}
+    sched = mksched(hub, cfg)
+    hub.create_node(mknode("busy"))
+    hub.create_node(mknode("idle"))
+    filler = mkpod("filler", cpu="6")
+    hub.create_pod(filler)
+    sched.run_until_idle()
+    busy_node = hub.get_pod(filler.metadata.uid).spec.node_name
+    p = mkpod("p")
+    hub.create_pod(p)
+    sched.run_until_idle()
+    assert hub.get_pod(p.metadata.uid).spec.node_name == busy_node
+
+
+def test_multi_profile_routing_and_foreign_pods_skipped():
+    hub = Hub()
+    cfg = default_config()
+    # second profile: bin-packing flavor under its own name
+    packy = SchedulerProfile(scheduler_name="packy",
+                             plugins=default_plugins())
+    packy.plugin_config["NodeResourcesFit"] = {
+        "scoring_strategy": {"type": "MostAllocated"}}
+    cfg.profiles.append(packy)
+    sched = mksched(hub, cfg)
+    hub.create_node(mknode("n0"))
+    hub.create_node(mknode("n1"))
+    ours = mkpod("ours")
+    theirs = mkpod("theirs", scheduler="packy")
+    foreign = mkpod("foreign", scheduler="somebody-else")
+    for p in (ours, theirs, foreign):
+        hub.create_pod(p)
+    sched.run_until_idle()
+    assert hub.get_pod(ours.metadata.uid).spec.node_name
+    assert hub.get_pod(theirs.metadata.uid).spec.node_name
+    assert hub.get_pod(foreign.metadata.uid).spec.node_name == "", \
+        "a foreign schedulerName pod is another scheduler's business"
+    assert sched.stats["scheduled"] == 2
+    assert len(sched.queue) == 0, "foreign pod never enqueued"
+
+
+def test_two_profiles_different_strategies_in_one_drain():
+    """default (LeastAllocated) spreads; packy (MostAllocated) packs —
+    both served from one queue, one launch per profile per batch."""
+    hub = Hub()
+    cfg = default_config()
+    _fit_only(cfg)
+    packy = SchedulerProfile(scheduler_name="packy",
+                             plugins=default_plugins())
+    packy.plugin_config["NodeResourcesFit"] = {
+        "scoring_strategy": {"type": "MostAllocated"}}
+    from kubernetes_tpu.config.types import Plugin, PluginSet
+
+    packy.plugins.score = PluginSet(disabled=[
+        Plugin("TaintToleration"), Plugin("NodeAffinity"),
+        Plugin("NodeResourcesBalancedAllocation"), Plugin("ImageLocality")])
+    cfg.profiles.append(packy)
+    sched = mksched(hub, cfg)
+    hub.create_node(mknode("busy"))
+    hub.create_node(mknode("idle"))
+    filler = mkpod("filler", cpu="6")
+    hub.create_pod(filler)
+    sched.run_until_idle()
+    busy_node = hub.get_pod(filler.metadata.uid).spec.node_name
+    spread_pod = mkpod("spread-me")
+    pack_pod = mkpod("pack-me", scheduler="packy")
+    hub.create_pod(spread_pod)
+    hub.create_pod(pack_pod)
+    sched.run_until_idle()
+    assert hub.get_pod(spread_pod.metadata.uid).spec.node_name != busy_node
+    assert hub.get_pod(pack_pod.metadata.uid).spec.node_name == busy_node
