@@ -264,7 +264,8 @@ class PodSpec:
     preemption_policy: str = "PreemptLowerPriority"  # or "Never"
     scheduling_gates: list[PodSchedulingGate] = field(default_factory=list)
     host_network: bool = False
-    volumes: list = field(default_factory=list)  # volume plugins: round 2
+    volumes: list = field(default_factory=list)
+    resource_claims: list = field(default_factory=list)  # PodResourceClaim
 
 
 @dataclass
@@ -506,6 +507,90 @@ class StorageClass:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     provisioner: str = ""
     volume_binding_mode: str = VOLUME_BINDING_IMMEDIATE
+
+
+# --- dynamic resource allocation (resource.k8s.io slices/claims) ----------------------
+
+
+@dataclass
+class PodResourceClaim:
+    """pod.spec.resourceClaims entry: a named reference to a
+    ResourceClaim the containers can then request by name."""
+
+    name: str
+    resource_claim_name: str = ""
+
+
+@dataclass
+class DeviceRequest:
+    """resourceclaim.spec.devices.requests entry (exactly-count mode)."""
+
+    name: str
+    device_class_name: str = ""
+    count: int = 1
+
+
+@dataclass
+class DeviceAllocationResult:
+    request: str = ""
+    driver: str = ""
+    pool: str = ""
+    device: str = ""
+
+
+@dataclass
+class AllocationResult:
+    node_name: str = ""
+    devices: list[DeviceAllocationResult] = field(default_factory=list)
+
+
+@dataclass
+class ResourceClaimStatus:
+    allocation: Optional[AllocationResult] = None
+    reserved_for: list[str] = field(default_factory=list)   # pod uids
+
+
+@dataclass
+class ResourceClaimSpec:
+    device_requests: list[DeviceRequest] = field(default_factory=list)
+
+
+@dataclass
+class ResourceClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceClaimSpec = field(default_factory=ResourceClaimSpec)
+    status: ResourceClaimStatus = field(default_factory=ResourceClaimStatus)
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def clone(self) -> "ResourceClaim":
+        import copy
+
+        return copy.deepcopy(self)
+
+
+@dataclass
+class Device:
+    name: str
+    device_class_name: str = ""
+
+
+@dataclass
+class ResourceSlice:
+    """resource.k8s.io ResourceSlice: one driver's device inventory on one
+    node (the publication a DRA driver makes)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    node_name: str = ""
+    driver: str = ""
+    pool: str = ""
+    devices: list[Device] = field(default_factory=list)
+
+
+@dataclass
+class DeviceClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
 
 
 # --- priority class ------------------------------------------------------------------

@@ -113,6 +113,7 @@ DEFAULT_MULTI_POINT = (
     ("NodeVolumeLimits", 0),
     ("VolumeBinding", 0),
     ("VolumeZone", 0),
+    ("DynamicResources", 0),
     ("PodTopologySpread", 2),
     ("InterPodAffinity", 2),
     ("DefaultPreemption", 0),

@@ -190,13 +190,17 @@ class Framework:
             return True
         return False
 
-    def host_filters_volume_gated(self) -> bool:
-        """True when every host filter declares VOLUME_GATED — the
-        scheduler then skips the per-pod host pass for pods without
-        spec.volumes (the default profile's host set is the volume family,
-        so plain workloads pay nothing)."""
-        return all(getattr(pl, "VOLUME_GATED", False)
-                   for pl in self._iter("filter", FilterPlugin))
+    def host_gates(self):
+        """Per-plugin fast relevance probes (``applies(pod)``). When every
+        host filter declares one, the scheduler skips the whole host pass
+        for pods none of them applies to — the default host set (volumes,
+        device claims) costs plain workloads nothing. None = some plugin
+        has no probe, so every pod must run the host pass."""
+        gates = [getattr(pl, "applies", None)
+                 for pl in self._iter("filter", FilterPlugin)]
+        if any(g is None for g in gates):
+            return None
+        return gates
 
     def has_host_scores(self) -> bool:
         return any(isinstance(self._instances.get(name), ScorePlugin)

@@ -27,6 +27,8 @@ from kubernetes_tpu.api.objects import (
     PodCondition,
     PodDisruptionBudget,
     PriorityClass,
+    ResourceClaim,
+    ResourceSlice,
     StorageClass,
 )
 
@@ -71,6 +73,9 @@ class Hub:
         self._pv_by_name: dict[str, str] = {}   # name -> uid
         self._sc_by_name: dict[str, str] = {}
         self._node_by_name: dict[str, str] = {}
+        self._claims = _Store("ResourceClaim")
+        self._slices = _Store("ResourceSlice")
+        self._claim_by_key: dict[str, str] = {}
 
     # ------------- watch registration -------------
 
@@ -336,6 +341,59 @@ class Hub:
         with self._lock:
             uid = self._sc_by_name.get(name)
             return self._storage_classes.objects.get(uid) if uid else None
+
+    # ------------- dynamic resource allocation -------------
+
+    def watch_resource_claims(self, h: EventHandlers,
+                              replay: bool = True) -> None:
+        with self._lock:
+            self._claims.handlers.append(h)
+            if replay and h.on_add:
+                for o in list(self._claims.objects.values()):
+                    h.on_add(o)
+
+    def create_resource_claim(self, claim: ResourceClaim) -> None:
+        with self._lock:
+            self._claim_by_key[claim.key()] = claim.metadata.uid
+        self._create(self._claims, claim)
+
+    def update_resource_claim(self, claim: ResourceClaim) -> None:
+        self._update(self._claims, claim)
+
+    def delete_resource_claim(self, uid: str) -> None:
+        with self._lock:
+            old = self._claims.objects.get(uid)
+            if old is not None:
+                self._claim_by_key.pop(old.key(), None)
+        self._delete(self._claims, uid)
+
+    def get_resource_claim(self, namespace: str, name: str
+                           ) -> Optional[ResourceClaim]:
+        with self._lock:
+            uid = self._claim_by_key.get(f"{namespace}/{name}")
+            return self._claims.objects.get(uid) if uid else None
+
+    def list_resource_claims(self) -> list[ResourceClaim]:
+        with self._lock:
+            return list(self._claims.objects.values())
+
+    def watch_resource_slices(self, h: EventHandlers,
+                              replay: bool = True) -> None:
+        with self._lock:
+            self._slices.handlers.append(h)
+            if replay and h.on_add:
+                for o in list(self._slices.objects.values()):
+                    h.on_add(o)
+
+    def create_resource_slice(self, sl: ResourceSlice) -> None:
+        self._create(self._slices, sl)
+
+    def delete_resource_slice(self, uid: str) -> None:
+        self._delete(self._slices, uid)
+
+    def list_resource_slices(self) -> list[ResourceSlice]:
+        with self._lock:
+            return list(self._slices.objects.values())
 
     # ------------- priority classes -------------
 

@@ -1,0 +1,287 @@
+"""DynamicResources: the DRA scheduler plugin, TPU-native host edition.
+
+From-scratch equivalent of the reference's accelerator-scheduling path
+(plugins/dynamicresources/dynamicresources.go:105-888 + the structured
+allocator): pods reference ResourceClaims; DRA drivers publish per-node
+device inventories as ResourceSlices; the plugin
+
+- PreFilter: resolve the pod's claims (missing claim => unresolvable;
+  no claims => Skip), build the free-device view per node from every
+  other claim's allocation (API truth + the assume overlay),
+- Filter: a node fits iff every unallocated claim can be satisfied from
+  that node's remaining devices, and every ALLOCATED claim is pinned to
+  its allocation's node,
+- Reserve: pick concrete devices on the chosen node and ASSUME the
+  allocation (assume overlay — the scheduler-side AssumeCache the
+  reference keeps for claims), Unreserve reverts,
+- PreBind: write the allocation + reservedFor to the API (hub).
+
+Restart safety is API-truth-based like everything else in this build: a
+restarted scheduler rebuilds its view from claim statuses, so allocations
+survive replay and allocated devices never double-book.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kubernetes_tpu.api.objects import (
+    AllocationResult,
+    DeviceAllocationResult,
+    Pod,
+    ResourceClaim,
+)
+from kubernetes_tpu.framework.interface import (
+    FilterPlugin,
+    PreBindPlugin,
+    PreFilterPlugin,
+    ReservePlugin,
+    Status,
+)
+
+
+def dra_serial_keys(hub, pod: Pod) -> set[str]:
+    """Host-serial conflict domains: two pods whose unallocated claims
+    could compete for the same driver's devices must not share a batch
+    (the first one's assume changes the second one's free-device view)."""
+    keys: set[str] = set()
+    for ref in pod.spec.resource_claims:
+        claim = hub.get_resource_claim(pod.metadata.namespace,
+                                       ref.resource_claim_name)
+        if claim is None:
+            continue
+        if claim.status.allocation is None:
+            for req in claim.spec.device_requests:
+                keys.add(f"draclass:{req.device_class_name}")
+        keys.add(f"draclaim:{claim.key()}")
+    return keys
+
+
+def release_pod_claims(hub, pod: Pod) -> None:
+    """The slice of the reference's resourceclaim controller the scheduler
+    build needs: a deleted pod leaves its claims' reservedFor; a claim with
+    no consumers left is DEALLOCATED so its devices return to the pool
+    (the claim update event requeues waiting DRA pods)."""
+    for ref in pod.spec.resource_claims:
+        claim = hub.get_resource_claim(pod.metadata.namespace,
+                                       ref.resource_claim_name)
+        if claim is None \
+                or pod.metadata.uid not in claim.status.reserved_for:
+            continue
+        new = claim.clone()
+        new.status.reserved_for.remove(pod.metadata.uid)
+        if not new.status.reserved_for:
+            new.status.allocation = None
+        hub.update_resource_claim(new)
+
+
+@dataclass
+class ClaimAssumeCache:
+    """Assumed claim allocations ahead of the API write."""
+
+    allocations: dict[str, ResourceClaim] = field(default_factory=dict)
+
+    def assume(self, claim: ResourceClaim) -> None:
+        self.allocations[claim.key()] = claim
+
+    def restore(self, key: str) -> None:
+        self.allocations.pop(key, None)
+
+    def get(self, key: str) -> Optional[ResourceClaim]:
+        return self.allocations.get(key)
+
+
+class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin,
+                       PreBindPlugin):
+    NAME = "DynamicResources"
+    STATE_KEY = "DynamicResources/claims"
+    ASSUMED_KEY = "DynamicResources/assumed"
+
+    def __init__(self, hub):
+        self.hub = hub
+        self.assume = ClaimAssumeCache()
+
+    @staticmethod
+    def applies(pod: Pod) -> bool:
+        return bool(pod.spec.resource_claims)
+
+    # --- views through the assume overlay ---
+
+    def _claim(self, ns: str, name: str) -> Optional[ResourceClaim]:
+        c = self.hub.get_resource_claim(ns, name)
+        if c is None:
+            return None
+        assumed = self.assume.get(c.key())
+        return assumed if assumed is not None else c
+
+    def _pod_claims(self, pod: Pod):
+        for ref in pod.spec.resource_claims:
+            yield ref, self._claim(pod.metadata.namespace,
+                                   ref.resource_claim_name)
+
+    def _used_devices(self, exclude_keys: set[str]) -> set[tuple]:
+        """(driver, pool, device) triples allocated by ANY claim (API truth
+        overlaid with assumed allocations), except the excluded claims."""
+        used: set[tuple] = set()
+        seen: set[str] = set()
+        for claim in list(self.assume.allocations.values()) \
+                + self.hub.list_resource_claims():
+            if claim.key() in seen:
+                continue
+            seen.add(claim.key())
+            if claim.key() in exclude_keys:
+                continue
+            alloc = claim.status.allocation
+            if alloc is None:
+                continue
+            for d in alloc.devices:
+                used.add((d.driver, d.pool, d.device))
+        return used
+
+    def _free_by_node(self, exclude_keys: set[str]) -> dict[str, list]:
+        """node -> [(driver, pool, device, device_class)] still free."""
+        used = self._used_devices(exclude_keys)
+        free: dict[str, list] = {}
+        for sl in self.hub.list_resource_slices():
+            for dev in sl.devices:
+                key = (sl.driver, sl.pool, dev.name)
+                if key in used:
+                    continue
+                free.setdefault(sl.node_name, []).append(
+                    (sl.driver, sl.pool, dev.name, dev.device_class_name))
+        return free
+
+    @staticmethod
+    def _satisfiable(claim: ResourceClaim, free_devs: list) -> bool:
+        pool = list(free_devs)
+        for req in claim.spec.device_requests:
+            need = req.count
+            for i in range(len(pool) - 1, -1, -1):
+                if need == 0:
+                    break
+                if pool[i][3] == req.device_class_name:
+                    pool.pop(i)
+                    need -= 1
+            if need > 0:
+                return False
+        return True
+
+    # --- extension points ---
+
+    def pre_filter(self, state, pod: Pod, nodes) -> Status:
+        if not pod.spec.resource_claims:
+            return Status.skip()
+        claims = []
+        for ref, claim in self._pod_claims(pod):
+            if claim is None:
+                return Status.unschedulable(
+                    f'resourceclaim "{ref.resource_claim_name}" not found',
+                    plugin=self.NAME, resolvable=False)
+            claims.append(claim)
+        state.write(self.STATE_KEY, claims)
+        # exclude only the pod's UNALLOCATED claims: an allocated claim's
+        # devices are taken no matter who reads the view (excluding it
+        # would let a sibling claim double-book them)
+        exclude = {c.key() for c in claims
+                   if c.status.allocation is None}
+        state.write(self.STATE_KEY + "/free", self._free_by_node(exclude))
+        return Status()
+
+    def filter(self, state, pod: Pod, node_info) -> Status:
+        claims = state.read(self.STATE_KEY) or []
+        free = state.read(self.STATE_KEY + "/free") or {}
+        node_name = node_info.node.metadata.name
+        for claim in claims:
+            alloc = claim.status.allocation
+            if alloc is not None:
+                if alloc.node_name and alloc.node_name != node_name:
+                    return Status.unschedulable(
+                        "claim already allocated on another node",
+                        plugin=self.NAME)
+                continue
+            if not self._satisfiable(claim, free.get(node_name, [])):
+                return Status.unschedulable(
+                    "cannot allocate all claims", plugin=self.NAME)
+        return Status()
+
+    def reserve(self, state, pod: Pod, node_name: str) -> Status:
+        assumed_keys = []
+        claims = []
+        for ref, c in self._pod_claims(pod):
+            if c is None:
+                return Status.unschedulable(
+                    f'resourceclaim "{ref.resource_claim_name}" '
+                    "disappeared", plugin=self.NAME)
+            claims.append(c)
+        exclude = {c.key() for c in claims
+                   if c.status.allocation is None}
+        free = self._free_by_node(exclude).get(node_name, [])
+        for claim in claims:
+            if claim.status.allocation is not None:
+                # already allocated: record this pod as a consumer
+                if pod.metadata.uid not in claim.status.reserved_for:
+                    new = claim.clone()
+                    new.status.reserved_for.append(pod.metadata.uid)
+                    self.assume.assume(new)
+                    assumed_keys.append(new.key())
+                continue
+            picked: list[DeviceAllocationResult] = []
+            pool = list(free)
+            ok = True
+            for req in claim.spec.device_requests:
+                for _ in range(req.count):
+                    idx = next((i for i, d in enumerate(pool)
+                                if d[3] == req.device_class_name), None)
+                    if idx is None:
+                        ok = False
+                        break
+                    drv, pl, dev, _cls = pool.pop(idx)
+                    picked.append(DeviceAllocationResult(
+                        request=req.name, driver=drv, pool=pl, device=dev))
+                if not ok:
+                    break
+            if not ok:
+                for k in assumed_keys:
+                    self.assume.restore(k)
+                return Status.unschedulable(
+                    "devices vanished before reserve", plugin=self.NAME)
+            free = pool
+            new = claim.clone()
+            new.status.allocation = AllocationResult(
+                node_name=node_name, devices=picked)
+            if pod.metadata.uid not in new.status.reserved_for:
+                new.status.reserved_for.append(pod.metadata.uid)
+            self.assume.assume(new)
+            assumed_keys.append(new.key())
+        state.write(self.ASSUMED_KEY, assumed_keys)
+        return Status()
+
+    def unreserve(self, state, pod: Pod, node_name: str) -> None:
+        for key in state.read(self.ASSUMED_KEY) or []:
+            self.assume.restore(key)
+
+    def pre_bind(self, state, pod: Pod, node_name: str) -> Status:
+        for key in state.read(self.ASSUMED_KEY) or []:
+            assumed = self.assume.get(key)
+            if assumed is None:
+                continue
+            ns, name = key.split("/", 1)
+            stored = self.hub.get_resource_claim(ns, name)
+            if stored is None:
+                return Status.error(f"resourceclaim {key} disappeared",
+                                    plugin=self.NAME)
+            try:
+                new = stored.clone()
+                if assumed.status.allocation is not None:
+                    new.status.allocation = assumed.status.allocation
+                merged = list(new.status.reserved_for)
+                for uid in assumed.status.reserved_for:
+                    if uid not in merged:
+                        merged.append(uid)
+                new.status.reserved_for = merged
+                self.hub.update_resource_claim(new)
+            except Exception as e:  # noqa: BLE001 — surfaced as Status
+                return Status.error(str(e), plugin=self.NAME)
+            self.assume.restore(key)
+        return Status()

@@ -210,6 +210,15 @@ def in_tree_registry() -> dict[str, PluginDescriptor]:
                     _ev(R.PVC, A.ADD),
                     _ev(R.PV, A.ADD)]),
         PluginDescriptor(
+            name="DynamicResources",
+            points=("filter", "reserve", "pre_bind"),
+            factory=_dra_factory,
+            # claims/slices dispatch as WILDCARD events; wildcard matches
+            # node/pod events too, which is the conservative requeue set
+            # the reference uses while DRA hints mature
+            events=[ClusterEventWithHint(event=ClusterEvent(
+                EventResource.WILDCARD, A.ALL, "dra"))]),
+        PluginDescriptor(
             name="VolumeBinding",
             points=("filter", "reserve", "pre_bind"),
             factory=_volume_factory("VolumeBinding"),
@@ -221,6 +230,15 @@ def in_tree_registry() -> dict[str, PluginDescriptor]:
                     _ev(R.ASSIGNED_POD, A.DELETE)]),
     ]
     return {d.name: d for d in descriptors}
+
+
+def _dra_factory(args: dict):
+    hub = args.get("hub")
+    if hub is None:
+        return None
+    from kubernetes_tpu.plugins.dra import DynamicResources
+
+    return DynamicResources(hub)
 
 
 def _volume_factory(name: str):

@@ -115,7 +115,10 @@ class VolumeZone(PreFilterPlugin, FilterPlugin):
     """volume_zone.go:77 (Filter), :191 (PreFilter Skip without PVCs)."""
 
     NAME = "VolumeZone"
-    VOLUME_GATED = True  # irrelevant to pods without spec.volumes
+
+    @staticmethod
+    def applies(pod: Pod) -> bool:
+        return bool(pod.spec.volumes)
 
     def __init__(self, hub):
         self.hub = hub
@@ -158,7 +161,10 @@ class VolumeRestrictions(PreFilterPlugin, FilterPlugin):
     + ReadWriteOncePod conflicts (:126-199, cluster-wide at PreFilter)."""
 
     NAME = "VolumeRestrictions"
-    VOLUME_GATED = True  # irrelevant to pods without spec.volumes
+
+    @staticmethod
+    def applies(pod: Pod) -> bool:
+        return bool(pod.spec.volumes)
 
     def __init__(self, hub):
         self.hub = hub
@@ -230,7 +236,10 @@ class NodeVolumeLimits(PreFilterPlugin, FilterPlugin):
     node's allocatable `attachable-volumes-csi-<driver>` limit."""
 
     NAME = "NodeVolumeLimits"
-    VOLUME_GATED = True  # irrelevant to pods without spec.volumes
+
+    @staticmethod
+    def applies(pod: Pod) -> bool:
+        return bool(pod.spec.volumes)
 
     def __init__(self, hub):
         self.hub = hub
@@ -312,9 +321,12 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ReservePlugin,
     PreBind (:346 BindPodVolumes) + Unreserve (:334 revert)."""
 
     NAME = "VolumeBinding"
-    VOLUME_GATED = True  # irrelevant to pods without spec.volumes
     STATE_KEY = "VolumeBinding/assumed"
     PLAN_KEY = "VolumeBinding/plan"
+
+    @staticmethod
+    def applies(pod: Pod) -> bool:
+        return bool(pod.spec.volumes)
 
     def __init__(self, hub):
         self.hub = hub

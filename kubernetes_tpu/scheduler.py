@@ -138,8 +138,9 @@ class Scheduler:
         self._enabled_filters = self.framework.enabled_filters()
         self._has_host_filters = any(fw.has_host_filters()
                                      for fw in self.frameworks.values())
-        self._host_volume_only = all(fw.host_filters_volume_gated()
-                                     for fw in self.frameworks.values())
+        gates = [fw.host_gates() for fw in self.frameworks.values()]
+        self._host_gates = (None if any(g is None for g in gates)
+                            else [g for gs in gates for g in gs])
         self._has_host_scores = any(fw.has_host_scores()
                                     for fw in self.frameworks.values())
         # pods popped but deferred to a later batch (host-serial volume
@@ -233,6 +234,17 @@ class Scheduler:
             on_update=w(lambda old, new:
                         self.queue.move_all_to_active_or_backoff(
                             ClusterEvent(R.PVC, A.UPDATE), old, new))))
+        self.hub.watch_resource_slices(EventHandlers(
+            on_add=w(lambda o: self.queue.move_all_to_active_or_backoff(
+                ClusterEvent(R.WILDCARD, A.ADD), None, o)),
+            on_delete=w(lambda o: self.queue.move_all_to_active_or_backoff(
+                ClusterEvent(R.WILDCARD, A.DELETE), o, None))))
+        self.hub.watch_resource_claims(EventHandlers(
+            on_add=w(lambda o: self.queue.move_all_to_active_or_backoff(
+                ClusterEvent(R.WILDCARD, A.ADD), None, o)),
+            on_update=w(lambda old, new:
+                        self.queue.move_all_to_active_or_backoff(
+                            ClusterEvent(R.WILDCARD, A.UPDATE), old, new))))
         self.hub.watch_pvs(EventHandlers(
             on_add=w(lambda o: self.queue.move_all_to_active_or_backoff(
                 ClusterEvent(R.PV, A.ADD), None, o)),
@@ -346,6 +358,10 @@ class Scheduler:
             self._pod_rv.pop(self._rv_tombstones.popleft(), None)
         # a pod parked at Permit WAIT holds an assumed reservation: free it
         # now (the reference rejects waiting pods from the delete handler)
+        if pod.spec.resource_claims:
+            from kubernetes_tpu.plugins.dra import release_pod_claims
+
+            release_pod_claims(self.hub, pod)
         wp = None
         for fw in self.frameworks.values():
             wp = fw.waiting_pods.remove(uid)
@@ -424,8 +440,7 @@ class Scheduler:
                 and not self.mirror.batch_has_topology(pods)
                 and not self.mirror.batch_has_host_ports(pods)
                 and not (self._has_host_filters
-                         and (not self._host_volume_only
-                              or any(p.spec.volumes for p in pods))))
+                         and any(self._host_relevant(p) for p in pods)))
 
     def _dispatch(self, runnable: list[QueuedPodInfo], chained: bool,
                   flush_pending=None) -> Optional[tuple]:
@@ -504,6 +519,11 @@ class Scheduler:
             self._chain = (out.free, out.nzr)
         return runnable, out, self.now(), self.now() - t_cycle0
 
+    def _host_relevant(self, pod: Pod) -> bool:
+        if self._has_host_scores or self._host_gates is None:
+            return True
+        return any(gate(pod) for gate in self._host_gates)
+
     def _defer_host_conflicts(self, runnable: list[QueuedPodInfo]
                               ) -> list[QueuedPodInfo]:
         """Host plugins can't see in-batch commits (their filters run once
@@ -511,15 +531,18 @@ class Scheduler:
         can influence each other — a shared write-restricted volume, a
         ReadWriteOncePod claim, an unbound PVC both want — must not share a
         batch: keep the first, defer the rest to the next batch."""
+        from kubernetes_tpu.plugins.dra import dra_serial_keys
         from kubernetes_tpu.plugins.volume import host_serial_keys
 
         seen: set[str] = set()
         keep: list[QueuedPodInfo] = []
         for qp in runnable:
-            if not qp.pod.spec.volumes:
+            if not qp.pod.spec.volumes \
+                    and not qp.pod.spec.resource_claims:
                 keep.append(qp)
                 continue
-            keys = host_serial_keys(self.hub, qp.pod)
+            keys = (host_serial_keys(self.hub, qp.pod)
+                    | dra_serial_keys(self.hub, qp.pod))
             if keys & seen:
                 self._deferred.append(qp)
             else:
@@ -534,8 +557,7 @@ class Scheduler:
         few dict probes per pod for volume-less workloads."""
         relevant = [
             (i, qp) for i, qp in enumerate(runnable)
-            if not (self._host_volume_only and not qp.pod.spec.volumes
-                    and not self._has_host_scores)]
+            if self._host_relevant(qp.pod)]
         if not relevant:
             return None, None
         # host plugins read the HUB (claims, pod placements): every

@@ -1,0 +1,237 @@
+"""DynamicResources (DRA) end-to-end: claim-backed pods, device-count
+pressure, allocation persistence across scheduler restart (reference:
+plugins/dynamicresources/dynamicresources.go:105-888)."""
+
+from kubernetes_tpu.api.objects import (
+    Container,
+    Device,
+    DeviceRequest,
+    LABEL_HOSTNAME,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodResourceClaim,
+    PodSpec,
+    ResourceClaim,
+    ResourceClaimSpec,
+    ResourceRequirements,
+    ResourceSlice,
+)
+from kubernetes_tpu.config.types import default_config
+from kubernetes_tpu.hub import Hub
+from kubernetes_tpu.ops.features import Capacities
+from kubernetes_tpu.scheduler import Scheduler
+
+
+def mknode(name):
+    return Node(metadata=ObjectMeta(name=name,
+                                    labels={LABEL_HOSTNAME: name}),
+                status=NodeStatus(allocatable={"cpu": "16",
+                                               "memory": "32Gi",
+                                               "pods": "110"}))
+
+
+def mkslice(node, n_devices, driver="tpu.example.com", cls="tpu"):
+    return ResourceSlice(
+        metadata=ObjectMeta(name=f"slice-{node}"),
+        node_name=node, driver=driver, pool=node,
+        devices=[Device(name=f"dev-{i}", device_class_name=cls)
+                 for i in range(n_devices)])
+
+
+def mkclaim(name, count=1, cls="tpu"):
+    return ResourceClaim(
+        metadata=ObjectMeta(name=name),
+        spec=ResourceClaimSpec(device_requests=[
+            DeviceRequest(name="accel", device_class_name=cls,
+                          count=count)]))
+
+
+def mkpod(name, claim=None):
+    claims = []
+    if claim:
+        claims = [PodResourceClaim(name="accel",
+                                   resource_claim_name=claim)]
+    return Pod(metadata=ObjectMeta(name=name),
+               spec=PodSpec(containers=[Container(
+                   name="c", resources=ResourceRequirements(
+                       requests={"cpu": "100m"}))],
+                   resource_claims=claims))
+
+
+def mksched(hub):
+    cfg = default_config()
+    cfg.batch_size = 16
+    return Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64))
+
+
+def bound(hub, pod):
+    return hub.get_pod(pod.metadata.uid).spec.node_name
+
+
+def test_claim_backed_pod_schedules_on_device_node():
+    hub = Hub()
+    sched = mksched(hub)
+    hub.create_node(mknode("plain"))
+    hub.create_node(mknode("accel"))
+    hub.create_resource_slice(mkslice("accel", 4))
+    hub.create_resource_claim(mkclaim("c1"))
+    p = mkpod("p", claim="c1")
+    hub.create_pod(p)
+    sched.run_until_idle()
+    assert bound(hub, p) == "accel", "only the slice-backed node fits"
+    claim = hub.get_resource_claim("default", "c1")
+    assert claim.status.allocation is not None
+    assert claim.status.allocation.node_name == "accel"
+    assert len(claim.status.allocation.devices) == 1
+    assert claim.status.allocation.devices[0].device == "dev-0"
+    assert p.metadata.uid in claim.status.reserved_for
+
+
+def test_missing_claim_unresolvable():
+    hub = Hub()
+    sched = mksched(hub)
+    hub.create_node(mknode("n"))
+    p = mkpod("p", claim="nope")
+    hub.create_pod(p)
+    sched.run_until_idle()
+    assert bound(hub, p) == ""
+    msg = hub.get_pod(p.metadata.uid).status.conditions[0].message
+    assert "DynamicResources" in msg
+
+
+def test_device_exhaustion_spreads_then_rejects():
+    hub = Hub()
+    sched = mksched(hub)
+    hub.create_node(mknode("a"))
+    hub.create_node(mknode("b"))
+    hub.create_resource_slice(mkslice("a", 1))
+    hub.create_resource_slice(mkslice("b", 1))
+    pods = []
+    for i in range(3):
+        hub.create_resource_claim(mkclaim(f"c{i}"))
+        pods.append(mkpod(f"p{i}", claim=f"c{i}"))
+        hub.create_pod(pods[-1])
+    sched.run_until_idle()
+    placed = [bound(hub, p) for p in pods if bound(hub, p)]
+    assert sorted(placed) == ["a", "b"], "one device per node"
+    loser = [p for p in pods if not bound(hub, p)]
+    assert len(loser) == 1
+    # no device double-booked
+    devs = set()
+    for i in range(3):
+        claim = hub.get_resource_claim("default", f"c{i}")
+        if claim.status.allocation is not None:
+            for d in claim.status.allocation.devices:
+                key = (d.driver, d.pool, d.device)
+                assert key not in devs
+                devs.add(key)
+
+
+def test_multi_device_claim():
+    hub = Hub()
+    sched = mksched(hub)
+    hub.create_node(mknode("small"))
+    hub.create_node(mknode("big"))
+    hub.create_resource_slice(mkslice("small", 1))
+    hub.create_resource_slice(mkslice("big", 4))
+    hub.create_resource_claim(mkclaim("c2", count=2))
+    p = mkpod("p", claim="c2")
+    hub.create_pod(p)
+    sched.run_until_idle()
+    assert bound(hub, p) == "big"
+    claim = hub.get_resource_claim("default", "c2")
+    assert len(claim.status.allocation.devices) == 2
+
+
+def test_allocation_survives_restart_replay():
+    """A restarted scheduler rebuilds its device view from claim statuses:
+    the surviving allocation keeps its devices booked, and a pre-allocated
+    pending claim pins its pod to the allocated node."""
+    hub = Hub()
+    sched1 = mksched(hub)
+    hub.create_node(mknode("a"))
+    hub.create_node(mknode("b"))
+    hub.create_resource_slice(mkslice("a", 1))
+    hub.create_resource_slice(mkslice("b", 1))
+    hub.create_resource_claim(mkclaim("c1"))
+    p1 = mkpod("p1", claim="c1")
+    hub.create_pod(p1)
+    sched1.run_until_idle()
+    first_node = bound(hub, p1)
+    assert first_node in ("a", "b")
+    sched1.close()
+
+    # "restart": a brand-new scheduler over the same hub state
+    sched2 = mksched(hub)
+    hub.create_resource_claim(mkclaim("c2"))
+    p2 = mkpod("p2", claim="c2")
+    hub.create_pod(p2)
+    sched2.run_until_idle()
+    other = "b" if first_node == "a" else "a"
+    assert bound(hub, p2) == other, \
+        "the restarted scheduler must see c1's device as taken"
+    c1 = hub.get_resource_claim("default", "c1")
+    assert c1.status.allocation.node_name == first_node, \
+        "c1's allocation untouched by the restart"
+    c2 = hub.get_resource_claim("default", "c2")
+    assert c2.status.allocation.node_name == other
+    assert (c1.status.allocation.devices[0].pool
+            != c2.status.allocation.devices[0].pool)
+
+
+def test_preallocated_claim_pins_pod_after_restart():
+    hub = Hub()
+    sched1 = mksched(hub)
+    hub.create_node(mknode("a"))
+    hub.create_node(mknode("b"))
+    hub.create_resource_slice(mkslice("a", 2))
+    hub.create_resource_slice(mkslice("b", 2))
+    hub.create_resource_claim(mkclaim("c1"))
+    p1 = mkpod("p1", claim="c1")
+    hub.create_pod(p1)
+    sched1.run_until_idle()
+    node1 = bound(hub, p1)
+    sched1.close()
+
+    # the pod is deleted but its claim stays allocated (DRA claims outlive
+    # pods until deallocated); a new pod reusing the claim must land on
+    # the allocation's node
+    hub.delete_pod(p1.metadata.uid)
+    sched2 = mksched(hub)
+    p2 = mkpod("p2", claim="c1")
+    hub.create_pod(p2)
+    sched2.run_until_idle()
+    assert bound(hub, p2) == node1, "pinned to the claim's allocation"
+
+
+def test_pod_deletion_releases_claim_devices():
+    """The deleted consumer leaves reservedFor; an orphaned claim
+    deallocates and its devices return to the pool for waiting pods."""
+    hub = Hub()
+    sched = mksched(hub)
+    hub.create_node(mknode("a"))
+    hub.create_resource_slice(mkslice("a", 1))
+    hub.create_resource_claim(mkclaim("c1"))
+    hub.create_resource_claim(mkclaim("c2"))
+    p1 = mkpod("p1", claim="c1")
+    p2 = mkpod("p2", claim="c2")
+    hub.create_pod(p1)
+    hub.create_pod(p2)
+    sched.run_until_idle()
+    first = p1 if bound(hub, p1) else p2
+    second = p2 if first is p1 else p1
+    assert bound(hub, first) == "a" and bound(hub, second) == ""
+    # delete the winner: its claim deallocates, the loser requeues and wins
+    hub.delete_pod(first.metadata.uid)
+    import time as _t
+
+    _t.sleep(1.2)
+    sched.queue.flush_backoff_completed()
+    sched.run_until_idle()
+    assert bound(hub, second) == "a"
+    freed = hub.get_resource_claim(
+        "default", "c1" if first is p1 else "c2")
+    assert freed.status.allocation is None
+    assert freed.status.reserved_for == []

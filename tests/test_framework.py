@@ -37,7 +37,7 @@ def test_default_expansion():
         "NodeUnschedulable", "NodeName", "TaintToleration", "NodeAffinity",
         "NodePorts", "NodeResourcesFit", "VolumeRestrictions",
         "NodeVolumeLimits", "VolumeBinding", "VolumeZone",
-        "PodTopologySpread", "InterPodAffinity"]
+        "DynamicResources", "PodTopologySpread", "InterPodAffinity"]
     scores = dict(fw.points["score"])
     assert scores["TaintToleration"] == 3
     assert scores["NodeAffinity"] == 2
@@ -52,8 +52,8 @@ def test_disable_star_wipes_point():
     fw = mkfw(lambda p: setattr(p.plugins, "score",
                                 PluginSet(disabled=[Plugin("*")])))
     assert fw.points["score"] == []
-    # filters untouched (8 device + 4 host volume plugins)
-    assert len(fw.points["filter"]) == 12
+    # filters untouched (8 device + 4 volume + DynamicResources host)
+    assert len(fw.points["filter"]) == 13
 
 
 def test_disable_single_filter_reflected_in_device_flags():
