@@ -60,9 +60,11 @@ def dra_serial_keys(hub, pod: Pod) -> set[str]:
 
 def release_pod_claims(hub, pod: Pod) -> None:
     """The slice of the reference's resourceclaim controller the scheduler
-    build needs: a deleted pod leaves its claims' reservedFor; a claim with
-    no consumers left is DEALLOCATED so its devices return to the pool
-    (the claim update event requeues waiting DRA pods)."""
+    build needs: a deleted pod leaves its claims' reservedFor. The
+    ALLOCATION persists — a standalone claim owns its devices until the
+    claim itself is deleted (that is how users hand a device from pod to
+    pod); freeing capacity means deleting the claim, whose event requeues
+    waiting DRA pods."""
     for ref in pod.spec.resource_claims:
         claim = hub.get_resource_claim(pod.metadata.namespace,
                                        ref.resource_claim_name)
@@ -71,8 +73,6 @@ def release_pod_claims(hub, pod: Pod) -> None:
             continue
         new = claim.clone()
         new.status.reserved_for.remove(pod.metadata.uid)
-        if not new.status.reserved_for:
-            new.status.allocation = None
         hub.update_resource_claim(new)
 
 

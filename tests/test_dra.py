@@ -199,6 +199,9 @@ def test_preallocated_claim_pins_pod_after_restart():
     # pods until deallocated); a new pod reusing the claim must land on
     # the allocation's node
     hub.delete_pod(p1.metadata.uid)
+    c1 = hub.get_resource_claim("default", "c1")
+    assert c1.status.allocation is not None, \
+        "standalone claim keeps its allocation across consumers"
     sched2 = mksched(hub)
     p2 = mkpod("p2", claim="c1")
     hub.create_pod(p2)
@@ -206,9 +209,10 @@ def test_preallocated_claim_pins_pod_after_restart():
     assert bound(hub, p2) == node1, "pinned to the claim's allocation"
 
 
-def test_pod_deletion_releases_claim_devices():
-    """The deleted consumer leaves reservedFor; an orphaned claim
-    deallocates and its devices return to the pool for waiting pods."""
+def test_claim_deletion_frees_devices_pod_deletion_does_not():
+    """A deleted consumer only leaves reservedFor (the standalone claim
+    keeps its devices); deleting the CLAIM is what returns them to the
+    pool and unsticks the waiting pod."""
     hub = Hub()
     sched = mksched(hub)
     hub.create_node(mknode("a"))
@@ -222,16 +226,23 @@ def test_pod_deletion_releases_claim_devices():
     sched.run_until_idle()
     first = p1 if bound(hub, p1) else p2
     second = p2 if first is p1 else p1
+    first_claim = "c1" if first is p1 else "c2"
     assert bound(hub, first) == "a" and bound(hub, second) == ""
-    # delete the winner: its claim deallocates, the loser requeues and wins
-    hub.delete_pod(first.metadata.uid)
     import time as _t
 
+    # pod deletion alone: reservedFor drops, allocation persists,
+    # the loser still cannot get the device
+    hub.delete_pod(first.metadata.uid)
+    held = hub.get_resource_claim("default", first_claim)
+    assert held.status.reserved_for == []
+    assert held.status.allocation is not None
+    _t.sleep(1.2)
+    sched.queue.flush_backoff_completed()
+    sched.run_until_idle()
+    assert bound(hub, second) == ""
+    # claim deletion frees the device: the loser requeues and wins
+    hub.delete_resource_claim(held.metadata.uid)
     _t.sleep(1.2)
     sched.queue.flush_backoff_completed()
     sched.run_until_idle()
     assert bound(hub, second) == "a"
-    freed = hub.get_resource_claim(
-        "default", "c1" if first is p1 else "c2")
-    assert freed.status.allocation is None
-    assert freed.status.reserved_for == []
