@@ -244,7 +244,9 @@ class Scheduler:
                 ClusterEvent(R.WILDCARD, A.ADD), None, o)),
             on_update=w(lambda old, new:
                         self.queue.move_all_to_active_or_backoff(
-                            ClusterEvent(R.WILDCARD, A.UPDATE), old, new))))
+                            ClusterEvent(R.WILDCARD, A.UPDATE), old, new)),
+            on_delete=w(lambda o: self.queue.move_all_to_active_or_backoff(
+                ClusterEvent(R.WILDCARD, A.DELETE), o, None))))
         self.hub.watch_pvs(EventHandlers(
             on_add=w(lambda o: self.queue.move_all_to_active_or_backoff(
                 ClusterEvent(R.PV, A.ADD), None, o)),
