@@ -241,8 +241,12 @@ def test_claim_deletion_frees_devices_pod_deletion_does_not():
     sched.run_until_idle()
     assert bound(hub, second) == ""
     # claim deletion frees the device: the loser requeues and wins
+    # (its accumulated backoff can reach ~10s of real time)
     hub.delete_resource_claim(held.metadata.uid)
-    _t.sleep(1.2)
-    sched.queue.flush_backoff_completed()
-    sched.run_until_idle()
+    for _ in range(30):
+        sched.queue.flush_backoff_completed()
+        sched.run_until_idle()
+        if bound(hub, second):
+            break
+        _t.sleep(0.5)
     assert bound(hub, second) == "a"
