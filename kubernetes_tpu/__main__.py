@@ -1,0 +1,94 @@
+"""The scheduler binary: ``python -m kubernetes_tpu``.
+
+Equivalent of cmd/kube-scheduler (app/server.go:89 Setup + Run): load the
+component config, stand up the hub + scheduler + serving endpoints, run
+the daemon under optional leader election until interrupted. The
+in-process hub doubles as the demo API surface; a real deployment would
+swap it for an apiserver-backed client implementing the same interface.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import socket
+import sys
+import threading
+import uuid
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kubernetes-tpu-scheduler")
+    parser.add_argument("--config", help="component config file (JSON/YAML)")
+    parser.add_argument("--bind-address", default="127.0.0.1")
+    parser.add_argument("--secure-port", type=int, default=10259,
+                        help="serving port for /metrics,/healthz,/configz "
+                             "(0 = disabled)")
+    parser.add_argument("--leader-elect", action="store_true")
+    parser.add_argument("--leader-elect-lease-duration", type=float,
+                        default=15.0)
+    parser.add_argument("--id", default=None,
+                        help="leader election identity")
+    parser.add_argument("--validate-only", action="store_true",
+                        help="load + validate the config, then exit")
+    args = parser.parse_args(argv)
+
+    from kubernetes_tpu.utils import jaxsetup
+
+    jaxsetup.setup()
+
+    from kubernetes_tpu.config.load import load_config
+    from kubernetes_tpu.config.types import default_config
+    from kubernetes_tpu.config.validation import validate_config
+    from kubernetes_tpu.hub import Hub
+    from kubernetes_tpu.plugins.registry import in_tree_registry
+    from kubernetes_tpu.scheduler import Scheduler
+
+    cfg = load_config(args.config) if args.config else default_config()
+    errs = validate_config(cfg, in_tree_registry())
+    if errs:
+        for e in errs:
+            print(f"invalid configuration: {e}", file=sys.stderr)
+        return 1
+    if args.validate_only:
+        print("configuration valid")
+        return 0
+
+    hub = Hub()
+    sched = Scheduler(hub, cfg)
+
+    serving = None
+    if args.secure_port:
+        from kubernetes_tpu.serving import ServingEndpoints
+
+        serving = ServingEndpoints(sched, host=args.bind_address,
+                                   port=args.secure_port)
+        serving.start()
+        print(f"serving /metrics,/healthz,/configz on "
+              f"{args.bind_address}:{serving.port}", file=sys.stderr)
+
+    elector = None
+    if args.leader_elect:
+        from kubernetes_tpu.leaderelection import LeaderElector
+
+        identity = args.id or f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
+        elector = LeaderElector(
+            hub.leases, identity,
+            lease_duration=args.leader_elect_lease_duration)
+        print(f"leader election enabled, id={identity}", file=sys.stderr)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    print("scheduler running (ctrl-c to stop)", file=sys.stderr)
+    try:
+        sched.run(stop, elector=elector)
+    finally:
+        if serving is not None:
+            serving.stop()
+        sched.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1268,7 +1268,13 @@ class Mirror:
         return tuple(sorted(fields))
 
     def pod_template_blobs(self) -> PodBlobs:
-        """Device-resident 1-row full-schema template (pushed once)."""
+        """Device-resident 1-row full-schema template (pushed once).
+
+        INVARIANT: _pod_tmpl_dev / _subset_tmpl are cached for the
+        Mirror's lifetime. That is sound only because template content is
+        state-independent (empty-pod defaults; the interner is append-only)
+        and re-bucketing constructs a FRESH Mirror. An edit that makes
+        _pod_template depend on mutable state must invalidate these."""
         if self._pod_tmpl_dev is None:
             f32, i32 = self._pod_template()
             self._pod_tmpl_dev = PodBlobs(f32=jnp.asarray(f32),

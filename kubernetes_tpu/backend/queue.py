@@ -262,7 +262,13 @@ class PriorityQueue:
         if not qp.unschedulable_plugins:
             return True  # rejected with no attribution: requeue on anything
         for plugin in qp.unschedulable_plugins:
-            for reg in self._hints.get(plugin, []):
+            regs = self._hints.get(plugin)
+            if regs is None:
+                # a rejector with NO registrations (extenders, out-of-tree
+                # plugins) cannot describe what unsticks its pods — requeue
+                # on any event, like the reference treats extender rejects
+                return True
+            for reg in regs:
                 if not reg.event.match(event):
                     continue
                 if reg.queueing_hint_fn is None:

@@ -82,6 +82,8 @@ class SchedulerConfiguration:
     percentage_of_nodes_to_score: Optional[int] = None  # 0/None = adaptive
     pod_initial_backoff_seconds: float = 1.0
     pod_max_backoff_seconds: float = 10.0
+    # legacy HTTP extenders (extender.ExtenderConfig entries)
+    extenders: list = field(default_factory=list)
     # binding cycle: runs on a worker pool after assume+permit
     # (schedule_one.go:124's per-pod goroutine)
     async_binding: bool = True

@@ -1,0 +1,123 @@
+"""HTTP scheduler extender: the out-of-process Filter/Prioritize webhook.
+
+From-scratch equivalent of /root/reference/pkg/scheduler/extender.go
+(HTTPExtender :43, Filter :248, Prioritize :319, IsInterested :361) and
+the v1 extender API (ExtenderArgs/ExtenderFilterResult/HostPriorityList):
+a legacy escape hatch predating the framework — JSON POSTs to an external
+service that can veto nodes and add weighted scores. Wired into the host
+side of the mixed framework: verdicts AND into the device mask, scores
+add into the aggregate.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kubernetes_tpu.api.objects import Pod
+
+DEFAULT_TIMEOUT = 5.0
+
+
+@dataclass
+class ExtenderConfig:
+    """apis/config.Extender (types.go:190+): the slice the scheduler
+    consumes."""
+
+    url_prefix: str
+    filter_verb: str = ""
+    prioritize_verb: str = ""
+    weight: float = 1.0
+    # resource names whose presence in a pod's requests makes the extender
+    # interested; empty = interested in every pod (extender.go:361)
+    managed_resources: list[str] = field(default_factory=list)
+    # an unreachable ignorable extender is skipped; a non-ignorable one
+    # fails the pod (extender.go IsIgnorable)
+    ignorable: bool = False
+    timeout_seconds: float = DEFAULT_TIMEOUT
+
+
+class ExtenderError(Exception):
+    pass
+
+
+def _pod_payload(pod: Pod) -> dict:
+    return {
+        "metadata": {"name": pod.metadata.name,
+                     "namespace": pod.metadata.namespace,
+                     "uid": pod.metadata.uid,
+                     "labels": dict(pod.metadata.labels)},
+        "spec": {"schedulerName": pod.spec.scheduler_name,
+                 "containers": [
+                     {"name": c.name,
+                      "resources": {"requests": dict(c.resources.requests)}}
+                     for c in pod.spec.containers]},
+    }
+
+
+class HTTPExtender:
+    """One configured extender endpoint."""
+
+    def __init__(self, cfg: ExtenderConfig):
+        self.cfg = cfg
+
+    @property
+    def name(self) -> str:
+        return f"Extender({self.cfg.url_prefix})"
+
+    def is_interested(self, pod: Pod) -> bool:
+        if not self.cfg.managed_resources:
+            return True
+        managed = set(self.cfg.managed_resources)
+        for c in pod.spec.containers + pod.spec.init_containers:
+            if managed & set(c.resources.requests):
+                return True
+        return False
+
+    def _post(self, verb: str, payload: dict) -> dict:
+        url = self.cfg.url_prefix.rstrip("/") + "/" + verb
+        data = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            url, data=data, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(
+                req, timeout=self.cfg.timeout_seconds) as resp:
+            return json.loads(resp.read().decode())
+
+    def filter(self, pod: Pod, node_names: list[str]
+               ) -> tuple[list[str], dict[str, str]]:
+        """(nodes that passed, {failed node: reason}). Raises
+        ExtenderError on transport errors (caller applies ignorable)."""
+        if not self.cfg.filter_verb:
+            return node_names, {}
+        try:
+            out = self._post(self.cfg.filter_verb, {
+                "pod": _pod_payload(pod), "nodenames": node_names})
+            if out.get("error"):
+                raise ExtenderError(f"{self.name}: {out['error']}")
+            passed = out.get("nodenames")
+            if passed is None:
+                passed = node_names
+            failed = dict(out.get("failedNodes") or {})
+            failed.update(out.get("failedAndUnresolvableNodes") or {})
+            return list(passed), failed
+        except ExtenderError:
+            raise
+        except Exception as e:  # noqa: BLE001 — transport OR malformed
+            # response; both surface as ExtenderError so `ignorable`
+            # applies instead of crashing the scheduling cycle
+            raise ExtenderError(f"{self.name}: {e}") from e
+
+    def prioritize(self, pod: Pod, node_names: list[str]
+                   ) -> Optional[dict[str, float]]:
+        """{node: weighted score} or None without a prioritize verb."""
+        if not self.cfg.prioritize_verb:
+            return None
+        try:
+            out = self._post(self.cfg.prioritize_verb, {
+                "pod": _pod_payload(pod), "nodenames": node_names})
+            return {e["host"]: float(e["score"]) * self.cfg.weight
+                    for e in out or []}
+        except Exception as e:  # noqa: BLE001 — transport or malformed
+            raise ExtenderError(f"{self.name}: {e}") from e

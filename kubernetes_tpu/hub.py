@@ -74,6 +74,9 @@ class Hub:
         self._sc_by_name: dict[str, str] = {}
         self._node_by_name: dict[str, str] = {}
         self._claims = _Store("ResourceClaim")
+        from kubernetes_tpu.leaderelection import LeaseStore
+
+        self.leases = LeaseStore()
         self._slices = _Store("ResourceSlice")
         self._claim_by_key: dict[str, str] = {}
 

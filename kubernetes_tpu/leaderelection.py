@@ -1,0 +1,147 @@
+"""Lease-based leader election.
+
+The reference's only multi-process story (cmd/kube-scheduler/app/
+server.go:284-317 + k8s.io/client-go/tools/leaderelection): candidate
+schedulers race to acquire a coordination Lease; the holder renews it
+every renew_interval and everyone else watches for expiry. The hub is the
+lease store (a real deployment would point this at the apiserver).
+
+Defaults mirror the reference's component config: 15s lease duration,
+10s renew deadline, 2s retry period.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+
+@dataclass
+class Lease:
+    """coordination.k8s.io/v1 Lease, the slice leader election uses."""
+
+    name: str = ""
+    holder_identity: str = ""
+    lease_duration_seconds: float = 15.0
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_transitions: int = 0
+
+
+class LeaseStore:
+    """The hub-side lease registry (get-or-create + compare-and-swap by
+    holder, which is all leaderelection needs)."""
+
+    def __init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+        self._leases: dict[str, Lease] = {}
+
+    def get(self, name: str) -> Optional[Lease]:
+        with self._lock:
+            lease = self._leases.get(name)
+            return None if lease is None else Lease(**vars(lease))
+
+    def update(self, lease: Lease, expect_holder: Optional[str]) -> bool:
+        """CAS: apply iff the stored holder matches ``expect_holder``
+        (None = lease must not exist yet or be the same holder)."""
+        with self._lock:
+            cur = self._leases.get(lease.name)
+            if cur is not None and expect_holder is not None \
+                    and cur.holder_identity != expect_holder:
+                return False
+            if cur is not None and expect_holder is None \
+                    and cur.holder_identity not in ("",
+                                                    lease.holder_identity):
+                return False
+            self._leases[lease.name] = Lease(**vars(lease))
+            return True
+
+
+class LeaderElector:
+    """tools/leaderelection.LeaderElector reduced to the scheduler's use:
+    tryAcquireOrRenew on a timer; is_leader() gates the scheduling loop."""
+
+    def __init__(self, store: LeaseStore, identity: str,
+                 lease_name: str = "kube-scheduler",
+                 lease_duration: float = 15.0,
+                 retry_period: float = 2.0,
+                 now: Callable[[], float] = time.time,
+                 on_started_leading: Optional[Callable] = None,
+                 on_stopped_leading: Optional[Callable] = None):
+        self.store = store
+        self.identity = identity
+        self.lease_name = lease_name
+        self.lease_duration = lease_duration
+        self.retry_period = retry_period
+        self.now = now
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self._leading = False
+        self._last_try = 0.0
+
+    def is_leader(self) -> bool:
+        return self._leading
+
+    def try_acquire_or_renew(self) -> bool:
+        """leaderelection.go tryAcquireOrRenew: renew our own lease, or
+        take an expired/vacant one."""
+        now = self.now()
+        cur = self.store.get(self.lease_name)
+        if cur is None or not cur.holder_identity:
+            ok = self.store.update(Lease(
+                name=self.lease_name, holder_identity=self.identity,
+                lease_duration_seconds=self.lease_duration,
+                acquire_time=now, renew_time=now), expect_holder=None)
+            self._set_leading(ok)
+            return self._leading
+        if cur.holder_identity == self.identity:
+            cur.renew_time = now
+            ok = self.store.update(cur, expect_holder=self.identity)
+            # a failed CAS means a peer stole the lease while we stalled:
+            # step down immediately (split-brain guard)
+            self._set_leading(ok)
+            return ok
+        if now - cur.renew_time > cur.lease_duration_seconds:
+            # expired: steal it (lease_transitions counts takeovers)
+            ok = self.store.update(Lease(
+                name=self.lease_name, holder_identity=self.identity,
+                lease_duration_seconds=self.lease_duration,
+                acquire_time=now, renew_time=now,
+                lease_transitions=cur.lease_transitions + 1),
+                expect_holder=cur.holder_identity)
+            self._set_leading(ok)
+            return self._leading
+        self._set_leading(False)
+        return False
+
+    def tick(self) -> bool:
+        """Rate-limited try_acquire_or_renew for the maintenance loop."""
+        now = self.now()
+        if now - self._last_try < self.retry_period:
+            return self._leading
+        self._last_try = now
+        return self.try_acquire_or_renew()
+
+    def release(self) -> None:
+        """Step down voluntarily (leaderelection.go release): zero out the
+        holder so a peer acquires without waiting for expiry."""
+        if not self._leading:
+            return
+        self.store.update(Lease(
+            name=self.lease_name, holder_identity="",
+            lease_duration_seconds=self.lease_duration,
+            acquire_time=0.0, renew_time=0.0), expect_holder=self.identity)
+        self._set_leading(False)
+
+    def _set_leading(self, leading: bool) -> None:
+        if leading and not self._leading:
+            self._leading = True
+            if self.on_started_leading:
+                self.on_started_leading()
+        elif not leading and self._leading:
+            self._leading = False
+            if self.on_stopped_leading:
+                self.on_stopped_leading()

@@ -92,6 +92,11 @@ class BlobCodec:
         lay = self._subset_cache.get(key)
         if lay is not None:
             return lay
+        unknown = [n for n in names if n not in self.schema]
+        if unknown:
+            # a typo'd subset name would otherwise silently ride the
+            # template defaults — a silent-wrong-results failure mode
+            raise KeyError(f"subset names not in schema: {unknown}")
         f_off: dict[str, tuple[int, int]] = {}
         i_off: dict[str, tuple[int, int]] = {}
         f = i = 0

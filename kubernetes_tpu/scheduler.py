@@ -136,6 +136,9 @@ class Scheduler:
                    "fit": fw.fit_scoring()}
             for name, fw in self.frameworks.items()}
         self._enabled_filters = self.framework.enabled_filters()
+        from kubernetes_tpu.extender import HTTPExtender
+
+        self._extenders = [HTTPExtender(c) for c in self.config.extenders]
         self._has_host_filters = any(fw.has_host_filters()
                                      for fw in self.frameworks.values())
         gates = [fw.host_gates() for fw in self.frameworks.values()]
@@ -506,7 +509,8 @@ class Scheduler:
                        and pcfg["filters"][FILTER_PLUGINS.index(
                            "NodeResourcesFit")])
         host_ok = host_score = None
-        if self._has_host_filters or self._has_host_scores:
+        if self._has_host_filters or self._has_host_scores \
+                or self._extenders:
             host_ok, host_score = self._run_host_plugins(runnable)
         fit_strategy, fit_shape = pcfg["fit"]
         out: BatchResult = launch_batch(
@@ -523,6 +527,8 @@ class Scheduler:
 
     def _host_relevant(self, pod: Pod) -> bool:
         if self._has_host_scores or self._host_gates is None:
+            return True
+        if any(ext.is_interested(pod) for ext in self._extenders):
             return True
         return any(gate(pod) for gate in self._host_gates)
 
@@ -562,6 +568,11 @@ class Scheduler:
             if self._host_relevant(qp.pod)]
         if not relevant:
             return None, None
+        ext_names = ext_rows = None
+        if self._extenders:
+            ext_names = [ni.node.metadata.name
+                         for ni in self.snapshot.node_info_list]
+            ext_rows = {n: self.mirror.row_of(n) for n in ext_names}
         # host plugins read the HUB (claims, pod placements): every
         # outstanding binding cycle must land first or a conflict check
         # could miss a just-bound pod
@@ -606,8 +617,58 @@ class Scheduler:
                 r = node_rows()
                 ok = r >= 0
                 host_score[i, r[ok]] = np.asarray(scores, np.float32)[ok]
+            if ext_names is not None:
+                host_ok, host_score = self._run_extenders(
+                    qp, i, ext_names, ext_rows, host_ok, host_score,
+                    b_cap, n_cap)
         return (jnp.asarray(host_ok) if host_ok is not None else None,
                 jnp.asarray(host_score) if host_score is not None else None)
+
+    def _run_extenders(self, qp, i, names, name_row, host_ok, host_score,
+                       b_cap, n_cap):
+        """Legacy HTTP extenders (extender.go:248 Filter, :319
+        Prioritize): verdicts AND into the host mask, weighted scores add
+        into the aggregate; an unreachable ignorable extender is skipped,
+        a non-ignorable one fails the pod for this cycle."""
+        from kubernetes_tpu.extender import ExtenderError
+
+        interested = [ext for ext in self._extenders
+                      if ext.is_interested(qp.pod)]
+        if not interested:
+            return host_ok, host_score
+        candidates = list(names)
+        for ext in interested:
+            try:
+                passed, failed = ext.filter(qp.pod, candidates)
+                scores = ext.prioritize(qp.pod, candidates)
+            except ExtenderError as e:
+                if ext.cfg.ignorable:
+                    continue
+                qp.host_reject_counts[ext.name] = len(candidates)
+                if host_ok is None:
+                    host_ok = np.ones((b_cap, n_cap), bool)
+                host_ok[i, :] = False
+                logger.warning("extender failed: %s", e)
+                return host_ok, host_score
+            rejected = set(failed) | (set(candidates) - set(passed))
+            if rejected:
+                qp.host_reject_counts[ext.name] = (
+                    qp.host_reject_counts.get(ext.name, 0) + len(rejected))
+                if host_ok is None:
+                    host_ok = np.ones((b_cap, n_cap), bool)
+                for name in rejected:
+                    row = name_row.get(name, -1)
+                    if row >= 0:
+                        host_ok[i, row] = False
+                candidates = [n for n in candidates if n not in rejected]
+            if scores:
+                if host_score is None:
+                    host_score = np.zeros((b_cap, n_cap), np.float32)
+                for name, sc in scores.items():
+                    row = name_row.get(name, -1)
+                    if row >= 0:
+                        host_score[i, row] += sc
+        return host_ok, host_score
 
     def _finish(self, inflight: tuple) -> None:
         """Pull one dispatched launch's results and commit/fail each pod."""
@@ -828,6 +889,11 @@ class Scheduler:
         (preemption) first, record the rejecting plugins for queueing hints,
         patch the PodScheduled condition (+ NominatedNodeName), park in
         unschedulable."""
+        # NOTE: auction-mode (parallel-rounds) launches attribute
+        # reject_counts against END-state capacity, not the state each pod
+        # was evaluated under mid-drain (pipeline._rounds_commit) — plugin
+        # attribution is exact, counts are post-drain. The serial scan is
+        # exact per step.
         plugins = {FILTER_PLUGINS[i] for i, c in enumerate(reject_counts)
                    if c > 0}
         plugins |= set(qp.host_reject_counts)
@@ -906,29 +972,46 @@ class Scheduler:
             self.metrics.cache_size.set(self.cache.assumed_pod_count(),
                                         type="assumed_pods")
 
-    def run(self, stop: threading.Event, idle_sleep: float = 0.02) -> None:
+    def run(self, stop: threading.Event, idle_sleep: float = 0.02,
+            elector=None) -> None:
         """Blocking daemon loop (scheduler.go:452 Run): maintenance timers
-        + scheduling cycles until ``stop`` is set. Exceptions are logged
-        and retained (daemon_error) instead of silently killing the
-        thread; the loop backs off and keeps serving."""
+        + scheduling cycles until ``stop`` is set. With an ``elector``
+        (leaderelection.LeaderElector) the loop only schedules while
+        holding the lease (server.go:284-317); a non-leader keeps its
+        informer state warm but mutates nothing. Exceptions are logged and
+        retained (daemon_error); the loop backs off and keeps serving."""
         self.daemon_error: Optional[BaseException] = None
-        while not stop.is_set():
-            try:
-                self.run_maintenance()
-                if self.run_until_idle() == 0:
-                    stop.wait(idle_sleep)
-            except Exception as e:  # noqa: BLE001 — keep the daemon alive
-                logger.exception("scheduling loop error: %s", e)
-                self.daemon_error = e
-                stop.wait(0.5)
+        try:
+            while not stop.is_set():
+                if elector is not None and not elector.tick():
+                    stop.wait(min(elector.retry_period, 0.5))
+                    continue
+                try:
+                    self.run_maintenance()
+                    # the drain renews the lease every batch and aborts the
+                    # moment leadership is lost (the reference renews on a
+                    # background goroutine; a long drain must not outlive
+                    # the lease while still binding pods)
+                    on_step = (None if elector is None
+                               else (lambda: not elector.tick()))
+                    if self.run_until_idle(on_step=on_step) == 0:
+                        stop.wait(idle_sleep)
+                except Exception as e:  # noqa: BLE001 — keep daemon alive
+                    logger.exception("scheduling loop error: %s", e)
+                    self.daemon_error = e
+                    stop.wait(0.5)
+        finally:
+            if elector is not None:
+                elector.release()
 
-    def start(self) -> None:
+    def start(self, elector=None) -> None:
         """Run the daemon on its own thread (tests/embedding)."""
         if self._daemon is not None:
             return
         self._stop = threading.Event()
         self._daemon = threading.Thread(
-            target=self.run, args=(self._stop,), daemon=True,
+            target=self.run, args=(self._stop,),
+            kwargs={"elector": elector}, daemon=True,
             name="kubernetes-tpu-scheduler")
         self._daemon.start()
 

@@ -1,0 +1,311 @@
+"""Leader election (server.go:284-317) + HTTP extender (extender.go)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubernetes_tpu.api.objects import (
+    Container,
+    LABEL_HOSTNAME,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResourceRequirements,
+)
+from kubernetes_tpu.config.types import default_config
+from kubernetes_tpu.extender import ExtenderConfig
+from kubernetes_tpu.hub import Hub
+from kubernetes_tpu.leaderelection import LeaderElector
+from kubernetes_tpu.ops.features import Capacities
+from kubernetes_tpu.scheduler import Scheduler
+
+
+class Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def now(self):
+        return self.t
+
+
+def test_leader_election_acquire_renew_takeover():
+    hub = Hub()
+    clock = Clock()
+    a = LeaderElector(hub.leases, "a", now=clock.now)
+    b = LeaderElector(hub.leases, "b", now=clock.now)
+    assert a.try_acquire_or_renew() is True
+    assert b.try_acquire_or_renew() is False
+    assert a.is_leader() and not b.is_leader()
+    # renewals keep the lease
+    clock.t += 10
+    assert a.try_acquire_or_renew() is True
+    clock.t += 10
+    assert b.try_acquire_or_renew() is False, "a renewed 10s ago"
+    # a goes silent past the lease duration: b takes over
+    clock.t += 16
+    assert b.try_acquire_or_renew() is True
+    assert not a.try_acquire_or_renew()
+    assert not a.is_leader()
+    lease = hub.leases.get("kube-scheduler")
+    assert lease.holder_identity == "b"
+    assert lease.lease_transitions == 1
+
+
+def test_leader_election_release():
+    hub = Hub()
+    clock = Clock()
+    a = LeaderElector(hub.leases, "a", now=clock.now)
+    b = LeaderElector(hub.leases, "b", now=clock.now)
+    a.try_acquire_or_renew()
+    a.release()
+    assert b.try_acquire_or_renew() is True, "vacated lease acquired"
+
+
+def test_only_leader_schedules():
+    hub = Hub()
+    hub.create_node(Node(
+        metadata=ObjectMeta(name="n", labels={LABEL_HOSTNAME: "n"}),
+        status=NodeStatus(allocatable={"cpu": "8", "memory": "16Gi",
+                                       "pods": "110"})))
+    cfg = default_config()
+    cfg.batch_size = 16
+    sched = Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64))
+    # another instance holds the lease
+    other = LeaderElector(hub.leases, "other")
+    assert other.try_acquire_or_renew()
+    follower = LeaderElector(hub.leases, "me", retry_period=0.01)
+    sched.start(elector=follower)
+    try:
+        p = Pod(metadata=ObjectMeta(name="p"),
+                spec=PodSpec(containers=[Container(
+                    name="c", resources=ResourceRequirements(
+                        requests={"cpu": "1"}))]))
+        hub.create_pod(p)
+        import time
+
+        time.sleep(0.5)
+        assert hub.get_pod(p.metadata.uid).spec.node_name == "", \
+            "a non-leader must not bind"
+        # the holder releases: our follower acquires and schedules
+        other.release()
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if hub.get_pod(p.metadata.uid).spec.node_name:
+                break
+            time.sleep(0.05)
+        assert hub.get_pod(p.metadata.uid).spec.node_name == "n"
+    finally:
+        sched.stop()
+        sched.close()
+
+
+# ---------------------------- extender ----------------------------
+
+
+class _StubExtender(BaseHTTPRequestHandler):
+    reject = set()
+    scores = {}
+    calls = []
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):  # noqa: N802
+        body = json.loads(self.rfile.read(
+            int(self.headers["Content-Length"])).decode())
+        type(self).calls.append((self.path, body))
+        if self.path.endswith("/filter"):
+            names = [n for n in body["nodenames"]
+                     if n not in type(self).reject]
+            out = {"nodenames": names,
+                   "failedNodes": {n: "vetoed" for n in type(self).reject
+                                   if n in body["nodenames"]}}
+        else:
+            out = [{"host": n, "score": type(self).scores.get(n, 0)}
+                   for n in body["nodenames"]]
+        data = json.dumps(out).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+def _with_stub(fn):
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _StubExtender)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        fn(f"http://127.0.0.1:{srv.server_address[1]}")
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def _cluster(url, managed=None):
+    hub = Hub()
+    for n in ("n0", "n1", "n2"):
+        hub.create_node(Node(
+            metadata=ObjectMeta(name=n, labels={LABEL_HOSTNAME: n}),
+            status=NodeStatus(allocatable={"cpu": "8", "memory": "16Gi",
+                                           "pods": "110"})))
+    cfg = default_config()
+    cfg.batch_size = 16
+    cfg.extenders = [ExtenderConfig(
+        url_prefix=url, filter_verb="filter", prioritize_verb="prioritize",
+        weight=100.0, managed_resources=managed or [])]
+    return hub, Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64))
+
+
+def test_extender_filter_vetoes_nodes():
+    _StubExtender.reject = {"n0", "n2"}
+    _StubExtender.scores = {}
+    _StubExtender.calls = []
+
+    def run(url):
+        hub, sched = _cluster(url)
+        p = Pod(metadata=ObjectMeta(name="p"),
+                spec=PodSpec(containers=[Container(
+                    name="c", resources=ResourceRequirements(
+                        requests={"cpu": "1"}))]))
+        hub.create_pod(p)
+        sched.run_until_idle()
+        assert hub.get_pod(p.metadata.uid).spec.node_name == "n1"
+        assert any(path.endswith("/filter")
+                   for path, _ in _StubExtender.calls)
+        sched.close()
+
+    _with_stub(run)
+
+
+def test_extender_prioritize_steers_choice():
+    _StubExtender.reject = set()
+    _StubExtender.scores = {"n2": 10}
+    _StubExtender.calls = []
+
+    def run(url):
+        hub, sched = _cluster(url)
+        p = Pod(metadata=ObjectMeta(name="p"),
+                spec=PodSpec(containers=[Container(
+                    name="c", resources=ResourceRequirements(
+                        requests={"cpu": "1"}))]))
+        hub.create_pod(p)
+        sched.run_until_idle()
+        assert hub.get_pod(p.metadata.uid).spec.node_name == "n2", \
+            "weighted extender score dominates"
+        sched.close()
+
+    _with_stub(run)
+
+
+def test_extender_managed_resources_gate():
+    _StubExtender.reject = {"n0", "n1", "n2"}
+    _StubExtender.calls = []
+
+    def run(url):
+        hub, sched = _cluster(url, managed=["example.com/fpga"])
+        plain = Pod(metadata=ObjectMeta(name="plain"),
+                    spec=PodSpec(containers=[Container(
+                        name="c", resources=ResourceRequirements(
+                            requests={"cpu": "1"}))]))
+        hub.create_pod(plain)
+        sched.run_until_idle()
+        assert hub.get_pod(plain.metadata.uid).spec.node_name, \
+            "uninterested extender never consulted"
+        assert not _StubExtender.calls
+        sched.close()
+
+    _with_stub(run)
+
+
+def test_extender_unreachable_nonignorable_fails_pod():
+    hub = Hub()
+    hub.create_node(Node(
+        metadata=ObjectMeta(name="n", labels={LABEL_HOSTNAME: "n"}),
+        status=NodeStatus(allocatable={"cpu": "8", "memory": "16Gi",
+                                       "pods": "110"})))
+    cfg = default_config()
+    cfg.batch_size = 16
+    cfg.extenders = [ExtenderConfig(
+        url_prefix="http://127.0.0.1:1", filter_verb="filter",
+        timeout_seconds=0.2)]
+    sched = Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64))
+    p = Pod(metadata=ObjectMeta(name="p"),
+            spec=PodSpec(containers=[Container(
+                name="c", resources=ResourceRequirements(
+                    requests={"cpu": "1"}))]))
+    hub.create_pod(p)
+    sched.run_until_idle()
+    assert hub.get_pod(p.metadata.uid).spec.node_name == ""
+    sched.close()
+
+
+def test_extender_unreachable_ignorable_skipped():
+    hub = Hub()
+    hub.create_node(Node(
+        metadata=ObjectMeta(name="n", labels={LABEL_HOSTNAME: "n"}),
+        status=NodeStatus(allocatable={"cpu": "8", "memory": "16Gi",
+                                       "pods": "110"})))
+    cfg = default_config()
+    cfg.batch_size = 16
+    cfg.extenders = [ExtenderConfig(
+        url_prefix="http://127.0.0.1:1", filter_verb="filter",
+        ignorable=True, timeout_seconds=0.2)]
+    sched = Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64))
+    p = Pod(metadata=ObjectMeta(name="p"),
+            spec=PodSpec(containers=[Container(
+                name="c", resources=ResourceRequirements(
+                    requests={"cpu": "1"}))]))
+    hub.create_pod(p)
+    sched.run_until_idle()
+    assert hub.get_pod(p.metadata.uid).spec.node_name == "n"
+    sched.close()
+
+
+def test_config_file_loading(tmp_path):
+    """cmd-level config loading: profiles, plugin args, extenders, knobs."""
+    from kubernetes_tpu.config.load import load_config
+
+    doc = {
+        "batch_size": 128,
+        "async_binding": False,
+        "profiles": [
+            {"scheduler_name": "default-scheduler",
+             "plugin_config": [
+                 {"name": "NodeResourcesFit",
+                  "args": {"scoring_strategy": {"type": "MostAllocated"}}}]},
+            {"scheduler_name": "second",
+             "plugins": {"score": {"disabled": [{"name": "ImageLocality"}]}}},
+        ],
+        "extenders": [
+            {"url_prefix": "http://127.0.0.1:9999", "filter_verb": "filter",
+             "weight": 3, "ignorable": True}],
+    }
+    path = tmp_path / "config.json"
+    path.write_text(json.dumps(doc))
+    cfg = load_config(str(path))
+    assert cfg.batch_size == 128
+    assert cfg.async_binding is False
+    assert [p.scheduler_name for p in cfg.profiles] == [
+        "default-scheduler", "second"]
+    assert cfg.profiles[0].plugin_config["NodeResourcesFit"][
+        "scoring_strategy"]["type"] == "MostAllocated"
+    assert cfg.extenders[0].weight == 3
+    assert cfg.extenders[0].ignorable is True
+    # the loaded config actually constructs a working scheduler
+    hub = Hub()
+    sched = Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64))
+    assert "second" in sched.frameworks
+    sched.close()
+
+
+def test_cli_validate_only(tmp_path):
+    from kubernetes_tpu.__main__ import main
+
+    path = tmp_path / "cfg.json"
+    path.write_text(json.dumps({"batch_size": 64}))
+    assert main(["--config", str(path), "--validate-only"]) == 0
+    path.write_text(json.dumps({"batch_size": 0}))
+    assert main(["--config", str(path), "--validate-only"]) == 1
