@@ -913,7 +913,8 @@ class Scheduler:
             state = CycleState()
             nominated, _s = self._fw_for(qp.pod).run_post_filter_plugins(
                 state, qp.pod, {"snapshot": self.snapshot,
-                                "reject_counts": reject_counts})
+                                "reject_counts": reject_counts,
+                                "host_rejects": qp.host_reject_counts})
             if nominated:
                 self.stats["preemptions"] = self.stats.get("preemptions",
                                                            0) + 1
