@@ -359,6 +359,40 @@ class Cache:
         with self._lock:
             return len(self._assumed_pods)
 
+    def compare_with_hub(self, hub) -> list[str]:
+        """The cache comparer (backend/cache/debugger/comparer.go
+        CompareNodes/ComparePods): diff the scheduler's view against API
+        truth; each discrepancy is one human-readable line. Assumed pods
+        are expected to lead the API (they are the optimistic writes), so
+        they are exempt from the bound-state checks."""
+        problems: list[str] = []
+        with self._lock:
+            cached_nodes = set(self._nodes)
+            cached_pods = {uid: st for uid, st in self._pod_states.items()}
+            assumed = set(self._assumed_pods)
+        hub_nodes = {n.metadata.name for n in hub.list_nodes()}
+        for name in sorted(cached_nodes - hub_nodes):
+            problems.append(f"node {name} in cache but not in apiserver")
+        for name in sorted(hub_nodes - cached_nodes):
+            problems.append(f"node {name} in apiserver but not in cache")
+        hub_pods = {p.metadata.uid: p for p in hub.list_pods()
+                    if p.spec.node_name}
+        for uid in sorted(set(cached_pods) - set(hub_pods) - assumed):
+            problems.append(
+                f"pod {cached_pods[uid].pod.key()} in cache but not bound "
+                "in apiserver")
+        for uid, p in sorted(hub_pods.items()):
+            st = cached_pods.get(uid)
+            if st is None:
+                problems.append(
+                    f"pod {p.key()} bound in apiserver but not in cache")
+            elif st.pod.spec.node_name != p.spec.node_name \
+                    and uid not in assumed:
+                problems.append(
+                    f"pod {p.key()} on {p.spec.node_name} in apiserver "
+                    f"but {st.pod.spec.node_name} in cache")
+        return problems
+
     def dump(self) -> dict:
         """Cache debugger surface (backend/cache/debugger): nodes + pods +
         assumed set, for the SIGUSR2-style comparer."""

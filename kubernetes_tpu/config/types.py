@@ -79,7 +79,12 @@ class SchedulerConfiguration:
 
     parallelism: int = 16
     profiles: list[SchedulerProfile] = field(default_factory=list)
-    percentage_of_nodes_to_score: Optional[int] = None  # 0/None = adaptive
+    # accepted for config parity; deliberately a NO-OP on device: the
+    # reference samples nodes to bound its serial goroutine fan-out
+    # (percentageOfNodesToScore), but one fused launch scores EVERY node in
+    # parallel for the same cost, so sampling would only lose placement
+    # quality
+    percentage_of_nodes_to_score: Optional[int] = None
     pod_initial_backoff_seconds: float = 1.0
     pod_max_backoff_seconds: float = 10.0
     # legacy HTTP extenders (extender.ExtenderConfig entries)

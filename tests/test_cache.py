@@ -200,3 +200,36 @@ def test_incremental_device_push_matches_full_upload():
     np.testing.assert_array_equal(np.asarray(blobs.node_f32), mirror.node_f32)
     np.testing.assert_array_equal(np.asarray(blobs.node_i32), mirror.node_i32)
     np.testing.assert_array_equal(np.asarray(blobs.pods_i32), mirror.pods_i32)
+
+
+def test_cache_comparer_against_hub():
+    """backend/cache/debugger/comparer.go CompareNodes/ComparePods."""
+    from kubernetes_tpu.hub import Hub
+
+    hub = Hub()
+    cache = Cache()
+    n = mknode("n0")
+    hub.create_node(n)
+    cache.add_node(n)
+    p = mkpod("p", node="n0")
+    hub.create_pod(p)
+    cache.add_pod(p)
+    assert cache.compare_with_hub(hub) == [], "consistent views"
+    # a node the cache never learned about
+    hub.create_node(mknode("n1"))
+    problems = cache.compare_with_hub(hub)
+    assert any("n1 in apiserver but not in cache" in s for s in problems)
+    cache.add_node(mknode("n1"))
+    # a pod bound in the hub the cache missed
+    q = mkpod("q", node="n1")
+    hub.create_pod(q)
+    problems = cache.compare_with_hub(hub)
+    assert any("bound in apiserver but not in cache" in s
+               for s in problems)
+    # assumed pods lead the API: not a discrepancy
+    cache.add_pod(q)
+    a = mkpod("a")
+    assumed = a.clone()
+    assumed.spec.node_name = "n0"
+    cache.assume_pod(assumed)
+    assert cache.compare_with_hub(hub) == []
