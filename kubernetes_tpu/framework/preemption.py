@@ -119,86 +119,16 @@ class Evaluator:
         mirror = self._get_mirror()
         caps = self._get_caps()
         prio = pod.priority()
-
-        # per-node victims ascending by importance (evict least-important
-        # first): priority asc, then start time desc (younger first).
-        # Nodes with no victims are skipped: the sweep only selects rows
-        # with 1 <= kmin <= len(victims), and an empty row can never win.
-        # CACHED across preemptors: a burst of same-priority preemptors
-        # (the PreemptionAsync shape) re-sweeps identical cluster state —
-        # key on (priority, node count, newest NodeInfo generation).
-        state_key = (prio, len(snapshot.node_info_list),
-                     max((ni.generation for ni in snapshot.node_info_list),
-                         default=0), mirror is self._sweep_cache_mirror)
-        cached = self._sweep_cache if state_key == self._sweep_cache_key \
-            else None
-        if cached is not None:
-            victims_by_row, k_cap, cumsum = cached
-            if not victims_by_row:
-                return []
-        else:
-            victims_by_row = {}
-            k_max = 0
-            for info in snapshot.node_info_list:
-                vs = [pi for pi in info.pods if pi.pod.priority() < prio]
-                if not vs:
-                    continue
-                row = mirror.row_of(info.name)
-                if row < 0:
-                    continue
-                vs.sort(key=lambda pi: (pi.pod.priority(),
-                                        -pi.pod.metadata.creation_timestamp))
-                victims_by_row[row] = vs
-                k_max = max(k_max, len(vs))
-            if k_max == 0:
-                self._sweep_cache_key = state_key
-                self._sweep_cache = ({}, 0, None)
-                self._sweep_cache_mirror = mirror
-                return []
-            k_cap = 1
-            while k_cap < k_max:
-                k_cap *= 2
-
-        # cumulative freed request per victim prefix (vectorized: the per-
-        # victim python accumulation was the preemption hot spot at 20k
-        # victims — one np.cumsum per node + a uid-keyed res-row cache)
-        n = caps.nodes
-        r = caps.res_cols
-        if self._res_rows_mirror is not mirror:
-            self._res_rows.clear()
-            self._res_rows_mirror = mirror
-        res_rows = self._res_rows
-        if len(res_rows) > 200_000:
-            res_rows.clear()
-        if cached is None:
-            cumsum = np.zeros((n, k_cap + 1, r), np.float32)
-            for row, vs in victims_by_row.items():
-                rows_k = []
-                for pi in vs:
-                    uid = pi.pod.metadata.uid
-                    rr = res_rows.get(uid)
-                    if rr is None:
-                        rr = np.asarray(mirror._res_row(pi.request),
-                                        np.float32)
-                        res_rows[uid] = rr
-                    rows_k.append(rr)
-                acc = np.cumsum(np.stack(rows_k), axis=0)      # [k, R]
-                acc[:, F.COL_PODS] = np.arange(1, len(vs) + 1,
-                                               dtype=np.float32)
-                cumsum[row, 1: len(vs) + 1] = acc
-                if len(vs) < k_cap:
-                    cumsum[row, len(vs) + 1:] = acc[-1]  # pad: no extras
-            cumsum = jnp.asarray(cumsum)   # device-resident: a preemptor
-            # burst re-sweeps the same state without re-uploading ~MBs
-            self._sweep_cache_key = state_key
-            self._sweep_cache = (victims_by_row, k_cap, cumsum)
-            self._sweep_cache_mirror = mirror
+        prep = self._collect_victims(prio, snapshot, mirror, caps)
+        if prep is None:
+            return []
+        victims_by_row, k_cap, cumsum = prep
 
         pblobs = mirror.pack_batch_blobs([pod], 1)
         cblobs = mirror.to_blobs()
         kmin = np.asarray(preempt_sweep_jit(
             cblobs, pblobs, mirror.well_known(), cumsum, caps,
-            self._get_enabled_filters(pod)))
+            self._get_enabled_filters(pod)))[0]
         self._kmin = kmin                     # reused by _minimize_victims
         self._victims_by_row = victims_by_row
 
@@ -230,15 +160,17 @@ class Evaluator:
             picked = [rows[(off + i) % len(rows)]
                       for i in range(min(want, len(rows)))]
             pdbs = self.hub.list_pdbs()
-            return [Candidate(
-                node_name=mirror.name_of_row(row) or "", row=row,
-                victims=[pi.pod
-                         for pi in victims_by_row[row][: int(kmin[row])]],
-                pdb_violations=self._pdb_violations(
-                    [pi.pod
-                     for pi in victims_by_row[row][: int(kmin[row])]],
-                    pdbs))
-                for row in picked]
+            free_mat = mirror.free_matrix()
+            out = []
+            for row in picked:
+                vs = self._reprieve_by_resources(
+                    [pi.pod for pi in victims_by_row[row][: int(kmin[row])]],
+                    pod, row, free_mat)
+                out.append(Candidate(
+                    node_name=mirror.name_of_row(row) or "", row=row,
+                    victims=vs,
+                    pdb_violations=self._pdb_violations(vs, pdbs)))
+            return out
 
         all_uids = {pi.pod.metadata.uid
                     for vs in victims_by_row.values() for pi in vs}
@@ -501,6 +433,188 @@ class Evaluator:
                 except Exception:  # noqa: BLE001
                     pass
         return len(work)
+
+    def _reprieve_by_resources(self, victims: list[Pod], pod: Pod,
+                               row: int, free_mat: np.ndarray) -> list[Pod]:
+        """The reference's reprieve pass, host-side: walk the victim set
+        most-important-first (oldest first at equal priority) and re-add
+        any victim whose eviction is NOT needed for the preemptor's
+        resource fit (default_preemption.go:219's re-add loop). Pure
+        arithmetic — the kmin prefix can contain useless small victims
+        (e.g. freshly-bound tiny pods sorted youngest-first) that must
+        never be evicted. ``free_mat`` is one hoisted free_matrix() copy
+        per failure batch. The effective free mirrors the sweep's fit
+        base: nominated reservations subtracted, the pod's OWN nomination
+        handed back."""
+        mirror = self._get_mirror()
+        free = np.asarray(free_mat[row], np.float32)
+        req = np.asarray(self._res_row_cached(pod), np.float32)
+        nom = getattr(mirror, "_nominated_req_of_row", {}).get(row)
+        if nom is not None:
+            free = free - np.asarray(nom, np.float32)
+        if pod.status.nominated_node_name \
+                and mirror.row_of(pod.status.nominated_node_name) == row:
+            free = free + req
+        needed = np.maximum(req - free, 0.0)
+        freed = np.zeros_like(req)
+        rows = {}
+        for v in victims:
+            rows[v.metadata.uid] = self._res_row_cached(v)
+            freed = freed + rows[v.metadata.uid]
+        kept: list[Pod] = list(victims)
+        # most important first: priority desc, oldest first
+        for v in sorted(victims,
+                        key=lambda q: (-q.priority(),
+                                       q.metadata.creation_timestamp)):
+            if len(kept) <= 1:
+                break
+            trial = freed - rows[v.metadata.uid]
+            if np.all(trial >= needed):
+                freed = trial
+                kept.remove(v)
+        return kept
+
+    def _collect_victims(self, prio: int, snapshot, mirror, caps):
+        """(victims_by_row, k_cap, device cumsum) for preemptors of
+        ``prio``, or None when nothing is evictable.
+
+        Per-node victims sort ascending by importance (evict
+        least-important first): priority asc, then start time desc.
+        Nodes with no victims are skipped: the sweep only selects rows
+        with 1 <= kmin <= len(victims), and an empty row can never win.
+        CACHED across preemptors: a burst of same-priority preemptors
+        (the PreemptionAsync shape) re-sweeps identical cluster state —
+        keyed on (priority, node count, newest NodeInfo generation) with
+        the cumsum kept device-resident so the burst never re-uploads."""
+        state_key = (prio, len(snapshot.node_info_list),
+                     max((ni.generation for ni in snapshot.node_info_list),
+                         default=0), mirror is self._sweep_cache_mirror)
+        if state_key == self._sweep_cache_key:
+            return self._sweep_cache if self._sweep_cache[0] else None
+        victims_by_row = {}
+        k_max = 0
+        for info in snapshot.node_info_list:
+            vs = [pi for pi in info.pods if pi.pod.priority() < prio]
+            if not vs:
+                continue
+            row = mirror.row_of(info.name)
+            if row < 0:
+                continue
+            vs.sort(key=lambda pi: (pi.pod.priority(),
+                                    -pi.pod.metadata.creation_timestamp))
+            victims_by_row[row] = vs
+            k_max = max(k_max, len(vs))
+        if k_max == 0:
+            self._sweep_cache_key = state_key
+            self._sweep_cache = ({}, 0, None)
+            self._sweep_cache_mirror = mirror
+            return None
+        k_cap = 1
+        while k_cap < k_max:
+            k_cap *= 2
+        # cumulative freed request per victim prefix (vectorized: the
+        # per-victim python accumulation was the preemption hot spot at
+        # 20k victims — one np.cumsum per node + a uid-keyed res-row cache)
+        n = caps.nodes
+        r = caps.res_cols
+        if self._res_rows_mirror is not mirror:
+            self._res_rows.clear()
+            self._res_rows_mirror = mirror
+        res_rows = self._res_rows
+        if len(res_rows) > 200_000:
+            res_rows.clear()
+        cumsum = np.zeros((n, k_cap + 1, r), np.float32)
+        for row, vs in victims_by_row.items():
+            rows_k = []
+            for pi in vs:
+                uid = pi.pod.metadata.uid
+                rr = res_rows.get(uid)
+                if rr is None:
+                    rr = np.asarray(mirror._res_row(pi.request), np.float32)
+                    res_rows[uid] = rr
+                rows_k.append(rr)
+            acc = np.cumsum(np.stack(rows_k), axis=0)          # [k, R]
+            acc[:, F.COL_PODS] = np.arange(1, len(vs) + 1,
+                                           dtype=np.float32)
+            cumsum[row, 1: len(vs) + 1] = acc
+            if len(vs) < k_cap:
+                cumsum[row, len(vs) + 1:] = acc[-1]  # pad: no extras
+        cumsum = jnp.asarray(cumsum)       # device-resident for the burst
+        self._sweep_cache_key = state_key
+        self._sweep_cache = (victims_by_row, k_cap, cumsum)
+        self._sweep_cache_mirror = mirror
+        return self._sweep_cache
+
+    def batch_preempt(self, jobs, snapshot) -> dict:
+        """ONE sweep launch for a whole burst of fit-only preemptors of
+        equal priority (the PreemptionAsync shape): returns
+        {uid: (nominated_node | None, Status)}. Nodes and victims are
+        assigned burst-locally so two preemptors never target the same
+        capacity (the per-pod path only discovers that next cycle)."""
+        self.cache_snapshot = snapshot.node_info_map
+        mirror = self._get_mirror()
+        caps = self._get_caps()
+        out: dict[str, tuple] = {}
+        jobs = list(jobs)
+        if not jobs:
+            return out
+        prio = jobs[0].pod.priority()
+        prep = self._collect_victims(prio, snapshot, mirror, caps)
+        if prep is None:
+            return {qp.uid: (None, Status.unschedulable(
+                "no preemption candidates", plugin="DefaultPreemption"))
+                for qp in jobs}
+        victims_by_row, k_cap, cumsum = prep
+        free_mat = mirror.free_matrix()
+        pods = [qp.pod for qp in jobs]
+        # ONE fixed sweep width: a varying pow2 bucket would compile a new
+        # program per burst size (each compile stalls the whole drain);
+        # oversized bursts chunk through the same program
+        P_CAP = 16
+        kmin_rows = []
+        for start in range(0, len(pods), P_CAP):
+            chunk = pods[start:start + P_CAP]
+            pblobs = mirror.pack_batch_blobs(chunk, P_CAP)
+            kmin_rows.append(np.asarray(preempt_sweep_jit(
+                mirror.to_blobs(), pblobs, mirror.well_known(), cumsum,
+                caps, self._get_enabled_filters(chunk[0])))[: len(chunk)])
+        kmin_all = np.concatenate(kmin_rows, axis=0)
+        pdbs = self.hub.list_pdbs()
+        used_rows: set[int] = set()
+        for j, qp in enumerate(jobs):
+            kmin = kmin_all[j]
+            ok, why = self.pod_eligible_to_preempt_others(qp.pod)
+            if not ok:
+                out[qp.uid] = (None, Status.unschedulable(
+                    f"not eligible for preemption: {why}",
+                    plugin="DefaultPreemption"))
+                continue
+            rows = [row for row, vs in victims_by_row.items()
+                    if row not in used_rows
+                    and kmin[row] != NONE and 1 <= kmin[row] <= len(vs)]
+            if not rows:
+                out[qp.uid] = (None, Status.unschedulable(
+                    "no preemption candidates",
+                    plugin="DefaultPreemption"))
+                continue
+            candidates = []
+            for row in rows[:MAX_VERIFY_CANDIDATES]:
+                vs = self._reprieve_by_resources(
+                    [pi.pod for pi in victims_by_row[row][: int(kmin[row])]],
+                    qp.pod, row, free_mat)
+                candidates.append(Candidate(
+                    node_name=mirror.name_of_row(row) or "", row=row,
+                    victims=vs,
+                    pdb_violations=self._pdb_violations(vs, pdbs)))
+            best = self.select_candidate(candidates)
+            if self.metrics is not None:
+                self.metrics.preemption_attempts.inc()
+                self.metrics.preemption_victims.observe(len(best.victims))
+            self.prepare_candidate(best, qp.pod)
+            self.nominator.add(qp.pod, best.node_name)
+            used_rows.add(best.row)
+            out[qp.uid] = (best.node_name, Status())
+        return out
 
     # ---------------- the whole PostFilter flow ----------------
 

@@ -46,38 +46,41 @@ def preempt_sweep(cblobs: ClusterBlobs, pblobs: PodBlobs,
                   caps: Capacities,
                   enabled_filters: tuple[bool, ...] | None = None
                   ) -> jnp.ndarray:
-    """[N] i32: minimal victim count k (1..K) making the pod fit on each
-    node; NONE where preemption cannot help (static filter fails, request
-    exceeds allocatable, or even evicting every victim is not enough).
+    """[P, N] i32: minimal victim count k (1..K) making each pod fit on
+    each node; NONE where preemption cannot help (static filter fails,
+    request exceeds allocatable, or even evicting every victim is not
+    enough). A whole burst of preemptors sweeps in ONE launch.
 
-    pblobs carries ONE pod (batch axis 1); vic_cumsum [N, K+1, R] f32 is the
-    cumulative freed request of the first k victims (k=0 row is zero)."""
+    pblobs carries P pods; vic_cumsum [N, K+1, R] f32 is the cumulative
+    freed request of the first k victims (k=0 row is zero)."""
     if enabled_filters is None:
         enabled_filters = (True,) * NUM_FILTER_PLUGINS
     ct = unpack_cluster(cblobs, caps)
-    pod = jax.tree_util.tree_map(lambda x: x[0], unpack_pods(pblobs, caps))
+    pods = unpack_pods(pblobs, caps)       # [P, ...] — BATCHED preemptors
 
-    # the sweep runs off the hot path: evaluate every static filter (no
-    # workload-activity DCE)
-    masks = static_filters(ct, pod, wk, enabled_filters,
-                           frozenset(ALL_FEATURES))            # [5, N]
-    static_ok = jnp.all(masks, axis=0) & ct.node_valid
-    unresolvable = jnp.any(pod.req[None] > ct.allocatable, axis=-1)
+    def per_pod(pod):
+        # the sweep runs off the hot path: evaluate every static filter
+        # (no workload-activity DCE)
+        masks = static_filters(ct, pod, wk, enabled_filters,
+                               frozenset(ALL_FEATURES))        # [5, N]
+        static_ok = jnp.all(masks, axis=0) & ct.node_valid & pod.valid
+        unresolvable = jnp.any(pod.req[None] > ct.allocatable, axis=-1)
+        # fit after evicting the first k victims, against the same
+        # effective free as the pipeline's fit check (nominated
+        # reservations subtracted, own nomination handed back): [N, K+1]
+        own = (jnp.arange(ct.free.shape[0]) == pod.nominated_row)
+        base = (ct.free - ct.nominated_req
+                + jnp.where(own[:, None], pod.req[None], 0.0))
+        eff = base[:, None, :] + vic_cumsum
+        fit = jnp.all(pod.req[None, None] <= eff, axis=-1)
+        # minimal k with a fit (k=0 would mean it already fits — the
+        # caller only sweeps rejected pods, but guard anyway)
+        kmin = jnp.argmax(fit, axis=1).astype(jnp.int32)       # first True
+        any_fit = jnp.any(fit, axis=1)
+        ok = static_ok & ~unresolvable & any_fit
+        return jnp.where(ok, kmin, jnp.int32(NONE))
 
-    # fit after evicting the first k victims, against the same effective
-    # free as the pipeline's fit check (nominated reservations subtracted,
-    # the pod's own nomination handed back): [N, K+1]
-    own = (jnp.arange(ct.free.shape[0]) == pod.nominated_row)
-    base = (ct.free - ct.nominated_req
-            + jnp.where(own[:, None], pod.req[None], 0.0))
-    eff = base[:, None, :] + vic_cumsum
-    fit = jnp.all(pod.req[None, None] <= eff, axis=-1)
-    # minimal k with a fit (k=0 would mean it already fits — the caller only
-    # sweeps pods the pipeline rejected, but guard anyway)
-    kmin = jnp.argmax(fit, axis=1).astype(jnp.int32)           # first True
-    any_fit = jnp.any(fit, axis=1)
-    ok = static_ok & ~unresolvable & any_fit
-    return jnp.where(ok, kmin, jnp.int32(NONE))
+    return jax.vmap(per_pod)(pods)         # [P, N]
 
 
 @partial(jax.jit, static_argnames=("caps", "enabled_filters"))

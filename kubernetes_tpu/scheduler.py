@@ -680,11 +680,14 @@ class Scheduler:
         rows = np.asarray(rows)[:n].tolist()
         rejects = np.asarray(rejects)[:n].tolist()
         t1 = self.now()
+        failures = []
         for qp, row, rej in zip(runnable, rows, rejects):
             if row >= 0:
                 self._commit(qp, self.mirror.name_of_row(row))
             else:
-                self._fail(qp, rej)
+                failures.append((qp, rej))
+        if failures:
+            self._handle_failures(failures)
         commit_s = self.now() - t1
         cycle_s = pack_s + launch_s + commit_s
         m = self.metrics
@@ -884,51 +887,84 @@ class Scheduler:
             self._undo_commit(wp.qp, wp.state, assumed, wp.node_name,
                               s.message(), rejected_by=s.plugin or "Permit")
 
-    def _fail(self, qp: QueuedPodInfo, reject_counts: list[int]) -> None:
-        """handleSchedulingFailure (schedule_one.go:1015): run PostFilter
-        (preemption) first, record the rejecting plugins for queueing hints,
-        patch the PodScheduled condition (+ NominatedNodeName), park in
-        unschedulable."""
-        # NOTE: auction-mode (parallel-rounds) launches attribute
-        # reject_counts against END-state capacity, not the state each pod
-        # was evaluated under mid-drain (pipeline._rounds_commit) — plugin
-        # attribution is exact, counts are post-drain. The serial scan is
-        # exact per step.
-        plugins = {FILTER_PLUGINS[i] for i, c in enumerate(reject_counts)
-                   if c > 0}
-        plugins |= set(qp.host_reject_counts)
-        qp.unschedulable_plugins = plugins or {"NodeResourcesFit"}
-        qp.unschedulable_count += 1
-        qp.consecutive_errors_count = 0
-        self.stats["unschedulable"] += 1
-        self.metrics.schedule_attempts.inc(
-            result="unschedulable", profile=qp.pod.spec.scheduler_name)
-        nominated = None
-        if self._fw_for(qp.pod).points["post_filter"]:
-            # chained launches skip the per-batch sync; the preemption
-            # dry-run reads the host snapshot + mirror, so refresh them
-            # (O(1) when already clean)
+    def _handle_failures(self, failures: list[tuple]) -> None:
+        """handleSchedulingFailure (schedule_one.go:1015) for a whole
+        batch: record diagnoses, run PostFilter (preemption), patch
+        conditions, park. Fit-only rejections of equal priority share ONE
+        batched preemption sweep (Evaluator.batch_preempt) — a churn of
+        identical preemptors costs one launch, not one per pod, and burst
+        members never target the same capacity."""
+        fit_idx = FILTER_PLUGINS.index("NodeResourcesFit")
+        prepped = []
+        any_pf = False
+        for qp, reject_counts in failures:
+            # NOTE: auction-mode (parallel-rounds) launches attribute
+            # reject_counts against END-state capacity, not the state each
+            # pod was evaluated under mid-drain (_rounds_commit) — plugin
+            # attribution is exact, counts are post-drain. The serial scan
+            # is exact per step.
+            plugins = {FILTER_PLUGINS[i]
+                       for i, c in enumerate(reject_counts) if c > 0}
+            plugins |= set(qp.host_reject_counts)
+            qp.unschedulable_plugins = plugins or {"NodeResourcesFit"}
+            qp.unschedulable_count += 1
+            qp.consecutive_errors_count = 0
+            self.stats["unschedulable"] += 1
+            self.metrics.schedule_attempts.inc(
+                result="unschedulable", profile=qp.pod.spec.scheduler_name)
+            has_pf = bool(self._fw_for(qp.pod).points["post_filter"])
+            fit_only = (not qp.host_reject_counts
+                        and all(c == 0 for i, c in enumerate(reject_counts)
+                                if i != fit_idx))
+            any_pf = any_pf or has_pf
+            prepped.append((qp, reject_counts, plugins, has_pf, fit_only))
+        nominated_by_uid: dict[str, str | None] = {}
+        if any_pf:
+            # chained launches skip the per-batch sync; preemption reads
+            # the host snapshot + mirror, so refresh (O(1) when clean)
             self.cache.update_snapshot(self.snapshot)
             self.mirror.sync(self.snapshot)
-            state = CycleState()
-            nominated, _s = self._fw_for(qp.pod).run_post_filter_plugins(
-                state, qp.pod, {"snapshot": self.snapshot,
-                                "reject_counts": reject_counts,
-                                "host_rejects": qp.host_reject_counts})
-            if nominated:
-                self.stats["preemptions"] = self.stats.get("preemptions",
-                                                           0) + 1
-        self.hub.patch_pod_condition(qp.pod, PodCondition(
-            type="PodScheduled", status="False", reason="Unschedulable",
-            message=f"rejected by {sorted(plugins)}"),
-            nominated_node=nominated)
-        # the patch fired while this pod was in-flight (the queue ignores
-        # updates for in-flight pods), so park the FRESH object — the packed
-        # nominated_row must see status.nominatedNodeName next attempt
-        stored = self.hub.get_pod(qp.uid)
-        if stored is not None:
-            qp.pod = stored
-        self.queue.add_unschedulable_if_not_present(qp)
+            # batched sweep for fit-only preemptors, grouped by priority
+            # grouped by (priority, profile): the sweep applies ONE
+            # enabled-filter set per chunk, which is per-profile state
+            groups: dict[tuple, list] = {}
+            for qp, _rej, _pl, has_pf, fit_only in prepped:
+                if has_pf and fit_only:
+                    groups.setdefault(
+                        (qp.pod.priority(), qp.pod.spec.scheduler_name),
+                        []).append(qp)
+            for _key, qps in groups.items():
+                results = self.preemption.batch_preempt(qps, self.snapshot)
+                for uid, (node, _status) in results.items():
+                    nominated_by_uid[uid] = node
+                    if node:
+                        self.stats["preemptions"] = self.stats.get(
+                            "preemptions", 0) + 1
+        for qp, reject_counts, plugins, has_pf, fit_only in prepped:
+            if has_pf and not fit_only:
+                state = CycleState()
+                nominated, _s = self._fw_for(
+                    qp.pod).run_post_filter_plugins(
+                    state, qp.pod, {"snapshot": self.snapshot,
+                                    "reject_counts": reject_counts,
+                                    "host_rejects": qp.host_reject_counts})
+                if nominated:
+                    self.stats["preemptions"] = self.stats.get(
+                        "preemptions", 0) + 1
+            else:
+                nominated = nominated_by_uid.get(qp.uid)
+            self.hub.patch_pod_condition(qp.pod, PodCondition(
+                type="PodScheduled", status="False", reason="Unschedulable",
+                message=f"rejected by {sorted(plugins)}"),
+                nominated_node=nominated)
+            # the patch fired while this pod was in-flight (the queue
+            # ignores updates for in-flight pods), so park the FRESH
+            # object — the packed nominated_row must see
+            # status.nominatedNodeName next attempt
+            stored = self.hub.get_pod(qp.uid)
+            if stored is not None:
+                qp.pod = stored
+            self.queue.add_unschedulable_if_not_present(qp)
 
     def _error(self, qp: QueuedPodInfo, msg: str) -> None:
         """Error-class failure: separate backoff counter
@@ -1069,6 +1105,13 @@ class Scheduler:
             self._process_deferred_events()
             self._process_waiting()
             self._drain_bind_results()
+            # the 1s backoff flush must tick DURING a busy drain too (the
+            # reference runs it as a goroutine): under continuous load the
+            # idle branch never runs and backoff pods would starve
+            now = self.now()
+            if now - self._last_backoff_flush >= 1.0:
+                self._last_backoff_flush = now
+                self.queue.flush_backoff_completed()
             if on_step is not None and on_step():
                 break
             popped, runnable = self._pop_runnable()
