@@ -40,13 +40,13 @@ def main() -> None:
     import jax
 
     from kubernetes_tpu.perf.harness import run_workload
-    from kubernetes_tpu.perf.workloads import ALL_WORKLOADS
+    from kubernetes_tpu.perf.workloads import BENCH_WORKLOADS
 
     smoke = "--smoke" in sys.argv
     print(f"platform: {jax.devices()[0].platform}", file=sys.stderr)
     results = {}
     headline = None
-    for factory in ALL_WORKLOADS:
+    for factory in BENCH_WORKLOADS:
         # warmup: same capacities => same jitted program shapes; tiny counts
         t0 = time.time()
         run_workload(factory(), scale=0.005)

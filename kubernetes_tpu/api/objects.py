@@ -312,13 +312,20 @@ class Pod:
         """Copy safe for *assigning* top-level metadata/spec/status fields (the
         only mutations the scheduler performs: nodeName, conditions,
         nominatedNodeName, labels). Deeper structures (containers, affinity,
-        tolerations...) are shared and must never be mutated in place."""
-        c = replace(
-            self,
-            metadata=replace(self.metadata, labels=dict(self.metadata.labels)),
-            spec=replace(self.spec),
-            status=replace(self.status, conditions=list(self.status.conditions)),
-        )
+        tolerations...) are shared and must never be mutated in place.
+
+        Shallow ``copy.copy`` per level instead of dataclasses.replace: the
+        clone runs once per commit and per hub write — replace() re-derives
+        the field list every call and was the hottest line of the commit
+        path."""
+        import copy as _copy
+
+        c = _copy.copy(self)
+        c.metadata = _copy.copy(self.metadata)
+        c.metadata.labels = dict(self.metadata.labels)
+        c.spec = _copy.copy(self.spec)
+        c.status = _copy.copy(self.status)
+        c.status.conditions = list(self.status.conditions)
         # containers/overhead are shared, so the parsed resource-request memo
         # (api.resources.pod_request) stays valid for the copy
         memo = self.__dict__.get("_request_memo")

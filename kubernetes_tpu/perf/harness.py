@@ -71,11 +71,15 @@ class CreatePods:
 
 @dataclass
 class Churn:
-    """churnOp mode=create: once reached, inject one pod per template
-    every ``interval_ms`` while subsequent ops drain."""
+    """churnOp (scheduler_perf.go:819): once reached, inject one object
+    per template every ``interval_ms`` while subsequent ops drain.
+    mode=create keeps creating; mode=recreate deletes the previous copy of
+    each template first, keeping ``number`` alive (the MixedChurn shape).
+    Templates may build Pods or Nodes."""
 
-    templates: list[Callable[[int], Pod]]
+    templates: list[Callable[[int], object]]
     interval_ms: int = 200
+    mode: str = "create"
 
 
 @dataclass
@@ -107,18 +111,44 @@ class _ChurnState:
         self.op = op
         self.t0 = now()
         self.created = 0
+        # mode=recreate: previous live copy per template index
+        self._live: dict[int, object] = {}
 
     def due(self, t: float) -> int:
         return int((t - self.t0) * 1000.0 / self.op.interval_ms)
+
+    def _create(self, hub: Hub, obj, i: int) -> None:
+        from kubernetes_tpu.api.objects import Node
+
+        obj.metadata.name = f"churn-{obj.metadata.name}-{i}"
+        if isinstance(obj, Node):
+            hub.create_node(obj)
+        else:
+            hub.create_pod(obj)
+
+    def _delete(self, hub: Hub, obj) -> None:
+        from kubernetes_tpu.api.objects import Node
+
+        try:
+            if isinstance(obj, Node):
+                hub.delete_node(obj.metadata.uid)
+            else:
+                hub.delete_pod(obj.metadata.uid)
+        except Exception:  # noqa: BLE001 — already gone is fine
+            pass
 
     def inject(self, hub: Hub, t: float) -> None:
         want = self.due(t)
         while self.created < want:
             i = self.created
-            tmpl = self.op.templates[i % len(self.op.templates)]
-            pod = tmpl(i)
-            pod.metadata.name = f"churn-{pod.metadata.name}-{i}"
-            hub.create_pod(pod)
+            ti = i % len(self.op.templates)
+            obj = self.op.templates[ti](i)
+            if self.op.mode == "recreate":
+                prev = self._live.pop(ti, None)
+                if prev is not None:
+                    self._delete(hub, prev)
+                self._live[ti] = obj
+            self._create(hub, obj, i)
             self.created += 1
 
 

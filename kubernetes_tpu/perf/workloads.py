@@ -201,10 +201,64 @@ def preemption_async(init_nodes=5000, init_pods=20000,
         ])
 
 
-ALL_WORKLOADS = (
+# ------------------------------------------- 6. Unschedulable
+# misc/performance-config.yaml:280+ (5kNodes/100Init/10kPods, 140): a
+# 200ms churn of 9-CPU high-priority pods that can NEVER fit a 4-CPU node
+# parks in the unschedulable pool; the measured default pods must flow
+# past them (the queueing-hint discipline this workload exists to test).
+
+def _large_cpu_pod(i: int) -> Pod:
+    return _pod(f"big-{i}", cpu="9", mem="500Mi", priority=10)
+
+
+def unschedulable(init_nodes=5000, init_pods=100,
+                  measure_pods=10000) -> Workload:
+    return Workload(
+        name="Unschedulable/5kNodes_100Init_10kPods",
+        threshold=140,
+        ops=[
+            CreateNodes(init_nodes, _node),
+            CreatePods(init_pods, lambda i: _pod(f"init-{i}")),
+            Churn([_large_cpu_pod], interval_ms=200),
+            CreatePods(measure_pods, lambda i: _pod(f"measure-{i}"),
+                       collect_metrics=True),
+        ])
+
+
+# ------------------------------------- 7. SchedulingWithMixedChurn
+# misc/performance-config.yaml:360+ (5000Nodes_10000Pods, 265): a 1s
+# recreate-churn of {node, unschedulable high-priority pod} while 10k
+# default pods schedule (the reference's template set also recreates a
+# Service, which has no scheduler-visible effect here).
+
+def _churn_node(i: int) -> object:
+    return _node(100000 + i)
+
+
+def mixed_churn(init_nodes=5000, measure_pods=10000) -> Workload:
+    return Workload(
+        name="SchedulingWithMixedChurn/5000Nodes_10000Pods",
+        threshold=265,
+        ops=[
+            CreateNodes(init_nodes, _node),
+            Churn([_churn_node, _large_cpu_pod], interval_ms=1000,
+                  mode="recreate"),
+            CreatePods(measure_pods, lambda i: _pod(f"measure-{i}"),
+                       collect_metrics=True),
+        ])
+
+
+# the 5 BASELINE.json configs bench.py runs within the driver's budget
+BENCH_WORKLOADS = (
     scheduling_basic,
     scheduling_node_affinity,
     scheduling_pod_anti_affinity,
     topology_spreading,
     preemption_async,
+)
+
+# the full suite (python -c "...run any of these on demand")
+ALL_WORKLOADS = BENCH_WORKLOADS + (
+    unschedulable,
+    mixed_churn,
 )

@@ -122,3 +122,33 @@ def test_anti_affinity_pod_template():
     assert p.metadata.namespace == "sched-1"
     terms = p.spec.affinity.pod_anti_affinity.required
     assert terms[0].namespaces == ["sched-1", "sched-0"]
+
+
+def test_unschedulable_workload_tiny():
+    """Parked unschedulable churn pods must not block the measured flow."""
+    from kubernetes_tpu.perf.workloads import unschedulable
+
+    w = small(unschedulable(init_nodes=4, init_pods=2, measure_pods=10))
+    r = run_workload(w)
+    assert r["pods_scheduled"] == 10
+
+
+def test_mixed_churn_workload_tiny():
+    from kubernetes_tpu.perf.workloads import mixed_churn
+
+    w = small(mixed_churn(init_nodes=4, measure_pods=10))
+    r = run_workload(w)
+    assert r["pods_scheduled"] == 10
+
+
+def test_churn_recreate_keeps_one_alive():
+    from kubernetes_tpu.hub import Hub
+    from kubernetes_tpu.perf.harness import Churn, _ChurnState
+
+    t = [1000.0]
+    hub = Hub()
+    st = _ChurnState(Churn([lambda i: _pod(f"c{i}")], interval_ms=100,
+                           mode="recreate"), now=lambda: t[0])
+    t[0] = 1000.55
+    st.inject(hub, t[0])
+    assert len(hub.list_pods()) == 1, "recreate keeps exactly one copy"
