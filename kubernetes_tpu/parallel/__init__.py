@@ -1,0 +1,60 @@
+"""Mesh/sharding layouts for the scheduling pipeline (SURVEY §5.7/§5.8).
+
+The framework's parallelism axes map onto a `jax.sharding.Mesh`:
+
+- **nodes** — the data-parallel axis. Node-table blobs shard row-wise;
+  per-(pod, node) masks/scores compute locally per shard; argmax and
+  normalization reductions become XLA collectives riding ICI.
+- **pods** — the batch axis. Pod blobs and per-pod outputs shard across
+  it; phase-1 (parallel Filter/Score) is embarrassingly parallel in both
+  axes at once, which is what the 2-D layout exploits on pods x nodes
+  meshes (the commit scan stays sequential in pods by design, so the pods
+  axis benefits phase-1 and the auction).
+
+`pipeline_shardings` returns the canonical in_shardings for
+`models.pipeline.schedule_batch` on either layout; the driver dryrun and
+tests/test_multichip.py consume it so they cannot diverge.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubernetes_tpu.ops.features import ClusterBlobs
+
+
+def node_mesh(devices, name: str = "nodes") -> Mesh:
+    """1-D mesh: every device holds a slice of the node table."""
+    import numpy as np
+
+    return Mesh(np.asarray(devices), (name,))
+
+
+def pods_nodes_mesh(devices, pods_axis: int) -> Mesh:
+    """2-D mesh [pods, nodes]: phase-1 work tiles over both axes."""
+    import numpy as np
+
+    devs = np.asarray(devices)
+    assert devs.size % pods_axis == 0, \
+        f"{devs.size} devices do not split into pods axis {pods_axis}"
+    return Mesh(devs.reshape(pods_axis, devs.size // pods_axis),
+                ("pods", "nodes"))
+
+
+def pipeline_shardings(mesh: Mesh, pblobs, wk, weights):
+    """in_shardings for schedule_batch(cblobs, pblobs, wk, weights) on a
+    ('nodes',) or ('pods', 'nodes') mesh: node-table blobs shard on the
+    node axis, pod blobs shard on the pods axis when present, small
+    operands replicate."""
+    has_pods = "pods" in mesh.axis_names
+    sh_nodes = NamedSharding(mesh, P("nodes", None))
+    sh_pods = NamedSharding(mesh, P("pods", None)) if has_pods else None
+    sh_rep = NamedSharding(mesh, P())
+    cluster_sh = ClusterBlobs(node_f32=sh_nodes, node_i32=sh_nodes,
+                              pods_i32=sh_rep)
+    pod_sh = jax.tree_util.tree_map(
+        lambda _: sh_pods if has_pods else sh_rep, pblobs)
+    wk_sh = {k: sh_rep for k in wk}
+    w_sh = jax.tree_util.tree_map(lambda _: sh_rep, weights)
+    return (cluster_sh, pod_sh, wk_sh, w_sh)
