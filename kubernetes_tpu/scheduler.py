@@ -702,11 +702,15 @@ class Scheduler:
                 per_pod, result="scheduled" if row >= 0 else "unschedulable")
         if cycle_s > SLOW_CYCLE_SECONDS:
             # schedule_one.go:404's slow-attempt trace, batch-shaped
-            logger.info(
-                "slow scheduling cycle: %.0fms for %d pods "
-                "(pack %.0fms, launch %.0fms, commit %.0fms)",
-                cycle_s * 1e3, n, pack_s * 1e3, launch_s * 1e3,
-                commit_s * 1e3)
+            from kubernetes_tpu.utils.tracing import Trace
+
+            tr = Trace("schedule_cycle", pods=n,
+                       scheduled=sum(1 for r in rows if r >= 0))
+            tr.start -= cycle_s     # reconstruct from measured phases
+            tr.steps = [("pack+host_plugins", pack_s, 0),
+                        ("device_launch", launch_s, 0),
+                        ("commit+bind", commit_s, 0)]
+            tr.log_if_long(SLOW_CYCLE_SECONDS, logger)
 
     def schedule_one_batch(self) -> int:
         """Pop up to batch_size pods, run one device launch, commit results.

@@ -1,0 +1,58 @@
+"""Lightweight scheduling traces.
+
+The slice of the reference's tracing the scheduler actually uses
+(utiltrace in schedule_one.go:404 + the component-base/tracing spans):
+nested timed steps collected per operation, logged ONLY when the whole
+operation exceeds its threshold — so the hot path pays two clock reads
+per step and nothing else.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from contextlib import contextmanager
+from typing import Callable, Optional
+
+logger = logging.getLogger("kubernetes_tpu.trace")
+
+
+class Trace:
+    """utiltrace.Trace: nested spans via span(); log_if_long at end."""
+
+    def __init__(self, name: str, now: Callable[[], float] = time.monotonic,
+                 **fields):
+        self.name = name
+        self.fields = fields
+        self._now = now
+        self.start = now()
+        self.steps: list[tuple[str, float, int]] = []  # (name, secs, depth)
+        self._depth = 0
+
+    @contextmanager
+    def span(self, name: str):
+        self._depth += 1
+        t0 = self._now()
+        try:
+            yield self
+        finally:
+            self._depth -= 1
+            self.steps.append((name, self._now() - t0, self._depth))
+
+    def total(self) -> float:
+        return self._now() - self.start
+
+    def log_if_long(self, threshold: float,
+                    log: Optional[logging.Logger] = None) -> bool:
+        """Emit the trace when total exceeds ``threshold`` (the reference's
+        100ms slow-attempt log). Returns whether it logged."""
+        total = self.total()
+        if total < threshold:
+            return False
+        log = log or logger
+        fields = " ".join(f"{k}={v}" for k, v in self.fields.items())
+        lines = [f"Trace[{self.name}] {fields} total={total * 1e3:.0f}ms"]
+        for name, secs, depth in self.steps:
+            lines.append(f"{'  ' * (depth + 1)}- {name}: {secs * 1e3:.0f}ms")
+        log.info("%s", "\n".join(lines))
+        return True

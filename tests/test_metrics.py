@@ -148,3 +148,67 @@ def test_pending_pods_gauge_live():
     sched.run_until_idle()
     gauge = sched.metrics.pending_pods.snapshot()
     assert gauge["{'queue': 'unschedulable'}"] == 1
+
+
+def test_trace_spans_and_threshold():
+    """utiltrace-style spans: silent under threshold, full dump over it."""
+    import logging
+
+    from kubernetes_tpu.utils.tracing import Trace
+
+    t = [0.0]
+
+    def now():
+        return t[0]
+
+    tr = Trace("cycle", now=now, pods=4)
+    with tr.span("launch"):
+        t[0] += 0.08
+        with tr.span("pull"):
+            t[0] += 0.01
+    with tr.span("commit"):
+        t[0] += 0.05
+    assert abs(tr.total() - 0.14) < 1e-9
+    records = []
+
+    class Cap(logging.Handler):
+        def emit(self, rec):
+            records.append(rec.getMessage())
+
+    log = logging.getLogger("trace-test")
+    log.addHandler(Cap())
+    log.setLevel(logging.INFO)
+    assert tr.log_if_long(1.0, log) is False, "under threshold: silent"
+    assert not records
+    assert tr.log_if_long(0.1, log) is True
+    assert "Trace[cycle]" in records[0]
+    assert "launch" in records[0] and "pull" in records[0]
+
+
+def test_slow_cycle_emits_trace(caplog):
+    """A scheduling cycle over the 100ms threshold logs the phase trace."""
+    import logging
+
+    from kubernetes_tpu.hub import Hub
+    from kubernetes_tpu.scheduler import Scheduler
+
+    class SlowClock:
+        t = 1000.0
+        calls = 0
+
+        def now(self):
+            # each clock read advances: any measured phase looks slow
+            SlowClock.t += 0.05
+            return SlowClock.t
+
+    hub = Hub()
+    cfg = default_config()
+    cfg.batch_size = 16
+    sched = Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64),
+                      now=SlowClock().now)
+    hub.create_node(mknode(0))
+    hub.create_pod(mkpod("p"))
+    with caplog.at_level(logging.INFO, logger="kubernetes_tpu.scheduler"):
+        sched.run_until_idle()
+    assert any("Trace[schedule_cycle]" in r.message for r in caplog.records)
+    sched.close()
