@@ -82,11 +82,15 @@ __all__ = sorted(_PUBLIC) + ["api"]
 
 
 def __getattr__(name: str):
+    import importlib
+
+    if name == "api":
+        value = importlib.import_module("kubernetes_tpu.api")
+        globals()[name] = value
+        return value
     entry = _PUBLIC.get(name)
     if entry is None:
         raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
-    import importlib
-
     mod = importlib.import_module(entry[0])
     value = getattr(mod, entry[1])
     globals()[name] = value

@@ -148,29 +148,9 @@ class Evaluator:
             # point (victims sorted ascending importance), so candidate
             # rows and minimal victim sets come straight from it — zero
             # additional dry-run launches on the hot preemption path
-            rows = [row for row, vs in victims_by_row.items()
-                    if kmin[row] != NONE and 1 <= kmin[row] <= len(vs)]
-            if not rows:
-                return []
-            rows.sort()
-            num_nodes = len(snapshot.node_info_list)
-            want = max(num_nodes * MIN_CANDIDATE_NODES_PERCENTAGE // 100,
-                       MIN_CANDIDATE_NODES_ABSOLUTE)
-            off = self._rng.randrange(len(rows))
-            picked = [rows[(off + i) % len(rows)]
-                      for i in range(min(want, len(rows)))]
-            pdbs = self.hub.list_pdbs()
-            free_mat = mirror.free_matrix()
-            out = []
-            for row in picked:
-                vs = self._reprieve_by_resources(
-                    [pi.pod for pi in victims_by_row[row][: int(kmin[row])]],
-                    pod, row, free_mat)
-                out.append(Candidate(
-                    node_name=mirror.name_of_row(row) or "", row=row,
-                    victims=vs,
-                    pdb_violations=self._pdb_violations(vs, pdbs)))
-            return out
+            return self._assemble_candidates(
+                pod, kmin, victims_by_row, snapshot, mirror,
+                mirror.free_matrix(), self.hub.list_pdbs())
 
         all_uids = {pi.pod.metadata.uid
                     for vs in victims_by_row.values() for pi in vs}
@@ -545,6 +525,39 @@ class Evaluator:
         self._sweep_cache_mirror = mirror
         return self._sweep_cache
 
+    def _assemble_candidates(self, pod: Pod, kmin, victims_by_row,
+                             snapshot, mirror, free_mat, pdbs,
+                             exclude_rows: set | None = None,
+                             limit: int | None = None) -> list[Candidate]:
+        """kmin rows -> reprieved Candidates, with the reference's
+        randomized percentage-bounded sampling (preemption.go:307
+        GetOffsetAndNumCandidates). Shared by the single-pod resource_only
+        path and batch_preempt so their semantics cannot diverge."""
+        rows = [row for row, vs in victims_by_row.items()
+                if (exclude_rows is None or row not in exclude_rows)
+                and kmin[row] != NONE and 1 <= kmin[row] <= len(vs)]
+        if not rows:
+            return []
+        rows.sort()
+        num_nodes = len(snapshot.node_info_list)
+        want = max(num_nodes * MIN_CANDIDATE_NODES_PERCENTAGE // 100,
+                   MIN_CANDIDATE_NODES_ABSOLUTE)
+        if limit is not None:
+            want = min(want, limit)
+        off = self._rng.randrange(len(rows))
+        picked = [rows[(off + i) % len(rows)]
+                  for i in range(min(want, len(rows)))]
+        out = []
+        for row in picked:
+            vs = self._reprieve_by_resources(
+                [pi.pod for pi in victims_by_row[row][: int(kmin[row])]],
+                pod, row, free_mat)
+            out.append(Candidate(
+                node_name=mirror.name_of_row(row) or "", row=row,
+                victims=vs,
+                pdb_violations=self._pdb_violations(vs, pdbs)))
+        return out
+
     def batch_preempt(self, jobs, snapshot) -> dict:
         """ONE sweep launch for a whole burst of fit-only preemptors of
         equal priority (the PreemptionAsync shape): returns
@@ -556,6 +569,17 @@ class Evaluator:
         caps = self._get_caps()
         out: dict[str, tuple] = {}
         jobs = list(jobs)
+        # eligibility first: an ineligible burst must not pay the sweep
+        eligible = []
+        for qp in jobs:
+            ok, why = self.pod_eligible_to_preempt_others(qp.pod)
+            if ok:
+                eligible.append(qp)
+            else:
+                out[qp.uid] = (None, Status.unschedulable(
+                    f"not eligible for preemption: {why}",
+                    plugin="DefaultPreemption"))
+        jobs = eligible
         if not jobs:
             return out
         prio = jobs[0].pod.priority()
@@ -583,29 +607,15 @@ class Evaluator:
         used_rows: set[int] = set()
         for j, qp in enumerate(jobs):
             kmin = kmin_all[j]
-            ok, why = self.pod_eligible_to_preempt_others(qp.pod)
-            if not ok:
-                out[qp.uid] = (None, Status.unschedulable(
-                    f"not eligible for preemption: {why}",
-                    plugin="DefaultPreemption"))
-                continue
-            rows = [row for row, vs in victims_by_row.items()
-                    if row not in used_rows
-                    and kmin[row] != NONE and 1 <= kmin[row] <= len(vs)]
-            if not rows:
+            candidates = self._assemble_candidates(
+                qp.pod, kmin, victims_by_row, snapshot, mirror, free_mat,
+                pdbs, exclude_rows=used_rows,
+                limit=MAX_VERIFY_CANDIDATES)
+            if not candidates:
                 out[qp.uid] = (None, Status.unschedulable(
                     "no preemption candidates",
                     plugin="DefaultPreemption"))
                 continue
-            candidates = []
-            for row in rows[:MAX_VERIFY_CANDIDATES]:
-                vs = self._reprieve_by_resources(
-                    [pi.pod for pi in victims_by_row[row][: int(kmin[row])]],
-                    qp.pod, row, free_mat)
-                candidates.append(Candidate(
-                    node_name=mirror.name_of_row(row) or "", row=row,
-                    victims=vs,
-                    pdb_violations=self._pdb_violations(vs, pdbs)))
             best = self.select_candidate(candidates)
             if self.metrics is not None:
                 self.metrics.preemption_attempts.inc()

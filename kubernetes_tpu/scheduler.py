@@ -133,7 +133,13 @@ class Scheduler:
         self._profile_cfg = {
             name: {"filters": fw.enabled_filters(),
                    "weights": fw.score_weights(),
-                   "fit": fw.fit_scoring()}
+                   "fit": fw.fit_scoring(),
+                   # the batched fit-only preemption fast path is only
+                   # semantics-preserving when DefaultPreemption is the
+                   # profile's ONLY PostFilter plugin
+                   "batch_preempt_ok": [n for n, _ in
+                                        fw.points["post_filter"]]
+                   == ["DefaultPreemption"]}
             for name, fw in self.frameworks.items()}
         self._enabled_filters = self.framework.enabled_filters()
         from kubernetes_tpu.extender import HTTPExtender
@@ -707,9 +713,9 @@ class Scheduler:
             tr = Trace("schedule_cycle", pods=n,
                        scheduled=sum(1 for r in rows if r >= 0))
             tr.start -= cycle_s     # reconstruct from measured phases
-            tr.steps = [("pack+host_plugins", pack_s, 0),
-                        ("device_launch", launch_s, 0),
-                        ("commit+bind", commit_s, 0)]
+            tr.steps = [("pack+host_plugins", 0.0, pack_s, 0),
+                        ("device_launch", pack_s, launch_s, 0),
+                        ("commit+bind", pack_s + launch_s, commit_s, 0)]
             tr.log_if_long(SLOW_CYCLE_SECONDS, logger)
 
     def schedule_one_batch(self) -> int:
@@ -917,7 +923,9 @@ class Scheduler:
             self.metrics.schedule_attempts.inc(
                 result="unschedulable", profile=qp.pod.spec.scheduler_name)
             has_pf = bool(self._fw_for(qp.pod).points["post_filter"])
-            fit_only = (not qp.host_reject_counts
+            pcfg = self._profile_cfg.get(qp.pod.spec.scheduler_name, {})
+            fit_only = (pcfg.get("batch_preempt_ok", False)
+                        and not qp.host_reject_counts
                         and all(c == 0 for i, c in enumerate(reject_counts)
                                 if i != fit_idx))
             any_pf = any_pf or has_pf

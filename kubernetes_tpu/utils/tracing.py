@@ -26,7 +26,8 @@ class Trace:
         self.fields = fields
         self._now = now
         self.start = now()
-        self.steps: list[tuple[str, float, int]] = []  # (name, secs, depth)
+        # (name, start offset, secs, depth)
+        self.steps: list[tuple[str, float, float, int]] = []
         self._depth = 0
 
     @contextmanager
@@ -37,7 +38,10 @@ class Trace:
             yield self
         finally:
             self._depth -= 1
-            self.steps.append((name, self._now() - t0, self._depth))
+            # (name, start offset, secs, depth): the dump sorts by start
+            # so parents print above their children
+            self.steps.append((name, t0 - self.start,
+                               self._now() - t0, self._depth))
 
     def total(self) -> float:
         return self._now() - self.start
@@ -52,7 +56,8 @@ class Trace:
         log = log or logger
         fields = " ".join(f"{k}={v}" for k, v in self.fields.items())
         lines = [f"Trace[{self.name}] {fields} total={total * 1e3:.0f}ms"]
-        for name, secs, depth in self.steps:
+        for name, _start, secs, depth in sorted(self.steps,
+                                                key=lambda s: (s[1], s[3])):
             lines.append(f"{'  ' * (depth + 1)}- {name}: {secs * 1e3:.0f}ms")
         log.info("%s", "\n".join(lines))
         return True
