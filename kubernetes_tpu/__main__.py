@@ -29,6 +29,8 @@ def main(argv=None) -> int:
                         default=15.0)
     parser.add_argument("--id", default=None,
                         help="leader election identity")
+    parser.add_argument("--feature-gates", default="",
+                        help="comma-separated gate=bool overrides")
     parser.add_argument("--validate-only", action="store_true",
                         help="load + validate the config, then exit")
     args = parser.parse_args(argv)
@@ -45,6 +47,10 @@ def main(argv=None) -> int:
     from kubernetes_tpu.scheduler import Scheduler
 
     cfg = load_config(args.config) if args.config else default_config()
+    for part in filter(None, args.feature_gates.split(",")):
+        name, _, val = part.partition("=")
+        cfg.feature_gates[name.strip()] = val.strip().lower() in (
+            "1", "true", "yes", "")
     errs = validate_config(cfg, in_tree_registry())
     if errs:
         for e in errs:

@@ -66,6 +66,7 @@ def config_from_dict(doc: dict) -> SchedulerConfiguration:
     if not profiles:
         profiles = [SchedulerProfile(plugins=default_plugins())]
     cfg.profiles = profiles
+    cfg.feature_gates = dict(doc.get("feature_gates") or {})
     cfg.extenders = [ExtenderConfig(
         url_prefix=e["url_prefix"],
         filter_verb=e.get("filter_verb", ""),

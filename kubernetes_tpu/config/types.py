@@ -89,6 +89,9 @@ class SchedulerConfiguration:
     pod_max_backoff_seconds: float = 10.0
     # legacy HTTP extenders (extender.ExtenderConfig entries)
     extenders: list = field(default_factory=list)
+    # feature gates (the component-base featuregate surface the perf
+    # configs toggle): unknown gates rejected by validation
+    feature_gates: dict[str, bool] = field(default_factory=dict)
     # binding cycle: runs on a worker pool after assume+permit
     # (schedule_one.go:124's per-pod goroutine)
     async_binding: bool = True
@@ -97,6 +100,9 @@ class SchedulerConfiguration:
     batch_size: int = 256       # pods scored per XLA launch
     node_capacity: int = 1024   # initial mirror bucket (grows by pow2)
     pod_table_capacity: int = 4096
+
+    def gate(self, name: str, default: bool = True) -> bool:
+        return self.feature_gates.get(name, default)
 
     def profile(self, scheduler_name: str) -> Optional[SchedulerProfile]:
         for p in self.profiles:
@@ -128,6 +134,10 @@ DEFAULT_MULTI_POINT = (
     ("ImageLocality", 1),
     ("DefaultBinder", 0),
 )
+
+
+# gates this build understands (both default ON, like current upstream)
+KNOWN_FEATURE_GATES = ("SchedulerQueueingHints", "SchedulerAsyncPreemption")
 
 
 def default_plugins() -> Plugins:

@@ -13,6 +13,11 @@ def validate_config(cfg: SchedulerConfiguration,
         errs.append("parallelism must be positive")
     if cfg.batch_size <= 0:
         errs.append("batch_size must be positive")
+    from kubernetes_tpu.config.types import KNOWN_FEATURE_GATES
+
+    for gate in cfg.feature_gates:
+        if gate not in KNOWN_FEATURE_GATES:
+            errs.append(f"unknown feature gate {gate!r}")
     if cfg.pod_initial_backoff_seconds <= 0:
         errs.append("pod_initial_backoff_seconds must be positive")
     if cfg.pod_max_backoff_seconds < cfg.pod_initial_backoff_seconds:

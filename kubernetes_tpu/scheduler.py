@@ -117,6 +117,16 @@ class Scheduler:
         merged_hints = {}
         for fw in self.frameworks.values():
             merged_hints.update(fw.events_to_register())
+        if not self.config.gate("SchedulerQueueingHints"):
+            # gate off: keep the event registrations but drop the hint fns
+            # — any matching event requeues (pre-hints upstream behavior)
+            from kubernetes_tpu.framework.interface import (
+                ClusterEventWithHint,
+            )
+
+            merged_hints = {
+                name: [ClusterEventWithHint(event=r.event) for r in regs]
+                for name, regs in merged_hints.items()}
         self.queue = PriorityQueue(
             less_fn=self.framework.queue_sort_less,
             pre_enqueue=lambda pod: self._fw_for(
@@ -952,6 +962,10 @@ class Scheduler:
                     if node:
                         self.stats["preemptions"] = self.stats.get(
                             "preemptions", 0) + 1
+            if not self.config.gate("SchedulerAsyncPreemption"):
+                # gate off: prepare candidates synchronously, inside the
+                # failure handling (pre-kep-4832 behavior)
+                self.preemption.flush_evictions()
         for qp, reject_counts, plugins, has_pf, fit_only in prepped:
             if has_pf and not fit_only:
                 state = CycleState()

@@ -309,3 +309,42 @@ def test_cli_validate_only(tmp_path):
     assert main(["--config", str(path), "--validate-only"]) == 0
     path.write_text(json.dumps({"batch_size": 0}))
     assert main(["--config", str(path), "--validate-only"]) == 1
+
+
+def test_feature_gates():
+    """Gates toggle hint consultation and async preemption; unknown gates
+    fail validation."""
+    from kubernetes_tpu.config.types import default_config
+    from kubernetes_tpu.config.validation import validate_config
+    from kubernetes_tpu.plugins.registry import in_tree_registry
+
+    cfg = default_config()
+    cfg.feature_gates["NoSuchGate"] = True
+    assert any("NoSuchGate" in e
+               for e in validate_config(cfg, in_tree_registry()))
+
+    # hints OFF: an unhelpful node still requeues the parked pod
+    cfg2 = default_config()
+    cfg2.batch_size = 16
+    cfg2.feature_gates["SchedulerQueueingHints"] = False
+    hub = Hub()
+    sched = Scheduler(hub, cfg2, caps=Capacities(nodes=16, pods=64))
+    hub.create_node(Node(
+        metadata=ObjectMeta(name="small", labels={LABEL_HOSTNAME: "small"}),
+        status=NodeStatus(allocatable={"cpu": "1", "memory": "8Gi",
+                                       "pods": "110"})))
+    big = Pod(metadata=ObjectMeta(name="big"),
+              spec=PodSpec(containers=[Container(
+                  name="c", resources=ResourceRequirements(
+                      requests={"cpu": "8"}))]))
+    hub.create_pod(big)
+    sched.run_until_idle()
+    assert sched.queue.pending_counts()["unschedulable"] == 1
+    hub.create_node(Node(
+        metadata=ObjectMeta(name="small2",
+                            labels={LABEL_HOSTNAME: "small2"}),
+        status=NodeStatus(allocatable={"cpu": "1", "memory": "8Gi",
+                                       "pods": "110"})))
+    assert sched.queue.pending_counts()["unschedulable"] == 0, \
+        "hints disabled: any matching event requeues"
+    sched.close()
