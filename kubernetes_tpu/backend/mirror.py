@@ -172,6 +172,8 @@ class Mirror:
         self._pod_tmpl: tuple[np.ndarray, np.ndarray] | None = None
         self._pod_tmpl_dev = None          # device push of _pod_template
         self._subset_tmpl: dict[tuple, tuple[np.ndarray, np.ndarray]] = {}
+        # plain-pod packed-row cache: fields-tuple -> content-key -> row
+        self._plain_rows: dict[tuple, dict] = {}
         self._table_i32_tmpl: np.ndarray | None = None
         self._row_node_obj: dict[int, object] = {}  # row -> packed Node obj
         # workload-activity tracking for launch_features(): which rows carry
@@ -1170,11 +1172,36 @@ class Mirror:
             for k in pod.metadata.labels:
                 self.pod_label_col(k)
 
+    @staticmethod
+    def _plain_pod_key(pod: Pod):
+        """Content key for the plain-pod packed-row cache, or None when
+        the pod uses any feature beyond (namespace, priority, labels-free
+        containers with resource requests) — deployment-shaped batches are
+        thousands of pods identical up to name/uid, and re-deriving the
+        whole row per pod was the dominant host pack cost."""
+        s = pod.spec
+        if (s.affinity is not None or s.node_selector or s.tolerations
+                or s.topology_spread_constraints or s.init_containers
+                or s.overhead or s.volumes or s.resource_claims
+                or s.scheduling_gates or s.node_name
+                or pod.status.nominated_node_name or pod.metadata.labels):
+            return None
+        for c in s.containers:
+            if c.ports:
+                return None
+        return (pod.metadata.namespace, s.priority,
+                tuple((c.image, tuple(sorted(c.resources.requests.items())))
+                      for c in s.containers))
+
     def _pack_batch_np(self, pods: list[Pod], batch_size: int,
                        fields: tuple[str, ...]
                        ) -> tuple[np.ndarray, np.ndarray]:
         """Subset-packed batch rows as host arrays (pack_batch_blobs body;
-        prepare_launch also hashes these rows for topology-group dedup)."""
+        prepare_launch also hashes these rows for topology-group dedup).
+
+        Plain pods (no features beyond requests) share a cached packed row
+        per content key; only the identity columns (name_id, uid_id) are
+        patched per pod."""
         self._batch_prepass(pods, batch_size)
         tmpl = self._subset_tmpl.get(fields)
         if tmpl is None:
@@ -1184,9 +1211,30 @@ class Mirror:
         f32, i32 = self.pod_codec.alloc_subset(fields, batch_size)
         f32[: len(pods)] = tmpl[0]
         i32[: len(pods)] = tmpl[1]
+        _f_off, i_off, _, _ = self.pod_codec.subset_layout(fields)
+        # identity patch offsets; a subset omitting them (any-subset is a
+        # legal BlobCodec contract) just skips the cache fast path
+        name_ent = i_off.get("name_id")
+        uid_ent = i_off.get("uid_id")
+        cacheable = name_ent is not None and uid_ent is not None
+        cache = self._plain_rows.setdefault(fields, {})
         for b, pod in enumerate(pods):
-            self.pod_codec.pack_into_subset(
-                fields, f32[b], i32[b], self.pack_pod(pod, active_only=True))
+            key = self._plain_pod_key(pod) if cacheable else None
+            row = cache.get(key) if key is not None else None
+            if row is not None:
+                f32[b] = row[0]
+                i32[b] = row[1]
+            else:
+                self.pod_codec.pack_into_subset(
+                    fields, f32[b], i32[b],
+                    self.pack_pod(pod, active_only=True))
+                if key is not None:
+                    if len(cache) > 4096:
+                        cache.clear()
+                    cache[key] = (f32[b].copy(), i32[b].copy())
+            if cacheable:
+                i32[b, name_ent[0]] = self._i(pod.metadata.name)
+                i32[b, uid_ent[0]] = self._i(pod.metadata.uid)
         return f32, i32
 
     # identity fields excluded from the topology-group signature: two pods
