@@ -1,6 +1,8 @@
-"""The 5 BASELINE workloads, mirroring the reference's performance-config
+"""The reference scheduler_perf workloads, mirroring performance-config
 shapes (node/pod templates from test/integration/scheduler_perf/templates;
-op sequences and thresholds from the per-suite performance-config.yaml).
+op sequences and thresholds from the per-suite performance-config.yaml):
+the 5 BASELINE.json configs bench.py runs, plus Unschedulable and
+SchedulingWithMixedChurn.
 
 Node template (node-default.yaml): cpu 4, memory 32Gi, pods 110.
 Pod template (pod-default.yaml): requests cpu 100m, memory 500Mi.
