@@ -1,0 +1,172 @@
+"""Scheduler restart + churn integration (SURVEY §5.4 stateless-by-design,
+VERDICT round-3 Weak #8): a brand-new scheduler over surviving hub state
+must rebuild everything from replay — bound pods, pending pods,
+nominations — and keep scheduling correctly under node/pod churn."""
+
+import random
+
+from kubernetes_tpu.api.objects import (
+    Container,
+    LABEL_HOSTNAME,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResourceRequirements,
+)
+from kubernetes_tpu.config.types import default_config
+from kubernetes_tpu.hub import Hub
+from kubernetes_tpu.ops.features import Capacities
+from kubernetes_tpu.scheduler import Scheduler
+
+
+class Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def now(self):
+        return self.t
+
+
+def mknode(i, cpu="8"):
+    return Node(metadata=ObjectMeta(name=f"node-{i}",
+                                    labels={LABEL_HOSTNAME: f"node-{i}"}),
+                status=NodeStatus(allocatable={"cpu": cpu,
+                                               "memory": "16Gi",
+                                               "pods": "110"}))
+
+
+def mkpod(name, cpu="500m", prio=0):
+    return Pod(metadata=ObjectMeta(name=name),
+               spec=PodSpec(containers=[Container(
+                   name="c", resources=ResourceRequirements(
+                       requests={"cpu": cpu, "memory": "128Mi"}))],
+                   priority=prio))
+
+
+def mksched(hub, clock):
+    cfg = default_config()
+    cfg.batch_size = 16
+    return Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=128),
+                     now=clock.now)
+
+
+def drain(sched, clock, rounds=6):
+    for _ in range(rounds):
+        sched.run_until_idle()
+        clock.t += 3.0
+        sched.queue.flush_backoff_completed()
+
+
+def bound(hub, pod):
+    p = hub.get_pod(pod.metadata.uid)
+    return p.spec.node_name if p else None
+
+
+def test_restart_replays_bound_and_pending_state():
+    hub = Hub()
+    clock = Clock()
+    s1 = mksched(hub, clock)
+    for i in range(3):
+        hub.create_node(mknode(i))
+    done = [mkpod(f"a{i}") for i in range(6)]
+    for p in done:
+        hub.create_pod(p)
+    drain(s1, clock)
+    assert all(bound(hub, p) for p in done)
+    # pending pods created while the old scheduler is gone
+    s1.close()
+    pending = [mkpod(f"b{i}") for i in range(4)]
+    for p in pending:
+        hub.create_pod(p)
+
+    s2 = mksched(hub, clock)
+    # the replayed cache must already account the 6 bound pods
+    assert s2.cache.pod_count() == 6
+    drain(s2, clock)
+    assert all(bound(hub, p) for p in pending)
+    assert s2.stats["scheduled"] == 4, "only the new pods were scheduled"
+    # capacity accounting survived: total cpu committed = 10 x 500m
+    committed = sum(n["requested_milli_cpu"]
+                    for n in s2.cache.dump()["nodes"].values())
+    assert committed == 5000, f"replayed+new cpu accounting: {committed}m"
+    assert s2.cache.assumed_pod_count() == 0
+    s2.close()
+
+
+def test_restart_preserves_nominations():
+    """A preemptor nominated before the crash keeps its reservation: the
+    new scheduler re-seeds the nominator from status.nominatedNodeName and
+    no other pod steals the freed room."""
+    hub = Hub()
+    clock = Clock()
+    s1 = mksched(hub, clock)
+    hub.create_node(mknode(0, cpu="2"))
+    low = [mkpod(f"low{i}", cpu="1") for i in range(2)]
+    for p in low:
+        hub.create_pod(p)
+    drain(s1, clock)
+    high = mkpod("high", cpu="2", prio=100)
+    hub.create_pod(high)
+    # one cycle: nominate + queue evictions, then "crash" BEFORE binding
+    s1.run_until_idle()
+    nominated = hub.get_pod(high.metadata.uid).status.nominated_node_name
+    assert nominated == "node-0"
+    s1.close()
+
+    s2 = mksched(hub, clock)
+    assert s2.nominator.node_of(high.metadata.uid) == "node-0", \
+        "nominator re-seeded from status.nominatedNodeName on replay"
+    # a greedy filler arrives; the nomination must hold the room
+    filler = mkpod("filler", cpu="1500m")
+    hub.create_pod(filler)
+    drain(s2, clock)
+    assert bound(hub, high) == "node-0", "nomination survived the restart"
+    assert bound(hub, filler) == "", "reserved room not stolen"
+    s2.close()
+
+
+def test_scheduling_under_node_churn():
+    """Nodes appear and disappear while pods flow: no pod lands on a
+    deleted node, and everything schedulable eventually binds."""
+    hub = Hub()
+    clock = Clock()
+    rng = random.Random(7)
+    sched = mksched(hub, clock)
+    nodes = {}
+    for i in range(4):
+        n = mknode(i)
+        nodes[i] = n
+        hub.create_node(n)
+    pods = []
+    next_node = 4
+    for wave in range(6):
+        for j in range(5):
+            p = mkpod(f"w{wave}-p{j}", cpu="200m")
+            pods.append(p)
+            hub.create_pod(p)
+        # churn: drop one node, add another
+        if rng.random() < 0.7 and len(nodes) > 2:
+            victim = rng.choice(list(nodes))
+            hub.delete_node(nodes.pop(victim).metadata.uid)
+        n = mknode(next_node)
+        nodes[next_node] = n
+        hub.create_node(n)
+        next_node += 1
+        drain(sched, clock, rounds=2)
+    drain(sched, clock)
+    # bound-to-since-deleted-node is legal (the API keeps the stale binding;
+    # that's the kubelet's problem in the reference) — only placement
+    # completeness and cache/hub agreement are asserted here
+    placed = sum(1 for p in pods if bound(hub, p))
+    assert placed == len(pods), f"{placed}/{len(pods)} placed under churn"
+    # the scheduler's view agrees with the hub: everything except
+    # deleted-NODE stragglers (pods bound to since-deleted nodes keep the
+    # node alive in the cache, like the reference) must match — including
+    # pod existence AND placement lines
+    problems = [x for x in sched.cache.compare_with_hub(hub)
+                if not (x.startswith("node ")
+                        and "in cache but not in apiserver" in x)]
+    assert not problems, problems
+    sched.close()
