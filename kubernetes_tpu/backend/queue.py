@@ -75,6 +75,8 @@ class QueuedPodInfo:
 class PriorityQueue:
     def __init__(self,
                  less_fn: Callable[[QueuedPodInfo, QueuedPodInfo], bool],
+                 sort_key_fn: Optional[
+                     Callable[[QueuedPodInfo], tuple]] = None,
                  pre_enqueue: Optional[Callable[[Pod], Status]] = None,
                  queueing_hints: Optional[
                      dict[str, list[ClusterEventWithHint]]] = None,
@@ -93,7 +95,7 @@ class PriorityQueue:
         self._max_in_unschedulable = max_in_unschedulable
 
         self._active: Heap[QueuedPodInfo] = Heap(
-            lambda qp: qp.uid, less_fn)
+            lambda qp: qp.uid, less_fn, sort_key_fn=sort_key_fn)
         self._backoff: Heap[QueuedPodInfo] = Heap(
             lambda qp: qp.uid,
             lambda a, b: self._backoff_expiry(a) < self._backoff_expiry(b))

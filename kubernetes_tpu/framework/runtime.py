@@ -305,6 +305,20 @@ class Framework:
             self._queue_sort_fn = fn
         return fn
 
+    @property
+    def queue_sort_key(self):
+        """Per-item sort-key function when the resolved QueueSort carries
+        the default PrioritySort semantics (the only in-tree sort), else
+        None. Lets the activeQ heap compare precomputed tuples instead of
+        calling a Python comparator per sift step."""
+        from kubernetes_tpu.plugins.registry import PrioritySort
+
+        fn = self.queue_sort_less
+        if fn is Framework._priority_sort_less or \
+                getattr(fn, "__func__", None) is PrioritySort.less:
+            return lambda qp: (-qp.pod.priority(), qp.timestamp)
+        return None
+
     def run_reserve_plugins(self, state: CycleState, pod: Pod,
                             node_name: str) -> Status:
         for pl in self._iter("reserve", ReservePlugin):

@@ -129,6 +129,7 @@ class Scheduler:
                 for name, regs in merged_hints.items()}
         self.queue = PriorityQueue(
             less_fn=self.framework.queue_sort_less,
+            sort_key_fn=self.framework.queue_sort_key,
             pre_enqueue=lambda pod: self._fw_for(
                 pod).run_pre_enqueue_plugins(pod),
             queueing_hints=merged_hints,
