@@ -89,6 +89,10 @@ PHASE1_CHUNK = 1024
 # dispatch overhead, which dominates the topology scan at these shapes
 SCAN_UNROLL = 8
 
+# minFeasibleNodesToFind (schedule_one.go:39-45): below this cluster-wide
+# feasible count the percentageOfNodesToScore early-exit never truncates
+MIN_FEASIBLE_NODES_TO_FIND = 100
+
 
 @jax.tree_util.register_dataclass
 @dataclass
@@ -138,6 +142,12 @@ class BatchResult:
     unresolvable_count: jax.Array  # [B] i32: nodes where fit can never succeed
     free: jax.Array            # [N, R] f32: post-batch free resources
     nzr: jax.Array             # [N, 2] f32: post-batch nonzero-requested
+    # [] i32: post-batch rotating visit offset (nextStartNodeIndex,
+    # schedule_one.go:620). Feed to the next launch's ``pct_start`` so the
+    # percentageOfNodesToScore window keeps rotating ACROSS batches, not
+    # just within one. Always present (0 when the knob is off) so the
+    # pytree structure is launch-config independent.
+    pct_start: jax.Array = None
 
 
 # workload-activity flags (STATIC, host-derived per launch by
@@ -279,7 +289,8 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
          zeros[:, None]], axis=1)
     return BatchResult(node_row=placed, score=win, feasible_count=feas,
                        reject_counts=reject_counts,
-                       unresolvable_count=unres, free=free, nzr=nzr)
+                       unresolvable_count=unres, free=free, nzr=nzr,
+                       pct_start=jnp.int32(0))
 
 
 def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
@@ -298,7 +309,9 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                    host_ok: jnp.ndarray | None = None,
                    host_score: jnp.ndarray | None = None,
                    fit_strategy: str = "LeastAllocated",
-                   fit_shape=None
+                   fit_shape=None,
+                   pct_nodes: int = 0,
+                   pct_start: jnp.ndarray | None = None,
                    ) -> BatchResult:
     """Schedule a whole pod batch in one launch, as-if-serial (see module
     docstring for the two-phase structure).
@@ -413,6 +426,10 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
     if not serial_scan:
         if enable_topology:
             raise ValueError("auction commit requires a no-topology launch")
+        if pct_nodes:
+            raise ValueError(
+                "percentageOfNodesToScore truncation only exists in the "
+                "serial scan; gate the auction off when the knob is set")
         return _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw,
                               aff_raw, img, unres, weights, free0, nzr0,
                               host_score, fit_strategy, fit_shape)
@@ -658,6 +675,8 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
         return forbid1_n, map2_n, pres_n, any3, wscore_n, cntmap, cnt_match_n
 
     def body(carry, xs):
+        if pct_nodes:
+            carry, start = carry[:-1], carry[-1]
         if enable_topology:
             (free, nzr, committed_rows, forbid1_n, map2_n, pres_n, any3,
              wscore_n, cntmap, cnt_match_n) = carry
@@ -695,6 +714,27 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
             jnp.maximum(committed_rows, 0)].max(clash)          # [N]
         ports_ok = ~forbidden
         feasible = ok_s & ports_ok & fit_ok & sp_ok & ipa_ok
+        if pct_nodes:
+            # percentageOfNodesToScore early-exit parity
+            # (schedule_one.go:668-694): visit nodes in rotating order from
+            # `start`, stop once k feasible are found, score only those.
+            # Unnecessary for TPU throughput (all nodes are scored in one
+            # launch regardless) but preserves the reference's node-subset
+            # SELECTION semantics when the knob is set. reject_counts stay
+            # full-cluster (better diagnostics than the reference's
+            # partial-visit counts; documented divergence). Padding rows are
+            # never feasible, so they only inflate `processed` bookkeeping.
+            n_total = feasible.shape[0]
+            k_find = jnp.maximum(
+                jnp.int32(MIN_FEASIBLE_NODES_TO_FIND),
+                (num_valid.astype(jnp.int32) * pct_nodes) // 100)
+            rolled = jnp.roll(feasible, -start)
+            csum = jnp.cumsum(rolled.astype(jnp.int32))
+            feasible = jnp.roll(rolled & (csum <= k_find), start)
+            found_k = csum[-1] >= k_find
+            kth = jnp.argmax(csum >= k_find).astype(jnp.int32)
+            processed = jnp.where(found_k, kth + 1, n_total)
+            start = (start + processed) % n_total
         frac = SC.utilization_fractions(alloc2, nzr, nzreq)
         least = SC.fit_score_from_fractions(frac, fit_strategy, fit_shape)
         bal = SC.balanced_allocation_from_fractions(frac)
@@ -737,6 +777,8 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                          pres_n, any3, wscore_n, cntmap, cnt_match_n)
         else:
             out_carry = (free, nzr, committed_rows)
+        if pct_nodes:
+            out_carry = out_carry + (start,)
         return out_carry, (
             row, win, jnp.sum(feasible).astype(jnp.int32),
             port_rejects, fit_rejects, sp_rejects, ipa_rejects)
@@ -758,6 +800,11 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
             jnp.zeros((g_cap, C_cap, d_cap), jnp.float32),   # cntmap
             jnp.zeros((g_cap, C_cap, n_cap), jnp.float32),   # cnt_match_n
         )
+    if pct_nodes:
+        # rotating nextStartNodeIndex, seeded from the previous launch's
+        # BatchResult.pct_start so rotation persists ACROSS batches
+        init = init + (jnp.int32(0) if pct_start is None
+                       else jnp.asarray(pct_start, jnp.int32),)
     # unroll: the body is many small fused kernels; per-iteration dispatch
     # overhead (not FLOPs) is a real cost at these shapes, so unrolling
     # amortizes it
@@ -766,6 +813,7 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                  ipa_rejects)) = jax.lax.scan(body, init, xs,
                                               unroll=SCAN_UNROLL)
     free_out, nzr_out = carry_out[0], carry_out[1]
+    start_out = carry_out[-1] if pct_nodes else jnp.int32(0)
 
     ports_idx = FILTER_PLUGINS.index("NodePorts")
     static_rejects = static_rejects.at[:, ports_idx].add(port_rejects)
@@ -774,31 +822,31 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
          ipa_rejects[:, None]], axis=1)
     return BatchResult(node_row=rows, score=win_scores, feasible_count=feas,
                        reject_counts=reject_counts, unresolvable_count=unres,
-                       free=free_out, nzr=nzr_out)
+                       free=free_out, nzr=nzr_out, pct_start=start_out)
 
 
 @partial(jax.jit, static_argnames=("caps", "enable_topology", "d_cap",
                                    "enabled_filters", "serial_scan",
                                    "active", "pfields", "g_cap",
-                                   "fit_strategy"))
+                                   "fit_strategy", "pct_nodes"))
 def schedule_batch_jit(cblobs, pblobs, wk, weights, caps,
                        enable_topology=True, d_cap=None,
                        enabled_filters=None, serial_scan=True, state=None,
                        active=None, pfields=None, ptmpl=None,
                        gid=None, rep=None, g_cap=0, host_ok=None,
                        host_score=None, fit_strategy="LeastAllocated",
-                       fit_shape=None):
+                       fit_shape=None, pct_nodes=0, pct_start=None):
     return schedule_batch(cblobs, pblobs, wk, weights, caps,
                           enable_topology, d_cap, enabled_filters,
                           serial_scan, state, active, pfields, ptmpl,
                           gid, rep, g_cap, host_ok, host_score,
-                          fit_strategy, fit_shape)
+                          fit_strategy, fit_shape, pct_nodes, pct_start)
 
 
 def launch_batch(spec, wk, weights, caps, enabled_filters=None,
                  serial_scan=True, state=None, host_ok=None,
                  host_score=None, fit_strategy="LeastAllocated",
-                 fit_shape=None) -> BatchResult:
+                 fit_shape=None, pct_nodes=0, pct_start=None) -> BatchResult:
     """schedule_batch_jit driven by a Mirror.prepare_launch LaunchSpec."""
     return schedule_batch_jit(
         spec.cblobs, spec.pblobs, wk, weights, caps,
@@ -807,4 +855,5 @@ def launch_batch(spec, wk, weights, caps, enabled_filters=None,
         pfields=spec.pfields, ptmpl=spec.ptmpl,
         gid=spec.gid, rep=spec.rep, g_cap=spec.g_cap,
         host_ok=host_ok, host_score=host_score,
-        fit_strategy=fit_strategy, fit_shape=fit_shape)
+        fit_strategy=fit_strategy, fit_shape=fit_shape,
+        pct_nodes=pct_nodes, pct_start=pct_start)

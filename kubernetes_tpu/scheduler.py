@@ -177,6 +177,9 @@ class Scheduler:
         # invalidates it (set to None) and forces a full re-sync.
         self._chain: Optional[tuple] = None
         self._chain_epoch = 0
+        # percentageOfNodesToScore rotating offset, persisted across
+        # launches (schedule_one.go:620 nextStartNodeIndex); device scalar
+        self._pct_start = None
         # threading model: ONE mutator thread at a time. The coarse lock
         # serializes the scheduling loop against event handlers invoked from
         # foreign threads; the binder pool's own hub writes dispatch events
@@ -520,7 +523,15 @@ class Scheduler:
         # port conflicts are impossible without batch host ports; node-side
         # conflicts are in the static masks the auction honors); the exact
         # as-if-serial scan otherwise (see pipeline._rounds_commit)
-        use_auction = (not spec.enable_topology
+        # percentageOfNodesToScore (schedule_one.go:668): when explicitly
+        # set below 100 the rotating feasible-subset selection only exists
+        # in the serial scan, so the auction (which scores all nodes by
+        # design) is gated off. Default None/100 = score everything — the
+        # TPU-native stance (SURVEY §2.7 P2).
+        pct = self.config.percentage_of_nodes_to_score or 0
+        pct = 0 if pct >= 100 else pct
+        use_auction = (not pct
+                       and not spec.enable_topology
                        and not self.mirror.batch_has_host_ports(
                            [qp.pod for qp in runnable])
                        and pcfg["filters"][FILTER_PLUGINS.index(
@@ -534,7 +545,15 @@ class Scheduler:
             spec, self.mirror.well_known(), pcfg["weights"], self.caps,
             pcfg["filters"], serial_scan=not use_auction, state=state,
             host_ok=host_ok, host_score=host_score,
-            fit_strategy=fit_strategy, fit_shape=fit_shape)
+            fit_strategy=fit_strategy, fit_shape=fit_shape, pct_nodes=pct,
+            # seeded with a concrete 0 (not None) so every launch shares one
+            # arg pytree and therefore one trace/compile
+            pct_start=(self._pct_start if self._pct_start is not None
+                       else np.int32(0)) if pct else None)
+        if pct:
+            # device-resident rotation carry; stays async (never sync'd to
+            # host), consumed as the next launch's seed
+            self._pct_start = out.pct_start
         # the chain advances to this launch's post-batch state UNLESS an
         # invalidation raced in while we were packing (epoch check); later
         # external events reset it via the handlers

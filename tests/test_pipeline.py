@@ -108,3 +108,77 @@ def test_dryrun_multichip():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(min(8, len(jax.devices())))
+
+
+def test_pct_nodes_to_score_knob():
+    """percentageOfNodesToScore (schedule_one.go:668-694): with the knob
+    set, selection happens among a rotating feasible subset; with it unset
+    (or >=100) all nodes are scored. At small clusters the
+    minFeasibleNodesToFind=100 floor keeps the knob a no-op."""
+    caps = Capacities(nodes=256, pods=64)
+    _, snap, mirror = build_cluster(200, caps=caps)
+    pods = [make_pod(i) for i in range(8)]
+    cb = mirror.to_blobs()
+    pb = mirror.pack_batch_blobs(pods, 8)
+    wk = mirror.well_known()
+    w = default_weights()
+    full = schedule_batch_jit(cb, pb, wk, w, caps)
+    # floor: 200 * 50% = 100 = minFeasibleNodesToFind, but all 200 nodes
+    # are feasible so the window truncates to the first 100 visited
+    capped = schedule_batch_jit(cb, pb, wk, w, caps, pct_nodes=50)
+    rows_f = np.asarray(full.node_row)
+    rows_c = np.asarray(capped.node_row)
+    assert (rows_c >= 0).all(), "capped run must still place every pod"
+    # the capped run only ever reports <= k feasible nodes
+    assert (np.asarray(capped.feasible_count) <= 100).all()
+    assert (np.asarray(full.feasible_count) == 200).all()
+    # pct=100 never truncates: byte-identical placements to the default
+    same = schedule_batch_jit(cb, pb, wk, w, caps, pct_nodes=100)
+    np.testing.assert_array_equal(rows_f, np.asarray(same.node_row))
+    np.testing.assert_array_equal(np.asarray(full.feasible_count),
+                                  np.asarray(same.feasible_count))
+
+
+def test_pct_nodes_rotates_start_index():
+    """The visit window advances between pods (nextStartNodeIndex,
+    schedule_one.go:620): with k=100 over 200 identical feasible nodes,
+    consecutive pods must not all pick from the same leading window."""
+    caps = Capacities(nodes=256, pods=64)
+    _, snap, mirror = build_cluster(200, caps=caps)
+    pods = [make_pod(i) for i in range(8)]
+    out = schedule_batch_jit(mirror.to_blobs(),
+                            mirror.pack_batch_blobs(pods, 8),
+                            mirror.well_known(), default_weights(), caps,
+                            pct_nodes=50)
+    rows = np.asarray(out.node_row)
+    # pod 0 picks inside nodes [0,100); pod 1's window starts at 100
+    assert rows[0] < 100
+    assert rows[1] >= 100
+    assert int(out.pct_start) > 0
+
+
+def test_pct_nodes_start_carries_across_launches():
+    """The rotation survives ACROSS launches via BatchResult.pct_start (the
+    Scheduler's persistent nextStartNodeIndex, schedule_one.go:620): a
+    launch seeded with a prior launch's final offset opens its first
+    window there, not at node 0. 150 valid nodes / k=100 makes the seeded
+    window [start, start+100) unambiguous."""
+    caps = Capacities(nodes=256, pods=64)
+    _, snap, mirror = build_cluster(150, caps=caps)
+    pods = [make_pod(i) for i in range(8)]
+    cb = mirror.to_blobs()
+    pb = mirror.pack_batch_blobs(pods, 8)
+    wk = mirror.well_known()
+    w = default_weights()
+    out = schedule_batch_jit(cb, pb, wk, w, caps, pct_nodes=50)
+    start1 = int(out.pct_start)
+    assert start1 > 0
+    out2 = schedule_batch_jit(cb, pb, wk, w, caps, pct_nodes=50,
+                              pct_start=out.pct_start)
+    rows2 = np.asarray(out2.node_row)
+    # pod 0's window is the 100 feasible nodes visited from start1; when
+    # that window doesn't wrap (start1 <= 50) every candidate is >= start1
+    if start1 <= 50:
+        assert rows2[0] >= start1, (start1, rows2[0])
+    # and the seeded trajectory ends at a different offset
+    assert int(out2.pct_start) != start1
