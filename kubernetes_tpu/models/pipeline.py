@@ -93,6 +93,11 @@ SCAN_UNROLL = 8
 # feasible count the percentageOfNodesToScore early-exit never truncates
 MIN_FEASIBLE_NODES_TO_FIND = 100
 
+# pct_nodes sentinel: config percentageOfNodesToScore == 0, meaning the
+# reference's ADAPTIVE percentage (50 - nodes/125, min 5) rather than a
+# fixed one. Unset (None) stays "score everything" — the TPU-native default.
+ADAPTIVE_PCT = -1
+
 
 @jax.tree_util.register_dataclass
 @dataclass
@@ -145,9 +150,9 @@ class BatchResult:
     # [] i32: post-batch rotating visit offset (nextStartNodeIndex,
     # schedule_one.go:620). Feed to the next launch's ``pct_start`` so the
     # percentageOfNodesToScore window keeps rotating ACROSS batches, not
-    # just within one. Always present (0 when the knob is off) so the
-    # pytree structure is launch-config independent.
-    pct_start: jax.Array = None
+    # just within one. Always a concrete scalar (0 when the knob is off) so
+    # the pytree structure is launch-config independent.
+    pct_start: jax.Array
 
 
 # workload-activity flags (STATIC, host-derived per launch by
@@ -725,9 +730,16 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
             # partial-visit counts; documented divergence). Padding rows are
             # never feasible, so they only inflate `processed` bookkeeping.
             n_total = feasible.shape[0]
+            nv = num_valid.astype(jnp.int32)
+            if pct_nodes == ADAPTIVE_PCT:
+                # explicit 0 in config = the reference's adaptive formula
+                # (numFeasibleNodesToFind, schedule_one.go:668-694):
+                # pct = 50 - nodes/125, floored at 5
+                eff = jnp.maximum(jnp.int32(5), 50 - nv // 125)
+            else:
+                eff = jnp.int32(pct_nodes)
             k_find = jnp.maximum(
-                jnp.int32(MIN_FEASIBLE_NODES_TO_FIND),
-                (num_valid.astype(jnp.int32) * pct_nodes) // 100)
+                jnp.int32(MIN_FEASIBLE_NODES_TO_FIND), (nv * eff) // 100)
             rolled = jnp.roll(feasible, -start)
             csum = jnp.cumsum(rolled.astype(jnp.int32))
             feasible = jnp.roll(rolled & (csum <= k_find), start)

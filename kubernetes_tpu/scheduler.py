@@ -52,6 +52,7 @@ from kubernetes_tpu.framework.interface import Code
 from kubernetes_tpu.framework.waiting import WaitingPod
 from kubernetes_tpu.hub import EventHandlers, Hub
 from kubernetes_tpu.models.pipeline import (
+    ADAPTIVE_PCT,
     FILTER_PLUGINS,
     BatchResult,
     launch_batch,
@@ -523,13 +524,14 @@ class Scheduler:
         # port conflicts are impossible without batch host ports; node-side
         # conflicts are in the static masks the auction honors); the exact
         # as-if-serial scan otherwise (see pipeline._rounds_commit)
-        # percentageOfNodesToScore (schedule_one.go:668): when explicitly
-        # set below 100 the rotating feasible-subset selection only exists
-        # in the serial scan, so the auction (which scores all nodes by
-        # design) is gated off. Default None/100 = score everything — the
-        # TPU-native stance (SURVEY §2.7 P2).
-        pct = self.config.percentage_of_nodes_to_score or 0
-        pct = 0 if pct >= 100 else pct
+        # percentageOfNodesToScore (schedule_one.go:668): when set, the
+        # rotating feasible-subset selection only exists in the serial
+        # scan, so the auction (which scores all nodes by design) is gated
+        # off. None/100 = score everything — the TPU-native stance (SURVEY
+        # §2.7 P2); an explicit 0 = the reference's adaptive percentage.
+        raw = self.config.percentage_of_nodes_to_score
+        pct = (0 if raw is None or raw >= 100
+               else ADAPTIVE_PCT if raw == 0 else raw)
         use_auction = (not pct
                        and not spec.enable_topology
                        and not self.mirror.batch_has_host_ports(

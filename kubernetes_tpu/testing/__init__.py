@@ -1,0 +1,27 @@
+"""Test fixtures: fluent wrappers + scripted fake plugins
+(pkg/scheduler/testing equivalents)."""
+
+from kubernetes_tpu.testing.fakes import (
+    FakePermitPlugin,
+    FakeReservePlugin,
+    FakeScorePlugin,
+    FalseFilterPlugin,
+    MatchFilterPlugin,
+    TrueFilterPlugin,
+    fake_profile,
+    fake_registry,
+)
+from kubernetes_tpu.testing.wrappers import MakeNode, MakePod
+
+__all__ = [
+    "FakePermitPlugin",
+    "FakeReservePlugin",
+    "FakeScorePlugin",
+    "FalseFilterPlugin",
+    "MatchFilterPlugin",
+    "TrueFilterPlugin",
+    "MakeNode",
+    "MakePod",
+    "fake_profile",
+    "fake_registry",
+]

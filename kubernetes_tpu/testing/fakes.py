@@ -1,0 +1,153 @@
+"""Scripted fake plugins for framework tests — the analog of the
+reference's fake plugin fixtures (pkg/scheduler/testing/framework/
+fake_plugins.go:36-115: TrueFilterPlugin, FalseFilterPlugin,
+MatchFilterPlugin, fake score/permit/reserve plugins).
+
+Each fake is a HOST plugin (runs through Framework.run_host_* /
+run_*_plugins), so tests can exercise the mixed host/device seam without a
+device kernel. ``fake_registry()`` merges them into the in-tree registry;
+``fake_profile()`` builds a SchedulerProfile enabling a chosen subset on
+top of the defaults.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from kubernetes_tpu.config.types import (
+    Plugin as PluginRef,
+    Plugins,
+    SchedulerProfile,
+    default_plugins,
+)
+from kubernetes_tpu.framework.interface import (
+    FilterPlugin,
+    PermitPlugin,
+    ReservePlugin,
+    ScorePlugin,
+    Status,
+)
+from kubernetes_tpu.plugins.registry import (
+    PluginDescriptor,
+    in_tree_registry,
+)
+
+
+class TrueFilterPlugin(FilterPlugin):
+    """Always passes (fake_plugins.go TrueFilterPlugin)."""
+
+    NAME = "TrueFilter"
+
+    def filter(self, state, pod, node_info) -> Status:
+        return Status()
+
+
+class FalseFilterPlugin(FilterPlugin):
+    """Always rejects (fake_plugins.go FalseFilterPlugin)."""
+
+    NAME = "FalseFilter"
+
+    def filter(self, state, pod, node_info) -> Status:
+        return Status.unschedulable("FalseFilter", plugin=self.NAME)
+
+
+class MatchFilterPlugin(FilterPlugin):
+    """Passes only the node whose name equals the pod's name
+    (fake_plugins.go MatchFilterPlugin)."""
+
+    NAME = "MatchFilter"
+
+    def filter(self, state, pod, node_info) -> Status:
+        if node_info.node.metadata.name == pod.metadata.name:
+            return Status()
+        return Status.unschedulable("no match", plugin=self.NAME)
+
+
+class FakeScorePlugin(ScorePlugin):
+    """Scores each node with a scripted function (node_name -> float);
+    default scores 0 everywhere."""
+
+    NAME = "FakeScore"
+
+    def __init__(self, score_fn: Optional[Callable[[str], float]] = None):
+        self._fn = score_fn or (lambda name: 0.0)
+        self.calls: list[str] = []
+
+    def score(self, state, pod, node_info) -> tuple[float, Status]:
+        name = node_info.node.metadata.name
+        self.calls.append(name)
+        return float(self._fn(name)), Status()
+
+
+class FakeReservePlugin(ReservePlugin):
+    """Records Reserve/Unreserve calls; optionally fails Reserve."""
+
+    NAME = "FakeReserve"
+
+    def __init__(self, fail: bool = False):
+        self.fail = fail
+        self.reserved: list[tuple[str, str]] = []
+        self.unreserved: list[tuple[str, str]] = []
+
+    def reserve(self, state, pod, node_name: str) -> Status:
+        self.reserved.append((pod.metadata.name, node_name))
+        if self.fail:
+            return Status.unschedulable("reserve failed", plugin=self.NAME)
+        return Status()
+
+    def unreserve(self, state, pod, node_name: str) -> None:
+        self.unreserved.append((pod.metadata.name, node_name))
+
+
+class FakePermitPlugin(PermitPlugin):
+    """Returns a scripted (Status, timeout) per pod; default allows."""
+
+    NAME = "FakePermit"
+
+    def __init__(self, decide: Optional[Callable[[object], tuple]] = None):
+        self._decide = decide
+        self.calls: list[str] = []
+
+    def permit(self, state, pod, node_name: str):
+        self.calls.append(pod.metadata.name)
+        if self._decide is None:
+            return Status(), 0.0
+        return self._decide(pod)
+
+
+_FAKES: dict[str, tuple[type, tuple[str, ...]]] = {
+    TrueFilterPlugin.NAME: (TrueFilterPlugin, ("filter",)),
+    FalseFilterPlugin.NAME: (FalseFilterPlugin, ("filter",)),
+    MatchFilterPlugin.NAME: (MatchFilterPlugin, ("filter",)),
+    FakeScorePlugin.NAME: (FakeScorePlugin, ("score",)),
+    FakeReservePlugin.NAME: (FakeReservePlugin, ("reserve",)),
+    FakePermitPlugin.NAME: (FakePermitPlugin, ("permit",)),
+}
+
+
+def fake_registry(**instances) -> dict[str, PluginDescriptor]:
+    """in_tree_registry() + every fake plugin. Pass pre-built instances by
+    plugin name (e.g. ``FakeScore=FakeScorePlugin(fn)``) to script them;
+    unnamed fakes are default-constructed by the framework."""
+    reg = in_tree_registry()
+    for name, (cls, points) in _FAKES.items():
+        inst = instances.get(name)
+        factory = ((lambda args, i=inst: i) if inst is not None
+                   else (lambda args, c=cls: c()))
+        reg[name] = PluginDescriptor(name=name, points=points,
+                                     factory=factory)
+    return reg
+
+
+def fake_profile(*enabled: str, weights: Optional[dict[str, float]] = None,
+                 scheduler_name: str = "default-scheduler"
+                 ) -> SchedulerProfile:
+    """Default profile + the named fakes enabled at their points."""
+    plugins: Plugins = default_plugins()
+    weights = weights or {}
+    for name in enabled:
+        _, points = _FAKES[name]
+        for point in points:
+            getattr(plugins, point).enabled.append(
+                PluginRef(name, weights.get(name, 0.0)))
+    return SchedulerProfile(scheduler_name=scheduler_name, plugins=plugins)

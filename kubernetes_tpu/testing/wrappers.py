@@ -1,0 +1,256 @@
+"""Fluent pod/node builders for tests — the TPU-framework analog of the
+reference's wrapper fixtures (pkg/scheduler/testing/wrappers.go:298 MakePod,
+:824 MakeNode). Chain setters, finish with ``.obj()``:
+
+    pod = (MakePod().name("p").req(cpu="500m").priority(10)
+           .pod_anti_affinity("kubernetes.io/hostname", {"app": "a"})
+           .obj())
+    node = MakeNode().name("n1").capacity(cpu="32").taint("k", "v").obj()
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api.objects import (
+    Affinity,
+    Container,
+    ContainerImage,
+    ContainerPort,
+    LABEL_HOSTNAME,
+    LabelSelector,
+    LabelSelectorRequirement,
+    Node,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PodSchedulingGate,
+    PodSpec,
+    PreferredSchedulingTerm,
+    ResourceRequirements,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+)
+
+
+class MakePod:
+    """Fluent Pod builder (wrappers.go:298 st.MakePod())."""
+
+    def __init__(self) -> None:
+        self._pod = Pod(metadata=ObjectMeta(name="pod"), spec=PodSpec())
+
+    def obj(self) -> Pod:
+        if not self._pod.spec.containers:
+            self._pod.spec.containers = [Container(name="c")]
+        return self._pod
+
+    # ---- metadata ----
+    def name(self, n: str) -> "MakePod":
+        self._pod.metadata.name = n
+        return self
+
+    def namespace(self, ns: str) -> "MakePod":
+        self._pod.metadata.namespace = ns
+        return self
+
+    def uid(self, u: str) -> "MakePod":
+        self._pod.metadata.uid = u
+        return self
+
+    def label(self, k: str, v: str) -> "MakePod":
+        self._pod.metadata.labels[k] = v
+        return self
+
+    def labels(self, d: dict) -> "MakePod":
+        self._pod.metadata.labels.update(d)
+        return self
+
+    # ---- spec ----
+    def req(self, **resources: str) -> "MakePod":
+        """Add a container with the given requests (cpu="500m", memory=...).
+        Underscores in kwargs map to dashes (ephemeral_storage)."""
+        reqs = {k.replace("_", "-"): v for k, v in resources.items()}
+        self._pod.spec.containers.append(Container(
+            name=f"c{len(self._pod.spec.containers)}",
+            resources=ResourceRequirements(requests=reqs)))
+        return self
+
+    def container_image(self, image: str, **resources: str) -> "MakePod":
+        self.req(**resources)
+        self._pod.spec.containers[-1].image = image
+        return self
+
+    def priority(self, p: int) -> "MakePod":
+        self._pod.spec.priority = p
+        return self
+
+    def node_name(self, n: str) -> "MakePod":
+        self._pod.spec.node_name = n
+        return self
+
+    def scheduler_name(self, n: str) -> "MakePod":
+        self._pod.spec.scheduler_name = n
+        return self
+
+    def node_selector(self, sel: dict) -> "MakePod":
+        self._pod.spec.node_selector.update(sel)
+        return self
+
+    def host_port(self, port: int, proto: str = "TCP",
+                  host_ip: str = "") -> "MakePod":
+        if not self._pod.spec.containers:
+            self._pod.spec.containers = [Container(name="c")]
+        self._pod.spec.containers[-1].ports.append(ContainerPort(
+            host_port=port, protocol=proto, host_ip=host_ip))
+        return self
+
+    def toleration(self, key: str, value: str = "", effect: str = "",
+                   operator: str = "Equal") -> "MakePod":
+        self._pod.spec.tolerations.append(Toleration(
+            key=key, operator=operator, value=value, effect=effect))
+        return self
+
+    def scheduling_gate(self, name: str) -> "MakePod":
+        self._pod.spec.scheduling_gates.append(PodSchedulingGate(name=name))
+        return self
+
+    def preemption_policy(self, p: str) -> "MakePod":
+        self._pod.spec.preemption_policy = p
+        return self
+
+    # ---- affinity ----
+    def _affinity(self) -> Affinity:
+        if self._pod.spec.affinity is None:
+            self._pod.spec.affinity = Affinity()
+        return self._pod.spec.affinity
+
+    def node_affinity_in(self, key: str, vals: list[str]) -> "MakePod":
+        """requiredDuringScheduling In-match (wrappers.go NodeAffinityIn)."""
+        a = self._affinity()
+        if a.node_affinity is None:
+            a.node_affinity = NodeAffinity()
+        if a.node_affinity.required is None:
+            a.node_affinity.required = NodeSelector(node_selector_terms=[])
+        a.node_affinity.required.node_selector_terms.append(NodeSelectorTerm(
+            match_expressions=[NodeSelectorRequirement(
+                key=key, operator="In", values=list(vals))]))
+        return self
+
+    def preferred_node_affinity(self, weight: int, key: str,
+                                vals: list[str]) -> "MakePod":
+        a = self._affinity()
+        if a.node_affinity is None:
+            a.node_affinity = NodeAffinity()
+        a.node_affinity.preferred.append(PreferredSchedulingTerm(
+            weight=weight, preference=NodeSelectorTerm(
+                match_expressions=[NodeSelectorRequirement(
+                    key=key, operator="In", values=list(vals))])))
+        return self
+
+    @staticmethod
+    def _term(topology_key: str, match: dict | LabelSelector
+              ) -> PodAffinityTerm:
+        sel = (match if isinstance(match, LabelSelector)
+               else LabelSelector(match_labels=dict(match)))
+        return PodAffinityTerm(topology_key=topology_key,
+                               label_selector=sel)
+
+    def pod_affinity(self, topology_key: str,
+                     match: dict | LabelSelector) -> "MakePod":
+        a = self._affinity()
+        if a.pod_affinity is None:
+            a.pod_affinity = PodAffinity()
+        a.pod_affinity.required.append(self._term(topology_key, match))
+        return self
+
+    def pod_anti_affinity(self, topology_key: str,
+                          match: dict | LabelSelector) -> "MakePod":
+        a = self._affinity()
+        if a.pod_anti_affinity is None:
+            a.pod_anti_affinity = PodAntiAffinity()
+        a.pod_anti_affinity.required.append(self._term(topology_key, match))
+        return self
+
+    def preferred_pod_affinity(self, weight: int, topology_key: str,
+                               match: dict | LabelSelector) -> "MakePod":
+        a = self._affinity()
+        if a.pod_affinity is None:
+            a.pod_affinity = PodAffinity()
+        a.pod_affinity.preferred.append(WeightedPodAffinityTerm(
+            weight=weight,
+            pod_affinity_term=self._term(topology_key, match)))
+        return self
+
+    def preferred_pod_anti_affinity(self, weight: int, topology_key: str,
+                                    match: dict | LabelSelector) -> "MakePod":
+        a = self._affinity()
+        if a.pod_anti_affinity is None:
+            a.pod_anti_affinity = PodAntiAffinity()
+        a.pod_anti_affinity.preferred.append(WeightedPodAffinityTerm(
+            weight=weight,
+            pod_affinity_term=self._term(topology_key, match)))
+        return self
+
+    def spread_constraint(self, max_skew: int, topology_key: str,
+                          when_unsatisfiable: str = "DoNotSchedule",
+                          match: dict | None = None,
+                          min_domains: int | None = None) -> "MakePod":
+        self._pod.spec.topology_spread_constraints.append(
+            TopologySpreadConstraint(
+                max_skew=max_skew, topology_key=topology_key,
+                when_unsatisfiable=when_unsatisfiable,
+                label_selector=LabelSelector(match_labels=dict(match or {})),
+                min_domains=min_domains))
+        return self
+
+
+class MakeNode:
+    """Fluent Node builder (wrappers.go:824 st.MakeNode())."""
+
+    def __init__(self) -> None:
+        self._node = Node(metadata=ObjectMeta(name="node"), spec=NodeSpec(),
+                          status=NodeStatus(allocatable={
+                              "cpu": "32", "memory": "128Gi", "pods": "110"}))
+
+    def obj(self) -> Node:
+        # hostname label mirrors the apiserver's defaulting; tests rely on
+        # hostname-keyed topology just like the reference's wrappers
+        self._node.metadata.labels.setdefault(
+            LABEL_HOSTNAME, self._node.metadata.name)
+        return self._node
+
+    def name(self, n: str) -> "MakeNode":
+        self._node.metadata.name = n
+        return self
+
+    def label(self, k: str, v: str) -> "MakeNode":
+        self._node.metadata.labels[k] = v
+        return self
+
+    def capacity(self, **resources: str) -> "MakeNode":
+        self._node.status.allocatable.update(
+            {k.replace("_", "-"): v for k, v in resources.items()})
+        return self
+
+    def taint(self, key: str, value: str = "",
+              effect: str = "NoSchedule") -> "MakeNode":
+        self._node.spec.taints.append(Taint(key=key, value=value,
+                                            effect=effect))
+        return self
+
+    def unschedulable(self, v: bool = True) -> "MakeNode":
+        self._node.spec.unschedulable = v
+        return self
+
+    def image(self, name: str, size_bytes: int) -> "MakeNode":
+        self._node.status.images.append(ContainerImage(
+            names=[name], size_bytes=size_bytes))
+        return self

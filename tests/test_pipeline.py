@@ -132,6 +132,12 @@ def test_pct_nodes_to_score_knob():
     # the capped run only ever reports <= k feasible nodes
     assert (np.asarray(capped.feasible_count) <= 100).all()
     assert (np.asarray(full.feasible_count) == 200).all()
+    # explicit 0 = the reference's ADAPTIVE percentage (49% at 200 nodes
+    # -> k=max(100, 98)=100): truncates exactly like pct=50 here
+    from kubernetes_tpu.models.pipeline import ADAPTIVE_PCT
+    adaptive = schedule_batch_jit(cb, pb, wk, w, caps,
+                                  pct_nodes=ADAPTIVE_PCT)
+    assert (np.asarray(adaptive.feasible_count) <= 100).all()
     # pct=100 never truncates: byte-identical placements to the default
     same = schedule_batch_jit(cb, pb, wk, w, caps, pct_nodes=100)
     np.testing.assert_array_equal(rows_f, np.asarray(same.node_row))

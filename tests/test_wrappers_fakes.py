@@ -1,0 +1,90 @@
+"""Fluent wrappers + scripted fake plugins (pkg/scheduler/testing
+equivalents) exercised through the REAL Scheduler loop — the same
+pattern as the reference's fake-plugin framework tests
+(testing/framework/fake_plugins.go driving schedule_one_test.go)."""
+
+import numpy as np
+
+from kubernetes_tpu.config.types import default_config
+from kubernetes_tpu.hub import Hub
+from kubernetes_tpu.ops.features import Capacities
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing import (
+    FakeReservePlugin,
+    FakeScorePlugin,
+    MakeNode,
+    MakePod,
+    MatchFilterPlugin,
+    fake_profile,
+    fake_registry,
+)
+
+CAPS = Capacities(nodes=16, pods=64)
+
+
+def _sched(hub, *fakes, caps=CAPS, **instances):
+    cfg = default_config()
+    cfg.batch_size = 8
+    cfg.profiles = [fake_profile(*fakes)]
+    return Scheduler(hub, cfg, caps=caps,
+                     registry=fake_registry(**instances))
+
+
+def test_wrappers_build_schedulable_objects():
+    hub = Hub()
+    for i in range(4):
+        hub.create_node(MakeNode().name(f"wn-{i}")
+                        .label("zone", f"z{i % 2}")
+                        .capacity(cpu="8", memory="32Gi").obj())
+    sched = _sched(hub)
+    pod = (MakePod().name("w-pod").req(cpu="500m", memory="1Gi")
+           .priority(5)
+           .node_affinity_in("zone", ["z1"])
+           .toleration("k", "v", "NoSchedule")
+           .obj())
+    hub.create_pod(pod)
+    sched.run_until_idle()
+    bound = hub.get_pod(pod.metadata.uid)
+    assert bound.spec.node_name in ("wn-1", "wn-3"), bound.spec.node_name
+    sched.close()
+
+
+def test_match_filter_fake_restricts_to_named_node():
+    hub = Hub()
+    for i in range(6):
+        hub.create_node(MakeNode().name(f"node-{i}").obj())
+    sched = _sched(hub, MatchFilterPlugin.NAME)
+    pod = MakePod().name("node-3").req(cpu="100m").obj()
+    hub.create_pod(pod)
+    sched.run_until_idle()
+    assert hub.get_pod(pod.metadata.uid).spec.node_name == "node-3"
+    sched.close()
+
+
+def test_fake_score_steers_selection():
+    hub = Hub()
+    for i in range(5):
+        hub.create_node(MakeNode().name(f"node-{i}").obj())
+    fake = FakeScorePlugin(lambda name: 100.0 if name == "node-4" else 0.0)
+    sched = _sched(hub, FakeScorePlugin.NAME, FakeScore=fake)
+    pod = MakePod().name("steered").req(cpu="100m").obj()
+    hub.create_pod(pod)
+    sched.run_until_idle()
+    assert hub.get_pod(pod.metadata.uid).spec.node_name == "node-4"
+    assert len(fake.calls) == 5, "scored once per node"
+    sched.close()
+
+
+def test_fake_reserve_failure_unreserves_and_requeues():
+    hub = Hub()
+    hub.create_node(MakeNode().name("only").obj())
+    fake = FakeReservePlugin(fail=True)
+    sched = _sched(hub, FakeReservePlugin.NAME, FakeReserve=fake)
+    pod = MakePod().name("rejected").req(cpu="100m").obj()
+    hub.create_pod(pod)
+    sched.run_until_idle()
+    assert hub.get_pod(pod.metadata.uid).spec.node_name == ""
+    assert fake.reserved, "reserve ran"
+    assert fake.unreserved == fake.reserved, \
+        "failed reserve must roll back via unreserve (schedule_one.go:212)"
+    sched.close()
