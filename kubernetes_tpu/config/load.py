@@ -19,11 +19,8 @@ from kubernetes_tpu.config.types import (
     SchedulerProfile,
     default_plugins,
 )
+from kubernetes_tpu.config.types import PLUGIN_SET_FIELDS as _POINTS
 from kubernetes_tpu.extender import ExtenderConfig
-
-_POINTS = ("pre_enqueue", "queue_sort", "pre_filter", "filter",
-           "post_filter", "pre_score", "score", "reserve", "permit",
-           "pre_bind", "bind", "post_bind", "multi_point")
 
 
 def _plugin_set(doc: dict) -> PluginSet:

@@ -20,6 +20,10 @@ EXTENSION_POINTS = (
     "post_bind",
 )
 
+# the PluginSet fields on Plugins: every extension point + the MultiPoint
+# shorthand (config load and validation iterate this, types.go:133-190)
+PLUGIN_SET_FIELDS = EXTENSION_POINTS + ("multi_point",)
+
 
 @dataclass
 class Plugin:
@@ -79,11 +83,11 @@ class SchedulerConfiguration:
 
     parallelism: int = 16
     profiles: list[SchedulerProfile] = field(default_factory=list)
-    # accepted for config parity; deliberately a NO-OP on device: the
-    # reference samples nodes to bound its serial goroutine fan-out
-    # (percentageOfNodesToScore), but one fused launch scores EVERY node in
-    # parallel for the same cost, so sampling would only lose placement
-    # quality
+    # percentageOfNodesToScore (schedule_one.go:668): None (default) scores
+    # every node — on TPU one fused launch covers the full node set for the
+    # same cost, so truncation buys nothing and loses placement quality.
+    # When SET, the serial scan reproduces the reference's rotating
+    # feasible-window selection (0 = the adaptive 50-nodes/125 formula)
     percentage_of_nodes_to_score: Optional[int] = None
     pod_initial_backoff_seconds: float = 1.0
     pod_max_backoff_seconds: float = 10.0

@@ -1,8 +1,61 @@
-"""Configuration validation (apis/config/validation in the reference)."""
+"""Configuration validation — the reference's apis/config/validation
+(validation.go ValidateKubeSchedulerConfiguration) re-derived for this
+config surface: scalar ranges, feature gates, profile uniqueness +
+queue-sort uniformity (profile/profile.go:47-66 NewMap), per-profile
+plugin existence/weights, scoring-strategy args, and extender entries."""
 
 from __future__ import annotations
 
-from kubernetes_tpu.config.types import SchedulerConfiguration
+from kubernetes_tpu.config.types import (
+    PLUGIN_SET_FIELDS as _POINTS,
+    SchedulerConfiguration,
+)
+
+_FIT_STRATEGIES = ("LeastAllocated", "MostAllocated",
+                   "RequestedToCapacityRatio")
+
+
+def _validate_fit_args(prefix: str, args: dict, errs: list[str]) -> None:
+    """NodeResourcesFitArgs (validation/validation_pluginargs.go); key
+    spelling matches what Framework.fit_scoring actually reads
+    (snake_case, runtime.py)."""
+    ss = args.get("scoring_strategy")
+    if ss is None:
+        return
+    stype = ss.get("type", "LeastAllocated")
+    if stype not in _FIT_STRATEGIES:
+        errs.append(f"{prefix}: scoring_strategy.type {stype!r} must be one "
+                    f"of {', '.join(_FIT_STRATEGIES)}")
+    shape = (ss.get("requested_to_capacity_ratio") or {}).get("shape", [])
+    if stype == "RequestedToCapacityRatio" and not shape:
+        errs.append(f"{prefix}: RequestedToCapacityRatio requires a "
+                    "non-empty shape")
+    last = None
+    for pt in shape:
+        u, s = pt.get("utilization", 0), pt.get("score", 0)
+        if not 0 <= u <= 100:
+            errs.append(f"{prefix}: shape utilization {u} not in [0, 100]")
+        if not 0 <= s <= 10:
+            errs.append(f"{prefix}: shape score {s} not in [0, 10]")
+        if last is not None and u <= last:
+            errs.append(f"{prefix}: shape utilization must be strictly "
+                        "increasing")
+        last = u
+
+
+def _validate_extenders(cfg: SchedulerConfiguration,
+                        errs: list[str]) -> None:
+    """validation.go validateExtenders: url required; weight must be
+    positive only when a prioritize verb makes it meaningful."""
+    for i, e in enumerate(cfg.extenders):
+        prefix = f"extenders[{i}]"
+        if not getattr(e, "url_prefix", ""):
+            errs.append(f"{prefix}: url_prefix is required")
+        if (getattr(e, "prioritize_verb", "")
+                and getattr(e, "weight", 1.0) <= 0):
+            errs.append(f"{prefix}: weight must be positive")
+        if getattr(e, "timeout_seconds", 1.0) <= 0:
+            errs.append(f"{prefix}: timeout_seconds must be positive")
 
 
 def validate_config(cfg: SchedulerConfiguration,
@@ -13,6 +66,10 @@ def validate_config(cfg: SchedulerConfiguration,
         errs.append("parallelism must be positive")
     if cfg.batch_size <= 0:
         errs.append("batch_size must be positive")
+    if cfg.binding_workers <= 0:
+        errs.append("binding_workers must be positive")
+    if cfg.node_capacity <= 0 or cfg.pod_table_capacity <= 0:
+        errs.append("mirror capacities must be positive")
     from kubernetes_tpu.config.types import KNOWN_FEATURE_GATES
 
     for gate in cfg.feature_gates:
@@ -30,18 +87,39 @@ def validate_config(cfg: SchedulerConfiguration,
     names = [p.scheduler_name for p in cfg.profiles]
     if len(set(names)) != len(names):
         errs.append("duplicate profile schedulerName")
+    for p in cfg.profiles:
+        if not p.scheduler_name:
+            errs.append("profile schedulerName must be non-empty")
+    if registry is not None and len(cfg.profiles) > 1:
+        # queue-sort uniformity: one shared queue across profiles requires
+        # one sort order (profile.go:57 "different queue sort plugins");
+        # resolved with the runtime's own MultiPoint expansion so disabled
+        # sets and custom sorts are honored
+        from kubernetes_tpu.framework.runtime import expand_point
+
+        sorts = {tuple(name for name, _ in
+                       expand_point(prof, registry, "queue_sort"))
+                 for prof in cfg.profiles}
+        if len(sorts) > 1:
+            errs.append("all profiles must use the same queueSort plugin set")
+    _validate_extenders(cfg, errs)
     if registry is not None:
         for prof in cfg.profiles:
-            sets = [getattr(prof.plugins, pt) for pt in (
-                "pre_enqueue", "queue_sort", "pre_filter", "filter",
-                "post_filter", "pre_score", "score", "reserve", "permit",
-                "pre_bind", "bind", "post_bind", "multi_point")]
-            for ps in sets:
-                for pl in ps.enabled:
+            for pt in _POINTS:
+                for pl in getattr(prof.plugins, pt).enabled:
                     if pl.name not in registry:
                         errs.append(
                             f"profile {prof.scheduler_name}: unknown plugin "
                             f"{pl.name}")
                     if pl.weight < 0:
                         errs.append(f"plugin {pl.name}: negative weight")
+                    if pl.weight > 100 and pt in ("score", "multi_point"):
+                        # MaxWeight guard (validation.go); weight is inert
+                        # on every other point (types.py Plugin)
+                        errs.append(f"plugin {pl.name}: weight > 100")
+            fit_args = prof.plugin_config.get("NodeResourcesFit")
+            if fit_args:
+                _validate_fit_args(
+                    f"profile {prof.scheduler_name}: NodeResourcesFit",
+                    fit_args, errs)
     return errs

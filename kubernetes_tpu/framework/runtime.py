@@ -58,6 +58,55 @@ _WEIGHT_FIELD = {
 }
 
 
+def _score_weight(point: str, explicit: float, multipoint: float,
+                  d: PluginDescriptor) -> float:
+    if point != "score":
+        return 0.0
+    # scorePluginWeight: explicit > multipoint > default > 1
+    return explicit or multipoint or d.default_weight or 1.0
+
+
+def expand_point(profile, registry: dict[str, PluginDescriptor],
+                 point: str) -> list[tuple[str, float]]:
+    """Effective (name, weight) list at one extension point: MultiPoint
+    expansion with specific-point overrides and disabled sets
+    (runtime/framework.go:523 expandMultiPointPlugins). Module-level so
+    config validation resolves points exactly the way the runtime will."""
+    plugins = profile.plugins
+    ps = getattr(plugins, point)
+    mp = plugins.multi_point
+    disabled = {p.name for p in ps.disabled}
+    wipe = "*" in disabled
+    mp_disabled = {p.name for p in mp.disabled}
+    mp_wipe = "*" in mp_disabled
+    explicit = {p.name: p for p in ps.enabled}
+    out: list[tuple[str, float]] = []
+    consumed: set[str] = set()
+    for p in mp.enabled:
+        d = registry.get(p.name)
+        if d is None or point not in d.points:
+            continue
+        if mp_wipe or p.name in mp_disabled:
+            continue
+        if wipe or p.name in disabled:
+            continue
+        if p.name in explicit:
+            # specific-point config overrides weight, keeps MP order
+            out.append((p.name, _score_weight(point, explicit[p.name].weight,
+                                              p.weight, d)))
+            consumed.add(p.name)
+        else:
+            out.append((p.name, _score_weight(point, 0.0, p.weight, d)))
+    for p in ps.enabled:
+        if p.name in consumed:
+            continue
+        d = registry.get(p.name)
+        if d is None or point not in d.points:
+            continue
+        out.append((p.name, _score_weight(point, p.weight, 0.0, d)))
+    return out
+
+
 class Framework:
     """One profile's resolved plugin configuration + host-plugin instances."""
 
@@ -92,47 +141,7 @@ class Framework:
     # ------------- MultiPoint expansion (framework.go:523) -------------
 
     def _expand(self, point: str) -> list[tuple[str, float]]:
-        plugins = self.profile.plugins
-        ps = getattr(plugins, point)
-        mp = plugins.multi_point
-        disabled = {p.name for p in ps.disabled}
-        wipe = "*" in disabled
-        mp_disabled = {p.name for p in mp.disabled}
-        mp_wipe = "*" in mp_disabled
-        explicit = {p.name: p for p in ps.enabled}
-        out: list[tuple[str, float]] = []
-        consumed: set[str] = set()
-        for p in mp.enabled:
-            d = self.registry.get(p.name)
-            if d is None or point not in d.points:
-                continue
-            if mp_wipe or p.name in mp_disabled:
-                continue
-            if wipe or p.name in disabled:
-                continue
-            if p.name in explicit:
-                # specific-point config overrides weight, keeps MP order
-                out.append((p.name, self._weight(point, explicit[p.name].weight,
-                                                 p.weight, d)))
-                consumed.add(p.name)
-            else:
-                out.append((p.name, self._weight(point, 0.0, p.weight, d)))
-        for p in ps.enabled:
-            if p.name in consumed:
-                continue
-            d = self.registry.get(p.name)
-            if d is None or point not in d.points:
-                continue
-            out.append((p.name, self._weight(point, p.weight, 0.0, d)))
-        return out
-
-    @staticmethod
-    def _weight(point: str, explicit: float, multipoint: float,
-                d: PluginDescriptor) -> float:
-        if point != "score":
-            return 0.0
-        # scorePluginWeight: explicit > multipoint > default > 1
-        return explicit or multipoint or d.default_weight or 1.0
+        return expand_point(self.profile, self.registry, point)
 
     # ------------- device launch configuration -------------
 
@@ -178,10 +187,18 @@ class Framework:
         return self._instances.get(name)
 
     def _iter(self, point: str, cls):
-        for name, _ in self.points[point]:
-            inst = self._instances.get(name)
-            if isinstance(inst, cls):
-                yield inst
+        """Instances at a point matching cls, cached: this runs per pod per
+        extension point on the commit path, and the plugin sets are fixed
+        after construction (the reference's frameworkImpl also resolves its
+        per-point slices once, runtime/framework.go:268)."""
+        cache = self.__dict__.setdefault("_iter_cache", {})
+        key = (point, cls)
+        out = cache.get(key)
+        if out is None:
+            out = cache[key] = tuple(
+                inst for name, _ in self.points[point]
+                if isinstance(inst := self._instances.get(name), cls))
+        return out
 
     def has_host_filters(self) -> bool:
         """Any instantiated host FilterPlugin in the filter point? (device

@@ -79,15 +79,17 @@ class Histogram:
         # per-label-set: (bucket counts [len+1], sum, count)
         self._series: dict[tuple, list] = {}
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, n: int = 1, **labels) -> None:
+        """Record ``value`` ``n`` times (n>1 = the batched loop attributing
+        one per-pod value to a whole batch without n histogram walks)."""
         k = _labels_key(labels)
         s = self._series.get(k)
         if s is None:
             s = self._series[k] = [[0] * (len(self.buckets) + 1), 0.0, 0]
         idx = bisect.bisect_left(self.buckets, value)
-        s[0][idx] += 1
-        s[1] += value
-        s[2] += 1
+        s[0][idx] += n
+        s[1] += value * n
+        s[2] += n
 
     def count(self, **labels) -> int:
         s = self._series.get(_labels_key(labels))

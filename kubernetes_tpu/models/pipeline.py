@@ -855,6 +855,19 @@ def schedule_batch_jit(cblobs, pblobs, wk, weights, caps,
                           fit_strategy, fit_shape, pct_nodes, pct_start)
 
 
+@partial(jax.jit, static_argnames=("caps",))
+def extract_state_jit(cblobs, caps):
+    """(free, nonzero_requested) of a cluster blob — the seed for the
+    device-resident usage chain. The Scheduler feeds this to every
+    UNCHAINED launch so chained and unchained dispatches share one
+    schedule_batch_jit signature (state always present): the warmup pass
+    then compiles the exact program the full-scale drain runs, instead of
+    a fresh multi-second XLA compile appearing mid-phase the first time a
+    drain chains two batches."""
+    ct = unpack_cluster(cblobs, caps)
+    return ct.free, ct.nonzero_requested
+
+
 def launch_batch(spec, wk, weights, caps, enabled_filters=None,
                  serial_scan=True, state=None, host_ok=None,
                  host_score=None, fit_strategy="LeastAllocated",

@@ -51,10 +51,12 @@ from kubernetes_tpu.framework.runtime import Framework
 from kubernetes_tpu.framework.interface import Code
 from kubernetes_tpu.framework.waiting import WaitingPod
 from kubernetes_tpu.hub import EventHandlers, Hub
+from kubernetes_tpu.utils.gcguard import guard as gc_guard
 from kubernetes_tpu.models.pipeline import (
     ADAPTIVE_PCT,
     FILTER_PLUGINS,
     BatchResult,
+    extract_state_jit,
     launch_batch,
 )
 from kubernetes_tpu.metrics import AsyncRecorder, SchedulerMetrics
@@ -65,6 +67,11 @@ logger = logging.getLogger("kubernetes_tpu.scheduler")
 # a scheduling cycle slower than this logs a phase-by-phase trace
 # (schedule_one.go:404's 100ms slow-attempt threshold)
 SLOW_CYCLE_SECONDS = 0.1
+
+# outstanding chained launches in run_until_idle's software pipeline: 2 =
+# commit batch k-1 while launches k and k+1 queue on the device, which
+# hides the device wait entirely when host commit time ~ device time
+PIPELINE_DEPTH = 2
 
 A = ActionType
 R = EventResource
@@ -513,6 +520,13 @@ class Scheduler:
                     [qp.pod for qp in runnable], self.config.batch_size)
                 break
             except CapacityError as e:
+                if flush_pending is not None:
+                    # commit in-flight launches against the OLD mirror NOW:
+                    # _grow replaces self.mirror with an empty re-bucketed
+                    # one, and a later flush would resolve their node rows
+                    # against it (name_of_row -> None for every row)
+                    flush_pending()
+                    flush_pending = None
                 self._grow(e)          # invalidates the chain
                 state = None
                 need_sync = True
@@ -543,6 +557,11 @@ class Scheduler:
                 or self._extenders:
             host_ok, host_score = self._run_host_plugins(runnable)
         fit_strategy, fit_shape = pcfg["fit"]
+        if state is None:
+            # seed the usage chain from the freshly synced mirror so every
+            # launch carries explicit state: one jit signature for chained
+            # and unchained dispatches (see pipeline.extract_state_jit)
+            state = extract_state_jit(spec.cblobs, self.caps)
         out: BatchResult = launch_batch(
             spec, self.mirror.well_known(), pcfg["weights"], self.caps,
             pcfg["filters"], serial_scan=not use_auction, state=state,
@@ -713,17 +732,22 @@ class Scheduler:
         runnable, out, t_dispatched, pack_s = inflight
         n = len(runnable)
         t0 = self.now()
-        rows, rejects = jax.device_get((out.node_row, out.reject_counts))
+        rows = np.asarray(jax.device_get(out.node_row))[:n].tolist()
         launch_s = self.now() - t_dispatched
-        rows = np.asarray(rows)[:n].tolist()
-        rejects = np.asarray(rejects)[:n].tolist()
         t1 = self.now()
         failures = []
-        for qp, row, rej in zip(runnable, rows, rejects):
+        rejects = None
+        for i, (qp, row) in enumerate(zip(runnable, rows)):
             if row >= 0:
                 self._commit(qp, self.mirror.name_of_row(row))
             else:
-                failures.append((qp, rej))
+                if rejects is None:
+                    # reject attribution is only read on failure; skipping
+                    # the [B, P] pull when every pod placed keeps the
+                    # host<->device link to one tiny [B] row vector
+                    rejects = np.asarray(
+                        jax.device_get(out.reject_counts))[:n].tolist()
+                failures.append((qp, rejects[i]))
         if failures:
             self._handle_failures(failures)
         commit_s = self.now() - t1
@@ -735,9 +759,13 @@ class Scheduler:
         m.extension_point_duration.observe(launch_s, extension_point="Filter")
         m.extension_point_duration.observe(commit_s, extension_point="Reserve")
         per_pod = cycle_s / max(n, 1)
-        for qp, row in zip(runnable, rows):
-            m.attempt_duration.observe(
-                per_pod, result="scheduled" if row >= 0 else "unschedulable")
+        n_fail = len(failures)
+        if n - n_fail:
+            m.attempt_duration.observe(per_pod, n=n - n_fail,
+                                       result="scheduled")
+        if n_fail:
+            m.attempt_duration.observe(per_pod, n=n_fail,
+                                       result="unschedulable")
         if cycle_s > SLOW_CYCLE_SECONDS:
             # schedule_one.go:404's slow-attempt trace, batch-shaped
             from kubernetes_tpu.utils.tracing import Trace
@@ -1136,18 +1164,25 @@ class Scheduler:
         (scheduler_perf.go:819 churnOp). A truthy return stops the drain
         (pending work is still committed): with a churn feed the queue may
         never go idle, so the harness signals "measured phase done" here."""
-        with self._lock:
+        with self._lock, gc_guard:
             return self._run_until_idle_locked(max_batches, on_step)
 
     def _run_until_idle_locked(self, max_batches, on_step) -> int:
         total = 0
-        pending: Optional[tuple] = None
+        # up to PIPELINE_DEPTH launches in flight: chained launches queue
+        # back-to-back on the device, so blocking on the OLDEST one after
+        # dispatching the newest gives the device a whole iteration of
+        # host-side commit work as head start (the batched analog of the
+        # reference's scheduling/binding goroutine overlap, P3)
+        pending: deque[tuple] = deque()
 
-        def flush() -> None:
-            nonlocal pending
-            if pending is not None:
-                p, pending = pending, None
-                self._finish(p)
+        def flush_all() -> None:
+            while pending:
+                self._finish(pending.popleft())
+
+        def flush_to(depth: int) -> None:
+            while len(pending) > depth:
+                self._finish(pending.popleft())
 
         for _ in range(max_batches):
             self._process_deferred_events()
@@ -1160,27 +1195,34 @@ class Scheduler:
             if now - self._last_backoff_flush >= 1.0:
                 self._last_backoff_flush = now
                 self.queue.flush_backoff_completed()
+                # once-a-second young-gen sweep keeps deferred cyclic
+                # garbage bounded during long drains (see utils.gcguard)
+                gc_guard.idle_sweep()
             if on_step is not None and on_step():
                 break
             popped, runnable = self._pop_runnable()
             if popped == 0:
-                flush()
+                flush_all()
                 self.queue.flush_backoff_completed()
                 popped, runnable = self._pop_runnable()
                 if popped == 0:
                     break
             total += popped
-            nxt = None
             if runnable:
                 chained = self._chain_eligible([qp.pod for qp in runnable])
                 if not chained:
-                    flush()   # next launch needs the synced cache
-                nxt = self._dispatch(runnable, chained, flush_pending=flush)
-            flush()
-            pending = nxt
+                    flush_all()   # next launch needs the synced cache
+                nxt = self._dispatch(runnable, chained,
+                                     flush_pending=flush_all)
+                if nxt is not None:
+                    pending.append(nxt)
+            # keep up to PIPELINE_DEPTH launches outstanding: batch k-1 is
+            # committed only after k AND k+1 are queued, so the device gets
+            # a full iteration (dispatch + commit) of head start
+            flush_to(PIPELINE_DEPTH)
             # async preemption evictions run between cycles (kep 4832)
             self.preemption.flush_evictions()
-        flush()
+        flush_all()
         self._drain_bind_results(wait=True)
         self.preemption.flush_evictions()
         self._process_deferred_events()

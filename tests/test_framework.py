@@ -149,3 +149,52 @@ def test_disabled_filter_device_semantics():
     out2 = launch_batch(spec, mirror.well_known(), fw_on.score_weights(),
                         caps, fw_on.enabled_filters())
     assert int(out2.node_row[0]) == -1
+
+
+def test_validation_deep():
+    """validation.go parity: queue-sort uniformity, extender entries,
+    scoring-strategy args, weight bounds."""
+    from kubernetes_tpu.config.types import SchedulerProfile, default_plugins
+    from kubernetes_tpu.extender import ExtenderConfig
+
+    cfg = default_config()
+    # queue-sort uniformity across profiles (profile.go:57): profile B
+    # wipes PrioritySort from its queue_sort point, so the two profiles
+    # resolve to different effective sort sets under MultiPoint expansion
+    second = SchedulerProfile(scheduler_name="other",
+                              plugins=default_plugins())
+    second.plugins.queue_sort.disabled.append(Plugin("*"))
+    cfg.profiles.append(second)
+    errs = validate_config(cfg, in_tree_registry())
+    assert any("queueSort" in e for e in errs)
+
+    cfg = default_config()
+    cfg.extenders.append(ExtenderConfig(url_prefix="", weight=-1,
+                                        prioritize_verb="prioritize"))
+    errs = validate_config(cfg)
+    assert any("url_prefix" in e for e in errs)
+    assert any("weight" in e for e in errs)
+    # weight only matters with a prioritize verb (validation.go)
+    cfg = default_config()
+    cfg.extenders.append(ExtenderConfig(url_prefix="http://x",
+                                        filter_verb="filter", weight=0))
+    assert validate_config(cfg) == []
+
+    cfg = default_config()
+    cfg.profiles[0].plugin_config["NodeResourcesFit"] = {
+        "scoring_strategy": {"type": "RequestedToCapacityRatio",
+                             "requested_to_capacity_ratio": {"shape": [
+                                 {"utilization": 80, "score": 5},
+                                 {"utilization": 20, "score": 200},
+                             ]}}}
+    errs = validate_config(cfg, in_tree_registry())
+    assert any("strictly increasing" in e for e in errs)
+    assert any("not in [0, 10]" in e for e in errs)
+
+    cfg = default_config()
+    cfg.profiles[0].plugins.score.enabled.append(Plugin("ImageLocality", 500))
+    errs = validate_config(cfg, in_tree_registry())
+    assert any("weight > 100" in e for e in errs)
+    cfg = default_config()
+    cfg.binding_workers = 0
+    assert any("binding_workers" in e for e in validate_config(cfg))
