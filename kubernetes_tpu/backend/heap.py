@@ -2,15 +2,25 @@
 
 Equivalent of /root/reference/pkg/scheduler/backend/heap/heap.go: a
 binary heap keyed by an arbitrary less(a, b) with O(1) membership lookup,
-update-in-place, and delete-by-key. When the ordering is expressible as a
-per-item sort key (the default PrioritySort is), pass ``sort_key_fn`` and
-sift operations compare precomputed tuples at C speed instead of calling
-a Python comparator O(n log n) times per drain.
+update-in-place, and delete-by-key.
+
+Two engines share the public API:
+
+* When the ordering is expressible as a per-item numeric sort key (the
+  default PrioritySort is: (-priority, enqueue time); backoff expiry is),
+  pass ``sort_key_fn`` — the heap then runs on the C++ ``KeyedHeap``
+  (kubernetes_tpu.native, src/_native.cpp) with all sift comparisons in
+  native code. An item whose sort key is not coercible to (float, float)
+  degrades the instance to the Python engine transparently.
+* Otherwise (custom queue-sort plugins with arbitrary less semantics),
+  a pure-Python binary heap calling less_fn.
 """
 
 from __future__ import annotations
 
 from typing import Callable, Generic, Optional, TypeVar
+
+from kubernetes_tpu.native import mod as _native
 
 T = TypeVar("T")
 
@@ -26,22 +36,56 @@ class Heap(Generic[T]):
         # sifts never re-invoke key_fn
         self._entries: list[tuple[str, object, T]] = []
         self._index: dict[str, int] = {}
+        self._nh = (_native.KeyedHeap()
+                    if sort_key_fn is not None and _native is not None
+                    else None)
 
     def __len__(self) -> int:
+        if self._nh is not None:
+            return len(self._nh)
         return len(self._entries)
 
     def __contains__(self, key: str) -> bool:
+        if self._nh is not None:
+            return key in self._nh
         return key in self._index
 
     def get(self, key: str) -> Optional[T]:
+        if self._nh is not None:
+            return self._nh.get(key)
         i = self._index.get(key)
         return self._entries[i][2] if i is not None else None
+
+    def _degrade(self) -> None:
+        """Move every native entry to the Python engine (an item produced
+        a sort key the C heap can't order). The sort key is dropped
+        entirely — a fn emitting non-numeric keys can't be trusted to emit
+        mutually comparable ones either — so ordering reverts to less_fn,
+        the authoritative comparator."""
+        items, self._nh = self._nh.list(), None
+        self._sort_key = None
+        for it in items:
+            self.add(it)
 
     def add(self, item: T) -> None:
         """Insert or update (re-heapify around the item); the sort key is
         (re)computed here, so updates that change ordering fields must go
         through add, as they always had to for less_fn correctness."""
         key = self._key(item)
+        if self._nh is not None:
+            sk = self._sort_key(item)
+            try:
+                if len(sk) > 2:
+                    # >2 components can't ride the (a, b) engine without
+                    # silently changing tie-breaks — degrade, don't truncate
+                    raise TypeError
+                a = float(sk[0])
+                b = float(sk[1]) if len(sk) > 1 else 0.0
+            except (TypeError, ValueError, IndexError):
+                self._degrade()
+            else:
+                self._nh.add(key, a, b, item)
+                return
         entry = (key, self._sort_key(item) if self._sort_key else None, item)
         i = self._index.get(key)
         if i is not None:
@@ -53,23 +97,31 @@ class Heap(Generic[T]):
             self._up(len(self._entries) - 1)
 
     def delete(self, key: str) -> Optional[T]:
+        if self._nh is not None:
+            return self._nh.delete(key)
         i = self._index.get(key)
         if i is None:
             return None
         return self._remove_at(i)
 
     def peek(self) -> Optional[T]:
+        if self._nh is not None:
+            return self._nh.peek()
         return self._entries[0][2] if self._entries else None
 
     def pop(self) -> Optional[T]:
+        if self._nh is not None:
+            return self._nh.pop()
         if not self._entries:
             return None
         return self._remove_at(0)
 
     def list(self) -> list[T]:
+        if self._nh is not None:
+            return self._nh.list()
         return [e[2] for e in self._entries]
 
-    # ---- internals ----
+    # ---- pure-Python engine internals ----
 
     def _lt(self, a: tuple[str, object, T], b: tuple[str, object, T]) -> bool:
         if self._sort_key is not None:

@@ -29,6 +29,7 @@ from kubernetes_tpu.backend.heap import Heap
 from kubernetes_tpu.framework.interface import (
     ClusterEvent,
     ClusterEventWithHint,
+    EventResource as R,
     QueueingHint,
     Status,
 )
@@ -52,6 +53,9 @@ class QueuedPodInfo:
     unschedulable_plugins: set[str] = field(default_factory=set)
     pending_plugins: set[str] = field(default_factory=set)
     gated_plugin: str = ""
+    # park-index bookkeeping: the (resource, action) keys this pod is
+    # filed under while parked (see PriorityQueue._park)
+    park_keys: list = field(default_factory=list)
     # host Filter rejects from the last attempt (plugin -> node count);
     # merged into the failure diagnosis alongside device reject_counts
     host_reject_counts: dict[str, int] = field(default_factory=dict)
@@ -98,8 +102,23 @@ class PriorityQueue:
             lambda qp: qp.uid, less_fn, sort_key_fn=sort_key_fn)
         self._backoff: Heap[QueuedPodInfo] = Heap(
             lambda qp: qp.uid,
-            lambda a, b: self._backoff_expiry(a) < self._backoff_expiry(b))
+            lambda a, b: self._backoff_expiry(a) < self._backoff_expiry(b),
+            # expiry is a plain float: the backoff heap rides the native
+            # engine (expiry recomputes on every add, same as less_fn did)
+            sort_key_fn=lambda qp: (self._backoff_expiry(qp),))
         self._unschedulable: dict[str, QueuedPodInfo] = {}
+        # gated pods (PreEnqueue rejections) live apart from unschedulable
+        # ones: 10k parked gated pods must cost busy-path events nothing
+        # (the SchedulingWhileGated workload's whole point)
+        self._gated: dict[str, QueuedPodInfo] = {}
+        # inverted requeue index over BOTH parked pools: (resource, action)
+        # of every registered ClusterEvent of a pod's rejecting/gating
+        # plugins -> uids. move_all touches only pods subscribed to a
+        # matching event instead of sweeping O(parked) per event — the
+        # index form of scheduling_queue.go:428's isPodWorthRequeuing
+        # prefilter, needed because a Python sweep is ~100x the Go one.
+        self._park_index: dict[tuple, set[str]] = {}
+        self._park_all: set[str] = set()   # pods any event can requeue
         # in-flight machinery (active_queue.go:147-169): ONE shared event log
         # (seq, event, old, new) + per-pod start seq — appending an event is
         # O(1) regardless of how many pods are in flight (the reference's
@@ -134,19 +153,63 @@ class PriorityQueue:
                            initial_attempt_timestamp=None)
         self._enqueue(qp)
 
+    def _park(self, qp: QueuedPodInfo,
+              pool: dict[str, QueuedPodInfo]) -> None:
+        """File a pod in a parked pool + the inverted requeue index."""
+        uid = qp.uid
+        pool[uid] = qp
+        plugins = set(qp.unschedulable_plugins)
+        if qp.gated_plugin:
+            plugins.add(qp.gated_plugin)
+        keys = []
+        wide = not plugins
+        for plugin in plugins:
+            regs = self._hints.get(plugin)
+            if regs is None:
+                # no registrations (extenders, out-of-tree): any event may
+                # unstick it, like _worth_requeuing treats it
+                wide = True
+                continue
+            for reg in regs:
+                keys.append((reg.event.resource, reg.event.action_type))
+        if wide:
+            self._park_all.add(uid)
+        for k in keys:
+            self._park_index.setdefault(k, set()).add(uid)
+        qp.park_keys = keys
+
+    def _unpark(self, qp: QueuedPodInfo) -> None:
+        uid = qp.uid
+        self._park_all.discard(uid)
+        for k in qp.park_keys:
+            bucket = self._park_index.get(k)
+            if bucket is not None:
+                bucket.discard(uid)
+                if not bucket:
+                    del self._park_index[k]
+        qp.park_keys = []
+
+    def _pop_parked(self, uid: str) -> Optional[QueuedPodInfo]:
+        qp = self._unschedulable.pop(uid, None)
+        if qp is None:
+            qp = self._gated.pop(uid, None)
+        if qp is not None:
+            self._unpark(qp)
+        return qp
+
     def _enqueue(self, qp: QueuedPodInfo) -> None:
-        """Run PreEnqueue gates; activeQ on success, unschedulable if gated
+        """Run PreEnqueue gates; activeQ on success, gated pool if gated
         (scheduling_queue.go:538 runPreEnqueuePlugins)."""
         s = self._pre_enqueue(qp.pod)
         if s.is_success():
             qp.gated_plugin = ""
             self._active.add(qp)
-            self._unschedulable.pop(qp.uid, None)
+            self._pop_parked(qp.uid)
             self._backoff.delete(qp.uid)
         else:
             qp.gated_plugin = s.plugin
             qp.unschedulable_plugins.add(s.plugin)
-            self._unschedulable[qp.uid] = qp
+            self._park(qp, self._gated)
 
     def update(self, old: Pod, new: Pod) -> None:
         uid = new.metadata.uid
@@ -156,13 +219,13 @@ class PriorityQueue:
                 qp.pod = new
                 heap.add(qp)
                 return
-        qp = self._unschedulable.get(uid)
+        qp = self._unschedulable.get(uid) or self._gated.get(uid)
         if qp is not None:
             qp.pod = new
             if qp.gated_plugin:
                 # gates may have been lifted by this update
                 qp.timestamp = self._now()
-                self._unschedulable.pop(uid)
+                self._pop_parked(uid)
                 self._enqueue(qp)
             return
         if uid not in self._in_flight:
@@ -172,7 +235,7 @@ class PriorityQueue:
         uid = pod.metadata.uid
         self._active.delete(uid)
         self._backoff.delete(uid)
-        self._unschedulable.pop(uid, None)
+        self._pop_parked(uid)
 
     # ------------- pop / in-flight -------------
 
@@ -228,7 +291,7 @@ class PriorityQueue:
         start = self._in_flight.pop(uid, None)
         qp.timestamp = self._now()
         if uid in self._active or uid in self._backoff \
-                or uid in self._unschedulable:
+                or uid in self._unschedulable or uid in self._gated:
             self._trim_events()
             return
         if start is not None:
@@ -245,12 +308,12 @@ class PriorityQueue:
             # (scheduling_queue.go:861 rejectedByError -> backoffQ)
             self._requeue(qp)
             return
-        self._unschedulable[uid] = qp
+        self._park(qp, self._unschedulable)
 
     def activate(self, pods: list[Pod]) -> None:
         """Plugin-requested activation (scheduling_queue.go:684)."""
         for pod in pods:
-            qp = self._unschedulable.pop(pod.metadata.uid, None)
+            qp = self._pop_parked(pod.metadata.uid)
             if qp is None:
                 qp = self._backoff.delete(pod.metadata.uid)
             if qp is not None:
@@ -284,7 +347,7 @@ class PriorityQueue:
         """To activeQ if backoff is over, else backoffQ
         (scheduling_queue.go:1139-1210 movePodsToActiveOrBackoffQueue)."""
         if qp.gated_plugin:
-            self._unschedulable[qp.uid] = qp
+            self._park(qp, self._gated)
             return
         if self._backoff_expiry(qp) <= self._now():
             self._enqueue(qp)
@@ -294,7 +357,7 @@ class PriorityQueue:
                 self._backoff.add(qp)
             else:
                 qp.gated_plugin = s.plugin
-                self._unschedulable[qp.uid] = qp
+                self._park(qp, self._gated)
 
     def move_all_to_active_or_backoff(self, event: ClusterEvent,
                                       old_obj=None, new_obj=None) -> int:
@@ -306,20 +369,34 @@ class PriorityQueue:
             self._next_seq += 1
         self._moved_cycle += 1
         moved = 0
-        for uid in list(self._unschedulable):
-            qp = self._unschedulable[uid]
-            if qp.gated_plugin:
-                # gated pods re-run PreEnqueue instead of hints
+        # candidates via the inverted index: distinct registered events are
+        # few (tens), parked pods can be tens of thousands — only pods
+        # whose plugins registered a MATCHING event are touched at all
+        cands = set(self._park_all)
+        for (res, action), uids in self._park_index.items():
+            if ((res == R.WILDCARD or res == event.resource)
+                    and action & event.action_type):
+                cands |= uids
+        for uid in cands:
+            qp = self._gated.get(uid)
+            if qp is not None:
+                # gated pods re-run PreEnqueue instead of hints (the
+                # matching registration got them here — e.g. the gates
+                # plugin's gate-eliminated event, or DefaultPreemption's
+                # victim-delete)
                 s = self._pre_enqueue(qp.pod)
                 if s.is_success():
-                    del self._unschedulable[uid]
+                    self._pop_parked(uid)
                     qp.gated_plugin = ""
                     qp.timestamp = self._now()
                     self._enqueue(qp)
                     moved += 1
                 continue
+            qp = self._unschedulable.get(uid)
+            if qp is None:
+                continue
             if self._worth_requeuing(qp, event, old_obj, new_obj):
-                del self._unschedulable[uid]
+                self._pop_parked(uid)
                 self._requeue(qp)
                 moved += 1
         return moved
@@ -344,12 +421,12 @@ class PriorityQueue:
         unconditionally (30s tick; 5min default timeout)."""
         now = self._now()
         moved = 0
+        # gated pods are exempt: no event, no timeout ungates them
+        # (the reference's flushUnschedulablePodsLeftover skips gated too)
         for uid in list(self._unschedulable):
             qp = self._unschedulable[uid]
-            if qp.gated_plugin:
-                continue
             if now - qp.timestamp >= self._max_in_unschedulable:
-                del self._unschedulable[uid]
+                self._pop_parked(uid)
                 self._requeue(qp)
                 moved += 1
         return moved
@@ -358,15 +435,13 @@ class PriorityQueue:
 
     def pending_counts(self) -> dict[str, int]:
         """pending_pods gauge split by queue (metrics.go:201)."""
-        gated = sum(1 for qp in self._unschedulable.values()
-                    if qp.gated_plugin)
         return {
             "active": len(self._active),
             "backoff": len(self._backoff),
-            "unschedulable": len(self._unschedulable) - gated,
-            "gated": gated,
+            "unschedulable": len(self._unschedulable),
+            "gated": len(self._gated),
         }
 
     def __len__(self) -> int:
         return (len(self._active) + len(self._backoff)
-                + len(self._unschedulable))
+                + len(self._unschedulable) + len(self._gated))

@@ -1,0 +1,64 @@
+"""Loader for the C++ host extension (src/_native.cpp).
+
+Compiles the module once per environment on first import (g++ into
+``_build/``, atomic rename) and exposes it as ``mod``; ``mod is None``
+when no toolchain is available or the build fails, and every consumer
+(backend.heap, utils.quantity) silently uses its pure-Python path. Set
+``KUBERNETES_TPU_NO_NATIVE=1`` to force the fallback.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sysconfig
+import tempfile
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "_native.cpp")
+_BUILD_DIR = os.path.join(_HERE, "_build")
+_SO = os.path.join(_BUILD_DIR, "kubernetes_tpu_native.so")
+
+
+def _build() -> bool:
+    include = sysconfig.get_paths()["include"]
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD_DIR)
+    os.close(fd)
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+           f"-I{include}", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)  # atomic: concurrent builders race safely
+        return True
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _load():
+    if os.environ.get("KUBERNETES_TPU_NO_NATIVE"):
+        return None
+    if not os.path.exists(_SO):
+        # stale check is deliberate and cheap: rebuild when the source is
+        # newer than the artifact (dev edits)
+        if not _build():
+            return None
+    elif os.path.getmtime(_SRC) > os.path.getmtime(_SO):
+        if not _build():
+            return None
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "kubernetes_tpu_native", _SO)
+        m = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(m)
+        return m
+    except Exception:  # noqa: BLE001 — any load failure means fallback
+        return None
+
+
+mod = _load()

@@ -1,0 +1,425 @@
+// kubernetes_tpu native host extension (C++/CPython C API; no pybind11).
+//
+// The reference's performance-critical host layer is the Go runtime itself
+// (SURVEY.md §2.9); ours is XLA for the device math plus this module for
+// the two host structures hot enough to show up next to it in profiles:
+//
+//  * KeyedHeap — the map-indexed binary heap under activeQ/backoffQ
+//    (reference: pkg/scheduler/backend/heap/heap.go). Sort keys are two
+//    doubles (PrioritySort = (-priority, enqueue time); backoff = expiry),
+//    so sifts run entirely in C with no Python comparisons.
+//  * parse_milli / parse_ceil — exact integer quantity parsing
+//    (apimachinery's resource.Quantity MilliValue/Value semantics, ceil
+//    rounding), replacing per-call decimal.Decimal arithmetic.
+//
+// Loaded by kubernetes_tpu.native (ctypes-free: a real extension module,
+// compiled on first import by build()); every consumer falls back to the
+// pure-Python implementation when the toolchain is unavailable.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <new>
+#include <vector>
+
+namespace {
+
+// ------------------------------------------------------------------ heap
+
+struct Entry {
+    PyObject *key;   // owned
+    double a;
+    double b;
+    PyObject *item;  // owned
+};
+
+struct HeapObj {
+    PyObject_HEAD
+    std::vector<Entry> *entries;
+    PyObject *index;  // dict: key -> int position (kept in lockstep)
+};
+
+static inline bool entry_lt(const Entry &x, const Entry &y) {
+    if (x.a != y.a) return x.a < y.a;
+    return x.b < y.b;
+}
+
+static int heap_set_index(HeapObj *self, PyObject *key, Py_ssize_t i) {
+    PyObject *pos = PyLong_FromSsize_t(i);
+    if (pos == nullptr) return -1;
+    int rc = PyDict_SetItem(self->index, key, pos);
+    Py_DECREF(pos);
+    return rc;
+}
+
+static void heap_swap(HeapObj *self, Py_ssize_t i, Py_ssize_t j) {
+    auto &e = *self->entries;
+    std::swap(e[i], e[j]);
+    // index updates cannot fail here in practice (keys already present);
+    // on the impossible failure PyErr is left set for the caller
+    heap_set_index(self, e[i].key, i);
+    heap_set_index(self, e[j].key, j);
+}
+
+static Py_ssize_t heap_up(HeapObj *self, Py_ssize_t i) {
+    auto &e = *self->entries;
+    while (i > 0) {
+        Py_ssize_t parent = (i - 1) / 2;
+        if (entry_lt(e[i], e[parent])) {
+            heap_swap(self, i, parent);
+            i = parent;
+        } else {
+            break;
+        }
+    }
+    return i;
+}
+
+static void heap_down(HeapObj *self, Py_ssize_t i) {
+    auto &e = *self->entries;
+    Py_ssize_t n = (Py_ssize_t)e.size();
+    for (;;) {
+        Py_ssize_t l = 2 * i + 1, r = 2 * i + 2, smallest = i;
+        if (l < n && entry_lt(e[l], e[smallest])) smallest = l;
+        if (r < n && entry_lt(e[r], e[smallest])) smallest = r;
+        if (smallest == i) return;
+        heap_swap(self, i, smallest);
+        i = smallest;
+    }
+}
+
+static PyObject *heap_new(PyTypeObject *type, PyObject *, PyObject *) {
+    HeapObj *self = (HeapObj *)type->tp_alloc(type, 0);
+    if (self == nullptr) return nullptr;
+    self->entries = new (std::nothrow) std::vector<Entry>();
+    self->index = PyDict_New();
+    if (self->entries == nullptr || self->index == nullptr) {
+        Py_XDECREF(self->index);
+        delete self->entries;
+        Py_TYPE(self)->tp_free((PyObject *)self);
+        return PyErr_NoMemory();
+    }
+    return (PyObject *)self;
+}
+
+static void heap_dealloc(HeapObj *self) {
+    if (self->entries != nullptr) {
+        for (Entry &e : *self->entries) {
+            Py_DECREF(e.key);
+            Py_DECREF(e.item);
+        }
+        delete self->entries;
+    }
+    Py_XDECREF(self->index);
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+static PyObject *heap_add(HeapObj *self, PyObject *args) {
+    PyObject *key, *item;
+    double a, b;
+    if (!PyArg_ParseTuple(args, "OddO", &key, &a, &b, &item)) return nullptr;
+    PyObject *pos = PyDict_GetItemWithError(self->index, key);  // borrowed
+    if (pos == nullptr && PyErr_Occurred()) return nullptr;
+    if (pos != nullptr) {
+        Py_ssize_t i = PyLong_AsSsize_t(pos);
+        if (i == -1 && PyErr_Occurred()) return nullptr;
+        Entry &e = (*self->entries)[i];
+        Py_INCREF(key);
+        Py_INCREF(item);
+        Py_DECREF(e.key);
+        Py_DECREF(e.item);
+        e.key = key;
+        e.item = item;
+        e.a = a;
+        e.b = b;
+        heap_down(self, heap_up(self, i));
+    } else {
+        Py_INCREF(key);
+        Py_INCREF(item);
+        self->entries->push_back(Entry{key, a, b, item});
+        Py_ssize_t i = (Py_ssize_t)self->entries->size() - 1;
+        if (heap_set_index(self, key, i) < 0) {
+            self->entries->pop_back();
+            Py_DECREF(key);
+            Py_DECREF(item);
+            return nullptr;
+        }
+        heap_up(self, i);
+    }
+    Py_RETURN_NONE;
+}
+
+static PyObject *heap_remove_at(HeapObj *self, Py_ssize_t i) {
+    auto &e = *self->entries;
+    Entry victim = e[i];
+    Py_ssize_t last = (Py_ssize_t)e.size() - 1;
+    if (i != last) heap_swap(self, i, last);
+    // after the swap the victim sits at `last`
+    e.pop_back();
+    if (PyDict_DelItem(self->index, victim.key) < 0) {
+        PyErr_Clear();  // index desync would be a bug; never leave errors
+    }
+    if (i < (Py_ssize_t)e.size()) heap_down(self, heap_up(self, i));
+    PyObject *item = victim.item;  // transfer ownership to caller
+    Py_DECREF(victim.key);
+    return item;
+}
+
+static PyObject *heap_pop(HeapObj *self, PyObject *) {
+    if (self->entries->empty()) Py_RETURN_NONE;
+    return heap_remove_at(self, 0);
+}
+
+static PyObject *heap_peek(HeapObj *self, PyObject *) {
+    if (self->entries->empty()) Py_RETURN_NONE;
+    PyObject *item = (*self->entries)[0].item;
+    Py_INCREF(item);
+    return item;
+}
+
+static PyObject *heap_delete(HeapObj *self, PyObject *key) {
+    PyObject *pos = PyDict_GetItemWithError(self->index, key);
+    if (pos == nullptr) {
+        if (PyErr_Occurred()) return nullptr;
+        Py_RETURN_NONE;
+    }
+    Py_ssize_t i = PyLong_AsSsize_t(pos);
+    if (i == -1 && PyErr_Occurred()) return nullptr;
+    return heap_remove_at(self, i);
+}
+
+static PyObject *heap_get(HeapObj *self, PyObject *key) {
+    PyObject *pos = PyDict_GetItemWithError(self->index, key);
+    if (pos == nullptr) {
+        if (PyErr_Occurred()) return nullptr;
+        Py_RETURN_NONE;
+    }
+    Py_ssize_t i = PyLong_AsSsize_t(pos);
+    if (i == -1 && PyErr_Occurred()) return nullptr;
+    PyObject *item = (*self->entries)[i].item;
+    Py_INCREF(item);
+    return item;
+}
+
+static PyObject *heap_list(HeapObj *self, PyObject *) {
+    Py_ssize_t n = (Py_ssize_t)self->entries->size();
+    PyObject *out = PyList_New(n);
+    if (out == nullptr) return nullptr;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = (*self->entries)[i].item;
+        Py_INCREF(item);
+        PyList_SET_ITEM(out, i, item);
+    }
+    return out;
+}
+
+static Py_ssize_t heap_len(HeapObj *self) {
+    return (Py_ssize_t)self->entries->size();
+}
+
+static int heap_contains(HeapObj *self, PyObject *key) {
+    return PyDict_Contains(self->index, key);
+}
+
+static PyMethodDef heap_methods[] = {
+    {"add", (PyCFunction)heap_add, METH_VARARGS,
+     "add(key, a, b, item): insert or update-in-place by key"},
+    {"pop", (PyCFunction)heap_pop, METH_NOARGS, "pop smallest item or None"},
+    {"peek", (PyCFunction)heap_peek, METH_NOARGS, "smallest item or None"},
+    {"delete", (PyCFunction)heap_delete, METH_O,
+     "remove by key, returning the item or None"},
+    {"get", (PyCFunction)heap_get, METH_O, "item by key or None"},
+    {"list", (PyCFunction)heap_list, METH_NOARGS, "items, heap order"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static PySequenceMethods heap_as_sequence = {
+    (lenfunc)heap_len,            // sq_length
+    nullptr, nullptr, nullptr, nullptr, nullptr, nullptr,
+    (objobjproc)heap_contains,    // sq_contains
+    nullptr, nullptr,
+};
+
+static PyTypeObject HeapType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "kubernetes_tpu_native.KeyedHeap",       // tp_name
+    sizeof(HeapObj),                         // tp_basicsize
+};
+
+// ------------------------------------------------------------- quantity
+
+// Exact quantity parse -> __int128 with ceil rounding at a given scale.
+// Returns 0 on success, -1 on malformed input, -2 on overflow (caller
+// falls back to the arbitrary-precision Python path).
+static inline bool is_space(char c) {
+    return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\v' ||
+           c == '\f';
+}
+
+static int parse_quantity_scaled(const char *s, int extra_exp10,
+                                 long long *out) {
+    while (is_space(*s)) s++;
+    bool neg = false;
+    if (*s == '+') s++;
+    else if (*s == '-') { neg = true; s++; }
+
+    __int128 mant = 0;
+    int frac_digits = 0;
+    bool any_digit = false, in_frac = false;
+    for (; *s; s++) {
+        if (*s >= '0' && *s <= '9') {
+            mant = mant * 10 + (*s - '0');
+            if (mant > (__int128)1 << 100) return -2;
+            if (in_frac) frac_digits++;
+            any_digit = true;
+        } else if (*s == '.') {
+            if (in_frac) return -1;
+            in_frac = true;
+        } else {
+            break;
+        }
+    }
+    if (!any_digit) return -1;
+
+    long exp10 = 0;
+    if (*s == 'e' || *s == 'E') {
+        // only an exponent when digits follow — otherwise this is the E
+        // (exa) or Ei (exbi) SUFFIX ("1E", "2Ei")
+        const char *save = s;
+        s++;
+        bool eneg = false;
+        if (*s == '+') s++;
+        else if (*s == '-') { eneg = true; s++; }
+        if (*s >= '0' && *s <= '9') {
+            for (; *s >= '0' && *s <= '9'; s++) {
+                exp10 = exp10 * 10 + (*s - '0');
+                if (exp10 > 40) return -2;
+            }
+            if (eneg) exp10 = -exp10;
+        } else {
+            s = save;
+        }
+    }
+
+    long long bin_mult = 1;
+    if (*s != '\0' && !is_space(*s)) {
+        if (s[1] == 'i') {
+            switch (s[0]) {
+                case 'K': bin_mult = 1LL << 10; break;
+                case 'M': bin_mult = 1LL << 20; break;
+                case 'G': bin_mult = 1LL << 30; break;
+                case 'T': bin_mult = 1LL << 40; break;
+                case 'P': bin_mult = 1LL << 50; break;
+                case 'E': bin_mult = 1LL << 60; break;
+                default: return -1;
+            }
+            s += 2;
+        } else {
+            switch (s[0]) {
+                case 'n': exp10 -= 9; break;
+                case 'u': exp10 -= 6; break;
+                case 'm': exp10 -= 3; break;
+                case 'k': exp10 += 3; break;
+                case 'M': exp10 += 6; break;
+                case 'G': exp10 += 9; break;
+                case 'T': exp10 += 12; break;
+                case 'P': exp10 += 15; break;
+                case 'E': exp10 += 18; break;
+                default: return -1;
+            }
+            s += 1;
+        }
+    }
+    while (is_space(*s)) s++;
+    if (*s != '\0') return -1;
+
+    exp10 += extra_exp10 - frac_digits;
+    __int128 v = mant * (__int128)bin_mult;
+    const __int128 LIMIT = (__int128)1 << 126;
+    while (exp10 > 0) {
+        v *= 10;
+        exp10--;
+        if (v > LIMIT) return -2;
+    }
+    bool inexact = false;
+    while (exp10 < 0) {
+        inexact = inexact || (v % 10 != 0);
+        v /= 10;
+        exp10++;
+    }
+    if (neg) {
+        // requests are never negative in practice; mirror Decimal math:
+        // ceil(-x) drops the fraction toward zero
+        v = -v;
+    } else if (inexact) {
+        v += 1;  // ceil
+    }
+    if (v > (__int128)INT64_MAX || v < (__int128)INT64_MIN) return -2;
+    *out = (long long)v;
+    return 0;
+}
+
+static PyObject *quantity_call(PyObject *arg, int extra_exp10) {
+    const char *s = PyUnicode_AsUTF8(arg);
+    if (s == nullptr) return nullptr;
+    long long out;
+    int rc = parse_quantity_scaled(s, extra_exp10, &out);
+    if (rc == -1) {
+        PyErr_Format(PyExc_ValueError, "malformed quantity %R", arg);
+        return nullptr;
+    }
+    if (rc == -2) {
+        PyErr_Format(PyExc_OverflowError, "quantity out of range: %R", arg);
+        return nullptr;
+    }
+    return PyLong_FromLongLong(out);
+}
+
+static PyObject *parse_milli(PyObject *, PyObject *arg) {
+    return quantity_call(arg, 3);   // Quantity.MilliValue, ceil
+}
+
+static PyObject *parse_ceil(PyObject *, PyObject *arg) {
+    return quantity_call(arg, 0);   // Quantity.Value, ceil
+}
+
+// ------------------------------------------------------------- module
+
+static PyMethodDef module_methods[] = {
+    {"parse_milli", parse_milli, METH_O,
+     "quantity string -> integer units*1000, ceil (MilliValue)"},
+    {"parse_ceil", parse_ceil, METH_O,
+     "quantity string -> integer units, ceil (Value)"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static struct PyModuleDef native_module = {
+    PyModuleDef_HEAD_INIT,
+    "kubernetes_tpu_native",
+    "C++ host structures for the TPU scheduler (heap, quantity parse)",
+    -1,
+    module_methods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_kubernetes_tpu_native(void) {
+    HeapType.tp_dealloc = (destructor)heap_dealloc;
+    HeapType.tp_flags = Py_TPFLAGS_DEFAULT;
+    HeapType.tp_doc = "map-indexed binary heap ordered by (a, b) doubles";
+    HeapType.tp_methods = heap_methods;
+    HeapType.tp_new = heap_new;
+    HeapType.tp_as_sequence = &heap_as_sequence;
+    if (PyType_Ready(&HeapType) < 0) return nullptr;
+    PyObject *m = PyModule_Create(&native_module);
+    if (m == nullptr) return nullptr;
+    Py_INCREF(&HeapType);
+    if (PyModule_AddObject(m, "KeyedHeap", (PyObject *)&HeapType) < 0) {
+        Py_DECREF(&HeapType);
+        Py_DECREF(m);
+        return nullptr;
+    }
+    return m;
+}

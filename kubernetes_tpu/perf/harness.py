@@ -67,6 +67,9 @@ class CreatePods:
     # maximum wall-clock seconds to wait for the phase to finish before
     # declaring the workload stuck (the reference fails the test case)
     timeout_s: float = 600.0
+    # wait=False: create without draining (pods that are NOT expected to
+    # schedule — e.g. permanently gated pods parked by PreEnqueue)
+    wait: bool = True
 
 
 @dataclass
@@ -239,6 +242,10 @@ def run_workload(w: Workload, now: Callable[[], float] = time.time,
                     collector.begin()
                 for p in pods:
                     hub.create_pod(p)
+                if not op.wait:
+                    phases.append({"op": "createPods", "count": n,
+                                   "measured": False, "waited": False})
+                    continue
                 if collector is not None:
                     drain(collector.done, op.timeout_s)
                     summary = collector.summarize()

@@ -250,6 +250,75 @@ def mixed_churn(init_nodes=5000, measure_pods=10000) -> Workload:
         ])
 
 
+# --------------------------------------------- 8. SchedulingDaemonset
+# misc/performance-config.yaml:100-128 (15000Nodes, 390): one pod per node,
+# pinned the way the daemonset controller pins them — a required
+# nodeAffinity matchFields term on metadata.name (the scheduler still runs
+# the full pipeline; NodeAffinity's PreFilter narrows to the one node).
+
+def _daemonset_pod(i: int) -> Pod:
+    aff = Affinity(node_affinity=NodeAffinity(required=NodeSelector(
+        node_selector_terms=[NodeSelectorTerm(match_fields=[
+            NodeSelectorRequirement(key="metadata.name", operator="In",
+                                    values=[f"node-{i}"])])])))
+    return _pod(f"ds-{i}", cpu="100m", mem="200Mi", affinity=aff)
+
+
+def scheduling_daemonset(init_nodes=15000, measure_pods=15000) -> Workload:
+    return Workload(
+        name="SchedulingDaemonset/15000Nodes",
+        threshold=390,
+        node_capacity=16384,
+        pod_capacity=32768,
+        ops=[
+            CreateNodes(init_nodes, _node),
+            CreatePods(measure_pods, _daemonset_pod,
+                       collect_metrics=True),
+        ],
+        # matchFields pin per pod: every pod is its own topology-free spec;
+        # warmup must see the same node bucket so the full-size node table
+        # compiles up front
+        warm_full_nodes=True)
+
+
+# ------------------------------------------- 9. SchedulingWhileGated
+# misc/performance-config.yaml:425-460 (1Node_10000GatedPods, 130): 10k
+# permanently gated pods park in unschedulablePods; 10k plain pods then
+# schedule onto one huge node — measures that the gated pool costs the
+# hot path nothing (PreEnqueue gate + no requeue events).
+
+def _gated_pod(i: int) -> Pod:
+    from kubernetes_tpu.api.objects import PodSchedulingGate
+
+    p = _pod(f"gated-{i}", cpu="1m", mem="1Mi")
+    p.spec.scheduling_gates = [PodSchedulingGate(name="example.com/hold")]
+    return p
+
+
+def _big_node(i: int) -> Node:
+    name = f"node-{i}"
+    return Node(
+        metadata=ObjectMeta(name=name, labels={LABEL_HOSTNAME: name}),
+        spec=NodeSpec(),
+        status=NodeStatus(allocatable={
+            "cpu": "4000", "memory": "64Ti", "pods": "30000"}))
+
+
+def scheduling_while_gated(gated_pods=10000, measure_pods=10000) -> Workload:
+    return Workload(
+        name="SchedulingWhileGated/1Node_10000GatedPods",
+        threshold=130,
+        node_capacity=64,
+        pod_capacity=32768,
+        ops=[
+            CreateNodes(1, _big_node),
+            CreatePods(gated_pods, _gated_pod, wait=False),
+            CreatePods(measure_pods, lambda i: _pod(f"measure-{i}",
+                                                    cpu="1m", mem="1Mi"),
+                       collect_metrics=True),
+        ])
+
+
 # the 5 BASELINE.json configs bench.py runs within the driver's budget
 BENCH_WORKLOADS = (
     scheduling_basic,
@@ -263,4 +332,6 @@ BENCH_WORKLOADS = (
 ALL_WORKLOADS = BENCH_WORKLOADS + (
     unschedulable,
     mixed_churn,
+    scheduling_daemonset,
+    scheduling_while_gated,
 )

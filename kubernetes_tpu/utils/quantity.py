@@ -16,6 +16,8 @@ from __future__ import annotations
 import math
 from decimal import Decimal, InvalidOperation
 
+from kubernetes_tpu.native import mod as _native
+
 _BINARY = {"Ki": 1024, "Mi": 1024**2, "Gi": 1024**3, "Ti": 1024**4,
            "Pi": 1024**5, "Ei": 1024**6}
 _DECIMAL = {"n": Decimal("1e-9"), "u": Decimal("1e-6"), "m": Decimal("1e-3"),
@@ -47,16 +49,33 @@ def parse_quantity(s: str | int | float) -> Decimal:
 def parse_cpu_milli(s: str | int | float) -> int:
     """CPU quantity -> integer milli-cores, rounding up (never under-reserve).
 
-    Mirrors Quantity.MilliValue() semantics (scale by 1000, ceil).
-    """
+    Mirrors Quantity.MilliValue() semantics (scale by 1000, ceil). String
+    parses run in the C++ extension when available (native/src/_native.cpp
+    parse_milli — exact int128 arithmetic); values past int64 fall back to
+    the Decimal path here."""
+    if _native is not None and type(s) is str:
+        try:
+            return _native.parse_milli(s)
+        except (OverflowError, ValueError):
+            pass  # out-of-int64 or C-grammar gap: exact Decimal path
     return math.ceil(parse_quantity(s) * 1000)
 
 
 def parse_bytes(s: str | int | float) -> int:
     """Memory/storage quantity -> integer bytes, rounding up."""
+    if _native is not None and type(s) is str:
+        try:
+            return _native.parse_ceil(s)
+        except (OverflowError, ValueError):
+            pass
     return math.ceil(parse_quantity(s))
 
 
 def parse_int(s: str | int | float) -> int:
     """Generic scalar resource (pods, GPUs, hugepages counts) -> int, ceil."""
+    if _native is not None and type(s) is str:
+        try:
+            return _native.parse_ceil(s)
+        except (OverflowError, ValueError):
+            pass
     return math.ceil(parse_quantity(s))

@@ -1,0 +1,143 @@
+"""C++ host extension (kubernetes_tpu.native): parity with the pure-Python
+engines it replaces. Skips cleanly when no toolchain built the module."""
+
+import random
+
+import pytest
+
+from kubernetes_tpu.native import mod as native
+
+
+def test_native_module_loads():
+    # the environment bakes in g++ (SURVEY env notes); if this fails the
+    # production heaps/parsers silently run the Python engines, which is
+    # correct but slower — surface it
+    assert native is not None
+
+
+needs_native = pytest.mark.skipif(native is None, reason="no native build")
+
+
+@needs_native
+def test_quantity_parity_fuzz():
+    from kubernetes_tpu.utils.quantity import parse_quantity
+    import math
+
+    rng = random.Random(7)
+    suffixes = ["", "m", "k", "M", "G", "T", "Ki", "Mi", "Gi", "Ti", "u",
+                "n", "E", "P", "Ei", "Pi"]
+    for _ in range(2000):
+        mant = rng.choice([
+            str(rng.randint(0, 10**9)),
+            f"{rng.randint(0, 10**6)}.{rng.randint(0, 999)}",
+            f"{rng.randint(1, 999)}e{rng.randint(0, 6)}",
+        ])
+        s = mant + rng.choice(suffixes)
+        want_milli = math.ceil(parse_quantity(s) * 1000)
+        want_ceil = math.ceil(parse_quantity(s))
+        if abs(want_milli) < 2**63:
+            assert native.parse_milli(s) == want_milli, s
+        if abs(want_ceil) < 2**63:
+            assert native.parse_ceil(s) == want_ceil, s
+    for bad in ["", "abc", "1.2.3", "12X", "e5", "1ee4", "5mi"]:
+        with pytest.raises((ValueError, OverflowError)):
+            native.parse_milli(bad)
+
+
+@needs_native
+def test_heap_parity_fuzz():
+    """Random add/update/pop/delete stream: native KeyedHeap == Python
+    engine, including update-in-place and duplicate sort keys."""
+    from kubernetes_tpu.backend.heap import Heap
+
+    class Item:
+        def __init__(self, uid, a, b):
+            self.uid, self.a, self.b = uid, a, b
+
+    def mk_pair():
+        py = Heap(lambda x: x.uid, lambda p, q: (p.a, p.b) < (q.a, q.b))
+        nat = Heap(lambda x: x.uid, lambda p, q: False,
+                   sort_key_fn=lambda x: (x.a, x.b))
+        assert nat._nh is not None
+        return py, nat
+
+    rng = random.Random(11)
+    py, nat = mk_pair()
+    live = set()
+    for step in range(4000):
+        op = rng.random()
+        if op < 0.5 or not live:
+            uid = f"u{rng.randint(0, 200)}"
+            it = Item(uid, rng.randint(0, 20) * 1.0, rng.random())
+            py.add(it)
+            nat.add(it)
+            live.add(uid)
+        elif op < 0.75:
+            a, b = py.pop(), nat.pop()
+            assert (a is None) == (b is None)
+            if a is not None:
+                # ties on (a, b) are broken arbitrarily but both engines
+                # must agree on the sort key of what they surface
+                assert (a.a, a.b) == (b.a, b.b)
+                live.discard(a.uid)
+                if a.uid != b.uid:       # tie: realign engines
+                    py.delete(b.uid)
+                    nat.delete(a.uid)
+                    live.discard(b.uid)
+        else:
+            uid = rng.choice(sorted(live))
+            a, b = py.delete(uid), nat.delete(uid)
+            assert (a is None) == (b is None)
+            live.discard(uid)
+        assert len(py) == len(nat)
+    while True:
+        a, b = py.pop(), nat.pop()
+        assert (a is None) == (b is None)
+        if a is None:
+            break
+        assert (a.a, a.b) == (b.a, b.b)
+
+
+@needs_native
+def test_heap_degrades_on_exotic_sort_key():
+    from kubernetes_tpu.backend.heap import Heap
+
+    h = Heap(lambda x: x[0], lambda p, q: str(p[1]) < str(q[1]),
+             sort_key_fn=lambda x: (x[1],))
+    h.add(("a", 2.0))
+    h.add(("b", "not-a-number"))       # degrade to the Python engine
+    assert h._nh is None
+    h.add(("c", 1.0))
+    assert len(h) == 3
+    assert h.pop()[0] == "c"           # less_fn ordering after degrade
+
+
+@needs_native
+def test_quantity_suffix_and_whitespace_edge_cases():
+    """Review regressions: E/Ei are SUFFIXES unless digits follow the 'e';
+    trailing whitespace parses like the Decimal path."""
+    assert native.parse_ceil("1Ei") == 1 << 60
+    assert native.parse_ceil("1E") == 10**18
+    assert native.parse_ceil("2.5E") == 25 * 10**17
+    assert native.parse_ceil("1e2") == 100
+    assert native.parse_ceil(" 1 ") == 1
+    assert native.parse_ceil("1\n") == 1
+    # milli of 1Ei exceeds int64: native signals overflow, wrapper falls
+    # back to the exact Decimal path
+    with pytest.raises(OverflowError):
+        native.parse_milli("1Ei")
+    from kubernetes_tpu.utils.quantity import parse_bytes, parse_cpu_milli
+    assert parse_bytes("1Ei") == 1 << 60
+    assert parse_cpu_milli("1Ei") == (1 << 60) * 1000
+
+
+@needs_native
+def test_heap_degrades_on_wide_sort_key():
+    from kubernetes_tpu.backend.heap import Heap
+
+    h = Heap(lambda x: x[0], lambda p, q: p[1:] < q[1:],
+             sort_key_fn=lambda x: x[1:])
+    h.add(("a", 1.0, 1.0, 2.0))
+    assert h._nh is None, "3-tuple sort key must degrade, not truncate"
+    h.add(("b", 1.0, 1.0, 1.0))
+    assert h.pop()[0] == "b"

@@ -152,3 +152,25 @@ def test_churn_recreate_keeps_one_alive():
     t[0] = 1000.55
     st.inject(hub, t[0])
     assert len(hub.list_pods()) == 1, "recreate keeps exactly one copy"
+
+
+def test_daemonset_workload_tiny():
+    from kubernetes_tpu.perf.workloads import scheduling_daemonset
+
+    w = small(scheduling_daemonset(init_nodes=6, measure_pods=6))
+    w.warm_full_nodes = False
+    r = run_workload(w)
+    assert r["pods_scheduled"] == 6
+    # daemonset pinning: pod i landed exactly on node-i (matchFields)
+    assert r["stats"]["scheduled"] == 6
+
+
+def test_while_gated_workload_tiny():
+    from kubernetes_tpu.perf.workloads import scheduling_while_gated
+
+    w = small(scheduling_while_gated(gated_pods=8, measure_pods=10))
+    r = run_workload(w)
+    # measured pods all bound; gated pods parked, never scheduled
+    assert r["pods_scheduled"] == 10
+    assert r["stats"]["scheduled"] == 10
+    assert r["stats"]["unschedulable"] == 0

@@ -131,13 +131,16 @@ def test_in_flight_event_replay():
 
 def test_gated_pod_held_until_gates_removed():
     q, _ = mkq()
-    q.add(mkpod("g", gates=("corp/hold",)))
+    old = mkpod("g", gates=("corp/hold",))
+    q.add(old)
     assert q.pending_counts()["gated"] == 1
     assert q.pop() is None
-    # gates removed (spec update): the next event re-runs PreEnqueue
-    for qp in list(q._unschedulable.values()):
-        qp.pod = Pod(metadata=qp.pod.metadata, spec=PodSpec())
+    # unrelated events never touch the gated pool (the index skips it)
     q.move_all_to_active_or_backoff(NODE_ADD)
+    assert q.pending_counts()["gated"] == 1
+    # gates removed: the pod's own spec update re-runs PreEnqueue
+    # (eventhandlers route pod updates through queue.update)
+    q.update(old, Pod(metadata=old.metadata, spec=PodSpec()))
     assert q.pending_counts()["gated"] == 0
     assert q.pop().pod.name == "g"
 
