@@ -56,6 +56,19 @@ class Heap(Generic[T]):
         i = self._index.get(key)
         return self._entries[i][2] if i is not None else None
 
+    @staticmethod
+    def _as_double(x) -> float:
+        """Sort-key component -> C double, ONLY when the conversion is
+        order-preserving: real numbers within double precision. Numeric
+        strings ('10' < '9' lexicographically, 10.0 > 9.0 numerically)
+        and huge ints (>2^53 collapse to false ties) must degrade
+        instead of silently reordering."""
+        if isinstance(x, bool) or not isinstance(x, (int, float)):
+            raise TypeError(f"non-numeric sort key {x!r}")
+        if isinstance(x, int) and abs(x) > (1 << 53):
+            raise TypeError("sort key beyond double precision")
+        return float(x)
+
     def _degrade(self) -> None:
         """Move every native entry to the Python engine (an item produced
         a sort key the C heap can't order). The sort key is dropped
@@ -79,8 +92,8 @@ class Heap(Generic[T]):
                     # >2 components can't ride the (a, b) engine without
                     # silently changing tie-breaks — degrade, don't truncate
                     raise TypeError
-                a = float(sk[0])
-                b = float(sk[1]) if len(sk) > 1 else 0.0
+                a = self._as_double(sk[0])
+                b = self._as_double(sk[1]) if len(sk) > 1 else 0.0
             except (TypeError, ValueError, IndexError):
                 self._degrade()
             else:

@@ -96,6 +96,19 @@ class Hub:
                 for o in list(self._pods.objects.values()):
                     h.on_add(o)
 
+    def unwatch(self, h: EventHandlers) -> None:
+        """Deregister a handler from every store (watch-stream teardown —
+        the transport layer's connection close)."""
+        with self._lock:
+            for store in (self._nodes, self._pods, self._namespaces,
+                          self._pdbs, self._pvcs, self._pvs, self._claims,
+                          self._slices, self._priority_classes,
+                          self._storage_classes):
+                try:
+                    store.handlers.remove(h)
+                except ValueError:
+                    pass
+
     @staticmethod
     def _dispatch(store: _Store, kind: str, old, new) -> None:
         """Deliver one event. NEVER called holding the hub lock: handlers

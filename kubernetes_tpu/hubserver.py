@@ -1,0 +1,192 @@
+"""HTTP transport for the Hub: the apiserver side of the wire.
+
+Serves a Hub over real HTTP so a scheduler in another process/host talks
+LIST+WATCH exactly like the reference's client-go does to its apiserver
+(SURVEY.md §5.8):
+
+* ``POST /call`` — JSON-RPC for every public Hub method (the typed REST
+  verbs; Conflict/NotFound map to 409/404 like the apiserver's status
+  codes).
+* ``GET /watch?kind=pods&replay=1`` — chunked JSON-lines event stream
+  (the WATCH verb): with replay, the current objects arrive as synthetic
+  adds under the hub lock (a consistent LIST) followed by a
+  ``{"synced": true}`` marker (WaitForCacheSync's signal), then live
+  events for the life of the connection.
+
+The in-process Hub stays the fast path for benchmarks; this transport
+exists so "real list/watch client" is an actual network boundary, not an
+interface comment.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubernetes_tpu.hub import Conflict, EventHandlers, Hub, NotFound
+from kubernetes_tpu.utils.wire import from_wire, to_wire
+
+# Hub methods reachable over /call (everything the scheduler, tests, and
+# controllers use; watch_* goes over /watch instead)
+CALL_METHODS = frozenset({
+    "create_node", "update_node", "delete_node", "get_node", "list_nodes",
+    "create_pod", "update_pod", "delete_pod", "get_pod", "list_pods",
+    "bind", "patch_pod_condition", "clear_nominated_node",
+    "create_namespace", "update_namespace", "delete_namespace",
+    "list_namespaces",
+    "create_pdb", "update_pdb", "delete_pdb", "list_pdbs",
+    "create_pvc", "update_pvc", "delete_pvc", "get_pvc", "list_pvcs",
+    "create_pv", "update_pv", "delete_pv", "get_pv", "list_pvs",
+    "create_storage_class", "get_storage_class",
+    "create_resource_claim", "update_resource_claim",
+    "delete_resource_claim", "get_resource_claim", "list_resource_claims",
+    "create_resource_slice", "delete_resource_slice",
+    "list_resource_slices",
+    "create_priority_class", "list_priority_classes",
+    "leases.get", "leases.update",
+})
+
+WATCH_KINDS = ("pods", "nodes", "namespaces", "pvcs", "pvs",
+               "resource_claims", "resource_slices")
+
+_ERROR_STATUS = {"Conflict": 409, "NotFound": 404, "ValueError": 400,
+                 "TypeError": 400}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "kubernetes-tpu-hub/1"
+
+    def log_message(self, *args) -> None:  # quiet
+        pass
+
+    @property
+    def hub(self) -> Hub:
+        return self.server.hub  # type: ignore[attr-defined]
+
+    def _json(self, status: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        if self.path != "/call":
+            self._json(404, {"error": "NotFound", "message": self.path})
+            return
+        length = int(self.headers.get("Content-Length", "0"))
+        try:
+            req = json.loads(self.rfile.read(length))
+            method = req["method"]
+            if method not in CALL_METHODS:
+                raise ValueError(f"unknown method {method!r}")
+            target = self.hub
+            for part in method.split("."):
+                target = getattr(target, part)
+            args = [from_wire(a) for a in req.get("args", [])]
+            result = target(*args)
+        except Exception as e:  # noqa: BLE001 — mapped to wire errors
+            name = type(e).__name__
+            self._json(_ERROR_STATUS.get(name, 500),
+                       {"error": name, "message": str(e)})
+            return
+        self._json(200, {"result": to_wire(result)})
+
+    def do_GET(self) -> None:  # noqa: N802
+        if not self.path.startswith("/watch"):
+            self._json(404, {"error": "NotFound", "message": self.path})
+            return
+        from urllib.parse import parse_qs, urlparse
+
+        q = parse_qs(urlparse(self.path).query)
+        kind = q.get("kind", [""])[0]
+        replay = q.get("replay", ["1"])[0] == "1"
+        if kind not in WATCH_KINDS:
+            self._json(400, {"error": "ValueError",
+                             "message": f"unknown watch kind {kind!r}"})
+            return
+        events: queue.Queue = queue.Queue(maxsize=100000)
+        overflow = threading.Event()
+
+        def push(etype, old, new):
+            try:
+                events.put_nowait({"type": etype, "old": to_wire(old),
+                                   "new": to_wire(new)})
+            except queue.Full:
+                # a silent gap would be an undetectable stale cache; close
+                # the stream instead — the client reflector reconnects and
+                # relists (client-go's "too old resource version" recovery)
+                overflow.set()
+
+        h = EventHandlers(
+            on_add=lambda o: push("add", None, o),
+            on_update=lambda old, new: push("update", old, new),
+            on_delete=lambda o: push("delete", o, None))
+        # registration under the hub lock makes replay a consistent LIST:
+        # replayed adds land in the queue before any live event
+        getattr(self.hub, f"watch_{kind}")(h, replay=replay)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/jsonlines")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def write_line(obj) -> None:
+            line = json.dumps(obj).encode() + b"\n"
+            self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+            self.wfile.flush()
+
+        try:
+            if replay:
+                # drain the synchronous replay, then mark sync
+                while True:
+                    try:
+                        write_line(events.get_nowait())
+                    except queue.Empty:
+                        break
+            write_line({"synced": True})
+            while not self.server.stopping \
+                    and not overflow.is_set():  # type: ignore[attr-defined]
+                try:
+                    ev = events.get(timeout=1.0)
+                except queue.Empty:
+                    write_line({})  # keepalive; also detects dead peers
+                    continue
+                write_line(ev)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            self.hub.unwatch(h)
+
+
+class HubServer:
+    """hub = Hub(); HubServer(hub).start() -> serve on 127.0.0.1:port."""
+
+    def __init__(self, hub: Hub, host: str = "127.0.0.1", port: int = 0):
+        self.hub = hub
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.hub = hub                 # type: ignore[attr-defined]
+        self._httpd.stopping = False          # type: ignore[attr-defined]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "HubServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="hub-server")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.stopping = True           # type: ignore[attr-defined]
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -22,21 +22,25 @@ _SO = os.path.join(_BUILD_DIR, "kubernetes_tpu_native.so")
 
 
 def _build() -> bool:
-    include = sysconfig.get_paths()["include"]
-    os.makedirs(_BUILD_DIR, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD_DIR)
-    os.close(fd)
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-           f"-I{include}", _SRC, "-o", tmp]
+    tmp = None
     try:
+        include = sysconfig.get_paths()["include"]
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD_DIR)
+        os.close(fd)
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+               f"-I{include}", _SRC, "-o", tmp]
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, _SO)  # atomic: concurrent builders race safely
         return True
     except (OSError, subprocess.SubprocessError):
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
+        # no toolchain, read-only install dir, sandbox… — ANY failure here
+        # must mean "Python engines", never an import-time crash
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
         return False
 
 

@@ -336,12 +336,16 @@ static int parse_quantity_scaled(const char *s, int extra_exp10,
     if (*s != '\0') return -1;
 
     exp10 += extra_exp10 - frac_digits;
-    __int128 v = mant * (__int128)bin_mult;
+    // overflow discipline: every multiply is guarded BEFORE it happens
+    // (signed __int128 overflow is UB, and a wrapped value would silently
+    // under-reserve); -2 sends the caller to the arbitrary-precision path
     const __int128 LIMIT = (__int128)1 << 126;
+    if (bin_mult > 1 && mant > LIMIT / bin_mult) return -2;
+    __int128 v = mant * (__int128)bin_mult;
     while (exp10 > 0) {
+        if (v > LIMIT / 10) return -2;
         v *= 10;
         exp10--;
-        if (v > LIMIT) return -2;
     }
     bool inexact = false;
     while (exp10 < 0) {

@@ -1,0 +1,63 @@
+"""JSON wire codec for the API dataclasses.
+
+The serialization layer under the HTTP hub transport (hubserver/hubclient
+— the stack's analog of the reference's JSON+protobuf REST layer,
+apimachinery runtime.Scheme). Dataclasses encode as plain dicts carrying a
+``__kind__`` tag; decode reconstructs from a registry of the api.objects
+(+ leaderelection Lease) classes, so nested objects round-trip without
+per-type code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+_REGISTRY: dict[str, type] = {}
+
+
+def _registry() -> dict[str, type]:
+    if not _REGISTRY:
+        import kubernetes_tpu.api.objects as objects
+        from kubernetes_tpu.leaderelection import Lease
+
+        for mod_attr in vars(objects).values():
+            if dataclasses.is_dataclass(mod_attr) and isinstance(mod_attr,
+                                                                 type):
+                _REGISTRY[mod_attr.__name__] = mod_attr
+        _REGISTRY["Lease"] = Lease
+    return _REGISTRY
+
+
+def to_wire(v: Any) -> Any:
+    """Object -> JSON-compatible value. Dataclasses become tagged dicts;
+    sets become sorted lists (wire stability)."""
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        out = {"__kind__": type(v).__name__}
+        for f in dataclasses.fields(v):
+            out[f.name] = to_wire(getattr(v, f.name))
+        return out
+    if isinstance(v, dict):
+        return {k: to_wire(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [to_wire(x) for x in v]
+    if isinstance(v, (set, frozenset)):
+        return sorted(to_wire(x) for x in v)
+    return v
+
+
+def from_wire(v: Any) -> Any:
+    """Inverse of to_wire. Unknown ``__kind__`` tags raise ValueError
+    (wire from a newer/older peer must fail loudly, not half-decode)."""
+    if isinstance(v, dict):
+        kind = v.get("__kind__")
+        if kind is None:
+            return {k: from_wire(x) for k, x in v.items()}
+        cls = _registry().get(kind)
+        if cls is None:
+            raise ValueError(f"unknown wire kind {kind!r}")
+        kwargs = {k: from_wire(x) for k, x in v.items() if k != "__kind__"}
+        return cls(**kwargs)
+    if isinstance(v, list):
+        return [from_wire(x) for x in v]
+    return v
