@@ -1,8 +1,9 @@
 """The reference scheduler_perf workloads, mirroring performance-config
 shapes (node/pod templates from test/integration/scheduler_perf/templates;
 op sequences and thresholds from the per-suite performance-config.yaml):
-the 5 BASELINE.json configs bench.py runs, plus Unschedulable and
-SchedulingWithMixedChurn.
+the 5 BASELINE.json configs bench.py runs, plus Unschedulable,
+SchedulingWithMixedChurn, SchedulingDaemonset, SchedulingWhileGated, and
+the preferred pod-(anti)affinity pair — 11 reference configs total.
 
 Node template (node-default.yaml): cpu 4, memory 32Gi, pods 110.
 Pod template (pod-default.yaml): requests cpu 100m, memory 500Mi.
@@ -25,11 +26,13 @@ from kubernetes_tpu.api.objects import (
     NodeStatus,
     ObjectMeta,
     Pod,
+    PodAffinity,
     PodAffinityTerm,
     PodAntiAffinity,
     PodSpec,
     ResourceRequirements,
     TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
 )
 from kubernetes_tpu.perf.harness import (
     Churn,
@@ -319,6 +322,56 @@ def scheduling_while_gated(gated_pods=10000, measure_pods=10000) -> Workload:
         ])
 
 
+# -------------------------------- 10/11. Preferred pod (anti)affinity
+# affinity/performance-config.yaml:141-198 / :204-261
+# (SchedulingPreferredPodAffinity / ...AntiAffinity, 5000Nodes_5000Pods,
+# both 90): soft zone-level terms — pure Score work, the weighted
+# preferred-term kernel (scoring.go:35) rather than the Filter path.
+
+def _preferred_affinity_pod(i: int, anti: bool) -> Pod:
+    term = WeightedPodAffinityTerm(weight=10, pod_affinity_term=(
+        PodAffinityTerm(
+            topology_key=LABEL_ZONE,
+            label_selector=LabelSelector(match_labels={"team": "perf"}))))
+    aff = (Affinity(pod_anti_affinity=PodAntiAffinity(preferred=[term]))
+           if anti else
+           Affinity(pod_affinity=PodAffinity(preferred=[term])))
+    kind = "panti" if anti else "paff"
+    return _pod(f"{kind}-{i}", labels={"team": "perf"}, affinity=aff)
+
+
+def preferred_pod_affinity(init_nodes=5000, init_pods=1000,
+                           measure_pods=5000) -> Workload:
+    return Workload(
+        name="SchedulingPreferredPodAffinity/5000Nodes_5000Pods",
+        threshold=90,
+        pod_capacity=32768,
+        ops=[
+            CreateNodes(init_nodes,
+                        lambda i: _node(i, zones=["z1", "z2", "z3"])),
+            CreatePods(init_pods, lambda i: _pod(f"init-{i}")),
+            CreatePods(measure_pods,
+                       lambda i: _preferred_affinity_pod(i, anti=False),
+                       collect_metrics=True),
+        ])
+
+
+def preferred_pod_anti_affinity(init_nodes=5000, init_pods=1000,
+                                measure_pods=5000) -> Workload:
+    return Workload(
+        name="SchedulingPreferredPodAntiAffinity/5000Nodes_5000Pods",
+        threshold=90,
+        pod_capacity=32768,
+        ops=[
+            CreateNodes(init_nodes,
+                        lambda i: _node(i, zones=["z1", "z2", "z3"])),
+            CreatePods(init_pods, lambda i: _pod(f"init-{i}")),
+            CreatePods(measure_pods,
+                       lambda i: _preferred_affinity_pod(i, anti=True),
+                       collect_metrics=True),
+        ])
+
+
 # the 5 BASELINE.json configs bench.py runs within the driver's budget
 BENCH_WORKLOADS = (
     scheduling_basic,
@@ -334,4 +387,6 @@ ALL_WORKLOADS = BENCH_WORKLOADS + (
     mixed_churn,
     scheduling_daemonset,
     scheduling_while_gated,
+    preferred_pod_affinity,
+    preferred_pod_anti_affinity,
 )

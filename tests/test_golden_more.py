@@ -1,0 +1,185 @@
+"""Golden parity tables, round 2 (SURVEY §4 rung 1): NodeAffinity
+operator semantics (nodeaffinity/node_affinity_test.go TestNodeAffinity),
+taints/tolerations (tainttoleration/taint_toleration_test.go), and host
+ports (nodeports/node_ports_test.go TestNodePorts) — each case runs the
+REAL device pipeline via the same harness as tests/test_golden.py."""
+
+import pytest
+
+from kubernetes_tpu.api.objects import (
+    Affinity,
+    Container,
+    ContainerPort,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Taint,
+    Toleration,
+)
+from tests.test_golden import _mknode, _mkpod, feasible_set, reject_plugins
+
+
+def _aff(match_expressions=None, match_fields=None, terms=None):
+    if terms is None:
+        terms = [NodeSelectorTerm(
+            match_expressions=match_expressions or [],
+            match_fields=match_fields or [])]
+    return Affinity(node_affinity=NodeAffinity(
+        required=NodeSelector(node_selector_terms=terms)))
+
+
+def req(key, op, *values):
+    return NodeSelectorRequirement(key=key, operator=op,
+                                   values=list(values))
+
+
+# node_affinity_test.go TestNodeAffinity, re-expressed: one node with
+# labels {foo: bar, gpu: "2"}; want = does the pod fit it?
+NODE_AFFINITY_CASES = [
+    ("no affinity matches everything", None, True),
+    ("In matches", _aff([req("foo", "In", "bar", "value2")]), True),
+    ("In mismatch", _aff([req("foo", "In", "value1", "value2")]), False),
+    ("In on absent key", _aff([req("no-such", "In", "bar")]), False),
+    ("NotIn matches when value differs",
+     _aff([req("foo", "NotIn", "value1")]), True),
+    ("NotIn rejects matching value", _aff([req("foo", "NotIn", "bar")]),
+     False),
+    ("NotIn matches when key absent",
+     _aff([req("no-such", "NotIn", "bar")]), True),
+    ("Exists matches present key", _aff([req("foo", "Exists")]), True),
+    ("Exists rejects absent key", _aff([req("no-such", "Exists")]), False),
+    ("DoesNotExist matches absent key",
+     _aff([req("no-such", "DoesNotExist")]), True),
+    ("DoesNotExist rejects present key",
+     _aff([req("foo", "DoesNotExist")]), False),
+    ("Gt matches larger value", _aff([req("gpu", "Gt", "1")]), True),
+    ("Gt rejects equal value", _aff([req("gpu", "Gt", "2")]), False),
+    ("Lt matches smaller value", _aff([req("gpu", "Lt", "3")]), True),
+    ("Lt rejects equal value", _aff([req("gpu", "Lt", "2")]), False),
+    ("two expressions AND within a term: both match",
+     _aff([req("foo", "In", "bar"), req("gpu", "Exists")]), True),
+    ("two expressions AND within a term: one fails",
+     _aff([req("foo", "In", "bar"), req("gpu", "In", "9")]), False),
+    ("terms OR across the selector: second matches",
+     _aff(terms=[
+         NodeSelectorTerm(match_expressions=[req("foo", "In", "nope")]),
+         NodeSelectorTerm(match_expressions=[req("gpu", "In", "2")])]),
+     True),
+    ("matchFields metadata.name In matches",
+     _aff(match_fields=[req("metadata.name", "In", "the-node")]), True),
+    ("matchFields metadata.name In mismatches",
+     _aff(match_fields=[req("metadata.name", "In", "other")]), False),
+]
+
+
+@pytest.mark.parametrize("name,aff,want", NODE_AFFINITY_CASES,
+                         ids=[c[0] for c in NODE_AFFINITY_CASES])
+def test_node_affinity_golden(name, aff, want):
+    node = _mknode("the-node", labels={"foo": "bar", "gpu": "2"})
+    pod = _mkpod("p", req={"cpu": "100m"}, affinity=aff)
+    feas = feasible_set(pod, [node])
+    assert (("the-node" in feas) == want), name
+    if not want:
+        _, plugins = reject_plugins(pod, [node])
+        assert "NodeAffinity" in plugins, name
+
+
+def tol(key="", op="Equal", value="", effect=""):
+    return Toleration(key=key, operator=op, value=value, effect=effect)
+
+
+# taint_toleration_test.go filter semantics: want = fits
+TAINT_CASES = [
+    ("no taints, no tolerations", [], [], True),
+    ("NoSchedule taint, no toleration",
+     [Taint(key="k", value="v", effect="NoSchedule")], [], False),
+    ("NoSchedule taint, matching toleration",
+     [Taint(key="k", value="v", effect="NoSchedule")],
+     [tol("k", "Equal", "v", "NoSchedule")], True),
+    ("NoSchedule taint, value mismatch",
+     [Taint(key="k", value="v", effect="NoSchedule")],
+     [tol("k", "Equal", "other", "NoSchedule")], False),
+    ("NoSchedule taint, Exists toleration ignores value",
+     [Taint(key="k", value="v", effect="NoSchedule")],
+     [tol("k", "Exists", "", "NoSchedule")], True),
+    ("empty-effect toleration matches any effect",
+     [Taint(key="k", value="v", effect="NoSchedule")],
+     [tol("k", "Equal", "v", "")], True),
+    ("empty-key Exists toleration matches everything",
+     [Taint(key="k", value="v", effect="NoSchedule"),
+      Taint(key="k2", value="v2", effect="NoExecute")],
+     [tol("", "Exists", "", "")], True),
+    ("NoExecute taint, no toleration",
+     [Taint(key="k", value="v", effect="NoExecute")], [], False),
+    ("PreferNoSchedule taint never filters",
+     [Taint(key="k", value="v", effect="PreferNoSchedule")], [], True),
+    ("two taints, one tolerated",
+     [Taint(key="k1", value="v1", effect="NoSchedule"),
+      Taint(key="k2", value="v2", effect="NoSchedule")],
+     [tol("k1", "Equal", "v1", "NoSchedule")], False),
+    ("two taints, both tolerated",
+     [Taint(key="k1", value="v1", effect="NoSchedule"),
+      Taint(key="k2", value="v2", effect="NoSchedule")],
+     [tol("k1", "Equal", "v1", "NoSchedule"),
+      tol("k2", "Exists", "", "")], True),
+    ("toleration for the wrong effect",
+     [Taint(key="k", value="v", effect="NoExecute")],
+     [tol("k", "Equal", "v", "NoSchedule")], False),
+]
+
+
+@pytest.mark.parametrize("name,taints,tols,want", TAINT_CASES,
+                         ids=[c[0] for c in TAINT_CASES])
+def test_taint_toleration_golden(name, taints, tols, want):
+    node = _mknode("tainted")
+    node.spec.taints = taints
+    pod = _mkpod("p", req={"cpu": "100m"})
+    pod.spec.tolerations = tols
+    feas = feasible_set(pod, [node])
+    assert (("tainted" in feas) == want), name
+    if not want:
+        _, plugins = reject_plugins(pod, [node])
+        assert "TaintToleration" in plugins, name
+
+
+def _port_pod(name, *ports, node=""):
+    p = _mkpod(name, req={"cpu": "100m"}, node=node)
+    p.spec.containers[0].ports = [
+        ContainerPort(host_port=hp, protocol=proto, host_ip=ip)
+        for hp, proto, ip in ports]
+    return p
+
+
+# node_ports_test.go TestNodePorts: want = fits next to `existing`
+PORT_CASES = [
+    ("nothing running", (8080, "TCP", ""), None, True),
+    ("other port in use", (8080, "TCP", ""), (8081, "TCP", ""), True),
+    ("same port conflicts", (8080, "TCP", ""), (8080, "TCP", ""), False),
+    ("same port different protocol", (8080, "UDP", ""),
+     (8080, "TCP", ""), True),
+    ("same port different specific IPs", (8080, "TCP", "127.0.0.1"),
+     (8080, "TCP", "192.168.0.1"), True),
+    ("wildcard IP conflicts with specific IP", (8080, "TCP", "0.0.0.0"),
+     (8080, "TCP", "127.0.0.1"), False),
+    ("specific IP conflicts with wildcard", (8080, "TCP", "127.0.0.1"),
+     (8080, "TCP", ""), False),
+    ("no host port requested never conflicts", None, (8080, "TCP", ""),
+     True),
+]
+
+
+@pytest.mark.parametrize("name,want_ports,existing_ports,want", PORT_CASES,
+                         ids=[c[0] for c in PORT_CASES])
+def test_node_ports_golden(name, want_ports, existing_ports, want):
+    node = _mknode("pn")
+    existing = []
+    if existing_ports:
+        existing.append(_port_pod("running", existing_ports, node="pn"))
+    pod = (_port_pod("incoming", want_ports) if want_ports
+           else _mkpod("incoming", req={"cpu": "100m"}))
+    feas = feasible_set(pod, [node], existing)
+    assert (("pn" in feas) == want), name
+    if not want:
+        _, plugins = reject_plugins(pod, [node], existing)
+        assert "NodePorts" in plugins, name

@@ -174,3 +174,16 @@ def test_while_gated_workload_tiny():
     assert r["pods_scheduled"] == 10
     assert r["stats"]["scheduled"] == 10
     assert r["stats"]["unschedulable"] == 0
+
+
+def test_preferred_affinity_workloads_tiny():
+    from kubernetes_tpu.perf.workloads import (
+        preferred_pod_affinity,
+        preferred_pod_anti_affinity,
+    )
+
+    for factory in (preferred_pod_affinity, preferred_pod_anti_affinity):
+        w = small(factory(init_nodes=6, init_pods=2, measure_pods=8))
+        r = run_workload(w)
+        assert r["pods_scheduled"] == 8, w.name
+        assert r["stats"]["unschedulable"] == 0
