@@ -122,12 +122,12 @@ class Evaluator:
         prep = self._collect_victims(prio, snapshot, mirror, caps)
         if prep is None:
             return []
-        victims_by_row, k_cap, cumsum = prep
+        victims_by_row, k_cap, cumsum, vic_cols, cumsum_np, cols_np = prep
 
         pblobs = mirror.pack_batch_blobs([pod], 1)
         cblobs = mirror.to_blobs()
         kmin = np.asarray(preempt_sweep_jit(
-            cblobs, pblobs, mirror.well_known(), cumsum, caps,
+            cblobs, pblobs, mirror.well_known(), cumsum, vic_cols, caps,
             self._get_enabled_filters(pod)))[0]
         self._kmin = kmin                     # reused by _minimize_victims
         self._victims_by_row = victims_by_row
@@ -176,8 +176,12 @@ class Evaluator:
                                 term.label_selector, v.metadata.labels):
                             all_uids.discard(v.metadata.uid)
                             break
-        freed = {row: cumsum[row, len(vs)]
-                 for row, vs in victims_by_row.items()}
+        r_cols = caps.res_cols
+        freed = {}
+        for row, vs in victims_by_row.items():
+            full = np.zeros((r_cols,), np.float32)
+            full[cols_np] = cumsum_np[row, len(vs), : len(cols_np)]
+            freed[row] = full
         feas = self._dryrun_feasible(pod, all_uids, freed)
         rows = [row for row in victims_by_row if feas[row]]
         if not rows:
@@ -455,8 +459,10 @@ class Evaluator:
         return kept
 
     def _collect_victims(self, prio: int, snapshot, mirror, caps):
-        """(victims_by_row, k_cap, device cumsum) for preemptors of
-        ``prio``, or None when nothing is evictable.
+        """(victims_by_row, k_cap, device cumsum [N, K+1, C], device
+        vic_cols [C], host cumsum, host cols) for preemptors of ``prio``,
+        or None when nothing is evictable. The trailing host pair backs
+        full-width freed-vector expansion (find_candidates' dry-run).
 
         Per-node victims sort ascending by importance (evict
         least-important first): priority asc, then start time desc.
@@ -465,7 +471,10 @@ class Evaluator:
         CACHED across preemptors: a burst of same-priority preemptors
         (the PreemptionAsync shape) re-sweeps identical cluster state —
         keyed on (priority, node count, newest NodeInfo generation) with
-        the cumsum kept device-resident so the burst never re-uploads."""
+        the cumsum kept device-resident so the burst never re-uploads.
+        The cumsum carries only the columns victims actually free (see
+        ops.preempt.preempt_sweep) — the full [N, K+1, R] upload was the
+        dominant per-burst cost on the tunnel."""
         state_key = (prio, len(snapshot.node_info_list),
                      max((ni.generation for ni in snapshot.node_info_list),
                          default=0), mirror is self._sweep_cache_mirror)
@@ -486,7 +495,7 @@ class Evaluator:
             k_max = max(k_max, len(vs))
         if k_max == 0:
             self._sweep_cache_key = state_key
-            self._sweep_cache = ({}, 0, None)
+            self._sweep_cache = ({}, 0, None, None, None, None)
             self._sweep_cache_mirror = mirror
             return None
         k_cap = 1
@@ -496,14 +505,14 @@ class Evaluator:
         # per-victim python accumulation was the preemption hot spot at
         # 20k victims — one np.cumsum per node + a uid-keyed res-row cache)
         n = caps.nodes
-        r = caps.res_cols
         if self._res_rows_mirror is not mirror:
             self._res_rows.clear()
             self._res_rows_mirror = mirror
         res_rows = self._res_rows
         if len(res_rows) > 200_000:
             res_rows.clear()
-        cumsum = np.zeros((n, k_cap + 1, r), np.float32)
+        by_row_rows: dict[int, np.ndarray] = {}
+        active: set[int] = {int(F.COL_PODS)}
         for row, vs in victims_by_row.items():
             rows_k = []
             for pi in vs:
@@ -513,15 +522,37 @@ class Evaluator:
                     rr = np.asarray(mirror._res_row(pi.request), np.float32)
                     res_rows[uid] = rr
                 rows_k.append(rr)
-            acc = np.cumsum(np.stack(rows_k), axis=0)          # [k, R]
-            acc[:, F.COL_PODS] = np.arange(1, len(vs) + 1,
-                                           dtype=np.float32)
-            cumsum[row, 1: len(vs) + 1] = acc
-            if len(vs) < k_cap:
-                cumsum[row, len(vs) + 1:] = acc[-1]  # pad: no extras
-        cumsum = jnp.asarray(cumsum)       # device-resident for the burst
+            stacked = np.stack(rows_k)                        # [k, R]
+            by_row_rows[row] = stacked
+            active.update(np.nonzero(stacked.any(axis=0))[0].tolist())
+        cols = sorted(active)
+        c_pad = 4
+        while c_pad < len(cols):
+            c_pad *= 2
+        pods_pos = cols.index(int(F.COL_PODS))
+        cols_np = np.asarray(cols, np.int64)
+        cumsum = np.zeros((n, k_cap + 1, c_pad), np.float32)
+        # padding columns alias col 0 in vic_cols; +BIG so they never bind
+        cumsum[:, :, len(cols):] = 3.0e38
+        for row, stacked in by_row_rows.items():
+            k = stacked.shape[0]
+            acc = np.cumsum(stacked[:, cols_np], axis=0)      # [k, C]
+            acc[:, pods_pos] = np.arange(1, k + 1, dtype=np.float32)
+            cumsum[row, 1: k + 1, : len(cols)] = acc
+            if k < k_cap:
+                cumsum[row, k + 1:, : len(cols)] = acc[-1]  # pad: no extras
+        # padding entries MUST alias an ACTIVE column (cols[0]), never a
+        # blanket column 0: aliasing an inactive column would add it to the
+        # kernel's col_freed mask (dropping it from the base-only check)
+        # while the +BIG padding cumsum makes the subset check vacuous for
+        # it — silently deleting that resource constraint from the sweep
+        vic_cols = np.full((c_pad,), cols_np[0], np.int32)
+        vic_cols[: len(cols)] = cols_np
         self._sweep_cache_key = state_key
-        self._sweep_cache = (victims_by_row, k_cap, cumsum)
+        # host copy rides along for full-width freed-vector expansion
+        # (find_candidates' dry-run path)
+        self._sweep_cache = (victims_by_row, k_cap, jnp.asarray(cumsum),
+                             jnp.asarray(vic_cols), cumsum, cols_np)
         self._sweep_cache_mirror = mirror
         return self._sweep_cache
 
@@ -558,54 +589,68 @@ class Evaluator:
                 pdb_violations=self._pdb_violations(vs, pdbs)))
         return out
 
-    def batch_preempt(self, jobs, snapshot) -> dict:
-        """ONE sweep launch for a whole burst of fit-only preemptors of
-        equal priority (the PreemptionAsync shape): returns
-        {uid: (nominated_node | None, Status)}. Nodes and victims are
-        assigned burst-locally so two preemptors never target the same
-        capacity (the per-pod path only discovers that next cycle)."""
+    def begin_batch_preempt(self, jobs, snapshot) -> tuple:
+        """Dispatch ONE sweep for a burst of fit-only preemptors of equal
+        priority WITHOUT blocking on the device: the kmin results stay
+        device-resident until finish_batch_preempt pulls them, so the
+        scheduling drain keeps dispatching while the sweep computes
+        (the device half of prepareCandidateAsync, kep 4832).
+
+        Returns (handle | None, immediate): ``immediate`` resolves pods
+        that never needed a sweep (ineligible, nothing evictable)."""
         self.cache_snapshot = snapshot.node_info_map
         mirror = self._get_mirror()
         caps = self._get_caps()
-        out: dict[str, tuple] = {}
-        jobs = list(jobs)
-        # eligibility first: an ineligible burst must not pay the sweep
+        immediate: dict[str, tuple] = {}
         eligible = []
-        for qp in jobs:
+        for qp in list(jobs):
             ok, why = self.pod_eligible_to_preempt_others(qp.pod)
             if ok:
                 eligible.append(qp)
             else:
-                out[qp.uid] = (None, Status.unschedulable(
+                immediate[qp.uid] = (None, Status.unschedulable(
                     f"not eligible for preemption: {why}",
                     plugin="DefaultPreemption"))
-        jobs = eligible
-        if not jobs:
-            return out
-        prio = jobs[0].pod.priority()
+        if not eligible:
+            return None, immediate
+        prio = eligible[0].pod.priority()
         prep = self._collect_victims(prio, snapshot, mirror, caps)
         if prep is None:
-            return {qp.uid: (None, Status.unschedulable(
-                "no preemption candidates", plugin="DefaultPreemption"))
-                for qp in jobs}
-        victims_by_row, k_cap, cumsum = prep
-        free_mat = mirror.free_matrix()
-        pods = [qp.pod for qp in jobs]
+            immediate.update(
+                {qp.uid: (None, Status.unschedulable(
+                    "no preemption candidates",
+                    plugin="DefaultPreemption")) for qp in eligible})
+            return None, immediate
+        victims_by_row, k_cap, cumsum, vic_cols = prep[:4]
+        pods = [qp.pod for qp in eligible]
         # ONE fixed sweep width: a varying pow2 bucket would compile a new
         # program per burst size (each compile stalls the whole drain);
         # oversized bursts chunk through the same program
         P_CAP = 16
-        kmin_rows = []
+        kmin_dev = []
         for start in range(0, len(pods), P_CAP):
             chunk = pods[start:start + P_CAP]
             pblobs = mirror.pack_batch_blobs(chunk, P_CAP)
-            kmin_rows.append(np.asarray(preempt_sweep_jit(
+            kmin_dev.append(preempt_sweep_jit(
                 mirror.to_blobs(), pblobs, mirror.well_known(), cumsum,
-                caps, self._get_enabled_filters(chunk[0])))[: len(chunk)])
-        kmin_all = np.concatenate(kmin_rows, axis=0)
+                vic_cols, caps, self._get_enabled_filters(chunk[0])))
+        return (eligible, kmin_dev, victims_by_row, mirror, snapshot), \
+            immediate
+
+    def finish_batch_preempt(self, handle) -> dict:
+        """Harvest a begin_batch_preempt dispatch: pull kmin, assign
+        nodes/victims burst-locally (two preemptors never target the same
+        capacity), queue evictions. {uid: (nominated_node | None, Status)}."""
+        eligible, kmin_dev, victims_by_row, mirror, snapshot = handle
+        self.cache_snapshot = snapshot.node_info_map
+        out: dict[str, tuple] = {}
+        # chunks are all exactly P_CAP wide; only the tail rows are padding
+        kmin_all = np.concatenate(
+            [np.asarray(k) for k in kmin_dev], axis=0)[: len(eligible)]
+        free_mat = mirror.free_matrix()
         pdbs = self.hub.list_pdbs()
         used_rows: set[int] = set()
-        for j, qp in enumerate(jobs):
+        for j, qp in enumerate(eligible):
             kmin = kmin_all[j]
             candidates = self._assemble_candidates(
                 qp.pod, kmin, victims_by_row, snapshot, mirror, free_mat,
@@ -625,6 +670,13 @@ class Evaluator:
             used_rows.add(best.row)
             out[qp.uid] = (best.node_name, Status())
         return out
+
+    def batch_preempt(self, jobs, snapshot) -> dict:
+        """Synchronous begin+finish (the pre-async path and tests)."""
+        handle, immediate = self.begin_batch_preempt(jobs, snapshot)
+        if handle is not None:
+            immediate.update(self.finish_batch_preempt(handle))
+        return immediate
 
     # ---------------- the whole PostFilter flow ----------------
 

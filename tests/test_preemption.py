@@ -318,3 +318,50 @@ def test_async_gate_holds_preemptor_until_victims_gone():
     assert bound_node(hub, high) == "node-0"
     assert all(hub.get_pod(p.metadata.uid) is None for p in low)
     assert not sched.preemption.preempting, "gate cleared after evictions"
+
+
+def test_sweep_never_drops_inactive_resource_constraint():
+    """Column-subset sweep regression: victims free ONLY memory (cpu-less
+    requests), the preemptor needs more CPU than the node has — eviction
+    can never help, so preemption must find no candidate and evict
+    nothing (the padding-alias bug silently deleted the CPU constraint
+    from the sweep)."""
+    hub = Hub()
+    hub.create_node(mknode(0, cpu="4"))
+    # an UNEVICTABLE cpu hog pins the node's cpu (priority above the
+    # preemptor), so cpu stays scarce no matter what gets evicted
+    hog = mkpod("cpu-hog", cpu="3500m", priority=100)
+    hub.create_pod(hog)
+    # low-priority victims request memory only: the freed-column set is
+    # {memory, pods}, cpu inactive
+    victims = []
+    for i in range(3):
+        v = Pod(metadata=ObjectMeta(name=f"memhog-{i}"),
+                spec=PodSpec(containers=[Container(
+                    name="c", resources=ResourceRequirements(
+                        requests={"memory": "8Gi"}))], priority=50))
+        victims.append(v)
+        hub.create_pod(v)
+    sched, clock = mksched(hub)
+    drain(sched, clock, rounds=2)
+    assert all(hub.get_pod(v.metadata.uid).spec.node_name
+               for v in victims), "victims must be running"
+    # preemptor: 2 CPU (only 500m free; no victim frees cpu) AND 8Gi
+    # memory (only ~7.7Gi free; victims DO free memory). Eviction makes
+    # the memory half fit but never the cpu half, and cpu is within
+    # allocatable so the unresolvable guard does not fire — only the
+    # sweep's cpu constraint stands between this pod and a useless
+    # eviction at kmin>=1
+    pre = Pod(metadata=ObjectMeta(name="cpu-hungry"),
+              spec=PodSpec(containers=[Container(
+                  name="c", resources=ResourceRequirements(
+                      requests={"cpu": "2", "memory": "8Gi"}))],
+                  priority=60))
+    hub.create_pod(pre)
+    drain(sched, clock, rounds=3)
+    assert hub.get_pod(pre.metadata.uid).spec.node_name == ""
+    assert sched.stats.get("preemptions", 0) == 0, \
+        "no nomination may come from a sweep that ignored the cpu column"
+    for v in victims:
+        assert hub.get_pod(v.metadata.uid) is not None, \
+            "no victim may be evicted for an unresolvable preemptor"
