@@ -852,6 +852,15 @@ class Mirror:
         convenience; the scheduling pipeline unpacks blobs inside its own jit."""
         return _unpack_cluster_jit(self.to_blobs(), self.caps)
 
+    def launch_d_cap(self, enable_topology: bool) -> int:
+        """The static d_cap for one launch: the domain bucket when the
+        launch runs topology kernels, else a CANONICAL 0 — a no-topology
+        program never reads domains, and keying it on the domain count
+        would make a scaled-down warmup (fewer nodes -> smaller bucket)
+        compile a DIFFERENT program than the full-scale run, paying a
+        fresh multi-second XLA compile on the first measured batch."""
+        return self.domain_bucket() if enable_topology else 0
+
     def domain_bucket(self) -> int:
         """Static scatter-space size for the next launch: power-of-two over
         the max domain count among topology keys any packed term/constraint
@@ -1369,7 +1378,8 @@ class Mirror:
             gid = jnp.asarray(gid_np)
             rep = jnp.asarray(rep_np)
         return LaunchSpec(cblobs=self.to_blobs(), pblobs=pblobs,
-                          enable_topology=enable, d_cap=self.domain_bucket(),
+                          enable_topology=enable,
+                          d_cap=self.launch_d_cap(enable),
                           active=feats, pfields=pfields,
                           ptmpl=self.pod_template_blobs(),
                           gid=gid, rep=rep, g_cap=g_cap)

@@ -233,7 +233,7 @@ class Evaluator:
         return np.asarray(preempt_feasible_jit(
             mirror.to_blobs(), pblobs, mirror.well_known(), caps,
             jnp.asarray(tval), jnp.asarray(free), enable,
-            mirror.domain_bucket(), self._get_enabled_filters(pod)))
+            mirror.launch_d_cap(enable), self._get_enabled_filters(pod)))
 
     def _res_row_cached(self, pod: Pod) -> np.ndarray:
         from kubernetes_tpu.api.resources import pod_request

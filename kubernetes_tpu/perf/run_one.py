@@ -1,0 +1,48 @@
+"""Run ONE perf workload in a fresh process and print its result as JSON.
+
+`python -m kubernetes_tpu.perf.run_one <workload_fn> [--scale X]`
+
+The bench driver (bench.py) shells out here per workload — the same
+isolation the reference harness gets from one integration-test process
+per workload. Process isolation matters empirically: in-process
+back-to-back workloads interfere (device-memory/executable-cache
+pressure from earlier workloads shows up as multi-second stalls in later
+measured phases), while solo runs are clean and reproducible. The
+on-disk XLA compile cache keeps each fresh process warm.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    name = sys.argv[1]
+    scale = 1.0
+    if "--scale" in sys.argv:
+        scale = float(sys.argv[sys.argv.index("--scale") + 1])
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from kubernetes_tpu.utils import jaxsetup
+
+    jaxsetup.setup(os.path.join(repo, ".jax_cache"))
+    import time
+
+    from kubernetes_tpu.perf import workloads as W
+    from kubernetes_tpu.perf.harness import run_workload
+
+    factory = getattr(W, name)
+    t0 = time.time()
+    run_workload(factory(), scale=0.005)   # compile pass, same shapes
+    t_warm = time.time() - t0
+    t0 = time.time()
+    r = run_workload(factory(), scale=scale)
+    r["warm_s"] = round(t_warm, 1)
+    r["run_s"] = round(time.time() - t0, 1)
+    print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

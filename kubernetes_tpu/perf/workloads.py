@@ -81,6 +81,7 @@ def scheduling_basic(init_nodes=5000, init_pods=1000,
     return Workload(
         name="SchedulingBasic/5000Nodes_10000Pods",
         threshold=270,
+        batch_size=4096,   # auction path: bigger launches amortize better
         ops=[
             CreateNodes(init_nodes, _node),
             CreatePods(init_pods, lambda i: _pod(f"init-{i}")),
@@ -109,6 +110,7 @@ def scheduling_node_affinity(init_nodes=5000, init_pods=5000,
         name="SchedulingNodeAffinity/5000Nodes_10000Pods",
         threshold=220,
         pod_capacity=32768,
+        batch_size=4096,   # auction path
         ops=[
             CreateNodes(init_nodes, lambda i: _node(i, zones=["zone1"])),
             CreatePods(init_pods, lambda i: _pod(f"init-{i}")),
@@ -372,7 +374,48 @@ def preferred_pod_anti_affinity(init_nodes=5000, init_pods=1000,
         ])
 
 
+# ------------------- 12. RequiredPodAntiAffinityWithNSSelector
+# affinity/performance-config.yaml:425-480 (5000Nodes_2000Pods, 24 — the
+# LOWEST floor in the reference's affinity suite): measured pods carry
+# required hostname anti-affinity whose namespaceSelector picks out the
+# team's namespaces, so the match set spans namespaces selected by LABEL.
+
+def _ns_selector_anti_pod(i: int, ns: str) -> Pod:
+    aff = Affinity(pod_anti_affinity=PodAntiAffinity(required=[
+        PodAffinityTerm(
+            topology_key=LABEL_HOSTNAME,
+            label_selector=LabelSelector(match_labels={"color": "teal"}),
+            namespace_selector=LabelSelector(
+                match_labels={"team": "sched"}))]))
+    return _pod(f"nsanti-{ns}-{i}", namespace=ns,
+                labels={"color": "teal"}, affinity=aff)
+
+
+def ns_selector_anti_affinity(init_nodes=5000, init_pods=1000,
+                              measure_pods=2000, namespaces=10) -> Workload:
+    return Workload(
+        name="SchedulingRequiredPodAntiAffinityWithNSSelector"
+             "/5000Nodes_2000Pods",
+        threshold=24,
+        warm_full_nodes=True,   # hostname topology: domains = nodes
+        ops=[
+            CreateNodes(init_nodes, _node),
+            CreateNamespaces("team", namespaces,
+                             labels=lambda i: {"team": "sched"}),
+            CreatePods(init_pods,
+                       lambda i: _ns_selector_anti_pod(
+                           i, f"team-{i % namespaces}")),
+            CreatePods(measure_pods,
+                       lambda i: _ns_selector_anti_pod(
+                           i + 10**6, f"team-{i % namespaces}"),
+                       collect_metrics=True),
+        ])
+
+
 # the 5 BASELINE.json configs bench.py runs within the driver's budget
+# (bench.py shells out per workload and mirrors these BY NAME in its
+# BENCH_WORKLOAD_FNS — tests/test_perf_harness.py asserts the two stay
+# in sync)
 BENCH_WORKLOADS = (
     scheduling_basic,
     scheduling_node_affinity,
@@ -389,4 +432,5 @@ ALL_WORKLOADS = BENCH_WORKLOADS + (
     scheduling_while_gated,
     preferred_pod_affinity,
     preferred_pod_anti_affinity,
+    ns_selector_anti_affinity,
 )

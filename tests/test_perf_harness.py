@@ -187,3 +187,34 @@ def test_preferred_affinity_workloads_tiny():
         r = run_workload(w)
         assert r["pods_scheduled"] == 8, w.name
         assert r["stats"]["unschedulable"] == 0
+
+
+def test_ns_selector_anti_affinity_tiny():
+    from kubernetes_tpu.perf.workloads import ns_selector_anti_affinity
+
+    w = small(ns_selector_anti_affinity(init_nodes=8, init_pods=3,
+                                        measure_pods=5, namespaces=2))
+    w.warm_full_nodes = False
+    r = run_workload(w)
+    # hostname anti-affinity across ns-selected namespaces: all 8 pods
+    # must land on distinct nodes
+    assert r["pods_scheduled"] == 5
+    assert r["stats"]["scheduled"] == 8
+
+
+def test_bench_workload_names_in_sync():
+    """bench.py names its subprocess workloads; they must be exactly
+    workloads.BENCH_WORKLOADS (by function name) or a new bench workload
+    silently never runs."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    from kubernetes_tpu.perf.workloads import BENCH_WORKLOADS
+
+    assert tuple(bench.BENCH_WORKLOAD_FNS) == tuple(
+        f.__name__ for f in BENCH_WORKLOADS)
