@@ -73,6 +73,17 @@ class CreatePods:
 
 
 @dataclass
+class CreateObjects:
+    """Generic typed-object create op (the reference DSL's createAny:
+    scheduler_perf.go createAny op for ResourceSlices/Claims/classes):
+    calls hub.<create_verb>(make(i)) count times."""
+
+    count: int
+    make: Callable[[int], object]
+    create_verb: str = "create_resource_claim"
+
+
+@dataclass
 class Churn:
     """churnOp (scheduler_perf.go:819): once reached, inject one object
     per template every ``interval_ms`` while subsequent ops drain.
@@ -220,6 +231,10 @@ def run_workload(w: Workload, now: Callable[[], float] = time.time,
                 n_nodes = op.count if w.warm_full_nodes else scaled(op.count)
                 for i in range(n_nodes):
                     hub.create_node(op.make_node(i))
+            elif isinstance(op, CreateObjects):
+                make = getattr(hub, op.create_verb)
+                for i in range(scaled(op.count)):
+                    make(op.make(i))
             elif isinstance(op, CreateNamespaces):
                 for i in range(op.count):
                     hub.create_namespace(Namespace(metadata=ObjectMeta(

@@ -38,6 +38,7 @@ from kubernetes_tpu.perf.harness import (
     Churn,
     CreateNamespaces,
     CreateNodes,
+    CreateObjects,
     CreatePods,
     Workload,
 )
@@ -412,6 +413,73 @@ def ns_selector_anti_affinity(init_nodes=5000, init_pods=1000,
         ])
 
 
+# --------------------------- 13. DRA steady-state claim scheduling
+# dra/performance-config.yaml:60-110 (SteadyStateClusterClaimTemplate,
+# ~100 nodes, floor ~50): every node publishes a ResourceSlice of
+# devices; each measured pod carries its own single-device ResourceClaim
+# which the DynamicResources host plugin allocates at Reserve and
+# persists through PreBind — the reference's own accelerator path.
+
+def _dra_node(i: int) -> Node:
+    name = f"node-{i}"
+    return Node(metadata=ObjectMeta(name=name,
+                                    labels={LABEL_HOSTNAME: name}),
+                spec=NodeSpec(),
+                status=NodeStatus(allocatable={
+                    "cpu": "16", "memory": "64Gi", "pods": "110"}))
+
+
+def _dra_slice(i: int):
+    from kubernetes_tpu.api.objects import Device, ResourceSlice
+
+    node = f"node-{i}"
+    return ResourceSlice(
+        metadata=ObjectMeta(name=f"slice-{node}"),
+        node_name=node, driver="tpu.example.com", pool=node,
+        devices=[Device(name=f"dev-{d}", device_class_name="tpu")
+                 for d in range(8)])
+
+
+def _dra_claim(i: int):
+    from kubernetes_tpu.api.objects import (
+        DeviceRequest,
+        ResourceClaim,
+        ResourceClaimSpec,
+    )
+
+    return ResourceClaim(
+        metadata=ObjectMeta(name=f"dra-claim-{i}"),
+        spec=ResourceClaimSpec(device_requests=[
+            DeviceRequest(name="accel", device_class_name="tpu",
+                          count=1)]))
+
+
+def _dra_pod(i: int) -> Pod:
+    from kubernetes_tpu.api.objects import PodResourceClaim
+
+    p = _pod(f"dra-{i}", cpu="100m", mem="200Mi")
+    p.spec.resource_claims = [PodResourceClaim(
+        name="accel", resource_claim_name=f"dra-claim-{i}")]
+    return p
+
+
+def dra_steady_state(init_nodes=100, measure_pods=500) -> Workload:
+    return Workload(
+        name="DRASteadyState/100Nodes_500Pods",
+        threshold=50,
+        node_capacity=128,
+        pod_capacity=2048,
+        batch_size=256,
+        ops=[
+            CreateNodes(init_nodes, _dra_node),
+            CreateObjects(init_nodes, _dra_slice,
+                          create_verb="create_resource_slice"),
+            CreateObjects(measure_pods, _dra_claim,
+                          create_verb="create_resource_claim"),
+            CreatePods(measure_pods, _dra_pod, collect_metrics=True),
+        ])
+
+
 # the 5 BASELINE.json configs bench.py runs within the driver's budget
 # (bench.py shells out per workload and mirrors these BY NAME in its
 # BENCH_WORKLOAD_FNS — tests/test_perf_harness.py asserts the two stay
@@ -433,4 +501,5 @@ ALL_WORKLOADS = BENCH_WORKLOADS + (
     preferred_pod_affinity,
     preferred_pod_anti_affinity,
     ns_selector_anti_affinity,
+    dra_steady_state,
 )

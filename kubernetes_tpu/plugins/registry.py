@@ -236,6 +236,13 @@ def _dra_factory(args: dict):
     hub = args.get("hub")
     if hub is None:
         return None
+    # ONE instance per scheduler, shared across profiles (the reference's
+    # SharedDRAManager, scheduler.go:311-333): the assume overlay must see
+    # every profile's in-flight allocations or two same-batch pods from
+    # different profiles could double-book a device
+    shared = args.get("dra_shared")
+    if shared is not None:
+        return shared
     from kubernetes_tpu.plugins.dra import DynamicResources
 
     return DynamicResources(hub)

@@ -110,8 +110,13 @@ class Scheduler:
         self.preemption = Evaluator(
             hub, lambda: self.mirror, lambda: self.caps,
             self._filters_for, self.nominator)
+        from kubernetes_tpu.plugins.dra import DynamicResources
+
         extra = {"binder": hub.bind, "hub": hub,
-                 "preemption_evaluator": self.preemption}
+                 "preemption_evaluator": self.preemption,
+                 # shared across profiles (SharedDRAManager analog): one
+                 # assume overlay must see every profile's allocations
+                 "dra_shared": DynamicResources(hub)}
         # one resolved framework per profile (profile/profile.go:47 Map);
         # frameworkForPod routes each pod by spec.schedulerName
         self.frameworks = {
@@ -820,8 +825,14 @@ class Scheduler:
             self._invalidate_chain()
         s = fw.run_reserve_plugins(state, pod, node_name)
         if not s.is_success():
+            # a REJECTING reserve (e.g. DRA "devices vanished" — the
+            # designed same-batch capacity race) is unschedulable with
+            # plugin attribution, not a scheduler error; only raising
+            # plugins land on the error path
             self._undo_commit(qp, state, assumed, node_name,
-                              f"reserve: {s.message()}")
+                              f"reserve: {s.message()}",
+                              rejected_by=(s.plugin if s.is_rejected()
+                                           else ""))
             return
         s, waits = fw.run_permit_plugins(state, pod, node_name)
         if s.code == Code.WAIT:

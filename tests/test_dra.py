@@ -250,3 +250,46 @@ def test_claim_deletion_frees_devices_pod_deletion_does_not():
             break
         _t.sleep(0.5)
     assert bound(hub, second) == "a"
+
+
+def test_dra_shared_across_profiles_no_double_booking():
+    """The reference shares one DRA manager across profiles
+    (scheduler.go:311-333 SharedDRAManager): all frameworks must hold the
+    SAME DynamicResources instance, and two same-batch pods from
+    different profiles competing for the last device must never
+    double-book it."""
+    from kubernetes_tpu.config.types import SchedulerProfile, default_plugins
+
+    hub = Hub()
+    hub.create_node(mknode("n1"))
+    hub.create_resource_slice(mkslice("n1", 1))     # ONE device
+    hub.create_resource_claim(mkclaim("c-a"))
+    hub.create_resource_claim(mkclaim("c-b"))
+    cfg = default_config()
+    cfg.profiles.append(SchedulerProfile(scheduler_name="second",
+                                         plugins=default_plugins()))
+    cfg.batch_size = 8
+    sched = Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64))
+    insts = {id(fw.instance("DynamicResources"))
+             for fw in sched.frameworks.values()}
+    assert len(insts) == 1, "profiles must share one DRA assume overlay"
+    pa = mkpod("pod-a", claim="c-a")
+    pb = mkpod("pod-b", claim="c-b")
+    pb.spec.scheduler_name = "second"
+    hub.create_pod(pa)
+    hub.create_pod(pb)
+    sched.run_until_idle()
+    allocated = [hub.get_resource_claim("default", n)
+                 for n in ("c-a", "c-b")]
+    devices = [tuple((d.driver, d.pool, d.device)
+                     for d in c.status.allocation.devices)
+               for c in allocated if c.status.allocation is not None]
+    assert len(devices) == 1, \
+        f"exactly one claim may win the single device, got {devices}"
+    bound = [p for p in (pa, pb)
+             if hub.get_pod(p.metadata.uid).spec.node_name]
+    assert len(bound) == 1
+    # the loser is parked unschedulable (not an error): capacity races
+    # and exhaustion are rejections with plugin attribution
+    assert sched.stats["errors"] == 0
+    sched.close()

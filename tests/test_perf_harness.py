@@ -218,3 +218,12 @@ def test_bench_workload_names_in_sync():
 
     assert tuple(bench.BENCH_WORKLOAD_FNS) == tuple(
         f.__name__ for f in BENCH_WORKLOADS)
+
+
+def test_dra_steady_state_tiny():
+    from kubernetes_tpu.perf.workloads import dra_steady_state
+
+    w = small(dra_steady_state(init_nodes=4, measure_pods=6))
+    r = run_workload(w)
+    assert r["pods_scheduled"] == 6
+    assert r["stats"]["unschedulable"] == 0
