@@ -86,6 +86,20 @@ def main(argv=None) -> int:
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
+
+    def _debug_dump(*_sig) -> None:
+        """SIGUSR2 cache debugger (backend/cache/debugger/debugger.go:31):
+        dump the cache and run the cache-vs-hub comparer."""
+        import json as _json
+
+        print(_json.dumps({"cache": sched.cache.dump(),
+                           "pending": sched.queue.pending_counts()},
+                          default=str)[:100000], file=sys.stderr)
+        for line in sched.cache.compare_with_hub(hub):
+            print(f"cache-vs-hub: {line}", file=sys.stderr)
+
+    if hasattr(signal, "SIGUSR2"):
+        signal.signal(signal.SIGUSR2, _debug_dump)
     print("scheduler running (ctrl-c to stop)", file=sys.stderr)
     try:
         sched.run(stop, elector=elector)
