@@ -87,16 +87,37 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
 
-    def _debug_dump(*_sig) -> None:
-        """SIGUSR2 cache debugger (backend/cache/debugger/debugger.go:31):
-        dump the cache and run the cache-vs-hub comparer."""
+    def _debug_dump_body() -> None:
         import json as _json
 
-        print(_json.dumps({"cache": sched.cache.dump(),
+        out = _json.dumps({"cache": sched.cache.dump(),
                            "pending": sched.queue.pending_counts()},
-                          default=str)[:100000], file=sys.stderr)
+                          default=str)
+        if len(out) > 100000:
+            out = out[:100000] + f'... [truncated, {len(out)} chars total]'
+        print(out, file=sys.stderr)
         for line in sched.cache.compare_with_hub(hub):
             print(f"cache-vs-hub: {line}", file=sys.stderr)
+
+    def _debug_dump(*_sig) -> None:
+        """SIGUSR2 cache debugger (backend/cache/debugger/debugger.go:31):
+        dump the cache and run the cache-vs-hub comparer — on its OWN
+        thread, like the reference's debugger goroutine: the handler
+        itself interrupts the scheduling loop mid-bytecode, where the
+        RLock would let an inline dump read half-applied cache state (and
+        a raising handler would crash the loop). A debug signal must
+        never be able to take the daemon down."""
+        threading.Thread(target=lambda: _swallow(_debug_dump_body),
+                         daemon=True, name="cache-debugger").start()
+
+    def _swallow(fn) -> None:
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 — diagnostics only
+            try:
+                print(f"cache-debugger failed: {e!r}", file=sys.stderr)
+            except OSError:
+                pass
 
     if hasattr(signal, "SIGUSR2"):
         signal.signal(signal.SIGUSR2, _debug_dump)
