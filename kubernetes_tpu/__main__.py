@@ -99,17 +99,6 @@ def main(argv=None) -> int:
         for line in sched.cache.compare_with_hub(hub):
             print(f"cache-vs-hub: {line}", file=sys.stderr)
 
-    def _debug_dump(*_sig) -> None:
-        """SIGUSR2 cache debugger (backend/cache/debugger/debugger.go:31):
-        dump the cache and run the cache-vs-hub comparer — on its OWN
-        thread, like the reference's debugger goroutine: the handler
-        itself interrupts the scheduling loop mid-bytecode, where the
-        RLock would let an inline dump read half-applied cache state (and
-        a raising handler would crash the loop). A debug signal must
-        never be able to take the daemon down."""
-        threading.Thread(target=lambda: _swallow(_debug_dump_body),
-                         daemon=True, name="cache-debugger").start()
-
     def _swallow(fn) -> None:
         try:
             fn()
@@ -118,6 +107,19 @@ def main(argv=None) -> int:
                 print(f"cache-debugger failed: {e!r}", file=sys.stderr)
             except OSError:
                 pass
+
+    def _debug_dump(*_sig) -> None:
+        """SIGUSR2 cache debugger (backend/cache/debugger/debugger.go:31):
+        dump the cache and run the cache-vs-hub comparer — on its OWN
+        thread, like the reference's debugger goroutine: the handler
+        itself interrupts the scheduling loop mid-bytecode, where the
+        RLock would let an inline dump read half-applied cache state (and
+        a raising handler would crash the loop). The WHOLE handler body
+        (thread start included — it can raise at the thread limit) is
+        guarded: a debug signal must never take the daemon down."""
+        _swallow(lambda: threading.Thread(
+            target=lambda: _swallow(_debug_dump_body),
+            daemon=True, name="cache-debugger").start())
 
     if hasattr(signal, "SIGUSR2"):
         signal.signal(signal.SIGUSR2, _debug_dump)
