@@ -130,6 +130,11 @@ class CapacityError(Exception):
 
 
 
+# phase-1 dedup group bucket for no-topology launches (prepare_launch):
+# FIXED so the static g_cap jit key never varies with batch composition
+P1_DEDUP_GROUP_CAP = 8
+
+
 class Mirror:
     def __init__(self, interner: Interner | None = None,
                  caps: Capacities = Capacities()):
@@ -1256,13 +1261,19 @@ class Mirror:
     GROUP_IGNORED_FIELDS = ("name_id", "uid_id")
 
     def _batch_groups(self, f32: np.ndarray, i32: np.ndarray, n_pods: int,
-                      fields: tuple[str, ...]
-                      ) -> tuple[np.ndarray, np.ndarray, int]:
+                      fields: tuple[str, ...],
+                      max_groups: int | None = None
+                      ) -> tuple[np.ndarray, np.ndarray, int] | None:
         """Dedup batch rows into topology groups: (gid [B], rep [G_cap],
         g_cap). Pods with byte-identical packed rows (minus identity fields)
         share all topology statics and pairwise term matches, so the device
         computes them once per GROUP (pipeline phase-1/scan); padding rows
-        form their own group."""
+        form their own group.
+
+        ``max_groups`` (probe mode): bail out with None as soon as the
+        distinct-row count (padding group included) would exceed it, so a
+        heterogeneous batch doesn't pay full per-row hashing for a result
+        the caller will discard."""
         batch_size = f32.shape[0]
         f_off, i_off, _, _ = self.pod_codec.subset_layout(fields)
         fh = f32[:n_pods]
@@ -1281,11 +1292,16 @@ class Mirror:
         gid = np.zeros((batch_size,), np.int32)
         seen: dict[bytes, int] = {}
         reps: list[int] = []
+        # the padding group (if any) counts against max_groups up front
+        cap = (max_groups - (1 if n_pods < batch_size else 0)
+               if max_groups is not None else None)
         for b in range(n_pods):
             key = fh[b].tobytes() + ih[b].tobytes()
             g = seen.get(key)
             if g is None:
                 g = len(reps)
+                if cap is not None and g >= cap:
+                    return None
                 seen[key] = g
                 reps.append(b)
             gid[b] = g
@@ -1377,6 +1393,24 @@ class Mirror:
                 f32, i32, len(pods), pfields)
             gid = jnp.asarray(gid_np)
             rep = jnp.asarray(rep_np)
+        elif pods:
+            # phase-1 static dedup for deployment-shaped NO-topology
+            # batches: identical specs share all static filters/scores, so
+            # the [B, N] phase-1 work collapses to [G, N] + a gather. Only
+            # taken at a FIXED tiny group bucket — g_cap is a static jit
+            # arg, and a fixed 8 keeps every batch of a workload (warmup,
+            # full-size, the short tail batch) on the same compiled
+            # program; spec-diverse batches bail out of the probe early
+            # and take the per-pod path, also a stable program.
+            probe = self._batch_groups(f32, i32, len(pods), pfields,
+                                       max_groups=P1_DEDUP_GROUP_CAP)
+            if probe is not None:
+                gid_np, rep_np, _ = probe
+                rep8 = np.full((P1_DEDUP_GROUP_CAP,), rep_np[0], np.int32)
+                rep8[: len(rep_np)] = rep_np[: P1_DEDUP_GROUP_CAP]
+                gid = jnp.asarray(gid_np)
+                rep = jnp.asarray(rep8)
+                g_cap = P1_DEDUP_GROUP_CAP
         return LaunchSpec(cblobs=self.to_blobs(), pblobs=pblobs,
                           enable_topology=enable,
                           d_cap=self.launch_d_cap(enable),

@@ -441,7 +441,19 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                                 + x.shape[2:])[:n_rows], outs)
 
     B_all = pblobs.f32.shape[0]
-    outs = chunked_vmap(per_pod, pods, B_all)
+    if gid is not None and rep.shape[0] < B_all:
+        # phase-1 dedup: statics are identity-free, so compute them per
+        # GROUP representative and gather back to pods — deployment-shaped
+        # batches (few distinct specs) collapse the [B, N] phase-1 work to
+        # [G, N] (Mirror.prepare_launch attaches groups for no-topology
+        # launches too when the batch is homogeneous enough). Degenerate
+        # per-pod groupings (rep as wide as the batch) skip the detour —
+        # the two full-batch gathers would only add HBM traffic.
+        pods_rep_p1 = jax.tree.map(lambda x: x[rep], pods)
+        outs_g = chunked_vmap(per_pod, pods_rep_p1, rep.shape[0])
+        outs = jax.tree.map(lambda x: x[gid], outs_g)
+    else:
+        outs = chunked_vmap(per_pod, pods, B_all)
     (static_ok, static_rejects, taint_raw, aff_raw, img, unres) = outs
     if host_ok is not None:
         # host Filter verdicts AND in here; host rejects are attributed by
