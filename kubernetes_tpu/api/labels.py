@@ -176,5 +176,3 @@ def find_untolerated_taint(
     return None
 
 
-def node_hostname(node: Node) -> str:
-    return node.metadata.labels.get(LABEL_HOSTNAME, node.metadata.name)

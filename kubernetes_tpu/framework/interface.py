@@ -244,11 +244,3 @@ class PostBindPlugin(Plugin):
         pass
 
 
-# event helpers used by plugins' EventsToRegister
-
-def node_event(action: ActionType) -> ClusterEvent:
-    return ClusterEvent(EventResource.NODE, action)
-
-
-def pod_event(action: ActionType) -> ClusterEvent:
-    return ClusterEvent(EventResource.ASSIGNED_POD, action)

@@ -40,25 +40,6 @@ def tolerations_tolerate(
     m_op = (tol_op == TOL_EXISTS) | (tol_val == tv)
     m = tol_valid & m_effect & m_key & m_op
     return jnp.any(m, axis=-1)
-
-
-def pairs_subset_of_labels(
-    sel_keys: jnp.ndarray, sel_vals: jnp.ndarray,
-    label_keys: jnp.ndarray, label_vals: jnp.ndarray,
-) -> jnp.ndarray:
-    """Are all (key, value) pairs present in the labels?
-
-    sel_*: [..., S]; label_*: [..., L] (leading axes broadcast).
-    Empty selector (all NONE) matches everything. Returns [...] bool.
-    """
-    sk = sel_keys[..., :, None]    # [..., S, 1]
-    sv = sel_vals[..., :, None]
-    lk = label_keys[..., None, :]  # [..., 1, L]
-    lv = label_vals[..., None, :]
-    hit = jnp.any((sk == lk) & (sv == lv), axis=-1)  # [..., S]
-    return jnp.all(hit | (sel_keys == NONE), axis=-1)
-
-
 def masked_max(x: jnp.ndarray, mask: jnp.ndarray, axis=None) -> jnp.ndarray:
     return jnp.max(jnp.where(mask, x, -jnp.inf), axis=axis)
 

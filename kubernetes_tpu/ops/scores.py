@@ -99,11 +99,6 @@ def least_allocated(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
     return least_allocated_from_fractions(_requested_fractions(ct, pod))
 
 
-def most_allocated(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
-    frac = _requested_fractions(ct, pod)
-    return jnp.mean(frac, axis=-1) * MAX_NODE_SCORE
-
-
 def balanced_allocation(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
     return balanced_allocation_from_fractions(_requested_fractions(ct, pod))
 
