@@ -156,6 +156,9 @@ class PriorityQueue:
     def _park(self, qp: QueuedPodInfo,
               pool: dict[str, QueuedPodInfo]) -> None:
         """File a pod in a parked pool + the inverted requeue index."""
+        if qp.park_keys or qp.uid in self._park_all:
+            # re-park without unpark would strand stale index entries
+            self._unpark(qp)
         uid = qp.uid
         pool[uid] = qp
         plugins = set(qp.unschedulable_plugins)

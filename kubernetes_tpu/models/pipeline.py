@@ -777,7 +777,12 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
             found_k = csum[-1] >= k_find
             kth = jnp.argmax(csum >= k_find).astype(jnp.int32)
             processed = jnp.where(found_k, kth + 1, n_total)
+            # Advance in row space, then SNAP to the next valid row so
+            # nextStartNodeIndex never dwells on padding/hole regions —
+            # matching the reference's rotation cadence over real nodes
+            # (schedule_one.go:620) while row layout may have holes.
             start = (start + processed) % n_total
+            start = (start + jnp.argmax(jnp.roll(valid, -start))) % n_total
         frac = SC.utilization_fractions(alloc2, nzr, nzreq)
         least = SC.fit_score_from_fractions(frac, fit_strategy, fit_shape)
         bal = SC.balanced_allocation_from_fractions(frac)

@@ -99,6 +99,10 @@ def least_allocated(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
     return least_allocated_from_fractions(_requested_fractions(ct, pod))
 
 
+def most_allocated(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
+    return most_allocated_from_fractions(_requested_fractions(ct, pod))
+
+
 def balanced_allocation(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
     return balanced_allocation_from_fractions(_requested_fractions(ct, pod))
 

@@ -31,7 +31,9 @@ def _registry() -> dict[str, type]:
 
 def to_wire(v: Any) -> Any:
     """Object -> JSON-compatible value. Dataclasses become tagged dicts;
-    sets become sorted lists (wire stability)."""
+    sets become ``{"__kind__": "__set__", "items": [...]}`` (sorted for
+    wire stability) so they round-trip typed and version-skewed peers
+    fail loudly on the unknown kind rather than half-decoding."""
     if dataclasses.is_dataclass(v) and not isinstance(v, type):
         out = {"__kind__": type(v).__name__}
         for f in dataclasses.fields(v):
@@ -42,7 +44,12 @@ def to_wire(v: Any) -> Any:
     if isinstance(v, (list, tuple)):
         return [to_wire(x) for x in v]
     if isinstance(v, (set, frozenset)):
-        return sorted(to_wire(x) for x in v)
+        items = [to_wire(x) for x in v]
+        try:
+            items.sort()
+        except TypeError:  # mixed-type set: stable but arbitrary order
+            items.sort(key=repr)
+        return {"__kind__": "__set__", "items": items}
     return v
 
 
@@ -53,6 +60,8 @@ def from_wire(v: Any) -> Any:
         kind = v.get("__kind__")
         if kind is None:
             return {k: from_wire(x) for k, x in v.items()}
+        if kind == "__set__":
+            return set(from_wire(x) for x in v["items"])
         cls = _registry().get(kind)
         if cls is None:
             raise ValueError(f"unknown wire kind {kind!r}")

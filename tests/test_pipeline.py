@@ -160,7 +160,12 @@ def test_pct_nodes_rotates_start_index():
     # pod 0 picks inside nodes [0,100); pod 1's window starts at 100
     assert rows[0] < 100
     assert rows[1] >= 100
-    assert int(out.pct_start) > 0
+    # windows alternate [0,100) / [100,200) for the whole batch, and the
+    # rotation wraps over the 200 REAL nodes (not the 256-row padded
+    # bucket): 8 pods x 100 processed -> nextStartNodeIndex back at 0
+    assert all(r < 100 for r in rows[0::2])
+    assert all(r >= 100 for r in rows[1::2])
+    assert int(out.pct_start) == 0
 
 
 def test_pct_nodes_start_carries_across_launches():

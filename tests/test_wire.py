@@ -1,0 +1,55 @@
+"""Wire codec round-trips (utils/wire.py) — the hub transport's analog of
+apimachinery serialization. Sets must survive the boundary typed (tagged
+as {"__set__": [...]}), not silently decay to lists."""
+
+import json
+
+from kubernetes_tpu.api.objects import (
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    Taint,
+)
+from kubernetes_tpu.utils.wire import from_wire, to_wire
+
+
+def rt(v):
+    return from_wire(json.loads(json.dumps(to_wire(v))))
+
+
+def test_scalars_and_containers_round_trip():
+    assert rt(5) == 5
+    assert rt("x") == "x"
+    assert rt([1, 2]) == [1, 2]
+    assert rt({"a": [1, {"b": None}]}) == {"a": [1, {"b": None}]}
+
+
+def test_sets_round_trip_typed():
+    assert rt({"b", "a"}) == {"a", "b"}
+    assert isinstance(rt({"a"}), set)
+    assert rt(frozenset({3, 1})) == {1, 3}
+    # mixed-type sets must not crash on ordering
+    got = rt({1, "a"})
+    assert got == {1, "a"}
+    # nested inside dicts/lists
+    assert rt({"k": [{"x", "y"}]}) == {"k": [{"x", "y"}]}
+
+
+def test_dataclasses_round_trip():
+    n = Node(metadata=ObjectMeta(name="n1", labels={"zone": "z1"}),
+             spec=NodeSpec(taints=[Taint(key="k", value="v",
+                                         effect="NoSchedule")]),
+             status=NodeStatus(allocatable={"cpu": "4"}))
+    got = rt(n)
+    assert got == n
+    p = Pod(metadata=ObjectMeta(name="p1"), spec=PodSpec())
+    assert rt(p) == p
+
+
+def test_unknown_kind_raises():
+    import pytest
+    with pytest.raises(ValueError):
+        from_wire({"__kind__": "NoSuchKind"})
