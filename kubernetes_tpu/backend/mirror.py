@@ -137,8 +137,26 @@ P1_DEDUP_GROUP_CAP = 8
 
 class Mirror:
     def __init__(self, interner: Interner | None = None,
-                 caps: Capacities = Capacities()):
+                 caps: Capacities = Capacities(), mesh=None):
         self.caps = caps
+        # multi-chip: shard the resident node table over the mesh's 'nodes'
+        # axis (SURVEY §5.7 — the node axis is what outgrows one chip's
+        # HBM). Every launch consuming to_blobs() then runs SPMD over the
+        # mesh with no further plumbing: jit partitions the program from
+        # the operand shardings, reductions become ICI collectives.
+        self.mesh = mesh
+        self._dev_sharding: dict[str, object] = {}
+        self._scatter_fns: dict[str, object] = {}
+        if mesh is not None:
+            from kubernetes_tpu.parallel import mirror_shardings
+
+            self._dev_sharding = mirror_shardings(mesh)
+            for key, sh in self._dev_sharding.items():
+                # pin the scatter output to the resident sharding so the
+                # incremental path can never drift the buffer to a layout
+                # the launch programs weren't compiled for
+                self._scatter_fns[key] = jax.jit(
+                    _scatter_rows, donate_argnums=(0,), out_shardings=sh)
         self.interner = interner or Interner()
         self.node_codec, self.table_codec, self.pod_codec = codecs(caps)
         self.node_f32, self.node_i32 = self.node_codec.alloc(caps.nodes)
@@ -821,7 +839,9 @@ class Mirror:
         multi-MB mirror over the host<->TPU link)."""
         dev = self._dev.get(key)
         if dev is None or full or len(dirty) > max(64, host_buf.shape[0] // 4):
-            self._dev[key] = jnp.asarray(host_buf)
+            sh = self._dev_sharding.get(key)
+            self._dev[key] = (jnp.asarray(host_buf) if sh is None
+                              else jax.device_put(host_buf, sh))
             return
         if not dirty:
             return
@@ -834,8 +854,9 @@ class Mirror:
         # XLA compiles one kernel per bucket, not per row-count
         idx = idx + [idx[-1]] * (k - len(idx))
         arr = np.asarray(idx, np.int32)
-        self._dev[key] = _scatter_rows_jit(dev, jnp.asarray(arr),
-                                           jnp.asarray(host_buf[arr]))
+        scatter = self._scatter_fns.get(key, _scatter_rows_jit)
+        self._dev[key] = scatter(dev, jnp.asarray(arr),
+                                 jnp.asarray(host_buf[arr]))
 
     def to_blobs(self) -> ClusterBlobs:
         """Refresh the device-resident mirror (incremental row scatter or

@@ -79,6 +79,10 @@ class Evaluator:
         # eviction work queue the scheduler drains between cycles
         self.preempting: set[str] = set()
         self._pending: list[tuple[Candidate, Pod]] = []
+        # scheduler-installed: activates preemptors whose flush produced no
+        # deletion event (empty/already-deleted victim sets) — the gate
+        # opener of last resort (see flush_evictions)
+        self.activate_fn = None
         self.metrics = None     # SchedulerMetrics, set by the Scheduler
         self._sweep_cache_key = None
         self._sweep_cache = None
@@ -394,8 +398,14 @@ class Evaluator:
         """Execute queued evictions; returns the number of preparations
         run. The preemptor leaves ``preempting`` BEFORE the last victim
         deletion so that deletion's cluster event finds the gate open and
-        requeues it (preemption.go:528's ordering)."""
+        requeues it (preemption.go:528's ordering). A candidate whose
+        victim set is empty — or whose victims were already deleted by an
+        overlapping candidate this flush — produces NO deletion event, so
+        its preemptor is activated explicitly (``activate_fn``): without
+        that, two preemptors nominating the same node can deadlock parked
+        behind each other's reservations."""
         work, self._pending = self._pending, []
+        stranded = []
         for candidate, pod in work:
             # lower-priority nominees on this node must re-evaluate: drop
             # the nomination AND clear the API status; the update event
@@ -411,11 +421,17 @@ class Evaluator:
                 except Exception:  # noqa: BLE001 — already gone is fine
                     pass
             self.preempting.discard(pod.metadata.uid)
+            fired = False
             if victims:
                 try:
                     self.hub.delete_pod(victims[-1].metadata.uid)
+                    fired = True
                 except Exception:  # noqa: BLE001
                     pass
+            if not fired:
+                stranded.append(pod)
+        if stranded and self.activate_fn is not None:
+            self.activate_fn(stranded)
         return len(work)
 
     def _reprieve_by_resources(self, victims: list[Pod], pod: Pod,

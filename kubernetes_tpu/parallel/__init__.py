@@ -42,6 +42,19 @@ def pods_nodes_mesh(devices, pods_axis: int) -> Mesh:
                 ("pods", "nodes"))
 
 
+def mirror_shardings(mesh: Mesh) -> dict:
+    """Sharding per Mirror device buffer: the node table shards row-wise on
+    the 'nodes' mesh axis (the framework's data-parallel axis); the pod
+    table replicates (topology kernels gather it by slot from every shard).
+    Passing this to ``Mirror(mesh=...)`` makes every production launch —
+    the batched pipeline, the usage chain, the preemption sweeps — run
+    SPMD over the mesh: placements are bit-identical to single-device
+    (tests/test_multichip.py), reductions ride ICI."""
+    sh_nodes = NamedSharding(mesh, P("nodes", None))
+    sh_rep = NamedSharding(mesh, P())
+    return {"node_f32": sh_nodes, "node_i32": sh_nodes, "pods_i32": sh_rep}
+
+
 def pipeline_shardings(mesh: Mesh, pblobs, wk, weights):
     """in_shardings for schedule_batch(cblobs, pblobs, wk, weights) on a
     ('nodes',) or ('pods', 'nodes') mesh: node-table blobs shard on the

@@ -94,7 +94,7 @@ class Scheduler:
     def __init__(self, hub: Hub,
                  config: Optional[SchedulerConfiguration] = None,
                  caps: Optional[Capacities] = None,
-                 now=time.time, registry=None):
+                 now=time.time, registry=None, mesh=None):
         self.hub = hub
         self.config = config or default_config()
         self.now = now
@@ -105,7 +105,13 @@ class Scheduler:
         self.caps = caps or Capacities(
             nodes=self.config.node_capacity,
             pods=self.config.pod_table_capacity)
-        self.mirror = Mirror(caps=self.caps)
+        # multi-chip: a jax.sharding.Mesh with a 'nodes' axis shards the
+        # resident node table row-wise (SURVEY §5.7/§5.8); every device
+        # launch this scheduler makes — batched pipeline, usage chain,
+        # preemption sweeps — then runs SPMD over the mesh, placements
+        # bit-identical to single-device (tests/test_multichip.py).
+        self.mesh = mesh
+        self.mirror = Mirror(caps=self.caps, mesh=mesh)
         self.nominator = Nominator()
         self.preemption = Evaluator(
             hub, lambda: self.mirror, lambda: self.caps,
@@ -151,6 +157,10 @@ class Scheduler:
             now=now)
         self.metrics = SchedulerMetrics(
             pending_fn=self.queue.pending_counts)
+        # gate opener of last resort: a flush that deleted nothing (empty
+        # or already-gone victim sets) fires no cluster event, so the
+        # evaluator re-activates those preemptors directly
+        self.preemption.activate_fn = self.queue.activate
         self.recorder = AsyncRecorder(now=now)
         self.preemption.metrics = self.metrics
         # per-profile launch configuration
@@ -436,7 +446,7 @@ class Scheduler:
         while new < err.needed:
             new *= 2
         self.caps = dataclasses.replace(self.caps, **{field: new})
-        self.mirror = Mirror(caps=self.caps)
+        self.mirror = Mirror(caps=self.caps, mesh=self.mesh)
         self.snapshot = Snapshot()
         self._invalidate_chain()
         self.cache.update_snapshot(self.snapshot)
