@@ -129,7 +129,11 @@ class _ChurnState:
         self._live: dict[int, object] = {}
 
     def due(self, t: float) -> int:
-        return int((t - self.t0) * 1000.0 / self.op.interval_ms)
+        # first injection fires immediately: a warm/compile pass whose
+        # drain completes inside one interval must still exercise the
+        # churn path (and compile its programs — e.g. the preemption
+        # sweep) or the full-scale run pays the XLA compile mid-phase
+        return 1 + int((t - self.t0) * 1000.0 / self.op.interval_ms)
 
     def _create(self, hub: Hub, obj, i: int) -> None:
         from kubernetes_tpu.api.objects import Node

@@ -1,0 +1,124 @@
+"""Shared production-drive scenario for multi-chip parity checks.
+
+One harness drives the REAL Scheduler drain loop — auction batches
+(plain pods), topology batches (hostname anti-affinity + optional zone
+spread), and a preemption burst on a saturated node pool — against the
+in-process hub, optionally under a ``jax.sharding.Mesh``. Both the driver
+dryrun (``__graft_entry__.dryrun_multichip``) and the pytest parity suite
+(tests/test_multichip.py) call THIS function, so the drain choreography
+they compare can never diverge.
+
+Determinism notes baked in: explicit uids (the process-global uid counter
+would change uid-hash tie-breaks between runs) and synchronous binding
+(the binder pool's hub writes land in thread-arrival order).
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api.objects import (
+    Affinity,
+    Container,
+    LABEL_HOSTNAME,
+    LABEL_ZONE,
+    LabelSelector,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PodSpec,
+    ResourceRequirements,
+    TopologySpreadConstraint,
+)
+from kubernetes_tpu.config.types import default_config
+from kubernetes_tpu.hub import Hub
+from kubernetes_tpu.ops.features import Capacities
+from kubernetes_tpu.scheduler import Scheduler
+
+
+def make_node(i: int, zone: str, labels: dict | None = None,
+              cpu: str = "4") -> Node:
+    name = f"node-{i:04d}"
+    lab = {LABEL_HOSTNAME: name, LABEL_ZONE: zone}
+    lab.update(labels or {})
+    return Node(metadata=ObjectMeta(name=name, uid=f"uid-n-{name}",
+                                    labels=lab),
+                spec=NodeSpec(),
+                status=NodeStatus(allocatable={"cpu": cpu, "memory": "32Gi",
+                                               "pods": "110"}))
+
+
+def make_pod(name: str, cpu: str = "500m", labels: dict | None = None,
+             priority: int = 0, selector: dict | None = None,
+             anti_on: dict | None = None, spread: bool = False) -> Pod:
+    affinity = None
+    if anti_on:
+        affinity = Affinity(pod_anti_affinity=PodAntiAffinity(
+            required=[PodAffinityTerm(
+                label_selector=LabelSelector(match_labels=anti_on),
+                topology_key=LABEL_HOSTNAME)]))
+    tsc = []
+    if spread:
+        tsc = [TopologySpreadConstraint(
+            max_skew=1, topology_key=LABEL_ZONE,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector(match_labels={"tier": "spread"}))]
+    return Pod(metadata=ObjectMeta(name=name, uid=f"uid-p-{name}",
+                                   labels=labels or {}),
+               spec=PodSpec(
+                   containers=[Container(name="c",
+                                         resources=ResourceRequirements(
+                                             requests={"cpu": cpu,
+                                                       "memory": "256Mi"}))],
+                   priority=priority, node_selector=selector or {},
+                   affinity=affinity, topology_spread_constraints=tsc))
+
+
+def drive_production_scenario(mesh, n_nodes: int, caps: Capacities, *,
+                              zones: int = 4, gold_nodes: int = 2,
+                              plain: int = 8, anti: int = 4,
+                              spread: int = 0, low: int = 4, high: int = 1,
+                              batch_size: int = 8, drain_rounds: int = 5,
+                              ) -> tuple[dict, Scheduler]:
+    """Run the production drain end to end; returns ({pod: node}, sched).
+
+    Phases: (A) ``plain`` pods — the parallel-rounds auction commit mode
+    (+ ``anti``/``spread`` topology pods — the serial as-if-serial commit
+    scan); (B) ``low`` 1800m fillers saturate the ``gold_nodes``-node
+    'pool=gold' subset; (C) ``high`` priority-100 pods restricted to the
+    pool must dry-run victims, nominate, evict, and bind — the preemption
+    sweep on (optionally sharded) resident blobs."""
+    hub = Hub()
+    cfg = default_config()
+    cfg.batch_size = batch_size
+    cfg.async_binding = False
+    clock = [1000.0]
+    sched = Scheduler(hub, cfg, caps=caps, now=lambda: clock[0], mesh=mesh)
+    for i in range(n_nodes):
+        labels = {"pool": "gold"} if i < gold_nodes else None
+        hub.create_node(make_node(i, zone=f"z{i % zones}", labels=labels))
+    for i in range(plain):
+        hub.create_pod(make_pod(f"plain-{i:03d}"))
+    for i in range(anti):
+        hub.create_pod(make_pod(f"anti-{i:02d}", labels={"grp": "a"},
+                                anti_on={"grp": "a"}))
+    for i in range(spread):
+        hub.create_pod(make_pod(f"spread-{i:02d}",
+                                labels={"tier": "spread"}, spread=True))
+    sched.run_until_idle()
+    for i in range(low):
+        hub.create_pod(make_pod(f"low-{i}", cpu="1800m",
+                                selector={"pool": "gold"}))
+    sched.run_until_idle()
+    for i in range(high):
+        hub.create_pod(make_pod(f"high-{i}", cpu="1800m", priority=100,
+                                selector={"pool": "gold"}))
+    for _ in range(drain_rounds):
+        sched.run_until_idle()
+        clock[0] += 3.0
+        sched.queue.flush_backoff_completed()
+    sched.run_until_idle()
+    return {p.metadata.name: p.spec.node_name
+            for p in hub.list_pods()}, sched

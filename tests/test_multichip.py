@@ -77,122 +77,25 @@ def test_graft_dryrun_entrypoint():
 # batches), and the preemption sweep (victim cumsum on sharded blobs).
 # ---------------------------------------------------------------------------
 
-from kubernetes_tpu.api.objects import (  # noqa: E402
-    Affinity,
-    Container,
-    LABEL_HOSTNAME,
-    LABEL_ZONE,
-    LabelSelector,
-    Node,
-    NodeSpec,
-    NodeStatus,
-    ObjectMeta,
-    Pod,
-    PodAntiAffinity,
-    PodAffinityTerm,
-    PodSpec,
-    ResourceRequirements,
-    TopologySpreadConstraint,
-)
 from kubernetes_tpu.config.types import default_config  # noqa: E402
 from kubernetes_tpu.hub import Hub  # noqa: E402
 from kubernetes_tpu.ops.features import Capacities  # noqa: E402
 from kubernetes_tpu.scheduler import Scheduler  # noqa: E402
-
-
-class _Clock:
-    def __init__(self):
-        self.t = 1000.0
-
-    def now(self):
-        return self.t
-
-    def tick(self, dt):
-        self.t += dt
-
-
-def _node(i, zone, cpu="4", labels=None):
-    name = f"node-{i:04d}"
-    lab = {LABEL_HOSTNAME: name, LABEL_ZONE: zone}
-    lab.update(labels or {})
-    # explicit uids: the process-global uid counter would otherwise hand
-    # the second run different uids, changing uid-hash tie-breaks
-    return Node(metadata=ObjectMeta(name=name, uid=f"uid-n-{name}",
-                                    labels=lab),
-                spec=NodeSpec(),
-                status=NodeStatus(allocatable={"cpu": cpu, "memory": "32Gi",
-                                               "pods": "110"}))
-
-
-def _pod(name, cpu="500m", labels=None, priority=0, selector=None,
-         anti_on=None, spread=False):
-    affinity = None
-    if anti_on:
-        affinity = Affinity(pod_anti_affinity=PodAntiAffinity(
-            required=[PodAffinityTerm(
-                label_selector=LabelSelector(match_labels=anti_on),
-                topology_key=LABEL_HOSTNAME)]))
-    tsc = []
-    if spread:
-        tsc = [TopologySpreadConstraint(
-            max_skew=1, topology_key=LABEL_ZONE,
-            when_unsatisfiable="DoNotSchedule",
-            label_selector=LabelSelector(match_labels={"tier": "spread"}))]
-    return Pod(metadata=ObjectMeta(name=name, uid=f"uid-p-{name}",
-                                   labels=labels or {}),
-               spec=PodSpec(
-                   containers=[Container(name="c",
-                                         resources=ResourceRequirements(
-                                             requests={"cpu": cpu,
-                                                       "memory": "256Mi"}))],
-                   priority=priority, node_selector=selector or {},
-                   affinity=affinity, topology_spread_constraints=tsc))
+from kubernetes_tpu.testing.parity import (  # noqa: E402
+    drive_production_scenario,
+    make_node as parity_node,
+    make_pod as parity_pod,
+)
 
 
 def _run_production(mesh, n_nodes=1024):
-    hub = Hub()
-    cfg = default_config()
-    cfg.batch_size = 16
-    # parity needs a deterministic event order: the binder pool's hub
-    # writes land in thread-arrival order, which legitimately varies
-    cfg.async_binding = False
-    clock = _Clock()
-    sched = Scheduler(hub, cfg, caps=Capacities(nodes=n_nodes, pods=512),
-                      now=clock.now, mesh=mesh)
-    for i in range(n_nodes):
-        labels = {"pool": "gold"} if i < 4 else None
-        hub.create_node(_node(i, zone=f"z{i % 8}", labels=labels))
-    # phase A — plain pods: the parallel-rounds auction commit mode
-    for i in range(64):
-        hub.create_pod(_pod(f"plain-{i:03d}"))
-    sched.run_until_idle()
-    # phase B — topology batches: hostname anti-affinity + zone spread
-    # force the serial as-if-serial commit scan with topology kernels
-    for i in range(16):
-        hub.create_pod(_pod(f"anti-{i:02d}", labels={"grp": "a"},
-                            anti_on={"grp": "a"}))
-    for i in range(16):
-        hub.create_pod(_pod(f"spread-{i:02d}", labels={"tier": "spread"},
-                            spread=True))
-    sched.run_until_idle()
-    # phase C — preemption sweep: the 4 gold nodes are saturated by
-    # low-priority pods; high-priority pods restricted to the pool must
-    # dry-run victims on the sharded blobs, nominate, and bind after the
-    # victims vacate
-    for i in range(8):
-        hub.create_pod(_pod(f"low-{i}", cpu="1800m", priority=0,
-                            selector={"pool": "gold"}))
-    sched.run_until_idle()
-    for i in range(4):
-        hub.create_pod(_pod(f"high-{i}", cpu="1800m", priority=100,
-                            selector={"pool": "gold"}))
-    for _ in range(6):
-        sched.run_until_idle()
-        clock.tick(3.0)
-        sched.queue.flush_backoff_completed()
-    sched.run_until_idle()
-    return {p.metadata.name: p.spec.node_name
-            for p in hub.list_pods()}, sched
+    """The shared scenario driver at 1k-node scale: 64 auction pods, 16
+    anti-affinity + 16 spread topology pods, 8 fillers saturating a
+    4-node gold pool, 4 preemptors."""
+    return drive_production_scenario(
+        mesh, n_nodes, Capacities(nodes=n_nodes, pods=512),
+        zones=8, gold_nodes=4, plain=64, anti=16, spread=16, low=8,
+        high=4, batch_size=16, drain_rounds=6)
 
 
 def test_mesh_survives_capacity_growth():
@@ -205,13 +108,13 @@ def test_mesh_survives_capacity_growth():
     cfg.async_binding = False
     mesh = Mesh(np.asarray(jax.devices()[:N_DEV]), ("nodes",))
     sched = Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64),
-                      now=_Clock().now, mesh=mesh)
+                      mesh=mesh)
     # 40 nodes overflow the 16-row bucket: sync raises CapacityError and
     # _grow re-buckets the mirror mid-dispatch
     for i in range(40):
-        hub.create_node(_node(i, zone=f"z{i % 2}"))
+        hub.create_node(parity_node(i, zone=f"z{i % 2}"))
     for i in range(8):
-        hub.create_pod(_pod(f"p-{i}"))
+        hub.create_pod(parity_pod(f"p-{i}"))
     sched.run_until_idle()
     assert sched.caps.nodes >= 40
     assert sched.mirror.mesh is mesh
