@@ -1,8 +1,9 @@
-"""Headline benchmark: production-path scheduling throughput, 5 workloads.
+"""Headline benchmark: production-path scheduling throughput, 21 workloads.
 
-Drives the 5 BASELINE workloads (scheduler_perf shapes: SchedulingBasic,
-SchedulingNodeAffinity, SchedulingPodAntiAffinity, TopologySpreading,
-PreemptionAsync) through the PRODUCTION Scheduler loop — pods created via
+Drives EVERY thresholded reference scheduler_perf workload (BASELINE.md's
+full table: the 5 BASELINE.json headliners plus the affinity, spreading,
+churn, gated, daemonset, unschedulable and DRA shapes) through the
+PRODUCTION Scheduler loop — pods created via
 hub.create_pod, popped from the PriorityQueue, packed into the HBM mirror,
 scheduled by the fused device pipeline, committed through the framework's
 reserve/permit/bind points, bindings written to the hub — exactly the path
@@ -39,6 +40,22 @@ BENCH_WORKLOAD_FNS = (
     "scheduling_pod_anti_affinity",
     "topology_spreading",
     "preemption_async",
+    "unschedulable",
+    "unschedulable_qhints",
+    "mixed_churn",
+    "scheduling_daemonset",
+    "scheduling_while_gated",
+    "preferred_pod_affinity",
+    "preferred_pod_anti_affinity",
+    "ns_selector_anti_affinity",
+    "dra_steady_state",
+    "scheduling_pod_affinity",
+    "mixed_scheduling_base_pod",
+    "ns_selector_pod_affinity",
+    "ns_selector_preferred_affinity",
+    "gated_pods_with_pod_affinity",
+    "preferred_topology_spreading",
+    "scheduling_with_node_inclusion_policy",
 )
 
 
@@ -69,6 +86,8 @@ def main() -> None:
               f"(threshold {r['threshold']}, warm {r.get('warm_s')}s, "
               f"run {r.get('run_s')}s)", file=sys.stderr)
         short = r["name"].split("/")[0]
+        if short in results:
+            short = r["name"]   # variant rows (e.g. _QueueingHintsEnabled)
         results[short] = {k: r[k] for k in (
             "name", "pods_per_sec", "threshold", "vs_baseline", "passed",
             "pods_scheduled", "elapsed_s", "p50", "p90", "p95", "p99",

@@ -114,6 +114,9 @@ class Workload:
     # arg) tracks the number of distinct domains = nodes, so a scaled-down
     # warmup would compile the wrong program; keep CreateNodes unscaled
     warm_full_nodes: bool = False
+    # featureGates overrides for this workload (the reference per-workload
+    # featureGates block), merged onto the scheduler config's gates
+    feature_gates: dict = field(default_factory=dict)
 
     def __post_init__(self) -> None:
         if not self.baseline:
@@ -192,6 +195,7 @@ def run_workload(w: Workload, now: Callable[[], float] = time.time,
     hub = Hub()
     cfg = copy.deepcopy(config) if config is not None else default_config()
     cfg.batch_size = w.batch_size
+    cfg.feature_gates.update(w.feature_gates)
     sched = Scheduler(hub, cfg, caps=Capacities(
         nodes=w.node_capacity, pods=w.pod_capacity), now=now)
     churns: list[_ChurnState] = []

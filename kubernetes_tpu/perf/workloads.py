@@ -1,9 +1,12 @@
 """The reference scheduler_perf workloads, mirroring performance-config
 shapes (node/pod templates from test/integration/scheduler_perf/templates;
-op sequences and thresholds from the per-suite performance-config.yaml):
-the 5 BASELINE.json configs bench.py runs, plus Unschedulable,
-SchedulingWithMixedChurn, SchedulingDaemonset, SchedulingWhileGated, and
-the preferred pod-(anti)affinity pair — 11 reference configs total.
+op sequences and thresholds from the per-suite performance-config.yaml).
+Every thresholded row of BASELINE.md is implemented — the 5 BASELINE.json
+headliners plus the affinity suite (required/preferred, NSSelector
+variants, MixedSchedulingBasePod, gated-with-affinity), the topology
+suite (required/preferred spreading, node-inclusion policy), churn,
+daemonset, gated, unschedulable (hints on/off), and DRA steady state —
+21 configs, all run and published by bench.py.
 
 Node template (node-default.yaml): cpu 4, memory 32Gi, pods 110.
 Pod template (pod-default.yaml): requests cpu 100m, memory 500Mi.
@@ -61,14 +64,20 @@ def _pod(name: str, cpu: str = "100m", mem: str = "500Mi",
          namespace: str = "default", labels: dict | None = None,
          affinity: Affinity | None = None, tsc: list | None = None,
          priority: int | None = None) -> Pod:
+    # cpu/mem "0" = a request-less pod (pod-with-label.yaml: fit consumes
+    # only a pod slot; scoring sees the NonZeroRequested defaults)
+    requests = {}
+    if cpu != "0":
+        requests["cpu"] = cpu
+    if mem != "0":
+        requests["memory"] = mem
     return Pod(
         metadata=ObjectMeta(name=name, namespace=namespace,
                             labels=labels or {}),
         spec=PodSpec(
             containers=[Container(
                 name="pause",
-                resources=ResourceRequirements(
-                    requests={"cpu": cpu, "memory": mem}))],
+                resources=ResourceRequirements(requests=requests))],
             affinity=affinity,
             topology_spread_constraints=tsc or [],
             priority=priority))
@@ -224,6 +233,9 @@ def unschedulable(init_nodes=5000, init_pods=100,
     return Workload(
         name="Unschedulable/5kNodes_100Init_10kPods",
         threshold=140,
+        # the 140 floor is the reference's hints-OFF row
+        # (misc/performance-config.yaml:315); the QHints variant re-enables
+        feature_gates={"SchedulerQueueingHints": False},
         ops=[
             CreateNodes(init_nodes, _node),
             CreatePods(init_pods, lambda i: _pod(f"init-{i}")),
@@ -480,21 +492,340 @@ def dra_steady_state(init_nodes=100, measure_pods=500) -> Workload:
         ])
 
 
-# the 5 BASELINE.json configs bench.py runs within the driver's budget
-# (bench.py shells out per workload and mirrors these BY NAME in its
-# BENCH_WORKLOAD_FNS — tests/test_perf_harness.py asserts the two stay
-# in sync)
+# -------------------------------------- 14. SchedulingPodAffinity
+# affinity/performance-config.yaml:83-148 (5000Nodes_5000Pods, 35 — the
+# reference's SLOWEST headline shape): every node in ONE zone; init and
+# measured pods carry required zone-level podAffinity on color=blue
+# across namespaces sched-0/sched-1 (pod-with-pod-affinity.yaml), so
+# every placement updates the single shared affinity domain.
+
+def _pod_affinity_pod(i: int, ns: str) -> Pod:
+    aff = Affinity(pod_affinity=PodAffinity(required=[
+        PodAffinityTerm(
+            topology_key=LABEL_ZONE,
+            label_selector=LabelSelector(match_labels={"color": "blue"}),
+            namespaces=["sched-1", "sched-0"])]))
+    return _pod(f"aff-{ns}-{i}", namespace=ns, labels={"color": "blue"},
+                affinity=aff)
+
+
+def scheduling_pod_affinity(init_nodes=5000, init_pods=5000,
+                            measure_pods=5000) -> Workload:
+    return Workload(
+        name="SchedulingPodAffinity/5000Nodes_5000Pods",
+        threshold=35,
+        pod_capacity=32768,
+        ops=[
+            CreateNodes(init_nodes, lambda i: _node(i, zones=["zone1"])),
+            CreateNamespaces("sched", 2),
+            CreatePods(init_pods,
+                       lambda i: _pod_affinity_pod(i, "sched-0")),
+            CreatePods(measure_pods,
+                       lambda i: _pod_affinity_pod(i, "sched-1"),
+                       collect_metrics=True),
+        ])
+
+
+# -------------------------------------- 15. MixedSchedulingBasePod
+# affinity/performance-config.yaml:338-418 (5000Nodes_5000Pods, 140):
+# one zone; 2000 init pods of EACH of five templates — plain, required
+# zone affinity (blue), required hostname anti-affinity (green),
+# preferred hostname affinity (red), preferred hostname anti-affinity
+# (yellow) — then 5000 plain measured pods scored against that mixture.
+
+def _mixed_init_pod(i: int) -> Pod:
+    kind = i % 5
+    j = i // 5
+    if kind == 0:
+        return _pod(f"mix-plain-{j}", namespace="sched-0")
+    if kind == 1:
+        aff = Affinity(pod_affinity=PodAffinity(required=[
+            PodAffinityTerm(
+                topology_key=LABEL_ZONE,
+                label_selector=LabelSelector(
+                    match_labels={"color": "blue"}),
+                namespaces=["sched-1", "sched-0"])]))
+        return _pod(f"mix-aff-{j}", namespace="sched-0",
+                    labels={"color": "blue"}, affinity=aff)
+    if kind == 2:
+        aff = Affinity(pod_anti_affinity=PodAntiAffinity(required=[
+            PodAffinityTerm(
+                topology_key=LABEL_HOSTNAME,
+                label_selector=LabelSelector(
+                    match_labels={"color": "green"}),
+                namespaces=["sched-1", "sched-0"])]))
+        return _pod(f"mix-anti-{j}", namespace="sched-0",
+                    labels={"color": "green"}, affinity=aff)
+    term = WeightedPodAffinityTerm(weight=1, pod_affinity_term=(
+        PodAffinityTerm(
+            topology_key=LABEL_HOSTNAME,
+            label_selector=LabelSelector(match_labels={
+                "color": "red" if kind == 3 else "yellow"}),
+            namespaces=["sched-1", "sched-0"])))
+    if kind == 3:
+        aff = Affinity(pod_affinity=PodAffinity(preferred=[term]))
+        return _pod(f"mix-paff-{j}", namespace="sched-0",
+                    labels={"color": "red"}, affinity=aff)
+    aff = Affinity(pod_anti_affinity=PodAntiAffinity(preferred=[term]))
+    return _pod(f"mix-panti-{j}", namespace="sched-0",
+                labels={"color": "yellow"}, affinity=aff)
+
+
+def mixed_scheduling_base_pod(init_nodes=5000, init_pods_each=2000,
+                              measure_pods=5000) -> Workload:
+    return Workload(
+        name="MixedSchedulingBasePod/5000Nodes_5000Pods",
+        threshold=140,
+        pod_capacity=32768,
+        warm_full_nodes=True,   # hostname terms: domains = nodes
+        ops=[
+            CreateNodes(init_nodes, lambda i: _node(i, zones=["zone1"])),
+            CreateNamespaces("sched", 1),
+            CreatePods(init_pods_each * 5, _mixed_init_pod),
+            CreatePods(measure_pods,
+                       lambda i: _pod(f"measure-{i}", namespace="sched-0"),
+                       collect_metrics=True),
+        ])
+
+
+# ------------------ 16. RequiredPodAffinityWithNSSelector
+# affinity/performance-config.yaml:574-648 (5000Nodes_2000Pods, 35):
+# one zone (labelNodePrepareStrategy zone1); 100 team=devops namespaces
+# x 50 init pods; measured pods carry required zone-level podAffinity
+# whose namespaceSelector picks team=devops — every placement feeds the
+# one shared domain through namespace-unrolled terms.
+
+def _ns_selector_aff_pod(i: int, ns: str) -> Pod:
+    aff = Affinity(pod_affinity=PodAffinity(required=[
+        PodAffinityTerm(
+            topology_key=LABEL_ZONE,
+            label_selector=LabelSelector(match_labels={"color": "blue"}),
+            namespace_selector=LabelSelector(
+                match_labels={"team": "devops"}))]))
+    return _pod(f"nsaff-{ns}-{i}", namespace=ns, labels={"color": "blue"},
+                affinity=aff)
+
+
+def ns_selector_pod_affinity(init_nodes=5000, init_namespaces=100,
+                             init_pods_per_ns=50,
+                             measure_pods=2000) -> Workload:
+    return Workload(
+        name="SchedulingRequiredPodAffinityWithNSSelector"
+             "/5000Nodes_2000Pods",
+        threshold=35,
+        pod_capacity=32768,
+        ops=[
+            CreateNodes(init_nodes, lambda i: _node(i, zones=["zone1"])),
+            CreateNamespaces("init-ns", init_namespaces,
+                             labels=lambda i: {"team": "devops"}),
+            CreateNamespaces("measure-ns", 1,
+                             labels=lambda i: {"team": "devops"}),
+            CreatePods(init_namespaces * init_pods_per_ns,
+                       lambda i: _ns_selector_aff_pod(
+                           i, f"init-ns-{i % init_namespaces}")),
+            CreatePods(measure_pods,
+                       lambda i: _ns_selector_aff_pod(
+                           i + 10**6, "measure-ns-0"),
+                       collect_metrics=True),
+        ])
+
+
+# ------------------ 17. PreferredAffinityWithNSSelector
+# affinity/performance-config.yaml:650-728 (5000Nodes_5000Pods, 90):
+# same namespace layout; measured pods carry a weight-1 PREFERRED
+# hostname affinity (red) with the devops namespaceSelector — pure Score
+# work over namespace-unrolled terms.
+
+def _ns_selector_pref_pod(i: int, ns: str) -> Pod:
+    term = WeightedPodAffinityTerm(weight=1, pod_affinity_term=(
+        PodAffinityTerm(
+            topology_key=LABEL_HOSTNAME,
+            label_selector=LabelSelector(match_labels={"color": "red"}),
+            namespace_selector=LabelSelector(
+                match_labels={"team": "devops"}))))
+    aff = Affinity(pod_affinity=PodAffinity(preferred=[term]))
+    return _pod(f"nspref-{ns}-{i}", namespace=ns, labels={"color": "red"},
+                affinity=aff)
+
+
+def ns_selector_preferred_affinity(init_nodes=5000, init_namespaces=100,
+                                   init_pods_per_ns=50,
+                                   measure_pods=5000) -> Workload:
+    return Workload(
+        name="SchedulingPreferredAffinityWithNSSelector"
+             "/5000Nodes_5000Pods",
+        threshold=90,
+        pod_capacity=32768,
+        warm_full_nodes=True,   # hostname topology: domains = nodes
+        ops=[
+            CreateNodes(init_nodes, _node),
+            CreateNamespaces("init-ns", init_namespaces,
+                             labels=lambda i: {"team": "devops"}),
+            CreateNamespaces("measure-ns", 1,
+                             labels=lambda i: {"team": "devops"}),
+            CreatePods(init_namespaces * init_pods_per_ns,
+                       lambda i: _ns_selector_pref_pod(
+                           i, f"init-ns-{i % init_namespaces}")),
+            CreatePods(measure_pods,
+                       lambda i: _ns_selector_pref_pod(
+                           i + 10**6, "measure-ns-0"),
+                       collect_metrics=True),
+        ])
+
+
+# ---------- 18. SchedulingGatedPodsWithPodAffinityImpactForThroughput
+# affinity/performance-config.yaml:731-800 (1Node_10000GatedPods, 110):
+# 10k gated pods carrying required hostname affinity on the measured
+# pods' label park in the gated pool; 20k app=scheduler-perf pods then
+# bind to the single 90000-pod node (node-with-name.yaml). Every bind
+# fires an AssignedPodAdd the gated pods' affinity COULD match — the
+# throughput must survive the event volume (the park-index discipline).
+
+def _gated_affinity_pod(i: int) -> Pod:
+    from kubernetes_tpu.api.objects import PodSchedulingGate
+
+    aff = Affinity(pod_affinity=PodAffinity(required=[
+        PodAffinityTerm(
+            topology_key=LABEL_HOSTNAME,
+            label_selector=LabelSelector(
+                match_labels={"app": "scheduler-perf"}))]))
+    p = _pod(f"gated-{i}", cpu="0", mem="0",
+             labels={"app": "scheduler-perf"}, affinity=aff)
+    p.spec.scheduling_gates = [PodSchedulingGate(name="scheduling-gate-1")]
+    return p
+
+
+def _perf_node(i: int) -> Node:
+    name = "scheduler-perf-node"
+    return Node(
+        metadata=ObjectMeta(name=name, labels={LABEL_HOSTNAME: name}),
+        spec=NodeSpec(),
+        status=NodeStatus(allocatable={
+            "cpu": "4", "memory": "32Gi", "pods": "90000"}))
+
+
+def gated_pods_with_pod_affinity(gated_pods=10000,
+                                 measure_pods=20000) -> Workload:
+    return Workload(
+        name="SchedulingGatedPodsWithPodAffinityImpactForThroughput"
+             "/1Node_10000GatedPods",
+        threshold=110,
+        node_capacity=64,
+        pod_capacity=65536,
+        batch_size=4096,
+        ops=[
+            CreateNodes(1, _perf_node),
+            CreatePods(gated_pods, _gated_affinity_pod, wait=False),
+            CreatePods(measure_pods,
+                       lambda i: _pod(f"measure-{i}", cpu="0", mem="0",
+                                      labels={"app": "scheduler-perf"}),
+                       collect_metrics=True),
+        ])
+
+
+# ------------------------------ 19. PreferredTopologySpreading
+# topology_spreading/performance-config.yaml:83-145 (5000Nodes_5000Pods,
+# 125): three zones; measured pods carry a maxSkew=5 ScheduleAnyway zone
+# constraint (pod-with-preferred-topology-spreading.yaml) — the SOFT
+# spread Score path rather than the DoNotSchedule Filter.
+
+def _preferred_spreading_pod(i: int) -> Pod:
+    return _pod(f"pspread-{i}", labels={"color": "blue"}, tsc=[
+        TopologySpreadConstraint(
+            max_skew=5, topology_key=LABEL_ZONE,
+            when_unsatisfiable="ScheduleAnyway",
+            label_selector=LabelSelector(match_labels={"color": "blue"}))])
+
+
+def preferred_topology_spreading(init_nodes=5000, init_pods=5000,
+                                 measure_pods=5000) -> Workload:
+    return Workload(
+        name="PreferredTopologySpreading/5000Nodes_5000Pods",
+        threshold=125,
+        pod_capacity=32768,
+        ops=[
+            CreateNodes(init_nodes, lambda i: _node(
+                i, zones=["moon-1", "moon-2", "moon-3"])),
+            CreatePods(init_pods, lambda i: _pod(f"init-{i}")),
+            CreatePods(measure_pods, _preferred_spreading_pod,
+                       collect_metrics=True),
+        ])
+
+
+# --------------------------- 20. SchedulingWithNodeInclusionPolicy
+# topology_spreading/performance-config.yaml:210-273 (5000Nodes, 68):
+# 4000 normal + 1000 tainted (foo:NoSchedule) nodes; measured pods carry
+# a hostname DoNotSchedule spread with Honor/Honor inclusion policies
+# (pod-with-node-inclusion-policy.yaml), so tainted nodes drop out of
+# both the domain set and the skew accounting.
+
+def _tainted_node(i: int) -> Node:
+    from kubernetes_tpu.api.objects import Taint
+
+    name = f"taint-node-{i}"
+    return Node(
+        metadata=ObjectMeta(name=name, labels={LABEL_HOSTNAME: name}),
+        spec=NodeSpec(taints=[Taint(key="foo", value="",
+                                    effect="NoSchedule")]),
+        status=NodeStatus(allocatable={
+            "cpu": "4", "memory": "32Gi", "pods": "110"}))
+
+
+def _inclusion_policy_pod(i: int) -> Pod:
+    from kubernetes_tpu.api.objects import POLICY_HONOR
+
+    return _pod(f"incl-{i}", labels={"foo": "bar"}, tsc=[
+        TopologySpreadConstraint(
+            max_skew=1, topology_key=LABEL_HOSTNAME,
+            when_unsatisfiable="DoNotSchedule",
+            node_affinity_policy=POLICY_HONOR,
+            node_taints_policy=POLICY_HONOR,
+            label_selector=LabelSelector(match_labels={"foo": "bar"}))])
+
+
+def scheduling_with_node_inclusion_policy(normal_nodes=4000,
+                                          taint_nodes=1000,
+                                          measure_pods=4000) -> Workload:
+    return Workload(
+        name="SchedulingWithNodeInclusionPolicy/5000Nodes",
+        threshold=68,
+        pod_capacity=16384,
+        warm_full_nodes=True,   # hostname topology: domains = nodes
+        ops=[
+            CreateNodes(normal_nodes, _node),
+            CreateNodes(taint_nodes, _tainted_node),
+            CreatePods(measure_pods, _inclusion_policy_pod,
+                       collect_metrics=True),
+        ])
+
+
+# ------------------------------ 21. Unschedulable (QHints enabled)
+# misc/performance-config.yaml:324 (170 with SchedulerQueueingHints):
+# same shape as Unschedulable, floor raised — the hints must prove they
+# keep the parked 9-CPU pods from re-entering on irrelevant events.
+
+def unschedulable_qhints(init_nodes=5000, init_pods=100,
+                         measure_pods=10000) -> Workload:
+    w = unschedulable(init_nodes, init_pods, measure_pods)
+    w.name = "Unschedulable/5kNodes_100Init_10kPods_QueueingHintsEnabled"
+    w.threshold = w.baseline = 170
+    w.feature_gates = {"SchedulerQueueingHints": True}
+    return w
+
+
+# every thresholded reference workload — bench.py runs the whole list,
+# one subprocess each, and publishes every row in its JSON (bench.py
+# mirrors these BY NAME in BENCH_WORKLOAD_FNS —
+# tests/test_perf_harness.py asserts the two stay in sync). The first
+# five are the BASELINE.json headline configs.
 BENCH_WORKLOADS = (
     scheduling_basic,
     scheduling_node_affinity,
     scheduling_pod_anti_affinity,
     topology_spreading,
     preemption_async,
-)
-
-# the full suite (python -c "...run any of these on demand")
-ALL_WORKLOADS = BENCH_WORKLOADS + (
     unschedulable,
+    unschedulable_qhints,
     mixed_churn,
     scheduling_daemonset,
     scheduling_while_gated,
@@ -502,4 +833,13 @@ ALL_WORKLOADS = BENCH_WORKLOADS + (
     preferred_pod_anti_affinity,
     ns_selector_anti_affinity,
     dra_steady_state,
+    scheduling_pod_affinity,
+    mixed_scheduling_base_pod,
+    ns_selector_pod_affinity,
+    ns_selector_preferred_affinity,
+    gated_pods_with_pod_affinity,
+    preferred_topology_spreading,
+    scheduling_with_node_inclusion_policy,
 )
+
+ALL_WORKLOADS = BENCH_WORKLOADS

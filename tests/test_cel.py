@@ -1,0 +1,95 @@
+"""CEL-subset evaluator (utils/cel.py) vs the reference's DRA selector
+expressions (dra/templates/resourceclaim-with-selector.yaml,
+deviceclass.yaml; cel-go semantics for the covered subset)."""
+
+import pytest
+
+from kubernetes_tpu.utils.cel import CelDevice, CelError, evaluate
+
+
+def dev(driver="test-driver.cdi.k8s.io", attributes=None, capacity=None):
+    return CelDevice(driver, attributes or {}, capacity or {})
+
+
+def test_driver_equality():
+    d = dev()
+    assert evaluate('device.driver == "test-driver.cdi.k8s.io"', d)
+    assert not evaluate('device.driver == "other"', d)
+    assert evaluate("device.driver != 'other'", d)
+
+
+def test_bool_attribute():
+    d = dev(attributes={"preallocate": True})
+    assert evaluate(
+        "device.attributes['test-driver.cdi.k8s.io'].preallocate", d)
+    d2 = dev(attributes={"preallocate": False})
+    assert not evaluate(
+        "device.attributes['test-driver.cdi.k8s.io'].preallocate", d2)
+    assert evaluate(
+        "!device.attributes['test-driver.cdi.k8s.io'].preallocate", d2)
+
+
+def test_qualified_attribute_domains():
+    d = dev(attributes={"dra.example.com/slice": 7, "model": "a100"})
+    assert evaluate("device.attributes['dra.example.com'].slice == 7", d)
+    # plain names live under the driver's own domain
+    assert evaluate(
+        "device.attributes['test-driver.cdi.k8s.io'].model == 'a100'", d)
+
+
+def test_capacity_compare_to_quantity():
+    d = dev(capacity={"counters": "2"})
+    expr = ("device.capacity['test-driver.cdi.k8s.io'].counters"
+            ".compareTo(quantity('2')) >= 0")
+    assert evaluate(expr, d)
+    d_small = dev(capacity={"counters": "1"})
+    assert not evaluate(expr, d_small)
+    d_gi = dev(capacity={"mem": "2Gi"})
+    assert evaluate("device.capacity['test-driver.cdi.k8s.io'].mem"
+                    ".compareTo(quantity('1Gi')) > 0", d_gi)
+
+
+def test_reference_selector_expression_verbatim():
+    # resourceclaim-with-selector.yaml's exact two-line expression
+    expr = ("device.capacity['test-driver.cdi.k8s.io'].counters"
+            ".compareTo(quantity('2')) >= 0 &&\n"
+            "device.attributes['test-driver.cdi.k8s.io'].preallocate")
+    good = dev(attributes={"preallocate": True},
+               capacity={"counters": "2"})
+    bad = dev(attributes={"preallocate": False},
+              capacity={"counters": "2"})
+    assert evaluate(expr, good)
+    assert not evaluate(expr, bad)
+
+
+def test_boolean_operators():
+    d = dev(attributes={"a": True, "b": False})
+    dom = "device.attributes['test-driver.cdi.k8s.io']"
+    assert evaluate(f"{dom}.a || {dom}.b", d)
+    assert not evaluate(f"{dom}.a && {dom}.b", d)
+    assert evaluate(f"{dom}.a && !{dom}.b", d)
+
+
+def test_int_and_string_comparisons():
+    d = dev(attributes={"gen": 3, "family": "tpu-v5e"})
+    dom = "device.attributes['test-driver.cdi.k8s.io']"
+    assert evaluate(f"{dom}.gen >= 2", d)
+    assert not evaluate(f"{dom}.gen > 3", d)
+    assert evaluate(f"{dom}.family.startsWith('tpu')", d)
+    assert evaluate(f"{dom}.family.matches('v5e$')", d)
+
+
+def test_errors_raise_cel_error():
+    d = dev()
+    with pytest.raises(CelError):
+        evaluate("import os", d)
+    with pytest.raises(CelError):
+        evaluate("__import__('os')", d)
+    with pytest.raises(CelError):
+        evaluate("device.__class__", d)
+    with pytest.raises(CelError):
+        evaluate("device.attributes['x'].missing", d)
+    with pytest.raises(CelError):
+        evaluate("(lambda: 1)()", d)
+    with pytest.raises(CelError):
+        evaluate("device.driver == ", d)
