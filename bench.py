@@ -1,4 +1,4 @@
-"""Headline benchmark: production-path scheduling throughput, 21 workloads.
+"""Headline benchmark: production-path scheduling throughput, 22 workloads.
 
 Drives EVERY thresholded reference scheduler_perf workload (BASELINE.md's
 full table: the 5 BASELINE.json headliners plus the affinity, spreading,
@@ -49,6 +49,7 @@ BENCH_WORKLOAD_FNS = (
     "preferred_pod_anti_affinity",
     "ns_selector_anti_affinity",
     "dra_steady_state",
+    "dra_steady_state_templates",
     "scheduling_pod_affinity",
     "mixed_scheduling_base_pod",
     "ns_selector_pod_affinity",

@@ -282,6 +282,10 @@ class PodStatus:
     phase: str = PHASE_PENDING
     conditions: list[PodCondition] = field(default_factory=list)
     nominated_node_name: str = ""
+    # pod.status.resourceClaimStatuses: generated claim name per
+    # resourceClaimTemplateName entry (written by the resourceclaim
+    # controller, read by the DRA plugin's claim-ref resolution)
+    resource_claim_statuses: dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
@@ -522,19 +526,62 @@ class StorageClass:
 @dataclass
 class PodResourceClaim:
     """pod.spec.resourceClaims entry: a named reference to a
-    ResourceClaim the containers can then request by name."""
+    ResourceClaim — direct by name, or via a ResourceClaimTemplate the
+    resourceclaim controller instantiates per pod."""
 
     name: str
     resource_claim_name: str = ""
+    resource_claim_template_name: str = ""
 
 
 @dataclass
-class DeviceRequest:
-    """resourceclaim.spec.devices.requests entry (exactly-count mode)."""
+class DeviceSelector:
+    """resourceclaim selectors entry: a CEL expression over one device's
+    driver/attributes/capacity (resource.k8s.io CELDeviceSelector;
+    evaluated by utils.cel)."""
+
+    cel_expression: str = ""
+
+
+ALLOCATION_MODE_EXACT = "ExactCount"
+ALLOCATION_MODE_ALL = "All"
+
+
+@dataclass
+class DeviceSubRequest:
+    """One alternative of a firstAvailable request (DRAPrioritizedList):
+    tried in order, first satisfiable wins."""
 
     name: str
     device_class_name: str = ""
     count: int = 1
+    allocation_mode: str = ALLOCATION_MODE_EXACT
+    selectors: list[DeviceSelector] = field(default_factory=list)
+
+
+@dataclass
+class DeviceRequest:
+    """resourceclaim.spec.devices.requests entry: ExactCount/All modes,
+    CEL selectors, adminAccess, or a firstAvailable alternatives list
+    (exactly one of deviceClassName / firstAvailable is set)."""
+
+    name: str
+    device_class_name: str = ""
+    count: int = 1
+    allocation_mode: str = ALLOCATION_MODE_EXACT
+    selectors: list[DeviceSelector] = field(default_factory=list)
+    admin_access: bool = False
+    first_available: list[DeviceSubRequest] = field(default_factory=list)
+
+
+@dataclass
+class DeviceConstraint:
+    """spec.devices.constraints entry: all devices allocated for the
+    listed requests (all requests when empty) must carry the SAME value
+    of match_attribute."""
+
+    requests: list[str] = field(default_factory=list)
+    match_attribute: str = ""
 
 
 @dataclass
@@ -543,6 +590,7 @@ class DeviceAllocationResult:
     driver: str = ""
     pool: str = ""
     device: str = ""
+    admin_access: bool = False
 
 
 @dataclass
@@ -560,6 +608,7 @@ class ResourceClaimStatus:
 @dataclass
 class ResourceClaimSpec:
     device_requests: list[DeviceRequest] = field(default_factory=list)
+    constraints: list[DeviceConstraint] = field(default_factory=list)
 
 
 @dataclass
@@ -578,9 +627,28 @@ class ResourceClaim:
 
 
 @dataclass
+class ResourceClaimTemplate:
+    """resource.k8s.io ResourceClaimTemplate: the spec stamped into a
+    fresh per-pod ResourceClaim by the resourceclaim controller."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceClaimSpec = field(default_factory=ResourceClaimSpec)
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+@dataclass
 class Device:
+    """One device in a ResourceSlice: attributes (bool/int/string values,
+    optionally 'domain/name'-qualified keys) + capacity quantities feed
+    CEL selectors; device_class_name is the legacy direct-match shortcut
+    kept for slices that publish pre-classified devices."""
+
     name: str
     device_class_name: str = ""
+    attributes: dict[str, object] = field(default_factory=dict)
+    capacity: dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
@@ -597,7 +665,11 @@ class ResourceSlice:
 
 @dataclass
 class DeviceClass:
+    """resource.k8s.io DeviceClass: CEL selectors over devices; a request
+    naming this class matches the devices its selectors accept."""
+
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selectors: list[DeviceSelector] = field(default_factory=list)
 
 
 # --- priority class ------------------------------------------------------------------

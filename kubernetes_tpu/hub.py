@@ -79,6 +79,10 @@ class Hub:
         self.leases = LeaseStore()
         self._slices = _Store("ResourceSlice")
         self._claim_by_key: dict[str, str] = {}
+        self._claim_templates = _Store("ResourceClaimTemplate")
+        self._template_by_key: dict[str, str] = {}
+        self._device_classes = _Store("DeviceClass")
+        self._device_class_by_name: dict[str, str] = {}
 
     # ------------- watch registration -------------
 
@@ -231,6 +235,19 @@ class Hub:
             ] + [condition]
             if nominated_node is not None:
                 new.status.nominated_node_name = nominated_node
+            self._swap_pod(stored, new)
+        self._dispatch(self._pods, "update", stored, new)
+
+    def set_pod_claim_statuses(self, uid: str,
+                               statuses: dict[str, str]) -> None:
+        """Record generated-claim names on pod.status.resourceClaimStatuses
+        (the resourceclaim controller's status patch)."""
+        with self._lock:
+            stored = self._pods.objects.get(uid)
+            if stored is None:
+                return
+            new = stored.clone()
+            new.status.resource_claim_statuses = dict(statuses)
             self._swap_pod(stored, new)
         self._dispatch(self._pods, "update", stored, new)
 
@@ -410,6 +427,38 @@ class Hub:
     def list_resource_slices(self) -> list[ResourceSlice]:
         with self._lock:
             return list(self._slices.objects.values())
+
+    def watch_resource_claim_templates(self, h: EventHandlers,
+                                       replay: bool = True) -> None:
+        with self._lock:
+            self._claim_templates.handlers.append(h)
+            if replay and h.on_add:
+                for o in list(self._claim_templates.objects.values()):
+                    h.on_add(o)
+
+    def create_resource_claim_template(self, t) -> None:
+        with self._lock:
+            self._template_by_key[t.key()] = t.metadata.uid
+        self._create(self._claim_templates, t)
+
+    def get_resource_claim_template(self, namespace: str, name: str):
+        with self._lock:
+            uid = self._template_by_key.get(f"{namespace}/{name}")
+            return self._claim_templates.objects.get(uid) if uid else None
+
+    def create_device_class(self, dc) -> None:
+        with self._lock:
+            self._device_class_by_name[dc.metadata.name] = dc.metadata.uid
+        self._create(self._device_classes, dc)
+
+    def get_device_class(self, name: str):
+        with self._lock:
+            uid = self._device_class_by_name.get(name)
+            return self._device_classes.objects.get(uid) if uid else None
+
+    def list_device_classes(self) -> list:
+        with self._lock:
+            return list(self._device_classes.objects.values())
 
     # ------------- priority classes -------------
 

@@ -117,6 +117,10 @@ class Workload:
     # featureGates overrides for this workload (the reference per-workload
     # featureGates block), merged onto the scheduler config's gates
     feature_gates: dict = field(default_factory=dict)
+    # run a ResourceClaimController against the hub (the reference's
+    # resourceclaim controller runs in kube-controller-manager): needed by
+    # claim-TEMPLATE workloads, whose claims the controller materializes
+    dra_claim_controller: bool = False
 
     def __post_init__(self) -> None:
         if not self.baseline:
@@ -193,6 +197,10 @@ def run_workload(w: Workload, now: Callable[[], float] = time.time,
     the XLA compile cache for the real one.
     """
     hub = Hub()
+    if w.dra_claim_controller:
+        from kubernetes_tpu.plugins.dra import ResourceClaimController
+
+        ResourceClaimController(hub)
     cfg = copy.deepcopy(config) if config is not None else default_config()
     cfg.batch_size = w.batch_size
     cfg.feature_gates.update(w.feature_gates)

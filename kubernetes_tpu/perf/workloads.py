@@ -5,8 +5,9 @@ Every thresholded row of BASELINE.md is implemented — the 5 BASELINE.json
 headliners plus the affinity suite (required/preferred, NSSelector
 variants, MixedSchedulingBasePod, gated-with-affinity), the topology
 suite (required/preferred spreading, node-inclusion policy), churn,
-daemonset, gated, unschedulable (hints on/off), and DRA steady state —
-21 configs, all run and published by bench.py.
+daemonset, gated, unschedulable (hints on/off), and DRA steady state
+(direct claims + claim templates with CEL selectors) — 22 configs, all
+run and published by bench.py.
 
 Node template (node-default.yaml): cpu 4, memory 32Gi, pods 110.
 Pod template (pod-default.yaml): requests cpu 100m, memory 500Mi.
@@ -492,6 +493,73 @@ def dra_steady_state(init_nodes=100, measure_pods=500) -> Workload:
         ])
 
 
+# --------------- 13b. DRA steady-state via claim TEMPLATES + CEL
+# dra/performance-config.yaml SteadyStateClusterClaimTemplate (+
+# resourceclaim-with-selector.yaml): pods reference a
+# ResourceClaimTemplate; the resourceclaim controller stamps a per-pod
+# claim whose request carries a CEL device selector; the structured
+# allocator matches attributes/capacity per device.
+
+def _dra_attr_slice(i: int):
+    from kubernetes_tpu.api.objects import Device, ResourceSlice
+
+    node = f"node-{i}"
+    return ResourceSlice(
+        metadata=ObjectMeta(name=f"slice-{node}"),
+        node_name=node, driver="tpu.example.com", pool=node,
+        devices=[Device(name=f"dev-{d}",
+                        attributes={"preallocate": d % 2 == 0},
+                        capacity={"counters": "2"})
+                 for d in range(8)])
+
+
+def _dra_template(i: int):
+    from kubernetes_tpu.api.objects import (
+        DeviceRequest,
+        DeviceSelector,
+        ResourceClaimSpec,
+        ResourceClaimTemplate,
+    )
+
+    expr = ("device.capacity['tpu.example.com'].counters"
+            ".compareTo(quantity('2')) >= 0 && "
+            "device.attributes['tpu.example.com'].preallocate")
+    return ResourceClaimTemplate(
+        metadata=ObjectMeta(name="perf-claim-template"),
+        spec=ResourceClaimSpec(device_requests=[
+            DeviceRequest(name="accel", selectors=[
+                DeviceSelector(cel_expression=expr)])]))
+
+
+def _dra_template_pod(i: int) -> Pod:
+    from kubernetes_tpu.api.objects import PodResourceClaim
+
+    p = _pod(f"drat-{i}", cpu="100m", mem="200Mi")
+    p.spec.resource_claims = [PodResourceClaim(
+        name="accel", resource_claim_template_name="perf-claim-template")]
+    return p
+
+
+def dra_steady_state_templates(init_nodes=100,
+                               measure_pods=400) -> Workload:
+    return Workload(
+        name="DRASteadyStateClaimTemplates/100Nodes_400Pods",
+        threshold=40,   # dra/performance-config.yaml:97 (template variant)
+        node_capacity=128,
+        pod_capacity=2048,
+        batch_size=256,
+        dra_claim_controller=True,
+        ops=[
+            CreateNodes(init_nodes, _dra_node),
+            CreateObjects(init_nodes, _dra_attr_slice,
+                          create_verb="create_resource_slice"),
+            CreateObjects(1, _dra_template,
+                          create_verb="create_resource_claim_template"),
+            CreatePods(measure_pods, _dra_template_pod,
+                       collect_metrics=True),
+        ])
+
+
 # -------------------------------------- 14. SchedulingPodAffinity
 # affinity/performance-config.yaml:83-148 (5000Nodes_5000Pods, 35 — the
 # reference's SLOWEST headline shape): every node in ONE zone; init and
@@ -833,6 +901,7 @@ BENCH_WORKLOADS = (
     preferred_pod_anti_affinity,
     ns_selector_anti_affinity,
     dra_steady_state,
+    dra_steady_state_templates,
     scheduling_pod_affinity,
     mixed_scheduling_base_pod,
     ns_selector_pod_affinity,

@@ -2,16 +2,21 @@
 
 From-scratch equivalent of the reference's accelerator-scheduling path
 (plugins/dynamicresources/dynamicresources.go:105-888 + the structured
-allocator): pods reference ResourceClaims; DRA drivers publish per-node
-device inventories as ResourceSlices; the plugin
+allocator under staging/src/k8s.io/dynamic-resource-allocation): pods
+reference ResourceClaims; DRA drivers publish per-node device inventories
+as ResourceSlices; the plugin
 
-- PreFilter: resolve the pod's claims (missing claim => unresolvable;
-  no claims => Skip), build the free-device view per node from every
-  other claim's allocation (API truth + the assume overlay),
-- Filter: a node fits iff every unallocated claim can be satisfied from
-  that node's remaining devices, and every ALLOCATED claim is pinned to
-  its allocation's node,
-- Reserve: pick concrete devices on the chosen node and ASSUME the
+- PreFilter: resolve the pod's claims — direct names or per-pod claims
+  generated from ResourceClaimTemplates (pod.status.resourceClaimStatuses
+  written by the ResourceClaimController below) — missing claim =>
+  unresolvable; no claims => Skip; build the free-device view per node
+  from the incremental allocated-device ledger + the assume overlay,
+- Filter: a node fits iff every unallocated claim can be ALLOCATED from
+  that node's remaining devices (structured parameters: per-request CEL
+  selectors + DeviceClass selectors, ExactCount/All modes, firstAvailable
+  alternatives, adminAccess, matchAttribute constraints), and every
+  already-allocated claim is pinned to its allocation's node,
+- Reserve: run the same allocator on the chosen node and ASSUME the
   allocation (assume overlay — the scheduler-side AssumeCache the
   reference keeps for claims), Unreserve reverts,
 - PreBind: write the allocation + reservedFor to the API (hub).
@@ -27,8 +32,10 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from kubernetes_tpu.api.objects import (
+    ALLOCATION_MODE_ALL,
     AllocationResult,
     DeviceAllocationResult,
+    ObjectMeta,
     Pod,
     ResourceClaim,
 )
@@ -39,6 +46,20 @@ from kubernetes_tpu.framework.interface import (
     ReservePlugin,
     Status,
 )
+from kubernetes_tpu.utils.cel import CelDevice, CelError, evaluate
+
+
+def claim_name_for(pod: Pod, ref) -> str:
+    """Resolve a pod.spec.resourceClaims entry to a claim NAME: direct
+    reference, or the controller-generated name for a template reference
+    (pod.status.resourceClaimStatuses, falling back to the deterministic
+    '<pod>-<ref>' convention the controller uses)."""
+    if ref.resource_claim_name:
+        return ref.resource_claim_name
+    if ref.resource_claim_template_name:
+        return (pod.status.resource_claim_statuses.get(ref.name)
+                or f"{pod.metadata.name}-{ref.name}")
+    return ref.name
 
 
 def dra_serial_keys(hub, pod: Pod) -> set[str]:
@@ -56,7 +77,7 @@ def dra_serial_keys(hub, pod: Pod) -> set[str]:
     keys: set[str] = set()
     for ref in pod.spec.resource_claims:
         claim = hub.get_resource_claim(pod.metadata.namespace,
-                                       ref.resource_claim_name)
+                                       claim_name_for(pod, ref))
         if claim is None:
             continue
         keys.add(f"draclaim:{claim.key()}")
@@ -72,13 +93,76 @@ def release_pod_claims(hub, pod: Pod) -> None:
     waiting DRA pods."""
     for ref in pod.spec.resource_claims:
         claim = hub.get_resource_claim(pod.metadata.namespace,
-                                       ref.resource_claim_name)
+                                       claim_name_for(pod, ref))
         if claim is None \
                 or pod.metadata.uid not in claim.status.reserved_for:
             continue
         new = claim.clone()
         new.status.reserved_for.remove(pod.metadata.uid)
         hub.update_resource_claim(new)
+
+
+class ResourceClaimController:
+    """The resourceclaim controller slice this build needs (the reference
+    runs the full version in kube-controller-manager,
+    pkg/controller/resourceclaim): watches pods, stamps a per-pod
+    ResourceClaim out of each referenced ResourceClaimTemplate under the
+    deterministic name '<pod>-<ref>', records the generated names in
+    pod.status.resourceClaimStatuses, and deletes the owned claims when
+    the pod goes away (template-generated claims die with their pod;
+    directly-referenced claims persist)."""
+
+    def __init__(self, hub):
+        from kubernetes_tpu.hub import EventHandlers
+
+        self.hub = hub
+        hub.watch_pods(EventHandlers(on_add=self._on_pod_add,
+                                     on_delete=self._on_pod_delete))
+        # a pod can reference a template created AFTER it (the reference
+        # controller retries via its workqueue): re-stamp waiting pods
+        # when their template appears
+        hub.watch_resource_claim_templates(EventHandlers(
+            on_add=self._on_template_add))
+
+    def _on_template_add(self, tmpl) -> None:
+        for pod in self.hub.list_pods():
+            if any(ref.resource_claim_template_name == tmpl.metadata.name
+                   and pod.metadata.namespace == tmpl.metadata.namespace
+                   for ref in pod.spec.resource_claims):
+                self._on_pod_add(pod)
+
+    def _on_pod_add(self, pod: Pod) -> None:
+        import copy
+
+        statuses: dict[str, str] = {}
+        for ref in pod.spec.resource_claims:
+            if not ref.resource_claim_template_name:
+                continue
+            name = f"{pod.metadata.name}-{ref.name}"
+            tmpl = self.hub.get_resource_claim_template(
+                pod.metadata.namespace, ref.resource_claim_template_name)
+            if tmpl is None:
+                continue    # the template watch re-stamps on its arrival
+            if self.hub.get_resource_claim(pod.metadata.namespace,
+                                           name) is None:
+                self.hub.create_resource_claim(ResourceClaim(
+                    metadata=ObjectMeta(name=name,
+                                        namespace=pod.metadata.namespace),
+                    spec=copy.deepcopy(tmpl.spec)))
+            statuses[ref.name] = name
+        if statuses and pod.status.resource_claim_statuses != statuses:
+            self.hub.set_pod_claim_statuses(pod.metadata.uid, statuses)
+
+    def _on_pod_delete(self, pod: Pod) -> None:
+        for ref in pod.spec.resource_claims:
+            if not ref.resource_claim_template_name:
+                continue
+            name = (pod.status.resource_claim_statuses.get(ref.name)
+                    or f"{pod.metadata.name}-{ref.name}")
+            claim = self.hub.get_resource_claim(pod.metadata.namespace,
+                                                name)
+            if claim is not None:
+                self.hub.delete_resource_claim(claim.metadata.uid)
 
 
 @dataclass
@@ -104,12 +188,127 @@ class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin,
     ASSUMED_KEY = "DynamicResources/assumed"
 
     def __init__(self, hub):
+        import threading
+
+        from kubernetes_tpu.hub import EventHandlers
+
         self.hub = hub
         self.assume = ClaimAssumeCache()
+        # incremental allocated-device ledger + per-node device index,
+        # maintained by claim/slice watch events — replaces the
+        # O(all claims x all slices) rescan per pod that dominated at
+        # reference DRA scale (thousands of slices). _ledger_lock guards
+        # against the binder pool's PreBind claim writes dispatching
+        # concurrently with the loop thread's reads.
+        self._ledger_lock = threading.Lock()
+        self._alloc_of: dict[str, frozenset] = {}   # claim key -> triples
+        self._in_use: dict[tuple, int] = {}         # triple -> refcount
+        self._claim_rv: dict[str, int] = {}         # claim key -> newest rv
+        self._node_devices: dict[str, list] = {}    # node -> [(drv,pool,Device)]
+        self._slice_entries: dict[str, tuple] = {}  # slice uid -> (node, n)
+        hub.watch_resource_claims(EventHandlers(
+            on_add=self._claim_event,
+            on_update=lambda old, new: self._claim_event(new),
+            on_delete=self._claim_removed))
+        hub.watch_resource_slices(EventHandlers(
+            on_add=self._slice_added, on_delete=self._slice_removed))
 
     @staticmethod
     def applies(pod: Pod) -> bool:
         return bool(pod.spec.resource_claims)
+
+    # --- the incremental ledger (claim/slice watch maintenance) ---
+
+    def _apply_triples(self, key: str, triples: frozenset) -> None:
+        """Ledger-lock-held: replace one claim's contribution."""
+        old = self._alloc_of.get(key, frozenset())
+        if old == triples:
+            return
+        for t in old - triples:
+            n = self._in_use.get(t, 0) - 1
+            if n <= 0:
+                self._in_use.pop(t, None)
+            else:
+                self._in_use[t] = n
+        for t in triples - old:
+            self._in_use[t] = self._in_use.get(t, 0) + 1
+        if triples:
+            self._alloc_of[key] = triples
+        else:
+            self._alloc_of.pop(key, None)
+
+    def _claim_event(self, claim: ResourceClaim) -> None:
+        alloc = claim.status.allocation
+        triples = frozenset(
+            (d.driver, d.pool, d.device)
+            for d in (alloc.devices if alloc is not None else ())
+            if not d.admin_access)      # admin access never blocks others
+        key = claim.key()
+        rv = claim.metadata.resource_version
+        with self._ledger_lock:
+            # hub dispatch happens outside the hub lock, so a binder
+            # thread's update and the loop thread's delete can arrive out
+            # of commit order: the rv guard keeps a late update from
+            # resurrecting a deleted claim's devices forever (hub rvs are
+            # globally monotonic, so recreations are covered too)
+            if rv <= self._claim_rv.get(key, -1):
+                return
+            self._claim_rv[key] = rv
+            self._apply_triples(key, triples)
+
+    def _claim_removed(self, claim: ResourceClaim) -> None:
+        key = claim.key()
+        with self._ledger_lock:
+            self._claim_rv[key] = max(claim.metadata.resource_version,
+                                      self._claim_rv.get(key, -1))
+            if len(self._claim_rv) > 100_000:   # bound tombstone growth:
+                # keep the newest half (stale events are short races)
+                keep = sorted(self._claim_rv.items(),
+                              key=lambda kv: kv[1])[50_000:]
+                self._claim_rv = dict(keep)
+            self._apply_triples(key, frozenset())
+
+    def _slice_added(self, sl) -> None:
+        with self._ledger_lock:
+            entries = self._node_devices.setdefault(sl.node_name, [])
+            for dev in sl.devices:
+                entries.append((sl.driver, sl.pool, dev))
+            self._slice_entries[sl.metadata.uid] = (sl.node_name,
+                                                    sl.driver, sl.pool,
+                                                    {d.name
+                                                     for d in sl.devices})
+    def _slice_removed(self, sl) -> None:
+        with self._ledger_lock:
+            meta = self._slice_entries.pop(sl.metadata.uid, None)
+            if meta is None:
+                return
+            node, driver, pool, names = meta
+            self._node_devices[node] = [
+                (drv, pl, dev)
+                for drv, pl, dev in self._node_devices.get(node, [])
+                if not (drv == driver and pl == pool and dev.name in names)]
+
+    def _in_use_view(self, exclude_keys: set[str]) -> set[tuple]:
+        """Triples taken by any claim — ledger truth overlaid with assumed
+        allocations — except the excluded claims'."""
+        with self._ledger_lock:
+            used = {t for t, n in self._in_use.items() if n > 0}
+            base_alloc = dict(self._alloc_of)
+        for key, claim in list(self.assume.allocations.items()):
+            # overlay replaces the stored claim's contribution entirely
+            used -= base_alloc.get(key, frozenset())
+            alloc = claim.status.allocation
+            if alloc is not None and key not in exclude_keys:
+                used |= {(d.driver, d.pool, d.device)
+                         for d in alloc.devices if not d.admin_access}
+        for key in exclude_keys:
+            if key not in self.assume.allocations:
+                used -= base_alloc.get(key, frozenset())
+        return used
+
+    def _devices_on(self, node_name: str) -> list:
+        with self._ledger_lock:
+            return list(self._node_devices.get(node_name, ()))
 
     # --- views through the assume overlay ---
 
@@ -123,54 +322,163 @@ class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin,
     def _pod_claims(self, pod: Pod):
         for ref in pod.spec.resource_claims:
             yield ref, self._claim(pod.metadata.namespace,
-                                   ref.resource_claim_name)
+                                   claim_name_for(pod, ref))
 
-    def _used_devices(self, exclude_keys: set[str]) -> set[tuple]:
-        """(driver, pool, device) triples allocated by ANY claim (API truth
-        overlaid with assumed allocations), except the excluded claims."""
-        used: set[tuple] = set()
-        seen: set[str] = set()
-        for claim in list(self.assume.allocations.values()) \
-                + self.hub.list_resource_claims():
-            if claim.key() in seen:
-                continue
-            seen.add(claim.key())
-            if claim.key() in exclude_keys:
-                continue
-            alloc = claim.status.allocation
-            if alloc is None:
-                continue
-            for d in alloc.devices:
-                used.add((d.driver, d.pool, d.device))
-        return used
+    # --- the structured allocator (the reference's staging allocator) ---
 
-    def _free_by_node(self, exclude_keys: set[str]) -> dict[str, list]:
-        """node -> [(driver, pool, device, device_class)] still free."""
-        used = self._used_devices(exclude_keys)
-        free: dict[str, list] = {}
-        for sl in self.hub.list_resource_slices():
-            for dev in sl.devices:
-                key = (sl.driver, sl.pool, dev.name)
-                if key in used:
-                    continue
-                free.setdefault(sl.node_name, []).append(
-                    (sl.driver, sl.pool, dev.name, dev.device_class_name))
-        return free
-
-    @staticmethod
-    def _satisfiable(claim: ResourceClaim, free_devs: list) -> bool:
-        pool = list(free_devs)
-        for req in claim.spec.device_requests:
-            need = req.count
-            for i in range(len(pool) - 1, -1, -1):
-                if need == 0:
-                    break
-                if pool[i][3] == req.device_class_name:
-                    pool.pop(i)
-                    need -= 1
-            if need > 0:
+    def _device_matches(self, entry, class_name: str, device_class,
+                        selectors) -> bool:
+        """entry = (driver, pool, Device). DeviceClass CEL selectors (or
+        the legacy direct device_class_name match when no class object
+        exists) AND the request's own CEL selectors must all accept.
+        ``device_class`` is the pre-resolved DeviceClass (resolved once
+        per alternative, not per device — the allocator runs this for
+        every device on every candidate node)."""
+        driver, _pool, dev = entry
+        cel_dev = None
+        if class_name:
+            if device_class is not None:
+                cel_dev = CelDevice(driver, dev.attributes, dev.capacity)
+                for sel in device_class.selectors:
+                    try:
+                        if not evaluate(sel.cel_expression, cel_dev):
+                            return False
+                    except CelError:
+                        return False
+            elif dev.device_class_name != class_name:
+                return False
+        for sel in selectors:
+            if cel_dev is None:
+                cel_dev = CelDevice(driver, dev.attributes, dev.capacity)
+            try:
+                if not evaluate(sel.cel_expression, cel_dev):
+                    return False
+            except CelError:
                 return False
         return True
+
+    @staticmethod
+    def _attr_of(entry, attribute: str):
+        """matchAttribute resolution: qualified 'domain/name' keys match
+        directly; plain keys resolve against the device's own driver
+        domain (mirroring utils.cel._DomainMap)."""
+        driver, _pool, dev = entry
+        if attribute in dev.attributes:
+            return dev.attributes[attribute]
+        if "/" in attribute:
+            dom, name = attribute.split("/", 1)
+            if dom == driver:
+                return dev.attributes.get(name)
+        return None
+
+    def allocate_claim(self, claim: ResourceClaim, node_name: str,
+                       in_use: set[tuple]
+                       ) -> Optional[list[DeviceAllocationResult]]:
+        """Pick concrete devices on ``node_name`` satisfying every request
+        of ``claim`` (ExactCount/All modes, firstAvailable alternatives,
+        adminAccess, matchAttribute constraints), or None. Used by both
+        Filter (feasibility = non-None) and Reserve (the actual pick), so
+        the two can never diverge."""
+        devices = self._devices_on(node_name)
+        constraints = claim.spec.constraints
+        picked: list[DeviceAllocationResult] = []
+        taken: set[tuple] = set()
+        locked: dict[int, object] = {}      # constraint idx -> value
+
+        def applicable(parent_name):
+            # a constraint names PARENT requests; it binds every
+            # subrequest of a firstAvailable parent (empty = all requests)
+            return [ci for ci, c in enumerate(constraints)
+                    if not c.requests or parent_name in c.requests]
+
+        def constraint_ok(cis, entry):
+            for ci in cis:
+                v = self._attr_of(entry, constraints[ci].match_attribute)
+                if v is None or (ci in locked and locked[ci] != v):
+                    return False
+            return True
+
+        def lock(cis, entry):
+            for ci in cis:
+                locked[ci] = self._attr_of(entry,
+                                           constraints[ci].match_attribute)
+
+        def fill(matched, cis, want, req_name, admin) -> bool:
+            got = 0
+            for entry, triple in matched:
+                if got == want:
+                    break
+                if triple in taken or not constraint_ok(cis, entry):
+                    continue
+                lock(cis, entry)
+                taken.add(triple)
+                picked.append(DeviceAllocationResult(
+                    request=req_name, driver=entry[0], pool=entry[1],
+                    device=entry[2].name, admin_access=admin))
+                got += 1
+            return got == want
+
+        def try_alternative(parent_name, req_name, class_name, selectors,
+                            count, mode, admin) -> bool:
+            device_class = (self.hub.get_device_class(class_name)
+                            if class_name else None)
+            matched = []
+            for entry in devices:
+                triple = (entry[0], entry[1], entry[2].name)
+                if triple in taken:
+                    continue
+                if not admin and triple in in_use:
+                    continue
+                if not self._device_matches(entry, class_name,
+                                            device_class, selectors):
+                    continue
+                matched.append((entry, triple))
+            want = len(matched) if mode == ALLOCATION_MODE_ALL else count
+            if len(matched) < want or want == 0:
+                return False
+            cis = applicable(parent_name)
+            unlocked = [ci for ci in cis if ci not in locked]
+            if not unlocked:
+                return fill(matched, cis, want, req_name, admin)
+            # unlocked matchAttribute constraints: a greedy first pick can
+            # lock the wrong value ([A,B,B] with count=2 must pick B) —
+            # try each candidate device as the constraint ANCHOR
+            save = (list(picked), set(taken), dict(locked))
+            for anchor, _t in matched:
+                if not constraint_ok(cis, anchor):
+                    continue
+                lock(cis, anchor)
+                if fill(matched, cis, want, req_name, admin):
+                    return True
+                picked[:] = save[0]
+                taken.clear()
+                taken.update(save[1])
+                locked.clear()
+                locked.update(save[2])
+            return False
+
+        for req in claim.spec.device_requests:
+            alternatives = ([(f"{req.name}/{sub.name}", sub)
+                             for sub in req.first_available]
+                            if req.first_available else [(req.name, req)])
+            satisfied = False
+            for alt_name, alt in alternatives:
+                save = (list(picked), set(taken), dict(locked))
+                if try_alternative(req.name, alt_name,
+                                   alt.device_class_name,
+                                   alt.selectors, alt.count,
+                                   alt.allocation_mode,
+                                   getattr(alt, "admin_access", False)):
+                    satisfied = True
+                    break
+                picked[:] = save[0]
+                taken.clear()
+                taken.update(save[1])
+                locked.clear()
+                locked.update(save[2])
+            if not satisfied:
+                return None
+        return picked
 
     # --- extension points ---
 
@@ -181,8 +489,8 @@ class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin,
         for ref, claim in self._pod_claims(pod):
             if claim is None:
                 return Status.unschedulable(
-                    f'resourceclaim "{ref.resource_claim_name}" not found',
-                    plugin=self.NAME, resolvable=False)
+                    f'resourceclaim "{claim_name_for(pod, ref)}" '
+                    "not found", plugin=self.NAME, resolvable=False)
             claims.append(claim)
         state.write(self.STATE_KEY, claims)
         # exclude only the pod's UNALLOCATED claims: an allocated claim's
@@ -190,13 +498,17 @@ class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin,
         # would let a sibling claim double-book them)
         exclude = {c.key() for c in claims
                    if c.status.allocation is None}
-        state.write(self.STATE_KEY + "/free", self._free_by_node(exclude))
+        state.write(self.STATE_KEY + "/in_use",
+                    self._in_use_view(exclude))
         return Status()
 
     def filter(self, state, pod: Pod, node_info) -> Status:
         claims = state.read(self.STATE_KEY) or []
-        free = state.read(self.STATE_KEY + "/free") or {}
+        in_use = state.read(self.STATE_KEY + "/in_use") or set()
         node_name = node_info.node.metadata.name
+        # claims share node devices: feasibility must thread one claim's
+        # picks into the next's in-use view
+        local_use = in_use
         for claim in claims:
             alloc = claim.status.allocation
             if alloc is not None:
@@ -205,9 +517,15 @@ class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin,
                         "claim already allocated on another node",
                         plugin=self.NAME)
                 continue
-            if not self._satisfiable(claim, free.get(node_name, [])):
+            picked = self.allocate_claim(claim, node_name, local_use)
+            if picked is None:
                 return Status.unschedulable(
                     "cannot allocate all claims", plugin=self.NAME)
+            if len(claims) > 1:
+                if local_use is in_use:
+                    local_use = set(in_use)
+                local_use |= {(d.driver, d.pool, d.device)
+                              for d in picked if not d.admin_access}
         return Status()
 
     def reserve(self, state, pod: Pod, node_name: str) -> Status:
@@ -216,12 +534,12 @@ class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin,
         for ref, c in self._pod_claims(pod):
             if c is None:
                 return Status.unschedulable(
-                    f'resourceclaim "{ref.resource_claim_name}" '
+                    f'resourceclaim "{claim_name_for(pod, ref)}" '
                     "disappeared", plugin=self.NAME)
             claims.append(c)
         exclude = {c.key() for c in claims
                    if c.status.allocation is None}
-        free = self._free_by_node(exclude).get(node_name, [])
+        in_use = self._in_use_view(exclude)
         for claim in claims:
             if claim.status.allocation is not None:
                 # already allocated: record this pod as a consumer
@@ -231,27 +549,14 @@ class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin,
                     self.assume.assume(new)
                     assumed_keys.append(new.key())
                 continue
-            picked: list[DeviceAllocationResult] = []
-            pool = list(free)
-            ok = True
-            for req in claim.spec.device_requests:
-                for _ in range(req.count):
-                    idx = next((i for i, d in enumerate(pool)
-                                if d[3] == req.device_class_name), None)
-                    if idx is None:
-                        ok = False
-                        break
-                    drv, pl, dev, _cls = pool.pop(idx)
-                    picked.append(DeviceAllocationResult(
-                        request=req.name, driver=drv, pool=pl, device=dev))
-                if not ok:
-                    break
-            if not ok:
+            picked = self.allocate_claim(claim, node_name, in_use)
+            if picked is None:
                 for k in assumed_keys:
                     self.assume.restore(k)
                 return Status.unschedulable(
                     "devices vanished before reserve", plugin=self.NAME)
-            free = pool
+            in_use = in_use | {(d.driver, d.pool, d.device)
+                               for d in picked if not d.admin_access}
             new = claim.clone()
             new.status.allocation = AllocationResult(
                 node_name=node_name, devices=picked)

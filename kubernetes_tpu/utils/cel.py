@@ -31,6 +31,7 @@ Semantics notes:
 from __future__ import annotations
 
 import ast
+import functools
 from dataclasses import dataclass
 
 from kubernetes_tpu.utils.quantity import parse_quantity
@@ -263,16 +264,23 @@ def _translate(expr: str) -> str:
     return "".join(out)
 
 
-def evaluate(expression: str, device: CelDevice) -> bool:
-    """Evaluate one CEL selector expression against a device. Raises
-    CelError for anything outside the supported subset."""
+@functools.lru_cache(maxsize=1024)
+def _parse(expression: str):
     try:
         # parenthesize: eval mode rejects leading whitespace (from a
         # translated leading '!') and bare newlines (multi-line YAML
         # expressions); parens make both legal continuations
-        tree = ast.parse("(" + _translate(expression) + ")", mode="eval")
+        return ast.parse("(" + _translate(expression) + ")", mode="eval")
     except SyntaxError as e:
         raise CelError(f"cannot parse CEL expression: {e}") from e
+
+
+def evaluate(expression: str, device: CelDevice) -> bool:
+    """Evaluate one CEL selector expression against a device (the parsed
+    AST is cached per expression — allocator hot path evaluates one
+    selector across many devices). Raises CelError for anything outside
+    the supported subset."""
+    tree = _parse(expression)
     try:
         return bool(_Evaluator(device).eval(tree))
     except CelError:
