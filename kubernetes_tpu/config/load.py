@@ -68,9 +68,12 @@ def config_from_dict(doc: dict) -> SchedulerConfiguration:
         url_prefix=e["url_prefix"],
         filter_verb=e.get("filter_verb", ""),
         prioritize_verb=e.get("prioritize_verb", ""),
+        bind_verb=e.get("bind_verb", ""),
+        preempt_verb=e.get("preempt_verb", ""),
         weight=e.get("weight", 1.0),
         managed_resources=e.get("managed_resources") or [],
         ignorable=e.get("ignorable", False),
+        node_cache_capable=e.get("node_cache_capable", False),
         timeout_seconds=e.get("timeout_seconds", 5.0))
         for e in doc.get("extenders") or []]
     return cfg

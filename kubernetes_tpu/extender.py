@@ -1,12 +1,22 @@
-"""HTTP scheduler extender: the out-of-process Filter/Prioritize webhook.
+"""HTTP scheduler extender: the out-of-process webhook, all four verbs.
 
 From-scratch equivalent of /root/reference/pkg/scheduler/extender.go
-(HTTPExtender :43, Filter :248, Prioritize :319, IsInterested :361) and
-the v1 extender API (ExtenderArgs/ExtenderFilterResult/HostPriorityList):
-a legacy escape hatch predating the framework — JSON POSTs to an external
-service that can veto nodes and add weighted scores. Wired into the host
-side of the mixed framework: verdicts AND into the device mask, scores
-add into the aggregate.
+(HTTPExtender :43, Filter :248, Prioritize :319, Bind :361,
+ProcessPreemption :136, IsInterested :465) and the v1 extender API
+(ExtenderArgs/ExtenderFilterResult/HostPriorityList/
+ExtenderBindingArgs/ExtenderPreemptionArgs): a legacy escape hatch
+predating the framework — JSON POSTs to an external service that can veto
+nodes, add weighted scores, bind pods itself, and veto/trim preemption
+candidates. Wired into the host side of the mixed framework: filter
+verdicts AND into the device mask, scores add into the aggregate, a
+binder extender replaces the default binder for its pods, and preemption
+candidates pass through ProcessPreemption before selection
+(framework/preemption.py call_extenders).
+
+Objects cross the wire in this build's full-fidelity JSON schema
+(utils.wire tagged dicts — the analog of the reference marshalling full
+v1.Pod/v1.Node objects, extender.go:248); nodeCacheCapable extenders get
+node NAMES only (extender.go:258-267).
 """
 
 from __future__ import annotations
@@ -17,6 +27,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from kubernetes_tpu.api.objects import Pod
+from kubernetes_tpu.utils.wire import to_wire
 
 DEFAULT_TIMEOUT = 5.0
 
@@ -29,13 +40,20 @@ class ExtenderConfig:
     url_prefix: str
     filter_verb: str = ""
     prioritize_verb: str = ""
+    bind_verb: str = ""
+    preempt_verb: str = ""
     weight: float = 1.0
     # resource names whose presence in a pod's requests makes the extender
-    # interested; empty = interested in every pod (extender.go:361)
+    # interested; empty = interested in every pod (extender.go:465)
     managed_resources: list[str] = field(default_factory=list)
     # an unreachable ignorable extender is skipped; a non-ignorable one
     # fails the pod (extender.go IsIgnorable)
     ignorable: bool = False
+    # nodeCacheCapable: the extender caches node objects itself, so
+    # filter/prioritize payloads carry node NAMES and preemption payloads
+    # carry pod-uid references instead of full objects. Defaults false
+    # like the upstream ExtenderConfig field.
+    node_cache_capable: bool = False
     timeout_seconds: float = DEFAULT_TIMEOUT
 
 
@@ -44,17 +62,10 @@ class ExtenderError(Exception):
 
 
 def _pod_payload(pod: Pod) -> dict:
-    return {
-        "metadata": {"name": pod.metadata.name,
-                     "namespace": pod.metadata.namespace,
-                     "uid": pod.metadata.uid,
-                     "labels": dict(pod.metadata.labels)},
-        "spec": {"schedulerName": pod.spec.scheduler_name,
-                 "containers": [
-                     {"name": c.name,
-                      "resources": {"requests": dict(c.resources.requests)}}
-                     for c in pod.spec.containers]},
-    }
+    """The FULL pod object (extender.go:248 marshals the entire v1.Pod):
+    a partial payload silently breaks extenders reading nodeSelector,
+    affinity, or tolerations."""
+    return to_wire(pod)
 
 
 class HTTPExtender:
@@ -66,6 +77,14 @@ class HTTPExtender:
     @property
     def name(self) -> str:
         return f"Extender({self.cfg.url_prefix})"
+
+    @property
+    def is_binder(self) -> bool:
+        return bool(self.cfg.bind_verb)
+
+    @property
+    def supports_preemption(self) -> bool:
+        return bool(self.cfg.preempt_verb)
 
     def is_interested(self, pod: Pod) -> bool:
         if not self.cfg.managed_resources:
@@ -85,18 +104,27 @@ class HTTPExtender:
                 req, timeout=self.cfg.timeout_seconds) as resp:
             return json.loads(resp.read().decode())
 
-    def filter(self, pod: Pod, node_names: list[str]
+    def filter(self, pod: Pod, node_names: list[str],
+               nodes: Optional[list] = None
                ) -> tuple[list[str], dict[str, str]]:
         """(nodes that passed, {failed node: reason}). Raises
-        ExtenderError on transport errors (caller applies ignorable)."""
+        ExtenderError on transport errors (caller applies ignorable).
+        ``nodes`` (full objects) ride along for non-nodeCacheCapable
+        extenders (extender.go:258: Nodes vs NodeNames)."""
         if not self.cfg.filter_verb:
             return node_names, {}
         try:
-            out = self._post(self.cfg.filter_verb, {
-                "pod": _pod_payload(pod), "nodenames": node_names})
+            payload = {"pod": _pod_payload(pod)}
+            if self.cfg.node_cache_capable or nodes is None:
+                payload["nodenames"] = node_names
+            else:
+                payload["nodes"] = [to_wire(n) for n in nodes]
+            out = self._post(self.cfg.filter_verb, payload)
             if out.get("error"):
                 raise ExtenderError(f"{self.name}: {out['error']}")
             passed = out.get("nodenames")
+            if passed is None and out.get("nodes") is not None:
+                passed = [n["metadata"]["name"] for n in out["nodes"]]
             if passed is None:
                 passed = node_names
             failed = dict(out.get("failedNodes") or {})
@@ -109,15 +137,95 @@ class HTTPExtender:
             # applies instead of crashing the scheduling cycle
             raise ExtenderError(f"{self.name}: {e}") from e
 
-    def prioritize(self, pod: Pod, node_names: list[str]
+    def prioritize(self, pod: Pod, node_names: list[str],
+                   nodes: Optional[list] = None
                    ) -> Optional[dict[str, float]]:
         """{node: weighted score} or None without a prioritize verb."""
         if not self.cfg.prioritize_verb:
             return None
         try:
-            out = self._post(self.cfg.prioritize_verb, {
-                "pod": _pod_payload(pod), "nodenames": node_names})
+            payload = {"pod": _pod_payload(pod)}
+            if self.cfg.node_cache_capable or nodes is None:
+                payload["nodenames"] = node_names
+            else:
+                payload["nodes"] = [to_wire(n) for n in nodes]
+            out = self._post(self.cfg.prioritize_verb, payload)
             return {e["host"]: float(e["score"]) * self.cfg.weight
                     for e in out or []}
         except Exception as e:  # noqa: BLE001 — transport or malformed
+            raise ExtenderError(f"{self.name}: {e}") from e
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        """Delegate the binding API call (extender.go:361 Bind;
+        ExtenderBindingArgs/ExtenderBindingResult). Raises ExtenderError
+        on transport errors or an error result — a failed delegated bind
+        fails the pod's binding cycle like a failed Binding POST."""
+        try:
+            out = self._post(self.cfg.bind_verb, {
+                "podName": pod.metadata.name,
+                "podNamespace": pod.metadata.namespace,
+                "podUID": pod.metadata.uid,
+                "node": node_name})
+            if out and out.get("error"):
+                raise ExtenderError(f"{self.name}: {out['error']}")
+        except ExtenderError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            raise ExtenderError(f"{self.name}: {e}") from e
+
+    def process_preemption(self, pod: Pod,
+                           node_to_victims: dict[str, list[Pod]],
+                           pdb_violations: dict[str, int]
+                           ) -> dict[str, tuple[list[Pod], int]]:
+        """ProcessPreemption (extender.go:136): the extender may veto
+        candidate nodes (omit them) or trim their victim lists. Returns
+        {node: (victims, pdb_violations)} for the surviving candidates;
+        returned victim references resolve by uid against the supplied
+        lists (convertToVictims, extender.go:177). nodeCacheCapable
+        extenders exchange NodeNameToMetaVictims (pod uids only,
+        extender.go:150); the rest get full pod objects."""
+        meta = self.cfg.node_cache_capable
+        if meta:
+            payload = {
+                "pod": _pod_payload(pod),
+                "nodeNameToMetaVictims": {
+                    node: {"pods": [{"uid": v.metadata.uid}
+                                    for v in victims],
+                           "numPDBViolations": pdb_violations.get(node, 0)}
+                    for node, victims in node_to_victims.items()},
+            }
+        else:
+            payload = {
+                "pod": _pod_payload(pod),
+                "nodeNameToVictims": {
+                    node: {"pods": [_pod_payload(v) for v in victims],
+                           "numPDBViolations": pdb_violations.get(node, 0)}
+                    for node, victims in node_to_victims.items()},
+            }
+        try:
+            out = self._post(self.cfg.preempt_verb, payload)
+            result = (out.get("nodeNameToMetaVictims")
+                      or out.get("nodeNameToVictims") or {})
+            by_uid = {v.metadata.uid: v
+                      for victims in node_to_victims.values()
+                      for v in victims}
+            survivors: dict[str, tuple[list[Pod], int]] = {}
+            for node, entry in result.items():
+                if node not in node_to_victims:
+                    continue    # an extender cannot add candidates
+                victims = []
+                for p in entry.get("pods") or []:
+                    uid = (p.get("uid")
+                           or (p.get("metadata") or {}).get("uid", ""))
+                    v = by_uid.get(uid)
+                    if v is not None:
+                        victims.append(v)
+                survivors[node] = (victims,
+                                   int(entry.get("numPDBViolations") or 0))
+            return survivors
+        except ExtenderError:
+            raise
+        except Exception as e:  # noqa: BLE001 — transport OR malformed
+            # response; both must surface as ExtenderError so `ignorable`
+            # applies in call_extenders
             raise ExtenderError(f"{self.name}: {e}") from e

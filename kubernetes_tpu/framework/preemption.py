@@ -16,6 +16,7 @@ point of the reference's remove-all-then-reprieve loop.
 
 from __future__ import annotations
 
+import logging
 import random
 from dataclasses import dataclass
 
@@ -34,6 +35,8 @@ from kubernetes_tpu.ops.preempt import preempt_feasible_jit, preempt_sweep_jit
 from kubernetes_tpu.utils.interner import NONE
 
 import jax
+
+logger = logging.getLogger("kubernetes_tpu.preemption")
 
 # sentinel: the incremental victim-state update cannot represent the new
 # cluster shape; fall back to a full rebuild
@@ -63,6 +66,11 @@ class Candidate:
     row: int
     victims: list[Pod]
     pdb_violations: int
+    # True once an extender's ProcessPreemption pass ran: the victim list
+    # is FINAL — verification may discard the candidate but must never
+    # regrow or reprieve the list (the reference runs callExtenders after
+    # the dry-run's reprieve, so extender trims are authoritative)
+    victims_final: bool = False
 
 
 class Evaluator:
@@ -93,6 +101,9 @@ class Evaluator:
         # deletion event (empty/already-deleted victim sets) — the gate
         # opener of last resort (see flush_evictions)
         self.activate_fn = None
+        # scheduler-installed: () -> [HTTPExtender]; candidates pass
+        # through ProcessPreemption before selection (preemption.go:335)
+        self.extenders_fn = None
         self.metrics = None     # SchedulerMetrics, set by the Scheduler
         # incremental victim-sweep state per preemptor priority (see
         # _collect_victims): row_gen-keyed victim lists + the resident
@@ -290,6 +301,11 @@ class Evaluator:
                 pod, {v.metadata.uid for v in vset}, {row: freed})
             return bool(feas[row])
 
+        if cand.victims_final:
+            # an extender trimmed this list: it is authoritative — verify
+            # as-is; never regrow to the full set or reprieve further
+            return cand if feasible_with(victims) else None
+
         kmin = getattr(self, "_kmin", None)
         k = int(kmin[row]) if kmin is not None else NONE
         from_prefix = k != NONE and len(victims) == k
@@ -374,6 +390,49 @@ class Evaluator:
             for pdb in matched:
                 budget[pdb.metadata.uid] -= 1
         return violations
+
+    # ------------- extender pass (preemption.go:335 callExtenders) --------
+
+    def call_extenders(self, pod: Pod,
+                       candidates: list[Candidate]) -> list[Candidate]:
+        """Run every preemption-capable interested extender over the
+        candidate map: extenders veto nodes (omission) and trim victim
+        lists (trims are FINAL — victims_final). An ignorable extender's
+        transport failure is skipped; a non-ignorable one raises
+        ExtenderError so the caller aborts the attempt as an ERROR, not
+        a misleading 'no candidates' (preemption.go:349)."""
+        from kubernetes_tpu.extender import ExtenderError
+
+        extenders = self.extenders_fn() if self.extenders_fn else []
+        relevant = [ext for ext in extenders
+                    if ext.supports_preemption and ext.is_interested(pod)]
+        if not relevant or not candidates:
+            return candidates
+        by_node = {c.node_name: c for c in candidates}
+        node_to_victims = {c.node_name: list(c.victims)
+                           for c in candidates}
+        pdbs = {c.node_name: c.pdb_violations for c in candidates}
+        for ext in relevant:
+            try:
+                survivors = ext.process_preemption(pod, node_to_victims,
+                                                   pdbs)
+            except ExtenderError as e:
+                if ext.cfg.ignorable:
+                    continue
+                logger.warning("preemption extender failed: %s", e)
+                raise
+            node_to_victims = {n: v for n, (v, _p) in survivors.items()}
+            pdbs = {n: p for n, (_v, p) in survivors.items()}
+            if not node_to_victims:
+                return []
+        out = []
+        for node, victims in node_to_victims.items():
+            c = by_node[node]
+            out.append(Candidate(node_name=c.node_name, row=c.row,
+                                 victims=victims,
+                                 pdb_violations=pdbs.get(node, 0),
+                                 victims_final=True))
+        return out
 
     # ---------------- selection (preemption.go:565 pickOneNode) -----------
 
@@ -917,6 +976,15 @@ class Evaluator:
                     node_name=mirror.name_of_row(row) or "", row=row,
                     victims=vs,
                     pdb_violations=self._pdb_violations(vs, pdbs)))
+            try:
+                candidates = self.call_extenders(qp.pod, candidates)
+            except Exception as e:  # noqa: BLE001 — non-ignorable
+                # extender failure: abort THIS preemptor's attempt as an
+                # error (retried with error backoff), not 'no candidates'
+                out[qp.uid] = (None, Status.error(
+                    f"preemption extender: {e}",
+                    plugin="DefaultPreemption"))
+                continue
             if not candidates:
                 out[qp.uid] = (None, Status.unschedulable(
                     "no preemption candidates",
@@ -958,8 +1026,13 @@ class Evaluator:
             reject_counts is not None and not host_rejects
             and all(c == 0 for i, c in enumerate(reject_counts)
                     if i != fit_idx))
-        candidates = self.find_candidates(pod, snapshot,
-                                          resource_only=resource_only)
+        try:
+            candidates = self.call_extenders(
+                pod, self.find_candidates(pod, snapshot,
+                                          resource_only=resource_only))
+        except Exception as e:  # noqa: BLE001 — non-ignorable extender
+            return None, Status.error(f"preemption extender: {e}",
+                                      plugin="DefaultPreemption")
         pdbs = self.hub.list_pdbs()
         for _ in range(min(len(candidates), MAX_VERIFY_CANDIDATES)):
             best = self.select_candidate(candidates)

@@ -179,6 +179,9 @@ class Scheduler:
         from kubernetes_tpu.extender import HTTPExtender
 
         self._extenders = [HTTPExtender(c) for c in self.config.extenders]
+        # preemption candidates pass through ProcessPreemption
+        # (preemption.go:335 callExtenders)
+        self.preemption.extenders_fn = lambda: self._extenders
         self._has_host_filters = any(fw.has_host_filters()
                                      for fw in self.frameworks.values())
         gates = [fw.host_gates() for fw in self.frameworks.values()]
@@ -711,8 +714,15 @@ class Scheduler:
         candidates = list(names)
         for ext in interested:
             try:
-                passed, failed = ext.filter(qp.pod, candidates)
-                scores = ext.prioritize(qp.pod, candidates)
+                nodes = None
+                if not ext.cfg.node_cache_capable:
+                    # non-nodeCacheCapable: ship full node objects
+                    # (extender.go:258 Nodes vs NodeNames)
+                    nodes = [info.node for name in candidates
+                             if (info := self.snapshot.node_info_map.get(
+                                 name)) is not None]
+                passed, failed = ext.filter(qp.pod, candidates, nodes)
+                scores = ext.prioritize(qp.pod, candidates, nodes)
             except ExtenderError as e:
                 if ext.cfg.ignorable:
                     continue
@@ -882,13 +892,37 @@ class Scheduler:
         else:
             self._error(qp, msg)
 
+    def _extenders_binding(self, pod: Pod, node_name: str):
+        """First interested binder extender binds INSTEAD of the bind
+        plugins (schedule_one.go:960 extendersBinding). Returns a Status
+        or None when no extender claims the pod."""
+        from kubernetes_tpu.extender import ExtenderError
+        from kubernetes_tpu.framework.interface import Status
+
+        for ext in self._extenders:
+            if not ext.is_binder or not ext.is_interested(pod):
+                continue
+            try:
+                ext.bind(pod, node_name)
+                # the extender performed the API binding; reflect it in
+                # the hub like the Binding POST would
+                self.hub.bind(pod, node_name)
+                return Status()
+            except ExtenderError as e:
+                return Status.error(str(e))
+            except Exception as e:  # noqa: BLE001
+                return Status.error(f"extender bind raised: {e!r}")
+        return None
+
     def _bind_task(self, state: CycleState, pod: Pod, node_name: str):
         fw = self._fw_for(pod)
         t0 = time.monotonic()
         try:
             s = fw.run_pre_bind_plugins(state, pod, node_name)
             if s.is_success():
-                s = fw.run_bind_plugins(state, pod, node_name)
+                ext_s = self._extenders_binding(pod, node_name)
+                s = ext_s if ext_s is not None \
+                    else fw.run_bind_plugins(state, pod, node_name)
         except Exception as e:  # noqa: BLE001 — a raising out-of-tree
             # plugin must not poison the chunk/future (every other pod in
             # it would stay assumed forever)

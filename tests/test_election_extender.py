@@ -112,19 +112,35 @@ class _StubExtender(BaseHTTPRequestHandler):
     def log_message(self, *a):
         pass
 
+    preempt_veto = set()    # candidate nodes dropped by /preempt
+    bound = []              # (podName, node) seen by /bind
+
     def do_POST(self):  # noqa: N802
         body = json.loads(self.rfile.read(
             int(self.headers["Content-Length"])).decode())
         type(self).calls.append((self.path, body))
         if self.path.endswith("/filter"):
-            names = [n for n in body["nodenames"]
-                     if n not in type(self).reject]
-            out = {"nodenames": names,
+            names = body.get("nodenames")
+            if names is None:   # non-nodeCacheCapable: full node objects
+                names = [n["metadata"]["name"] for n in body["nodes"]]
+            passed = [n for n in names if n not in type(self).reject]
+            out = {"nodenames": passed,
                    "failedNodes": {n: "vetoed" for n in type(self).reject
-                                   if n in body["nodenames"]}}
+                                   if n in names}}
+        elif self.path.endswith("/bind"):
+            type(self).bound.append((body["podName"], body["node"]))
+            out = {}
+        elif self.path.endswith("/preempt"):
+            out = {"nodeNameToVictims": {
+                node: entry
+                for node, entry in body["nodeNameToVictims"].items()
+                if node not in type(self).preempt_veto}}
         else:
+            names = body.get("nodenames")
+            if names is None:
+                names = [n["metadata"]["name"] for n in body["nodes"]]
             out = [{"host": n, "score": type(self).scores.get(n, 0)}
-                   for n in body["nodenames"]]
+                   for n in names]
         data = json.dumps(out).encode()
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
@@ -348,3 +364,203 @@ def test_feature_gates():
     assert sched.queue.pending_counts()["unschedulable"] == 0, \
         "hints disabled: any matching event requeues"
     sched.close()
+
+
+# ------------------- extender bind / preempt / payload verbs -------------------
+
+
+def test_extender_bind_verb_delegates_binding():
+    """extender.go:361 Bind: the first interested binder extender performs
+    the binding instead of the default binder; the hub still reflects it."""
+    _StubExtender.reject = set()
+    _StubExtender.scores = {}
+    _StubExtender.calls = []
+    _StubExtender.bound = []
+
+    def run(url):
+        hub = Hub()
+        hub.create_node(Node(
+            metadata=ObjectMeta(name="n0", labels={LABEL_HOSTNAME: "n0"}),
+            status=NodeStatus(allocatable={"cpu": "8", "memory": "16Gi",
+                                           "pods": "110"})))
+        cfg = default_config()
+        cfg.batch_size = 16
+        cfg.extenders = [ExtenderConfig(url_prefix=url, bind_verb="bind")]
+        sched = Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64))
+        p = Pod(metadata=ObjectMeta(name="delegated"),
+                spec=PodSpec(containers=[Container(
+                    name="c", resources=ResourceRequirements(
+                        requests={"cpu": "1"}))]))
+        hub.create_pod(p)
+        sched.run_until_idle()
+        assert hub.get_pod(p.metadata.uid).spec.node_name == "n0"
+        assert _StubExtender.bound == [("delegated", "n0")]
+        sched.close()
+
+    _with_stub(run)
+
+
+def test_extender_process_preemption_vetoes_candidate():
+    """preemption.go:335 callExtenders: a ProcessPreemption veto removes
+    the candidate node; the preemptor lands on a surviving candidate."""
+    _StubExtender.reject = set()
+    _StubExtender.scores = {}
+    _StubExtender.calls = []
+    _StubExtender.preempt_veto = {"n0"}
+
+    def run(url):
+        hub = Hub()
+        for n in ("n0", "n1"):
+            hub.create_node(Node(
+                metadata=ObjectMeta(name=n, labels={LABEL_HOSTNAME: n}),
+                status=NodeStatus(allocatable={"cpu": "4",
+                                               "memory": "16Gi",
+                                               "pods": "110"})))
+        cfg = default_config()
+        cfg.batch_size = 16
+        cfg.extenders = [ExtenderConfig(url_prefix=url,
+                                        preempt_verb="preempt")]
+        clock = [1000.0]
+        sched = Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64),
+                          now=lambda: clock[0])
+        # saturate both nodes with evictable low-priority pods
+        for n in ("n0", "n1"):
+            for j in range(2):
+                hub.create_pod(Pod(
+                    metadata=ObjectMeta(name=f"low-{n}-{j}"),
+                    spec=PodSpec(containers=[Container(
+                        name="c", resources=ResourceRequirements(
+                            requests={"cpu": "1800m"}))], priority=0)))
+        sched.run_until_idle()
+        high = Pod(metadata=ObjectMeta(name="high"),
+                   spec=PodSpec(containers=[Container(
+                       name="c", resources=ResourceRequirements(
+                           requests={"cpu": "1800m"}))], priority=100))
+        hub.create_pod(high)
+        for _ in range(6):
+            sched.run_until_idle()
+            clock[0] += 3.0
+            sched.queue.flush_backoff_completed()
+        sched.run_until_idle()
+        assert hub.get_pod(high.metadata.uid).spec.node_name == "n1", \
+            "vetoed candidate n0 must not be chosen"
+        assert any(path.endswith("/preempt")
+                   for path, _ in _StubExtender.calls)
+        # the payload carried the FULL pod (priority visible to extender)
+        preempt_body = next(b for path, b in _StubExtender.calls
+                            if path.endswith("/preempt"))
+        assert preempt_body["pod"]["spec"]["priority"] == 100
+        victims = next(iter(
+            preempt_body["nodeNameToVictims"].values()))["pods"]
+        assert victims[0]["spec"]["containers"][0]["resources"][
+            "requests"]["cpu"] == "1800m"
+        sched.close()
+
+    _with_stub(run)
+
+
+def test_extender_non_node_cache_capable_gets_full_nodes():
+    """extender.go:258: a non-nodeCacheCapable extender receives full
+    node objects in the filter payload."""
+    _StubExtender.reject = {"n0"}
+    _StubExtender.scores = {}
+    _StubExtender.calls = []
+
+    def run(url):
+        hub = Hub()
+        for n in ("n0", "n1"):
+            hub.create_node(Node(
+                metadata=ObjectMeta(name=n, labels={LABEL_HOSTNAME: n}),
+                status=NodeStatus(allocatable={"cpu": "8",
+                                               "memory": "16Gi",
+                                               "pods": "110"})))
+        cfg = default_config()
+        cfg.batch_size = 16
+        cfg.extenders = [ExtenderConfig(url_prefix=url,
+                                        filter_verb="filter",
+                                        node_cache_capable=False)]
+        sched = Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64))
+        p = Pod(metadata=ObjectMeta(name="p"),
+                spec=PodSpec(containers=[Container(
+                    name="c", resources=ResourceRequirements(
+                        requests={"cpu": "1"}))]))
+        hub.create_pod(p)
+        sched.run_until_idle()
+        assert hub.get_pod(p.metadata.uid).spec.node_name == "n1"
+        body = next(b for path, b in _StubExtender.calls
+                    if path.endswith("/filter"))
+        assert "nodes" in body and "nodenames" not in body
+        names = {n["metadata"]["name"] for n in body["nodes"]}
+        assert names == {"n0", "n1"}
+        assert body["nodes"][0]["status"]["allocatable"]["cpu"] == "8"
+        sched.close()
+
+    _with_stub(run)
+
+
+def test_extender_preempt_meta_victims_for_cache_capable():
+    """extender.go:150: a nodeCacheCapable extender exchanges
+    NodeNameToMetaVictims — pod uid references, not full objects."""
+    _StubExtender.reject = set()
+    _StubExtender.scores = {}
+    _StubExtender.calls = []
+    _StubExtender.preempt_veto = set()
+
+    class _MetaStub(_StubExtender):
+        def do_POST(self):  # noqa: N802
+            body = json.loads(self.rfile.read(
+                int(self.headers["Content-Length"])).decode())
+            _StubExtender.calls.append((self.path, body))
+            assert "nodeNameToMetaVictims" in body
+            out = {"nodeNameToMetaVictims": body["nodeNameToMetaVictims"]}
+            data = json.dumps(out).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _MetaStub)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}"
+        hub = Hub()
+        hub.create_node(Node(
+            metadata=ObjectMeta(name="n0", labels={LABEL_HOSTNAME: "n0"}),
+            status=NodeStatus(allocatable={"cpu": "4", "memory": "16Gi",
+                                           "pods": "110"})))
+        cfg = default_config()
+        cfg.batch_size = 16
+        cfg.extenders = [ExtenderConfig(url_prefix=url,
+                                        preempt_verb="preempt",
+                                        node_cache_capable=True)]
+        clock = [1000.0]
+        sched = Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64),
+                          now=lambda: clock[0])
+        for j in range(2):
+            hub.create_pod(Pod(
+                metadata=ObjectMeta(name=f"low-{j}"),
+                spec=PodSpec(containers=[Container(
+                    name="c", resources=ResourceRequirements(
+                        requests={"cpu": "1800m"}))], priority=0)))
+        sched.run_until_idle()
+        high = Pod(metadata=ObjectMeta(name="high"),
+                   spec=PodSpec(containers=[Container(
+                       name="c", resources=ResourceRequirements(
+                           requests={"cpu": "1800m"}))], priority=100))
+        hub.create_pod(high)
+        for _ in range(6):
+            sched.run_until_idle()
+            clock[0] += 3.0
+            sched.queue.flush_backoff_completed()
+        sched.run_until_idle()
+        assert hub.get_pod(high.metadata.uid).spec.node_name == "n0"
+        body = next(b for path, b in _StubExtender.calls
+                    if path.endswith("/preempt"))
+        victims = next(iter(
+            body["nodeNameToMetaVictims"].values()))["pods"]
+        assert victims and set(victims[0]) == {"uid"}
+        sched.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
