@@ -514,10 +514,42 @@ VOLUME_BINDING_WAIT = "WaitForFirstConsumer"
 
 
 @dataclass
+class TopologySelectorTerm:
+    """StorageClass.allowedTopologies entry: every requirement must match
+    the node's labels (v1helper.MatchTopologySelectorTerms)."""
+
+    match_label_expressions: list["TopologySelectorLabelRequirement"] = \
+        field(default_factory=list)
+
+
+@dataclass
+class TopologySelectorLabelRequirement:
+    key: str = ""
+    values: list[str] = field(default_factory=list)
+
+
+@dataclass
 class StorageClass:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     provisioner: str = ""
     volume_binding_mode: str = VOLUME_BINDING_IMMEDIATE
+    allowed_topologies: list[TopologySelectorTerm] = \
+        field(default_factory=list)
+
+
+@dataclass
+class CSIStorageCapacity:
+    """storage.k8s.io CSIStorageCapacity: a CSI driver's published
+    capacity for one storage class in one topology segment — the input to
+    VolumeBinding's dynamic-provisioning capacity check and Score
+    (volumebinding/binder.go hasEnoughCapacity)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    storage_class_name: str = ""
+    # label selector over NODE labels delimiting the topology segment;
+    # None = the whole cluster
+    node_topology: Optional[LabelSelector] = None
+    capacity: str = "0"
 
 
 # --- dynamic resource allocation (resource.k8s.io slices/claims) ----------------------

@@ -421,18 +421,51 @@ class Evaluator:
                     continue
                 logger.warning("preemption extender failed: %s", e)
                 raise
-            node_to_victims = {n: v for n, (v, _p) in survivors.items()}
-            pdbs = {n: p for n, (_v, p) in survivors.items()}
+            # a node returned with NO victims is removed, like upstream
+            # callExtenders deletes empty/unresolvable entries — an
+            # empty-victim candidate would otherwise always win selection
+            # while evicting nothing
+            node_to_victims = {n: v for n, (v, _p) in survivors.items()
+                               if v}
+            pdbs = {n: p for n, (_v, p) in survivors.items() if _v}
             if not node_to_victims:
                 return []
         out = []
         for node, victims in node_to_victims.items():
             c = by_node[node]
+            if len(victims) < len(c.victims):
+                # the extender TRIMMED a verified-minimal list: upstream
+                # trusts the extender blindly; we add a cheap host
+                # resource-sufficiency check and drop candidates whose
+                # trimmed set can no longer free enough (a bad extender
+                # must not cause a pointless eviction)
+                if not self._resources_sufficient(pod, c.row, victims):
+                    continue
             out.append(Candidate(node_name=c.node_name, row=c.row,
                                  victims=victims,
                                  pdb_violations=pdbs.get(node, 0),
                                  victims_final=True))
         return out
+
+    def _resources_sufficient(self, pod: Pod, row: int,
+                              victims: list[Pod]) -> bool:
+        """Host arithmetic: do these victims' requests free enough on
+        ``row`` for the pod to fit resource-wise? (Necessary, not
+        sufficient, for topology-blocked preemptors — still strictly
+        safer than upstream's unchecked trust in extender trims.)"""
+        mirror = self._get_mirror()
+        free = np.asarray(mirror.free_matrix()[row], np.float32)
+        nom = getattr(mirror, "_nominated_req_of_row", {}).get(row)
+        if nom is not None:
+            free = free - np.asarray(nom, np.float32)
+        req = np.asarray(self._res_row_cached(pod), np.float32)
+        nnn = pod.status.nominated_node_name
+        if nnn and mirror.row_of(nnn) == row:
+            free = free + req
+        freed = np.zeros_like(req)
+        for v in victims:
+            freed = freed + self._res_row_cached(v)
+        return bool(np.all(req <= free + freed))
 
     # ---------------- selection (preemption.go:565 pickOneNode) -----------
 
@@ -1026,20 +1059,33 @@ class Evaluator:
             reject_counts is not None and not host_rejects
             and all(c == 0 for i, c in enumerate(reject_counts)
                     if i != fit_idx))
+        candidates = self.find_candidates(pod, snapshot,
+                                          resource_only=resource_only)
+        pdbs = self.hub.list_pdbs()
+        has_preempt_ext = any(
+            ext.supports_preemption and ext.is_interested(pod)
+            for ext in (self.extenders_fn() if self.extenders_fn else []))
+        if has_preempt_ext and not resource_only:
+            # the reference runs callExtenders AFTER the dry-run's
+            # reprieve (preemption.go:335): minimize every candidate
+            # first so extenders see — and freeze — MINIMAL victim
+            # lists, not the optimistic all-evicted estimates
+            candidates = [m for c in candidates
+                          if (m := self._minimize_victims(pod, c,
+                                                          pdbs)) is not None]
         try:
-            candidates = self.call_extenders(
-                pod, self.find_candidates(pod, snapshot,
-                                          resource_only=resource_only))
+            candidates = self.call_extenders(pod, candidates)
         except Exception as e:  # noqa: BLE001 — non-ignorable extender
             return None, Status.error(f"preemption extender: {e}",
                                       plugin="DefaultPreemption")
-        pdbs = self.hub.list_pdbs()
         for _ in range(min(len(candidates), MAX_VERIFY_CANDIDATES)):
             best = self.select_candidate(candidates)
             if best is None:
                 break
-            if resource_only:
-                final = best        # sweep-exact: no verification launches
+            if resource_only or best.victims_final:
+                final = best        # sweep-exact / extender-final lists:
+                                    # already verified (minimized above or
+                                    # resource-checked in call_extenders)
             else:
                 final = self._minimize_victims(pod, best, pdbs)
             if final is not None:

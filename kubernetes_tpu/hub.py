@@ -83,6 +83,7 @@ class Hub:
         self._template_by_key: dict[str, str] = {}
         self._device_classes = _Store("DeviceClass")
         self._device_class_by_name: dict[str, str] = {}
+        self._csi_capacities = _Store("CSIStorageCapacity")
 
     # ------------- watch registration -------------
 
@@ -107,7 +108,8 @@ class Hub:
             for store in (self._nodes, self._pods, self._namespaces,
                           self._pdbs, self._pvcs, self._pvs, self._claims,
                           self._slices, self._priority_classes,
-                          self._storage_classes):
+                          self._storage_classes, self._claim_templates,
+                          self._device_classes, self._csi_capacities):
                 try:
                     store.handlers.remove(h)
                 except ValueError:
@@ -445,6 +447,24 @@ class Hub:
         with self._lock:
             uid = self._template_by_key.get(f"{namespace}/{name}")
             return self._claim_templates.objects.get(uid) if uid else None
+
+    def watch_csi_capacities(self, h: EventHandlers,
+                             replay: bool = True) -> None:
+        with self._lock:
+            self._csi_capacities.handlers.append(h)
+            if replay and h.on_add:
+                for o in list(self._csi_capacities.objects.values()):
+                    h.on_add(o)
+
+    def create_csi_capacity(self, c) -> None:
+        self._create(self._csi_capacities, c)
+
+    def update_csi_capacity(self, c) -> None:
+        self._update(self._csi_capacities, c)
+
+    def list_csi_capacities(self) -> list:
+        with self._lock:
+            return list(self._csi_capacities.objects.values())
 
     def create_device_class(self, dc) -> None:
         with self._lock:

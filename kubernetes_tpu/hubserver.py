@@ -44,12 +44,17 @@ CALL_METHODS = frozenset({
     "delete_resource_claim", "get_resource_claim", "list_resource_claims",
     "create_resource_slice", "delete_resource_slice",
     "list_resource_slices",
+    "create_resource_claim_template", "get_resource_claim_template",
+    "create_device_class", "get_device_class", "list_device_classes",
+    "create_csi_capacity", "update_csi_capacity", "list_csi_capacities",
+    "set_pod_claim_statuses",
     "create_priority_class", "list_priority_classes",
     "leases.get", "leases.update",
 })
 
 WATCH_KINDS = ("pods", "nodes", "namespaces", "pvcs", "pvs",
-               "resource_claims", "resource_slices")
+               "resource_claims", "resource_slices",
+               "resource_claim_templates", "csi_capacities")
 
 _ERROR_STATUS = {"Conflict": 409, "NotFound": 404, "ValueError": 400,
                  "TypeError": 400}

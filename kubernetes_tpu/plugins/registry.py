@@ -220,13 +220,15 @@ def in_tree_registry() -> dict[str, PluginDescriptor]:
                 EventResource.WILDCARD, A.ALL, "dra"))]),
         PluginDescriptor(
             name="VolumeBinding",
-            points=("filter", "reserve", "pre_bind"),
+            points=("filter", "score", "reserve", "pre_bind"),
+            default_weight=1,
             factory=_volume_factory("VolumeBinding"),
             events=[_ev(R.PVC, A.ADD | A.UPDATE),
                     _ev(R.PV, A.ADD | A.UPDATE),
                     _ev(R.NODE, A.ADD | A.UPDATE_NODE_LABEL
                         | A.UPDATE_NODE_TAINT),
                     _ev(R.STORAGE_CLASS, A.ADD),
+                    _ev(R.CSI_STORAGE_CAPACITY, A.ADD | A.UPDATE),
                     _ev(R.ASSIGNED_POD, A.DELETE)]),
     ]
     return {d.name: d for d in descriptors}

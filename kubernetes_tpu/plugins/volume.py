@@ -28,7 +28,10 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
-from kubernetes_tpu.api.labels import node_selector_matches
+from kubernetes_tpu.api.labels import (
+    label_selector_matches,
+    node_selector_matches,
+)
 from kubernetes_tpu.api.objects import (
     LABEL_REGION,
     LABEL_ZONE,
@@ -45,6 +48,7 @@ from kubernetes_tpu.framework.interface import (
     PreBindPlugin,
     PreFilterPlugin,
     ReservePlugin,
+    ScorePlugin,
     Status,
 )
 
@@ -315,10 +319,12 @@ class AssumeCache:
             self.pvcs.pop(pvc_key, None)
 
 
-class VolumeBinding(PreFilterPlugin, FilterPlugin, ReservePlugin,
-                    PreBindPlugin):
-    """volume_binding.go Filter (:268) + Reserve (:318 AssumePodVolumes) +
-    PreBind (:346 BindPodVolumes) + Unreserve (:334 revert)."""
+class VolumeBinding(PreFilterPlugin, FilterPlugin, ScorePlugin,
+                    ReservePlugin, PreBindPlugin):
+    """volume_binding.go Filter (:268) + Score (:464 storage-capacity
+    fit) + Reserve (:318 AssumePodVolumes) + PreBind (:346
+    BindPodVolumes, dynamic provisioning trigger) + Unreserve (:334
+    revert)."""
 
     NAME = "VolumeBinding"
     STATE_KEY = "VolumeBinding/assumed"
@@ -374,8 +380,8 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ReservePlugin,
         # per-claim Filter work, computed once per pod (the reference's
         # PreFilter builds podVolumeClaims the same way): bound claims ->
         # their PV; unbound claims -> (class/access/size-matched candidate
-        # PVs, provisionable flag). Filter then only checks per-node
-        # affinity against these.
+        # PVs, the storage class when provisionable). Filter then checks
+        # per-node affinity / provisioning topology+capacity against these.
         plan = []
         for pvc in claims:
             if pvc.spec.volume_name:
@@ -384,7 +390,7 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ReservePlugin,
                     return Status.unschedulable(
                         f'persistentvolume "{pvc.spec.volume_name}" '
                         "not found", plugin=self.NAME, resolvable=False)
-                plan.append(("bound", pv))
+                plan.append(("bound", (pv, pvc)))
             else:
                 cands = [pv for pv in
                          (self._pv(p.metadata.name) or p
@@ -393,10 +399,59 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ReservePlugin,
                 cands.sort(key=lambda pv: parse_bytes(
                     pv.spec.capacity.get("storage", "0")))
                 sc2 = self.hub.get_storage_class(pvc.spec.storage_class_name)
-                provisionable = sc2 is not None and bool(sc2.provisioner)
-                plan.append(("unbound", (cands, provisionable)))
+                provision_class = (sc2 if sc2 is not None
+                                   and sc2.provisioner else None)
+                plan.append(("unbound", (cands, provision_class, pvc)))
         state.write(self.PLAN_KEY, plan)
         return Status()
+
+    # --- dynamic provisioning checks (binder.go checkVolumeProvisions) ---
+
+    @staticmethod
+    def _topology_allows(sc, node) -> bool:
+        """StorageClass.allowedTopologies vs node labels
+        (v1helper.MatchTopologySelectorTerms): any term whose every
+        requirement matches; empty = everywhere."""
+        if not sc.allowed_topologies:
+            return True
+        for term in sc.allowed_topologies:
+            ok = True
+            for req in term.match_label_expressions:
+                if node.metadata.labels.get(req.key) not in req.values:
+                    ok = False
+                    break
+            if ok:
+                return True
+        return False
+
+    def _node_capacity_for(self, sc, node) -> Optional[int]:
+        """Largest published CSIStorageCapacity (bytes) covering this
+        (class, node), None when the driver publishes nothing for the
+        class — no capacity objects means no capacity checking
+        (binder.go hasEnoughCapacity's CSIDriver gate)."""
+        best = None
+        found_class = False
+        for cap in self.hub.list_csi_capacities():
+            if cap.storage_class_name != sc.metadata.name:
+                continue
+            found_class = True
+            if cap.node_topology is not None and not label_selector_matches(
+                    cap.node_topology, node.metadata.labels):
+                continue
+            v = parse_bytes(cap.capacity)
+            if best is None or v > best:
+                best = v
+        if best is None and not found_class:
+            return None
+        return best or 0
+
+    def _provision_ok(self, sc, pvc, node) -> bool:
+        if not self._topology_allows(sc, node):
+            return False
+        cap = self._node_capacity_for(sc, node)
+        if cap is None:
+            return True         # driver publishes no capacity: no check
+        return cap >= parse_bytes(pvc.spec.requests.get("storage", "0"))
 
     # --- matching (scheduler_binder.go findMatchingVolumes) ---
 
@@ -431,22 +486,86 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ReservePlugin,
         node = node_info.node
         for kind, data in state.read(self.PLAN_KEY) or []:
             if kind == "bound":
-                pv = data
+                pv, _pvc = data
                 if not node_selector_matches(pv.spec.node_affinity, node):
                     return Status.unschedulable(
                         "node(s) had volume node affinity conflict",
                         plugin=self.NAME)
                 continue
-            cands, provisionable = data
-            if provisionable:
-                continue            # dynamic provisioning will cover it
+            cands, provision_class, pvc = data
             if any(node_selector_matches(pv.spec.node_affinity, node)
                    for pv in cands):
-                continue
+                continue            # a static PV covers it on this node
+            if provision_class is not None and self._provision_ok(
+                    provision_class, pvc, node):
+                continue            # dynamic provisioning covers it
+            if provision_class is not None:
+                return Status.unschedulable(
+                    "node(s) did not have enough free storage",
+                    plugin=self.NAME)
             return Status.unschedulable(
                 "node(s) didn't find available persistent volumes to bind",
                 plugin=self.NAME)
         return Status()
+
+    # --- Score: storage-capacity fit (volume_binding.go:449-516) ---
+
+    def score(self, state, pod: Pod, node_info) -> tuple[float, Status]:
+        """Utilization-shaped capacity score per class: static bindings
+        score by chosen-PV utilization (requested/capacity of the PVs this
+        node would bind), dynamic provisions by requested/published
+        CSIStorageCapacity — the reference's classResourceMap + shape
+        scorer with the default 0->0, 100->10 shape."""
+        plan = state.read(self.PLAN_KEY) or []
+        if not plan:
+            return 0.0, Status()
+        node = node_info.node
+        static: list[tuple] = []        # (want, chosen_pv, class)
+        dynamic: list[tuple] = []       # (want, provision_class, class)
+        for kind, data in plan:
+            if kind == "bound":
+                continue
+            cands, provision_class, pvc = data
+            want = parse_bytes(pvc.spec.requests.get("storage", "0"))
+            chosen = None
+            for pv in cands:
+                if node_selector_matches(pv.spec.node_affinity, node):
+                    if chosen is None or parse_bytes(
+                            pv.spec.capacity.get("storage", "0")) < \
+                            parse_bytes(chosen.spec.capacity.get(
+                                "storage", "0")):
+                        chosen = pv     # smallest fitting PV (the binder's
+                                        # own choice order)
+            cls = pvc.spec.storage_class_name
+            if chosen is not None:
+                static.append((want, chosen, cls))
+            elif provision_class is not None:
+                dynamic.append((want, provision_class, cls))
+        by_class: dict[str, list[int]] = {}     # class -> [requested, cap]
+        if static:
+            # the reference scores static bindings whenever any exist,
+            # dynamic provisions only otherwise (volume_binding.go:479) —
+            # never mixing the two accountings within one pod
+            for want, pv, cls in static:
+                entry = by_class.setdefault(cls, [0, 0])
+                entry[0] += want
+                entry[1] += parse_bytes(
+                    pv.spec.capacity.get("storage", "0"))
+        else:
+            for want, provision_class, cls in dynamic:
+                cap = self._node_capacity_for(provision_class, node)
+                if cap:
+                    entry = by_class.setdefault(cls, [0, 0])
+                    entry[0] += want
+                    # NOT +=: several claims of one class share the same
+                    # published node capacity (volume_binding.go:505-509)
+                    entry[1] = cap
+        utils = [req / cap for req, cap in by_class.values() if cap > 0]
+        if not utils:
+            return 0.0, Status()
+        # default shape {0: 0, 100: 10}: linear in utilization, averaged
+        # over classes (higher utilization = tighter fit = better score)
+        return 10.0 * (sum(utils) / len(utils)), Status()
 
     # --- Reserve: AssumePodVolumes ---
 
@@ -462,7 +581,11 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ReservePlugin,
             if pv is None:
                 sc = self.hub.get_storage_class(pvc.spec.storage_class_name)
                 if sc is not None and sc.provisioner:
-                    continue        # provisioned at PreBind in a real cluster
+                    # dynamic provisioning: PreBind writes the
+                    # selected-node annotation that triggers the external
+                    # provisioner (binder.go BindPodVolumes)
+                    assumed.append(("", pvc.key()))
+                    continue
                 for _pv_name, _pvc_key in assumed:
                     self.assume.restore(_pv_name, _pvc_key)
                 return Status.unschedulable(
@@ -487,8 +610,29 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ReservePlugin,
 
     # --- PreBind: BindPodVolumes (API writes) ---
 
+    # the annotation the external provisioner watches for
+    # (volume.kubernetes.io/selected-node, scheduler_binder.go)
+    SELECTED_NODE_ANNOTATION = "volume.kubernetes.io/selected-node"
+
     def pre_bind(self, state, pod: Pod, node_name: str) -> Status:
         for pv_name, pvc_key in state.read(self.STATE_KEY) or []:
+            if not pv_name:
+                # dynamic provision: annotate the claim with the chosen
+                # node; the (fake or real) PV controller provisions + binds
+                ns, name = pvc_key.split("/", 1)
+                stored_c = self.hub.get_pvc(ns, name)
+                if stored_c is None:
+                    return Status.error(
+                        f"persistentvolumeclaim {pvc_key} disappeared",
+                        plugin=self.NAME)
+                try:
+                    new_c = stored_c.clone()
+                    new_c.metadata.annotations[
+                        self.SELECTED_NODE_ANNOTATION] = node_name
+                    self.hub.update_pvc(new_c)
+                except Exception as e:  # noqa: BLE001
+                    return Status.error(str(e), plugin=self.NAME)
+                continue
             pv = self.assume.pvs.get(pv_name)
             pvc = self.assume.pvcs.get(pvc_key)
             try:

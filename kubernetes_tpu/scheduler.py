@@ -302,6 +302,13 @@ class Scheduler:
             on_update=w(lambda old, new:
                         self.queue.move_all_to_active_or_backoff(
                             ClusterEvent(R.PV, A.UPDATE), old, new))))
+        self.hub.watch_csi_capacities(EventHandlers(
+            on_add=w(lambda o: self.queue.move_all_to_active_or_backoff(
+                ClusterEvent(R.CSI_STORAGE_CAPACITY, A.ADD), None, o)),
+            on_update=w(lambda old, new:
+                        self.queue.move_all_to_active_or_backoff(
+                            ClusterEvent(R.CSI_STORAGE_CAPACITY, A.UPDATE),
+                            old, new))))
 
     def _invalidate_chain(self) -> None:
         """Drop the device-resident usage chain and bump the epoch so a

@@ -151,3 +151,61 @@ def fake_profile(*enabled: str, weights: Optional[dict[str, float]] = None,
             getattr(plugins, point).enabled.append(
                 PluginRef(name, weights.get(name, 0.0)))
     return SchedulerProfile(scheduler_name=scheduler_name, plugins=plugins)
+
+
+class FakePVController:
+    """The integration harness's fake PV controller
+    (test/integration/util/util.go:150): watches PVCs carrying the
+    selected-node annotation VolumeBinding's PreBind writes for dynamic
+    provisioning, provisions a PV (capacity = request, node affinity
+    pinned to the chosen node), and binds the claim — completing the
+    WaitForFirstConsumer flow without a real CSI driver."""
+
+    def __init__(self, hub):
+        from kubernetes_tpu.hub import EventHandlers
+
+        self.hub = hub
+        self.provisioned: list[str] = []    # pv names, in creation order
+        hub.watch_pvcs(EventHandlers(
+            on_add=self._maybe_provision,
+            on_update=lambda old, new: self._maybe_provision(new)))
+
+    def _maybe_provision(self, pvc) -> None:
+        from kubernetes_tpu.api.objects import (
+            LABEL_HOSTNAME,
+            ClaimRef,
+            NodeSelector,
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+            ObjectMeta,
+            PersistentVolume,
+            PersistentVolumeSpec,
+        )
+        from kubernetes_tpu.plugins.volume import VolumeBinding
+
+        node = pvc.metadata.annotations.get(
+            VolumeBinding.SELECTED_NODE_ANNOTATION)
+        if not node or pvc.spec.volume_name:
+            return
+        pv_name = f"provisioned-{pvc.metadata.name}"
+        if self.hub.get_pv(pv_name) is None:
+            self.hub.create_pv(PersistentVolume(
+                metadata=ObjectMeta(name=pv_name),
+                spec=PersistentVolumeSpec(
+                    capacity={"storage":
+                              pvc.spec.requests.get("storage", "0")},
+                    access_modes=list(pvc.spec.access_modes),
+                    storage_class_name=pvc.spec.storage_class_name,
+                    claim_ref=ClaimRef(namespace=pvc.metadata.namespace,
+                                       name=pvc.metadata.name,
+                                       uid=pvc.metadata.uid),
+                    node_affinity=NodeSelector(node_selector_terms=[
+                        NodeSelectorTerm(match_expressions=[
+                            NodeSelectorRequirement(
+                                key=LABEL_HOSTNAME, operator="In",
+                                values=[node])])]))))
+            self.provisioned.append(pv_name)
+        bound = pvc.clone()
+        bound.spec.volume_name = pv_name
+        bound.status.phase = "Bound"
+        self.hub.update_pvc(bound)

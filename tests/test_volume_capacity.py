@@ -1,0 +1,215 @@
+"""VolumeBinding dynamic provisioning + storage-capacity scoring
+(reference: plugins/volumebinding volume_binding.go Score :464,
+binder.go checkVolumeProvisions/hasEnoughCapacity; CSIStorageCapacity).
+"""
+
+from kubernetes_tpu.api.objects import (
+    CSIStorageCapacity,
+    Container,
+    LABEL_HOSTNAME,
+    LabelSelector,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    PersistentVolumeClaim,
+    PersistentVolumeClaimSpec,
+    PersistentVolumeClaimVolumeSource,
+    Pod,
+    PodSpec,
+    READ_WRITE_ONCE,
+    ResourceRequirements,
+    StorageClass,
+    TopologySelectorLabelRequirement,
+    TopologySelectorTerm,
+    VOLUME_BINDING_WAIT,
+    Volume,
+)
+from kubernetes_tpu.config.types import default_config
+from kubernetes_tpu.hub import Hub
+from kubernetes_tpu.ops.features import Capacities
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing.fakes import FakePVController
+
+
+def mknode(name, labels=None):
+    lab = {LABEL_HOSTNAME: name}
+    lab.update(labels or {})
+    return Node(metadata=ObjectMeta(name=name, labels=lab),
+                spec=NodeSpec(),
+                status=NodeStatus(allocatable={"cpu": "16",
+                                               "memory": "32Gi",
+                                               "pods": "110"}))
+
+
+def mkpod(name, claim):
+    return Pod(metadata=ObjectMeta(name=name),
+               spec=PodSpec(
+                   containers=[Container(name="c",
+                                         resources=ResourceRequirements(
+                                             requests={"cpu": "100m"}))],
+                   volumes=[Volume(name=claim,
+                                   persistent_volume_claim=(
+                                       PersistentVolumeClaimVolumeSource(
+                                           claim_name=claim)))]))
+
+
+def mkpvc(name, sc, storage="10Gi"):
+    return PersistentVolumeClaim(
+        metadata=ObjectMeta(name=name),
+        spec=PersistentVolumeClaimSpec(
+            access_modes=[READ_WRITE_ONCE], storage_class_name=sc,
+            requests={"storage": storage}))
+
+
+def wait_sc(name="fast"):
+    return StorageClass(metadata=ObjectMeta(name=name),
+                        provisioner="csi.example.com",
+                        volume_binding_mode=VOLUME_BINDING_WAIT)
+
+
+def mkcap(name, sc, capacity, node=None):
+    sel = None
+    if node:
+        sel = LabelSelector(match_labels={LABEL_HOSTNAME: node})
+    return CSIStorageCapacity(metadata=ObjectMeta(name=name),
+                              storage_class_name=sc,
+                              node_topology=sel, capacity=capacity)
+
+
+def mksched(hub):
+    cfg = default_config()
+    cfg.batch_size = 16
+    return Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64))
+
+
+def bound(hub, pod):
+    return hub.get_pod(pod.metadata.uid).spec.node_name
+
+
+def test_capacity_filter_rejects_insufficient_nodes():
+    """hasEnoughCapacity: a node whose published capacity is below the
+    claim's request cannot host the provisioning."""
+    hub = Hub()
+    sched = mksched(hub)
+    hub.create_node(mknode("small"))
+    hub.create_node(mknode("big"))
+    hub.create_storage_class(wait_sc())
+    hub.create_csi_capacity(mkcap("c-small", "fast", "5Gi", node="small"))
+    hub.create_csi_capacity(mkcap("c-big", "fast", "100Gi", node="big"))
+    hub.create_pvc(mkpvc("data", "fast", storage="10Gi"))
+    p = mkpod("p", "data")
+    hub.create_pod(p)
+    sched.run_until_idle()
+    assert bound(hub, p) == "big"
+
+
+def test_no_capacity_objects_means_no_capacity_check():
+    """A class whose driver publishes nothing skips the capacity check
+    (the CSIDriver gate in the reference)."""
+    hub = Hub()
+    sched = mksched(hub)
+    hub.create_node(mknode("n1"))
+    hub.create_storage_class(wait_sc())
+    hub.create_pvc(mkpvc("data", "fast", storage="10Ti"))
+    p = mkpod("p", "data")
+    hub.create_pod(p)
+    sched.run_until_idle()
+    assert bound(hub, p) == "n1"
+
+
+def test_allowed_topologies_restrict_provisioning():
+    """Class allowedTopologies gate provisioning to matching nodes
+    (MatchTopologySelectorTerms)."""
+    hub = Hub()
+    sched = mksched(hub)
+    hub.create_node(mknode("ssd-node", labels={"disk": "ssd"}))
+    hub.create_node(mknode("hdd-node", labels={"disk": "hdd"}))
+    sc = wait_sc()
+    sc.allowed_topologies = [TopologySelectorTerm(
+        match_label_expressions=[TopologySelectorLabelRequirement(
+            key="disk", values=["ssd"])])]
+    hub.create_storage_class(sc)
+    hub.create_pvc(mkpvc("data", "fast"))
+    p = mkpod("p", "data")
+    hub.create_pod(p)
+    sched.run_until_idle()
+    assert bound(hub, p) == "ssd-node"
+
+
+def test_capacity_score_prefers_tighter_fit():
+    """Score = utilization through the default 0->0, 100->10 shape: with
+    both nodes sufficient, the node whose published capacity yields the
+    HIGHER utilization (tighter fit) wins (volume_binding.go:505)."""
+    hub = Hub()
+    sched = mksched(hub)
+    hub.create_node(mknode("roomy"))
+    hub.create_node(mknode("snug"))
+    hub.create_storage_class(wait_sc())
+    hub.create_csi_capacity(mkcap("c-roomy", "fast", "100Gi",
+                                  node="roomy"))
+    hub.create_csi_capacity(mkcap("c-snug", "fast", "12Gi", node="snug"))
+    hub.create_pvc(mkpvc("data", "fast", storage="10Gi"))
+    p = mkpod("p", "data")
+    hub.create_pod(p)
+    sched.run_until_idle()
+    assert bound(hub, p) == "snug"
+
+
+def test_dynamic_provisioning_end_to_end():
+    """PreBind writes the selected-node annotation; the fake PV
+    controller (test/integration/util/util.go:150) provisions and binds;
+    the claim ends Bound to a node-pinned PV."""
+    hub = Hub()
+    FakePVController(hub)
+    sched = mksched(hub)
+    hub.create_node(mknode("n1"))
+    hub.create_storage_class(wait_sc())
+    hub.create_csi_capacity(mkcap("c1", "fast", "50Gi", node="n1"))
+    hub.create_pvc(mkpvc("data", "fast"))
+    p = mkpod("p", "data")
+    hub.create_pod(p)
+    sched.run_until_idle()
+    assert bound(hub, p) == "n1"
+    pvc = hub.get_pvc("default", "data")
+    assert pvc.spec.volume_name == "provisioned-data"
+    assert pvc.status.phase == "Bound"
+    pv = hub.get_pv("provisioned-data")
+    assert pv is not None
+    assert pv.spec.claim_ref.name == "data"
+    sel = pv.spec.node_affinity.node_selector_terms[0]
+    assert sel.match_expressions[0].values == ["n1"]
+
+
+def test_capacity_event_requeues_parked_pod():
+    """A pod parked on 'not enough free storage' requeues when the driver
+    publishes new capacity (the CSIStorageCapacity Add event upstream
+    VolumeBinding registers)."""
+    hub = Hub()
+    clock = [1000.0]
+    cfg = default_config()
+    cfg.batch_size = 16
+    sched = Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64),
+                      now=lambda: clock[0])
+    hub.create_node(mknode("n1"))
+    hub.create_storage_class(wait_sc())
+    hub.create_csi_capacity(mkcap("c1", "fast", "1Gi", node="n1"))
+    hub.create_pvc(mkpvc("data", "fast", storage="10Gi"))
+    p = mkpod("p", "data")
+    hub.create_pod(p)
+    sched.run_until_idle()
+    assert bound(hub, p) in ("", None)
+    # driver publishes more capacity -> requeue and schedule
+    cap = [c for c in hub.list_csi_capacities()
+           if c.metadata.name == "c1"][0]
+    new = CSIStorageCapacity(metadata=cap.metadata,
+                             storage_class_name="fast",
+                             node_topology=cap.node_topology,
+                             capacity="50Gi")
+    hub.update_csi_capacity(new)
+    for _ in range(4):
+        sched.run_until_idle()
+        clock[0] += 3.0
+        sched.queue.flush_backoff_completed()
+    sched.run_until_idle()
+    assert bound(hub, p) == "n1"
