@@ -1062,14 +1062,18 @@ class Evaluator:
         candidates = self.find_candidates(pod, snapshot,
                                           resource_only=resource_only)
         pdbs = self.hub.list_pdbs()
+        extenders = self.extenders_fn() if self.extenders_fn else []
         has_preempt_ext = any(
             ext.supports_preemption and ext.is_interested(pod)
-            for ext in (self.extenders_fn() if self.extenders_fn else []))
+            for ext in extenders)
         if has_preempt_ext and not resource_only:
             # the reference runs callExtenders AFTER the dry-run's
-            # reprieve (preemption.go:335): minimize every candidate
-            # first so extenders see — and freeze — MINIMAL victim
-            # lists, not the optimistic all-evicted estimates
+            # reprieve (preemption.go:335): minimize candidates first so
+            # extenders see — and freeze — MINIMAL victim lists. Bounded
+            # to MAX_VERIFY_CANDIDATES: minimization costs device
+            # launches, and find_candidates can return one candidate per
+            # feasible row
+            candidates = candidates[:MAX_VERIFY_CANDIDATES]
             candidates = [m for c in candidates
                           if (m := self._minimize_victims(pod, c,
                                                           pdbs)) is not None]

@@ -223,6 +223,20 @@ class Framework:
         return any(isinstance(self._instances.get(name), ScorePlugin)
                    for name, _ in self.points["score"])
 
+    def host_score_gates(self):
+        """Per-plugin relevance probes for host ScorePlugins, mirroring
+        host_gates(): when every host scorer declares ``applies(pod)``,
+        a pod none of them applies to skips host scoring entirely —
+        adding VolumeBinding's capacity Score must not re-route every
+        plain pod through the per-node Python score loop. None = some
+        scorer has no probe."""
+        gates = [getattr(self._instances.get(name), "applies", None)
+                 for name, _ in self.points["score"]
+                 if isinstance(self._instances.get(name), ScorePlugin)]
+        if any(g is None for g in gates):
+            return None
+        return gates
+
     def run_host_filters(self, state: CycleState, pod: Pod, node_infos
                          ) -> tuple[Optional[list[bool]], dict[str, int],
                                     Optional[Status]]:
@@ -273,6 +287,10 @@ class Framework:
         entries = [(self._instances.get(name), weight)
                    for name, weight in self.points["score"]
                    if isinstance(self._instances.get(name), ScorePlugin)]
+        # per-plugin relevance probe: a scorer that declares applies()
+        # skips pods it cannot score (the per-node loop is Python)
+        entries = [(pl, w) for pl, w in entries
+                   if not hasattr(pl, "applies") or pl.applies(pod)]
         if not entries:
             return None
         total = [0.0] * len(node_infos)

@@ -174,3 +174,142 @@ def topology_spread_hint(pod: Pod, old_obj, new_obj) -> QueueingHint:
                 c.label_selector, old_pod.metadata.labels):
             return QUEUE    # label update out of the matching set
     return SKIP
+
+
+# ------------- volume family / DRA / gates / ports hints -------------
+# The remaining per-plugin isSchedulableAfter* fns: without them every
+# PV/PVC/claim/slice event thundered the whole unschedulable pool.
+
+
+def scheduling_gates_hint(pod: Pod, old_obj, new_obj) -> QueueingHint:
+    """schedulinggates.go isSchedulableAfterUpdatePodScheduling
+    GatesEliminated: only THE pod's own gate-removal update helps."""
+    new_pod = _as_pod(new_obj)
+    if new_pod is None:
+        return SKIP
+    if new_pod.metadata.uid != pod.metadata.uid:
+        return SKIP
+    return QUEUE if not new_pod.spec.scheduling_gates else SKIP
+
+
+def _pod_host_ports(p: Pod) -> set[tuple[str, int]]:
+    out = set()
+    for c in p.spec.containers:
+        for prt in c.ports:
+            if prt.host_port:
+                out.add((prt.protocol or "TCP", prt.host_port))
+    return out
+
+
+def node_ports_hint(pod: Pod, old_obj, new_obj) -> QueueingHint:
+    """nodeports.go isSchedulableAfterPodDeleted: a deleted pod helps
+    only if it held a host port the pending pod wants."""
+    old_pod = _as_pod(old_obj)
+    if old_pod is not None and new_obj is None:
+        if not old_pod.spec.node_name:
+            return SKIP
+        want = _pod_host_ports(pod)
+        held = _pod_host_ports(old_pod)
+        return QUEUE if want & held else SKIP
+    return QUEUE    # node events: allocatable/new node could host the port
+
+
+def _pod_claim_names(pod: Pod) -> set[str]:
+    from kubernetes_tpu.plugins.dra import claim_name_for
+
+    return {claim_name_for(pod, ref) for ref in pod.spec.resource_claims}
+
+
+def dra_hint(pod: Pod, old_obj, new_obj) -> QueueingHint:
+    """dynamicresources.go isSchedulableAfterClaimChange /
+    ...ResourceSliceChange: the pod's OWN claim appearing/changing helps
+    (template-generated claims arrive late; deallocation frees its
+    devices); ANY claim's deletion frees devices; a new/removed slice
+    changes the device inventory."""
+    obj = new_obj if new_obj is not None else old_obj
+    kind = type(obj).__name__ if obj is not None else ""
+    if kind == "ResourceClaim":
+        if new_obj is None:
+            return QUEUE        # deletion frees its devices for anyone
+        if obj.metadata.namespace == pod.metadata.namespace \
+                and obj.metadata.name in _pod_claim_names(pod):
+            return QUEUE        # the pod's own claim appeared / changed
+        old_claim = old_obj
+        if old_claim is not None \
+                and old_claim.status.allocation is not None \
+                and new_obj.status.allocation is None:
+            return QUEUE        # a claim deallocated: devices freed
+        return SKIP
+    if kind == "ResourceSlice":
+        return QUEUE            # inventory changed either way
+    return QUEUE                # node/pod events: conservative
+
+
+def _pod_pvc_names(pod: Pod) -> set[str]:
+    out = set()
+    for v in pod.spec.volumes:
+        pvc_src = getattr(v, "persistent_volume_claim", None)
+        if pvc_src is not None:
+            out.add(pvc_src.claim_name)
+    return out
+
+
+def volume_binding_hint(pod: Pod, old_obj, new_obj) -> QueueingHint:
+    """volume_binding.go isSchedulableAfter{PVC,PV,StorageClass,
+    CSIStorageCapacity}Change: only objects that can serve one of the
+    pod's claims help."""
+    obj = new_obj if new_obj is not None else old_obj
+    kind = type(obj).__name__ if obj is not None else ""
+    if kind == "PersistentVolumeClaim":
+        return (QUEUE if obj.metadata.namespace == pod.metadata.namespace
+                and obj.metadata.name in _pod_pvc_names(pod) else SKIP)
+    # PV / StorageClass / CSIStorageCapacity / node events: the pod's
+    # claim set cannot be resolved to classes without the hub, so any
+    # such event may help (the reference checks class names; this stays
+    # one notch more conservative, still far from wildcard)
+    return QUEUE
+
+
+def _restricted_volume_keys(p: Pod) -> set[str]:
+    """Type-prefixed restricted-volume identities (reuses volume.py's
+    _restricted_key so gce/rbd/etc. namespaces can never collide)."""
+    from kubernetes_tpu.plugins.volume import _restricted_key
+
+    out = set()
+    for v in p.spec.volumes:
+        k = _restricted_key(v) if hasattr(v, "gce_pd_name") else None
+        if k is not None:
+            out.add(k)
+    return out
+
+
+def volume_restrictions_hint(pod: Pod, old_obj, new_obj) -> QueueingHint:
+    """volume_restrictions.go isSchedulableAfterPodDeleted: the departed
+    pod must have shared a restricted volume or a ReadWriteOncePod claim
+    namespace-wise; PVC adds must belong to the pod."""
+    old_pod = _as_pod(old_obj)
+    if old_pod is not None and new_obj is None:
+        if not old_pod.spec.node_name:
+            return SKIP
+        if old_pod.metadata.namespace != pod.metadata.namespace:
+            # restricted non-PVC volumes conflict cross-namespace
+            return (QUEUE if _restricted_volume_keys(pod)
+                    & _restricted_volume_keys(old_pod) else SKIP)
+        return (QUEUE if _pod_pvc_names(pod) & _pod_pvc_names(old_pod)
+                or _restricted_volume_keys(old_pod) else SKIP)
+    if type(new_obj).__name__ == "PersistentVolumeClaim":
+        return (QUEUE
+                if new_obj.metadata.namespace == pod.metadata.namespace
+                and new_obj.metadata.name in _pod_pvc_names(pod) else SKIP)
+    return QUEUE
+
+
+def node_volume_limits_hint(pod: Pod, old_obj, new_obj) -> QueueingHint:
+    """csi.go isSchedulableAfterPodDeleted: a departed pod frees attach
+    slots only if it mounted PVC-backed volumes."""
+    old_pod = _as_pod(old_obj)
+    if old_pod is not None and new_obj is None:
+        if not old_pod.spec.node_name:
+            return SKIP
+        return QUEUE if _pod_pvc_names(old_pod) else SKIP
+    return QUEUE    # CSINode / PVC / PV events: limits or claims changed

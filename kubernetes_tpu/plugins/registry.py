@@ -126,7 +126,8 @@ def in_tree_registry() -> dict[str, PluginDescriptor]:
             name="SchedulingGates", points=("pre_enqueue",),
             factory=lambda args: SchedulingGates(),
             events=[_ev(R.POD,
-                        A.UPDATE_POD_SCHEDULING_GATES_ELIMINATED)]),
+                        A.UPDATE_POD_SCHEDULING_GATES_ELIMINATED,
+                        hints.scheduling_gates_hint)]),
         PluginDescriptor(
             name="PrioritySort", points=("queue_sort",),
             factory=lambda args: PrioritySort()),
@@ -148,7 +149,10 @@ def in_tree_registry() -> dict[str, PluginDescriptor]:
                         hints.node_affinity_hint)]),
         PluginDescriptor(
             name="NodePorts", points=("filter",), device_filter=True,
-            events=[_ev(R.ASSIGNED_POD, A.DELETE), node_alloc]),
+            events=[_ev(R.ASSIGNED_POD, A.DELETE,
+                        hints.node_ports_hint),
+                    _ev(R.NODE, A.ADD | A.UPDATE_NODE_ALLOCATABLE,
+                        hints.node_ports_hint)]),
         PluginDescriptor(
             name="NodeResourcesFit", points=("filter", "score"),
             device_filter=True, device_score=True, default_weight=1,
@@ -193,42 +197,51 @@ def in_tree_registry() -> dict[str, PluginDescriptor]:
         PluginDescriptor(
             name="VolumeZone", points=("filter",),
             factory=_volume_factory("VolumeZone"),
-            events=[_ev(R.PV, A.ADD | A.UPDATE),
-                    _ev(R.PVC, A.ADD | A.UPDATE),
+            events=[_ev(R.PV, A.ADD | A.UPDATE,
+                        hints.volume_binding_hint),
+                    _ev(R.PVC, A.ADD | A.UPDATE,
+                        hints.volume_binding_hint),
                     _ev(R.NODE, A.ADD | A.UPDATE_NODE_LABEL),
                     _ev(R.STORAGE_CLASS, A.ADD)]),
         PluginDescriptor(
             name="VolumeRestrictions", points=("filter",),
             factory=_volume_factory("VolumeRestrictions"),
-            events=[_ev(R.ASSIGNED_POD, A.DELETE),
-                    _ev(R.PVC, A.ADD | A.UPDATE)]),
+            events=[_ev(R.ASSIGNED_POD, A.DELETE,
+                        hints.volume_restrictions_hint),
+                    _ev(R.PVC, A.ADD | A.UPDATE,
+                        hints.volume_restrictions_hint)]),
         PluginDescriptor(
             name="NodeVolumeLimits", points=("filter",),
             factory=_volume_factory("NodeVolumeLimits"),
             events=[_ev(R.CSI_NODE, A.ADD | A.UPDATE),
-                    _ev(R.ASSIGNED_POD, A.DELETE),
+                    _ev(R.ASSIGNED_POD, A.DELETE,
+                        hints.node_volume_limits_hint),
                     _ev(R.PVC, A.ADD),
                     _ev(R.PV, A.ADD)]),
         PluginDescriptor(
             name="DynamicResources",
             points=("filter", "reserve", "pre_bind"),
             factory=_dra_factory,
-            # claims/slices dispatch as WILDCARD events; wildcard matches
-            # node/pod events too, which is the conservative requeue set
-            # the reference uses while DRA hints mature
-            events=[ClusterEventWithHint(event=ClusterEvent(
-                EventResource.WILDCARD, A.ALL, "dra"))]),
+            events=[_ev(R.RESOURCE_CLAIM, A.ADD | A.UPDATE | A.DELETE,
+                        hints.dra_hint),
+                    _ev(R.RESOURCE_SLICE, A.ADD | A.DELETE,
+                        hints.dra_hint),
+                    _ev(R.NODE, A.ADD)]),
         PluginDescriptor(
             name="VolumeBinding",
             points=("filter", "score", "reserve", "pre_bind"),
             default_weight=1,
             factory=_volume_factory("VolumeBinding"),
-            events=[_ev(R.PVC, A.ADD | A.UPDATE),
-                    _ev(R.PV, A.ADD | A.UPDATE),
+            events=[_ev(R.PVC, A.ADD | A.UPDATE,
+                        hints.volume_binding_hint),
+                    _ev(R.PV, A.ADD | A.UPDATE,
+                        hints.volume_binding_hint),
                     _ev(R.NODE, A.ADD | A.UPDATE_NODE_LABEL
                         | A.UPDATE_NODE_TAINT),
-                    _ev(R.STORAGE_CLASS, A.ADD),
-                    _ev(R.CSI_STORAGE_CAPACITY, A.ADD | A.UPDATE),
+                    _ev(R.STORAGE_CLASS, A.ADD,
+                        hints.volume_binding_hint),
+                    _ev(R.CSI_STORAGE_CAPACITY, A.ADD | A.UPDATE,
+                        hints.volume_binding_hint),
                     _ev(R.ASSIGNED_POD, A.DELETE)]),
     ]
     return {d.name: d for d in descriptors}

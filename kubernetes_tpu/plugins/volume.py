@@ -403,6 +403,15 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ScorePlugin,
                                    and sc2.provisioner else None)
                 plan.append(("unbound", (cands, provision_class, pvc)))
         state.write(self.PLAN_KEY, plan)
+        # per-class capacity index, built once per pod and probed per
+        # node by Filter/Score
+        cap_index = {}
+        for kind, data in plan:
+            if kind == "unbound" and data[1] is not None:
+                cls = data[1].metadata.name
+                if cls not in cap_index:
+                    cap_index[cls] = self._class_capacities(cls)
+        state.write(self.PLAN_KEY + "/caps", cap_index)
         return Status()
 
     # --- dynamic provisioning checks (binder.go checkVolumeProvisions) ---
@@ -424,34 +433,52 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ScorePlugin,
                 return True
         return False
 
-    def _node_capacity_for(self, sc, node) -> Optional[int]:
-        """Largest published CSIStorageCapacity (bytes) covering this
-        (class, node), None when the driver publishes nothing for the
-        class — no capacity objects means no capacity checking
-        (binder.go hasEnoughCapacity's CSIDriver gate)."""
-        best = None
-        found_class = False
+    def _class_capacities(self, class_name: str) -> list:
+        """All published CSIStorageCapacity entries for one class — ONE
+        hub scan per pod (cached per class per call site), probed per
+        node. The per-(node, claim) full-list rescan held the hub lock
+        O(nodes x claims x capacities) times per pod."""
+        out = []
         for cap in self.hub.list_csi_capacities():
-            if cap.storage_class_name != sc.metadata.name:
-                continue
-            found_class = True
-            if cap.node_topology is not None and not label_selector_matches(
-                    cap.node_topology, node.metadata.labels):
-                continue
-            v = parse_bytes(cap.capacity)
-            if best is None or v > best:
-                best = v
-        if best is None and not found_class:
-            return None
-        return best or 0
+            if cap.storage_class_name == class_name:
+                out.append((cap.node_topology, parse_bytes(cap.capacity)))
+        return out
 
-    def _provision_ok(self, sc, pvc, node) -> bool:
+    @staticmethod
+    def _capacity_on_node(entries: list, node) -> Optional[int]:
+        """Largest capacity among ``entries`` covering ``node``; None for
+        an empty entry list — a class whose driver publishes nothing is
+        exempt from capacity checking (binder.go hasEnoughCapacity's
+        CSIDriver gate)."""
+        if not entries:
+            return None
+        best = 0
+        for sel, v in entries:
+            if sel is not None and not label_selector_matches(
+                    sel, node.metadata.labels):
+                continue
+            if v > best:
+                best = v
+        return best
+
+    def _node_capacity_for(self, sc, node) -> Optional[int]:
+        return self._capacity_on_node(
+            self._class_capacities(sc.metadata.name), node)
+
+    def _provision_ok(self, sc, pvc, node, entries=None) -> Optional[str]:
+        """None when the node can host the provisioning; an unschedulable
+        message otherwise (topology vs capacity attributed distinctly)."""
         if not self._topology_allows(sc, node):
-            return False
-        cap = self._node_capacity_for(sc, node)
+            return "node(s) did not satisfy the storage class's " \
+                   "allowedTopologies"
+        cap = self._capacity_on_node(
+            self._class_capacities(sc.metadata.name)
+            if entries is None else entries, node)
         if cap is None:
-            return True         # driver publishes no capacity: no check
-        return cap >= parse_bytes(pvc.spec.requests.get("storage", "0"))
+            return None         # driver publishes no capacity: no check
+        if cap >= parse_bytes(pvc.spec.requests.get("storage", "0")):
+            return None
+        return "node(s) did not have enough free storage"
 
     # --- matching (scheduler_binder.go findMatchingVolumes) ---
 
@@ -484,6 +511,7 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ScorePlugin,
 
     def filter(self, state, pod: Pod, node_info) -> Status:
         node = node_info.node
+        cap_index = state.read(self.PLAN_KEY + "/caps") or {}
         for kind, data in state.read(self.PLAN_KEY) or []:
             if kind == "bound":
                 pv, _pvc = data
@@ -496,13 +524,13 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ScorePlugin,
             if any(node_selector_matches(pv.spec.node_affinity, node)
                    for pv in cands):
                 continue            # a static PV covers it on this node
-            if provision_class is not None and self._provision_ok(
-                    provision_class, pvc, node):
-                continue            # dynamic provisioning covers it
             if provision_class is not None:
-                return Status.unschedulable(
-                    "node(s) did not have enough free storage",
-                    plugin=self.NAME)
+                why = self._provision_ok(
+                    provision_class, pvc, node,
+                    entries=cap_index.get(provision_class.metadata.name))
+                if why is None:
+                    continue        # dynamic provisioning covers it
+                return Status.unschedulable(why, plugin=self.NAME)
             return Status.unschedulable(
                 "node(s) didn't find available persistent volumes to bind",
                 plugin=self.NAME)
@@ -552,8 +580,11 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ScorePlugin,
                 entry[1] += parse_bytes(
                     pv.spec.capacity.get("storage", "0"))
         else:
+            cap_index = state.read(self.PLAN_KEY + "/caps") or {}
             for want, provision_class, cls in dynamic:
-                cap = self._node_capacity_for(provision_class, node)
+                cap = self._capacity_on_node(
+                    cap_index.get(cls,
+                                  self._class_capacities(cls)), node)
                 if cap:
                     entry = by_class.setdefault(cls, [0, 0])
                     entry[0] += want

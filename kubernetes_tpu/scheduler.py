@@ -189,6 +189,9 @@ class Scheduler:
                             else [g for gs in gates for g in gs])
         self._has_host_scores = any(fw.has_host_scores()
                                     for fw in self.frameworks.values())
+        sgates = [fw.host_score_gates() for fw in self.frameworks.values()]
+        self._host_score_gates = (None if any(g is None for g in sgates)
+                                  else [g for gs in sgates for g in gs])
         # pods popped but deferred to a later batch (host-serial volume
         # conflicts — see _defer_host_conflicts); still in-flight queue-wise
         self._deferred: list[QueuedPodInfo] = []
@@ -285,17 +288,18 @@ class Scheduler:
                             ClusterEvent(R.PVC, A.UPDATE), old, new))))
         self.hub.watch_resource_slices(EventHandlers(
             on_add=w(lambda o: self.queue.move_all_to_active_or_backoff(
-                ClusterEvent(R.WILDCARD, A.ADD), None, o)),
+                ClusterEvent(R.RESOURCE_SLICE, A.ADD), None, o)),
             on_delete=w(lambda o: self.queue.move_all_to_active_or_backoff(
-                ClusterEvent(R.WILDCARD, A.DELETE), o, None))))
+                ClusterEvent(R.RESOURCE_SLICE, A.DELETE), o, None))))
         self.hub.watch_resource_claims(EventHandlers(
             on_add=w(lambda o: self.queue.move_all_to_active_or_backoff(
-                ClusterEvent(R.WILDCARD, A.ADD), None, o)),
+                ClusterEvent(R.RESOURCE_CLAIM, A.ADD), None, o)),
             on_update=w(lambda old, new:
                         self.queue.move_all_to_active_or_backoff(
-                            ClusterEvent(R.WILDCARD, A.UPDATE), old, new)),
+                            ClusterEvent(R.RESOURCE_CLAIM, A.UPDATE),
+                            old, new)),
             on_delete=w(lambda o: self.queue.move_all_to_active_or_backoff(
-                ClusterEvent(R.WILDCARD, A.DELETE), o, None))))
+                ClusterEvent(R.RESOURCE_CLAIM, A.DELETE), o, None))))
         self.hub.watch_pvs(EventHandlers(
             on_add=w(lambda o: self.queue.move_all_to_active_or_backoff(
                 ClusterEvent(R.PV, A.ADD), None, o)),
@@ -608,7 +612,14 @@ class Scheduler:
         return runnable, out, self.now(), self.now() - t_cycle0
 
     def _host_relevant(self, pod: Pod) -> bool:
-        if self._has_host_scores or self._host_gates is None:
+        if self._host_gates is None:
+            return True
+        if self._has_host_scores and (
+                self._host_score_gates is None
+                or any(g(pod) for g in self._host_score_gates)):
+            # host scoring applies to this pod (per-plugin applies()
+            # probes — a host scorer must not re-route PLAIN pods
+            # through the per-node Python score loop)
             return True
         if any(ext.is_interested(pod) for ext in self._extenders):
             return True
