@@ -83,6 +83,23 @@ POD_TOPO_FIELDS = (
 ) + ("paff_weight", "panti_weight")
 
 _unpack_cluster_jit = jax.jit(unpack_cluster, static_argnums=1)
+
+
+def _f32_ceil(x) -> np.float32:
+    """Smallest float32 >= x (x exact in float64 for byte values < 2^53:
+    /MI is a power-of-two scale). Demand rounds UP. Comparisons go
+    through python float: NEP-50 weak promotion would otherwise demote
+    x to float32 and hide the rounding error being tested for."""
+    v = np.float32(x)
+    return v if float(v) >= float(x) else np.nextafter(v,
+                                                       np.float32(np.inf))
+
+
+def _f32_floor(x) -> np.float32:
+    """Largest float32 <= x. Capacity rounds DOWN."""
+    v = np.float32(x)
+    return v if float(v) <= float(x) else np.nextafter(v,
+                                                       np.float32(-np.inf))
 _unpack_pods_jit = jax.jit(unpack_pods, static_argnums=1)
 
 
@@ -318,14 +335,26 @@ class Mirror:
             self._ext_index[resource_name] = col = nxt
         return col
 
-    def _res_row(self, r: Resource) -> np.ndarray:
+    def _res_row(self, r: Resource, capacity: bool = False) -> np.ndarray:
+        """Pack a Resource into its f32 column image. f32 is EXACT for
+        Mi-granular memory up to 16 TiB and integer values up to 2^24
+        (ops/features.py unit notes) — but odd-byte memory or huge
+        extended-resource counts are not representable, and a silently
+        nearest-rounded image could flip the device fit compare against
+        the exact-integer semantics of fitsRequest (fit.go:509-592).
+        Non-representable quantities are therefore rounded
+        CONSERVATIVELY: demand (pod requests, per-node requested sums)
+        rounds UP, ``capacity=True`` (node allocatable) rounds DOWN —
+        free = alloc_down - sum(req_up) can only under-state headroom,
+        so a placement the device accepts always fits exactly."""
         row = np.zeros((self.caps.res_cols,), np.float32)
-        row[F.COL_CPU] = r.milli_cpu
-        row[F.COL_MEM] = r.memory / MI
-        row[F.COL_EPH] = r.ephemeral_storage / MI
+        rnd = _f32_floor if capacity else _f32_ceil
+        row[F.COL_CPU] = rnd(r.milli_cpu)
+        row[F.COL_MEM] = rnd(r.memory / MI)
+        row[F.COL_EPH] = rnd(r.ephemeral_storage / MI)
         row[F.COL_PODS] = r.allowed_pod_number
         for name, v in r.scalar.items():
-            row[self.ext_col(name)] = v
+            row[self.ext_col(name)] = rnd(v)
         return row
 
     def _pairs(self, labels: dict[str, str], cap: int, what: str
@@ -371,7 +400,7 @@ class Mirror:
                      allocatable: np.ndarray | None = None
                      ) -> tuple[np.ndarray, np.ndarray]:
         if allocatable is None:
-            allocatable = self._res_row(info.allocatable)
+            allocatable = self._res_row(info.allocatable, capacity=True)
         free = allocatable - self._res_row(info.requested)
         free[F.COL_PODS] = info.allocatable.allowed_pod_number - len(info.pods)
         nzr = np.asarray(
@@ -424,7 +453,7 @@ class Mirror:
         node = info.node
         assert node is not None
         f: dict[str, np.ndarray] = {}
-        f["allocatable"] = self._res_row(info.allocatable)
+        f["allocatable"] = self._res_row(info.allocatable, capacity=True)
         f["free"], f["nonzero_requested"] = self._free_nzr_of(
             info, f["allocatable"])
         f["nominated_req"] = self._nominated_req_of_row.get(

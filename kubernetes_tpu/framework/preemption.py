@@ -470,22 +470,25 @@ class Evaluator:
     # ---------------- selection (preemption.go:565 pickOneNode) -----------
 
     @staticmethod
+    def candidate_key(c: Candidate):
+        """pickOneNodeForPreemption's ordering (preemption.go:565):
+        fewest PDB violations, lowest max victim priority, lowest
+        priority sum, fewest victims, latest-started important victim."""
+        prios = [v.priority() for v in c.victims]
+        high = max(prios) if prios else -(2 ** 31)
+        # latest start of the highest-priority victim: prefer evicting
+        # the youngest important pod
+        starts = [v.metadata.creation_timestamp for v in c.victims
+                  if v.priority() == high]
+        latest = max(starts) if starts else 0.0
+        return (c.pdb_violations, high, sum(prios), len(c.victims),
+                -latest, c.node_name)
+
+    @staticmethod
     def select_candidate(candidates: list[Candidate]) -> Candidate | None:
         if not candidates:
             return None
-
-        def key(c: Candidate):
-            prios = [v.priority() for v in c.victims]
-            high = max(prios) if prios else -(2 ** 31)
-            # latest start of the highest-priority victim: prefer evicting
-            # the youngest important pod
-            starts = [v.metadata.creation_timestamp for v in c.victims
-                      if v.priority() == high]
-            latest = max(starts) if starts else 0.0
-            return (c.pdb_violations, high, sum(prios), len(c.victims),
-                    -latest, c.node_name)
-
-        return min(candidates, key=key)
+        return min(candidates, key=Evaluator.candidate_key)
 
     # ---------------- execution (preemption.go:428 prepareCandidate) ------
 
@@ -1070,10 +1073,12 @@ class Evaluator:
             # the reference runs callExtenders AFTER the dry-run's
             # reprieve (preemption.go:335): minimize candidates first so
             # extenders see — and freeze — MINIMAL victim lists. Bounded
-            # to MAX_VERIFY_CANDIDATES: minimization costs device
-            # launches, and find_candidates can return one candidate per
-            # feasible row
-            candidates = candidates[:MAX_VERIFY_CANDIDATES]
+            # to MAX_VERIFY_CANDIDATES best-first (the selection order),
+            # not positionally: minimization costs device launches, and
+            # find_candidates can return one candidate per feasible row
+            candidates = sorted(
+                candidates,
+                key=Evaluator.candidate_key)[:MAX_VERIFY_CANDIDATES]
             candidates = [m for c in candidates
                           if (m := self._minimize_victims(pod, c,
                                                           pdbs)) is not None]

@@ -181,17 +181,6 @@ def topology_spread_hint(pod: Pod, old_obj, new_obj) -> QueueingHint:
 # PV/PVC/claim/slice event thundered the whole unschedulable pool.
 
 
-def scheduling_gates_hint(pod: Pod, old_obj, new_obj) -> QueueingHint:
-    """schedulinggates.go isSchedulableAfterUpdatePodScheduling
-    GatesEliminated: only THE pod's own gate-removal update helps."""
-    new_pod = _as_pod(new_obj)
-    if new_pod is None:
-        return SKIP
-    if new_pod.metadata.uid != pod.metadata.uid:
-        return SKIP
-    return QUEUE if not new_pod.spec.scheduling_gates else SKIP
-
-
 def _pod_host_ports(p: Pod) -> set[tuple[str, int]]:
     out = set()
     for c in p.spec.containers:
@@ -296,7 +285,8 @@ def volume_restrictions_hint(pod: Pod, old_obj, new_obj) -> QueueingHint:
             return (QUEUE if _restricted_volume_keys(pod)
                     & _restricted_volume_keys(old_pod) else SKIP)
         return (QUEUE if _pod_pvc_names(pod) & _pod_pvc_names(old_pod)
-                or _restricted_volume_keys(old_pod) else SKIP)
+                or _restricted_volume_keys(pod)
+                & _restricted_volume_keys(old_pod) else SKIP)
     if type(new_obj).__name__ == "PersistentVolumeClaim":
         return (QUEUE
                 if new_obj.metadata.namespace == pod.metadata.namespace

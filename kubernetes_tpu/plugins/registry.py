@@ -125,9 +125,11 @@ def in_tree_registry() -> dict[str, PluginDescriptor]:
         PluginDescriptor(
             name="SchedulingGates", points=("pre_enqueue",),
             factory=lambda args: SchedulingGates(),
+            # gated pods live in the queue's _gated pool and re-probe
+            # PreEnqueue directly on gate events — queueing-hint fns are
+            # never consulted for them, so no hint is registered here
             events=[_ev(R.POD,
-                        A.UPDATE_POD_SCHEDULING_GATES_ELIMINATED,
-                        hints.scheduling_gates_hint)]),
+                        A.UPDATE_POD_SCHEDULING_GATES_ELIMINATED)]),
         PluginDescriptor(
             name="PrioritySort", points=("queue_sort",),
             factory=lambda args: PrioritySort()),

@@ -582,9 +582,10 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ScorePlugin,
         else:
             cap_index = state.read(self.PLAN_KEY + "/caps") or {}
             for want, provision_class, cls in dynamic:
-                cap = self._capacity_on_node(
-                    cap_index.get(cls,
-                                  self._class_capacities(cls)), node)
+                entries = cap_index.get(cls)
+                if entries is None:     # dict.get's default would EAGERLY
+                    entries = self._class_capacities(cls)   # rescan the hub
+                cap = self._capacity_on_node(entries, node)
                 if cap:
                     entry = by_class.setdefault(cls, [0, 0])
                     entry[0] += want

@@ -195,25 +195,6 @@ def test_end_to_end_unhelpful_node_stays_parked():
 # --------------- volume / DRA / gates / ports hints ---------------
 
 
-def test_scheduling_gates_hint_only_own_pod():
-    from kubernetes_tpu.api.objects import PodSchedulingGate
-    from kubernetes_tpu.plugins.hints import scheduling_gates_hint
-
-    pod = mkpod("gated")
-    other = mkpod("other")
-    # another pod's gate removal is noise
-    assert scheduling_gates_hint(pod, other, other) == SKIP
-    # the pod's own update with gates remaining still blocks
-    still = mkpod("gated")
-    still.metadata.uid = pod.metadata.uid
-    still.spec.scheduling_gates = [PodSchedulingGate(name="hold")]
-    assert scheduling_gates_hint(pod, pod, still) == SKIP
-    # its own gate-free update queues
-    freed = mkpod("gated")
-    freed.metadata.uid = pod.metadata.uid
-    assert scheduling_gates_hint(pod, pod, freed) == QUEUE
-
-
 def test_node_ports_hint_conflicting_port_only():
     from kubernetes_tpu.api.objects import ContainerPort
     from kubernetes_tpu.plugins.hints import node_ports_hint

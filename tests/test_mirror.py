@@ -1,0 +1,70 @@
+"""Mirror packing semantics at the f32 representability boundary."""
+
+
+def test_non_mi_granular_quantities_round_conservatively():
+    """Exact-integer fit semantics at the f32 boundary (fitsRequest,
+    fit.go:509-592): odd-byte memory requests beyond float32's 2^24-MiB
+    exact range must never FALSELY fit. Demand rounds UP, capacity
+    rounds DOWN, so free = alloc_down - req_up understates headroom."""
+    import numpy as np
+
+    from kubernetes_tpu.api.objects import (
+        Container,
+        Node,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+        ResourceRequirements,
+    )
+    from kubernetes_tpu.backend.cache import Cache
+    from kubernetes_tpu.backend.mirror import MI, Mirror, _f32_ceil, \
+        _f32_floor
+    from kubernetes_tpu.backend.snapshot import Snapshot
+    from kubernetes_tpu.ops.features import COL_MEM, Capacities
+
+    tib16 = 16 * 1024 ** 4              # 16 TiB = 2^24 MiB: f32-exact edge
+    # one byte above: 2^24 MiB + 2^-20 MiB is NOT f32-representable
+    odd = tib16 + 1
+
+    assert float(_f32_ceil(odd / MI)) > odd / MI
+    assert float(_f32_floor(odd / MI)) < odd / MI
+    # Mi-granular values stay EXACT (no rounding perturbation)
+    assert float(_f32_ceil(tib16 / MI)) == tib16 / MI
+    assert float(_f32_floor(tib16 / MI)) == tib16 / MI
+
+    cache = Cache()
+    node = Node(metadata=ObjectMeta(name="n"),
+                status=NodeStatus(allocatable={
+                    "cpu": "64", "memory": str(odd), "pods": "110"}))
+    cache.add_node(node)
+    snap = Snapshot()
+    cache.update_snapshot(snap)
+    mirror = Mirror(caps=Capacities(nodes=8, pods=16))
+    mirror.sync(snap)
+    row = mirror.row_of("n")
+    free_mem = mirror.free_matrix()[row, COL_MEM]
+    # capacity rounded DOWN: the node never advertises the odd byte
+    assert float(free_mem) <= odd / MI
+
+    # a pod requesting the full odd size: request rounds UP, so the
+    # device compare req <= free must REJECT (capacity was floored)
+    pod = Pod(metadata=ObjectMeta(name="p"),
+              spec=PodSpec(containers=[Container(
+                  name="c", resources=ResourceRequirements(
+                      requests={"memory": str(odd)}))]))
+    from kubernetes_tpu.api.resources import pod_request
+
+    req = mirror._res_row(pod_request(pod))
+    assert float(req[COL_MEM]) >= odd / MI
+    assert not bool(np.all(req[COL_MEM] <= free_mem)), \
+        "odd-byte request must not falsely fit the floored capacity"
+
+    # the Mi-granular pod of the same nominal size still fits exactly
+    pod2 = Pod(metadata=ObjectMeta(name="p2"),
+               spec=PodSpec(containers=[Container(
+                   name="c", resources=ResourceRequirements(
+                       requests={"memory": str(tib16)}))]))
+    req2 = mirror._res_row(pod_request(pod2))
+    assert float(req2[COL_MEM]) == tib16 / MI
+    assert bool(req2[COL_MEM] <= free_mem)
