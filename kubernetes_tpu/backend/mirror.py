@@ -100,6 +100,19 @@ def _f32_floor(x) -> np.float32:
     v = np.float32(x)
     return v if float(v) <= float(x) else np.nextafter(v,
                                                        np.float32(-np.inf))
+
+
+def _round_row_f32(row64: np.ndarray, up: bool) -> np.ndarray:
+    """Vectorized directed f32 rounding of a float64 row (the scalar
+    helpers per column were measurable on the pod-commit fast path)."""
+    v = row64.astype(np.float32)
+    back = v.astype(np.float64)
+    m = (back < row64) if up else (back > row64)
+    if m.any():
+        v[m] = np.nextafter(v[m],
+                            np.float32(np.inf) if up
+                            else np.float32(-np.inf))
+    return v
 _unpack_pods_jit = jax.jit(unpack_pods, static_argnums=1)
 
 
@@ -335,6 +348,18 @@ class Mirror:
             self._ext_index[resource_name] = col = nxt
         return col
 
+    def _res_row64(self, r: Resource) -> np.ndarray:
+        """Exact float64 column image (exact for byte values < 2^53:
+        /MI is a power-of-two scale)."""
+        row = np.zeros((self.caps.res_cols,), np.float64)
+        row[F.COL_CPU] = r.milli_cpu
+        row[F.COL_MEM] = r.memory / MI
+        row[F.COL_EPH] = r.ephemeral_storage / MI
+        row[F.COL_PODS] = r.allowed_pod_number
+        for name, v in r.scalar.items():
+            row[self.ext_col(name)] = v
+        return row
+
     def _res_row(self, r: Resource, capacity: bool = False) -> np.ndarray:
         """Pack a Resource into its f32 column image. f32 is EXACT for
         Mi-granular memory up to 16 TiB and integer values up to 2^24
@@ -343,19 +368,13 @@ class Mirror:
         nearest-rounded image could flip the device fit compare against
         the exact-integer semantics of fitsRequest (fit.go:509-592).
         Non-representable quantities are therefore rounded
-        CONSERVATIVELY: demand (pod requests, per-node requested sums)
-        rounds UP, ``capacity=True`` (node allocatable) rounds DOWN —
-        free = alloc_down - sum(req_up) can only under-state headroom,
-        so a placement the device accepts always fits exactly."""
-        row = np.zeros((self.caps.res_cols,), np.float32)
-        rnd = _f32_floor if capacity else _f32_ceil
-        row[F.COL_CPU] = rnd(r.milli_cpu)
-        row[F.COL_MEM] = rnd(r.memory / MI)
-        row[F.COL_EPH] = rnd(r.ephemeral_storage / MI)
-        row[F.COL_PODS] = r.allowed_pod_number
-        for name, v in r.scalar.items():
-            row[self.ext_col(name)] = rnd(v)
-        return row
+        CONSERVATIVELY: demand (pod requests) rounds UP;
+        ``capacity=True`` (node allocatable, and preemption freed-amount
+        rows, which add back onto capacity) rounds DOWN. Differences
+        like free = alloc - requested are computed in float64 and
+        floored (_free_nzr_of): subtracting two f32 images would round
+        to NEAREST and could overstate headroom."""
+        return _round_row_f32(self._res_row64(r), up=not capacity)
 
     def _pairs(self, labels: dict[str, str], cap: int, what: str
                ) -> tuple[np.ndarray, np.ndarray]:
@@ -396,12 +415,12 @@ class Mirror:
         off, size = self.node_codec._f32_off["free"]
         return self.node_f32[:, off:off + size].copy()
 
-    def _free_nzr_of(self, info: NodeInfo,
-                     allocatable: np.ndarray | None = None
-                     ) -> tuple[np.ndarray, np.ndarray]:
-        if allocatable is None:
-            allocatable = self._res_row(info.allocatable, capacity=True)
-        free = allocatable - self._res_row(info.requested)
+    def _free_nzr_of(self, info: NodeInfo) -> tuple[np.ndarray,
+                                                    np.ndarray]:
+        # exact float64 difference, floored into f32: alloc_f32 - req_f32
+        # would round to NEAREST and can overstate the exact free
+        free = _round_row_f32(self._res_row64(info.allocatable)
+                              - self._res_row64(info.requested), up=False)
         free[F.COL_PODS] = info.allocatable.allowed_pod_number - len(info.pods)
         nzr = np.asarray(
             [info.non_zero_requested.milli_cpu,
@@ -454,8 +473,7 @@ class Mirror:
         assert node is not None
         f: dict[str, np.ndarray] = {}
         f["allocatable"] = self._res_row(info.allocatable, capacity=True)
-        f["free"], f["nonzero_requested"] = self._free_nzr_of(
-            info, f["allocatable"])
+        f["free"], f["nonzero_requested"] = self._free_nzr_of(info)
         f["nominated_req"] = self._nominated_req_of_row.get(
             row, np.zeros((caps.res_cols,), np.float32))
         f["node_valid"] = np.bool_(True)

@@ -261,15 +261,20 @@ class Evaluator:
             jnp.asarray(tval), jnp.asarray(free), enable,
             mirror.launch_d_cap(enable), self._get_enabled_filters(pod)))
 
-    def _res_row_cached(self, pod: Pod) -> np.ndarray:
+    def _res_row_cached(self, pod: Pod, freed: bool = False) -> np.ndarray:
+        """A pod's f32 resource row: demand (the preemptor's request)
+        rounds UP; ``freed=True`` (a victim's contribution handed back
+        to capacity) rounds DOWN — summing ceiled victim rows onto free
+        would overstate post-eviction headroom and evict pods for a
+        preemption that cannot succeed."""
         from kubernetes_tpu.api.resources import pod_request
 
-        uid = pod.metadata.uid
-        rr = self._res_rows.get(uid)
+        key = (pod.metadata.uid, freed)
+        rr = self._res_rows.get(key)
         if rr is None:
-            rr = np.asarray(self._get_mirror()._res_row(pod_request(pod)),
-                            np.float32)
-            self._res_rows[uid] = rr
+            rr = np.asarray(self._get_mirror()._res_row(
+                pod_request(pod), capacity=freed), np.float32)
+            self._res_rows[key] = rr
         return rr
 
     def _minimize_victims(self, pod: Pod, cand: Candidate,
@@ -294,9 +299,10 @@ class Evaluator:
         def feasible_with(vset: list[Pod]) -> bool:
             if not vset:
                 return False
-            freed = np.zeros_like(self._res_row_cached(vset[0]))
+            freed = np.zeros_like(self._res_row_cached(vset[0],
+                                                       freed=True))
             for v in vset:
-                freed = freed + self._res_row_cached(v)
+                freed = freed + self._res_row_cached(v, freed=True)
             feas = self._dryrun_feasible(
                 pod, {v.metadata.uid for v in vset}, {row: freed})
             return bool(feas[row])
@@ -464,7 +470,7 @@ class Evaluator:
             free = free + req
         freed = np.zeros_like(req)
         for v in victims:
-            freed = freed + self._res_row_cached(v)
+            freed = freed + self._res_row_cached(v, freed=True)
         return bool(np.all(req <= free + freed))
 
     # ---------------- selection (preemption.go:565 pickOneNode) -----------
@@ -565,7 +571,7 @@ class Evaluator:
         freed = np.zeros_like(req)
         rows = {}
         for v in victims:
-            rows[v.metadata.uid] = self._res_row_cached(v)
+            rows[v.metadata.uid] = self._res_row_cached(v, freed=True)
             freed = freed + rows[v.metadata.uid]
         kept: list[Pod] = list(victims)
         # most important first: priority desc, oldest first
@@ -609,13 +615,14 @@ class Evaluator:
         return self._rebuild_victims(prio, snapshot, mirror, caps)
 
     def _res_row_of(self, pi) -> np.ndarray:
-        """Victim res row via the uid-keyed cache (immutable per mirror)."""
-        uid = pi.pod.metadata.uid
-        rr = self._res_rows.get(uid)
+        """Victim freed-amount row (floored — it adds back to capacity),
+        via the (uid, freed=True) cache key space."""
+        key = (pi.pod.metadata.uid, True)
+        rr = self._res_rows.get(key)
         if rr is None:
-            rr = np.asarray(self._get_mirror()._res_row(pi.request),
-                            np.float32)
-            self._res_rows[uid] = rr
+            rr = np.asarray(self._get_mirror()._res_row(
+                pi.request, capacity=True), np.float32)
+            self._res_rows[key] = rr
         return rr
 
     @staticmethod
@@ -678,11 +685,12 @@ class Evaluator:
             row_ids[i] = row
             k_arr[i] = len(vs)
             for pi in vs:
-                uid = pi.pod.metadata.uid
-                rr = res_rows.get(uid)
+                key = (pi.pod.metadata.uid, True)
+                rr = res_rows.get(key)
                 if rr is None:
-                    rr = np.asarray(mirror._res_row(pi.request), np.float32)
-                    res_rows[uid] = rr
+                    rr = np.asarray(mirror._res_row(
+                        pi.request, capacity=True), np.float32)
+                    res_rows[key] = rr
                 flat_rows.append(rr)
         stacked_all = np.stack(flat_rows)                     # [V, R]
         active = set(np.nonzero(stacked_all.any(axis=0))[0].tolist())

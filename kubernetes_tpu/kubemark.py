@@ -1,0 +1,153 @@
+"""Kubemark-style hollow nodes: fake kubelets against the HTTP hub.
+
+From-scratch equivalent of the reference's kubemark rung
+(pkg/kubemark/hollow_kubelet.go:63, cmd/kubemark/hollow-node.go): a
+standalone process registers N Node objects against a REAL (HTTP) hub,
+heartbeats them, watches for pods bound to its nodes, and acks each
+binding by driving the pod's status to Running — 5k-node-scale control
+plane testing with no machines behind the nodes.
+
+Run against a hubserver:
+
+    python -m kubernetes_tpu.hubserver --port 8080      # (or in-process)
+    python -m kubernetes_tpu.kubemark --hub http://127.0.0.1:8080 \
+        --nodes 1000 [--prefix hollow] [--heartbeat 10]
+
+The scheduler (kubernetes_tpu --hub ...) then schedules onto the hollow
+nodes exactly as it would onto real ones; tests/test_kubemark.py drives
+the whole stack across three processes' worth of components.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from kubernetes_tpu.api.objects import (
+    LABEL_HOSTNAME,
+    LABEL_ZONE,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+)
+from kubernetes_tpu.hub import EventHandlers
+
+PHASE_RUNNING = "Running"
+
+
+class HollowNodes:
+    """N hollow kubelets sharing one hub client (the reference runs one
+    process per hollow node; one feeder process with N node identities
+    registers the same API objects at a fraction of the overhead)."""
+
+    def __init__(self, hub, count: int, prefix: str = "hollow",
+                 cpu: str = "4", memory: str = "32Gi", pods: str = "110",
+                 zones: int = 0):
+        self.hub = hub
+        self.prefix = prefix
+        self.names: set[str] = set()
+        self.acked: set[str] = set()        # pod uids driven to Running
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._hb: threading.Thread | None = None
+        for i in range(count):
+            name = f"{prefix}-{i}"
+            labels = {LABEL_HOSTNAME: name}
+            if zones:
+                labels[LABEL_ZONE] = f"zone-{i % zones}"
+            node = Node(metadata=ObjectMeta(name=name, labels=labels),
+                        spec=NodeSpec(),
+                        status=NodeStatus(allocatable={
+                            "cpu": cpu, "memory": memory, "pods": pods}))
+            self.hub.create_node(node)
+            self.names.add(name)
+        # ack bindings: the kubelet side of the contract — a pod bound to
+        # one of OUR nodes gets its status driven to Running
+        # (hollow_kubelet runs a real kubelet loop against a fake runtime;
+        # the scheduler-visible effect is exactly this status update)
+        self.hub.watch_pods(EventHandlers(
+            on_add=self._maybe_ack,
+            on_update=lambda old, new: self._maybe_ack(new)))
+
+    def _maybe_ack(self, pod: Pod) -> None:
+        if pod.spec.node_name not in self.names:
+            return
+        if pod.status.phase == PHASE_RUNNING:
+            return
+        # re-fetch and mutate only the phase: the watch-event object can
+        # be STALE, and hub updates are last-write-wins — writing a clone
+        # of the event object back would roll back any field another
+        # writer (the scheduler's status patches) set in between
+        fresh = self.hub.get_pod(pod.metadata.uid)
+        if fresh is None or fresh.status.phase == PHASE_RUNNING:
+            return
+        new = fresh.clone()
+        new.status.phase = PHASE_RUNNING
+        try:
+            self.hub.update_pod(new)
+        except Exception:  # noqa: BLE001 — pod vanished mid-ack; the
+            return         # next watch event (if any) retries
+        with self._lock:
+            self.acked.add(pod.metadata.uid)
+
+    def ack_count(self) -> int:
+        with self._lock:
+            return len(self.acked)
+
+    # --- heartbeats (node-status updater) ---
+
+    def start_heartbeat(self, interval_s: float = 10.0) -> None:
+        def beat() -> None:
+            while not self._stop.wait(interval_s):
+                for name in list(self.names):
+                    node = self.hub.get_node(name)
+                    if node is None:
+                        continue
+                    hb = node.clone() if hasattr(node, "clone") else node
+                    hb.metadata.annotations["kubemark.alpha/heartbeat"] = \
+                        str(time.time())
+                    try:
+                        self.hub.update_node(hb)
+                    except Exception:  # noqa: BLE001 — hub restarting
+                        pass
+
+        self._hb = threading.Thread(target=beat, daemon=True,
+                                    name="kubemark-heartbeat")
+        self._hb.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._hb is not None:
+            self._hb.join(timeout=5)
+
+
+def main() -> None:
+    import argparse
+
+    from kubernetes_tpu.hubclient import RemoteHub
+
+    ap = argparse.ArgumentParser(description="kubemark hollow-node feeder")
+    ap.add_argument("--hub", required=True, help="hub URL")
+    ap.add_argument("--nodes", type=int, default=100)
+    ap.add_argument("--prefix", default="hollow")
+    ap.add_argument("--zones", type=int, default=0)
+    ap.add_argument("--heartbeat", type=float, default=0.0,
+                    help="node heartbeat interval seconds (0 = off)")
+    args = ap.parse_args()
+    client = RemoteHub(args.hub)
+    hollow = HollowNodes(client, args.nodes, prefix=args.prefix,
+                         zones=args.zones)
+    if args.heartbeat:
+        hollow.start_heartbeat(args.heartbeat)
+    print(f"kubemark: {args.nodes} hollow nodes registered", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        hollow.stop()
+
+
+if __name__ == "__main__":
+    main()
