@@ -67,6 +67,29 @@ def main() -> None:
     headline = None
     env = dict(os.environ)
     env["PYTHONPATH"] = _repo + os.pathsep + env.get("PYTHONPATH", "")
+    if not smoke and "--no-test-gate" not in sys.argv:
+        # a round must not publish benchmark numbers over a red suite:
+        # run the CI gate first and REFUSE on failure (the tests force
+        # the virtual-CPU platform via tests/conftest.py, so this never
+        # touches the TPU the measurements need)
+        print("bench: running the test gate (pytest -q)...",
+              file=sys.stderr)
+        try:
+            gate = subprocess.run(
+                [sys.executable, "-m", "pytest", "tests/", "-q",
+                 "--maxfail", "5"],
+                capture_output=True, text=True, timeout=3600, env=env,
+                cwd=_repo)
+        except subprocess.TimeoutExpired:
+            print("bench: TEST SUITE TIMED OUT — refusing to benchmark",
+                  file=sys.stderr)
+            sys.exit(1)
+        if gate.returncode != 0:
+            print("bench: TEST SUITE RED — refusing to benchmark\n"
+                  + gate.stdout[-3000:] + "\n" + gate.stderr[-1500:],
+                  file=sys.stderr)
+            sys.exit(1)
+        print("bench: test gate green", file=sys.stderr)
     for fn in BENCH_WORKLOAD_FNS:
         try:
             proc = subprocess.run(

@@ -415,12 +415,15 @@ class Mirror:
         off, size = self.node_codec._f32_off["free"]
         return self.node_f32[:, off:off + size].copy()
 
-    def _free_nzr_of(self, info: NodeInfo) -> tuple[np.ndarray,
-                                                    np.ndarray]:
+    def _free_nzr_of(self, info: NodeInfo,
+                     alloc64: np.ndarray | None = None
+                     ) -> tuple[np.ndarray, np.ndarray]:
         # exact float64 difference, floored into f32: alloc_f32 - req_f32
         # would round to NEAREST and can overstate the exact free
-        free = _round_row_f32(self._res_row64(info.allocatable)
-                              - self._res_row64(info.requested), up=False)
+        if alloc64 is None:
+            alloc64 = self._res_row64(info.allocatable)
+        free = _round_row_f32(alloc64 - self._res_row64(info.requested),
+                              up=False)
         free[F.COL_PODS] = info.allocatable.allowed_pod_number - len(info.pods)
         nzr = np.asarray(
             [info.non_zero_requested.milli_cpu,
@@ -472,8 +475,9 @@ class Mirror:
         node = info.node
         assert node is not None
         f: dict[str, np.ndarray] = {}
-        f["allocatable"] = self._res_row(info.allocatable, capacity=True)
-        f["free"], f["nonzero_requested"] = self._free_nzr_of(info)
+        alloc64 = self._res_row64(info.allocatable)
+        f["allocatable"] = _round_row_f32(alloc64, up=False)
+        f["free"], f["nonzero_requested"] = self._free_nzr_of(info, alloc64)
         f["nominated_req"] = self._nominated_req_of_row.get(
             row, np.zeros((caps.res_cols,), np.float32))
         f["node_valid"] = np.bool_(True)

@@ -90,7 +90,7 @@ class Evaluator:
         # uid FOR A GIVEN MIRROR — a re-bucketed mirror changes res_cols and
         # ext-resource column order, so the cache is tied to the mirror
         # object and dropped when the scheduler rebuilds it
-        self._res_rows: dict[str, np.ndarray] = {}
+        self._res_rows: dict[tuple[str, bool], np.ndarray] = {}
         self._res_rows_mirror: object = None
         # async preemption (preemption.go:460 prepareCandidateAsync +
         # kep 4832): pods whose victims are still being evicted, and the

@@ -98,9 +98,21 @@ class HollowNodes:
 
     # --- heartbeats (node-status updater) ---
 
+    def resync_acks(self) -> None:
+        """Retry acks a transient update failure dropped: a bound pod
+        generates no further watch events, so the status loop (like the
+        kubelet's) rescans bound-but-not-Running pods on our nodes."""
+        try:
+            pods = self.hub.list_pods()
+        except Exception:  # noqa: BLE001 — hub restarting
+            return
+        for pod in pods:
+            self._maybe_ack(pod)
+
     def start_heartbeat(self, interval_s: float = 10.0) -> None:
         def beat() -> None:
             while not self._stop.wait(interval_s):
+                self.resync_acks()
                 for name in list(self.names):
                     node = self.hub.get_node(name)
                     if node is None:

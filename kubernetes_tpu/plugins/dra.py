@@ -206,6 +206,13 @@ class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin,
         self._claim_rv: dict[str, int] = {}         # claim key -> newest rv
         self._node_devices: dict[str, list] = {}    # node -> [(drv,pool,Device)]
         self._slice_entries: dict[str, tuple] = {}  # slice uid -> (node, n)
+        # (epoch, expression, id(device)) -> bool; devices are held
+        # strongly by _node_devices while their verdicts matter, and the
+        # epoch bumps on slice removal so an allocator thread racing the
+        # removal can only insert entries no future lookup reaches
+        # (id(dev) may be reused after GC)
+        self._sel_cache: dict[tuple, bool] = {}
+        self._sel_epoch = 0
         hub.watch_resource_claims(EventHandlers(
             on_add=self._claim_event,
             on_update=lambda old, new: self._claim_event(new),
@@ -287,6 +294,11 @@ class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin,
                 (drv, pl, dev)
                 for drv, pl, dev in self._node_devices.get(node, [])
                 if not (drv == driver and pl == pool and dev.name in names)]
+            # dropped Device objects may be GC'd and their ids reused —
+            # bump the epoch (old-epoch keys become unreachable even if a
+            # racing allocator inserts after this clear) and drop the bulk
+            self._sel_epoch += 1
+            self._sel_cache.clear()
 
     def _in_use_view(self, exclude_keys: set[str]) -> set[tuple]:
         """Triples taken by any claim — ledger truth overlaid with assumed
@@ -326,6 +338,27 @@ class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin,
 
     # --- the structured allocator (the reference's staging allocator) ---
 
+    def _selector_accepts(self, expression: str, entry) -> bool:
+        """One CEL selector against one device, MEMOIZED: a device's
+        attributes are immutable for its lifetime in the slice index, so
+        (expression, device) verdicts never change — without the cache
+        the steady-state template workload re-evaluates the same
+        expression over the same 800 devices for every (pod, node)."""
+        driver, _pool, dev = entry
+        key = (self._sel_epoch, expression, id(dev))
+        hit = self._sel_cache.get(key)
+        if hit is not None:
+            return hit
+        try:
+            ok = evaluate(expression,
+                          CelDevice(driver, dev.attributes, dev.capacity))
+        except CelError:
+            ok = False
+        if len(self._sel_cache) > 500_000:
+            self._sel_cache.clear()
+        self._sel_cache[key] = ok
+        return ok
+
     def _device_matches(self, entry, class_name: str, device_class,
                         selectors) -> bool:
         """entry = (driver, pool, Device). DeviceClass CEL selectors (or
@@ -334,26 +367,17 @@ class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin,
         ``device_class`` is the pre-resolved DeviceClass (resolved once
         per alternative, not per device — the allocator runs this for
         every device on every candidate node)."""
-        driver, _pool, dev = entry
-        cel_dev = None
+        _driver, _pool, dev = entry
         if class_name:
             if device_class is not None:
-                cel_dev = CelDevice(driver, dev.attributes, dev.capacity)
                 for sel in device_class.selectors:
-                    try:
-                        if not evaluate(sel.cel_expression, cel_dev):
-                            return False
-                    except CelError:
+                    if not self._selector_accepts(sel.cel_expression,
+                                                  entry):
                         return False
             elif dev.device_class_name != class_name:
                 return False
         for sel in selectors:
-            if cel_dev is None:
-                cel_dev = CelDevice(driver, dev.attributes, dev.capacity)
-            try:
-                if not evaluate(sel.cel_expression, cel_dev):
-                    return False
-            except CelError:
+            if not self._selector_accepts(sel.cel_expression, entry):
                 return False
         return True
 
