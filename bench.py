@@ -61,6 +61,23 @@ BENCH_WORKLOAD_FNS = (
 
 
 def main() -> None:
+    if "--chaos-smoke" in sys.argv:
+        # red-suite gate: one short chaos scenario (scheduler + kubemark
+        # through the fault-injecting proxy) must hold the storm
+        # invariants — no double-bind, no lost pod, cache–hub converged
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _repo + os.pathsep + env.get("PYTHONPATH", "")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "kubernetes_tpu.chaos"],
+            capture_output=True, text=True, timeout=600, env=env,
+            cwd=_repo)
+        out = proc.stdout.strip().splitlines()
+        print(out[-1] if out else '{"ok": false, "error": "no output"}')
+        if proc.returncode != 0:
+            print(f"chaos smoke FAILED\n{proc.stderr[-2000:]}",
+                  file=sys.stderr)
+        sys.exit(proc.returncode)
     smoke = "--smoke" in sys.argv
     scale = "0.02" if smoke else "1.0"
     results = {}

@@ -20,6 +20,9 @@ import uuid
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="kubernetes-tpu-scheduler")
     parser.add_argument("--config", help="component config file (JSON/YAML)")
+    parser.add_argument("--hub", default=None,
+                        help="remote hub URL (http://host:port); default "
+                             "is an in-process demo hub")
     parser.add_argument("--bind-address", default="127.0.0.1")
     parser.add_argument("--secure-port", type=int, default=10259,
                         help="serving port for /metrics,/healthz,/configz "
@@ -60,7 +63,16 @@ def main(argv=None) -> int:
         print("configuration valid")
         return 0
 
-    hub = Hub()
+    if args.hub:
+        # the kubemark/hubserver deployment shape: this process holds no
+        # state, it list/watches a hub in another process and rides the
+        # hub-client resilience machinery through its outages
+        from kubernetes_tpu.hubclient import RemoteHub
+
+        hub = RemoteHub(args.hub)
+        print(f"using remote hub {args.hub}", file=sys.stderr)
+    else:
+        hub = Hub()
     sched = Scheduler(hub, cfg)
 
     serving = None
@@ -130,6 +142,8 @@ def main(argv=None) -> int:
         if serving is not None:
             serving.stop()
         sched.close()
+        if args.hub:
+            hub.close()
     return 0
 
 

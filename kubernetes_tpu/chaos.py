@@ -1,0 +1,479 @@
+"""Chaos/fault-injection layer: prove the control plane tolerates churn.
+
+Kant (PAPERS.md) and SURVEY §5.3/§5.8 make failure detection/recovery a
+first-class scheduler component; this module is the harness that injects
+the failures the resilience machinery (utils/backoff, RemoteHub retry +
+reconnect, scheduler degraded mode, leader renew-deadline) must survive.
+Two injection points, both seeded-deterministic:
+
+* ``ChaosHub`` — wraps any in-process Hub; every RPC-shaped verb (the
+  hubserver CALL_METHODS surface, leases included) can be delayed, can
+  fail with ``Unavailable``, and can be blacked out wholesale for a
+  timed partition window. Watch registration passes through untouched —
+  stream-level chaos belongs to the proxy, where a real network cut
+  happens.
+* ``ChaosProxy`` — an HTTP-level man-in-the-middle between a RemoteHub
+  and a hubserver: injects per-call latency, 5xx error responses,
+  connection aborts, mid-stream watch cuts (after N events or by rate),
+  and timed partition windows during which every connection is severed.
+  The client under test talks to ``proxy.address`` exactly as it would
+  to the hub; nothing in the client knows chaos exists.
+
+``run_smoke()`` drives one short end-to-end scenario (scheduler +
+kubemark hollow nodes through the proxy under call faults, a watch cut,
+and a partition) and asserts the storm invariants: no double-bind, no
+lost pod, cache–hub convergence. ``bench.py --chaos-smoke`` runs it as a
+red-suite gate.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubernetes_tpu.hub import Unavailable
+from kubernetes_tpu.hubserver import CALL_METHODS
+
+
+@dataclass
+class ChaosConfig:
+    """Fault knobs. All injection draws from ONE seeded rng, so a given
+    (seed, call sequence) replays the same fault schedule."""
+
+    seed: int = 0
+    call_error_rate: float = 0.0     # P(injected failure) per call
+    call_abort_rate: float = 0.0     # proxy only: P(connection abort)
+    call_latency: float = 0.0        # fixed added seconds per call
+    call_latency_jitter: float = 0.0  # + uniform(0, jitter)
+    watch_cut_every: int = 0         # cut after relaying N live events
+                                     # (the N+1th is dropped; 0 = off)
+    watch_cut_rate: float = 0.0      # P(cut) per relayed event
+
+
+class _FaultClock:
+    """Shared, lock-guarded fault state: config + rng + partition window
+    + counters. One instance backs a ChaosHub or a ChaosProxy."""
+
+    def __init__(self, config: ChaosConfig | None):
+        self.config = config or ChaosConfig()
+        self.rng = random.Random(self.config.seed)
+        self.lock = threading.Lock()
+        self.partition_until = 0.0
+        self.stats = {"injected_errors": 0, "injected_aborts": 0,
+                      "injected_cuts": 0, "partitions": 0,
+                      "calls_seen": 0, "events_relayed": 0}
+
+    def set_fault(self, **kw) -> None:
+        with self.lock:
+            for k, v in kw.items():
+                if not hasattr(self.config, k):
+                    raise AttributeError(f"unknown fault knob {k!r}")
+                setattr(self.config, k, v)
+
+    def partition_for(self, seconds: float) -> None:
+        with self.lock:
+            self.partition_until = time.monotonic() + seconds
+            self.stats["partitions"] += 1
+
+    def heal(self) -> None:
+        with self.lock:
+            self.partition_until = 0.0
+
+    @property
+    def partitioned(self) -> bool:
+        with self.lock:
+            return time.monotonic() < self.partition_until
+
+    def draw(self, rate: float) -> bool:
+        if rate <= 0:
+            return False
+        with self.lock:
+            return self.rng.random() < rate
+
+    def latency(self) -> float:
+        c = self.config
+        if c.call_latency <= 0 and c.call_latency_jitter <= 0:
+            return 0.0
+        with self.lock:
+            return c.call_latency + (
+                self.rng.uniform(0, c.call_latency_jitter)
+                if c.call_latency_jitter > 0 else 0.0)
+
+    def count(self, key: str, n: int = 1) -> None:
+        with self.lock:
+            self.stats[key] += n
+
+
+# --------------------------------------------------------------------------
+# ChaosHub: in-process fault injection
+# --------------------------------------------------------------------------
+
+
+class _ChaosLeases:
+    def __init__(self, chub: "ChaosHub"):
+        self._chub = chub
+
+    def get(self, name: str):
+        self._chub._maybe_fault("leases.get")
+        return self._chub._inner.leases.get(name)
+
+    def update(self, lease, expect_holder) -> bool:
+        self._chub._maybe_fault("leases.update")
+        return self._chub._inner.leases.update(lease, expect_holder)
+
+
+class ChaosHub:
+    """Wrap any Hub; RPC-shaped verbs gain injected latency, error rate,
+    and partition windows. Watches and non-CALL attributes delegate."""
+
+    def __init__(self, hub, config: ChaosConfig | None = None,
+                 sleep=time.sleep):
+        self._inner = hub
+        self._clock = _FaultClock(config)
+        self._sleep = sleep
+        self.leases = _ChaosLeases(self)
+
+    # --- chaos controls -------------------------------------------------
+
+    def set_fault(self, **kw) -> None:
+        self._clock.set_fault(**kw)
+
+    def partition_for(self, seconds: float) -> None:
+        self._clock.partition_for(seconds)
+
+    def heal(self) -> None:
+        self._clock.heal()
+
+    def chaos_stats(self) -> dict:
+        with self._clock.lock:
+            return dict(self._clock.stats)
+
+    # --- fault gate -----------------------------------------------------
+
+    def _maybe_fault(self, method: str) -> None:
+        self._clock.count("calls_seen")
+        lat = self._clock.latency()
+        if lat > 0:
+            self._sleep(lat)
+        if self._clock.partitioned:
+            self._clock.count("injected_errors")
+            raise Unavailable(f"chaos: partitioned ({method})")
+        if self._clock.draw(self._clock.config.call_error_rate):
+            self._clock.count("injected_errors")
+            raise Unavailable(f"chaos: injected failure ({method})")
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._inner, name)
+        if name in CALL_METHODS and callable(attr):
+            def faulted(*args, _m=name, _fn=attr):
+                self._maybe_fault(_m)
+                return _fn(*args)
+
+            faulted.__name__ = name
+            setattr(self, name, faulted)
+            return faulted
+        return attr
+
+
+# --------------------------------------------------------------------------
+# ChaosProxy: HTTP-level fault injection between RemoteHub and hubserver
+# --------------------------------------------------------------------------
+
+
+class _ProxyHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "kubernetes-tpu-chaos/1"
+
+    def log_message(self, *args) -> None:  # quiet
+        pass
+
+    @property
+    def clock(self) -> _FaultClock:
+        return self.server.clock          # type: ignore[attr-defined]
+
+    @property
+    def upstream(self) -> str:
+        return self.server.upstream       # type: ignore[attr-defined]
+
+    def _abort(self) -> None:
+        """Sever the connection with no HTTP response — what a network
+        partition looks like from the client's socket."""
+        try:
+            self.connection.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.close_connection = True
+
+    def _json(self, status: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # --- /call ----------------------------------------------------------
+
+    def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        clock = self.clock
+        clock.count("calls_seen")
+        lat = clock.latency()
+        if lat > 0:
+            time.sleep(lat)
+        if clock.partitioned or clock.draw(
+                clock.config.call_abort_rate):
+            clock.count("injected_aborts" if not clock.partitioned
+                        else "injected_errors")
+            self._abort()
+            return
+        if clock.draw(clock.config.call_error_rate):
+            clock.count("injected_errors")
+            self._json(503, {"error": "ChaosInjected",
+                             "message": "injected 503"})
+            return
+        length = int(self.headers.get("Content-Length", "0"))
+        body = self.rfile.read(length)
+        req = urllib.request.Request(
+            self.upstream + self.path, data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30.0) as resp:
+                payload = resp.read()
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            payload = e.read()
+            status = e.code
+        except OSError:
+            # upstream itself is down: same as a partition
+            self._abort()
+            return
+        data = payload
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    # --- /watch ---------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802
+        clock = self.clock
+        if clock.partitioned:
+            self._abort()
+            return
+        try:
+            upstream = urllib.request.urlopen(
+                self.upstream + self.path, timeout=30.0)
+        except urllib.error.HTTPError as e:
+            self._json(e.code, {"error": "Upstream", "message": str(e)})
+            return
+        except OSError:
+            self._abort()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/jsonlines")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        relayed = 0
+        synced = False
+        try:
+            for raw in upstream:
+                if self.server.stopping:   # type: ignore[attr-defined]
+                    break
+                if clock.partitioned:
+                    clock.count("injected_cuts")
+                    break
+                line = raw if raw.endswith(b"\n") else raw + b"\n"
+                stripped = raw.strip()
+                if stripped == b'{"synced": true}':
+                    synced = True
+                elif synced and stripped not in (b"", b"{}"):
+                    # only LIVE events trip the cut triggers — a cut
+                    # quota smaller than the replay would otherwise trap
+                    # the reflector in a replay loop that never syncs.
+                    # After N relayed events the N+1th is dropped and
+                    # the stream cut, so that event is genuinely lost
+                    # from this stream and only the reconnect's relist
+                    # diff can recover it.
+                    cut_after = clock.config.watch_cut_every
+                    if (cut_after and relayed >= cut_after) \
+                            or clock.draw(clock.config.watch_cut_rate):
+                        clock.count("injected_cuts")
+                        break
+                    relayed += 1
+                    clock.count("events_relayed")
+                self.wfile.write(f"{len(line):x}\r\n".encode()
+                                 + line + b"\r\n")
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError,
+                ValueError):
+            pass
+        finally:
+            try:
+                upstream.close()
+            except OSError:
+                pass
+            self._abort()
+
+
+class ChaosProxy:
+    """proxy = ChaosProxy(hub_server.address).start(); point a RemoteHub
+    at ``proxy.address``; twist the knobs mid-flight."""
+
+    def __init__(self, upstream: str, host: str = "127.0.0.1",
+                 port: int = 0, config: ChaosConfig | None = None):
+        self.clock = _FaultClock(config)
+        self._httpd = ThreadingHTTPServer((host, port), _ProxyHandler)
+        self._httpd.daemon_threads = True
+        self._httpd.clock = self.clock         # type: ignore[attr-defined]
+        self._httpd.upstream = upstream.rstrip("/")  # type: ignore
+        self._httpd.stopping = False           # type: ignore[attr-defined]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    @property
+    def stats(self) -> dict:
+        with self.clock.lock:
+            return dict(self.clock.stats)
+
+    def set_fault(self, **kw) -> None:
+        self.clock.set_fault(**kw)
+
+    def partition_for(self, seconds: float) -> None:
+        self.clock.partition_for(seconds)
+
+    def heal(self) -> None:
+        self.clock.heal()
+
+    def start(self) -> "ChaosProxy":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="chaos-proxy")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.stopping = True            # type: ignore[attr-defined]
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+# --------------------------------------------------------------------------
+# chaos smoke scenario (bench.py --chaos-smoke's red-suite gate)
+# --------------------------------------------------------------------------
+
+
+def run_smoke(pods: int = 40, nodes: int = 8, seed: int = 7,
+              timeout_s: float = 90.0) -> dict:
+    """One short storm: scheduler + kubemark hollow nodes both talking
+    through a ChaosProxy while it injects 503s, a mid-stream watch cut,
+    and a partition window. Returns the invariant report; ``ok`` is True
+    iff every pod bound exactly once, every binding was acked Running,
+    and the cache converged against the hub."""
+    from kubernetes_tpu.config.types import default_config
+    from kubernetes_tpu.hub import Hub
+    from kubernetes_tpu.hubclient import RemoteHub
+    from kubernetes_tpu.hubserver import HubServer
+    from kubernetes_tpu.kubemark import HollowNodes
+    from kubernetes_tpu.ops.features import Capacities
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing import MakePod
+
+    hub = Hub()
+    server = HubServer(hub).start()
+    proxy = ChaosProxy(server.address,
+                       config=ChaosConfig(seed=seed)).start()
+    sched_client = RemoteHub(proxy.address, timeout=10.0,
+                             retry_deadline=6.0, retry_base=0.02,
+                             retry_cap=0.25)
+    mark_client = RemoteHub(proxy.address, timeout=10.0,
+                            retry_deadline=6.0, retry_base=0.02,
+                            retry_cap=0.25)
+    report: dict = {"pods": pods, "nodes": nodes, "seed": seed}
+    sched = None
+    hollow = None
+    try:
+        hollow = HollowNodes(mark_client, nodes, prefix="storm")
+        # the heartbeat's resync_acks is the feeder's own resilience: an
+        # ack dropped by an injected fault is retried on the next beat
+        hollow.start_heartbeat(0.5)
+        cfg = default_config()
+        cfg.batch_size = 16
+        sched = Scheduler(sched_client, cfg,
+                          caps=Capacities(nodes=max(16, nodes * 2),
+                                          pods=max(128, pods * 2)))
+        sched.start()
+        for i in range(pods):
+            hub.create_pod(
+                MakePod().name(f"storm-{i}").req(cpu="100m").obj())
+        # the storm: flaky calls, then a stream cut, then a partition
+        proxy.set_fault(call_error_rate=0.30)
+        time.sleep(1.5)
+        proxy.set_fault(call_error_rate=0.0, watch_cut_every=5)
+        time.sleep(1.0)
+        proxy.set_fault(watch_cut_every=0)
+        proxy.partition_for(1.5)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            bound = [p for p in hub.list_pods() if p.spec.node_name]
+            if len(bound) == pods and hollow.ack_count() == pods:
+                break
+            time.sleep(0.2)
+        proxy.heal()
+        all_pods = hub.list_pods()
+        bound = [p for p in all_pods if p.spec.node_name]
+        running = [p for p in all_pods if p.status.phase == "Running"]
+        # settle: let the reflector relist catch the cache up, then diff
+        settle_end = time.monotonic() + 10.0
+        problems = ["unsettled"]
+        while problems and time.monotonic() < settle_end:
+            time.sleep(0.5)
+            problems = sched.cache.compare_with_hub(hub)
+        report.update({
+            "bound": len(bound), "running": len(running),
+            "lost": pods - len(bound),
+            "cache_vs_hub": problems,
+            "hub_client": sched_client.resilience_stats(),
+            "chaos": proxy.stats,
+            "ok": (len(bound) == pods and len(running) == pods
+                   and not problems),
+        })
+    finally:
+        if sched is not None:
+            sched.close()
+        if hollow is not None:
+            hollow.stop()
+        sched_client.close()
+        mark_client.close()
+        proxy.stop()
+        server.stop()
+    return report
+
+
+def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="chaos smoke scenario")
+    ap.add_argument("--pods", type=int, default=40)
+    ap.add_argument("--nodes", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+    report = run_smoke(pods=args.pods, nodes=args.nodes, seed=args.seed)
+    print(json.dumps(report, default=str))
+    raise SystemExit(0 if report.get("ok") else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,7 @@ import numpy as np
 
 from kubernetes_tpu.api.labels import label_selector_matches
 from kubernetes_tpu.api.objects import Pod
+from kubernetes_tpu.hub import Unavailable
 from kubernetes_tpu.framework.interface import (
     PostFilterPlugin,
     PreEnqueuePlugin,
@@ -97,6 +98,9 @@ class Evaluator:
         # eviction work queue the scheduler drains between cycles
         self.preempting: set[str] = set()
         self._pending: list[tuple[Candidate, Pod]] = []
+        # nominee status-clear writes deferred by a hub outage (the
+        # local nomination is already dropped; only the API write waits)
+        self._pending_clears: list[str] = []
         # scheduler-installed: activates preemptors whose flush produced no
         # deletion event (empty/already-deleted victim sets) — the gate
         # opener of last resort (see flush_evictions)
@@ -516,35 +520,78 @@ class Evaluator:
         its preemptor is activated explicitly (``activate_fn``): without
         that, two preemptors nominating the same node can deadlock parked
         behind each other's reservations."""
+        # retry API nomination clears a previous outage deferred (the
+        # local nominator entries are already gone, so only the status
+        # write can be replayed)
+        clears, self._pending_clears = self._pending_clears, []
+        for uid in clears:
+            try:
+                self.hub.clear_nominated_node(uid)
+            except Unavailable:
+                self._pending_clears.append(uid)
+            except Exception:  # noqa: BLE001 — pod gone: nothing to clear
+                pass
         work, self._pending = self._pending, []
         stranded = []
-        for candidate, pod in work:
-            # lower-priority nominees on this node must re-evaluate: drop
-            # the nomination AND clear the API status; the update event
-            # re-activates them
-            dropped = self.nominator.clear_for_node_below_priority(
-                candidate.node_name, pod.priority())
-            for nominee in dropped:
-                self.hub.clear_nominated_node(nominee.metadata.uid)
-            victims = candidate.victims
-            for victim in victims[:-1]:
-                try:
-                    self.hub.delete_pod(victim.metadata.uid)
-                except Exception:  # noqa: BLE001 — already gone is fine
-                    pass
-            self.preempting.discard(pod.metadata.uid)
-            fired = False
-            if victims:
-                try:
-                    self.hub.delete_pod(victims[-1].metadata.uid)
-                    fired = True
-                except Exception:  # noqa: BLE001
-                    pass
-            if not fired:
-                stranded.append(pod)
-        if stranded and self.activate_fn is not None:
-            self.activate_fn(stranded)
+        try:
+            self._flush_candidates(work, stranded)
+        finally:
+            # the activation of already-processed stranded preemptors
+            # must fire even when an outage aborts the flush mid-way:
+            # they are no longer in ``preempting`` and no deletion event
+            # will requeue them (activate_fn is queue-local, hub-free)
+            if stranded and self.activate_fn is not None:
+                self.activate_fn(stranded)
         return len(work)
+
+    def _flush_candidates(self, work: list, stranded: list) -> None:
+        for i, (candidate, pod) in enumerate(work):
+            try:
+                # lower-priority nominees on this node must re-evaluate:
+                # drop the nomination AND clear the API status; the
+                # update event re-activates them
+                dropped = self.nominator.clear_for_node_below_priority(
+                    candidate.node_name, pod.priority())
+                for nominee in dropped:
+                    try:
+                        self.hub.clear_nominated_node(
+                            nominee.metadata.uid)
+                    except Unavailable:
+                        # the nominator entry is dropped for good — a
+                        # retried candidate would find nothing to clear
+                        # — so park the STATUS write itself for replay
+                        self._pending_clears.append(nominee.metadata.uid)
+                victims = candidate.victims
+                for victim in victims[:-1]:
+                    try:
+                        self.hub.delete_pod(victim.metadata.uid)
+                    except Unavailable:
+                        raise           # outage ≠ "already gone"
+                    except Exception:  # noqa: BLE001 — gone is fine
+                        pass
+                self.preempting.discard(pod.metadata.uid)
+                fired = False
+                if victims:
+                    try:
+                        self.hub.delete_pod(victims[-1].metadata.uid)
+                        fired = True
+                    except Unavailable:
+                        raise
+                    except Exception:  # noqa: BLE001
+                        pass
+                if not fired:
+                    stranded.append(pod)
+            except Unavailable:
+                # hub outage mid-candidate: requeue it and the whole
+                # unprocessed tail so nothing is dropped on the floor.
+                # Re-gate THIS candidate's preemptor: its discard may
+                # already have run, and an ungated preemptor could fail
+                # another cycle and enqueue a duplicate candidate before
+                # this one replays. Every step above is idempotent on
+                # replay (NotFound deletes are swallowed, set ops).
+                self.preempting.add(pod.metadata.uid)
+                self._pending = work[i:] + self._pending
+                raise
 
     def _reprieve_by_resources(self, victims: list[Pod], pod: Pod,
                                row: int, free_mat: np.ndarray) -> list[Pod]:

@@ -50,6 +50,13 @@ class NotFound(Exception):
     pass
 
 
+class Unavailable(Exception):
+    """Transport-level failure: the hub exists but could not be reached
+    (connection refused/reset, timeout, 5xx gateway, partition). The
+    scheduler treats this as a degraded-mode signal — park and retry —
+    never as a verdict about the object."""
+
+
 class _Store:
     def __init__(self, kind: str):
         self.kind = kind

@@ -9,21 +9,58 @@ swap the reference makes between fake clientsets and a real apiserver.
 Server-side Conflict/NotFound round-trip as the hub's own exception
 types, so optimistic-concurrency handling (bind conflicts, requeues)
 behaves identically on both transports.
+
+Resilience (client-go's retry/reflector discipline, SURVEY §5.3/§5.8):
+
+* idempotent verbs (get/list/leases.get) retry transport failures and
+  5xx-gateway responses through decorrelated-jitter backoff under a
+  per-call deadline and a shared retry budget (no retry storms);
+* non-idempotent verbs fail fast with ``Unavailable`` so the caller's
+  own reconciliation (informer truth, requeue-with-backoff) owns the
+  ambiguity of a write that may or may not have landed;
+* watch reconnects back off instead of spinning, the initial connect
+  survives a hub still binding its port, and stale stream handles are
+  pruned instead of leaking;
+* ``connected``/``resilience_stats()`` expose degraded state, retry and
+  reconnect counts, and cumulative degraded seconds for metrics.
 """
 
 from __future__ import annotations
 
+import http.client
 import json
+import logging
 import threading
+import time
 import urllib.error
 import urllib.request
 
-from kubernetes_tpu.hub import Conflict, EventHandlers, NotFound
+from kubernetes_tpu.hub import (
+    Conflict,
+    EventHandlers,
+    NotFound,
+    Unavailable,
+)
 from kubernetes_tpu.hubserver import CALL_METHODS, WATCH_KINDS
+from kubernetes_tpu.utils.backoff import Backoff, RetryBudget
 from kubernetes_tpu.utils.wire import from_wire, to_wire
 
 _ERRORS = {"Conflict": Conflict, "NotFound": NotFound,
            "ValueError": ValueError, "TypeError": TypeError}
+
+# safe to replay blindly: reads never mutate. The split covers dotted
+# verbs too ("leases.get" -> "get").
+IDEMPOTENT_METHODS = frozenset(
+    m for m in CALL_METHODS
+    if m.split(".")[-1].startswith(("get", "list")))
+
+# a response from these statuses is the PATH failing, not the hub's
+# verdict on the request (gateway/proxy 5xx — chaos injects 503)
+_RETRYABLE_HTTP = (502, 503, 504)
+
+_TRANSPORT_ERRORS = (OSError, http.client.HTTPException)
+
+logger = logging.getLogger("kubernetes_tpu.hubclient")
 
 
 class RemoteError(Exception):
@@ -42,33 +79,123 @@ class _RemoteLeases:
 
 
 class RemoteHub:
-    def __init__(self, base_url: str, timeout: float = 30.0):
+    def __init__(self, base_url: str, timeout: float = 30.0,
+                 retry_deadline: float = 8.0,
+                 retry_base: float = 0.05, retry_cap: float = 1.0,
+                 retry_budget: float = 20.0,
+                 retry_refill_per_sec: float = 4.0):
         self._base = base_url.rstrip("/")
         self._timeout = timeout
+        self._retry_deadline = retry_deadline
+        self._retry_base = retry_base
+        self._retry_cap = retry_cap
+        self._budget = RetryBudget(budget=retry_budget,
+                                   refill_per_sec=retry_refill_per_sec)
         self._watchers: list = []          # open watch responses
         self._threads: list[threading.Thread] = []
         self._closed = threading.Event()
+        self._wlock = threading.Lock()     # guards _watchers
+        # degraded-state tracking (stats lock; hot path touches it only
+        # on failure or on the first success after a failure)
+        self._slock = threading.Lock()
+        self._degraded_since: float | None = None
+        self._degraded_accum = 0.0
+        self._retries = 0
+        self._watch_reconnects = 0
+        # reflectors currently disconnected (watch health is tracked
+        # apart from call health: RPCs can succeed while every stream is
+        # down, and informer-confirm-dependent logic must see THAT)
+        self._watch_down = 0
         self.leases = _RemoteLeases(self._call)
+
+    # ------------- degraded-state bookkeeping -------------
+
+    def _mark_degraded(self) -> None:
+        with self._slock:
+            if self._degraded_since is None:
+                self._degraded_since = time.monotonic()
+
+    def _mark_connected(self) -> None:
+        if self._degraded_since is None:   # benign race: cheap fast path
+            return
+        with self._slock:
+            if self._degraded_since is not None:
+                self._degraded_accum += time.monotonic() - \
+                    self._degraded_since
+                self._degraded_since = None
+
+    @property
+    def connected(self) -> bool:
+        return self._degraded_since is None
+
+    @property
+    def watches_healthy(self) -> bool:
+        """False while any reflector stream is down — even if RPCs
+        succeed, informer confirms cannot arrive through a dead watch."""
+        with self._slock:
+            return self._watch_down == 0
+
+    def resilience_stats(self) -> dict:
+        """Counters for the hub_client_* metrics."""
+        with self._slock:
+            degraded_s = self._degraded_accum
+            if self._degraded_since is not None:
+                degraded_s += time.monotonic() - self._degraded_since
+            return {"retries": self._retries,
+                    "watch_reconnects": self._watch_reconnects,
+                    "watches_down": self._watch_down,
+                    "degraded_seconds": degraded_s,
+                    "degraded": self._degraded_since is not None}
 
     # ------------- RPC -------------
 
     def _call(self, method: str, *args):
         body = json.dumps({"method": method,
                            "args": [to_wire(a) for a in args]}).encode()
-        req = urllib.request.Request(
-            self._base + "/call", data=body,
-            headers={"Content-Type": "application/json"})
-        try:
-            with urllib.request.urlopen(req, timeout=self._timeout) as resp:
-                payload = json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            payload = json.loads(e.read())
-            exc = _ERRORS.get(payload.get("error", ""))
-            msg = payload.get("message", "")
-            if exc is not None:
-                raise exc(msg) from None
-            raise RemoteError(f"{payload.get('error')}: {msg}") from None
-        return from_wire(payload["result"])
+        idempotent = method in IDEMPOTENT_METHODS
+        bo = Backoff(self._retry_base, self._retry_cap)
+        t_end = time.monotonic() + self._retry_deadline
+        while True:
+            req = urllib.request.Request(
+                self._base + "/call", data=body,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self._timeout) as resp:
+                    payload = json.loads(resp.read())
+                self._mark_connected()
+                return from_wire(payload["result"])
+            except urllib.error.HTTPError as e:
+                if e.code in _RETRYABLE_HTTP:
+                    err = f"HTTP {e.code}"
+                    try:
+                        e.close()   # don't leak one socket per retry
+                    except OSError:
+                        pass
+                else:
+                    # the hub answered: transport is fine, the request
+                    # has a verdict — map and raise it
+                    self._mark_connected()
+                    try:
+                        payload = json.loads(e.read())
+                    except (ValueError, OSError):
+                        payload = {"error": f"HTTP {e.code}", "message": ""}
+                    exc = _ERRORS.get(payload.get("error", ""))
+                    msg = payload.get("message", "")
+                    if exc is not None:
+                        raise exc(msg) from None
+                    raise RemoteError(
+                        f"{payload.get('error')}: {msg}") from None
+            except _TRANSPORT_ERRORS as e:
+                err = repr(e)
+            self._mark_degraded()
+            remaining = t_end - time.monotonic()
+            if not idempotent or remaining <= 0 \
+                    or not self._budget.try_spend():
+                raise Unavailable(f"{method}: {err}") from None
+            with self._slock:
+                self._retries += 1
+            time.sleep(min(bo.next(), max(remaining, 0.0)))
 
     def __getattr__(self, name: str):
         if name in CALL_METHODS:
@@ -101,6 +228,7 @@ class RemoteHub:
         dispatching, so reconnects can't replay ancient history at it."""
         synced = threading.Event()
         state: dict[str, tuple[int, object]] = {}
+        current: list = [None]   # this reflector's live response handle
 
         def dispatch(ev: dict, suppress: bool, live: set) -> None:
             etype = ev.get("type")
@@ -131,10 +259,18 @@ class RemoteHub:
             resp = urllib.request.urlopen(
                 f"{self._base}/watch?kind={kind}&replay=1",
                 timeout=self._timeout)
-            self._watchers.append(resp)
+            with self._wlock:
+                # swap, don't leak: the previous connection's response
+                # object is dead once we reconnect
+                old = current[0]
+                if old is not None and old in self._watchers:
+                    self._watchers.remove(old)
+                current[0] = resp
+                self._watchers.append(resp)
             return resp
 
-        def consume(resp, suppress_replay: bool) -> None:
+        def consume(resp, suppress_replay: bool,
+                    progressed: list[bool]) -> None:
             replaying = True
             live: set[str] = set()
             for raw in resp:
@@ -144,6 +280,14 @@ class RemoteHub:
                 if not line:
                     continue
                 ev = json.loads(line)
+                if not replaying and ev and not ev.get("synced"):
+                    # a LIVE event arrived: the stream genuinely worked,
+                    # so the next outage's backoff restarts from base.
+                    # (Keying on any bytes would reset on every replay —
+                    # a reconnect/relist storm the backoff exists to
+                    # damp. consume() normally ENDS via an exception, so
+                    # a return-based signal would never fire.)
+                    progressed[0] = True
                 if ev.get("synced"):
                     # relist diff: anything tracked but absent from this
                     # replay was deleted while we weren't watching
@@ -160,31 +304,120 @@ class RemoteHub:
 
         def run(first_resp) -> None:
             resp, suppress = first_resp, not replay
-            while not self._closed.is_set():
-                try:
-                    consume(resp, suppress)
-                except (OSError, ValueError, AttributeError):
-                    # close() from another thread nulls the fp mid-read
-                    # (AttributeError); a dying server surfaces OSError
-                    pass
-                finally:
-                    synced.set()
-                    try:
-                        resp.close()
-                    except OSError:
-                        pass
-                if self._closed.is_set():
-                    return
-                # reconnect + relist; replay is never suppressed again —
-                # state absorbs it via rv dedup, the diff emits the gap
-                self._closed.wait(0.2)
-                suppress = False
-                try:
-                    resp = connect()
-                except OSError:
-                    continue
+            bo = Backoff(self._retry_base, self._retry_cap)
+            stream_ok = [True]
 
-        resp0 = connect()
+            def set_down(down: bool) -> None:
+                # per-reflector edge-triggered contribution to the
+                # client-wide watch-health gauge (watches_healthy):
+                # call health alone can't see a dead stream, and
+                # informer-confirm-dependent logic needs to
+                if down and stream_ok[0]:
+                    stream_ok[0] = False
+                    with self._slock:
+                        self._watch_down += 1
+                elif not down and not stream_ok[0]:
+                    stream_ok[0] = True
+                    with self._slock:
+                        self._watch_down -= 1
+
+            try:
+                while not self._closed.is_set():
+                    progressed = [False]
+                    try:
+                        consume(resp, suppress, progressed)
+                    except (OSError, ValueError, AttributeError):
+                        # close() from another thread nulls the fp
+                        # mid-read (AttributeError); a dying server
+                        # surfaces OSError
+                        pass
+                    finally:
+                        synced.set()
+                        try:
+                            resp.close()
+                        except OSError:
+                            pass
+                    if self._closed.is_set():
+                        return     # clean close() is not an outage
+                    set_down(True)
+                    if progressed[0]:
+                        # the stream lived long enough to carry events:
+                        # the next outage's backoff restarts from base
+                        bo.reset()
+                    self._mark_degraded()
+                    # reconnect + relist; replay is never suppressed
+                    # again — state absorbs it via rv dedup, the diff
+                    # emits the gap. The inner loop sleeps-then-dials
+                    # until a connection holds, so consume() is never
+                    # re-entered with a dead handle.
+                    while True:
+                        if self._closed.wait(bo.next()):
+                            return             # close() during the sleep
+                        try:
+                            resp = connect()
+                        except urllib.error.HTTPError as e:
+                            if e.code in _RETRYABLE_HTTP:
+                                try:
+                                    e.close()  # no socket leak per retry
+                                except OSError:
+                                    pass
+                                continue       # gateway blip: redial
+                            # a definitive server verdict (400 unknown
+                            # kind, 404 misroute) cannot heal by
+                            # retrying: stop this reflector instead of
+                            # hammering the server
+                            logger.error("watch %s rejected by server "
+                                         "(HTTP %s); reflector stopping",
+                                         kind, e.code)
+                            return
+                        except _TRANSPORT_ERRORS:
+                            continue
+                        break
+                    if self._closed.is_set():
+                        # close() raced the reconnect: it already
+                        # drained _watchers, so this handle is ours
+                        try:
+                            resp.close()
+                        except OSError:
+                            pass
+                        return
+                    suppress = False
+                    set_down(False)
+                    self._mark_connected()
+                    with self._slock:
+                        self._watch_reconnects += 1
+            finally:
+                # a reflector exiting (close(), fatal server verdict)
+                # must not pin the client-wide watch-health gauge down
+                set_down(False)
+
+        # guard the FIRST connect: scheduler startup must survive a hub
+        # that is still binding its port (bounded retry, then Unavailable)
+        bo = Backoff(self._retry_base, self._retry_cap)
+        t_end = time.monotonic() + max(self._retry_deadline, self._timeout)
+        while True:
+            try:
+                resp0 = connect()
+                self._mark_connected()
+                break
+            except urllib.error.HTTPError as e:
+                if e.code not in _RETRYABLE_HTTP:
+                    # the server ANSWERED: surface its verdict instead
+                    # of blind-retrying a doomed request to its deadline
+                    raise RemoteError(
+                        f"watch {kind}: HTTP {e.code}") from None
+                err: Exception = e
+                try:
+                    e.close()       # don't leak one socket per retry
+                except OSError:
+                    pass
+            except _TRANSPORT_ERRORS as e:
+                err = e
+            self._mark_degraded()
+            remaining = t_end - time.monotonic()
+            if remaining <= 0 or self._closed.is_set():
+                raise Unavailable(f"watch {kind}: {err!r}") from None
+            time.sleep(min(bo.next(), max(remaining, 0.0)))
         t = threading.Thread(target=run, args=(resp0,), daemon=True,
                              name=f"reflector-{kind}")
         t.start()
@@ -199,14 +432,15 @@ class RemoteHub:
 
     def close(self) -> None:
         self._closed.set()
-        for resp in self._watchers:
+        with self._wlock:
+            watchers, self._watchers = self._watchers, []
+        for resp in watchers:
             try:
                 resp.close()
             except OSError:
                 pass
         for t in self._threads:
             t.join(timeout=2)
-        self._watchers.clear()
         self._threads.clear()
 
 

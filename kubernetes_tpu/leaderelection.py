@@ -8,13 +8,23 @@ lease store (a real deployment would point this at the apiserver).
 
 Defaults mirror the reference's component config: 15s lease duration,
 10s renew deadline, 2s retry period.
+
+The store may be REMOTE (RemoteHub.leases over HTTP): every store call
+can raise a transport error. A failed or unreachable renew is treated as
+"not leading" — never as a crash of the maintenance loop — and a holder
+that cannot renew within ``renew_deadline`` steps down voluntarily
+(leaderelection.go's RenewDeadline contract) so a healthy peer takes
+over within the lease duration instead of waiting out a zombie.
 """
 
 from __future__ import annotations
 
+import logging
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
+
+logger = logging.getLogger("kubernetes_tpu.leaderelection")
 
 
 @dataclass
@@ -67,6 +77,7 @@ class LeaderElector:
     def __init__(self, store: LeaseStore, identity: str,
                  lease_name: str = "kube-scheduler",
                  lease_duration: float = 15.0,
+                 renew_deadline: float = 10.0,
                  retry_period: float = 2.0,
                  now: Callable[[], float] = time.time,
                  on_started_leading: Optional[Callable] = None,
@@ -75,65 +86,103 @@ class LeaderElector:
         self.identity = identity
         self.lease_name = lease_name
         self.lease_duration = lease_duration
+        # client-go validates LeaseDuration > RenewDeadline; clamp to
+        # the reference's 2/3 ratio so a short --lease-duration cannot
+        # open a dual-leader window (peer steals at lease_duration while
+        # we still think the renew deadline hasn't passed)
+        self.renew_deadline = min(renew_deadline, lease_duration * 2 / 3)
         self.retry_period = retry_period
         self.now = now
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
         self._leading = False
         self._last_try = 0.0
+        self._last_renew = 0.0   # last SUCCESSFUL acquire/renew
+        self.transport_errors = 0
 
     def is_leader(self) -> bool:
         return self._leading
 
+    def _enforce_renew_deadline(self, now: float) -> None:
+        """RenewDeadline exceeded: we may still hold the lease in the
+        store, but we can no longer PROVE it — step down before a peer's
+        clock says we expired (split-brain guard)."""
+        if self._leading and now - self._last_renew > self.renew_deadline:
+            logger.warning("leaderelection: renew deadline exceeded "
+                           "(%.1fs), stepping down", self.renew_deadline)
+            self._set_leading(False)
+
     def try_acquire_or_renew(self) -> bool:
         """leaderelection.go tryAcquireOrRenew: renew our own lease, or
-        take an expired/vacant one."""
+        take an expired/vacant one. A store that cannot be reached is a
+        failed renew (not leading), never an escaping exception."""
         now = self.now()
-        cur = self.store.get(self.lease_name)
-        if cur is None or not cur.holder_identity:
-            ok = self.store.update(Lease(
-                name=self.lease_name, holder_identity=self.identity,
-                lease_duration_seconds=self.lease_duration,
-                acquire_time=now, renew_time=now), expect_holder=None)
-            self._set_leading(ok)
-            return self._leading
-        if cur.holder_identity == self.identity:
-            cur.renew_time = now
-            ok = self.store.update(cur, expect_holder=self.identity)
-            # a failed CAS means a peer stole the lease while we stalled:
-            # step down immediately (split-brain guard)
-            self._set_leading(ok)
-            return ok
-        if now - cur.renew_time > cur.lease_duration_seconds:
-            # expired: steal it (lease_transitions counts takeovers)
-            ok = self.store.update(Lease(
-                name=self.lease_name, holder_identity=self.identity,
-                lease_duration_seconds=self.lease_duration,
-                acquire_time=now, renew_time=now,
-                lease_transitions=cur.lease_transitions + 1),
-                expect_holder=cur.holder_identity)
-            self._set_leading(ok)
-            return self._leading
-        self._set_leading(False)
-        return False
+        self._enforce_renew_deadline(now)
+        # the try wraps ONLY store I/O: a raising user callback in
+        # _set_leading must surface as itself, not masquerade as a
+        # transport failure (and flap leadership forever)
+        try:
+            cur = self.store.get(self.lease_name)
+            if cur is None or not cur.holder_identity:
+                ok = self.store.update(Lease(
+                    name=self.lease_name, holder_identity=self.identity,
+                    lease_duration_seconds=self.lease_duration,
+                    acquire_time=now, renew_time=now), expect_holder=None)
+            elif cur.holder_identity == self.identity:
+                cur.renew_time = now
+                # a failed CAS means a peer stole the lease while we
+                # stalled: step down immediately (split-brain guard)
+                ok = self.store.update(cur, expect_holder=self.identity)
+            elif now - cur.renew_time > cur.lease_duration_seconds:
+                # expired: steal it (lease_transitions counts takeovers)
+                ok = self.store.update(Lease(
+                    name=self.lease_name, holder_identity=self.identity,
+                    lease_duration_seconds=self.lease_duration,
+                    acquire_time=now, renew_time=now,
+                    lease_transitions=cur.lease_transitions + 1),
+                    expect_holder=cur.holder_identity)
+            else:
+                ok = False
+        except Exception as e:  # noqa: BLE001 — remote store transport
+            # failure: an unreachable store means we cannot renew; we are
+            # not leading until it answers again
+            self.transport_errors += 1
+            logger.warning("leaderelection: lease store unreachable "
+                           "(%r); treating as not leading", e)
+            ok = False
+        if ok:
+            self._last_renew = now
+        self._set_leading(ok)
+        return self._leading
 
     def tick(self) -> bool:
-        """Rate-limited try_acquire_or_renew for the maintenance loop."""
+        """Rate-limited try_acquire_or_renew for the maintenance loop.
+        Exception-safe: transport errors demote, they never escape."""
         now = self.now()
         if now - self._last_try < self.retry_period:
+            # don't coast on a stale lease between retries
+            self._enforce_renew_deadline(now)
             return self._leading
         self._last_try = now
         return self.try_acquire_or_renew()
 
     def release(self) -> None:
         """Step down voluntarily (leaderelection.go release): zero out the
-        holder so a peer acquires without waiting for expiry."""
+        holder so a peer acquires without waiting for expiry. Best-effort
+        over an unreachable store — local demotion always happens."""
         if not self._leading:
             return
-        self.store.update(Lease(
-            name=self.lease_name, holder_identity="",
-            lease_duration_seconds=self.lease_duration,
-            acquire_time=0.0, renew_time=0.0), expect_holder=self.identity)
+        try:
+            self.store.update(Lease(
+                name=self.lease_name, holder_identity="",
+                lease_duration_seconds=self.lease_duration,
+                acquire_time=0.0, renew_time=0.0),
+                expect_holder=self.identity)
+        except Exception as e:  # noqa: BLE001 — the lease then simply
+            # expires on its own; peers take over within lease_duration
+            self.transport_errors += 1
+            logger.warning("leaderelection: release failed (%r); lease "
+                           "will expire naturally", e)
         self._set_leading(False)
 
     def _set_leading(self, leading: bool) -> None:

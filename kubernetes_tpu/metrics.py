@@ -215,6 +215,28 @@ class SchedulerMetrics:
             VICTIMS_BUCKETS))
         self.pending_pods = r.register(Gauge(
             "pending_pods", "Pending pods by queue", fn=pending_fn))
+        # hub-client resilience + chaos surface (mirrored from
+        # RemoteHub.resilience_stats / ChaosHub.chaos_stats each
+        # maintenance tick; counters live in the transport layer, the
+        # registry is the one exposition point)
+        self.hub_degraded = r.register(Gauge(
+            "scheduler_hub_degraded",
+            "1 while the hub is unreachable (degraded mode)"))
+        # gauges mirroring externally-owned counters, so no _total
+        # suffix (Prometheus reserves it for true counters — rate()
+        # over a mirrored gauge would misread restarts)
+        self.hub_client_retries = r.register(Gauge(
+            "hub_client_retries",
+            "Transport-level retries issued by the hub client"))
+        self.hub_client_watch_reconnects = r.register(Gauge(
+            "hub_client_watch_reconnects",
+            "Watch streams re-established after a cut"))
+        self.hub_client_degraded_seconds = r.register(Gauge(
+            "hub_client_degraded_seconds",
+            "Cumulative seconds the hub client spent unreachable"))
+        self.chaos_injected_faults = r.register(Gauge(
+            "chaos_injected_faults",
+            "Faults injected by an attached chaos layer, by kind"))
         self.queue_incoming_pods = r.register(Counter(
             "queue_incoming_pods_total",
             "Pods added to scheduling queues by event/queue",

@@ -39,6 +39,7 @@ from kubernetes_tpu.api.objects import (
     Pod,
     ResourceClaim,
 )
+from kubernetes_tpu.hub import Unavailable
 from kubernetes_tpu.framework.interface import (
     FilterPlugin,
     PreBindPlugin,
@@ -615,6 +616,8 @@ class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin,
                         merged.append(uid)
                 new.status.reserved_for = merged
                 self.hub.update_resource_claim(new)
+            except Unavailable:
+                raise    # transport outage: degraded mode parks the pod
             except Exception as e:  # noqa: BLE001 — surfaced as Status
                 return Status.error(str(e), plugin=self.NAME)
             self.assume.restore(key)

@@ -42,6 +42,7 @@ from kubernetes_tpu.api.objects import (
     Pod,
     Volume,
 )
+from kubernetes_tpu.hub import Unavailable
 from kubernetes_tpu.utils.quantity import parse_bytes, parse_int
 from kubernetes_tpu.framework.interface import (
     FilterPlugin,
@@ -662,6 +663,8 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ScorePlugin,
                     new_c.metadata.annotations[
                         self.SELECTED_NODE_ANNOTATION] = node_name
                     self.hub.update_pvc(new_c)
+                except Unavailable:
+                    raise    # transport outage: degraded mode parks
                 except Exception as e:  # noqa: BLE001
                     return Status.error(str(e), plugin=self.NAME)
                 continue
@@ -683,6 +686,8 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ScorePlugin,
                         new_c.spec.volume_name = pv_name
                         new_c.status.phase = "Bound"
                         self.hub.update_pvc(new_c)
+            except Unavailable:
+                raise    # transport outage: degraded mode parks
             except Exception as e:  # noqa: BLE001 — surfaced as Status
                 return Status.error(str(e), plugin=self.NAME)
             # API truth now holds the binding; drop the assumed overlay

@@ -50,7 +50,7 @@ from kubernetes_tpu.framework.interface import (
 from kubernetes_tpu.framework.runtime import Framework
 from kubernetes_tpu.framework.interface import Code
 from kubernetes_tpu.framework.waiting import WaitingPod
-from kubernetes_tpu.hub import EventHandlers, Hub
+from kubernetes_tpu.hub import EventHandlers, Hub, Unavailable
 from kubernetes_tpu.utils.gcguard import guard as gc_guard
 from kubernetes_tpu.models.pipeline import (
     ADAPTIVE_PCT,
@@ -196,7 +196,16 @@ class Scheduler:
         # conflicts — see _defer_host_conflicts); still in-flight queue-wise
         self._deferred: list[QueuedPodInfo] = []
         self.stats = {"scheduled": 0, "unschedulable": 0, "errors": 0,
-                      "batches": 0, "attempts": 0}
+                      "batches": 0, "attempts": 0,
+                      "parked_unreachable": 0}
+        # degraded mode: the hub is unreachable (transport Unavailable).
+        # Work parks with backoff instead of erroring; assumed pods are
+        # preserved (their confirm events cannot arrive); the informer's
+        # relist diff re-converges everything after reconnect.
+        self._hub_down = False
+        # expired assumed pods awaiting their requeue check (the hub may
+        # be unreachable when they expire; see _drain_assumed_requeue)
+        self._assumed_requeue: list[Pod] = []
         # device-resident (free, nonzero_requested) chain: the post-launch
         # usage state of the NEWEST dispatched launch. While no external
         # event has touched the cluster state, the next no-topology batch can
@@ -423,7 +432,12 @@ class Scheduler:
         if pod.spec.resource_claims:
             from kubernetes_tpu.plugins.dra import release_pod_claims
 
-            release_pod_claims(self.hub, pod)
+            try:
+                release_pod_claims(self.hub, pod)
+            except Unavailable:
+                # raised on the informer thread: must not kill the
+                # reflector; claim reservations reconcile on relist
+                self._note_hub_down()
         wp = None
         for fw in self.frameworks.values():
             wp = fw.waiting_pods.remove(uid)
@@ -445,6 +459,62 @@ class Scheduler:
                 ClusterEvent(R.ASSIGNED_POD, A.DELETE), pod, None)
         else:
             self.queue.delete(pod)
+
+    # ------------- degraded mode (hub unreachable) -------------
+
+    def hub_degraded(self) -> bool:
+        """True while the hub transport is down. A RemoteHub knows its
+        own state; for in-process wrappers (ChaosHub) the flag set by the
+        last failed call stands until a probe succeeds."""
+        connected = getattr(self.hub, "connected", None)
+        if connected is not None:
+            return not connected
+        return self._hub_down
+
+    def _note_hub_down(self) -> None:
+        if not self._hub_down:
+            logger.warning(
+                "hub unreachable: entering degraded mode (parking work)")
+        self._hub_down = True
+
+    def _park_unreachable(self, qp: QueuedPodInfo) -> None:
+        """Park a pod the hub outage interrupted: error-class backoff so
+        retries pace themselves, but NO condition patch (it would need
+        the hub) and no error accounting — the pod did nothing wrong."""
+        qp.unschedulable_plugins = set()
+        qp.consecutive_errors_count += 1
+        self.stats["parked_unreachable"] += 1
+        self.queue.add_unschedulable_if_not_present(qp)
+
+    def _park_batch_unreachable(self, runnable: list[QueuedPodInfo]
+                                ) -> None:
+        """Hub outage during pack/dispatch: park the whole batch and
+        keep the loop alive. Anything _dispatch deferred came out of
+        this same runnable list, so clearing _deferred cannot strand a
+        pod."""
+        self._note_hub_down()
+        self._invalidate_chain()
+        self._deferred = []
+        for qp in runnable:
+            self._park_unreachable(qp)
+
+    def _patch_condition_best_effort(self, pod: Pod,
+                                     condition: PodCondition,
+                                     nominated_node: str | None = None
+                                     ) -> None:
+        """Condition patches are observability, not correctness: in
+        degraded mode they are dropped, not allowed to wedge the loop."""
+        try:
+            # positional: RemoteHub's RPC proxies take *args only
+            self.hub.patch_pod_condition(pod, condition, nominated_node)
+        except Unavailable:
+            self._note_hub_down()
+
+    def _flush_evictions_safe(self) -> None:
+        try:
+            self.preemption.flush_evictions()
+        except Unavailable:
+            self._note_hub_down()
 
     # ------------- capacity re-bucketing -------------
 
@@ -479,8 +549,17 @@ class Scheduler:
         batch = deferred + self.queue.pop_batch(
             self.config.batch_size - len(deferred))
         runnable: list[QueuedPodInfo] = []
-        for qp in batch:
-            stored = self.hub.get_pod(qp.uid)
+        for i, qp in enumerate(batch):
+            try:
+                stored = self.hub.get_pod(qp.uid)
+            except Unavailable:
+                # hub unreachable mid-pop: park the whole batch (vetted
+                # pods included — their binds would only fail) and let
+                # backoff pace the retry; nothing errors, nothing is lost
+                self._note_hub_down()
+                for rest in runnable + batch[i:]:
+                    self._park_unreachable(rest)
+                return len(batch), []
             if stored is None or stored.metadata.deletion_timestamp:
                 self.queue.done(qp.uid)
                 continue
@@ -831,18 +910,23 @@ class Scheduler:
             popped, runnable = self._pop_runnable()
             if popped == 0:
                 self._drain_bind_results(wait=True)
-                self.preemption.flush_evictions()
+                self._flush_evictions_safe()
                 self._process_deferred_events()
                 return 0
             if runnable:
-                inflight = self._dispatch(runnable, self._chain_eligible(
-                    [qp.pod for qp in runnable]))
+                try:
+                    inflight = self._dispatch(
+                        runnable, self._chain_eligible(
+                            [qp.pod for qp in runnable]))
+                except Unavailable:
+                    self._park_batch_unreachable(runnable)
+                    inflight = None
                 if inflight is not None:
                     self._finish(inflight)
             self._drain_bind_results(wait=True)
             # async preemption: victims queued by PostFilter are evicted
             # here, OUTSIDE the cycle (prepareCandidateAsync's analog)
-            self.preemption.flush_evictions()
+            self._flush_evictions_safe()
             self._process_deferred_events()
             return popped
 
@@ -862,7 +946,15 @@ class Scheduler:
         # table stale: the chain must not skip the sync that packs it
         if self.mirror.batch_has_topology([pod]):
             self._invalidate_chain()
-        s = fw.run_reserve_plugins(state, pod, node_name)
+        try:
+            s = fw.run_reserve_plugins(state, pod, node_name)
+        except Unavailable as e:
+            # reserve plugins read the hub (DRA claims): an outage here
+            # must not wedge the rest of the batch in-flight — undo the
+            # assume and park this pod like any other unreachable write
+            self._undo_commit(qp, state, assumed, node_name,
+                              f"reserve: {e}", park_unreachable=True)
+            return
         if not s.is_success():
             # a REJECTING reserve (e.g. DRA "devices vanished" — the
             # designed same-batch capacity race) is unschedulable with
@@ -873,7 +965,12 @@ class Scheduler:
                               rejected_by=(s.plugin if s.is_rejected()
                                            else ""))
             return
-        s, waits = fw.run_permit_plugins(state, pod, node_name)
+        try:
+            s, waits = fw.run_permit_plugins(state, pod, node_name)
+        except Unavailable as e:
+            self._undo_commit(qp, state, assumed, node_name,
+                              f"permit: {e}", park_unreachable=True)
+            return
         if s.code == Code.WAIT:
             fw.waiting_pods.add(WaitingPod(qp, node_name, state, waits,
                                            self.now()))
@@ -888,22 +985,36 @@ class Scheduler:
 
     def _undo_commit(self, qp: QueuedPodInfo, state: CycleState,
                      assumed: Pod, node_name: str, msg: str,
-                     rejected_by: str = "") -> None:
+                     rejected_by: str = "",
+                     park_unreachable: bool = False) -> None:
         """Unreserve + Forget, then requeue: error-class for infrastructure
         failures (schedule_one.go:337's bind-failure path), unschedulable
         with plugin attribution when a plugin REJECTED the pod (permit
         reject/timeout goes through handleSchedulingFailure as
-        Unschedulable, schedule_one.go:270)."""
-        self._fw_for(qp.pod).run_unreserve_plugins(state, qp.pod, node_name)
+        Unschedulable, schedule_one.go:270). ``park_unreachable`` routes a
+        hub-outage failure to the degraded-mode park instead — the bind
+        may or may not have landed; the informer's relist decides, and the
+        hub's bind-once Conflict guarantees no double-bind either way."""
+        try:
+            self._fw_for(qp.pod).run_unreserve_plugins(state, qp.pod,
+                                                       node_name)
+        except Unavailable:
+            # hub-side claim state reconciles via informer truth after
+            # the outage; the local overlay cleanup below is what matters
+            self._note_hub_down()
         self.cache.forget_pod(assumed)
         # the device chain assumed this placement; force a re-sync
         self._invalidate_chain()
+        if park_unreachable:
+            self._note_hub_down()
+            self._park_unreachable(qp)
+            return
         if rejected_by:
             qp.unschedulable_plugins = {rejected_by}
             qp.unschedulable_count += 1
             qp.consecutive_errors_count = 0
             self.stats["unschedulable"] += 1
-            self.hub.patch_pod_condition(qp.pod, PodCondition(
+            self._patch_condition_best_effort(qp.pod, PodCondition(
                 type="PodScheduled", status="False", reason="Unschedulable",
                 message=msg))
             self.queue.add_unschedulable_if_not_present(qp)
@@ -926,6 +1037,8 @@ class Scheduler:
                 # the hub like the Binding POST would
                 self.hub.bind(pod, node_name)
                 return Status()
+            except Unavailable:
+                raise    # transport outage: degraded mode parks the pod
             except ExtenderError as e:
                 return Status.error(str(e))
             except Exception as e:  # noqa: BLE001
@@ -941,6 +1054,13 @@ class Scheduler:
                 ext_s = self._extenders_binding(pod, node_name)
                 s = ext_s if ext_s is not None \
                     else fw.run_bind_plugins(state, pod, node_name)
+        except Unavailable as e:
+            # hub outage mid-bind: tagged so _finish_binding parks the
+            # pod in degraded mode instead of taking the error path
+            from kubernetes_tpu.framework.interface import Status
+
+            s = Status.error(f"hub unavailable: {e}",
+                             plugin="HubUnavailable")
         except Exception as e:  # noqa: BLE001 — a raising out-of-tree
             # plugin must not poison the chunk/future (every other pod in
             # it would stay assumed forever)
@@ -999,7 +1119,9 @@ class Scheduler:
                         assumed: Pod, node_name: str, s) -> None:
         if not s.is_success():
             self._undo_commit(qp, state, assumed, node_name,
-                              f"bind: {s.message()}")
+                              f"bind: {s.message()}",
+                              park_unreachable=(
+                                  s.plugin == "HubUnavailable"))
             return
         self.cache.finish_binding(assumed)
         self.nominator.delete(qp.uid)
@@ -1085,7 +1207,14 @@ class Scheduler:
                 # on PreemptionAsync: the extra cycle of nomination latency
                 # per burst outweighs the hidden device wait. Synchronous
                 # begin+finish it stays.
-                results = self.preemption.batch_preempt(qps, self.snapshot)
+                try:
+                    results = self.preemption.batch_preempt(qps,
+                                                            self.snapshot)
+                except Unavailable:
+                    # outage mid-sweep: no nominations this round; the
+                    # parked preemptors retry after backoff
+                    self._note_hub_down()
+                    results = {}
                 for uid, (node, _status) in results.items():
                     nominated_by_uid[uid] = node
                     if node:
@@ -1094,15 +1223,20 @@ class Scheduler:
             if not self.config.gate("SchedulerAsyncPreemption"):
                 # gate off: prepare candidates synchronously, inside the
                 # failure handling (pre-kep-4832 behavior)
-                self.preemption.flush_evictions()
+                self._flush_evictions_safe()
         for qp, reject_counts, plugins, has_pf, fit_only in prepped:
             if has_pf and not fit_only:
                 state = CycleState()
-                nominated, _s = self._fw_for(
-                    qp.pod).run_post_filter_plugins(
-                    state, qp.pod, {"snapshot": self.snapshot,
-                                    "reject_counts": reject_counts,
-                                    "host_rejects": qp.host_reject_counts})
+                try:
+                    nominated, _s = self._fw_for(
+                        qp.pod).run_post_filter_plugins(
+                        state, qp.pod, {"snapshot": self.snapshot,
+                                        "reject_counts": reject_counts,
+                                        "host_rejects":
+                                            qp.host_reject_counts})
+                except Unavailable:
+                    self._note_hub_down()
+                    nominated = None
                 if nominated:
                     self.stats["preemptions"] = self.stats.get(
                         "preemptions", 0) + 1
@@ -1113,15 +1247,18 @@ class Scheduler:
     def _park_failed(self, qp: QueuedPodInfo, plugins,
                      nominated: Optional[str]) -> None:
         """Condition patch + park (the tail of handleSchedulingFailure)."""
-        self.hub.patch_pod_condition(qp.pod, PodCondition(
+        self._patch_condition_best_effort(qp.pod, PodCondition(
             type="PodScheduled", status="False", reason="Unschedulable",
-            message=f"rejected by {sorted(plugins)}"),
-            nominated_node=nominated)
+            message=f"rejected by {sorted(plugins)}"), nominated)
         # the patch fired while this pod was in-flight (the queue
         # ignores updates for in-flight pods), so park the FRESH
         # object — the packed nominated_row must see
         # status.nominatedNodeName next attempt
-        stored = self.hub.get_pod(qp.uid)
+        try:
+            stored = self.hub.get_pod(qp.uid)
+        except Unavailable:
+            self._note_hub_down()
+            stored = None
         if stored is not None:
             qp.pod = stored
         self.queue.add_unschedulable_if_not_present(qp)
@@ -1134,7 +1271,7 @@ class Scheduler:
         self.stats["errors"] += 1
         self.metrics.schedule_attempts.inc(
             result="error", profile=qp.pod.spec.scheduler_name)
-        self.hub.patch_pod_condition(qp.pod, PodCondition(
+        self._patch_condition_best_effort(qp.pod, PodCondition(
             type="PodScheduled", status="False", reason="SchedulerError",
             message=msg))
         self.queue.add_unschedulable_if_not_present(qp)
@@ -1156,18 +1293,89 @@ class Scheduler:
             if now - self._last_unsched_flush >= 30.0:
                 self._last_unsched_flush = now
                 self.queue.flush_unschedulable_timeout()
-                for pod in self.cache.cleanup_assumed_pods():
-                    stored = self.hub.get_pod(pod.metadata.uid)
-                    if stored is not None and not stored.spec.node_name:
-                        self.queue.add(stored)
+                # degraded: do NOT expire assumed pods — their informer
+                # confirms cannot arrive while the hub is unreachable;
+                # expiring them now would forget real placements and
+                # invite double scheduling the moment the hub heals.
+                # watches_healthy is checked separately: RPCs can
+                # succeed while every watch stream is down, and the
+                # confirms ride the streams, not the calls
+                if not self.hub_degraded() \
+                        and getattr(self.hub, "watches_healthy", True):
+                    # expiry removed these from the cache already: they
+                    # MUST reach the requeue check eventually, so an
+                    # outage mid-loop defers the tail instead of
+                    # dropping it (_assumed_requeue drains every tick)
+                    self._assumed_requeue.extend(
+                        self.cache.cleanup_assumed_pods())
+            self._drain_assumed_requeue()
             self._process_waiting()
             self._drain_bind_results()
-            self.preemption.flush_evictions()
+            self._flush_evictions_safe()
             self._process_deferred_events()
             self.recorder.flush(force=False)
+            self._probe_hub()
             self.metrics.cache_size.set(self.cache.pod_count(), type="pods")
             self.metrics.cache_size.set(self.cache.assumed_pod_count(),
                                         type="assumed_pods")
+            self._export_resilience_metrics()
+
+    def _drain_assumed_requeue(self) -> None:
+        """Requeue expired assumed pods whose hub-side object is still
+        unbound; retried across ticks because the hub may vanish between
+        the expiry and the check."""
+        if not self._assumed_requeue:
+            return
+        still: list[Pod] = []
+        for pod in self._assumed_requeue:
+            try:
+                stored = self.hub.get_pod(pod.metadata.uid)
+            except Unavailable:
+                self._note_hub_down()
+                still.append(pod)
+                continue
+            if stored is not None and not stored.spec.node_name:
+                self.queue.add(stored)
+        self._assumed_requeue = still
+
+    def _probe_hub(self) -> None:
+        """Degraded-mode recovery probe for in-process hubs (a RemoteHub
+        tracks its own transport state; its reads below double as the
+        probe). One cheap read per maintenance tick."""
+        if not self._hub_down:
+            return
+        if getattr(self.hub, "connected", None) is not None:
+            # the client tracks its own transport state: probing would
+            # burn a retried RPC (and the retry budget) per tick while
+            # holding the scheduler lock
+            self._hub_down = False
+            return
+        try:
+            self.hub.get_pod("__degraded_probe__")
+            self._hub_down = False
+            logger.info("hub reachable again: leaving degraded mode")
+        except Unavailable:
+            pass
+
+    def _export_resilience_metrics(self) -> None:
+        """Mirror hub-client and chaos counters into the registry (the
+        hub client and chaos layer have no registry of their own)."""
+        m = self.metrics
+        m.hub_degraded.set(1.0 if self.hub_degraded() else 0.0)
+        rs = getattr(self.hub, "resilience_stats", None)
+        if rs is not None:
+            s = rs()
+            m.hub_client_retries.set(float(s["retries"]))
+            m.hub_client_watch_reconnects.set(
+                float(s["watch_reconnects"]))
+            m.hub_client_degraded_seconds.set(s["degraded_seconds"])
+        cs = getattr(self.hub, "chaos_stats", None)
+        if cs is not None:
+            for kind, v in cs().items():
+                # only actual faults: calls_seen/events_relayed are
+                # traffic counters, not injections
+                if kind.startswith("injected_") or kind == "partitions":
+                    m.chaos_injected_faults.set(float(v), kind=kind)
 
     def run(self, stop: threading.Event, idle_sleep: float = 0.02,
             elector=None) -> None:
@@ -1296,8 +1504,12 @@ class Scheduler:
                 chained = self._chain_eligible([qp.pod for qp in runnable])
                 if not chained:
                     flush_all()   # next launch needs the synced cache
-                nxt = self._dispatch(runnable, chained,
-                                     flush_pending=flush_all)
+                try:
+                    nxt = self._dispatch(runnable, chained,
+                                         flush_pending=flush_all)
+                except Unavailable:
+                    self._park_batch_unreachable(runnable)
+                    nxt = None
                 if nxt is not None:
                     pending.append(nxt)
             # keep up to PIPELINE_DEPTH launches outstanding: batch k-1 is
@@ -1305,10 +1517,10 @@ class Scheduler:
             # a full iteration (dispatch + commit) of head start
             flush_to(PIPELINE_DEPTH)
             # async preemption evictions run between cycles (kep 4832)
-            self.preemption.flush_evictions()
+            self._flush_evictions_safe()
         flush_all()
         self._drain_bind_results(wait=True)
-        self.preemption.flush_evictions()
+        self._flush_evictions_safe()
         self._process_deferred_events()
         self.recorder.flush()
         return total

@@ -37,7 +37,15 @@ class ServingEndpoints:
                 path = self.path.split("?", 1)[0]
                 if path == "/metrics":
                     self._send(200, sched.metrics.registry.render_text())
-                elif path in ("/healthz", "/livez", "/readyz"):
+                elif path == "/readyz":
+                    # degraded (hub unreachable) = alive but NOT ready:
+                    # load balancers should drain, probes should not kill
+                    degraded_fn = getattr(sched, "hub_degraded", None)
+                    if degraded_fn is not None and degraded_fn():
+                        self._send(503, "degraded: hub unreachable")
+                    else:
+                        self._send(200, "ok")
+                elif path in ("/healthz", "/livez"):
                     self._send(200, "ok")
                 elif path == "/configz":
                     cfg = sched.config

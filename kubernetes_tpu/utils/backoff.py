@@ -1,0 +1,107 @@
+"""Retry/backoff primitives for the hub transport (client-go's
+util/retry + flowcontrol.Backoff distilled).
+
+Three pieces, composable and clock-injectable so tests run them on a
+fake clock:
+
+* ``Backoff`` — decorrelated-jitter exponential backoff (the AWS
+  "Exponential Backoff and Jitter" recipe: ``sleep = min(cap,
+  uniform(base, prev * 3))``), seeded-deterministic when given an rng.
+* ``RetryBudget`` — a token bucket capping the cluster-wide retry
+  amplification: each retry spends a token, tokens refill at a fixed
+  rate, and an empty bucket means *fail fast* instead of piling a retry
+  storm onto a hub that is already down (client-go's
+  flowcontrol/throttle + gRPC retry-budget semantics).
+* ``retry_call`` — drive a callable through both plus a per-call
+  deadline: the total time spent including sleeps never exceeds
+  ``deadline`` seconds.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+
+class Backoff:
+    """Decorrelated-jitter backoff sequence. ``next()`` returns the next
+    sleep; ``reset()`` after a success so the next failure starts small."""
+
+    def __init__(self, base: float = 0.05, cap: float = 2.0,
+                 rng: Optional[random.Random] = None):
+        self.base = base
+        self.cap = cap
+        # default: the module-level rng — constructing a fresh
+        # urandom-seeded Random per Backoff would tax the call hot path
+        self._rng = rng if rng is not None else random
+        self._prev = base
+
+    def next(self) -> float:
+        sleep = min(self.cap, self._rng.uniform(self.base, self._prev * 3))
+        self._prev = sleep
+        return sleep
+
+    def reset(self) -> None:
+        self._prev = self.base
+
+
+class RetryBudget:
+    """Token bucket over retries: ``try_spend()`` is True while budget
+    remains; refills continuously at ``refill_per_sec`` up to ``budget``."""
+
+    def __init__(self, budget: float = 10.0, refill_per_sec: float = 2.0,
+                 now: Callable[[], float] = time.monotonic):
+        self._capacity = budget
+        self._tokens = budget
+        self._rate = refill_per_sec
+        self._now = now
+        self._last = now()
+        self._lock = threading.Lock()
+
+    def try_spend(self, cost: float = 1.0) -> bool:
+        with self._lock:
+            now = self._now()
+            self._tokens = min(self._capacity,
+                               self._tokens + (now - self._last) * self._rate)
+            self._last = now
+            if self._tokens < cost:
+                return False
+            self._tokens -= cost
+            return True
+
+    def remaining(self) -> float:
+        with self._lock:
+            now = self._now()
+            return min(self._capacity,
+                       self._tokens + (now - self._last) * self._rate)
+
+
+def retry_call(fn: Callable, *,
+               retry_on: tuple = (OSError,),
+               deadline: float = 8.0,
+               backoff: Optional[Backoff] = None,
+               budget: Optional[RetryBudget] = None,
+               sleep: Callable[[float], None] = time.sleep,
+               now: Callable[[], float] = time.monotonic,
+               on_retry: Optional[Callable[[int, BaseException], None]] = None):
+    """Call ``fn()`` until it succeeds, a non-retryable exception escapes,
+    the deadline passes, or the budget runs dry (then the last retryable
+    exception re-raises). A sleep is clipped so it never overshoots the
+    deadline just to fail on wakeup."""
+    bo = backoff or Backoff()
+    t_end = now() + deadline
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retry_on as e:
+            attempt += 1
+            remaining = t_end - now()
+            if remaining <= 0 or (budget is not None
+                                  and not budget.try_spend()):
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(min(bo.next(), max(remaining, 0.0)))

@@ -106,6 +106,71 @@ def test_scheduler_runs_against_remote_hub(served_hub):
     sched.close()
 
 
+def test_hubserver_restart_mid_watch_emits_gap_diff():
+    """Kill and restart the hubserver mid-watch: the reconnect's relist
+    diff must emit the adds, UPDATES, and deletes that happened during
+    the gap (the docstring contract at hubclient.RemoteHub._watch) —
+    rv-newer objects as updates, unknown ones as adds, vanished ones as
+    deletes."""
+    import socket
+    import time
+
+    hub = Hub()
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    server = HubServer(hub, port=port).start()
+    client = RemoteHub(f"http://127.0.0.1:{port}", timeout=10.0,
+                       retry_base=0.01, retry_cap=0.2)
+    kept = MakePod().name("kept").req(cpu="1").obj()
+    doomed = MakePod().name("doomed").req(cpu="1").obj()
+    hub.create_pod(kept)
+    hub.create_pod(doomed)
+    added, updated, deleted = [], [], []
+    client.watch_pods(EventHandlers(
+        on_add=lambda o: added.append(o.metadata.name),
+        on_update=lambda old, new: updated.append(
+            (new.metadata.name, new.spec.node_name)),
+        on_delete=lambda o: deleted.append(o.metadata.name)))
+    assert sorted(added) == ["doomed", "kept"]
+    server.stop()                      # stream dies
+    # mutate while the reflector is disconnected: one of each verb
+    hub.delete_pod(doomed.metadata.uid)
+    fresh = MakePod().name("fresh").req(cpu="1").obj()
+    hub.create_pod(fresh)
+    hub.bind(kept, "somewhere")        # update: kept gains a node_name
+    server2 = HubServer(hub, port=port).start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline and (
+                "fresh" not in added or "doomed" not in deleted
+                or ("kept", "somewhere") not in updated):
+            time.sleep(0.05)
+        assert "fresh" in added, "add missed during gap must relist in"
+        assert deleted == ["doomed"], "delete during gap must be diffed in"
+        assert ("kept", "somewhere") in updated, \
+            "rv-newer object must dispatch as an update after the gap"
+        assert added.count("kept") == 1, "no duplicate adds from relist"
+    finally:
+        client.close()
+        server2.stop()
+
+
+def test_watch_unknown_kind_fails_fast(served_hub):
+    """A definitive server verdict (400 unknown kind) must surface
+    immediately as RemoteError, not blind-retry to the deadline."""
+    import time
+
+    from kubernetes_tpu.hubclient import RemoteError
+
+    hub, client = served_hub
+    t0 = time.time()
+    with pytest.raises(RemoteError):
+        client._watch("bogus", EventHandlers(), True)
+    assert time.time() - t0 < 2.0
+
+
 def test_lease_rpc(served_hub):
     hub, client = served_hub
     from kubernetes_tpu.leaderelection import Lease
