@@ -34,6 +34,70 @@ _repo = os.path.dirname(os.path.abspath(__file__))
 
 BASELINE_PODS_PER_SEC = 270.0  # misc/performance-config.yaml:63
 
+# the committed artifact README.md's bench table is generated from; a
+# new measurement round commits a new artifact and re-points this
+README_BENCH_ARTIFACT = "BENCH_r05_builder.json"
+_TABLE_BEGIN = "<!-- BENCH_TABLE_BEGIN"
+_TABLE_END = "<!-- BENCH_TABLE_END -->"
+
+
+def readme_bench_table(artifact: dict) -> str:
+    """Render the README bench table MECHANICALLY from a bench artifact —
+    hand-edited numbers drift from the committed measurements (round-5
+    shipped a 243 pods/s claim over a 44.8 artifact row); generated rows
+    cannot."""
+    lines = ["| workload | pods/s | floor | multiple |",
+             "|---|---|---|---|"]
+    for w in artifact["workloads"].values():
+        floor = w.get("threshold") or 0
+        mult = w["pods_per_sec"] / floor if floor else 0.0
+        lines.append(f"| {w['name']} | {w['pods_per_sec']:,.1f} "
+                     f"| {floor:g} | {mult:.1f}× |")
+    return "\n".join(lines)
+
+
+def readme_check(write: bool = False,
+                 artifact_path: str | None = None) -> bool:
+    """--readme-check: diff README.md's generated bench-table block
+    against the committed artifact; False (CI-red) on mismatch.
+    --readme-update (write=True) rewrites the block in place."""
+    artifact_path = artifact_path or os.path.join(_repo,
+                                                  README_BENCH_ARTIFACT)
+    with open(artifact_path) as f:
+        artifact = json.load(f)
+    readme_path = os.path.join(_repo, "README.md")
+    with open(readme_path) as f:
+        readme = f.read()
+    begin = readme.find(_TABLE_BEGIN)
+    end = readme.find(_TABLE_END)
+    if begin < 0 or end < 0 or end < begin:
+        print("README.md: bench-table markers missing/corrupt "
+              f"({_TABLE_BEGIN} ... {_TABLE_END})", file=sys.stderr)
+        return False
+    # keep the marker line (it names the artifact) — regenerate between
+    # the end of that line and the END marker
+    body_start = readme.index("\n", begin) + 1
+    want = readme_bench_table(artifact) + "\n"
+    have = readme[body_start:end]
+    if have == want:
+        return True
+    if write:
+        with open(readme_path, "w") as f:
+            f.write(readme[:body_start] + want + readme[end:])
+        print(f"README.md bench table regenerated from "
+              f"{os.path.basename(artifact_path)}", file=sys.stderr)
+        return True
+    import difflib
+
+    diff = difflib.unified_diff(
+        have.splitlines(keepends=True), want.splitlines(keepends=True),
+        fromfile="README.md (committed)",
+        tofile=f"{os.path.basename(artifact_path)} (generated)")
+    sys.stderr.writelines(diff)
+    print("README bench table does not match the committed artifact; "
+          "run `python bench.py --readme-update`", file=sys.stderr)
+    return False
+
 BENCH_WORKLOAD_FNS = (
     "scheduling_basic",
     "scheduling_node_affinity",
@@ -61,6 +125,11 @@ BENCH_WORKLOAD_FNS = (
 
 
 def main() -> None:
+    if "--readme-check" in sys.argv or "--readme-update" in sys.argv:
+        # red-suite gate next to --chaos-smoke: published README numbers
+        # must be the committed artifact's, mechanically
+        ok = readme_check(write="--readme-update" in sys.argv)
+        sys.exit(0 if ok else 1)
     if "--chaos-smoke" in sys.argv:
         # red-suite gate: one short chaos scenario (scheduler + kubemark
         # through the fault-injecting proxy) must hold the storm

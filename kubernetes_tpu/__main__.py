@@ -27,6 +27,17 @@ def main(argv=None) -> int:
     parser.add_argument("--secure-port", type=int, default=10259,
                         help="serving port for /metrics,/healthz,/configz "
                              "(0 = disabled)")
+    parser.add_argument("--debug-token", default=None,
+                        help="bearer token admitting /debug endpoints "
+                             "(unset = /debug disabled, per the "
+                             "reference's authz-gated debugging handlers)")
+    parser.add_argument("--wal", default=None,
+                        help="WAL file for the in-process hub's event "
+                             "journal (restart replays it); ignored with "
+                             "--hub")
+    parser.add_argument("--journal-capacity", type=int, default=16384,
+                        help="event-journal ring capacity per resource "
+                             "kind (the watch-resume window)")
     parser.add_argument("--leader-elect", action="store_true")
     parser.add_argument("--leader-elect-lease-duration", type=float,
                         default=15.0)
@@ -72,15 +83,21 @@ def main(argv=None) -> int:
         hub = RemoteHub(args.hub)
         print(f"using remote hub {args.hub}", file=sys.stderr)
     else:
-        hub = Hub()
+        hub = Hub(journal_capacity=args.journal_capacity,
+                  wal_path=args.wal)
+        if args.wal:
+            print(f"hub journal WAL at {args.wal} "
+                  f"(replayed rv={hub.current_rv})", file=sys.stderr)
     sched = Scheduler(hub, cfg)
 
     serving = None
     if args.secure_port:
-        from kubernetes_tpu.serving import ServingEndpoints
+        from kubernetes_tpu.serving import ServingEndpoints, token_auth
 
-        serving = ServingEndpoints(sched, host=args.bind_address,
-                                   port=args.secure_port)
+        serving = ServingEndpoints(
+            sched, host=args.bind_address, port=args.secure_port,
+            debug_auth=token_auth(args.debug_token)
+            if args.debug_token else None)
         serving.start()
         print(f"serving /metrics,/healthz,/configz on "
               f"{args.bind_address}:{serving.port}", file=sys.stderr)
@@ -142,8 +159,7 @@ def main(argv=None) -> int:
         if serving is not None:
             serving.stop()
         sched.close()
-        if args.hub:
-            hub.close()
+        hub.close()   # RemoteHub: drain streams; local Hub: release WAL
     return 0
 
 

@@ -713,3 +713,22 @@ class PriorityClass:
     value: int = 0
     global_default: bool = False
     preemption_policy: str = "PreemptLowerPriority"
+
+
+# --- events (core/v1 Event) --------------------------------------------------
+
+
+@dataclass
+class Event:
+    """core/v1 Event analog: an object-level notice a controller records
+    against a referenced object (``ref_kind``/``ref_key``), deduped by
+    (ref, reason) with a bump of ``count`` — how failures that have no
+    natural status field (a DeviceClass whose CEL selector does not
+    compile) become visible instead of silently parking pods."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    ref_kind: str = ""
+    ref_key: str = ""            # "namespace/name" or bare name
+    reason: str = ""
+    message: str = ""
+    count: int = 1

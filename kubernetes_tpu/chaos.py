@@ -292,7 +292,7 @@ class _ProxyHandler(BaseHTTPRequestHandler):
                     break
                 line = raw if raw.endswith(b"\n") else raw + b"\n"
                 stripped = raw.strip()
-                if stripped == b'{"synced": true}':
+                if stripped.startswith(b'{"synced": true'):
                     synced = True
                 elif synced and stripped not in (b"", b"{}"):
                     # only LIVE events trip the cut triggers — a cut

@@ -9,18 +9,30 @@ and pod status patches. Real-cluster integration would implement this same
 interface over HTTPS list/watch; tests and benchmarks run against this hub
 exactly like the reference's integration tests run against an in-process
 apiserver (test/integration/util/util.go:86 StartScheduler).
+
+L0 storage (kubernetes_tpu.storage): every mutation commits a
+revision-stamped event to an etcd-analog journal — a bounded per-kind
+ring with a compaction watermark, optionally WAL-backed so a restarted
+hub replays its state from disk. ``watch_*(h, since_rv=N)`` resumes a
+watch by replaying journal events after N instead of re-listing the
+world; a resume point older than the watermark raises
+:class:`storage.RvTooOld` (the apiserver's 410 "too old resource
+version"), telling the caller to relist. Delete events consume a
+revision of their own (etcd stamps deletions), carried by the event —
+the tombstoned object keeps the rv it died with.
 """
 
 from __future__ import annotations
 
-import itertools
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Optional
 
 from kubernetes_tpu.api.objects import (
+    Event,
     Namespace,
     Node,
+    ObjectMeta,
     PersistentVolume,
     PersistentVolumeClaim,
     Pod,
@@ -31,15 +43,35 @@ from kubernetes_tpu.api.objects import (
     ResourceSlice,
     StorageClass,
 )
+from kubernetes_tpu.storage import Journal, JournalEvent, RvTooOld  # noqa: F401  (re-exported: transport + tests import RvTooOld from here)
 
 
 @dataclass
 class EventHandlers:
-    """cache.ResourceEventHandler equivalent."""
+    """cache.ResourceEventHandler equivalent. ``on_event``, when set,
+    receives the full :class:`JournalEvent` (rv included) INSTEAD of the
+    typed callbacks — the transport layer uses it to put revisions on
+    the wire; informer-style consumers keep the typed trio."""
 
     on_add: Optional[Callable] = None
     on_update: Optional[Callable] = None       # (old, new)
     on_delete: Optional[Callable] = None
+    on_event: Optional[Callable] = None        # (JournalEvent)
+
+
+def _deliver(h: EventHandlers, ev: JournalEvent) -> None:
+    if h.on_event is not None:
+        h.on_event(ev)
+        return
+    if ev.type == "add":
+        if h.on_add:
+            h.on_add(ev.new)
+    elif ev.type == "update":
+        if h.on_update:
+            h.on_update(ev.old, ev.new)
+    elif ev.type == "delete":
+        if h.on_delete:
+            h.on_delete(ev.old)
 
 
 class Conflict(Exception):
@@ -57,84 +89,253 @@ class Unavailable(Exception):
     never as a verdict about the object."""
 
 
+def _by_name(obj) -> str:
+    return obj.metadata.name
+
+
+def _by_key(obj) -> str:
+    return obj.key()
+
+
 class _Store:
-    def __init__(self, kind: str):
+    def __init__(self, kind: str, watch_kind: str,
+                 index_key: Optional[Callable] = None):
         self.kind = kind
+        self.watch_kind = watch_kind
         self.objects: dict[str, object] = {}   # uid -> object
         self.handlers: list[EventHandlers] = []
+        self.index_key = index_key             # secondary index key fn
+        self.index: dict[str, str] = {}        # key -> uid
+
+    def index_add(self, obj) -> None:
+        if self.index_key is not None:
+            self.index[self.index_key(obj)] = obj.metadata.uid
+
+    def index_remove(self, obj) -> None:
+        if self.index_key is not None:
+            self.index.pop(self.index_key(obj), None)
+
+    def by_index(self, key: str):
+        uid = self.index.get(key)
+        return self.objects.get(uid) if uid else None
 
 
 class Hub:
-    def __init__(self) -> None:
+    def __init__(self, journal_capacity: int = 16384,
+                 wal_path: str | None = None) -> None:
         self._lock = threading.RLock()
-        self._rv = itertools.count(1)
-        self._nodes = _Store("Node")
-        self._pods = _Store("Pod")
-        self._priority_classes = _Store("PriorityClass")
-        self._namespaces = _Store("Namespace")
-        self._pdbs = _Store("PodDisruptionBudget")
-        self._pvcs = _Store("PersistentVolumeClaim")
-        self._pvs = _Store("PersistentVolume")
-        self._storage_classes = _Store("StorageClass")
-        self._pvc_by_key: dict[str, str] = {}   # "ns/name" -> uid
-        self._pv_by_name: dict[str, str] = {}   # name -> uid
-        self._sc_by_name: dict[str, str] = {}
-        self._node_by_name: dict[str, str] = {}
-        self._claims = _Store("ResourceClaim")
+        self._last_rv = 0
+        self._nodes = _Store("Node", "nodes", _by_name)
+        self._pods = _Store("Pod", "pods")
+        self._priority_classes = _Store("PriorityClass", "priority_classes")
+        self._namespaces = _Store("Namespace", "namespaces")
+        self._pdbs = _Store("PodDisruptionBudget", "pdbs")
+        self._pvcs = _Store("PersistentVolumeClaim", "pvcs", _by_key)
+        self._pvs = _Store("PersistentVolume", "pvs", _by_name)
+        self._storage_classes = _Store("StorageClass", "storage_classes",
+                                       _by_name)
+        self._claims = _Store("ResourceClaim", "resource_claims", _by_key)
+        self._slices = _Store("ResourceSlice", "resource_slices")
+        self._claim_templates = _Store("ResourceClaimTemplate",
+                                       "resource_claim_templates", _by_key)
+        self._device_classes = _Store("DeviceClass", "device_classes",
+                                      _by_name)
+        self._csi_capacities = _Store("CSIStorageCapacity",
+                                      "csi_capacities")
+        # core/v1 Event analog, deduped by (ref, reason) with a count
+        # bump — how controllers surface object-level failures (e.g. a
+        # DeviceClass whose CEL selector does not compile)
+        self._events = _Store("Event", "events",
+                              lambda e: f"{e.ref_kind}/{e.ref_key}"
+                                        f":{e.reason}")
+        self._stores: dict[str, _Store] = {
+            s.watch_kind: s for s in (
+                self._nodes, self._pods, self._priority_classes,
+                self._namespaces, self._pdbs, self._pvcs, self._pvs,
+                self._storage_classes, self._claims, self._slices,
+                self._claim_templates, self._device_classes,
+                self._csi_capacities, self._events)}
+        self.journal = Journal(capacity=journal_capacity,
+                               wal_path=wal_path)
+        if wal_path:
+            self._replay_wal()
         from kubernetes_tpu.leaderelection import LeaseStore
 
+        # leases are deliberately NOT journaled: leadership is ephemeral
+        # by contract (a restarted hub must force re-election, not
+        # resurrect a stale holder)
         self.leases = LeaseStore()
-        self._slices = _Store("ResourceSlice")
-        self._claim_by_key: dict[str, str] = {}
-        self._claim_templates = _Store("ResourceClaimTemplate")
-        self._template_by_key: dict[str, str] = {}
-        self._device_classes = _Store("DeviceClass")
-        self._device_class_by_name: dict[str, str] = {}
-        self._csi_capacities = _Store("CSIStorageCapacity")
+
+    # ------------- revision space / journal -------------
+
+    def _next_rv(self) -> int:
+        self._last_rv += 1
+        return self._last_rv
+
+    @property
+    def current_rv(self) -> int:
+        with self._lock:
+            return self._last_rv
+
+    def _commit(self, store: _Store, etype: str, old, new) -> JournalEvent:
+        """Stamp one revision, journal the event (WAL included). Caller
+        holds the lock and has already mutated ``store.objects`` — the
+        journal append must land before any later revision is stamped,
+        so ring suffixes stay complete per kind."""
+        rv = self._next_rv()
+        if new is not None:
+            new.metadata.resource_version = rv
+        ev = JournalEvent(rv=rv, kind=store.watch_kind, type=etype,
+                          old=old, new=new)
+        self.journal.append(ev)
+        return ev
+
+    def _replay_wal(self) -> None:
+        """Rebuild stores + journal rings from the WAL (hub restart).
+        Events re-apply in commit order with their original revisions;
+        nothing dispatches — there are no watchers yet. When the
+        replayed history dwarfs the live object count, the WAL is
+        compacted on the spot (snapshot rewrite) so it cannot grow
+        without bound across restart cycles."""
+        max_rv = 0
+        n_events = 0
+        for ev in self.journal.replay_wal():
+            store = self._stores.get(ev.kind)
+            if store is not None:
+                if ev.type == "delete":
+                    old = store.objects.pop(ev.old.metadata.uid, None)
+                    if old is not None:
+                        store.index_remove(old)
+                else:
+                    store.objects[ev.new.metadata.uid] = ev.new
+                    store.index_add(ev.new)
+            self.journal.append(ev, persist=False)
+            max_rv = max(max_rv, ev.rv)
+            n_events += 1
+        # a torn tail (write cut mid-append) must be truncated BEFORE
+        # this hub's first append merges into it
+        self.journal.repair_wal()
+        # a WAL rewrite may have compacted past the last surviving event
+        self._last_rv = max(max_rv, self.journal.compact_floor)
+        live = sum(len(s.objects) for s in self._stores.values())
+        if n_events > max(64, 2 * live):
+            self._compact_wal()
+
+    def _compact_wal(self) -> None:
+        """Snapshot-rewrite the WAL: one add-event per live object,
+        behind a compact record at the current revision. The in-memory
+        rings keep this boot's full replayed history — the floor only
+        governs what the NEXT restart (and resumes across it) can see."""
+        events = [JournalEvent(rv=o.metadata.resource_version,
+                               kind=s.watch_kind, type="add", new=o)
+                  for s in self._stores.values()
+                  for o in s.objects.values()]
+        events.sort(key=lambda e: e.rv)
+        self.journal.rewrite_wal(self._last_rv, events)
+
+    def get_journal_stats(self) -> dict:
+        """Journal depth/watermark per kind (the hub_journal_* gauges)."""
+        with self._lock:
+            return {"rv": self._last_rv,
+                    "capacity": self.journal.capacity,
+                    "wal": bool(self.journal.wal_path),
+                    "kinds": self.journal.stats()}
+
+    def close(self) -> None:
+        """Release the WAL file handle (no-op for memory-only hubs)."""
+        self.journal.close()
 
     # ------------- watch registration -------------
 
-    def watch_nodes(self, h: EventHandlers, replay: bool = True) -> None:
+    def _watch_store(self, store: _Store, h: EventHandlers,
+                     replay: bool = True,
+                     since_rv: int | None = None) -> int:
+        """Register ``h`` and replay under the lock (a consistent LIST /
+        journal suffix: replayed deliveries land before any live event).
+        ``since_rv`` switches replay to watch-resume — journal events
+        after since_rv instead of synthetic adds of the world — raising
+        RvTooOld (BEFORE registering) when the gap was compacted.
+        Returns the current global revision (the wire's sync marker)."""
         with self._lock:
-            self._nodes.handlers.append(h)
-            if replay and h.on_add:
-                for o in list(self._nodes.objects.values()):
-                    h.on_add(o)
+            if since_rv is not None:
+                if since_rv > self._last_rv:
+                    # a resume point from a FUTURE revision means the
+                    # client watched a different revision space (a hub
+                    # reborn without its WAL): "no events" here would be
+                    # a lie that pins phantom state in the client forever
+                    raise RvTooOld(store.watch_kind, since_rv,
+                                   self._last_rv)
+                events = self.journal.events_after(store.watch_kind,
+                                                   since_rv)
+                store.handlers.append(h)
+                for ev in events:
+                    _deliver(h, ev)
+            else:
+                store.handlers.append(h)
+                if replay:
+                    for o in list(store.objects.values()):
+                        _deliver(h, JournalEvent(
+                            rv=o.metadata.resource_version,
+                            kind=store.watch_kind, type="add", new=o))
+            return self._last_rv
 
-    def watch_pods(self, h: EventHandlers, replay: bool = True) -> None:
-        with self._lock:
-            self._pods.handlers.append(h)
-            if replay and h.on_add:
-                for o in list(self._pods.objects.values()):
-                    h.on_add(o)
+    def watch_nodes(self, h: EventHandlers, replay: bool = True,
+                    since_rv: int | None = None) -> int:
+        return self._watch_store(self._nodes, h, replay, since_rv)
+
+    def watch_pods(self, h: EventHandlers, replay: bool = True,
+                   since_rv: int | None = None) -> int:
+        return self._watch_store(self._pods, h, replay, since_rv)
+
+    def watch_namespaces(self, h: EventHandlers, replay: bool = True,
+                         since_rv: int | None = None) -> int:
+        return self._watch_store(self._namespaces, h, replay, since_rv)
+
+    def watch_pvcs(self, h: EventHandlers, replay: bool = True,
+                   since_rv: int | None = None) -> int:
+        return self._watch_store(self._pvcs, h, replay, since_rv)
+
+    def watch_pvs(self, h: EventHandlers, replay: bool = True,
+                  since_rv: int | None = None) -> int:
+        return self._watch_store(self._pvs, h, replay, since_rv)
+
+    def watch_resource_claims(self, h: EventHandlers, replay: bool = True,
+                              since_rv: int | None = None) -> int:
+        return self._watch_store(self._claims, h, replay, since_rv)
+
+    def watch_resource_slices(self, h: EventHandlers, replay: bool = True,
+                              since_rv: int | None = None) -> int:
+        return self._watch_store(self._slices, h, replay, since_rv)
+
+    def watch_resource_claim_templates(self, h: EventHandlers,
+                                       replay: bool = True,
+                                       since_rv: int | None = None) -> int:
+        return self._watch_store(self._claim_templates, h, replay,
+                                 since_rv)
+
+    def watch_csi_capacities(self, h: EventHandlers, replay: bool = True,
+                             since_rv: int | None = None) -> int:
+        return self._watch_store(self._csi_capacities, h, replay,
+                                 since_rv)
 
     def unwatch(self, h: EventHandlers) -> None:
         """Deregister a handler from every store (watch-stream teardown —
         the transport layer's connection close)."""
         with self._lock:
-            for store in (self._nodes, self._pods, self._namespaces,
-                          self._pdbs, self._pvcs, self._pvs, self._claims,
-                          self._slices, self._priority_classes,
-                          self._storage_classes, self._claim_templates,
-                          self._device_classes, self._csi_capacities):
+            for store in self._stores.values():
                 try:
                     store.handlers.remove(h)
                 except ValueError:
                     pass
 
     @staticmethod
-    def _dispatch(store: _Store, kind: str, old, new) -> None:
+    def _dispatch(store: _Store, ev: JournalEvent) -> None:
         """Deliver one event. NEVER called holding the hub lock: handlers
         take their own locks (the scheduler's loop lock), and a watcher
         blocked there must not hold up other API callers — the cycle
         hub-lock -> handler-lock -> (binder) -> hub-lock would deadlock."""
         for h in list(store.handlers):
-            if kind == "add" and h.on_add:
-                h.on_add(new)
-            elif kind == "update" and h.on_update:
-                h.on_update(old, new)
-            elif kind == "delete" and h.on_delete:
-                h.on_delete(old)
+            _deliver(h, ev)
 
     # ------------- generic CRUD -------------
 
@@ -143,9 +344,10 @@ class Hub:
             uid = obj.metadata.uid
             if uid in store.objects:
                 raise Conflict(f"{store.kind} {uid} already exists")
-            obj.metadata.resource_version = next(self._rv)
             store.objects[uid] = obj
-        self._dispatch(store, "add", None, obj)
+            store.index_add(obj)
+            ev = self._commit(store, "add", None, obj)
+        self._dispatch(store, ev)
 
     def _update(self, store: _Store, obj) -> None:
         with self._lock:
@@ -153,38 +355,34 @@ class Hub:
             old = store.objects.get(uid)
             if old is None:
                 raise NotFound(f"{store.kind} {uid}")
-            obj.metadata.resource_version = next(self._rv)
             store.objects[uid] = obj
-        self._dispatch(store, "update", old, obj)
+            store.index_add(obj)
+            ev = self._commit(store, "update", old, obj)
+        self._dispatch(store, ev)
 
     def _delete(self, store: _Store, uid: str) -> None:
         with self._lock:
             old = store.objects.pop(uid, None)
             if old is None:
                 raise NotFound(f"{store.kind} {uid}")
-        self._dispatch(store, "delete", old, None)
+            store.index_remove(old)
+            ev = self._commit(store, "delete", old, None)
+        self._dispatch(store, ev)
 
     # ------------- nodes -------------
 
     def create_node(self, node: Node) -> None:
-        with self._lock:
-            self._node_by_name[node.metadata.name] = node.metadata.uid
         self._create(self._nodes, node)
 
     def update_node(self, node: Node) -> None:
         self._update(self._nodes, node)
 
     def delete_node(self, uid: str) -> None:
-        with self._lock:
-            old = self._nodes.objects.get(uid)
-            if old is not None:
-                self._node_by_name.pop(old.metadata.name, None)
         self._delete(self._nodes, uid)
 
     def get_node(self, name: str) -> Optional[Node]:
         with self._lock:
-            uid = self._node_by_name.get(name)
-            return self._nodes.objects.get(uid) if uid else None
+            return self._nodes.by_index(name)
 
     def list_nodes(self) -> list[Node]:
         with self._lock:
@@ -211,10 +409,10 @@ class Hub:
 
     # ------------- the scheduler's write paths -------------
 
-    def _swap_pod(self, old: Pod, new: Pod) -> None:
+    def _swap_pod(self, old: Pod, new: Pod) -> JournalEvent:
         """Commit a prepared pod revision under the lock, dispatch outside."""
-        new.metadata.resource_version = next(self._rv)
         self._pods.objects[new.metadata.uid] = new
+        return self._commit(self._pods, "update", old, new)
 
     def bind(self, pod: Pod, node_name: str) -> None:
         """The Binding subresource: sets spec.nodeName exactly once
@@ -228,8 +426,8 @@ class Hub:
                                f"{stored.spec.node_name}")
             new = stored.clone()
             new.spec.node_name = node_name
-            self._swap_pod(stored, new)
-        self._dispatch(self._pods, "update", stored, new)
+            ev = self._swap_pod(stored, new)
+        self._dispatch(self._pods, ev)
 
     def patch_pod_condition(self, pod: Pod, condition: PodCondition,
                             nominated_node: str | None = None) -> None:
@@ -244,8 +442,8 @@ class Hub:
             ] + [condition]
             if nominated_node is not None:
                 new.status.nominated_node_name = nominated_node
-            self._swap_pod(stored, new)
-        self._dispatch(self._pods, "update", stored, new)
+            ev = self._swap_pod(stored, new)
+        self._dispatch(self._pods, ev)
 
     def set_pod_claim_statuses(self, uid: str,
                                statuses: dict[str, str]) -> None:
@@ -257,8 +455,8 @@ class Hub:
                 return
             new = stored.clone()
             new.status.resource_claim_statuses = dict(statuses)
-            self._swap_pod(stored, new)
-        self._dispatch(self._pods, "update", stored, new)
+            ev = self._swap_pod(stored, new)
+        self._dispatch(self._pods, ev)
 
     def clear_nominated_node(self, uid: str) -> None:
         """Clear status.nominatedNodeName (preemption.go prepareCandidate
@@ -269,17 +467,10 @@ class Hub:
                 return
             new = stored.clone()
             new.status.nominated_node_name = ""
-            self._swap_pod(stored, new)
-        self._dispatch(self._pods, "update", stored, new)
+            ev = self._swap_pod(stored, new)
+        self._dispatch(self._pods, ev)
 
     # ------------- namespaces -------------
-
-    def watch_namespaces(self, h: EventHandlers, replay: bool = True) -> None:
-        with self._lock:
-            self._namespaces.handlers.append(h)
-            if replay and h.on_add:
-                for o in list(self._namespaces.objects.values()):
-                    h.on_add(o)
 
     def create_namespace(self, ns: Namespace) -> None:
         self._create(self._namespaces, ns)
@@ -311,121 +502,67 @@ class Hub:
 
     # ------------- volumes (PVC / PV / StorageClass) -------------
 
-    def watch_pvcs(self, h: EventHandlers, replay: bool = True) -> None:
-        with self._lock:
-            self._pvcs.handlers.append(h)
-            if replay and h.on_add:
-                for o in list(self._pvcs.objects.values()):
-                    h.on_add(o)
-
-    def watch_pvs(self, h: EventHandlers, replay: bool = True) -> None:
-        with self._lock:
-            self._pvs.handlers.append(h)
-            if replay and h.on_add:
-                for o in list(self._pvs.objects.values()):
-                    h.on_add(o)
-
     def create_pvc(self, pvc: PersistentVolumeClaim) -> None:
-        with self._lock:
-            self._pvc_by_key[pvc.key()] = pvc.metadata.uid
         self._create(self._pvcs, pvc)
 
     def update_pvc(self, pvc: PersistentVolumeClaim) -> None:
         self._update(self._pvcs, pvc)
 
     def delete_pvc(self, uid: str) -> None:
-        with self._lock:
-            old = self._pvcs.objects.get(uid)
-            if old is not None:
-                self._pvc_by_key.pop(old.key(), None)
         self._delete(self._pvcs, uid)
 
     def get_pvc(self, namespace: str, name: str
                 ) -> Optional[PersistentVolumeClaim]:
         with self._lock:
-            uid = self._pvc_by_key.get(f"{namespace}/{name}")
-            return self._pvcs.objects.get(uid) if uid else None
+            return self._pvcs.by_index(f"{namespace}/{name}")
 
     def list_pvcs(self) -> list[PersistentVolumeClaim]:
         with self._lock:
             return list(self._pvcs.objects.values())
 
     def create_pv(self, pv: PersistentVolume) -> None:
-        with self._lock:
-            self._pv_by_name[pv.metadata.name] = pv.metadata.uid
         self._create(self._pvs, pv)
 
     def update_pv(self, pv: PersistentVolume) -> None:
         self._update(self._pvs, pv)
 
     def delete_pv(self, uid: str) -> None:
-        with self._lock:
-            old = self._pvs.objects.get(uid)
-            if old is not None:
-                self._pv_by_name.pop(old.metadata.name, None)
         self._delete(self._pvs, uid)
 
     def get_pv(self, name: str) -> Optional[PersistentVolume]:
         with self._lock:
-            uid = self._pv_by_name.get(name)
-            return self._pvs.objects.get(uid) if uid else None
+            return self._pvs.by_index(name)
 
     def list_pvs(self) -> list[PersistentVolume]:
         with self._lock:
             return list(self._pvs.objects.values())
 
     def create_storage_class(self, sc: StorageClass) -> None:
-        with self._lock:
-            self._sc_by_name[sc.metadata.name] = sc.metadata.uid
         self._create(self._storage_classes, sc)
 
     def get_storage_class(self, name: str) -> Optional[StorageClass]:
         with self._lock:
-            uid = self._sc_by_name.get(name)
-            return self._storage_classes.objects.get(uid) if uid else None
+            return self._storage_classes.by_index(name)
 
     # ------------- dynamic resource allocation -------------
 
-    def watch_resource_claims(self, h: EventHandlers,
-                              replay: bool = True) -> None:
-        with self._lock:
-            self._claims.handlers.append(h)
-            if replay and h.on_add:
-                for o in list(self._claims.objects.values()):
-                    h.on_add(o)
-
     def create_resource_claim(self, claim: ResourceClaim) -> None:
-        with self._lock:
-            self._claim_by_key[claim.key()] = claim.metadata.uid
         self._create(self._claims, claim)
 
     def update_resource_claim(self, claim: ResourceClaim) -> None:
         self._update(self._claims, claim)
 
     def delete_resource_claim(self, uid: str) -> None:
-        with self._lock:
-            old = self._claims.objects.get(uid)
-            if old is not None:
-                self._claim_by_key.pop(old.key(), None)
         self._delete(self._claims, uid)
 
     def get_resource_claim(self, namespace: str, name: str
                            ) -> Optional[ResourceClaim]:
         with self._lock:
-            uid = self._claim_by_key.get(f"{namespace}/{name}")
-            return self._claims.objects.get(uid) if uid else None
+            return self._claims.by_index(f"{namespace}/{name}")
 
     def list_resource_claims(self) -> list[ResourceClaim]:
         with self._lock:
             return list(self._claims.objects.values())
-
-    def watch_resource_slices(self, h: EventHandlers,
-                              replay: bool = True) -> None:
-        with self._lock:
-            self._slices.handlers.append(h)
-            if replay and h.on_add:
-                for o in list(self._slices.objects.values()):
-                    h.on_add(o)
 
     def create_resource_slice(self, sl: ResourceSlice) -> None:
         self._create(self._slices, sl)
@@ -437,31 +574,12 @@ class Hub:
         with self._lock:
             return list(self._slices.objects.values())
 
-    def watch_resource_claim_templates(self, h: EventHandlers,
-                                       replay: bool = True) -> None:
-        with self._lock:
-            self._claim_templates.handlers.append(h)
-            if replay and h.on_add:
-                for o in list(self._claim_templates.objects.values()):
-                    h.on_add(o)
-
     def create_resource_claim_template(self, t) -> None:
-        with self._lock:
-            self._template_by_key[t.key()] = t.metadata.uid
         self._create(self._claim_templates, t)
 
     def get_resource_claim_template(self, namespace: str, name: str):
         with self._lock:
-            uid = self._template_by_key.get(f"{namespace}/{name}")
-            return self._claim_templates.objects.get(uid) if uid else None
-
-    def watch_csi_capacities(self, h: EventHandlers,
-                             replay: bool = True) -> None:
-        with self._lock:
-            self._csi_capacities.handlers.append(h)
-            if replay and h.on_add:
-                for o in list(self._csi_capacities.objects.values()):
-                    h.on_add(o)
+            return self._claim_templates.by_index(f"{namespace}/{name}")
 
     def create_csi_capacity(self, c) -> None:
         self._create(self._csi_capacities, c)
@@ -474,14 +592,11 @@ class Hub:
             return list(self._csi_capacities.objects.values())
 
     def create_device_class(self, dc) -> None:
-        with self._lock:
-            self._device_class_by_name[dc.metadata.name] = dc.metadata.uid
         self._create(self._device_classes, dc)
 
     def get_device_class(self, name: str):
         with self._lock:
-            uid = self._device_class_by_name.get(name)
-            return self._device_classes.objects.get(uid) if uid else None
+            return self._device_classes.by_index(name)
 
     def list_device_classes(self) -> list:
         with self._lock:
@@ -495,3 +610,44 @@ class Hub:
     def list_priority_classes(self) -> list[PriorityClass]:
         with self._lock:
             return list(self._priority_classes.objects.values())
+
+    # ------------- events (core/v1 Event analog) -------------
+
+    def record_event(self, ref_kind: str, ref_key: str, reason: str,
+                     message: str) -> None:
+        """Record an object-level failure/notice, deduped by
+        (ref, reason): a repeat bumps ``count`` and refreshes the
+        message (the reference's event aggregation), so a hot loop
+        hitting the same broken object cannot flood the store."""
+        with self._lock:
+            key = f"{ref_kind}/{ref_key}:{reason}"
+            old = self._events.by_index(key)
+            if old is not None:
+                new = Event(metadata=ObjectMeta(
+                                name=old.metadata.name,
+                                uid=old.metadata.uid),
+                            ref_kind=ref_kind, ref_key=ref_key,
+                            reason=reason, message=message,
+                            count=old.count + 1)
+                self._events.objects[new.metadata.uid] = new
+                ev = self._commit(self._events, "update", old, new)
+            else:
+                obj = Event(metadata=ObjectMeta(
+                                name=f"{ref_kind.lower()}-{reason.lower()}"
+                                     f"-{self._last_rv + 1}"),
+                            ref_kind=ref_kind, ref_key=ref_key,
+                            reason=reason, message=message)
+                self._events.objects[obj.metadata.uid] = obj
+                self._events.index_add(obj)
+                ev = self._commit(self._events, "add", None, obj)
+        self._dispatch(self._events, ev)
+
+    def list_events(self, ref_kind: str | None = None,
+                    ref_key: str | None = None) -> list[Event]:
+        with self._lock:
+            out = list(self._events.objects.values())
+        if ref_kind is not None:
+            out = [e for e in out if e.ref_kind == ref_kind]
+        if ref_key is not None:
+            out = [e for e in out if e.ref_key == ref_key]
+        return out

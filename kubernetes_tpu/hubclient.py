@@ -23,6 +23,17 @@ Resilience (client-go's retry/reflector discipline, SURVEY §5.3/§5.8):
   pruned instead of leaking;
 * ``connected``/``resilience_stats()`` expose degraded state, retry and
   reconnect counts, and cumulative degraded seconds for metrics.
+
+Watch-resume (the etcd revision discipline, kubernetes_tpu.storage):
+every reflector tracks the newest journal revision it has seen (event
+``rv`` fields + sync markers). A reconnect dials ``since_rv=<last>``
+first — the hub replays only the missed journal suffix, so a stream cut
+at Daemonset scale costs a handful of events, not a 15k-object relist
+storm. Only when the server answers 410 (``RvTooOld``: the gap was
+compacted) does the reflector fall back to the full relist, whose
+replay is DIFFED against local state so missed deletes still surface.
+``resilience_stats()`` counts both paths (``watch_resumes`` /
+``watch_relists``) for the hub_watch_*_total metrics.
 """
 
 from __future__ import annotations
@@ -102,6 +113,8 @@ class RemoteHub:
         self._degraded_accum = 0.0
         self._retries = 0
         self._watch_reconnects = 0
+        self._watch_resumes = 0    # reconnects served from the journal
+        self._watch_relists = 0    # reconnects that fell back to LIST
         # reflectors currently disconnected (watch health is tracked
         # apart from call health: RPCs can succeed while every stream is
         # down, and informer-confirm-dependent logic must see THAT)
@@ -143,6 +156,8 @@ class RemoteHub:
                 degraded_s += time.monotonic() - self._degraded_since
             return {"retries": self._retries,
                     "watch_reconnects": self._watch_reconnects,
+                    "watch_resumes": self._watch_resumes,
+                    "watch_relists": self._watch_relists,
                     "watches_down": self._watch_down,
                     "degraded_seconds": degraded_s,
                     "degraded": self._degraded_since is not None}
@@ -212,16 +227,24 @@ class RemoteHub:
 
     def _watch(self, kind: str, h: EventHandlers, replay: bool) -> None:
         """One reflector: LIST(replay)+WATCH with resourceVersion dedup,
-        reconnect-with-relist on stream failure (client-go's reflector
-        discipline). ``state`` tracks uid -> (rv, obj) so
+        reconnect-with-RESUME on stream failure (client-go's reflector
+        discipline over the hub's etcd-analog journal). ``state`` tracks
+        uid -> (rv, obj) so
 
         * duplicate adds from the replay/live race are dropped by rv,
         * orphan deletes (object gone before we ever listed it) are
           dropped,
-        * a reconnect's replay is DIFFED against state: rv-newer objects
+        * a RELIST's replay is DIFFED against state: rv-newer objects
           dispatch as updates, unknown ones as adds, and tracked objects
           absent from the relist dispatch as deletes (the events missed
           during the gap).
+
+        ``last_rv`` tracks the newest journal revision this reflector
+        has seen (event rv fields and sync markers). Reconnects dial
+        ``since_rv=last_rv`` first: the hub replays only the missed
+        journal suffix — no relist, no diff needed. A 410 answer
+        (RvTooOld: the gap was compacted) falls back to the full-relist
+        path above. ``watch_resumes``/``watch_relists`` count the split.
 
         When the caller asked replay=False (live-only consumers), the
         first connection's replay still runs but only SEEDS state without
@@ -229,6 +252,11 @@ class RemoteHub:
         synced = threading.Event()
         state: dict[str, tuple[int, object]] = {}
         current: list = [None]   # this reflector's live response handle
+        last_rv = [0]            # newest journal revision seen
+
+        def note_rv(rv) -> None:
+            if rv and rv > last_rv[0]:
+                last_rv[0] = rv
 
         def dispatch(ev: dict, suppress: bool, live: set) -> None:
             etype = ev.get("type")
@@ -255,10 +283,12 @@ class RemoteHub:
             elif h.on_update:
                 h.on_update(prev[1], new)
 
-        def connect():
-            resp = urllib.request.urlopen(
-                f"{self._base}/watch?kind={kind}&replay=1",
-                timeout=self._timeout)
+        def connect(since_rv: int | None = None):
+            if since_rv is not None:
+                url = f"{self._base}/watch?kind={kind}&since_rv={since_rv}"
+            else:
+                url = f"{self._base}/watch?kind={kind}&replay=1"
+            resp = urllib.request.urlopen(url, timeout=self._timeout)
             with self._wlock:
                 # swap, don't leak: the previous connection's response
                 # object is dead once we reconnect
@@ -270,8 +300,12 @@ class RemoteHub:
             return resp
 
         def consume(resp, suppress_replay: bool,
-                    progressed: list[bool]) -> None:
-            replaying = True
+                    progressed: list[bool], resumed: bool) -> None:
+            # a resumed stream replays the JOURNAL SUFFIX, not a LIST:
+            # its pre-sync events are ordinary incremental events (never
+            # suppressed, never relist-diffed at the sync marker)
+            in_replay = not resumed
+            sync_seen = False
             live: set[str] = set()
             for raw in resp:
                 if self._closed.is_set():
@@ -280,7 +314,7 @@ class RemoteHub:
                 if not line:
                     continue
                 ev = json.loads(line)
-                if not replaying and ev and not ev.get("synced"):
+                if sync_seen and ev and not ev.get("synced"):
                     # a LIVE event arrived: the stream genuinely worked,
                     # so the next outage's backoff restarts from base.
                     # (Keying on any bytes would reset on every replay —
@@ -289,21 +323,35 @@ class RemoteHub:
                     # a return-based signal would never fire.)
                     progressed[0] = True
                 if ev.get("synced"):
-                    # relist diff: anything tracked but absent from this
-                    # replay was deleted while we weren't watching
-                    for uid in [u for u in state if u not in live]:
-                        _, obj = state.pop(uid)
-                        if h.on_delete and not suppress_replay:
-                            h.on_delete(obj)
-                    replaying = False
+                    note_rv(ev.get("rv"))
+                    if in_replay:
+                        # relist diff: anything tracked but absent from
+                        # this replay was deleted while we weren't
+                        # watching
+                        for uid in [u for u in state if u not in live]:
+                            _, obj = state.pop(uid)
+                            if h.on_delete and not suppress_replay:
+                                h.on_delete(obj)
+                    in_replay = False
+                    sync_seen = True
                     synced.set()
                     continue
                 if not ev:
                     continue                # keepalive
-                dispatch(ev, suppress_replay and replaying, live)
+                if not in_replay:
+                    # the resume point advances ONLY along rv-ordered
+                    # streams: live events, journal suffixes, and sync
+                    # markers. LIST replay is insertion-ordered — a cut
+                    # mid-replay could leave last_rv beyond objects never
+                    # delivered, and a resume from there would skip them
+                    # silently forever; leaving last_rv untouched makes
+                    # that reconnect retry/relist instead
+                    note_rv(ev.get("rv"))
+                dispatch(ev, suppress_replay and in_replay, live)
 
         def run(first_resp) -> None:
             resp, suppress = first_resp, not replay
+            resumed = False
             bo = Backoff(self._retry_base, self._retry_cap)
             stream_ok = [True]
 
@@ -325,7 +373,7 @@ class RemoteHub:
                 while not self._closed.is_set():
                     progressed = [False]
                     try:
-                        consume(resp, suppress, progressed)
+                        consume(resp, suppress, progressed, resumed)
                     except (OSError, ValueError, AttributeError):
                         # close() from another thread nulls the fp
                         # mid-read (AttributeError); a dying server
@@ -345,22 +393,34 @@ class RemoteHub:
                         # the next outage's backoff restarts from base
                         bo.reset()
                     self._mark_degraded()
-                    # reconnect + relist; replay is never suppressed
-                    # again — state absorbs it via rv dedup, the diff
-                    # emits the gap. The inner loop sleeps-then-dials
-                    # until a connection holds, so consume() is never
-                    # re-entered with a dead handle.
+                    # reconnect, preferring RESUME (since_rv=last seen
+                    # revision: the hub replays only the missed journal
+                    # suffix). A 410 means the gap was compacted — fall
+                    # back to the relist, whose replay is never
+                    # suppressed — state absorbs it via rv dedup, the
+                    # diff emits the gap. The inner loop sleeps-then-
+                    # dials until a connection holds, so consume() is
+                    # never re-entered with a dead handle.
+                    force_relist = False
                     while True:
                         if self._closed.wait(bo.next()):
                             return             # close() during the sleep
+                        since = None if force_relist or last_rv[0] <= 0 \
+                            else last_rv[0]
                         try:
-                            resp = connect()
+                            resp = connect(since)
                         except urllib.error.HTTPError as e:
-                            if e.code in _RETRYABLE_HTTP:
-                                try:
-                                    e.close()  # no socket leak per retry
-                                except OSError:
-                                    pass
+                            code = e.code
+                            try:
+                                e.close()      # no socket leak per retry
+                            except OSError:
+                                pass
+                            if code == 410 and since is not None:
+                                # journal compacted past our resume
+                                # point: relist on the next dial
+                                force_relist = True
+                                continue
+                            if code in _RETRYABLE_HTTP:
                                 continue       # gateway blip: redial
                             # a definitive server verdict (400 unknown
                             # kind, 404 misroute) cannot heal by
@@ -368,10 +428,11 @@ class RemoteHub:
                             # hammering the server
                             logger.error("watch %s rejected by server "
                                          "(HTTP %s); reflector stopping",
-                                         kind, e.code)
+                                         kind, code)
                             return
                         except _TRANSPORT_ERRORS:
                             continue
+                        resumed = since is not None
                         break
                     if self._closed.is_set():
                         # close() raced the reconnect: it already
@@ -386,6 +447,10 @@ class RemoteHub:
                     self._mark_connected()
                     with self._slock:
                         self._watch_reconnects += 1
+                        if resumed:
+                            self._watch_resumes += 1
+                        else:
+                            self._watch_relists += 1
             finally:
                 # a reflector exiting (close(), fatal server verdict)
                 # must not pin the client-wide watch-health gauge down

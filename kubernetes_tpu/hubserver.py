@@ -10,8 +10,15 @@ LIST+WATCH exactly like the reference's client-go does to its apiserver
 * ``GET /watch?kind=pods&replay=1`` — chunked JSON-lines event stream
   (the WATCH verb): with replay, the current objects arrive as synthetic
   adds under the hub lock (a consistent LIST) followed by a
-  ``{"synced": true}`` marker (WaitForCacheSync's signal), then live
-  events for the life of the connection.
+  ``{"synced": true, "rv": N}`` marker (WaitForCacheSync's signal, N =
+  the global revision the stream is consistent at), then live events for
+  the life of the connection. Every event line carries its journal
+  revision (``"rv"``) so clients can track their resume point.
+* ``GET /watch?kind=pods&since_rv=N`` — watch-RESUME: instead of a full
+  LIST, journal events after revision N replay (then the sync marker,
+  then live events). When the gap has been compacted away the server
+  answers **410** ``{"error": "RvTooOld"}`` — the apiserver's "too old
+  resource version" — and the client falls back to a relist.
 
 The in-process Hub stays the fast path for benchmarks; this transport
 exists so "real list/watch client" is an actual network boundary, not an
@@ -25,7 +32,13 @@ import queue
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from kubernetes_tpu.hub import Conflict, EventHandlers, Hub, NotFound
+from kubernetes_tpu.hub import (
+    Conflict,
+    EventHandlers,
+    Hub,
+    NotFound,
+    RvTooOld,
+)
 from kubernetes_tpu.utils.wire import from_wire, to_wire
 
 # Hub methods reachable over /call (everything the scheduler, tests, and
@@ -49,6 +62,8 @@ CALL_METHODS = frozenset({
     "create_csi_capacity", "update_csi_capacity", "list_csi_capacities",
     "set_pod_claim_statuses",
     "create_priority_class", "list_priority_classes",
+    "record_event", "list_events",
+    "get_journal_stats",
     "leases.get", "leases.update",
 })
 
@@ -110,6 +125,13 @@ class _Handler(BaseHTTPRequestHandler):
         q = parse_qs(urlparse(self.path).query)
         kind = q.get("kind", [""])[0]
         replay = q.get("replay", ["1"])[0] == "1"
+        since_raw = q.get("since_rv", [""])[0]
+        try:
+            since_rv = int(since_raw) if since_raw else None
+        except ValueError:
+            self._json(400, {"error": "ValueError",
+                             "message": f"bad since_rv {since_raw!r}"})
+            return
         if kind not in WATCH_KINDS:
             self._json(400, {"error": "ValueError",
                              "message": f"unknown watch kind {kind!r}"})
@@ -117,23 +139,30 @@ class _Handler(BaseHTTPRequestHandler):
         events: queue.Queue = queue.Queue(maxsize=100000)
         overflow = threading.Event()
 
-        def push(etype, old, new):
+        def push(ev):
             try:
-                events.put_nowait({"type": etype, "old": to_wire(old),
-                                   "new": to_wire(new)})
+                events.put_nowait({"type": ev.type, "rv": ev.rv,
+                                   "old": to_wire(ev.old),
+                                   "new": to_wire(ev.new)})
             except queue.Full:
                 # a silent gap would be an undetectable stale cache; close
-                # the stream instead — the client reflector reconnects and
-                # relists (client-go's "too old resource version" recovery)
+                # the stream instead — the client reflector reconnects,
+                # resuming from its last-seen rv (or relisting when the
+                # journal has compacted the gap away)
                 overflow.set()
 
-        h = EventHandlers(
-            on_add=lambda o: push("add", None, o),
-            on_update=lambda old, new: push("update", old, new),
-            on_delete=lambda o: push("delete", o, None))
-        # registration under the hub lock makes replay a consistent LIST:
-        # replayed adds land in the queue before any live event
-        getattr(self.hub, f"watch_{kind}")(h, replay=replay)
+        h = EventHandlers(on_event=push)
+        # registration under the hub lock makes replay a consistent LIST
+        # (or, with since_rv, a consistent journal suffix): replayed
+        # events land in the queue before any live event
+        try:
+            cur_rv = getattr(self.hub, f"watch_{kind}")(
+                h, replay=replay, since_rv=since_rv)
+        except RvTooOld as e:
+            # the 410-Gone analog: this resume point was compacted away
+            self._json(410, {"error": "RvTooOld", "message": str(e),
+                             "compacted_rv": e.compacted_rv})
+            return
         self.send_response(200)
         self.send_header("Content-Type", "application/jsonlines")
         self.send_header("Transfer-Encoding", "chunked")
@@ -145,14 +174,15 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.flush()
 
         try:
-            if replay:
-                # drain the synchronous replay, then mark sync
+            if replay or since_rv is not None:
+                # drain the synchronous replay (LIST or journal suffix),
+                # then mark sync
                 while True:
                     try:
                         write_line(events.get_nowait())
                     except queue.Empty:
                         break
-            write_line({"synced": True})
+            write_line({"synced": True, "rv": cur_rv})
             while not self.server.stopping \
                     and not overflow.is_set():  # type: ignore[attr-defined]
                 try:

@@ -234,6 +234,24 @@ class SchedulerMetrics:
         self.hub_client_degraded_seconds = r.register(Gauge(
             "hub_client_degraded_seconds",
             "Cumulative seconds the hub client spent unreachable"))
+        # watch-resume split (true counters: the scheduler mirrors the
+        # client's monotonic counts by DELTA, so rate() stays honest)
+        self.hub_watch_resumes = r.register(Counter(
+            "hub_watch_resumes_total",
+            "Watch reconnects resumed from since_rv (journal replay)"))
+        self.hub_watch_relists = r.register(Counter(
+            "hub_watch_relists_total",
+            "Watch reconnects that fell back to a full relist"))
+        self.hub_journal_depth = r.register(Gauge(
+            "hub_journal_depth",
+            "Event journal ring depth by resource kind"))
+        self.hub_journal_compacted_rv = r.register(Gauge(
+            "hub_journal_compacted_rv",
+            "Journal compaction watermark by resource kind"))
+        self.dra_cel_errors = r.register(Counter(
+            "dra_cel_errors_total",
+            "CEL selector compile/eval errors by source object",
+            ("source",)))
         self.chaos_injected_faults = r.register(Gauge(
             "chaos_injected_faults",
             "Faults injected by an attached chaos layer, by kind"))

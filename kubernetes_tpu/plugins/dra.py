@@ -214,6 +214,12 @@ class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin,
         # (id(dev) may be reused after GC)
         self._sel_cache: dict[tuple, bool] = {}
         self._sel_epoch = 0
+        # CEL selector failures surfaced instead of silently parking
+        # pods: per-source counts (the dra_cel_errors_total mirror) and
+        # a (source, expression) dedup set so a broken expression records
+        # ONE hub Event per object, not one per (pod, node, device)
+        self._cel_errors: dict[str, int] = {}
+        self._cel_seen: set[tuple] = set()
         hub.watch_resource_claims(EventHandlers(
             on_add=self._claim_event,
             on_update=lambda old, new: self._claim_event(new),
@@ -339,12 +345,16 @@ class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin,
 
     # --- the structured allocator (the reference's staging allocator) ---
 
-    def _selector_accepts(self, expression: str, entry) -> bool:
+    def _selector_accepts(self, expression: str, entry,
+                          source: tuple[str, str]) -> bool:
         """One CEL selector against one device, MEMOIZED: a device's
         attributes are immutable for its lifetime in the slice index, so
         (expression, device) verdicts never change — without the cache
         the steady-state template workload re-evaluates the same
-        expression over the same 800 devices for every (pod, node)."""
+        expression over the same 800 devices for every (pod, node).
+        A CelError (broken expression) counts as no-match but is
+        SURFACED: a hub Event on the source object + the per-source
+        error count the scheduler mirrors into dra_cel_errors_total."""
         driver, _pool, dev = entry
         key = (self._sel_epoch, expression, id(dev))
         hit = self._sel_cache.get(key)
@@ -353,15 +363,56 @@ class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin,
         try:
             ok = evaluate(expression,
                           CelDevice(driver, dev.attributes, dev.capacity))
-        except CelError:
+        except CelError as e:
             ok = False
+            self._record_cel_error(source, expression, e)
         if len(self._sel_cache) > 500_000:
             self._sel_cache.clear()
         self._sel_cache[key] = ok
         return ok
 
+    def _record_cel_error(self, source: tuple[str, str],
+                          expression: str, err: Exception) -> None:
+        kind, key = source
+        src = f"{kind}/{key}"
+        with self._ledger_lock:
+            if (src, expression) in self._cel_seen:
+                return
+            self._cel_seen.add((src, expression))
+            self._cel_errors[src] = self._cel_errors.get(src, 0) + 1
+        try:
+            self.hub.record_event(
+                kind, key, "CELSelectorError",
+                f"selector {expression!r} failed: {err}")
+        except Exception:  # noqa: BLE001 — best-effort: an unreachable
+            # hub must not turn a diagnostic into a scheduling failure
+            pass
+
+    def cel_error_stats(self) -> dict[str, int]:
+        """{source object: distinct broken expressions} — mirrored into
+        dra_cel_errors_total by the scheduler's maintenance tick."""
+        with self._ledger_lock:
+            return dict(self._cel_errors)
+
+    def _cel_error_hint(self, claim: ResourceClaim) -> str:
+        """Names the broken selector source touching ``claim``, if any —
+        appended to the Filter's unschedulable message so a parked pod's
+        condition points at the actual offender."""
+        with self._ledger_lock:
+            if not self._cel_errors:
+                return ""
+            if f"ResourceClaim/{claim.key()}" in self._cel_errors:
+                return f"broken CEL selector on claim {claim.key()}"
+            for req in claim.spec.device_requests:
+                for alt in (req.first_available or [req]):
+                    src = f"DeviceClass/{alt.device_class_name}"
+                    if alt.device_class_name and src in self._cel_errors:
+                        return ("broken CEL selector on deviceclass "
+                                f"{alt.device_class_name}")
+        return ""
+
     def _device_matches(self, entry, class_name: str, device_class,
-                        selectors) -> bool:
+                        selectors, claim_key: str) -> bool:
         """entry = (driver, pool, Device). DeviceClass CEL selectors (or
         the legacy direct device_class_name match when no class object
         exists) AND the request's own CEL selectors must all accept.
@@ -372,13 +423,15 @@ class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin,
         if class_name:
             if device_class is not None:
                 for sel in device_class.selectors:
-                    if not self._selector_accepts(sel.cel_expression,
-                                                  entry):
+                    if not self._selector_accepts(
+                            sel.cel_expression, entry,
+                            ("DeviceClass", class_name)):
                         return False
             elif dev.device_class_name != class_name:
                 return False
         for sel in selectors:
-            if not self._selector_accepts(sel.cel_expression, entry):
+            if not self._selector_accepts(sel.cel_expression, entry,
+                                          ("ResourceClaim", claim_key)):
                 return False
         return True
 
@@ -455,7 +508,8 @@ class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin,
                 if not admin and triple in in_use:
                     continue
                 if not self._device_matches(entry, class_name,
-                                            device_class, selectors):
+                                            device_class, selectors,
+                                            claim.key()):
                     continue
                 matched.append((entry, triple))
             want = len(matched) if mode == ALLOCATION_MODE_ALL else count
@@ -544,8 +598,10 @@ class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin,
                 continue
             picked = self.allocate_claim(claim, node_name, local_use)
             if picked is None:
+                hint = self._cel_error_hint(claim)
                 return Status.unschedulable(
-                    "cannot allocate all claims", plugin=self.NAME)
+                    "cannot allocate all claims"
+                    + (f" ({hint})" if hint else ""), plugin=self.NAME)
             if len(claims) > 1:
                 if local_use is in_use:
                     local_use = set(in_use)

@@ -118,11 +118,12 @@ class Scheduler:
             self._filters_for, self.nominator)
         from kubernetes_tpu.plugins.dra import DynamicResources
 
+        self._dra = DynamicResources(hub)
         extra = {"binder": hub.bind, "hub": hub,
                  "preemption_evaluator": self.preemption,
                  # shared across profiles (SharedDRAManager analog): one
                  # assume overlay must see every profile's allocations
-                 "dra_shared": DynamicResources(hub)}
+                 "dra_shared": self._dra}
         # one resolved framework per profile (profile/profile.go:47 Map);
         # frameworkForPod routes each pod by spec.schedulerName
         self.frameworks = {
@@ -239,6 +240,11 @@ class Scheduler:
         self._deferred_events: deque = deque()
         self._last_backoff_flush = 0.0
         self._last_unsched_flush = 0.0
+        # mirrored-counter watermarks: external monotonic counts (hub
+        # client watch resumes/relists, DRA CEL errors) flow into the
+        # registry's true Counters by DELTA
+        self._mirrored_counts: dict[str, float] = {}
+        self._last_journal_mirror = 0.0
         self._daemon: Optional[threading.Thread] = None
         self._stop: Optional[threading.Event] = None
         self._register_handlers()
@@ -1369,6 +1375,14 @@ class Scheduler:
             m.hub_client_watch_reconnects.set(
                 float(s["watch_reconnects"]))
             m.hub_client_degraded_seconds.set(s["degraded_seconds"])
+            self._mirror_count("watch_resumes", s.get("watch_resumes", 0),
+                               m.hub_watch_resumes)
+            self._mirror_count("watch_relists", s.get("watch_relists", 0),
+                               m.hub_watch_relists)
+        for src, n in self._dra.cel_error_stats().items():
+            self._mirror_count(f"cel:{src}", n, m.dra_cel_errors,
+                               source=src)
+        self._mirror_journal_stats()
         cs = getattr(self.hub, "chaos_stats", None)
         if cs is not None:
             for kind, v in cs().items():
@@ -1376,6 +1390,35 @@ class Scheduler:
                 # traffic counters, not injections
                 if kind.startswith("injected_") or kind == "partitions":
                     m.chaos_injected_faults.set(float(v), kind=kind)
+
+    def _mirror_count(self, key: str, current: float, counter,
+                      **labels) -> None:
+        """Advance a registry Counter by the delta of an externally-owned
+        monotonic count (mirrored gauges would break rate() on restart)."""
+        prev = self._mirrored_counts.get(key, 0.0)
+        if current > prev:
+            counter.inc(current - prev, **labels)
+            self._mirrored_counts[key] = current
+
+    def _mirror_journal_stats(self) -> None:
+        """Journal depth/watermark gauges, throttled: for a RemoteHub
+        this is an RPC, and the maintenance tick runs every loop."""
+        now = self.now()
+        if now - self._last_journal_mirror < 10.0:
+            return
+        self._last_journal_mirror = now
+        js_fn = getattr(self.hub, "get_journal_stats", None)
+        if js_fn is None or self.hub_degraded():
+            return
+        try:
+            js = js_fn()
+        except Unavailable:
+            return
+        for kind, st in js.get("kinds", {}).items():
+            self.metrics.hub_journal_depth.set(
+                float(st["depth"]), kind=kind)
+            self.metrics.hub_journal_compacted_rv.set(
+                float(st["compacted_rv"]), kind=kind)
 
     def run(self, stop: threading.Event, idle_sleep: float = 0.02,
             elector=None) -> None:

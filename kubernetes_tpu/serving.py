@@ -1,9 +1,16 @@
-"""Serving endpoints: /metrics, /healthz, /configz.
+"""Serving endpoints: /metrics, /healthz, /configz, authz-gated /debug.
 
 The slice of the reference's component HTTP surface the scheduler exposes
 (cmd/kube-scheduler/app/server.go:252 newHealthEndpointsAndMetricsHandler:
 healthz/livez/readyz + /metrics + /configz): a tiny threaded HTTP server
 over the metrics Registry and the component config.
+
+Debug endpoints (/debug/cache, /debug/queue, /debug/journal) follow the
+reference's discipline for its debugging handlers
+(server.go:248-255: installed only behind the authz filter): they are
+DENIED unless the caller passed a ``debug_auth`` callback, which
+receives the request's Authorization header value and returns True to
+admit. ``token_auth("secret")`` builds the common bearer-token check.
 """
 
 from __future__ import annotations
@@ -12,11 +19,24 @@ import json
 import threading
 from dataclasses import asdict, is_dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Optional
+
+
+def token_auth(token: str) -> Callable[[str], bool]:
+    """The usual debug_auth: admit ``Authorization: Bearer <token>``."""
+    import hmac
+
+    expect = f"Bearer {token}"
+
+    def check(authorization: str) -> bool:
+        return hmac.compare_digest(authorization or "", expect)
+
+    return check
 
 
 class ServingEndpoints:
-    def __init__(self, scheduler, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, scheduler, host: str = "127.0.0.1", port: int = 0,
+                 debug_auth: Optional[Callable[[str], bool]] = None):
         self.scheduler = scheduler
         sched = scheduler
 
@@ -32,6 +52,33 @@ class ServingEndpoints:
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+
+            def _debug(self, path: str) -> None:
+                # server.go:248-255: debug handlers exist only behind
+                # authorization — no callback, no endpoints (403, not
+                # 404: the surface is real but the caller is not allowed)
+                if debug_auth is None:
+                    self._send(403, "debug endpoints disabled "
+                                    "(no debug_auth configured)")
+                    return
+                if not debug_auth(self.headers.get("Authorization", "")):
+                    self._send(401, "unauthorized")
+                    return
+                if path == "/debug/cache":
+                    body = json.dumps(sched.cache.dump(), indent=2,
+                                      default=str)
+                elif path == "/debug/queue":
+                    body = json.dumps(
+                        {"pending": sched.queue.pending_counts(),
+                         "stats": sched.stats}, indent=2, default=str)
+                elif path == "/debug/journal":
+                    js_fn = getattr(sched.hub, "get_journal_stats", None)
+                    body = json.dumps(js_fn() if js_fn else {}, indent=2,
+                                      default=str)
+                else:
+                    self._send(404, "not found")
+                    return
+                self._send(200, body, "application/json")
 
             def do_GET(self):  # noqa: N802 (stdlib API)
                 path = self.path.split("?", 1)[0]
@@ -53,6 +100,8 @@ class ServingEndpoints:
                         asdict(cfg) if is_dataclass(cfg) else str(cfg),
                         indent=2, default=str)
                     self._send(200, body, "application/json")
+                elif path.startswith("/debug/"):
+                    self._debug(path)
                 else:
                     self._send(404, "not found")
 

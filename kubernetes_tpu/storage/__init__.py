@@ -1,0 +1,17 @@
+"""L0 storage for the hub: event journal, compaction, WAL, watch-resume.
+
+The etcd-analog layer under the in-memory hub (SURVEY §1 L0): every
+mutation becomes a revision-stamped :class:`JournalEvent` appended to a
+bounded per-kind ring (:class:`Journal`), so a watcher that lost its
+stream can resume from its last-seen resourceVersion instead of
+re-listing the world — the revision-resumed watch that keeps reconnects
+cheap at Daemonset scale. When the requested gap has been compacted away,
+:class:`RvTooOld` is the typed "410 Gone" the transport maps onto the
+wire and the client reflector answers with a full relist.
+"""
+
+from kubernetes_tpu.storage.journal import (  # noqa: F401
+    Journal,
+    JournalEvent,
+    RvTooOld,
+)

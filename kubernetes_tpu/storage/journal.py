@@ -1,0 +1,271 @@
+"""Event journal: bounded per-kind rings + compaction + optional WAL.
+
+The storage discipline is etcd's (reference: SharedEtcd in
+test/integration/scheduler_perf/util.go): one monotonically increasing
+revision space (the hub's resourceVersion counter) stamps every mutation,
+the journal retains a bounded suffix of events per resource kind, and a
+watch can resume from any revision that has not been compacted away.
+
+Semantics:
+
+* ``append(ev)`` retains ``ev`` in its kind's ring. When the ring is
+  full the oldest event is dropped and that event's rv becomes the
+  kind's ``compacted_rv`` — the compaction watermark.
+* ``events_after(kind, since_rv)`` returns every retained event with
+  ``rv > since_rv`` **iff** ``since_rv >= compacted_rv`` (the boundary
+  is inclusive: a client that saw exactly the last compacted event can
+  still resume). Below the watermark the gap is unrecoverable from the
+  journal and :class:`RvTooOld` is raised — the caller relists.
+* Revisions are global across kinds, so a kind's retained suffix is a
+  COMPLETE event history for that kind above its watermark; per-kind rv
+  gaps (revisions spent on other kinds) are expected and harmless.
+
+WAL: with ``wal_path`` set, every appended event is also written as one
+JSON line (wire-encoded objects) and flushed, so a restarted hub can
+replay the file to rebuild both its object stores and the journal rings
+(``replay_wal``, a lazy line-at-a-time iterator — the file is never
+materialized whole). Writes are flushed, not fsynced — the durability
+target is hub-process restart, not kernel crash. A truncated final line
+(a write cut mid-append) is tolerated and ignored; corruption earlier in
+the file raises, because silently skipping interior history would
+resurrect a hub with holes in its state.
+
+WAL compaction (``rewrite_wal``): appending forever would grow the file
+linearly with total history, so the hub snapshots on boot when the
+replayed history dwarfs the live object count — the WAL is atomically
+rewritten as a ``{"compact": rv}`` record followed by one add-event per
+live object. The compact record is etcd's compaction revision: replay
+raises ``RvTooOld`` for any resume below it (``compact_floor``), because
+the rewritten file no longer holds the update/delete history a resumer
+from down there would need.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import deque
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+
+class RvTooOld(Exception):
+    """The requested resume point is unserviceable from the journal:
+    either it predates the compaction watermark (the gap was dropped) or
+    it lies BEYOND the hub's newest revision (the revision space was
+    reset — a hub restarted without its WAL; "resuming" there would
+    silently pin phantom state forever). The transport maps both to the
+    apiserver's 410 Gone / "too old resource version"; clients relist."""
+
+    def __init__(self, kind: str, since_rv: int, compacted_rv: int):
+        if since_rv > compacted_rv:
+            msg = (f"watch {kind}: since_rv {since_rv} is ahead of the "
+                   f"hub's newest revision {compacted_rv} (revision "
+                   f"space reset); relist required")
+        else:
+            msg = (f"watch {kind}: since_rv {since_rv} is older than "
+                   f"the compaction watermark {compacted_rv}; relist "
+                   f"required")
+        super().__init__(msg)
+        self.kind = kind
+        self.since_rv = since_rv
+        self.compacted_rv = compacted_rv
+
+
+@dataclass(frozen=True)
+class JournalEvent:
+    """One committed mutation: rv is the global revision stamped by the
+    hub; ``old``/``new`` carry the object before/after (None on the
+    add/delete side respectively), exactly what a watch dispatches."""
+
+    rv: int
+    kind: str                     # watch kind, e.g. "pods"
+    type: str                     # "add" | "update" | "delete"
+    old: object = None
+    new: object = None
+
+
+class _KindRing:
+    __slots__ = ("ring", "compacted_rv")
+
+    def __init__(self, capacity: int):
+        self.ring: deque[JournalEvent] = deque(maxlen=capacity)
+        self.compacted_rv = 0
+
+    def append(self, ev: JournalEvent) -> None:
+        if self.ring.maxlen and len(self.ring) == self.ring.maxlen:
+            self.compacted_rv = self.ring[0].rv
+        self.ring.append(ev)
+
+
+class Journal:
+    """Per-kind event rings sharing one revision space, plus the WAL.
+
+    NOT self-locking: the hub appends and reads under its own lock (the
+    journal is part of the same consistency domain as the stores — an
+    event must land in the ring before any later revision is stamped)."""
+
+    def __init__(self, capacity: int = 16384,
+                 wal_path: Optional[str] = None):
+        if capacity < 1:
+            raise ValueError("journal capacity must be >= 1")
+        self.capacity = capacity
+        self.wal_path = wal_path
+        self._kinds: dict[str, _KindRing] = {}
+        # the WAL's compaction revision: resume below this is impossible
+        # for EVERY kind — a rewrite discarded the update/delete history
+        self.compact_floor = 0
+        # replay_wal bookkeeping for repair_wal's torn-tail truncation
+        self._wal_good_end = 0
+        self._wal_size = 0
+        self._wal = open(wal_path, "a", encoding="utf-8") \
+            if wal_path else None
+
+    # ------------- append / read -------------
+
+    def append(self, ev: JournalEvent, persist: bool = True) -> None:
+        ring = self._kinds.get(ev.kind)
+        if ring is None:
+            ring = self._kinds[ev.kind] = _KindRing(self.capacity)
+        ring.append(ev)
+        if self._wal is not None and persist:
+            self._wal.write(self._wal_record(ev) + "\n")
+            self._wal.flush()
+
+    def events_after(self, kind: str, since_rv: int) -> list[JournalEvent]:
+        """Every retained event of ``kind`` with rv > since_rv, oldest
+        first; raises RvTooOld below the compaction watermark (ring
+        wraparound or the WAL compact floor, whichever is newer). A kind
+        never journaled above the floor has watermark ``compact_floor``
+        (0 when no WAL rewrite ever ran): resuming at/above it is legal
+        and yields nothing (there is genuinely no history to miss)."""
+        wm = self.compacted_rv(kind)
+        if since_rv < wm:
+            raise RvTooOld(kind, since_rv, wm)
+        ring = self._kinds.get(kind)
+        if ring is None:
+            return []
+        return [e for e in ring.ring if e.rv > since_rv]
+
+    def compacted_rv(self, kind: str) -> int:
+        ring = self._kinds.get(kind)
+        return max(ring.compacted_rv if ring else 0, self.compact_floor)
+
+    def stats(self) -> dict[str, dict[str, int]]:
+        """{kind: {depth, compacted_rv, last_rv}} for the depth gauges."""
+        return {kind: {"depth": len(r.ring),
+                       "compacted_rv": self.compacted_rv(kind),
+                       "last_rv": r.ring[-1].rv if r.ring else
+                       self.compacted_rv(kind)}
+                for kind, r in self._kinds.items()}
+
+    # ------------- WAL replay / compaction / lifecycle -------------
+
+    @staticmethod
+    def _wal_record(ev: JournalEvent) -> str:
+        from kubernetes_tpu.utils.wire import to_wire
+
+        return json.dumps({"rv": ev.rv, "kind": ev.kind, "type": ev.type,
+                           "old": to_wire(ev.old), "new": to_wire(ev.new)})
+
+    def _wal_decode(self, rec: dict) -> Optional[JournalEvent]:
+        from kubernetes_tpu.utils.wire import from_wire
+
+        if "compact" in rec:
+            self.compact_floor = max(self.compact_floor,
+                                     int(rec["compact"]))
+            return None
+        return JournalEvent(rv=rec["rv"], kind=rec["kind"],
+                            type=rec["type"],
+                            old=from_wire(rec.get("old")),
+                            new=from_wire(rec.get("new")))
+
+    def replay_wal(self) -> Iterator[JournalEvent]:
+        """Yield the WAL's events oldest-first, lazily — one line in
+        memory at a time (a long-lived WAL must not be materialized
+        whole on every boot). A ``{"compact": rv}`` record (written by
+        ``rewrite_wal``) raises ``compact_floor`` instead of yielding.
+        Re-seeding the rings via ``append(..., persist=False)`` is the
+        caller's job, alongside re-applying events to its stores.
+
+        A torn FINAL record (unparseable, or missing its newline — the
+        write was cut mid-append) never committed: it is skipped, and
+        the byte offset of the last good line is kept so ``repair_wal``
+        can truncate the tail — appending after a partial record would
+        otherwise merge two lines into interior corruption that bricks
+        every later boot."""
+        self._wal_good_end = 0
+        self._wal_size = 0
+        if not self.wal_path or not os.path.exists(self.wal_path):
+            return
+        with open(self.wal_path, "rb") as f:
+            pending: Optional[tuple] = None   # (text, end_offset, raw)
+            pos = 0
+            for raw in f:
+                pos += len(raw)
+                if pending is not None:
+                    # an interior line MUST parse: skipping one would
+                    # resurrect a hub with holes in its history
+                    ev = self._wal_decode(json.loads(pending[0]))
+                    self._wal_good_end = pending[1]
+                    if ev is not None:
+                        yield ev
+                s = raw.strip()
+                if s:
+                    pending = (s.decode("utf-8"), pos, raw)
+                else:
+                    pending = None            # blank filler line
+                    self._wal_good_end = pos
+            self._wal_size = pos
+            if pending is not None:           # the final record
+                complete = pending[2].endswith(b"\n")
+                try:
+                    rec = json.loads(pending[0]) if complete else None
+                except ValueError:
+                    rec = None                # torn: never committed
+                if rec is not None:
+                    ev = self._wal_decode(rec)
+                    self._wal_good_end = pending[1]
+                    if ev is not None:
+                        yield ev
+
+    def repair_wal(self) -> bool:
+        """Truncate the torn tail ``replay_wal`` detected (if any) so the
+        next append starts on a clean line. Returns True if bytes were
+        dropped. Safe with the open append handle: O_APPEND writes land
+        at the post-truncation end."""
+        if not self.wal_path or self._wal_good_end >= self._wal_size:
+            return False
+        os.truncate(self.wal_path, self._wal_good_end)
+        self._wal_size = self._wal_good_end
+        return True
+
+    def rewrite_wal(self, floor_rv: int,
+                    events: list[JournalEvent]) -> None:
+        """Compact the WAL: atomically replace it with a compact record
+        at ``floor_rv`` plus a snapshot of ``events`` (the hub's live
+        objects as add-events). The FILE's history below the floor is
+        gone — that is the point — so the next boot's replay raises
+        ``compact_floor`` and resumes from below it relist via RvTooOld.
+        The in-memory floor is deliberately NOT raised: this process's
+        rings still hold the genuine history and can serve resumes the
+        rewritten file no longer could."""
+        if not self.wal_path:
+            return
+        tmp = self.wal_path + ".compact"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(json.dumps({"compact": floor_rv}) + "\n")
+            for ev in events:
+                f.write(self._wal_record(ev) + "\n")
+            f.flush()
+        if self._wal is not None:
+            self._wal.close()
+        os.replace(tmp, self.wal_path)
+        self._wal = open(self.wal_path, "a", encoding="utf-8")
+
+    def close(self) -> None:
+        if self._wal is not None:
+            try:
+                self._wal.close()
+            finally:
+                self._wal = None

@@ -455,6 +455,62 @@ def test_readyz_reflects_degraded_state():
         sched.close()
 
 
+# --------------------------------------- watch-resume at Daemonset scale
+
+
+def test_midwatch_cut_at_15k_nodes_resumes_with_zero_relists():
+    """The Daemonset-15k reconnect storm the journal exists to kill: a
+    reflector synced over 15 000 nodes loses its stream mid-watch; the
+    reconnect must RESUME from since_rv (replaying only the gap events)
+    — zero relists, zero duplicate adds — because a full 15k-object
+    relist per reconnect is exactly the L0 cost etcd's revision-resumed
+    watches avoid."""
+    hub = Hub()                      # default ring >> the gap size
+    server = HubServer(hub).start()
+    proxy = ChaosProxy(server.address, config=ChaosConfig(seed=3)).start()
+    client = RemoteHub(proxy.address, timeout=30.0, retry_base=0.01,
+                       retry_cap=0.1)
+    n_nodes = 15_000
+    for i in range(n_nodes):
+        hub.create_node(MakeNode().name(f"n{i}").obj())
+    adds, updates = [], []
+    try:
+        client.watch_nodes(EventHandlers(
+            on_add=lambda o: adds.append(o.metadata.name),
+            on_update=lambda old, new: updates.append(new.metadata.name)))
+        assert len(adds) == n_nodes, "initial LIST replay synced"
+        # cut the stream on the next live event (that event is dropped
+        # from the wire — only the journal can deliver it now)
+        proxy.set_fault(watch_cut_rate=1.0)
+        upd = hub.get_node("n0").clone()
+        upd.metadata.labels["touched"] = "1"
+        hub.update_node(upd)
+        # while the stream is down, more of the gap accumulates
+        deadline = time.time() + 10
+        while proxy.stats["injected_cuts"] < 1 and time.time() < deadline:
+            time.sleep(0.02)
+        proxy.set_fault(watch_cut_rate=0.0)
+        for i in range(1, 6):
+            u = hub.get_node(f"n{i}").clone()
+            u.metadata.labels["touched"] = "1"
+            hub.update_node(u)
+        deadline = time.time() + 30
+        while time.time() < deadline and len(updates) < 6:
+            time.sleep(0.05)
+        assert sorted(set(updates)) == [f"n{i}" for i in range(6)], \
+            "every gap event must arrive through the journal resume"
+        stats = client.resilience_stats()
+        assert stats["watch_resumes"] >= 1, stats
+        assert stats["watch_relists"] == 0, \
+            f"a 15k-node relist storm happened: {stats}"
+        assert len(adds) == n_nodes, "no duplicate adds (no relist ran)"
+        assert proxy.stats["injected_cuts"] >= 1
+    finally:
+        client.close()
+        proxy.stop()
+        server.stop()
+
+
 # ------------------------------------------------- the full storm (slow)
 
 

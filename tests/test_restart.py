@@ -127,6 +127,53 @@ def test_restart_preserves_nominations():
     s2.close()
 
 
+def test_hub_restart_replays_wal_and_scheduler_rebuilds(tmp_path):
+    """The HUB dies this time, not the scheduler: a WAL-backed hub comes
+    back from its journal file with stores, revision counter, and
+    journal rings intact — a fresh scheduler over the reborn hub
+    replays bound state and keeps scheduling, and a watcher holding a
+    pre-restart rv resumes across the restart."""
+    wal = str(tmp_path / "hub.wal")
+    clock = Clock()
+    h1 = Hub(wal_path=wal)
+    s1 = mksched(h1, clock)
+    for i in range(3):
+        h1.create_node(mknode(i))
+    done = [mkpod(f"a{i}") for i in range(6)]
+    for p in done:
+        h1.create_pod(p)
+    drain(s1, clock)
+    assert all(bound(h1, p) for p in done)
+    resume_rv = h1.current_rv
+    s1.close()
+    h1.close()                       # the hub process dies
+
+    h2 = Hub(wal_path=wal)           # ...and restarts over the same WAL
+    assert h2.current_rv == resume_rv
+    assert all(bound(h2, p) for p in done), "bindings survived the WAL"
+    # a watcher with a pre-restart rv resumes: only post-restart events
+    from kubernetes_tpu.hub import EventHandlers
+
+    resumed = []
+    h2.watch_pods(EventHandlers(
+        on_add=lambda o: resumed.append(o.metadata.name)),
+        since_rv=resume_rv)
+    assert resumed == []
+    s2 = mksched(h2, clock)
+    assert s2.cache.pod_count() == 6, "cache rebuilt from WAL-replayed hub"
+    pending = [mkpod(f"b{i}") for i in range(4)]
+    for p in pending:
+        h2.create_pod(p)
+    assert sorted(resumed) == sorted(p.metadata.name for p in pending)
+    drain(s2, clock)
+    assert all(bound(h2, p) for p in pending)
+    committed = sum(n["requested_milli_cpu"]
+                    for n in s2.cache.dump()["nodes"].values())
+    assert committed == 5000, f"replayed+new cpu accounting: {committed}m"
+    s2.close()
+    h2.close()
+
+
 def test_scheduling_under_node_churn():
     """Nodes appear and disappear while pods flow: no pod lands on a
     deleted node, and everything schedulable eventually binds."""
