@@ -131,15 +131,20 @@ def main() -> None:
         ok = readme_check(write="--readme-update" in sys.argv)
         sys.exit(0 if ok else 1)
     if "--chaos-smoke" in sys.argv:
-        # red-suite gate: one short chaos scenario (scheduler + kubemark
-        # through the fault-injecting proxy) must hold the storm
-        # invariants — no double-bind, no lost pod, cache–hub converged
+        # red-suite gate: the full storm battery — the smoke scenario
+        # (call faults + watch cut + partition through the proxy), the
+        # device-fault storm (fallback ladder + poison-pod quarantine),
+        # and the 1k-pod crash storm (watch cuts + leader kill +
+        # kill-and-restart). Invariants: every pod bound exactly once
+        # (fencing + bind-once), zero daemon deaths, poison quarantined
+        # with a hub Event, cache-hub converged.
         env = dict(os.environ)
         env["PYTHONPATH"] = _repo + os.pathsep + env.get("PYTHONPATH", "")
         env.setdefault("JAX_PLATFORMS", "cpu")
         proc = subprocess.run(
-            [sys.executable, "-m", "kubernetes_tpu.chaos"],
-            capture_output=True, text=True, timeout=600, env=env,
+            [sys.executable, "-m", "kubernetes_tpu.chaos",
+             "--storm", "all"],
+            capture_output=True, text=True, timeout=1200, env=env,
             cwd=_repo)
         out = proc.stdout.strip().splitlines()
         print(out[-1] if out else '{"ok": false, "error": "no output"}')

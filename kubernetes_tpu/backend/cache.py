@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from kubernetes_tpu.api.objects import Node, Pod
@@ -31,6 +31,43 @@ class _PodState:
     assumed: bool = False
     deadline: Optional[float] = None  # set by finish_binding when ttl > 0
     binding_finished: bool = False
+
+
+@dataclass
+class DriftReport:
+    """Structured cache-vs-hub diff (the comparer's findings, typed so
+    the drift sentinel can repair them surgically instead of re-listing
+    the world into a fresh cache)."""
+
+    nodes_stale: list = field(default_factory=list)      # names, cache-only
+    nodes_missing: list = field(default_factory=list)    # Nodes, hub-only
+    pods_stale: list = field(default_factory=list)       # Pods, cache-only
+    pods_missing: list = field(default_factory=list)     # Pods, hub-only
+    pods_misplaced: list = field(default_factory=list)   # (cached, hub) Pods
+
+    def count(self) -> int:
+        return (len(self.nodes_stale) + len(self.nodes_missing)
+                + len(self.pods_stale) + len(self.pods_missing)
+                + len(self.pods_misplaced))
+
+    def render(self) -> list[str]:
+        """The comparer's human-readable lines (SIGUSR2 debug format)."""
+        out = []
+        for name in self.nodes_stale:
+            out.append(f"node {name} in cache but not in apiserver")
+        for node in self.nodes_missing:
+            out.append(f"node {node.metadata.name} in apiserver but "
+                       "not in cache")
+        for pod in self.pods_stale:
+            out.append(f"pod {pod.key()} in cache but not bound "
+                       "in apiserver")
+        for pod in self.pods_missing:
+            out.append(f"pod {pod.key()} bound in apiserver but "
+                       "not in cache")
+        for cached, p in self.pods_misplaced:
+            out.append(f"pod {p.key()} on {p.spec.node_name} in apiserver "
+                       f"but {cached.spec.node_name} in cache")
+        return out
 
 
 class _NodeInfoListItem:
@@ -359,39 +396,100 @@ class Cache:
         with self._lock:
             return len(self._assumed_pods)
 
-    def compare_with_hub(self, hub) -> list[str]:
+    def drift_report(self, hub) -> DriftReport:
         """The cache comparer (backend/cache/debugger/comparer.go
-        CompareNodes/ComparePods): diff the scheduler's view against API
-        truth; each discrepancy is one human-readable line. Assumed pods
-        are expected to lead the API (they are the optimistic writes), so
-        they are exempt from the bound-state checks."""
-        problems: list[str] = []
+        CompareNodes/ComparePods), structured: diff the scheduler's view
+        against API truth. Assumed pods are expected to lead the API
+        (they are the optimistic writes), so they are exempt from the
+        bound-state checks."""
+        report = DriftReport()
         with self._lock:
             cached_nodes = set(self._nodes)
             cached_pods = {uid: st for uid, st in self._pod_states.items()}
             assumed = set(self._assumed_pods)
-        hub_nodes = {n.metadata.name for n in hub.list_nodes()}
-        for name in sorted(cached_nodes - hub_nodes):
-            problems.append(f"node {name} in cache but not in apiserver")
-        for name in sorted(hub_nodes - cached_nodes):
-            problems.append(f"node {name} in apiserver but not in cache")
+        hub_node_objs = {n.metadata.name: n for n in hub.list_nodes()}
+        hub_nodes = set(hub_node_objs)
+        report.nodes_stale = sorted(cached_nodes - hub_nodes)
+        report.nodes_missing = [hub_node_objs[n]
+                                for n in sorted(hub_nodes - cached_nodes)]
         hub_pods = {p.metadata.uid: p for p in hub.list_pods()
                     if p.spec.node_name}
-        for uid in sorted(set(cached_pods) - set(hub_pods) - assumed):
-            problems.append(
-                f"pod {cached_pods[uid].pod.key()} in cache but not bound "
-                "in apiserver")
+        report.pods_stale = [
+            cached_pods[uid].pod
+            for uid in sorted(set(cached_pods) - set(hub_pods) - assumed)]
         for uid, p in sorted(hub_pods.items()):
             st = cached_pods.get(uid)
             if st is None:
-                problems.append(
-                    f"pod {p.key()} bound in apiserver but not in cache")
+                report.pods_missing.append(p)
             elif st.pod.spec.node_name != p.spec.node_name \
                     and uid not in assumed:
-                problems.append(
-                    f"pod {p.key()} on {p.spec.node_name} in apiserver "
-                    f"but {st.pod.spec.node_name} in cache")
-        return problems
+                report.pods_misplaced.append((st.pod, p))
+        return report
+
+    def compare_with_hub(self, hub) -> list[str]:
+        """Human-readable drift lines (the SIGUSR2 debug surface; the
+        drift sentinel consumes the structured drift_report instead)."""
+        return self.drift_report(hub).render()
+
+    def repair_from_hub(self, hub, report: Optional[DriftReport] = None
+                        ) -> int:
+        """Targeted drift repair: mutate ONLY the drifted entries back to
+        hub truth (generation bumps make the incremental snapshot refresh
+        re-pack exactly those rows — no full relist, no cache rebuild).
+        Returns the number of repairs applied. Re-checks each finding
+        against the live cache under the lock: a finding the informer
+        already fixed (or that became an assumed-pod optimistic write)
+        is skipped, not clobbered."""
+        if report is None:
+            report = self.drift_report(hub)
+        repaired = 0
+        with self._lock:
+            for name in report.nodes_stale:
+                item = self._nodes.get(name)
+                if item is None:
+                    continue
+                if item.info.node is not None:
+                    self._node_tree.remove_node(item.info.node)
+                self._node_set_version += 1
+                if item.info.pods:
+                    item.info.remove_node()
+                    self._move_to_head(item)
+                else:
+                    self._remove_from_list(item)
+                    del self._nodes[name]
+                repaired += 1
+        for node in report.nodes_missing:
+            with self._lock:
+                item = self._nodes.get(node.metadata.name)
+                if item is not None and item.info.node is not None:
+                    continue            # informer beat us to it
+            self.add_node(node)
+            repaired += 1
+        for pod in report.pods_stale:
+            uid = pod.metadata.uid
+            with self._lock:
+                st = self._pod_states.get(uid)
+                if st is None or st.assumed:
+                    continue            # gone, or an optimistic write
+            self.remove_pod(pod)
+            repaired += 1
+        for pod in report.pods_missing:
+            uid = pod.metadata.uid
+            with self._lock:
+                if uid in self._pod_states:
+                    continue
+            self.add_pod(pod)
+            repaired += 1
+        for cached, p in report.pods_misplaced:
+            uid = p.metadata.uid
+            with self._lock:
+                st = self._pod_states.get(uid)
+                if st is None or st.assumed \
+                        or st.pod.spec.node_name == p.spec.node_name:
+                    continue
+            self.update_pod(cached, p)
+            repaired += 1
+        return repaired
 
     def dump(self) -> dict:
         """Cache debugger surface (backend/cache/debugger): nodes + pods +

@@ -282,6 +282,14 @@ class PriorityQueue:
     def in_flight_count(self) -> int:
         return len(self._in_flight)
 
+    def is_parked(self, uid: str) -> bool:
+        """True when the pod already re-entered a queue pool (active,
+        backoff, unschedulable, or gated) — i.e. some failure handler
+        owns it and it must not be driven again this cycle (the fault
+        containment path uses this to skip already-parked batch peers)."""
+        return (uid in self._active or uid in self._backoff
+                or uid in self._unschedulable or uid in self._gated)
+
     # ------------- unschedulable / requeue -------------
 
     def add_unschedulable_if_not_present(self, qp: QueuedPodInfo,

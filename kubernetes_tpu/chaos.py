@@ -18,12 +18,21 @@ Two injection points, both seeded-deterministic:
   and timed partition windows during which every connection is severed.
   The client under test talks to ``proxy.address`` exactly as it would
   to the hub; nothing in the client knows chaos exists.
+* ``DeviceChaos`` — accelerator-path fault injection, plugged into
+  ``Scheduler.fault_injector``: raises inside the pack/launch path
+  (device launch errors, forced ``CapacityError``) and NaN-poisons
+  launch results (recomputing the REAL guard reduction over the
+  poisoned tensors), provoking the device→host fallback ladder and the
+  poison-pod quarantine.
 
 ``run_smoke()`` drives one short end-to-end scenario (scheduler +
 kubemark hollow nodes through the proxy under call faults, a watch cut,
 and a partition) and asserts the storm invariants: no double-bind, no
-lost pod, cache–hub convergence. ``bench.py --chaos-smoke`` runs it as a
-red-suite gate.
+lost pod, cache–hub convergence. ``run_device_storm()`` provokes the
+fallback ladder + quarantine; ``run_crash_storm()`` is the full
+acceptance storm — device faults + watch cuts + leader kill +
+kill-and-restart over ≥1k pods, every pod bound exactly once.
+``bench.py --chaos-smoke`` runs all three as the red-suite gate.
 """
 
 from __future__ import annotations
@@ -180,6 +189,94 @@ class ChaosHub:
             setattr(self, name, faulted)
             return faulted
         return attr
+
+
+# --------------------------------------------------------------------------
+# DeviceChaos: accelerator-path fault injection (Scheduler.fault_injector)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class DeviceChaosConfig:
+    """Device-path fault knobs, seeded-deterministic like ChaosConfig."""
+
+    seed: int = 0
+    launch_error_rate: float = 0.0     # P(raise at pack/launch) per batch
+    capacity_error_rate: float = 0.0   # P(forced CapacityError) per batch
+    nan_rate: float = 0.0              # P(NaN-poison the result) per batch
+
+
+class DeviceChaos:
+    """Injects accelerator-path faults through the Scheduler's
+    ``fault_injector`` seam: ``on_pack`` may raise (a device launch
+    error or a forced ``CapacityError``) before the fused launch;
+    ``on_result`` may NaN-poison the launch's score tensor — and
+    recomputes the REAL guard reduction over the poisoned tensors, so
+    the scheduler's NaN guard (not this injector) is what trips. Every
+    injected fault must come out the other side of the device→host
+    fallback ladder with zero daemon deaths and zero lost pods."""
+
+    def __init__(self, config: DeviceChaosConfig | None = None):
+        self.config = config or DeviceChaosConfig()
+        self._rng = random.Random(self.config.seed)
+        self._lock = threading.Lock()
+        self.stats = {"injected_launch_errors": 0,
+                      "injected_capacity_errors": 0,
+                      "injected_nans": 0, "batches_seen": 0}
+
+    def set_fault(self, **kw) -> None:
+        with self._lock:
+            for k, v in kw.items():
+                if not hasattr(self.config, k):
+                    raise AttributeError(f"unknown fault knob {k!r}")
+                setattr(self.config, k, v)
+
+    def _draw(self, rate: float) -> bool:
+        if rate <= 0:
+            return False
+        with self._lock:
+            return self._rng.random() < rate
+
+    def on_pack(self, pods) -> None:
+        with self._lock:
+            self.stats["batches_seen"] += 1
+        if self._draw(self.config.launch_error_rate):
+            with self._lock:
+                self.stats["injected_launch_errors"] += 1
+            raise RuntimeError(
+                f"chaos: injected device launch failure "
+                f"({len(pods)}-pod batch)")
+        if self._draw(self.config.capacity_error_rate):
+            from kubernetes_tpu.backend.mirror import CapacityError
+
+            with self._lock:
+                self.stats["injected_capacity_errors"] += 1
+            raise CapacityError("__chaos__", 2 ** 30)
+
+    def on_result(self, out):
+        if not self._draw(self.config.nan_rate):
+            return out
+        import dataclasses as _dc
+
+        import jax.numpy as jnp
+
+        from kubernetes_tpu.models.pipeline import _guard_reduction
+
+        with self._lock:
+            self.stats["injected_nans"] += 1
+        score = jnp.full_like(out.score, float("nan"))
+        return _dc.replace(out, score=score,
+                           guard=_guard_reduction(score, out.free))
+
+
+def make_poison_pod(name: str = "poison"):
+    """A genuinely poisonous pod: its cpu request fails quantity parsing,
+    so ANY batch that packs it raises — the device path faults wholesale,
+    and the serial host fallback's per-pod evaluation is what isolates
+    (bisects) it into quarantine while its batch peers schedule on."""
+    from kubernetes_tpu.testing import MakePod
+
+    return MakePod().name(name).req(cpu="not-a-quantity").obj()
 
 
 # --------------------------------------------------------------------------
@@ -462,15 +559,287 @@ def run_smoke(pods: int = 40, nodes: int = 8, seed: int = 7,
     return report
 
 
+# --------------------------------------------------------------------------
+# device-fault storm: the fallback ladder + quarantine under fire
+# --------------------------------------------------------------------------
+
+
+def run_device_storm(pods: int = 80, nodes: int = 8, seed: int = 11,
+                     timeout_s: float = 90.0) -> dict:
+    """Accelerator-path storm on an in-process hub: injected device
+    launch errors, forced CapacityErrors, and NaN-poisoned results
+    against a live drain, plus one genuinely poisonous pod. ``ok`` iff
+    every healthy pod bound exactly once (the ladder kept peers
+    scheduling), the poison pod was quarantined with a hub Event (never
+    bound), and the daemon survived every injected fault."""
+    from kubernetes_tpu.config.types import default_config
+    from kubernetes_tpu.hub import Hub
+    from kubernetes_tpu.ops.features import Capacities
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing import MakeNode, MakePod
+
+    hub = Hub()
+    for i in range(nodes):
+        hub.create_node(MakeNode().name(f"dn-{i}")
+                        .capacity(cpu="64", pods="440").obj())
+    cfg = default_config()
+    cfg.batch_size = 16
+    sched = Scheduler(hub, cfg, caps=Capacities(nodes=max(16, nodes * 2),
+                                                pods=max(128, pods * 2)))
+    chaos = DeviceChaos(DeviceChaosConfig(seed=seed))
+    sched.fault_injector = chaos
+    report: dict = {"pods": pods, "nodes": nodes, "seed": seed}
+    poison = make_poison_pod("poison-0")
+    all_knobs = ("nan_rate", "launch_error_rate", "capacity_error_rate")
+    try:
+        # three deterministic fault phases — every rung of the ladder is
+        # provoked at least once regardless of scale — then a clean drain.
+        # The poison pod lands in phase 1: its pack-time exception must
+        # not eclipse phase 0's NaN injection (which needs a launch that
+        # actually completes to poison its result).
+        third = max(1, pods // 3)
+        phases = ({"nan_rate": 1.0}, {"launch_error_rate": 1.0},
+                  {"capacity_error_rate": 1.0})
+        for n, knobs in enumerate(phases):
+            chaos.set_fault(**{k: 0.0 for k in all_knobs})
+            chaos.set_fault(**knobs)
+            if n == 1:
+                hub.create_pod(poison)
+            for i in range(n * third, pods if n == 2 else (n + 1) * third):
+                hub.create_pod(
+                    MakePod().name(f"dp-{i}").req(cpu="100m").obj())
+            sched.run_until_idle()
+            sched.run_maintenance()
+        chaos.set_fault(**{k: 0.0 for k in all_knobs})
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            sched.run_until_idle()
+            sched.run_maintenance()
+            bound = sum(1 for p in hub.list_pods() if p.spec.node_name)
+            if bound == pods and sched.stats["quarantined"] >= 1:
+                break
+            time.sleep(0.05)
+        bound = sum(1 for p in hub.list_pods() if p.spec.node_name)
+        q_events = [e for e in hub.list_events(ref_kind="Pod")
+                    if e.reason == "Quarantined"]
+        report.update({
+            "bound": bound, "lost": pods - bound,
+            "poison_bound": bool(
+                hub.get_pod(poison.metadata.uid).spec.node_name),
+            "quarantines": sched.stats["quarantined"],
+            "quarantine_events": len(q_events),
+            "device_fallbacks": sched.stats["device_fallbacks"],
+            "device_chaos": dict(chaos.stats),
+            "cache_vs_hub": sched.cache.compare_with_hub(hub),
+            "ok": (bound == pods
+                   and not hub.get_pod(poison.metadata.uid).spec.node_name
+                   and sched.stats["quarantined"] >= 1
+                   and len(q_events) >= 1
+                   and sched.stats["device_fallbacks"] > 0
+                   and chaos.stats["injected_nans"] >= 1
+                   and chaos.stats["injected_launch_errors"] >= 1
+                   and chaos.stats["injected_capacity_errors"] >= 1
+                   and not sched.cache.compare_with_hub(hub)),
+        })
+    finally:
+        sched.close()
+    return report
+
+
+# --------------------------------------------------------------------------
+# crash-kill/restart storm: the full acceptance gate (ISSUE 3)
+# --------------------------------------------------------------------------
+
+
+def run_crash_storm(pods: int = 1000, nodes: int = 24, seed: int = 13,
+                    timeout_s: float = 300.0) -> dict:
+    """The acceptance storm: device faults + watch cuts + leader kill +
+    kill-and-restart over >=1k pods, two elected scheduler incarnations
+    each behind its own ChaosProxy. Every bind is tallied straight off
+    the hub's watch stream; ``ok`` iff every healthy pod bound EXACTLY
+    once (fencing + bind-once), the poison pod was quarantined with a
+    hub Event, and no surviving daemon recorded a loop crash."""
+    from kubernetes_tpu.config.types import default_config
+    from kubernetes_tpu.hub import EventHandlers, Hub
+    from kubernetes_tpu.hubclient import RemoteHub
+    from kubernetes_tpu.hubserver import HubServer
+    from kubernetes_tpu.leaderelection import LeaderElector
+    from kubernetes_tpu.ops.features import Capacities
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing import MakeNode, MakePod
+
+    hub = Hub()
+    server = HubServer(hub).start()
+    proxies: dict = {}
+    clients: dict = {}
+    scheds: dict = {}
+    electors: dict = {}
+
+    def spawn(ident: str) -> None:
+        proxy = ChaosProxy(server.address,
+                           config=ChaosConfig(seed=seed)).start()
+        client = RemoteHub(proxy.address, timeout=10.0, retry_deadline=3.0,
+                           retry_base=0.01, retry_cap=0.1)
+        cfg = default_config()
+        cfg.batch_size = 64
+        sched = Scheduler(client, cfg,
+                          caps=Capacities(nodes=max(32, nodes * 2),
+                                          pods=2048))
+        sched.fault_injector = DeviceChaos(DeviceChaosConfig(
+            seed=seed, launch_error_rate=0.05, nan_rate=0.05))
+        elector = LeaderElector(client.leases, ident, lease_duration=2.0,
+                                renew_deadline=1.0, retry_period=0.1)
+        sched.start(elector=elector)
+        proxies[ident], clients[ident] = proxy, client
+        scheds[ident], electors[ident] = sched, elector
+
+    # exactly-once ledger, tallied straight off the hub's own stream
+    bind_counts: dict[str, int] = {}
+    block = threading.Lock()
+
+    def on_update(old, new) -> None:
+        if not old.spec.node_name and new.spec.node_name:
+            with block:
+                uid = new.metadata.uid
+                bind_counts[uid] = bind_counts.get(uid, 0) + 1
+
+    hub.watch_pods(EventHandlers(on_update=on_update), replay=False)
+    report: dict = {"pods": pods, "nodes": nodes, "seed": seed}
+    poison = make_poison_pod("poison-crash")
+    try:
+        for i in range(nodes):
+            hub.create_node(MakeNode().name(f"cn-{i}")
+                            .capacity(cpu="64", memory="256Gi",
+                                      pods="440").obj())
+        spawn("a")
+        spawn("b")
+        hub.create_pod(poison)
+        for i in range(pods):
+            hub.create_pod(MakePod().name(f"cp-{i}").req(cpu="50m").obj())
+
+        def leader():
+            for ident, el in electors.items():
+                if el.is_leader():
+                    return ident
+            return None
+
+        def bound_count() -> int:
+            return sum(1 for p in hub.list_pods() if p.spec.node_name)
+
+        # phase 1: the first leader works through watch cuts
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 30.0 and bound_count() < pods // 4:
+            time.sleep(0.2)
+        for proxy in proxies.values():
+            proxy.set_fault(watch_cut_every=50)
+        time.sleep(1.0)
+        for proxy in proxies.values():
+            proxy.set_fault(watch_cut_every=0)
+        # phase 2: leader kill (zombie): partition the leader's wire; it
+        # must step down by the renew deadline and the peer takes over
+        # with a NEWER fencing epoch — any zombie bind surfacing later
+        # is rejected Fenced, never double-placed
+        victim = None
+        deadline = time.monotonic() + 30.0
+        while victim is None and time.monotonic() < deadline:
+            victim = leader()
+            time.sleep(0.05)
+        report["first_leader"] = victim
+        if victim is not None:
+            proxies[victim].partition_for(6.0)
+            others = [i for i in electors if i != victim]
+            takeover = time.monotonic() + 20.0
+            while time.monotonic() < takeover:
+                if any(electors[i].is_leader() for i in others):
+                    break
+                time.sleep(0.05)
+            report["failover"] = True
+            # phase 3: SIGKILL-restart — tear the victim down ABRUPTLY
+            # (stop flag only: no graceful drain, binder pool abandoned
+            # mid-flight) and bring up a fresh incarnation that relists
+            dead = scheds.pop(victim)
+            electors.pop(victim)
+            if dead._stop is not None:
+                dead._stop.set()
+            clients.pop(victim).close()
+            proxies.pop(victim).stop()
+            spawn(victim + "2")
+        # phase 4: drain to completion under residual device faults
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if bound_count() >= pods:
+                break
+            time.sleep(0.3)
+        bound = bound_count()
+        with block:
+            dup = {uid: n for uid, n in bind_counts.items() if n > 1}
+        q_events = [e for e in hub.list_events(ref_kind="Pod")
+                    if e.reason == "Quarantined"]
+        daemon_errors = {
+            ident: repr(s.daemon_error) for ident, s in scheds.items()
+            if getattr(s, "daemon_error", None) is not None}
+        report.update({
+            "bound": bound, "lost": pods - bound,
+            "duplicate_binds": dup,
+            "poison_bound": bool(
+                hub.get_pod(poison.metadata.uid).spec.node_name),
+            "quarantine_events": len(q_events),
+            "fenced_writes": sum(s.stats.get("fenced", 0)
+                                 for s in scheds.values()),
+            "device_fallbacks": sum(s.stats.get("device_fallbacks", 0)
+                                    for s in scheds.values()),
+            "daemon_errors": daemon_errors,
+            "ok": (bound == pods and not dup and not daemon_errors
+                   and not hub.get_pod(poison.metadata.uid).spec.node_name
+                   and len(q_events) >= 1),
+        })
+    finally:
+        for s in scheds.values():
+            try:
+                s.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        for c in clients.values():
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        for p in proxies.values():
+            try:
+                p.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        server.stop()
+    return report
+
+
 def main() -> None:
     import argparse
 
-    ap = argparse.ArgumentParser(description="chaos smoke scenario")
+    ap = argparse.ArgumentParser(description="chaos storm gate")
     ap.add_argument("--pods", type=int, default=40)
     ap.add_argument("--nodes", type=int, default=8)
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--storm", choices=("smoke", "device", "crash", "all"),
+                    default="smoke",
+                    help="which storm to run (bench.py --chaos-smoke "
+                         "runs 'all')")
     args = ap.parse_args()
-    report = run_smoke(pods=args.pods, nodes=args.nodes, seed=args.seed)
+    if args.storm == "smoke":
+        report: dict = run_smoke(pods=args.pods, nodes=args.nodes,
+                                 seed=args.seed)
+    elif args.storm == "device":
+        report = run_device_storm(seed=args.seed)
+    elif args.storm == "crash":
+        report = run_crash_storm(seed=args.seed)
+    else:
+        report = {
+            "smoke": run_smoke(pods=args.pods, nodes=args.nodes,
+                               seed=args.seed),
+            "device": run_device_storm(seed=args.seed),
+            "crash": run_crash_storm(seed=args.seed),
+        }
+        report["ok"] = all(r.get("ok") for r in report.values())
     print(json.dumps(report, default=str))
     raise SystemExit(0 if report.get("ok") else 1)
 

@@ -89,6 +89,12 @@ class Unavailable(Exception):
     never as a verdict about the object."""
 
 
+class Fenced(Exception):
+    """Write carried a fencing epoch older than the newest leadership
+    acquisition: the caller was deposed while the write was in flight.
+    The write did NOT land; the new leader owns the object now."""
+
+
 def _by_name(obj) -> str:
     return obj.metadata.name
 
@@ -414,10 +420,28 @@ class Hub:
         self._pods.objects[new.metadata.uid] = new
         return self._commit(self._pods, "update", old, new)
 
-    def bind(self, pod: Pod, node_name: str) -> None:
+    def _check_fence(self, verb: str, epoch: int | None,
+                     lease_name: str) -> None:
+        """Reject a fenced write whose epoch predates the newest
+        leadership acquisition (the etcd/Chubby sequencer check). A None
+        epoch is an unfenced caller (no elector — single-scheduler
+        deployments, tests) and passes."""
+        if epoch is None:
+            return
+        cur = self.leases.epoch_of(lease_name)
+        if epoch < cur:
+            raise Fenced(f"{verb} from deposed epoch {epoch} "
+                         f"(current {cur}, lease {lease_name!r})")
+
+    def bind(self, pod: Pod, node_name: str, epoch: int | None = None,
+             lease_name: str = "kube-scheduler") -> None:
         """The Binding subresource: sets spec.nodeName exactly once
-        (defaultbinder POST target). Conflict if already bound."""
+        (defaultbinder POST target). Conflict if already bound; Fenced
+        if ``epoch`` predates the newest leadership acquisition (an old
+        leader's async binder pool must never double-place a pod after
+        failover)."""
         with self._lock:
+            self._check_fence("bind", epoch, lease_name)
             stored = self._pods.objects.get(pod.metadata.uid)
             if stored is None:
                 raise NotFound(f"pod {pod.key()}")
@@ -430,9 +454,14 @@ class Hub:
         self._dispatch(self._pods, ev)
 
     def patch_pod_condition(self, pod: Pod, condition: PodCondition,
-                            nominated_node: str | None = None) -> None:
-        """util.PatchPodStatus equivalent (schedule_one.go:1092)."""
+                            nominated_node: str | None = None,
+                            epoch: int | None = None,
+                            lease_name: str = "kube-scheduler") -> None:
+        """util.PatchPodStatus equivalent (schedule_one.go:1092); fenced
+        like bind — a deposed leader must not overwrite the new leader's
+        status writes."""
         with self._lock:
+            self._check_fence("patch_pod_condition", epoch, lease_name)
             stored = self._pods.objects.get(pod.metadata.uid)
             if stored is None:
                 return

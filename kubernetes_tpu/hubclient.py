@@ -49,6 +49,7 @@ import urllib.request
 from kubernetes_tpu.hub import (
     Conflict,
     EventHandlers,
+    Fenced,
     NotFound,
     Unavailable,
 )
@@ -56,7 +57,7 @@ from kubernetes_tpu.hubserver import CALL_METHODS, WATCH_KINDS
 from kubernetes_tpu.utils.backoff import Backoff, RetryBudget
 from kubernetes_tpu.utils.wire import from_wire, to_wire
 
-_ERRORS = {"Conflict": Conflict, "NotFound": NotFound,
+_ERRORS = {"Conflict": Conflict, "NotFound": NotFound, "Fenced": Fenced,
            "ValueError": ValueError, "TypeError": TypeError}
 
 # safe to replay blindly: reads never mutate. The split covers dotted

@@ -72,7 +72,7 @@ WATCH_KINDS = ("pods", "nodes", "namespaces", "pvcs", "pvs",
                "resource_claim_templates", "csi_capacities")
 
 _ERROR_STATUS = {"Conflict": 409, "NotFound": 404, "ValueError": 400,
-                 "TypeError": 400}
+                 "TypeError": 400, "Fenced": 403}
 
 
 class _Handler(BaseHTTPRequestHandler):

@@ -29,7 +29,14 @@ logger = logging.getLogger("kubernetes_tpu.leaderelection")
 
 @dataclass
 class Lease:
-    """coordination.k8s.io/v1 Lease, the slice leader election uses."""
+    """coordination.k8s.io/v1 Lease, the slice leader election uses.
+
+    ``epoch`` is the fencing token (the etcd/Chubby sequencer): the store
+    stamps a fresh, monotonically increasing value on every ACQUISITION
+    (holder change), never on renewals. Writers attach their epoch to
+    fenced hub writes (``Hub.bind``/``patch_pod_condition``); the hub
+    rejects any epoch older than the newest issued, so a deposed
+    leader's in-flight async binds can never land after failover."""
 
     name: str = ""
     holder_identity: str = ""
@@ -37,26 +44,39 @@ class Lease:
     acquire_time: float = 0.0
     renew_time: float = 0.0
     lease_transitions: int = 0
+    epoch: int = 0
 
 
 class LeaseStore:
     """The hub-side lease registry (get-or-create + compare-and-swap by
-    holder, which is all leaderelection needs)."""
+    holder, which is all leaderelection needs). Issues fencing epochs:
+    one monotonic counter per lease name, bumped on holder change."""
 
     def __init__(self) -> None:
         import threading
 
         self._lock = threading.Lock()
         self._leases: dict[str, Lease] = {}
+        # newest epoch ever ISSUED per lease name — survives a released
+        # (vacated) lease, so re-acquisition always moves forward
+        self._epochs: dict[str, int] = {}
 
     def get(self, name: str) -> Optional[Lease]:
         with self._lock:
             lease = self._leases.get(name)
             return None if lease is None else Lease(**vars(lease))
 
+    def epoch_of(self, name: str) -> int:
+        """Newest fencing epoch issued for ``name`` (0 = never held)."""
+        with self._lock:
+            return self._epochs.get(name, 0)
+
     def update(self, lease: Lease, expect_holder: Optional[str]) -> bool:
         """CAS: apply iff the stored holder matches ``expect_holder``
-        (None = lease must not exist yet or be the same holder)."""
+        (None = lease must not exist yet or be the same holder). The
+        STORE owns the epoch: a holder change stamps the next fencing
+        token; a renewal (same holder) carries the current one forward
+        regardless of what the caller passed."""
         with self._lock:
             cur = self._leases.get(lease.name)
             if cur is not None and expect_holder is not None \
@@ -66,7 +86,17 @@ class LeaseStore:
                     and cur.holder_identity not in ("",
                                                     lease.holder_identity):
                 return False
-            self._leases[lease.name] = Lease(**vars(lease))
+            stored = Lease(**vars(lease))
+            prev_holder = cur.holder_identity if cur is not None else ""
+            if stored.holder_identity and \
+                    stored.holder_identity != prev_holder:
+                # acquisition (vacant -> holder or steal): new epoch
+                nxt = self._epochs.get(lease.name, 0) + 1
+                self._epochs[lease.name] = nxt
+                stored.epoch = nxt
+            elif cur is not None:
+                stored.epoch = cur.epoch
+            self._leases[lease.name] = stored
             return True
 
 
@@ -99,6 +129,11 @@ class LeaderElector:
         self._last_try = 0.0
         self._last_renew = 0.0   # last SUCCESSFUL acquire/renew
         self.transport_errors = 0
+        # fencing token of our newest acquisition. Deliberately NOT
+        # cleared on step-down: in-flight writes must keep carrying the
+        # epoch they were issued under so the hub can reject them after
+        # a peer acquires a newer one.
+        self.epoch = 0
 
     def is_leader(self) -> bool:
         return self._leading
@@ -122,17 +157,21 @@ class LeaderElector:
         # _set_leading must surface as itself, not masquerade as a
         # transport failure (and flap leadership forever)
         try:
+            acquired = False
             cur = self.store.get(self.lease_name)
             if cur is None or not cur.holder_identity:
                 ok = self.store.update(Lease(
                     name=self.lease_name, holder_identity=self.identity,
                     lease_duration_seconds=self.lease_duration,
                     acquire_time=now, renew_time=now), expect_holder=None)
+                acquired = ok
             elif cur.holder_identity == self.identity:
                 cur.renew_time = now
                 # a failed CAS means a peer stole the lease while we
                 # stalled: step down immediately (split-brain guard)
                 ok = self.store.update(cur, expect_holder=self.identity)
+                if ok:
+                    self.epoch = cur.epoch
             elif now - cur.renew_time > cur.lease_duration_seconds:
                 # expired: steal it (lease_transitions counts takeovers)
                 ok = self.store.update(Lease(
@@ -141,8 +180,26 @@ class LeaderElector:
                     acquire_time=now, renew_time=now,
                     lease_transitions=cur.lease_transitions + 1),
                     expect_holder=cur.holder_identity)
+                acquired = ok
             else:
                 ok = False
+            if acquired:
+                # the store stamped our fencing epoch during the CAS;
+                # read it back (a racing steal leaves a stale epoch here,
+                # which is exactly what fencing then rejects). The
+                # read-back gets its own guard: the CAS already
+                # succeeded, so a transport blip HERE must not demote a
+                # holder — it just leaves the (older, safely fenced)
+                # epoch until the next renew's read.
+                try:
+                    got = self.store.get(self.lease_name)
+                    if got is not None \
+                            and got.holder_identity == self.identity:
+                        self.epoch = got.epoch
+                except Exception as e:  # noqa: BLE001 — transport only
+                    self.transport_errors += 1
+                    logger.warning("leaderelection: epoch read-back "
+                                   "failed (%r); keeping prior epoch", e)
         except Exception as e:  # noqa: BLE001 — remote store transport
             # failure: an unreachable store means we cannot renew; we are
             # not leading until it answers again

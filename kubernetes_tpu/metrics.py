@@ -255,6 +255,43 @@ class SchedulerMetrics:
         self.chaos_injected_faults = r.register(Gauge(
             "chaos_injected_faults",
             "Faults injected by an attached chaos layer, by kind"))
+        # self-healing scheduling core: fencing, quarantine, the
+        # device->host fallback ladder, the drift sentinel, and the
+        # daemon keep-alive (true counters — all owned by this process)
+        self.fenced_writes = r.register(Counter(
+            "scheduler_fenced_writes_total",
+            "Hub writes rejected because this scheduler's fencing epoch "
+            "was deposed by a newer leader", ("verb",)))
+        self.quarantined_pods = r.register(Gauge(
+            "scheduler_quarantined_pods",
+            "Pods currently parked in the poison-pod quarantine"))
+        self.quarantines = r.register(Counter(
+            "scheduler_quarantines_total",
+            "Pods moved to quarantine after repeatedly faulting their "
+            "batch", ("reason",)))
+        self.device_fallbacks = r.register(Counter(
+            "scheduler_device_fallbacks_total",
+            "Batches degraded from the fused device launch to the host "
+            "Filter/Score path after a device fault"))
+        self.drift_detected = r.register(Counter(
+            "scheduler_drift_detected_total",
+            "Cache/mirror-vs-hub discrepancies found by the drift "
+            "sentinel"))
+        self.drift_repaired = r.register(Counter(
+            "scheduler_drift_repaired_total",
+            "Drift discrepancies repaired by targeted re-sync"))
+        self.drift_rebuilds = r.register(Counter(
+            "scheduler_drift_full_rebuilds_total",
+            "Last-resort full mirror/snapshot rebuilds after targeted "
+            "drift repair failed to converge"))
+        self.cycle_crashes = r.register(Counter(
+            "scheduler_cycle_crashes_total",
+            "Scheduling-loop exceptions survived by the daemon "
+            "keep-alive (each backs the loop off before retrying)"))
+        self.condition_patches_dropped = r.register(Counter(
+            "scheduler_condition_patches_dropped_total",
+            "Pod condition patches dropped (degraded mode or fenced) "
+            "instead of wedging the loop", ("reason",)))
         self.queue_incoming_pods = r.register(Counter(
             "queue_incoming_pods_total",
             "Pods added to scheduling queues by event/queue",

@@ -172,6 +172,12 @@ class BatchResult:
     # just within one. Always a concrete scalar (0 when the knob is off) so
     # the pytree structure is launch-config independent.
     pct_start: jax.Array
+    # [] i32 guard bitmask, the device-side poison detector: bit 0 = NaN
+    # in the winning scores, bit 1 = NaN in the post-batch free state
+    # (which would poison the usage chain and every chained launch after
+    # it). A cheap reduction computed on device; the scheduler pulls it
+    # with node_row and degrades the batch to the host path when set.
+    guard: jax.Array
 
 
 # workload-activity flags (STATIC, host-derived per launch by
@@ -180,6 +186,14 @@ class BatchResult:
 # eliminates the whole kernel. The device analog of PreFilter returning
 # Skip for a pod that doesn't use the plugin (framework/interface.go:518).
 ALL_FEATURES = ("nodeaffinity", "taints", "ports", "images")
+
+
+def _guard_reduction(scores: jnp.ndarray, free: jnp.ndarray) -> jnp.ndarray:
+    """BatchResult.guard: NaN poison detector, fused into the launch.
+    Bit 0 = NaN in the winning scores (placements untrustworthy), bit 1 =
+    NaN in the post-batch free state (the usage chain is poisoned)."""
+    return (jnp.any(jnp.isnan(scores)).astype(jnp.int32)
+            | (jnp.any(jnp.isnan(free)).astype(jnp.int32) << 1))
 
 
 def static_filters(ct: ClusterTensors, pod: PodFeatures,
@@ -314,7 +328,8 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
     return BatchResult(node_row=placed, score=win, feasible_count=feas,
                        reject_counts=reject_counts,
                        unresolvable_count=unres, free=free, nzr=nzr,
-                       pct_start=jnp.int32(0))
+                       pct_start=jnp.int32(0),
+                       guard=_guard_reduction(win, free))
 
 
 def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
@@ -870,7 +885,8 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
          ipa_rejects[:, None]], axis=1)
     return BatchResult(node_row=rows, score=win_scores, feasible_count=feas,
                        reject_counts=reject_counts, unresolvable_count=unres,
-                       free=free_out, nzr=nzr_out, pct_start=start_out)
+                       free=free_out, nzr=nzr_out, pct_start=start_out,
+                       guard=_guard_reduction(win_scores, free_out))
 
 
 @partial(jax.jit, static_argnames=("caps", "enable_topology", "d_cap",

@@ -23,7 +23,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from kubernetes_tpu.api.objects import Pod
-from kubernetes_tpu.hub import Unavailable
+from kubernetes_tpu.hub import Fenced, Unavailable
 from kubernetes_tpu.plugins import hints
 from kubernetes_tpu.framework.interface import (
     ActionType,
@@ -104,6 +104,8 @@ class DefaultBinder(BindPlugin):
             self._binder(pod, node_name)
         except Unavailable:
             raise    # transport outage: degraded mode parks, not errors
+        except Fenced:
+            raise    # deposed epoch: the scheduler releases the claim
         except Exception as e:  # noqa: BLE001 — surfaced as Status
             return Status.error(str(e), self.NAME)
         return Status()

@@ -50,7 +50,8 @@ from kubernetes_tpu.framework.interface import (
 from kubernetes_tpu.framework.runtime import Framework
 from kubernetes_tpu.framework.interface import Code
 from kubernetes_tpu.framework.waiting import WaitingPod
-from kubernetes_tpu.hub import EventHandlers, Hub, Unavailable
+from kubernetes_tpu.hub import EventHandlers, Fenced, Hub, Unavailable
+from kubernetes_tpu.utils.backoff import Backoff
 from kubernetes_tpu.utils.gcguard import guard as gc_guard
 from kubernetes_tpu.models.pipeline import (
     ADAPTIVE_PCT,
@@ -72,6 +73,19 @@ SLOW_CYCLE_SECONDS = 0.1
 # commit batch k-1 while launches k and k+1 queue on the device, which
 # hides the device wait entirely when host commit time ~ device time
 PIPELINE_DEPTH = 2
+
+# poison-pod quarantine: a pod in this many faulted batches (or raising
+# in its own serial host-fallback evaluation) is parked out of the
+# scheduling population with escalating backoff instead of wedging peers
+QUARANTINE_STRIKES = 3
+QUARANTINE_BASE_S = 5.0
+QUARANTINE_CAP_S = 300.0
+
+
+class DeviceFault(RuntimeError):
+    """The fused device launch produced untrustworthy output (guard
+    reduction tripped: NaN scores or a poisoned usage state). Raised by
+    ``_finish`` before any commit; contained by the fallback ladder."""
 
 A = ActionType
 R = EventResource
@@ -112,6 +126,18 @@ class Scheduler:
         # bit-identical to single-device (tests/test_multichip.py).
         self.mesh = mesh
         self.mirror = Mirror(caps=self.caps, mesh=mesh)
+        # fencing: set by run()/start() when an elector gates the loop;
+        # every bind/status-patch then carries the elector's epoch so a
+        # deposed incarnation's in-flight writes are rejected (Fenced)
+        self._elector = None
+        # per-binder-thread fencing context: the epoch a bind carries is
+        # captured when the bind is SUBMITTED, not when it executes — a
+        # deposed-then-re-elected leader must not launder a stale
+        # placement through its newer epoch
+        self._bind_fence = threading.local()
+        # chaos seam: a DeviceChaos (kubernetes_tpu.chaos) hooks the
+        # pack/launch path here to provoke the fallback ladder under test
+        self.fault_injector = None
         self.nominator = Nominator()
         self.preemption = Evaluator(
             hub, lambda: self.mirror, lambda: self.caps,
@@ -119,7 +145,7 @@ class Scheduler:
         from kubernetes_tpu.plugins.dra import DynamicResources
 
         self._dra = DynamicResources(hub)
-        extra = {"binder": hub.bind, "hub": hub,
+        extra = {"binder": self._fenced_bind, "hub": hub,
                  "preemption_evaluator": self.preemption,
                  # shared across profiles (SharedDRAManager analog): one
                  # assume overlay must see every profile's allocations
@@ -198,7 +224,20 @@ class Scheduler:
         self._deferred: list[QueuedPodInfo] = []
         self.stats = {"scheduled": 0, "unschedulable": 0, "errors": 0,
                       "batches": 0, "attempts": 0,
-                      "parked_unreachable": 0}
+                      "parked_unreachable": 0, "fenced": 0,
+                      "device_fallbacks": 0, "quarantined": 0,
+                      "drift_repairs": 0}
+        # poison-pod quarantine: uid -> {"qp", "until", "reason"};
+        # strike/quarantine counts survive release so a re-offender's
+        # backoff keeps escalating
+        self._quarantine: dict[str, dict] = {}
+        self._fault_strikes: dict[str, int] = {}
+        self._quarantine_counts: dict[str, int] = {}
+        # drift sentinel cadence (0 disables); strikes gate the
+        # full-rebuild last resort
+        self.drift_check_interval = 30.0
+        self._last_drift_check = 0.0
+        self._drift_strikes = 0
         # degraded mode: the hub is unreachable (transport Unavailable).
         # Work parks with backoff instead of erroring; assumed pods are
         # preserved (their confirm events cannot arrive); the informer's
@@ -383,6 +422,13 @@ class Scheduler:
     def _ours(self, pod: Pod) -> bool:
         return pod.spec.scheduler_name in self.frameworks
 
+    def _quarantine_holds(self, pod: Pod) -> bool:
+        """A quarantined pod must not re-enter the queue through an
+        informer add/update — a controller status patch or relist replay
+        would otherwise reset its escalating backoff. The release path
+        re-fetches hub truth, so nothing else to track here."""
+        return pod.metadata.uid in self._quarantine
+
     def _on_pod_add(self, pod: Pod) -> None:
         if self._pod_event_stale(pod):
             return
@@ -392,7 +438,8 @@ class Scheduler:
             self.cache.add_pod(pod)
             self.queue.move_all_to_active_or_backoff(
                 ClusterEvent(R.ASSIGNED_POD, A.ADD), None, pod)
-        elif not self._terminal(pod) and self._ours(pod):
+        elif not self._terminal(pod) and self._ours(pod) \
+                and not self._quarantine_holds(pod):
             # foreign schedulerName pods are another scheduler's business
             # (schedule_one.go:371); restart/replay: re-seed nominations
             # from status so reservations survive a scheduler restart
@@ -420,7 +467,8 @@ class Scheduler:
                 self.queue.delete(new)
                 self.queue.move_all_to_active_or_backoff(
                     ClusterEvent(R.ASSIGNED_POD, A.ADD), old, new)
-        elif not self._terminal(new) and self._ours(new):
+        elif not self._terminal(new) and self._ours(new) \
+                and not self._quarantine_holds(new):
             self.nominator.update(new)
             self.queue.update(old, new)
 
@@ -429,6 +477,9 @@ class Scheduler:
         # for the dead pod can't resurrect it in the cache; tombstones age
         # out of a bounded FIFO instead of a wholesale clear
         uid = pod.metadata.uid
+        self._quarantine.pop(uid, None)
+        self._fault_strikes.pop(uid, None)
+        self._quarantine_counts.pop(uid, None)
         self._pod_rv[uid] = 2 ** 62
         self._rv_tombstones.append(uid)
         if len(self._rv_tombstones) > 50_000:
@@ -504,23 +555,341 @@ class Scheduler:
         for qp in runnable:
             self._park_unreachable(qp)
 
+    def _fencing_args(self) -> tuple:
+        """Extra positional args for fenced hub writes: (epoch,
+        lease_name) while an elector gates this scheduler, () otherwise
+        (single-scheduler deployments stay unfenced)."""
+        el = self._elector
+        return () if el is None else (el.epoch, el.lease_name)
+
+    def _fenced_bind(self, pod: Pod, node_name: str) -> None:
+        """The binder client handed to DefaultBinder: Hub.bind carrying
+        our fencing epoch, so an in-flight bind submitted before we were
+        deposed is rejected (Fenced) instead of double-placing the pod.
+        Inside a binding cycle the epoch captured at submission wins —
+        re-election must not refresh a stale decision's token."""
+        fargs = getattr(self._bind_fence, "args", None)
+        if fargs is None:
+            fargs = self._fencing_args()
+        self.hub.bind(pod, node_name, *fargs)
+
     def _patch_condition_best_effort(self, pod: Pod,
                                      condition: PodCondition,
                                      nominated_node: str | None = None
                                      ) -> None:
         """Condition patches are observability, not correctness: in
-        degraded mode they are dropped, not allowed to wedge the loop."""
+        degraded mode (or when fenced) they are dropped — and COUNTED,
+        so operators can see lost status — not allowed to wedge the
+        loop."""
         try:
             # positional: RemoteHub's RPC proxies take *args only
-            self.hub.patch_pod_condition(pod, condition, nominated_node)
+            self.hub.patch_pod_condition(pod, condition, nominated_node,
+                                         *self._fencing_args())
         except Unavailable:
             self._note_hub_down()
+            self.metrics.condition_patches_dropped.inc(
+                reason="unavailable")
+        except Fenced:
+            self.stats["fenced"] += 1
+            self.metrics.fenced_writes.inc(verb="patch_pod_condition")
+            self.metrics.condition_patches_dropped.inc(reason="fenced")
 
     def _flush_evictions_safe(self) -> None:
         try:
             self.preemption.flush_evictions()
         except Unavailable:
             self._note_hub_down()
+
+    # ------------- fault containment (the self-healing ladder) -------------
+    #
+    # The ladder, top to bottom: (1) the fused device launch; (2) on any
+    # device-path exception (XLA error, guard-reduction NaN, re-bucket
+    # non-convergence, a plugin raising during pack) the batch degrades
+    # to the serial host Filter/Score path — peers keep scheduling THIS
+    # cycle, and the device path is retried fresh on the next batch;
+    # (3) a pod that raises in its own serial evaluation, or keeps
+    # appearing in faulted batches (QUARANTINE_STRIKES), is bisected out
+    # into the quarantine set with escalating backoff, a hub Event, and
+    # a metric. The daemon never dies because the accelerator path did.
+
+    def _finish_contained(self, inflight: tuple) -> None:
+        """_finish with blast-radius containment: an exception commits
+        nothing further and routes the batch's still-pending pods down
+        the ladder instead of escaping the loop."""
+        try:
+            self._finish(inflight)
+        except Unavailable:
+            self._park_batch_unreachable(self._still_pending(inflight[0]))
+        except Exception as e:  # noqa: BLE001 — the containment seam
+            self._contain_batch_fault(inflight[0], e)
+
+    def _still_pending(self, runnable: list[QueuedPodInfo]
+                       ) -> list[QueuedPodInfo]:
+        """The subset of a faulted batch that no commit path has touched
+        yet (a _finish that raised midway may have assumed — or even
+        bound-and-confirmed — some pods already, or parked others; none
+        of those may be re-driven)."""
+        return [qp for qp in runnable
+                if self.cache.get_pod(qp.pod) is None
+                and not self.queue.is_parked(qp.uid)]
+
+    def _contain_batch_fault(self, runnable: list[QueuedPodInfo],
+                             exc: BaseException) -> None:
+        """Rung 2 of the ladder: the device path failed for this batch.
+        Strike every member (poison attribution), invalidate the usage
+        chain, and degrade the survivors to the host path."""
+        self.stats["device_fallbacks"] += 1
+        self.metrics.device_fallbacks.inc()
+        self._invalidate_chain()
+        logger.warning(
+            "device path failed for a %d-pod batch (%r); degrading to "
+            "the host fallback path", len(runnable), exc)
+        pending = self._still_pending(runnable)
+        # pods _dispatch deferred before raising (profile split, host
+        # volume conflicts) are still in flight via _deferred — the next
+        # pop drives them; driving them here too would double-place
+        deferred = {qp.uid for qp in self._deferred}
+        pending = [qp for qp in pending if qp.uid not in deferred]
+        for qp in pending:
+            self._fault_strikes[qp.uid] = \
+                self._fault_strikes.get(qp.uid, 0) + 1
+        self._host_fallback_batch(pending)
+
+    def _host_fallback_batch(self, qps: list[QueuedPodInfo]) -> None:
+        """The degraded scheduling path: serial host-side Filter/Score
+        over the snapshot (resources, taints, node selector/affinity,
+        host ports, unschedulable marks, plus the host plugin filters
+        and scores). Serial evaluation IS the bisection: a pod that
+        raises poisons only itself and is quarantined; its batch peers
+        keep scheduling. Pods needing topology kernels are parked to
+        retry the device path next cycle (the host path has no fused
+        affinity state)."""
+        if not qps:
+            return
+        try:
+            self._drain_bind_results(wait=True)
+            self.cache.update_snapshot(self.snapshot)
+        except Unavailable:
+            self._park_batch_unreachable(qps)
+            return
+        committed: dict[str, object] = {}     # node -> Resource committed
+        committed_pods: dict[str, int] = {}
+        for qp in qps:
+            if self._fault_strikes.get(qp.uid, 0) >= QUARANTINE_STRIKES:
+                self._quarantine_pod(
+                    qp, f"{self._fault_strikes[qp.uid]} batch faults")
+                continue
+            try:
+                node, plugins = self._host_place_one(qp, committed,
+                                                     committed_pods)
+            except Unavailable:
+                self._note_hub_down()
+                self._park_unreachable(qp)
+                continue
+            except Exception as e:  # noqa: BLE001 — the poison seam:
+                # this pod's own spec/plugins raised in SERIAL evaluation,
+                # so the attribution is exact — quarantine it alone
+                self._quarantine_pod(qp, f"host fallback raised: {e!r}")
+                continue
+            if node is None:
+                self._park_unschedulable(qp, plugins,
+                                         "host fallback: no feasible node")
+            elif node == "":
+                # topology pod: the host path cannot evaluate it — park
+                # error-class and let the next cycle retry the device path
+                self._error(qp, "device path failed; topology pod awaits "
+                                "device retry")
+            else:
+                from kubernetes_tpu.api.resources import pod_request
+
+                r = committed.get(node)
+                if r is None:
+                    committed[node] = pod_request(qp.pod).clone()
+                else:
+                    r.add(pod_request(qp.pod))
+                committed_pods[node] = committed_pods.get(node, 0) + 1
+                self._fault_strikes.pop(qp.uid, None)
+                self._commit(qp, node)
+
+    def _host_place_one(self, qp: QueuedPodInfo, committed: dict,
+                        committed_pods: dict
+                        ) -> tuple[Optional[str], set[str]]:
+        """One pod through the host predicates + scores. Returns
+        (node_name, set()) on success, (None, rejecting_plugins) when
+        infeasible, ("", set()) when the pod needs the device's topology
+        kernels (affinity/anti-affinity/spread — not evaluable here)."""
+        from kubernetes_tpu.api.labels import (
+            find_untolerated_taint,
+            label_selector_matches,
+            pod_matches_node_selector_and_affinity,
+        )
+        from kubernetes_tpu.api.resources import pod_request
+
+        pod = qp.pod
+        if self.mirror.batch_has_topology([pod]):
+            return "", set()
+        req = pod_request(pod)
+        infos = self.snapshot.node_info_list
+        fw = self._fw_for(pod)
+        host_mask = host_scores = None
+        qp.host_reject_counts = {}
+        if (self._has_host_filters or self._has_host_scores) \
+                and self._host_relevant(pod):
+            state = CycleState()
+            host_mask, counts, early = fw.run_host_filters(state, pod,
+                                                           infos)
+            if counts:
+                qp.host_reject_counts = counts
+            if early is not None:
+                return None, set(counts) or {early.plugin or "HostFilter"}
+            if self._has_host_scores:
+                host_scores = fw.run_host_scores(state, pod, infos)
+        ports = [(p.host_ip, p.protocol, p.host_port)
+                 for c in pod.spec.containers for p in c.ports
+                 if p.host_port > 0]
+        rejects: set[str] = set(qp.host_reject_counts)
+        best = None
+        best_score = float("-inf")
+        for i, ni in enumerate(infos):
+            node = ni.node
+            if node is None:
+                continue
+            if host_mask is not None and not host_mask[i]:
+                continue
+            if node.spec.unschedulable:
+                rejects.add("NodeUnschedulable")
+                continue
+            if not pod_matches_node_selector_and_affinity(pod, node):
+                rejects.add("NodeAffinity")
+                continue
+            if find_untolerated_taint(node.spec.taints,
+                                      pod.spec.tolerations) is not None:
+                rejects.add("TaintToleration")
+                continue
+            if any(ni.used_ports.conflicts(*p) for p in ports):
+                rejects.add("NodePorts")
+                continue
+            # symmetry guard: an EXISTING pod's required anti-affinity
+            # must not be violated by this placement; non-hostname
+            # domains span other nodes, which only the device kernels
+            # track — send such pods back to the device path
+            sym_block = False
+            for pi in ni.pods_with_required_anti_affinity:
+                for term in pi.required_anti_affinity_terms:
+                    if label_selector_matches(term.label_selector,
+                                              pod.metadata.labels) \
+                            and pi.pod.metadata.namespace \
+                            == pod.metadata.namespace:
+                        if term.topology_key != "kubernetes.io/hostname":
+                            return "", set()
+                        sym_block = True
+            if sym_block:
+                rejects.add("InterPodAffinity")
+                continue
+            alloc = ni.allocatable
+            extra = committed.get(ni.name)
+            free_cpu = alloc.milli_cpu - ni.requested.milli_cpu \
+                - (extra.milli_cpu if extra else 0)
+            free_mem = alloc.memory - ni.requested.memory \
+                - (extra.memory if extra else 0)
+            free_eph = alloc.ephemeral_storage \
+                - ni.requested.ephemeral_storage \
+                - (extra.ephemeral_storage if extra else 0)
+            n_pods = len(ni.pods) + committed_pods.get(ni.name, 0)
+            if req.milli_cpu > free_cpu or req.memory > free_mem \
+                    or req.ephemeral_storage > free_eph \
+                    or (alloc.allowed_pod_number > 0
+                        and n_pods + 1 > alloc.allowed_pod_number):
+                rejects.add("NodeResourcesFit")
+                continue
+            if any(v > alloc.scalar.get(k, 0)
+                   - ni.requested.scalar.get(k, 0)
+                   - (extra.scalar.get(k, 0) if extra else 0)
+                   for k, v in req.scalar.items()):
+                rejects.add("NodeResourcesFit")
+                continue
+            # LeastAllocated over cpu+memory — the host analog of the
+            # default fit scoring, enough to spread a degraded batch —
+            # plus any configured host score plugins
+            score = 0.0
+            if alloc.milli_cpu > 0:
+                score += (free_cpu - req.milli_cpu) / alloc.milli_cpu
+            if alloc.memory > 0:
+                score += (free_mem - req.memory) / alloc.memory
+            if host_scores is not None:
+                score += host_scores[i]
+            if score > best_score:
+                best, best_score = ni.name, score
+        if best is None:
+            return None, rejects or {"NodeResourcesFit"}
+        return best, set()
+
+    def _park_unschedulable(self, qp: QueuedPodInfo, plugins: set[str],
+                            msg: str) -> None:
+        """Unschedulable park with plugin attribution, minus PostFilter:
+        preemption is a device sweep, which the fallback path must not
+        re-enter (the pod retries the full path after backoff)."""
+        qp.unschedulable_plugins = plugins or {"NodeResourcesFit"}
+        qp.unschedulable_count += 1
+        qp.consecutive_errors_count = 0
+        self.stats["unschedulable"] += 1
+        self.metrics.schedule_attempts.inc(
+            result="unschedulable", profile=qp.pod.spec.scheduler_name)
+        self._patch_condition_best_effort(qp.pod, PodCondition(
+            type="PodScheduled", status="False", reason="Unschedulable",
+            message=msg))
+        self.queue.add_unschedulable_if_not_present(qp)
+
+    # ------------- poison-pod quarantine -------------
+
+    def _quarantine_pod(self, qp: QueuedPodInfo, reason: str) -> None:
+        """Park a pod that keeps faulting its batch: out of the queue,
+        escalating backoff, hub Event + metric so operators see it."""
+        uid = qp.uid
+        n = self._quarantine_counts.get(uid, 0) + 1
+        self._quarantine_counts[uid] = n
+        backoff = min(QUARANTINE_CAP_S, QUARANTINE_BASE_S * (2 ** (n - 1)))
+        self._quarantine[uid] = {"qp": qp, "until": self.now() + backoff,
+                                 "reason": reason}
+        self._fault_strikes.pop(uid, None)
+        self.queue.done(uid)
+        self.stats["quarantined"] += 1
+        self.metrics.quarantines.inc(reason="poison")
+        self.metrics.quarantined_pods.set(float(len(self._quarantine)))
+        logger.error("quarantining pod %s for %.0fs (offense %d): %s",
+                     qp.pod.key(), backoff, n, reason)
+        try:
+            self.hub.record_event(
+                "Pod", qp.pod.key(), "Quarantined",
+                f"poison-pod quarantine ({backoff:.0f}s, offense {n}): "
+                f"{reason}")
+        except Unavailable:
+            self._note_hub_down()
+
+    def _release_quarantined(self) -> None:
+        """Maintenance tick: return served-out quarantine entries to the
+        queue (re-offense re-quarantines with doubled backoff)."""
+        if not self._quarantine:
+            self.metrics.quarantined_pods.set(0.0)
+            return
+        now = self.now()
+        for uid, entry in list(self._quarantine.items()):
+            if entry["until"] > now:
+                continue
+            try:
+                stored = self.hub.get_pod(uid)
+            except Unavailable:
+                self._note_hub_down()
+                continue            # retry on the next tick
+            del self._quarantine[uid]
+            if stored is not None and not stored.spec.node_name \
+                    and not self._terminal(stored):
+                self.queue.add(stored)
+        self.metrics.quarantined_pods.set(float(len(self._quarantine)))
+
+    def quarantined_uids(self) -> set[str]:
+        """Introspection for tests/serving: pods currently quarantined."""
+        return set(self._quarantine)
 
     # ------------- capacity re-bucketing -------------
 
@@ -572,6 +941,14 @@ class Scheduler:
             if self.cache.is_assumed_pod(qp.pod):
                 self.queue.done(qp.uid)
                 continue
+            if self._fault_strikes.get(qp.uid, 0) >= QUARANTINE_STRIKES:
+                # repeat offender re-entering via error backoff (e.g. a
+                # pod whose reserve plugin keeps raising): bisect it out
+                # before it faults another batch
+                self._quarantine_pod(
+                    qp, f"{self._fault_strikes[qp.uid]} batch/commit "
+                        "faults")
+                continue
             runnable.append(qp)
         return len(batch), runnable
 
@@ -617,6 +994,11 @@ class Scheduler:
             runnable = self._defer_host_conflicts(runnable)
             if not runnable:
                 return None
+        if self.fault_injector is not None:
+            # chaos seam: may raise (device launch error, forced
+            # CapacityError, poison-pod exception) — contained by the
+            # fallback ladder exactly like a real device fault
+            self.fault_injector.on_pack([qp.pod for qp in runnable])
         self.stats["batches"] += 1
         self.stats["attempts"] += len(runnable)
         state = self._chain if chained else None
@@ -685,6 +1067,8 @@ class Scheduler:
             # arg pytree and therefore one trace/compile
             pct_start=(self._pct_start if self._pct_start is not None
                        else np.int32(0)) if pct else None)
+        if self.fault_injector is not None:
+            out = self.fault_injector.on_result(out)
         if pct:
             # device-resident rotation carry; stays async (never sync'd to
             # host), consumed as the next launch's seed
@@ -860,7 +1244,16 @@ class Scheduler:
         runnable, out, t_dispatched, pack_s = inflight
         n = len(runnable)
         t0 = self.now()
-        rows = np.asarray(jax.device_get(out.node_row))[:n].tolist()
+        rows_arr, guard = jax.device_get((out.node_row, out.guard))
+        if int(guard):
+            # the launch's own guard reduction tripped: NaN scores or a
+            # poisoned usage chain — nothing below can be trusted; the
+            # containment wrapper degrades this batch to the host path
+            raise DeviceFault(
+                f"launch guard tripped (mask {int(guard):#x}): "
+                f"{'NaN scores ' if int(guard) & 1 else ''}"
+                f"{'poisoned usage state' if int(guard) & 2 else ''}")
+        rows = np.asarray(rows_arr)[:n].tolist()
         launch_s = self.now() - t_dispatched
         t1 = self.now()
         # reject attribution is only read on failure; skipping the [B, P]
@@ -927,8 +1320,11 @@ class Scheduler:
                 except Unavailable:
                     self._park_batch_unreachable(runnable)
                     inflight = None
+                except Exception as e:  # noqa: BLE001 — containment seam
+                    self._contain_batch_fault(runnable, e)
+                    inflight = None
                 if inflight is not None:
-                    self._finish(inflight)
+                    self._finish_contained(inflight)
             self._drain_bind_results(wait=True)
             # async preemption: victims queued by PostFilter are evicted
             # here, OUTSIDE the cycle (prepareCandidateAsync's analog)
@@ -961,6 +1357,15 @@ class Scheduler:
             self._undo_commit(qp, state, assumed, node_name,
                               f"reserve: {e}", park_unreachable=True)
             return
+        except Exception as e:  # noqa: BLE001 — a raising out-of-tree
+            # plugin must not strand the assume (the pod would be a
+            # phantom placement forever); error path + strike so a
+            # repeat offender quarantines
+            self._fault_strikes[qp.uid] = \
+                self._fault_strikes.get(qp.uid, 0) + 1
+            self._undo_commit(qp, state, assumed, node_name,
+                              f"reserve raised: {e!r}")
+            return
         if not s.is_success():
             # a REJECTING reserve (e.g. DRA "devices vanished" — the
             # designed same-batch capacity race) is unschedulable with
@@ -976,6 +1381,13 @@ class Scheduler:
         except Unavailable as e:
             self._undo_commit(qp, state, assumed, node_name,
                               f"permit: {e}", park_unreachable=True)
+            return
+        except Exception as e:  # noqa: BLE001 — same containment as
+            # reserve: undo the assume, error path, strike
+            self._fault_strikes[qp.uid] = \
+                self._fault_strikes.get(qp.uid, 0) + 1
+            self._undo_commit(qp, state, assumed, node_name,
+                              f"permit raised: {e!r}")
             return
         if s.code == Code.WAIT:
             fw.waiting_pods.add(WaitingPod(qp, node_name, state, waits,
@@ -1040,20 +1452,27 @@ class Scheduler:
             try:
                 ext.bind(pod, node_name)
                 # the extender performed the API binding; reflect it in
-                # the hub like the Binding POST would
-                self.hub.bind(pod, node_name)
+                # the hub like the Binding POST would (fenced: a deposed
+                # leader's delegated bind must be rejected too)
+                self._fenced_bind(pod, node_name)
                 return Status()
             except Unavailable:
                 raise    # transport outage: degraded mode parks the pod
+            except Fenced:
+                raise    # deposed epoch: _bind_task tags, claim released
             except ExtenderError as e:
                 return Status.error(str(e))
             except Exception as e:  # noqa: BLE001
                 return Status.error(f"extender bind raised: {e!r}")
         return None
 
-    def _bind_task(self, state: CycleState, pod: Pod, node_name: str):
+    def _bind_task(self, state: CycleState, pod: Pod, node_name: str,
+                   fargs: tuple = None):
         fw = self._fw_for(pod)
         t0 = time.monotonic()
+        if fargs is not None:
+            # decision-time fencing token (see _fenced_bind)
+            self._bind_fence.args = fargs
         try:
             s = fw.run_pre_bind_plugins(state, pod, node_name)
             if s.is_success():
@@ -1067,26 +1486,41 @@ class Scheduler:
 
             s = Status.error(f"hub unavailable: {e}",
                              plugin="HubUnavailable")
+        except Fenced as e:
+            # we were deposed while this bind was in flight: the hub
+            # rejected it, the new leader owns the pod now — tagged so
+            # _finish_binding releases our claim without status writes
+            from kubernetes_tpu.framework.interface import Status
+
+            s = Status.error(f"fenced: {e}", plugin="Fenced")
         except Exception as e:  # noqa: BLE001 — a raising out-of-tree
             # plugin must not poison the chunk/future (every other pod in
             # it would stay assumed forever)
             from kubernetes_tpu.framework.interface import Status
 
             s = Status.error(f"bind cycle raised: {e!r}")
+        finally:
+            self._bind_fence.args = None    # don't leak across chunks
         self.recorder.observe(self.metrics.extension_point_duration,
                               time.monotonic() - t0, extension_point="Bind")
         return s
 
     def _start_binding(self, qp: QueuedPodInfo, state: CycleState,
                        assumed: Pod, node_name: str) -> None:
+        # the fencing token travels WITH the bind from here: the epoch
+        # this placement was decided under, not whatever the elector
+        # holds when the binder thread finally executes it
+        fargs = self._fencing_args()
         if self._binder is None:
             self._finish_binding(qp, state, assumed, node_name,
-                                 self._bind_task(state, qp.pod, node_name))
+                                 self._bind_task(state, qp.pod, node_name,
+                                                 fargs))
             self._process_deferred_events()
         else:
             # per-pod futures are too fine for python threads; the backlog
             # is chunked across the pool by _submit_bind_backlog
-            self._bind_backlog.append((qp, state, assumed, node_name))
+            self._bind_backlog.append((qp, state, assumed, node_name,
+                                       fargs))
 
     def _submit_bind_backlog(self) -> None:
         backlog, self._bind_backlog = self._bind_backlog, []
@@ -1096,8 +1530,8 @@ class Scheduler:
         chunk = max(1, -(-len(backlog) // workers))
 
         def run_chunk(items):
-            return [self._bind_task(state, qp.pod, node_name)
-                    for qp, state, assumed, node_name in items]
+            return [self._bind_task(state, qp.pod, node_name, fargs)
+                    for qp, state, assumed, node_name, fargs in items]
 
         for i in range(0, len(backlog), chunk):
             items = backlog[i:i + chunk]
@@ -1113,8 +1547,8 @@ class Scheduler:
         for item in self._inflight_binds:
             items, fut = item
             if wait or fut.done():
-                for (qp, state, assumed, node_name), s in zip(items,
-                                                              fut.result()):
+                for (qp, state, assumed, node_name, _fargs), s in zip(
+                        items, fut.result()):
                     self._finish_binding(qp, state, assumed, node_name, s)
                 self._process_deferred_events()
             else:
@@ -1124,6 +1558,9 @@ class Scheduler:
     def _finish_binding(self, qp: QueuedPodInfo, state: CycleState,
                         assumed: Pod, node_name: str, s) -> None:
         if not s.is_success():
+            if s.plugin == "Fenced":
+                self._finish_fenced(qp, state, assumed, node_name)
+                return
             self._undo_commit(qp, state, assumed, node_name,
                               f"bind: {s.message()}",
                               park_unreachable=(
@@ -1132,12 +1569,41 @@ class Scheduler:
         self.cache.finish_binding(assumed)
         self.nominator.delete(qp.uid)
         self.queue.done(qp.uid)
+        self._fault_strikes.pop(qp.uid, None)
         self._fw_for(qp.pod).run_post_bind_plugins(state, qp.pod, node_name)
         qp.consecutive_errors_count = 0
         self.stats["scheduled"] += 1
         self.metrics.schedule_attempts.inc(
             result="scheduled", profile=qp.pod.spec.scheduler_name)
         self.metrics.pod_scheduling_attempts.observe(qp.attempts)
+
+    def _finish_fenced(self, qp: QueuedPodInfo, state: CycleState,
+                       assumed: Pod, node_name: str) -> None:
+        """A deposed leader's in-flight bind was rejected by the fencing
+        check: release the optimistic claim quietly. NO condition patch
+        (the new leader owns the pod's status — and ours are fenced
+        anyway) and no error accounting — the pod did nothing wrong. It
+        parks error-class so a later re-election finds it retryable;
+        the new leader's bind confirms through the informer and deletes
+        it from our queue like any foreign placement."""
+        self.stats["fenced"] += 1
+        self.metrics.fenced_writes.inc(verb="bind")
+        try:
+            self._fw_for(qp.pod).run_unreserve_plugins(state, qp.pod,
+                                                       node_name)
+        except Unavailable:
+            self._note_hub_down()
+        if not self.cache.is_assumed_pod(assumed):
+            # the new leader's bind of this pod already CONFIRMED through
+            # our informer (add_pod replaced the assumed state): the pod
+            # is theirs, placed and cached — nothing to forget or requeue
+            self.queue.done(qp.uid)
+            return
+        self.cache.forget_pod(assumed)
+        self._invalidate_chain()
+        qp.unschedulable_plugins = set()
+        qp.consecutive_errors_count += 1
+        self.queue.add_unschedulable_if_not_present(qp)
 
     def _process_waiting(self) -> None:
         """Harvest the waitingPodsMap: fully-allowed pods proceed to the
@@ -1315,12 +1781,14 @@ class Scheduler:
                     self._assumed_requeue.extend(
                         self.cache.cleanup_assumed_pods())
             self._drain_assumed_requeue()
+            self._release_quarantined()
             self._process_waiting()
             self._drain_bind_results()
             self._flush_evictions_safe()
             self._process_deferred_events()
             self.recorder.flush(force=False)
             self._probe_hub()
+            self._run_drift_sentinel()
             self.metrics.cache_size.set(self.cache.pod_count(), type="pods")
             self.metrics.cache_size.set(self.cache.assumed_pod_count(),
                                         type="assumed_pods")
@@ -1362,6 +1830,62 @@ class Scheduler:
             logger.info("hub reachable again: leaving degraded mode")
         except Unavailable:
             pass
+
+    def _run_drift_sentinel(self) -> None:
+        """The cache comparer (backend/cache/debugger/comparer.go),
+        promoted from a SIGUSR2 debug hook to a periodic sentinel: every
+        ``drift_check_interval`` diff the scheduler's cache against hub
+        truth and auto-repair divergence by TARGETED re-sync (only the
+        drifted entries mutate — generation bumps make the incremental
+        snapshot/mirror refresh pick up exactly those rows). Persistent
+        drift (targeted repair not converging) escalates to the full
+        mirror/snapshot rebuild as last resort. Skipped while degraded
+        or with dead watch streams: everything would look drifted."""
+        if self.drift_check_interval <= 0:
+            return
+        now = self.now()
+        if now - self._last_drift_check < self.drift_check_interval:
+            return
+        if self.hub_degraded() \
+                or not getattr(self.hub, "watches_healthy", True):
+            return
+        self._last_drift_check = now
+        try:
+            report = self.cache.drift_report(self.hub)
+        except Unavailable:
+            self._note_hub_down()
+            return
+        n = report.count()
+        if n == 0:
+            self._drift_strikes = 0
+            return
+        self._drift_strikes += 1
+        self.metrics.drift_detected.inc(n)
+        logger.warning("drift sentinel: %d cache-vs-hub discrepancies "
+                       "(strike %d): %s", n, self._drift_strikes,
+                       report.render()[:5])
+        try:
+            repaired = self.cache.repair_from_hub(self.hub, report)
+        except Unavailable:
+            self._note_hub_down()
+            return
+        self.stats["drift_repairs"] += repaired
+        self.metrics.drift_repaired.inc(repaired)
+        # the mirror re-packs the repaired rows from the snapshot on the
+        # next unchained launch; drop the chain so one happens
+        self._invalidate_chain()
+        if self._drift_strikes >= 3:
+            # targeted repair is not converging: rebuild the device side
+            # from scratch (the mirror itself may be corrupt in ways the
+            # host diff cannot see)
+            logger.error("drift sentinel: persistent drift after %d "
+                         "targeted repairs; rebuilding mirror + snapshot",
+                         self._drift_strikes)
+            self.metrics.drift_rebuilds.inc()
+            self.mirror = Mirror(caps=self.caps, mesh=self.mesh)
+            self.snapshot = Snapshot()
+            self.cache.update_snapshot(self.snapshot)
+            self._drift_strikes = 0
 
     def _export_resilience_metrics(self) -> None:
         """Mirror hub-client and chaos counters into the registry (the
@@ -1427,8 +1951,12 @@ class Scheduler:
         (leaderelection.LeaderElector) the loop only schedules while
         holding the lease (server.go:284-317); a non-leader keeps its
         informer state warm but mutates nothing. Exceptions are logged and
-        retained (daemon_error); the loop backs off and keeps serving."""
+        retained (daemon_error); the loop backs off with decorrelated
+        jitter (a persistent error must not busy-spin the keep-alive)
+        and keeps serving."""
         self.daemon_error: Optional[BaseException] = None
+        self._elector = elector
+        crash_bo = Backoff(base=0.5, cap=30.0)
         try:
             while not stop.is_set():
                 if elector is not None and not elector.tick():
@@ -1444,10 +1972,12 @@ class Scheduler:
                                else (lambda: not elector.tick()))
                     if self.run_until_idle(on_step=on_step) == 0:
                         stop.wait(idle_sleep)
+                    crash_bo.reset()
                 except Exception as e:  # noqa: BLE001 — keep daemon alive
                     logger.exception("scheduling loop error: %s", e)
                     self.daemon_error = e
-                    stop.wait(0.5)
+                    self.metrics.cycle_crashes.inc()
+                    stop.wait(crash_bo.next())
         finally:
             if elector is not None:
                 elector.release()
@@ -1513,11 +2043,11 @@ class Scheduler:
 
         def flush_all() -> None:
             while pending:
-                self._finish(pending.popleft())
+                self._finish_contained(pending.popleft())
 
         def flush_to(depth: int) -> None:
             while len(pending) > depth:
-                self._finish(pending.popleft())
+                self._finish_contained(pending.popleft())
 
         for _ in range(max_batches):
             self._process_deferred_events()
@@ -1552,6 +2082,12 @@ class Scheduler:
                                          flush_pending=flush_all)
                 except Unavailable:
                     self._park_batch_unreachable(runnable)
+                    nxt = None
+                except Exception as e:  # noqa: BLE001 — containment seam:
+                    # commit what was already in flight first (their
+                    # launches predate the fault), then degrade this batch
+                    flush_all()
+                    self._contain_batch_fault(runnable, e)
                     nxt = None
                 if nxt is not None:
                     pending.append(nxt)

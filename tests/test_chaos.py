@@ -511,6 +511,283 @@ def test_midwatch_cut_at_15k_nodes_resumes_with_zero_relists():
         server.stop()
 
 
+# ------------------------- the self-healing core (fencing / ladder / etc)
+
+
+def test_inflight_async_bind_rejected_fenced_after_failover():
+    """Satellite: leader failover with in-flight async binds. The old
+    leader's late Hub.bind must be rejected Fenced (no double-place);
+    the new leader then schedules the pod exactly once."""
+    from kubernetes_tpu.leaderelection import LeaderElector
+
+    hub = Hub()
+    hub.create_node(MakeNode().name("n").capacity(cpu="8").obj())
+    elector_a = LeaderElector(hub.leases, "a", lease_duration=0.5,
+                              renew_deadline=0.3, retry_period=0.05,
+                              now=time.monotonic)
+    elector_b = LeaderElector(hub.leases, "b", lease_duration=0.5,
+                              renew_deadline=0.3, retry_period=0.05,
+                              now=time.monotonic)
+
+    class StallHub:
+        """Delegating hub whose bind stalls long enough for the caller
+        to be deposed mid-flight (the async binder pool race)."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.stall = None       # callable run before the first bind
+
+        def bind(self, *args):
+            if self.stall is not None:
+                stall, self.stall = self.stall, None
+                stall()
+            return self._inner.bind(*args)
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    shub = StallHub(hub)
+    cfg = default_config()
+    sched_a = Scheduler(shub, cfg, caps=Capacities(nodes=8, pods=64))
+    sched_a._elector = elector_a
+    assert elector_a.tick() and elector_a.epoch == 1
+    binds = []
+    hub.watch_pods(EventHandlers(
+        on_update=lambda old, new: binds.append(new.metadata.uid)
+        if not old.spec.node_name and new.spec.node_name else None),
+        replay=False)
+
+    def depose_a():
+        # runs on the binder thread, after a's launch chose a node but
+        # before its bind lands: a's lease expires, b acquires
+        time.sleep(0.6)
+        assert elector_b.try_acquire_or_renew()
+        assert elector_b.epoch == 2
+
+    shub.stall = depose_a
+    pod = MakePod().name("p").req(cpu="100m").obj()
+    hub.create_pod(pod)
+    try:
+        sched_a.run_until_idle()           # must not raise
+        assert hub.get_pod(pod.metadata.uid).spec.node_name == "", \
+            "deposed leader's in-flight bind must be rejected"
+        assert sched_a.stats["fenced"] == 1
+        assert sched_a.metrics.fenced_writes.value(verb="bind") == 1, \
+            "the BIND must be what was fenced (not a follow-on patch)"
+        assert sched_a.stats["errors"] == 0, \
+            "a fenced bind is not a scheduler error"
+        assert sched_a.cache.assumed_pod_count() == 0, \
+            "fenced bind must release its optimistic claim"
+        # the new leader schedules it exactly once
+        sched_b = Scheduler(hub, cfg, caps=Capacities(nodes=8, pods=64))
+        sched_b._elector = elector_b
+        try:
+            sched_b.run_until_idle()
+            assert hub.get_pod(pod.metadata.uid).spec.node_name == "n"
+            assert binds == [pod.metadata.uid], \
+                f"pod must bind exactly once, saw {binds}"
+        finally:
+            sched_b.close()
+    finally:
+        sched_a.close()
+
+
+@pytest.mark.quarantine
+def test_rebucket_nonconvergence_parks_batch_daemon_survives(monkeypatch):
+    """Satellite regression: the re-bucketing RuntimeError used to
+    escape the scheduling loop and kill the daemon; now the batch
+    degrades to the host path and everything still schedules."""
+    from kubernetes_tpu.backend.mirror import CapacityError, Mirror
+
+    hub = Hub()
+    for i in range(2):
+        hub.create_node(MakeNode().name(f"n-{i}").capacity(cpu="8").obj())
+    cfg = default_config()
+    sched = Scheduler(hub, cfg, caps=Capacities(nodes=8, pods=64))
+
+    def always_overflow(self, pods, batch_size):
+        raise CapacityError("nodes", 64)
+
+    monkeypatch.setattr(Mirror, "prepare_launch", always_overflow)
+    try:
+        for i in range(6):
+            hub.create_pod(MakePod().name(f"p-{i}").req(cpu="100m").obj())
+        sched.run_until_idle()             # must not raise
+        assert sched.stats["device_fallbacks"] >= 1
+        assert all(p.spec.node_name for p in hub.list_pods()), \
+            "host fallback must still place the batch"
+    finally:
+        sched.close()
+
+
+def test_keepalive_backs_off_on_persistent_error(monkeypatch):
+    """Satellite: a persistent scheduling-loop error must not busy-spin
+    the keep-alive — decorrelated backoff paces retries and
+    scheduler_cycle_crashes_total counts them."""
+    hub = Hub()
+    sched = Scheduler(hub, default_config(),
+                      caps=Capacities(nodes=8, pods=64))
+    monkeypatch.setattr(
+        sched, "run_maintenance",
+        lambda: (_ for _ in ()).throw(RuntimeError("persistent")))
+    stop = threading.Event()
+    t = threading.Thread(target=sched.run, args=(stop,), daemon=True)
+    t.start()
+    time.sleep(1.2)
+    stop.set()
+    t.join(timeout=5)
+    try:
+        crashes = sched.metrics.cycle_crashes.value()
+        assert crashes >= 1, "keep-alive must record the crash"
+        assert crashes <= 5, \
+            f"{crashes} crashes in 1.2s: the keep-alive is busy-spinning"
+        assert isinstance(sched.daemon_error, RuntimeError)
+    finally:
+        sched.close()
+
+
+def test_condition_patch_drops_are_counted():
+    """Satellite: degraded-mode (and fenced) condition-patch drops are
+    counted so operators can see lost status."""
+    from kubernetes_tpu.api.objects import PodCondition
+    from kubernetes_tpu.leaderelection import Lease
+
+    hub = Hub()
+    chub = ChaosHub(hub)
+    sched = Scheduler(chub, default_config(),
+                      caps=Capacities(nodes=8, pods=64))
+    try:
+        pod = MakePod().name("p").req(cpu="100m").obj()
+        hub.create_pod(pod)
+        cond = PodCondition(type="PodScheduled", status="False",
+                            reason="Unschedulable")
+        chub.partition_for(60.0)
+        sched._patch_condition_best_effort(pod, cond)
+        m = sched.metrics.condition_patches_dropped
+        assert m.value(reason="unavailable") == 1
+        chub.heal()
+        # fenced drop: our epoch predates an acquisition we never made
+        hub.leases.update(Lease(name="kube-scheduler",
+                                holder_identity="other"), None)
+
+        class Tok:
+            epoch = 0
+            lease_name = "kube-scheduler"
+
+        sched._elector = Tok()
+        sched._patch_condition_best_effort(pod, cond)
+        assert m.value(reason="fenced") == 1
+        assert sched.metrics.fenced_writes.value(
+            verb="patch_pod_condition") == 1
+    finally:
+        sched._elector = None
+        sched.close()
+
+
+def test_fenced_error_roundtrips_the_wire(proxied_hub):
+    """Fenced must survive the HTTP hop typed (the RPC layer's analog
+    of the apiserver's 403), not decay into RemoteError."""
+    from kubernetes_tpu.hub import Fenced
+    from kubernetes_tpu.leaderelection import Lease
+
+    hub, proxy, client = proxied_hub
+    pod = MakePod().name("p").req(cpu="100m").obj()
+    hub.create_pod(pod)
+    hub.create_node(MakeNode().name("n").obj())
+    hub.leases.update(Lease(name="kube-scheduler",
+                            holder_identity="leader"), None)
+    with pytest.raises(Fenced):
+        client.bind(pod, "n", 0, "kube-scheduler")
+    assert hub.get_pod(pod.metadata.uid).spec.node_name == ""
+    client.bind(pod, "n", hub.leases.epoch_of("kube-scheduler"),
+                "kube-scheduler")
+    assert hub.get_pod(pod.metadata.uid).spec.node_name == "n"
+
+
+@pytest.mark.quarantine
+def test_device_fault_storm_ladder_and_quarantine():
+    """The device-fault storm gate, small: injected launch errors +
+    NaN-poisoned results + a genuine poison pod; every healthy pod
+    binds, the poison pod is quarantined with a hub Event, zero daemon
+    deaths (bench.py --chaos-smoke runs the full battery)."""
+    from kubernetes_tpu.chaos import run_device_storm
+
+    report = run_device_storm(pods=24, nodes=4, seed=11)
+    assert report["ok"], report
+
+
+@pytest.mark.quarantine
+def test_quarantine_releases_with_escalating_backoff():
+    """A quarantined pod re-enters the queue after its backoff and, on
+    re-offense, re-quarantines with a doubled window."""
+    from kubernetes_tpu.chaos import make_poison_pod
+
+    clock = [1000.0]
+    hub = Hub()
+    hub.create_node(MakeNode().name("n").capacity(cpu="8").obj())
+    sched = Scheduler(hub, default_config(),
+                      caps=Capacities(nodes=8, pods=64),
+                      now=lambda: clock[0])
+    try:
+        poison = make_poison_pod("bad")
+        hub.create_pod(poison)
+        sched.run_until_idle()
+        uid = poison.metadata.uid
+        assert uid in sched.quarantined_uids()
+        until1 = sched._quarantine[uid]["until"]
+        assert until1 - clock[0] == pytest.approx(5.0)
+        clock[0] = until1 + 0.1
+        sched.run_maintenance()                # released back to queue
+        assert uid not in sched.quarantined_uids()
+        sched.run_until_idle()                 # re-offends immediately
+        assert uid in sched.quarantined_uids()
+        until2 = sched._quarantine[uid]["until"]
+        assert until2 - clock[0] == pytest.approx(10.0), \
+            "re-offense must double the quarantine window"
+        events = [e for e in hub.list_events(ref_kind="Pod")
+                  if e.reason == "Quarantined"]
+        assert events and events[0].count >= 1
+    finally:
+        sched.close()
+
+
+@pytest.mark.quarantine
+def test_quarantine_holds_through_informer_updates():
+    """A controller status patch (or relist replay) for a quarantined
+    pod must not re-queue it — that would reset the escalating backoff;
+    the freshened spec rides along for the eventual release."""
+    from kubernetes_tpu.chaos import make_poison_pod
+
+    clock = [1000.0]
+    hub = Hub()
+    hub.create_node(MakeNode().name("n").capacity(cpu="8").obj())
+    sched = Scheduler(hub, default_config(),
+                      caps=Capacities(nodes=8, pods=64),
+                      now=lambda: clock[0])
+    try:
+        poison = make_poison_pod("bad")
+        hub.create_pod(poison)
+        sched.run_until_idle()
+        uid = poison.metadata.uid
+        assert uid in sched.quarantined_uids()
+        # a controller annotates the pod mid-quarantine
+        upd = hub.get_pod(uid).clone()
+        upd.metadata.labels["touched"] = "1"
+        hub.update_pod(upd)
+        assert uid in sched.quarantined_uids()
+        assert sched.queue.pending_counts() == {
+            k: 0 for k in sched.queue.pending_counts()}, \
+            "the update must not re-queue the quarantined pod"
+        assert sched.run_until_idle() == 0
+        # release re-fetches hub truth, so the newest spec comes back
+        clock[0] = sched._quarantine[uid]["until"] + 0.1
+        sched.run_maintenance()
+        assert uid not in sched.quarantined_uids()
+        assert sched.queue.pending_counts()["active"] == 1
+    finally:
+        sched.close()
+
+
 # ------------------------------------------------- the full storm (slow)
 
 
@@ -521,4 +798,17 @@ def test_chaos_smoke_storm():
     from kubernetes_tpu.chaos import run_smoke
 
     report = run_smoke(pods=30, nodes=6, seed=7)
+    assert report["ok"], report
+
+
+@pytest.mark.slow
+@pytest.mark.quarantine
+def test_chaos_crash_storm():
+    """The acceptance storm, scaled down for the suite: device faults +
+    watch cuts + leader kill + kill-and-restart; every pod bound exactly
+    once, poison quarantined, zero daemon deaths (bench.py --chaos-smoke
+    runs it at >=1k pods)."""
+    from kubernetes_tpu.chaos import run_crash_storm
+
+    report = run_crash_storm(pods=150, nodes=8, seed=13, timeout_s=120.0)
     assert report["ok"], report

@@ -1,0 +1,184 @@
+"""Drift sentinel: cache/mirror-vs-hub divergence detection + targeted
+repair (backend/cache/debugger/comparer.go promoted from a SIGUSR2 debug
+hook to a periodic maintenance-loop sentinel, ISSUE 3 tentpole layer 4).
+"""
+
+import pytest
+
+from kubernetes_tpu.backend.cache import Cache
+from kubernetes_tpu.config.types import default_config
+from kubernetes_tpu.hub import Hub
+from kubernetes_tpu.ops.features import Capacities
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+def _bound_pod(name: str, node: str):
+    pod = MakePod().name(name).req(cpu="100m").obj()
+    pod.spec.node_name = node
+    return pod
+
+
+def test_drift_report_structured_and_rendered():
+    """drift_report finds every divergence class; compare_with_hub stays
+    the human-readable rendering of the same findings."""
+    hub = Hub()
+    cache = Cache()
+    for i in range(3):
+        node = MakeNode().name(f"n-{i}").capacity(cpu="8").obj()
+        hub.create_node(node)
+        if i < 2:
+            cache.add_node(node)          # n-2 missing from the cache
+    ghost = MakeNode().name("ghost").obj()
+    cache.add_node(ghost)                 # stale: cache-only node
+    p_ok = _bound_pod("ok", "n-0")
+    hub.create_pod(p_ok)
+    cache.add_pod(p_ok)
+    p_missing = _bound_pod("missing", "n-1")
+    hub.create_pod(p_missing)             # bound in hub, absent in cache
+    p_stale = _bound_pod("stale", "n-0")
+    cache.add_pod(p_stale)                # cached, never bound in hub
+    p_moved = _bound_pod("moved", "n-1")
+    hub.create_pod(p_moved)
+    cached_moved = p_moved.clone()
+    cached_moved.spec.node_name = "n-0"
+    cache.add_pod(cached_moved)           # node mismatch
+    report = cache.drift_report(hub)
+    assert report.nodes_stale == ["ghost"]
+    assert [n.metadata.name for n in report.nodes_missing] == ["n-2"]
+    assert [p.metadata.name for p in report.pods_stale] == ["stale"]
+    assert [p.metadata.name for p in report.pods_missing] == ["missing"]
+    assert [(c.metadata.name, p.spec.node_name)
+            for c, p in report.pods_misplaced] == [("moved", "n-1")]
+    assert report.count() == 5
+    assert sorted(report.render()) == sorted(cache.compare_with_hub(hub))
+
+
+def test_targeted_repair_converges_without_rebuild():
+    """repair_from_hub fixes exactly the drifted entries; a second
+    report is clean and the repair count matches the findings."""
+    hub = Hub()
+    cache = Cache()
+    node = MakeNode().name("n-0").capacity(cpu="8").obj()
+    hub.create_node(node)
+    cache.add_node(node)
+    cache.add_node(MakeNode().name("ghost").obj())
+    p = _bound_pod("p", "n-0")
+    hub.create_pod(p)                     # missing from cache
+    stale = _bound_pod("stale", "n-0")
+    cache.add_pod(stale)
+    report = cache.drift_report(hub)
+    assert report.count() == 3
+    assert cache.repair_from_hub(hub, report) == 3
+    assert cache.drift_report(hub).count() == 0
+    assert cache.compare_with_hub(hub) == []
+    # assumed pods are optimistic writes, never "repaired" away
+    ghost = MakePod().name("assumed").req(cpu="100m").obj()
+    ghost.spec.node_name = "n-0"
+    cache.assume_pod(ghost)
+    assert cache.drift_report(hub).count() == 0
+    assert cache.repair_from_hub(hub) == 0
+    assert cache.assumed_pod_count() == 1
+
+
+def test_sentinel_repairs_corruption_within_one_period():
+    """Acceptance: an artificially corrupted cache entry is detected and
+    repaired within ONE maintenance period, by targeted re-sync (no
+    relist, no rebuild), with the drift metrics advancing."""
+    clock = [1000.0]
+    hub = Hub()
+    hub.create_node(MakeNode().name("n").capacity(cpu="8").obj())
+    cfg = default_config()
+    cfg.async_binding = False
+    sched = Scheduler(hub, cfg, caps=Capacities(nodes=8, pods=64),
+                      now=lambda: clock[0])
+    try:
+        pod = MakePod().name("p").req(cpu="100m").obj()
+        hub.create_pod(pod)
+        sched.run_until_idle()
+        stored = hub.get_pod(pod.metadata.uid)
+        assert stored.spec.node_name == "n"
+        # corrupt the cache: drop the confirmed placement
+        sched.cache.remove_pod(stored)
+        assert sched.cache.compare_with_hub(hub) != []
+        clock[0] += sched.drift_check_interval + 1.0
+        sched.run_maintenance()           # ONE period later: sentinel runs
+        assert sched.cache.compare_with_hub(hub) == []
+        assert sched.metrics.drift_detected.value() == 1
+        assert sched.metrics.drift_repaired.value() == 1
+        assert sched.metrics.drift_rebuilds.value() == 0
+        assert sched.stats["drift_repairs"] == 1
+        # clean period: strikes reset, nothing repaired
+        clock[0] += sched.drift_check_interval + 1.0
+        sched.run_maintenance()
+        assert sched.metrics.drift_repaired.value() == 1
+        assert sched._drift_strikes == 0
+    finally:
+        sched.close()
+
+
+def test_sentinel_escalates_to_full_rebuild(monkeypatch):
+    """Targeted repair that cannot converge (mirror corrupt in ways the
+    host diff can't see) escalates to the mirror/snapshot rebuild after
+    three strikes."""
+    clock = [1000.0]
+    hub = Hub()
+    hub.create_node(MakeNode().name("n").capacity(cpu="8").obj())
+    sched = Scheduler(hub, default_config(),
+                      caps=Capacities(nodes=8, pods=64),
+                      now=lambda: clock[0])
+    try:
+        monkeypatch.setattr(
+            sched.cache, "drift_report",
+            lambda _hub: type("R", (), {
+                "count": lambda self: 1,
+                "render": lambda self: ["synthetic drift"]})())
+        monkeypatch.setattr(sched.cache, "repair_from_hub",
+                            lambda _hub, _r: 0)
+        old_mirror = sched.mirror
+        for i in range(3):
+            clock[0] += sched.drift_check_interval + 1.0
+            sched.run_maintenance()
+        assert sched.metrics.drift_rebuilds.value() == 1
+        assert sched.mirror is not old_mirror, "last resort rebuilds"
+        assert sched._drift_strikes == 0
+    finally:
+        sched.close()
+
+
+def test_sentinel_skipped_while_degraded():
+    """Everything looks drifted during an outage; the sentinel must not
+    'repair' phantom divergence while the hub is unreachable."""
+    from kubernetes_tpu.chaos import ChaosHub
+
+    clock = [1000.0]
+    hub = Hub()
+    chub = ChaosHub(hub)
+    chub.create_node(MakeNode().name("n").capacity(cpu="8").obj())
+    sched = Scheduler(chub, default_config(),
+                      caps=Capacities(nodes=8, pods=64),
+                      now=lambda: clock[0])
+    try:
+        chub.partition_for(3600.0)
+        sched._hub_down = True
+        clock[0] += sched.drift_check_interval + 1.0
+        sched.run_maintenance()
+        assert sched.metrics.drift_detected.value() == 0
+    finally:
+        sched.close()
+
+
+def test_drift_check_interval_zero_disables():
+    hub = Hub()
+    sched = Scheduler(hub, default_config(),
+                      caps=Capacities(nodes=8, pods=64))
+    try:
+        sched.drift_check_interval = 0.0
+        sched.run_maintenance()
+        assert sched.metrics.drift_detected.value() == 0
+    finally:
+        sched.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -53,6 +53,68 @@ def test_leader_election_acquire_renew_takeover():
     assert lease.lease_transitions == 1
 
 
+def test_fencing_epoch_monotonic_per_acquisition():
+    """The store stamps a fresh epoch on every ACQUISITION (vacant ->
+    holder, steal), never on renewals; electors track their newest
+    acquisition's epoch (the fencing token for hub writes)."""
+    hub = Hub()
+    clock = Clock()
+    a = LeaderElector(hub.leases, "a", now=clock.now)
+    b = LeaderElector(hub.leases, "b", now=clock.now)
+    assert a.try_acquire_or_renew()
+    assert a.epoch == 1
+    clock.t += 5
+    assert a.try_acquire_or_renew()            # renewal: same epoch
+    assert a.epoch == 1
+    assert hub.leases.epoch_of("kube-scheduler") == 1
+    clock.t += 16                              # a expires; b steals
+    assert b.try_acquire_or_renew()
+    assert b.epoch == 2
+    assert a.epoch == 1, "deposed holder keeps its old token"
+    assert hub.leases.epoch_of("kube-scheduler") == 2
+    b.release()
+    assert a.try_acquire_or_renew()            # re-acquire after vacancy
+    assert a.epoch == 3
+
+
+def test_hub_rejects_fenced_writes():
+    """Hub.bind / patch_pod_condition from a deposed epoch raise Fenced;
+    the current epoch's writes land (satellite: fenced binds)."""
+    import pytest as _pytest
+
+    from kubernetes_tpu.api.objects import PodCondition
+    from kubernetes_tpu.hub import Conflict, Fenced
+    from kubernetes_tpu.testing import MakeNode, MakePod
+
+    hub = Hub()
+    clock = Clock()
+    a = LeaderElector(hub.leases, "a", now=clock.now)
+    b = LeaderElector(hub.leases, "b", now=clock.now)
+    hub.create_node(MakeNode().name("n").obj())
+    pod = MakePod().name("p").req(cpu="100m").obj()
+    hub.create_pod(pod)
+    assert a.try_acquire_or_renew()
+    clock.t += 16
+    assert b.try_acquire_or_renew()            # b deposes a
+    with _pytest.raises(Fenced):
+        hub.bind(pod, "n", a.epoch, a.lease_name)
+    assert hub.get_pod(pod.metadata.uid).spec.node_name == "", \
+        "a fenced bind must not land"
+    with _pytest.raises(Fenced):
+        hub.patch_pod_condition(pod, PodCondition(
+            type="PodScheduled", status="False", reason="x"),
+            None, a.epoch, a.lease_name)
+    hub.bind(pod, "n", b.epoch, b.lease_name)  # the new leader binds
+    assert hub.get_pod(pod.metadata.uid).spec.node_name == "n"
+    with _pytest.raises(Conflict):
+        hub.bind(pod, "n", b.epoch, b.lease_name)   # bind-once holds
+    # unfenced callers (no elector) are untouched
+    pod2 = MakePod().name("p2").req(cpu="100m").obj()
+    hub.create_pod(pod2)
+    hub.bind(pod2, "n")
+    assert hub.get_pod(pod2.metadata.uid).spec.node_name == "n"
+
+
 def test_leader_election_release():
     hub = Hub()
     clock = Clock()
