@@ -1,8 +1,9 @@
-"""Headline benchmark: production-path scheduling throughput, 22 workloads.
+"""Headline benchmark: production-path scheduling throughput, 25 workloads.
 
 Drives EVERY thresholded reference scheduler_perf workload (BASELINE.md's
 full table: the 5 BASELINE.json headliners plus the affinity, spreading,
-churn, gated, daemonset, unschedulable and DRA shapes) through the
+churn, gated, daemonset, unschedulable, DRA and feature-gate-variant
+shapes) through the
 PRODUCTION Scheduler loop — pods created via
 hub.create_pod, popped from the PriorityQueue, packed into the HBM mirror,
 scheduled by the fused device pipeline, committed through the framework's
@@ -121,7 +122,135 @@ BENCH_WORKLOAD_FNS = (
     "gated_pods_with_pod_affinity",
     "preferred_topology_spreading",
     "scheduling_with_node_inclusion_policy",
+    "scheduling_basic_qhints",
+    "preemption_async_enabled",
+    "ns_selector_preferred_anti_affinity",
 )
+
+# the ROADMAP's sub-10x offenders, profiled with the flight recorder's
+# per-phase attribution by --profile (mirrors workloads.PROFILE_WORKLOADS
+# by name; tests/test_perf_harness.py asserts the two stay in sync)
+PROFILE_WORKLOAD_FNS = (
+    "scheduling_daemonset",
+    "mixed_churn",
+    "dra_steady_state_templates",
+)
+
+# the always-on recorder's cost ceiling: what makes "every cycle, every
+# phase" viable instead of sampling-on-slow
+TRACE_OVERHEAD_BUDGET = 0.02   # <2% p50 cycle time
+
+
+def run_profile(smoke: bool = False) -> dict:
+    """--profile: run the sub-10x offender workloads with the flight
+    recorder's breakdown in each subprocess result, print a per-phase
+    p50/p99 table (incl. host-plugin and DRA-allocator time) to stderr
+    and the artifact JSON line to stdout."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _repo + os.pathsep + env.get("PYTHONPATH", "")
+    scale = "0.02" if smoke else "1.0"
+    out = {}
+    for fn in PROFILE_WORKLOAD_FNS:
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "kubernetes_tpu.perf.run_one", fn,
+                 "--scale", scale, "--profile"],
+                capture_output=True, text=True, timeout=1800, env=env,
+                cwd=_repo)
+        except subprocess.TimeoutExpired:
+            print(f"{fn}: TIMEOUT after 1800s", file=sys.stderr)
+            continue
+        if proc.returncode != 0:
+            print(f"{fn}: FAILED\n{proc.stderr[-2000:]}", file=sys.stderr)
+            continue
+        r = json.loads(proc.stdout.strip().splitlines()[-1])
+        fl = r.get("flight", {})
+        out[r["name"]] = {
+            "name": r["name"],
+            "pods_per_sec": r.get("pods_per_sec"),
+            "threshold": r.get("threshold"),
+            "flight": fl,
+        }
+        print(f"\n{r['name']}: {r.get('pods_per_sec', 0):.1f} pods/s — "
+              f"host-tail share {fl.get('host_tail_share', 0):.1%}, "
+              f"{fl.get('cycles_recorded', 0)} cycles recorded",
+              file=sys.stderr)
+        print(f"  {'phase':<18} {'p50_ms':>9} {'p99_ms':>9} "
+              f"{'count':>7} {'total_s':>9}", file=sys.stderr)
+        for phase, p in sorted(fl.get("phases", {}).items(),
+                               key=lambda kv: -kv[1]["total_s"]):
+            print(f"  {phase:<18} {p['p50_ms']:>9.3f} {p['p99_ms']:>9.3f} "
+                  f"{p['count']:>7} {p['total_s']:>9.3f}", file=sys.stderr)
+        plugins = sorted(fl.get("plugins", {}).items(),
+                         key=lambda kv: -kv[1]["total_s"])[:8]
+        if plugins:
+            print(f"  {'plugin/point':<34} {'p50_ms':>9} {'p99_ms':>9} "
+                  f"{'total_s':>9}", file=sys.stderr)
+            for key, p in plugins:
+                print(f"  {key:<34} {p['p50_ms']:>9.3f} "
+                      f"{p['p99_ms']:>9.3f} {p['total_s']:>9.3f}",
+                      file=sys.stderr)
+    return {
+        "metric": "phase_profile",
+        "unit": "ms",
+        "workloads": out,
+    }
+
+
+def trace_overhead_smoke(pairs: int = 4) -> dict:
+    """--trace-overhead: the always-on recorder's bar — <2% p50
+    cycle-time cost. One process (shared compile cache), a fixed-seed
+    shrunk SchedulingBasic, alternating recorder-off/on runs, EXACT raw
+    per-cycle durations pooled per arm (the histogram's power-of-2
+    buckets would quantize a 2% delta away), medians compared."""
+    from kubernetes_tpu.utils import jaxsetup
+
+    jaxsetup.setup(os.path.join(_repo, ".jax_cache"))
+    from kubernetes_tpu.config.types import default_config
+    from kubernetes_tpu.perf.harness import run_workload
+    from kubernetes_tpu.perf.workloads import scheduling_basic
+
+    def make():
+        # ~16 pods/cycle x ~16 cycles per run: enough samples per arm
+        # for a stable median without a minutes-long smoke
+        w = scheduling_basic(init_nodes=32, init_pods=16,
+                             measure_pods=240)
+        w.node_capacity = 64
+        w.pod_capacity = 512
+        w.batch_size = 16
+        return w
+
+    def cfg(recorder_on: bool):
+        c = default_config()
+        if not recorder_on:
+            c.flight_recorder_capacity = 0
+        return c
+
+    run_workload(make(), scale=0.1, config=cfg(True))   # compile pass
+    arms: dict[bool, list[float]] = {False: [], True: []}
+    for _ in range(pairs):
+        for on in (False, True):    # alternate so drift hits both arms
+            times: list[float] = []
+            run_workload(make(), config=cfg(on), cycle_times=times)
+            arms[on].extend(times)
+
+    def p50(xs: list[float]) -> float:
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    off_p50, on_p50 = p50(arms[False]), p50(arms[True])
+    # 100us absolute floor: on a loaded CI box two sub-5ms medians can
+    # sit 2% apart from scheduler-unrelated jitter alone
+    ok = on_p50 <= off_p50 * (1.0 + TRACE_OVERHEAD_BUDGET) + 100e-6
+    return {
+        "metric": "trace_overhead",
+        "cycle_p50_off_ms": round(off_p50 * 1e3, 3),
+        "cycle_p50_on_ms": round(on_p50 * 1e3, 3),
+        "delta_pct": round((on_p50 - off_p50) / off_p50 * 100.0, 2),
+        "budget_pct": TRACE_OVERHEAD_BUDGET * 100.0,
+        "cycles_per_arm": len(arms[True]),
+        "ok": ok,
+    }
 
 
 def main() -> None:
@@ -130,6 +259,24 @@ def main() -> None:
         # must be the committed artifact's, mechanically
         ok = readme_check(write="--readme-update" in sys.argv)
         sys.exit(0 if ok else 1)
+    if "--profile" in sys.argv:
+        # per-phase attribution for the sub-10x offenders: the BENCH
+        # artifact row the next VERDICT reads instead of guessing where
+        # Daemonset/MixedChurn/DRA host time goes
+        print(json.dumps(run_profile(smoke="--smoke" in sys.argv)))
+        return
+    if "--trace-overhead" in sys.argv:
+        # red-suite gate next to --chaos-smoke: the always-on recorder
+        # must stay under its <2% p50 cycle-time budget
+        r = trace_overhead_smoke()
+        print(json.dumps(r))
+        if not r["ok"]:
+            print(f"trace overhead over budget: recorder-on p50 "
+                  f"{r['cycle_p50_on_ms']}ms vs off "
+                  f"{r['cycle_p50_off_ms']}ms "
+                  f"({r['delta_pct']:+.2f}% > {r['budget_pct']:.0f}%)",
+                  file=sys.stderr)
+        sys.exit(0 if r["ok"] else 1)
     if "--chaos-smoke" in sys.argv:
         # red-suite gate: the full storm battery — the smoke scenario
         # (call faults + watch cut + partition through the proxy), the
@@ -151,7 +298,16 @@ def main() -> None:
         if proc.returncode != 0:
             print(f"chaos smoke FAILED\n{proc.stderr[-2000:]}",
                   file=sys.stderr)
-        sys.exit(proc.returncode)
+            sys.exit(proc.returncode)
+        # the trace-overhead gate rides along: one red-suite invocation
+        # covers both "survives storms" and "the always-on recorder
+        # stays under its <2% budget"
+        r = trace_overhead_smoke()
+        print(json.dumps(r))
+        if not r["ok"]:
+            print("trace overhead over budget (see --trace-overhead)",
+                  file=sys.stderr)
+        sys.exit(0 if r["ok"] else 1)
     smoke = "--smoke" in sys.argv
     scale = "0.02" if smoke else "1.0"
     results = {}

@@ -38,6 +38,10 @@ def main(argv=None) -> int:
     parser.add_argument("--journal-capacity", type=int, default=16384,
                         help="event-journal ring capacity per resource "
                              "kind (the watch-resume window)")
+    parser.add_argument("--trace-export", default=None,
+                        help="append each scheduling cycle's flight-"
+                             "recorder trace as a JSON line to this file "
+                             "(offline phase analysis)")
     parser.add_argument("--leader-elect", action="store_true")
     parser.add_argument("--leader-elect-lease-duration", type=float,
                         default=15.0)
@@ -61,6 +65,8 @@ def main(argv=None) -> int:
     from kubernetes_tpu.scheduler import Scheduler
 
     cfg = load_config(args.config) if args.config else default_config()
+    if args.trace_export:
+        cfg.trace_export_path = args.trace_export
     for part in filter(None, args.feature_gates.split(",")):
         name, _, val = part.partition("=")
         cfg.feature_gates[name.strip()] = val.strip().lower() in (

@@ -104,6 +104,12 @@ class SchedulerConfiguration:
     batch_size: int = 256       # pods scored per XLA launch
     node_capacity: int = 1024   # initial mirror bucket (grows by pow2)
     pod_table_capacity: int = 4096
+    # flight recorder (always-on per-phase cycle tracing): ring size in
+    # cycles; 0 disables the recorder entirely (not recommended — the
+    # overhead budget is <2% of cycle time, see bench.py --trace-overhead)
+    flight_recorder_capacity: int = 256
+    # append each cycle trace as a JSON line here (offline analysis)
+    trace_export_path: Optional[str] = None
 
     def gate(self, name: str, default: bool = True) -> bool:
         return self.feature_gates.get(name, default)

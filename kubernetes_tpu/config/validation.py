@@ -70,6 +70,8 @@ def validate_config(cfg: SchedulerConfiguration,
         errs.append("binding_workers must be positive")
     if cfg.node_capacity <= 0 or cfg.pod_table_capacity <= 0:
         errs.append("mirror capacities must be positive")
+    if cfg.flight_recorder_capacity < 0:
+        errs.append("flight_recorder_capacity must be >= 0 (0 disables)")
     from kubernetes_tpu.config.types import KNOWN_FEATURE_GATES
 
     for gate in cfg.feature_gates:

@@ -510,6 +510,12 @@ class Evaluator:
         self.preempting.add(pod.metadata.uid)
         self._pending.append((candidate, pod))
 
+    def has_pending(self) -> bool:
+        """Whether flush_evictions has queued work (evictions or deferred
+        nomination clears) — the scheduler's cue to time the flush as an
+        eviction_flush phase instead of skipping the empty no-op."""
+        return bool(self._pending or self._pending_clears)
+
     def flush_evictions(self) -> int:
         """Execute queued evictions; returns the number of preparations
         run. The preemptor leaves ``preempting`` BEFORE the last victim

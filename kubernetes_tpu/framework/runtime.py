@@ -128,6 +128,13 @@ class Framework:
         from kubernetes_tpu.framework.waiting import WaitingPodsMap
 
         self.waiting_pods = WaitingPodsMap()
+        # flight-recorder seam: the scheduler installs a
+        # (plugin_name, extension_point, secs) callback here to get
+        # per-plugin timing (the reference's
+        # plugin_execution_duration_seconds). None = no timing reads at
+        # all. Called only from loop-thread runners (host filters/
+        # scores/reserve) — binder-thread points stay uninstrumented.
+        self.plugin_timer = None
         self._instances: dict[str, object] = {}
         for point, entries in self.points.items():
             for name, _ in entries:
@@ -249,16 +256,23 @@ class Framework:
         An early status (a PreFilter rejecting outright) means the pod is
         unschedulable everywhere; the caller masks every node and attributes
         the failure to the returned plugin."""
+        import time as _time
+
         plugins = self.__dict__.get("_host_filter_list")
         if plugins is None:
             plugins = self._host_filter_list = list(
                 self._iter("filter", FilterPlugin))
         if not plugins:
             return None, {}, None
+        timer = self.plugin_timer
         active = []
         for pl in plugins:
             if isinstance(pl, PreFilterPlugin):
+                t0 = _time.perf_counter() if timer else 0.0
                 s = pl.pre_filter(state, pod, node_infos)
+                if timer is not None:
+                    timer(pl.name(), "PreFilter",
+                          _time.perf_counter() - t0)
                 if s.is_skip():
                     continue
                 if not s.is_success():
@@ -269,14 +283,34 @@ class Framework:
             return None, {}, None
         mask = [True] * len(node_infos)
         counts: dict[str, int] = {}
+        if timer is None:
+            for i, ni in enumerate(node_infos):
+                for pl in active:
+                    s = pl.filter(state, pod, ni)
+                    if not s.is_success():
+                        mask[i] = False
+                        name = s.plugin or pl.name()
+                        counts[name] = counts.get(name, 0) + 1
+                        break       # first-fail attribution, like the device
+            return mask, counts, None
+        # timed variant: accumulate per-plugin across the node loop and
+        # flush ONE observation per plugin (a per-(node, plugin) observe
+        # would be histogram walks in the hot loop; the perf_counter
+        # pair per call is noise next to the Python plugin call itself)
+        acc = [0.0] * len(active)
         for i, ni in enumerate(node_infos):
-            for pl in active:
+            for j, pl in enumerate(active):
+                t0 = _time.perf_counter()
                 s = pl.filter(state, pod, ni)
+                acc[j] += _time.perf_counter() - t0
                 if not s.is_success():
                     mask[i] = False
                     name = s.plugin or pl.name()
                     counts[name] = counts.get(name, 0) + 1
                     break           # first-fail attribution, like the device
+        for j, pl in enumerate(active):
+            if acc[j] > 0.0:
+                timer(pl.name(), "Filter", acc[j])
         return mask, counts, None
 
     def run_host_scores(self, state: CycleState, pod: Pod, node_infos
@@ -293,17 +327,26 @@ class Framework:
                    if not hasattr(pl, "applies") or pl.applies(pod)]
         if not entries:
             return None
+        import time as _time
+
+        timer = self.plugin_timer
         total = [0.0] * len(node_infos)
         for pl, weight in entries:
+            t0 = _time.perf_counter() if timer else 0.0
             if isinstance(pl, PreScorePlugin):
                 s = pl.pre_score(state, pod, node_infos)
                 if s.is_skip():
+                    if timer is not None:
+                        timer(pl.name(), "Score",
+                              _time.perf_counter() - t0)
                     continue
             scores = []
             for ni in node_infos:
                 val, s = pl.score(state, pod, ni)
                 scores.append(val if s.is_success() else 0.0)
             pl.normalize_scores(state, pod, scores)
+            if timer is not None:
+                timer(pl.name(), "Score", _time.perf_counter() - t0)
             w = weight or 1.0
             for i, v in enumerate(scores):
                 total[i] += w * v
@@ -356,8 +399,19 @@ class Framework:
 
     def run_reserve_plugins(self, state: CycleState, pod: Pod,
                             node_name: str) -> Status:
+        timer = self.plugin_timer
+        if timer is None:
+            for pl in self._iter("reserve", ReservePlugin):
+                s = pl.reserve(state, pod, node_name)
+                if not s.is_success():
+                    return s
+            return Status()
+        import time as _time
+
         for pl in self._iter("reserve", ReservePlugin):
+            t0 = _time.perf_counter()
             s = pl.reserve(state, pod, node_name)
+            timer(pl.name(), "Reserve", _time.perf_counter() - t0)
             if not s.is_success():
                 return s
         return Status()

@@ -17,6 +17,10 @@ from typing import Callable, Optional
 
 # k8s histogram buckets: exponential 0.001s..~16s (metrics.go power-of-2)
 DURATION_BUCKETS = tuple(0.001 * (2 ** i) for i in range(15))
+# flight-recorder phases and per-plugin timings live in the 10us..10s
+# range (a host dict probe is microseconds, a DRA allocation
+# milliseconds) — finer low end than the reference's 1ms floor
+FINE_DURATION_BUCKETS = tuple(0.00001 * (2 ** i) for i in range(21))
 ATTEMPTS_BUCKETS = (1, 2, 4, 8, 16)
 VICTIMS_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 
@@ -149,7 +153,7 @@ class Registry:
         out = []
         for name, m in self._metrics.items():
             if m.help:
-                out.append(f"# HELP {name} {m.help}")
+                out.append(f"# HELP {name} {_escape_help(m.help)}")
             if isinstance(m, Counter):
                 out.append(f"# TYPE {name} counter")
                 for k, v in m._values.items():
@@ -174,10 +178,25 @@ class Registry:
         return "\n".join(out) + "\n"
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus exposition-format label escaping: backslash, double
+    quote and line feed must be escaped inside label values (the spec's
+    only three escapes) — a plugin name or failure message containing
+    any of them would otherwise emit unparseable exposition text."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    """HELP lines escape backslash and line feed (not double quote)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_labels(labels: dict[str, str]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
     return "{" + inner + "}"
 
 
@@ -208,6 +227,24 @@ class SchedulerMetrics:
         self.pod_scheduling_attempts = r.register(Histogram(
             "pod_scheduling_attempts",
             "Attempts needed to schedule a pod", ATTEMPTS_BUCKETS))
+        # flight recorder: per-phase cycle attribution + per-plugin
+        # timing + the reference's e2e pod scheduling latency
+        # (metrics.go pod_scheduling_duration_seconds /
+        # plugin_execution_duration_seconds, never reproduced until now)
+        self.phase_duration = r.register(Histogram(
+            "scheduling_phase_duration_seconds",
+            "Per-phase scheduling cycle latency from the always-on "
+            "flight recorder", FINE_DURATION_BUCKETS, ("phase",)))
+        self.plugin_duration = r.register(Histogram(
+            "plugin_execution_duration_seconds",
+            "Per-plugin execution latency by extension point (host "
+            "plugins; device plugins are fused into one launch)",
+            FINE_DURATION_BUCKETS, ("plugin", "extension_point")))
+        self.pod_e2e_duration = r.register(Histogram(
+            "pod_scheduling_duration_seconds",
+            "E2e latency from a pod's first scheduling attempt to its "
+            "successful bind, by attempts needed",
+            DURATION_BUCKETS, ("attempts",)))
         self.preemption_attempts = r.register(Counter(
             "preemption_attempts_total", "Preemption attempts"))
         self.preemption_victims = r.register(Histogram(

@@ -187,7 +187,8 @@ class WorkloadStuck(Exception):
 def run_workload(w: Workload, now: Callable[[], float] = time.time,
                  sleep: Callable[[float], None] = time.sleep,
                  scale: float = 1.0,
-                 config=None) -> dict:
+                 config=None, profile: bool = False,
+                 cycle_times: Optional[list] = None) -> dict:
     """Execute one workload; returns the result dict (throughput summary,
     threshold verdict, scheduler stats).
 
@@ -195,6 +196,12 @@ def run_workload(w: Workload, now: Callable[[], float] = time.time,
     tests) while keeping capacities — and therefore every jitted program
     shape — identical to the full-size run, so a scale=0.01 pass populates
     the XLA compile cache for the real one.
+
+    ``profile`` adds the flight recorder's per-phase/per-plugin
+    percentiles and host-tail share to the result (bench.py --profile).
+    ``cycle_times`` (a caller-owned list) collects every RAW cycle
+    duration in seconds — exact samples, not bucket-resolution histogram
+    reads — for the --trace-overhead on/off comparison.
     """
     hub = Hub()
     if w.dra_claim_controller:
@@ -206,6 +213,16 @@ def run_workload(w: Workload, now: Callable[[], float] = time.time,
     cfg.feature_gates.update(w.feature_gates)
     sched = Scheduler(hub, cfg, caps=Capacities(
         nodes=w.node_capacity, pods=w.pod_capacity), now=now)
+    if cycle_times is not None:
+        # exact per-cycle samples: wrap the cycle histogram's observe so
+        # every recorded duration also lands in the caller's list
+        _obs = sched.metrics.batch_duration.observe
+
+        def _capture(value: float, n: int = 1, **labels) -> None:
+            cycle_times.append(value)
+            _obs(value, n, **labels)
+
+        sched.metrics.batch_duration.observe = _capture
     churns: list[_ChurnState] = []
     summary = None
     phases: list[dict] = []
@@ -316,6 +333,15 @@ def run_workload(w: Workload, now: Callable[[], float] = time.time,
                 m.schedule_attempts._values.values())),
         },
     }
+    if profile:
+        fl = sched.flight
+        result["flight"] = {
+            "enabled": fl.enabled,
+            "cycles_recorded": len(fl.ring),
+            "phases": fl.phase_percentiles(),
+            "plugins": fl.plugin_percentiles(),
+            "host_tail_share": round(fl.host_tail_share(), 4),
+        }
     if summary is not None:
         result.update(summary.to_dict())
         result["vs_baseline"] = (

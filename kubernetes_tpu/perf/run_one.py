@@ -1,6 +1,12 @@
 """Run ONE perf workload in a fresh process and print its result as JSON.
 
-`python -m kubernetes_tpu.perf.run_one <workload_fn> [--scale X]`
+`python -m kubernetes_tpu.perf.run_one <workload_fn> [--scale X]
+ [--profile] [--recorder off]`
+
+--profile includes the flight recorder's per-phase/per-plugin breakdown
+in the JSON result (bench.py --profile consumes it); --recorder off
+disables the always-on recorder (flight_recorder_capacity=0) for the
+--trace-overhead on/off comparison.
 
 The bench driver (bench.py) shells out here per workload — the same
 isolation the reference harness gets from one integration-test process
@@ -34,11 +40,25 @@ def main() -> None:
     from kubernetes_tpu.perf.harness import run_workload
 
     factory = getattr(W, name)
+    profile = "--profile" in sys.argv
+    config = None
+    if "--recorder" in sys.argv:
+        idx = sys.argv.index("--recorder")
+        mode = sys.argv[idx + 1] if idx + 1 < len(sys.argv) else ""
+        if mode not in ("on", "off"):
+            sys.exit("--recorder expects 'on' or 'off'")
+        if mode == "off":
+            from kubernetes_tpu.config.types import default_config
+
+            config = default_config()
+            config.flight_recorder_capacity = 0
     t0 = time.time()
-    run_workload(factory(), scale=0.005)   # compile pass, same shapes
+    run_workload(factory(), scale=0.005,   # compile pass, same shapes
+                 config=config)
     t_warm = time.time() - t0
     t0 = time.time()
-    r = run_workload(factory(), scale=scale)
+    r = run_workload(factory(), scale=scale, config=config,
+                     profile=profile)
     r["warm_s"] = round(t_warm, 1)
     r["run_s"] = round(time.time() - t0, 1)
     print(json.dumps(r))

@@ -5,9 +5,11 @@ Every thresholded row of BASELINE.md is implemented — the 5 BASELINE.json
 headliners plus the affinity suite (required/preferred, NSSelector
 variants, MixedSchedulingBasePod, gated-with-affinity), the topology
 suite (required/preferred spreading, node-inclusion policy), churn,
-daemonset, gated, unschedulable (hints on/off), and DRA steady state
-(direct claims + claim templates with CEL selectors) — 22 configs, all
-run and published by bench.py.
+daemonset, gated, unschedulable (hints on/off), DRA steady state
+(direct claims + claim templates with CEL selectors), and the
+feature-gate variants (QueueingHints, AsyncPreemption, preferred
+NSSelector anti-affinity) — 25 configs, all run and published by
+bench.py.
 
 Node template (node-default.yaml): cpu 4, memory 32Gi, pods 110.
 Pod template (pod-default.yaml): requests cpu 100m, memory 500Mi.
@@ -881,11 +883,81 @@ def unschedulable_qhints(init_nodes=5000, init_pods=100,
     return w
 
 
+# ------------------------------ 22. SchedulingBasic (QHints enabled)
+# misc/performance-config.yaml:72 (270): the headline shape with
+# SchedulerQueueingHints pinned on — its own thresholded reference row
+# (the gate defaults on here, but the variant is measured separately so
+# a hints regression shows up against its own floor).
+
+def scheduling_basic_qhints(init_nodes=5000, init_pods=1000,
+                            measure_pods=10000) -> Workload:
+    w = scheduling_basic(init_nodes, init_pods, measure_pods)
+    w.name = "SchedulingBasic/5000Nodes_10000Pods_QueueingHintsEnabled"
+    w.threshold = w.baseline = 270
+    w.feature_gates = {"SchedulerQueueingHints": True}
+    return w
+
+
+# ------------------------------ 23. PreemptionAsync (async enabled)
+# misc/performance-config.yaml:247 (160): the preemption shape with
+# SchedulerAsyncPreemption pinned on — victims are evicted between
+# cycles (kep 4832) instead of inside the failure handler.
+
+def preemption_async_enabled(init_nodes=5000, init_pods=20000,
+                             measure_pods=5000) -> Workload:
+    w = preemption_async(init_nodes, init_pods, measure_pods)
+    w.name = "PreemptionAsync/5000Nodes_AsyncPreemptionEnabled"
+    w.feature_gates = {"SchedulerAsyncPreemption": True}
+    return w
+
+
+# ------------------ 24. PreferredAntiAffinityWithNSSelector
+# affinity/performance-config.yaml:488-557 (5000Nodes_2000Pods, 55):
+# the namespace-selector layout with a weight-1 PREFERRED hostname
+# ANTI-affinity term — soft avoidance Score work over
+# namespace-unrolled terms.
+
+def _ns_selector_pref_anti_pod(i: int, ns: str) -> Pod:
+    term = WeightedPodAffinityTerm(weight=1, pod_affinity_term=(
+        PodAffinityTerm(
+            topology_key=LABEL_HOSTNAME,
+            label_selector=LabelSelector(match_labels={"color": "teal"}),
+            namespace_selector=LabelSelector(
+                match_labels={"team": "sched"}))))
+    aff = Affinity(pod_anti_affinity=PodAntiAffinity(preferred=[term]))
+    return _pod(f"nspanti-{ns}-{i}", namespace=ns,
+                labels={"color": "teal"}, affinity=aff)
+
+
+def ns_selector_preferred_anti_affinity(init_nodes=5000, init_pods=1000,
+                                        measure_pods=2000,
+                                        namespaces=10) -> Workload:
+    return Workload(
+        name="SchedulingPreferredAntiAffinityWithNSSelector"
+             "/5000Nodes_2000Pods",
+        threshold=55,
+        pod_capacity=32768,
+        warm_full_nodes=True,   # hostname topology: domains = nodes
+        ops=[
+            CreateNodes(init_nodes, _node),
+            CreateNamespaces("team", namespaces,
+                             labels=lambda i: {"team": "sched"}),
+            CreatePods(init_pods,
+                       lambda i: _ns_selector_pref_anti_pod(
+                           i, f"team-{i % namespaces}")),
+            CreatePods(measure_pods,
+                       lambda i: _ns_selector_pref_anti_pod(
+                           i + 10**6, f"team-{i % namespaces}"),
+                       collect_metrics=True),
+        ])
+
+
 # every thresholded reference workload — bench.py runs the whole list,
 # one subprocess each, and publishes every row in its JSON (bench.py
 # mirrors these BY NAME in BENCH_WORKLOAD_FNS —
 # tests/test_perf_harness.py asserts the two stay in sync). The first
-# five are the BASELINE.json headline configs.
+# five are the BASELINE.json headline configs; the last three are the
+# VERDICT r05 "still unmeasured" thresholded variants.
 BENCH_WORKLOADS = (
     scheduling_basic,
     scheduling_node_affinity,
@@ -909,6 +981,17 @@ BENCH_WORKLOADS = (
     gated_pods_with_pod_affinity,
     preferred_topology_spreading,
     scheduling_with_node_inclusion_policy,
+    scheduling_basic_qhints,
+    preemption_async_enabled,
+    ns_selector_preferred_anti_affinity,
 )
 
 ALL_WORKLOADS = BENCH_WORKLOADS
+
+# the ROADMAP's sub-10x offenders — the `bench.py --profile` set: each
+# runs with the flight recorder's phase attribution in the artifact
+PROFILE_WORKLOADS = (
+    "scheduling_daemonset",
+    "mixed_churn",
+    "dra_steady_state_templates",
+)

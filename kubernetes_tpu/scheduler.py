@@ -53,6 +53,7 @@ from kubernetes_tpu.framework.waiting import WaitingPod
 from kubernetes_tpu.hub import EventHandlers, Fenced, Hub, Unavailable
 from kubernetes_tpu.utils.backoff import Backoff
 from kubernetes_tpu.utils.gcguard import guard as gc_guard
+from kubernetes_tpu.utils.tracing import FlightRecorder, PodTimelines
 from kubernetes_tpu.models.pipeline import (
     ADAPTIVE_PCT,
     FILTER_PLUGINS,
@@ -184,6 +185,20 @@ class Scheduler:
             now=now)
         self.metrics = SchedulerMetrics(
             pending_fn=self.queue.pending_counts)
+        # the always-on flight recorder: every cycle's fine-grained
+        # phases into a bounded ring + the phase/plugin histograms
+        # (utils/tracing.FlightRecorder); per-pod lifecycle timelines
+        # behind /debug/pod. flight_recorder_capacity=0 disables.
+        self.flight = FlightRecorder(
+            phase_hist=self.metrics.phase_duration,
+            plugin_hist=self.metrics.plugin_duration,
+            capacity=getattr(self.config, "flight_recorder_capacity", 256),
+            export_path=getattr(self.config, "trace_export_path", None))
+        self.timelines = PodTimelines(now=now)
+        self._last_pop_s = 0.0
+        if self.flight.enabled:
+            for fw in self.frameworks.values():
+                fw.plugin_timer = self.flight.plugin_observe
         # gate opener of last resort: a flush that deleted nothing (empty
         # or already-gone victim sets) fires no cluster event, so the
         # evaluator re-activates those preemptors directly
@@ -445,6 +460,8 @@ class Scheduler:
             # from status so reservations survive a scheduler restart
             if pod.status.nominated_node_name:
                 self.nominator.add(pod, pod.status.nominated_node_name)
+            if self.flight.enabled:
+                self.timelines.event(pod, "enqueued")
             self.queue.add(pod)
 
     def _on_pod_update(self, old: Pod, new: Pod) -> None:
@@ -595,10 +612,18 @@ class Scheduler:
             self.metrics.condition_patches_dropped.inc(reason="fenced")
 
     def _flush_evictions_safe(self) -> None:
+        # only a flush with queued work is a measurable phase (this runs
+        # every cycle; an empty flush is a couple of attribute reads)
+        busy = self.preemption.has_pending()
+        t0 = self.now() if busy else 0.0
         try:
             self.preemption.flush_evictions()
         except Unavailable:
             self._note_hub_down()
+        finally:
+            if busy:
+                self.flight.observe_phase("eviction_flush",
+                                          self.now() - t0)
 
     # ------------- fault containment (the self-healing ladder) -------------
     #
@@ -666,8 +691,27 @@ class Scheduler:
         affinity state)."""
         if not qps:
             return
+        # drain in-flight binds BEFORE the phase clock starts: the drain
+        # records its own binder_drain observation, and both phases are
+        # HOST_PHASES — timing it here too would double-count the wall
+        # time in host_tail_share
         try:
             self._drain_bind_results(wait=True)
+        except Unavailable:
+            self._park_batch_unreachable(qps)
+            return
+        # the fallback's serial host-path cost feeds the host_fallback
+        # phase histogram: scheduler_device_fallbacks_total says how
+        # OFTEN the ladder fired, this says what each firing COST
+        t_fb0 = self.now()
+        try:
+            self._host_fallback_batch_inner(qps)
+        finally:
+            self.flight.observe_phase("host_fallback",
+                                      self.now() - t_fb0)
+
+    def _host_fallback_batch_inner(self, qps: list[QueuedPodInfo]) -> None:
+        try:
             self.cache.update_snapshot(self.snapshot)
         except Unavailable:
             self._park_batch_unreachable(qps)
@@ -829,6 +873,10 @@ class Scheduler:
         """Unschedulable park with plugin attribution, minus PostFilter:
         preemption is a device sweep, which the fallback path must not
         re-enter (the pod retries the full path after backoff)."""
+        if self.flight.enabled:
+            self.timelines.diagnose(qp.pod, {}, qp.host_reject_counts
+                                    or {p: -1 for p in plugins}, msg)
+            self.timelines.event(qp.pod, "unschedulable", msg)
         qp.unschedulable_plugins = plugins or {"NodeResourcesFit"}
         qp.unschedulable_count += 1
         qp.consecutive_errors_count = 0
@@ -856,6 +904,9 @@ class Scheduler:
         self.stats["quarantined"] += 1
         self.metrics.quarantines.inc(reason="poison")
         self.metrics.quarantined_pods.set(float(len(self._quarantine)))
+        if self.flight.enabled:
+            self.timelines.event(qp.pod, "quarantined",
+                                 f"{backoff:.0f}s: {reason}")
         logger.error("quarantining pod %s for %.0fs (offense %d): %s",
                      qp.pod.key(), backoff, n, reason)
         try:
@@ -920,6 +971,7 @@ class Scheduler:
         (schedule_one.go:380: deleted or already assumed). Pods deferred
         from the previous batch (host-serial volume conflicts) go first —
         they are still in flight from their original pop."""
+        t_pop0 = self.now()
         deferred, self._deferred = self._deferred, []
         batch = deferred + self.queue.pop_batch(
             self.config.batch_size - len(deferred))
@@ -950,6 +1002,15 @@ class Scheduler:
                         "faults")
                 continue
             runnable.append(qp)
+        t_pop1 = self.now()
+        # consumed by _dispatch into the cycle's queue_pop phase (one
+        # shared clock read stamps the whole batch's pop events)
+        self._last_pop_s = t_pop1 - t_pop0
+        if self.flight.enabled and runnable:
+            tl = self.timelines
+            for qp in runnable:
+                tl.event(qp.pod, "popped", f"attempt {qp.attempts}",
+                         t=t_pop1)
         return len(batch), runnable
 
     def _chain_eligible(self, pods: list[Pod]) -> bool:
@@ -1001,6 +1062,12 @@ class Scheduler:
             self.fault_injector.on_pack([qp.pod for qp in runnable])
         self.stats["batches"] += 1
         self.stats["attempts"] += len(runnable)
+        # flight recorder: this cycle's trace opens here and is recorded
+        # by _finish (the dispatched tuple carries it through the
+        # pipelined drain)
+        tr = self.flight.begin(t_cycle0, len(runnable), chained)
+        tr.add("queue_pop", self._last_pop_s)
+        self._last_pop_s = 0.0
         state = self._chain if chained else None
         need_sync = not chained
         for attempt in range(16):  # one capacity field may grow per attempt
@@ -1009,11 +1076,15 @@ class Scheduler:
                     if flush_pending is not None:
                         flush_pending()
                         flush_pending = None
+                    t_sync0 = self.now()
                     self.cache.update_snapshot(self.snapshot)
                     self.mirror.sync(self.snapshot)
+                    tr.add("snapshot_sync", self.now() - t_sync0)
+                t_pack0 = self.now()
                 self.mirror.set_nominated(self.nominator.by_node())
                 spec = self.mirror.prepare_launch(
                     [qp.pod for qp in runnable], self.config.batch_size)
+                tr.add("pack", self.now() - t_pack0)
                 break
             except CapacityError as e:
                 if flush_pending is not None:
@@ -1051,13 +1122,16 @@ class Scheduler:
         host_ok = host_score = None
         if self._has_host_filters or self._has_host_scores \
                 or self._extenders:
+            t_host0 = self.now()
             host_ok, host_score = self._run_host_plugins(runnable)
+            tr.add("host_plugins", self.now() - t_host0)
         fit_strategy, fit_shape = pcfg["fit"]
         if state is None:
             # seed the usage chain from the freshly synced mirror so every
             # launch carries explicit state: one jit signature for chained
             # and unchained dispatches (see pipeline.extract_state_jit)
             state = extract_state_jit(spec.cblobs, self.caps)
+        t_disp0 = self.now()
         out: BatchResult = launch_batch(
             spec, self.mirror.well_known(), pcfg["weights"], self.caps,
             pcfg["filters"], serial_scan=not use_auction, state=state,
@@ -1078,7 +1152,9 @@ class Scheduler:
         # external events reset it via the handlers
         if epoch == self._chain_epoch:
             self._chain = (out.free, out.nzr)
-        return runnable, out, self.now(), self.now() - t_cycle0
+        t_done = self.now()
+        tr.add("device_dispatch", t_done - t_disp0)
+        return runnable, out, t_done, t_done - t_cycle0, tr
 
     def _host_relevant(self, pod: Pod) -> bool:
         if self._host_gates is None:
@@ -1241,7 +1317,10 @@ class Scheduler:
 
     def _finish(self, inflight: tuple) -> None:
         """Pull one dispatched launch's results and commit/fail each pod."""
-        runnable, out, t_dispatched, pack_s = inflight
+        runnable, out, t_dispatched, pack_s, tr = inflight
+        # re-attach the cycle's trace: the pipelined drain may have
+        # dispatched k+1 (opening its trace) before finishing k
+        self.flight.resume(tr)
         n = len(runnable)
         t0 = self.now()
         rows_arr, guard = jax.device_get((out.node_row, out.guard))
@@ -1265,16 +1344,29 @@ class Scheduler:
         fail_is = [i for i in range(n) if rows[i] < 0]
         rejects = None
         if fail_is:
+            t_pull0 = self.now()
             rejects = np.asarray(jax.device_get(out.reject_counts))
+            # the rows/guard pull above is inseparable from the device
+            # wait (folded into device_launch); this one is a pure
+            # post-compute transfer — the honest D2H measurement
+            tr.add("d2h_pull", self.now() - t_pull0)
+        t_commit0 = self.now()
         for qp, row in zip(runnable, rows):
             if row >= 0:
                 self._commit(qp, self.mirror.name_of_row(row))
+        t_commit1 = self.now()
+        tr.add("commit", t_commit1 - t_commit0)
         n_fail = len(fail_is)
         if fail_is:
             self._handle_failures([(runnable[i], rejects[i].tolist())
                                    for i in fail_is])
+            tr.add("failure_handling", self.now() - t_commit1)
         commit_s = self.now() - t1
         cycle_s = pack_s + launch_s + commit_s
+        tr.add("device_launch", launch_s)
+        tr.scheduled = n - n_fail
+        tr.failed = n_fail
+        self.flight.record(tr)
         m = self.metrics
         m.algorithm_duration.observe(launch_s)
         m.batch_duration.observe(cycle_s)
@@ -1428,6 +1520,9 @@ class Scheduler:
             self._park_unreachable(qp)
             return
         if rejected_by:
+            if self.flight.enabled:
+                self.timelines.diagnose(qp.pod, {}, {rejected_by: -1}, msg)
+                self.timelines.event(qp.pod, "unschedulable", msg)
             qp.unschedulable_plugins = {rejected_by}
             qp.unschedulable_count += 1
             qp.consecutive_errors_count = 0
@@ -1543,10 +1638,15 @@ class Scheduler:
         the binder thread's own hub events replay here, on the loop
         thread, right after each completion."""
         self._submit_bind_backlog()
+        if not self._inflight_binds:
+            return
+        t_drain0 = self.now()
+        drained = False
         still: list[tuple] = []
         for item in self._inflight_binds:
             items, fut = item
             if wait or fut.done():
+                drained = True
                 for (qp, state, assumed, node_name, _fargs), s in zip(
                         items, fut.result()):
                     self._finish_binding(qp, state, assumed, node_name, s)
@@ -1554,6 +1654,9 @@ class Scheduler:
             else:
                 still.append(item)
         self._inflight_binds = still
+        if drained:
+            self.flight.observe_phase("binder_drain",
+                                      self.now() - t_drain0)
 
     def _finish_binding(self, qp: QueuedPodInfo, state: CycleState,
                         assumed: Pod, node_name: str, s) -> None:
@@ -1576,6 +1679,16 @@ class Scheduler:
         self.metrics.schedule_attempts.inc(
             result="scheduled", profile=qp.pod.spec.scheduler_name)
         self.metrics.pod_scheduling_attempts.observe(qp.attempts)
+        if self.flight.enabled:
+            # the reference's e2e pod_scheduling_duration_seconds: first
+            # attempt -> successful bind, by attempts needed (capped so
+            # the label set stays bounded)
+            t_bind = self.now()
+            if qp.initial_attempt_timestamp is not None:
+                self.metrics.pod_e2e_duration.observe(
+                    t_bind - qp.initial_attempt_timestamp,
+                    attempts=str(min(qp.attempts, 16)))
+            self.timelines.event(qp.pod, "bound", node_name, t=t_bind)
 
     def _finish_fenced(self, qp: QueuedPodInfo, state: CycleState,
                        assumed: Pod, node_name: str) -> None:
@@ -1644,6 +1757,18 @@ class Scheduler:
             plugins = {FILTER_PLUGINS[i]
                        for i, c in enumerate(reject_counts) if c > 0}
             plugins |= set(qp.host_reject_counts)
+            if self.flight.enabled:
+                # /debug/pod diagnosis: which device filter rejected how
+                # many nodes (the already-pulled reject_counts), which
+                # host plugin rejected (host_reject_counts)
+                self.timelines.diagnose(
+                    qp.pod,
+                    {FILTER_PLUGINS[i]: int(c)
+                     for i, c in enumerate(reject_counts) if c > 0},
+                    qp.host_reject_counts,
+                    "no feasible node (device launch)")
+                self.timelines.event(qp.pod, "unschedulable",
+                                     ",".join(sorted(plugins)))
             qp.unschedulable_plugins = plugins or {"NodeResourcesFit"}
             qp.unschedulable_count += 1
             qp.consecutive_errors_count = 0
@@ -1738,6 +1863,8 @@ class Scheduler:
     def _error(self, qp: QueuedPodInfo, msg: str) -> None:
         """Error-class failure: separate backoff counter
         (types.go:394-404) so apiserver-error storms back off."""
+        if self.flight.enabled:
+            self.timelines.event(qp.pod, "error", msg)
         qp.consecutive_errors_count += 1
         qp.unschedulable_plugins = set()
         self.stats["errors"] += 1
@@ -2010,6 +2137,7 @@ class Scheduler:
             self._process_deferred_events()
             self._binder.shutdown(wait=True)
             self._binder = None
+        self.flight.close()
 
     # ------------- driving -------------
 

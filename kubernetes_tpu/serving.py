@@ -5,12 +5,22 @@ The slice of the reference's component HTTP surface the scheduler exposes
 healthz/livez/readyz + /metrics + /configz): a tiny threaded HTTP server
 over the metrics Registry and the component config.
 
-Debug endpoints (/debug/cache, /debug/queue, /debug/journal) follow the
-reference's discipline for its debugging handlers
-(server.go:248-255: installed only behind the authz filter): they are
-DENIED unless the caller passed a ``debug_auth`` callback, which
-receives the request's Authorization header value and returns True to
-admit. ``token_auth("secret")`` builds the common bearer-token check.
+Debug endpoints (/debug/cache, /debug/queue, /debug/journal,
+/debug/trace, /debug/pod) follow the reference's discipline for its
+debugging handlers (server.go:248-255: installed only behind the authz
+filter): they are DENIED unless the caller passed a ``debug_auth``
+callback, which receives the request's Authorization header value and
+returns True to admit. ``token_auth("secret")`` builds the common
+bearer-token check.
+
+Flight-recorder surface:
+- ``/debug/trace[?n=32]`` — the last-N cycle traces from the always-on
+  recorder ring plus per-phase percentiles (p50/p90/p99) and the
+  host-tail share.
+- ``/debug/pod?name=X[&namespace=ns]`` (or ``?uid=``) — one pod's
+  lifecycle timeline (enqueue/pop/bind/park stamps) and its last
+  unschedulable diagnosis (which device filter rejected how many nodes,
+  which host plugin rejected).
 """
 
 from __future__ import annotations
@@ -20,6 +30,7 @@ import threading
 from dataclasses import asdict, is_dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
+from urllib.parse import parse_qs
 
 
 def token_auth(token: str) -> Callable[[str], bool]:
@@ -53,7 +64,7 @@ class ServingEndpoints:
                 self.end_headers()
                 self.wfile.write(data)
 
-            def _debug(self, path: str) -> None:
+            def _debug(self, path: str, query: dict) -> None:
                 # server.go:248-255: debug handlers exist only behind
                 # authorization — no callback, no endpoints (403, not
                 # 404: the surface is real but the caller is not allowed)
@@ -75,13 +86,44 @@ class ServingEndpoints:
                     js_fn = getattr(sched.hub, "get_journal_stats", None)
                     body = json.dumps(js_fn() if js_fn else {}, indent=2,
                                       default=str)
+                elif path == "/debug/trace":
+                    flight = getattr(sched, "flight", None)
+                    if flight is None:
+                        self._send(404, "no flight recorder")
+                        return
+                    try:
+                        n = int(query.get("n", ["32"])[0])
+                    except ValueError:
+                        n = 32
+                    body = json.dumps({
+                        "enabled": flight.enabled,
+                        "cycles": flight.last(n),
+                        "phases": flight.phase_percentiles(),
+                        "host_tail_share": round(
+                            flight.host_tail_share(), 4),
+                    }, indent=2, default=str)
+                elif path == "/debug/pod":
+                    timelines = getattr(sched, "timelines", None)
+                    if timelines is None:
+                        self._send(404, "no pod timelines")
+                        return
+                    tl = timelines.get(
+                        name=query.get("name", [""])[0],
+                        uid=query.get("uid", [""])[0],
+                        namespace=query.get("namespace",
+                                            ["default"])[0])
+                    if tl is None:
+                        self._send(404, "pod not found (timelines keep "
+                                        "the newest pods only)")
+                        return
+                    body = json.dumps(tl, indent=2, default=str)
                 else:
                     self._send(404, "not found")
                     return
                 self._send(200, body, "application/json")
 
             def do_GET(self):  # noqa: N802 (stdlib API)
-                path = self.path.split("?", 1)[0]
+                path, _, rawq = self.path.partition("?")
                 if path == "/metrics":
                     self._send(200, sched.metrics.registry.render_text())
                 elif path == "/readyz":
@@ -101,7 +143,7 @@ class ServingEndpoints:
                         indent=2, default=str)
                     self._send(200, body, "application/json")
                 elif path.startswith("/debug/"):
-                    self._debug(path)
+                    self._debug(path, parse_qs(rawq))
                 else:
                     self._send(404, "not found")
 

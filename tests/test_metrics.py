@@ -40,6 +40,43 @@ def test_histogram_percentiles_and_text():
     assert h.percentile(95) == 1.0
 
 
+def test_exposition_label_escaping():
+    """Prometheus exposition spec: backslash, double quote and line feed
+    in label VALUES must be escaped — a failure message or plugin name
+    carrying any of them used to emit unparseable exposition text."""
+    r = Registry()
+    c = r.register(Counter("weird_total", "", ("msg",)))
+    c.inc(msg='say "hi" to C:\\temp\nplease')
+    text = r.render_text()
+    assert ('weird_total{msg="say \\"hi\\" to C:\\\\temp\\nplease"} 1.0'
+            in text)
+    # no raw newline survives; every inner quote is backslash-escaped
+    line = next(ln for ln in text.splitlines()
+                if ln.startswith("weird_total"))
+    assert "\n" not in line
+    inner = line[line.index('="') + 2:line.rindex('"')]
+    assert all(inner[i - 1] == "\\" for i, ch in enumerate(inner)
+               if ch == '"')
+
+
+def test_exposition_help_escaping():
+    """HELP lines escape backslash and line feed (quotes stay raw)."""
+    r = Registry()
+    r.register(Counter("h_total", 'multi\nline "help" with \\slash'))
+    text = r.render_text()
+    assert ('# HELP h_total multi\\nline "help" with \\\\slash' in text)
+
+
+def test_exposition_histogram_label_escaping():
+    r = Registry()
+    h = r.register(Histogram("lat", "", buckets=(0.1, 1.0),
+                             label_names=("plugin",)))
+    h.observe(0.05, plugin='odd"name\\')
+    text = r.render_text()
+    assert 'plugin="odd\\"name\\\\"' in text
+    assert 'le="0.1"' in text
+
+
 def test_counter_labels():
     c = Counter("c", label_names=("result",))
     c.inc(result="scheduled")

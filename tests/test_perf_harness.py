@@ -206,16 +206,9 @@ def test_bench_workload_names_in_sync():
     """bench.py names its subprocess workloads; they must be exactly
     workloads.BENCH_WORKLOADS (by function name) or a new bench workload
     silently never runs."""
-    import importlib.util
-    import os
-
-    spec = importlib.util.spec_from_file_location(
-        "bench", os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "bench.py"))
-    bench = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bench)
     from kubernetes_tpu.perf.workloads import BENCH_WORKLOADS
 
+    bench = _load_bench()
     assert tuple(bench.BENCH_WORKLOAD_FNS) == tuple(
         f.__name__ for f in BENCH_WORKLOADS)
 
@@ -226,4 +219,87 @@ def test_dra_steady_state_tiny():
     w = small(dra_steady_state(init_nodes=4, measure_pods=6))
     r = run_workload(w)
     assert r["pods_scheduled"] == 6
+    assert r["stats"]["unschedulable"] == 0
+
+
+def _load_bench():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    return bench
+
+
+def test_profile_workload_names_in_sync():
+    """bench.py --profile names its offender set; it must be exactly
+    workloads.PROFILE_WORKLOADS or a profiled workload silently drops."""
+    from kubernetes_tpu.perf.workloads import PROFILE_WORKLOADS
+
+    bench = _load_bench()
+    assert tuple(bench.PROFILE_WORKLOAD_FNS) == tuple(PROFILE_WORKLOADS)
+
+
+def test_run_workload_profile_breakdown():
+    """profile=True: the result carries the flight recorder's per-phase
+    p50/p99 (incl. the dra_allocator view when DRA plugins ran) and the
+    host-tail share — what bench.py --profile publishes per offender."""
+    w = small(scheduling_basic(init_nodes=4, init_pods=2, measure_pods=10))
+    r = run_workload(w, profile=True)
+    fl = r["flight"]
+    assert fl["enabled"] and fl["cycles_recorded"] >= 1
+    for phase in ("queue_pop", "device_launch", "commit"):
+        assert phase in fl["phases"], phase
+        assert fl["phases"][phase]["count"] >= 1
+        assert fl["phases"][phase]["p99_ms"] >= fl["phases"][phase]["p50_ms"]
+    assert fl["plugins"], "per-plugin timings present"
+    assert 0.0 <= fl["host_tail_share"] <= 1.0
+
+
+def test_run_workload_cycle_times_capture():
+    """cycle_times collects exact raw per-cycle durations (the
+    --trace-overhead arms compare medians of these, not
+    bucket-quantized histogram reads)."""
+    w = small(scheduling_basic(init_nodes=4, init_pods=2, measure_pods=10))
+    times = []
+    r = run_workload(w, cycle_times=times)
+    assert len(times) >= 1
+    assert all(t >= 0.0 for t in times)
+    assert r["pods_scheduled"] == 10
+
+
+def test_qhints_variant_tiny():
+    from kubernetes_tpu.perf.workloads import scheduling_basic_qhints
+
+    w = small(scheduling_basic_qhints(init_nodes=4, init_pods=2,
+                                      measure_pods=10))
+    assert w.feature_gates == {"SchedulerQueueingHints": True}
+    r = run_workload(w)
+    assert r["pods_scheduled"] == 10
+
+
+def test_preemption_async_enabled_variant_tiny():
+    from kubernetes_tpu.perf.workloads import preemption_async_enabled
+
+    w = small(preemption_async_enabled(init_nodes=2, init_pods=8,
+                                       measure_pods=4))
+    assert w.feature_gates == {"SchedulerAsyncPreemption": True}
+    r = run_workload(w)
+    assert r["pods_scheduled"] == 4
+
+
+def test_ns_selector_preferred_anti_affinity_tiny():
+    from kubernetes_tpu.perf.workloads import (
+        ns_selector_preferred_anti_affinity,
+    )
+
+    w = small(ns_selector_preferred_anti_affinity(
+        init_nodes=8, init_pods=3, measure_pods=5, namespaces=2))
+    w.warm_full_nodes = False
+    r = run_workload(w)
+    # PREFERRED anti-affinity: soft avoidance only, everything schedules
+    assert r["pods_scheduled"] == 5
     assert r["stats"]["unschedulable"] == 0

@@ -1,0 +1,383 @@
+"""Flight recorder + pod lifecycle timelines + /debug trace surface.
+
+The always-on CycleTrace recorder (utils/tracing.py): every scheduling
+cycle's phases into a bounded ring + the phase/plugin histograms, pod
+lifecycle stamps behind /debug/pod, and the authz-gated serving
+endpoints that expose both. The slow-cycle Trace (log_if_long) keeps its
+coverage in test_metrics.py.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api.objects import (
+    Container,
+    LABEL_HOSTNAME,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResourceRequirements,
+)
+from kubernetes_tpu.config.types import default_config
+from kubernetes_tpu.hub import Hub
+from kubernetes_tpu.metrics import FINE_DURATION_BUCKETS, Histogram
+from kubernetes_tpu.ops.features import Capacities
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.serving import ServingEndpoints, token_auth
+from kubernetes_tpu.utils.tracing import (
+    CYCLE_PHASES,
+    CycleTrace,
+    FlightRecorder,
+    HOST_PHASES,
+    PodTimelines,
+)
+
+
+def mknode(i):
+    return Node(metadata=ObjectMeta(name=f"node-{i}",
+                                    labels={LABEL_HOSTNAME: f"node-{i}"}),
+                status=NodeStatus(allocatable={"cpu": "8", "memory": "16Gi",
+                                               "pods": "110"}))
+
+
+def mkpod(name, cpu="100m"):
+    return Pod(metadata=ObjectMeta(name=name),
+               spec=PodSpec(containers=[Container(
+                   name="c", resources=ResourceRequirements(
+                       requests={"cpu": cpu}))]))
+
+
+def _sched(hub, recorder_capacity=256, export_path=None):
+    cfg = default_config()
+    cfg.batch_size = 16
+    cfg.flight_recorder_capacity = recorder_capacity
+    cfg.trace_export_path = export_path
+    return Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64))
+
+
+# ------------------------------------------------- CycleTrace units
+
+
+def test_cycle_trace_accumulates_and_totals():
+    tr = CycleTrace(cycle=1, start=100.0, pods=8)
+    tr.add("host_plugins", 0.01)
+    tr.add("host_plugins", 0.02)   # touched twice: accumulates
+    tr.add("device_launch", 0.1)
+    tr.add("dra_allocator", 0.005)  # a VIEW: excluded from total()
+    assert abs(tr.phases["host_plugins"] - 0.03) < 1e-12
+    assert abs(tr.total() - 0.13) < 1e-12
+    d = tr.to_dict()
+    assert d["total_ms"] == 130.0
+    assert d["phases_ms"]["dra_allocator"] == 5.0
+
+
+def test_phase_vocabulary():
+    # host-tail arithmetic depends on these set relations
+    assert set(HOST_PHASES) < set(CYCLE_PHASES)
+    assert "dra_allocator" not in HOST_PHASES
+    assert "device_launch" not in HOST_PHASES
+
+
+# --------------------------------------------- FlightRecorder units
+
+
+def _hists():
+    phase = Histogram("phase", buckets=FINE_DURATION_BUCKETS,
+                      label_names=("phase",))
+    plugin = Histogram("plugin", buckets=FINE_DURATION_BUCKETS,
+                       label_names=("plugin", "extension_point"))
+    return phase, plugin
+
+
+def test_recorder_ring_is_bounded_and_feeds_histograms():
+    phase, plugin = _hists()
+    rec = FlightRecorder(phase_hist=phase, plugin_hist=plugin, capacity=4)
+    for i in range(10):
+        tr = rec.begin(start=float(i), pods=2)
+        tr.add("queue_pop", 0.001)
+        tr.add("commit", 0.002)
+        rec.record(tr)
+    assert len(rec.ring) == 4, "ring bounded at capacity"
+    assert [t["cycle"] for t in rec.last(2)] == [9, 10]
+    assert rec.last(0) == [] and rec.last(-5) == [], \
+        "n<=0 asks for nothing, not the whole ring"
+    assert phase.count(phase="queue_pop") == 10
+    assert phase.count(phase="commit") == 10
+    pct = rec.phase_percentiles()
+    assert set(pct) == {"queue_pop", "commit"}
+    assert pct["commit"]["count"] == 10
+
+
+def test_recorder_disabled_paths():
+    rec = FlightRecorder(capacity=0)
+    assert not rec.enabled
+    tr = rec.begin(start=0.0, pods=4)
+    tr.add("commit", 1.0)            # null trace: add is a no-op
+    assert tr.phases == {}
+    rec.record(tr)
+    rec.observe_phase("commit", 1.0)
+    rec.plugin_observe("NodeAffinity", "Filter", 1.0)
+    assert len(rec.ring) == 0
+    assert rec.phase_percentiles() == {} or rec.phase_hist is None
+
+
+def test_plugin_observe_feeds_dra_view():
+    phase, plugin = _hists()
+    rec = FlightRecorder(phase_hist=phase, plugin_hist=plugin)
+    tr = rec.begin(start=0.0, pods=1)
+    rec.plugin_observe("NodeAffinity", "Filter", 0.001)
+    rec.plugin_observe("DynamicResources", "Filter", 0.002)
+    rec.plugin_observe("DynamicResources", "Reserve", 0.003)
+    rec.record(tr)
+    # per-plugin timings land on the current cycle...
+    assert tr.plugins["NodeAffinity/Filter"] == 0.001
+    # ...and DynamicResources time additionally fills the dra_allocator
+    # phase view
+    assert abs(tr.phases["dra_allocator"] - 0.005) < 1e-12
+    assert plugin.count(plugin="DynamicResources",
+                        extension_point="Filter") == 1
+    keys = set(rec.plugin_percentiles())
+    assert {"NodeAffinity/Filter", "DynamicResources/Reserve"} <= keys
+
+
+def test_recorder_resume_reattaches_dispatched_cycle():
+    phase, plugin = _hists()
+    rec = FlightRecorder(phase_hist=phase, plugin_hist=plugin)
+    tr_k = rec.begin(start=0.0, pods=1)
+    tr_k1 = rec.begin(start=1.0, pods=1)   # pipelined: k+1 dispatched
+    assert rec.current is tr_k1
+    rec.resume(tr_k)                        # finishing k: plugins land on k
+    rec.plugin_observe("DynamicResources", "Reserve", 0.001)
+    assert "dra_allocator" in tr_k.phases
+    assert "dra_allocator" not in tr_k1.phases
+    rec.record(tr_k)
+    assert rec.current is None or rec.current is tr_k1
+
+
+def test_host_tail_share():
+    phase, _ = _hists()
+    rec = FlightRecorder(phase_hist=phase)
+    tr = rec.begin(start=0.0, pods=1)
+    tr.add("host_plugins", 0.03)           # host
+    tr.add("device_launch", 0.06)          # device
+    tr.add("commit", 0.01)                 # host
+    tr.add("dra_allocator", 0.02)          # view: excluded
+    rec.record(tr)
+    assert abs(rec.host_tail_share() - 0.4) < 1e-9
+
+
+def test_recorder_jsonl_export(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    rec = FlightRecorder(capacity=8, export_path=path)
+    for i in range(3):
+        tr = rec.begin(start=float(i), pods=1)
+        tr.add("commit", 0.001 * (i + 1))
+        rec.record(tr)
+    rec.close()
+    lines = [json.loads(ln) for ln in open(path)]
+    assert [ln["cycle"] for ln in lines] == [1, 2, 3]
+    assert lines[2]["phases_ms"]["commit"] == 3.0
+
+
+# --------------------------------------------------- PodTimelines
+
+
+def test_timelines_lru_and_lookup():
+    tl = PodTimelines(capacity=2, now=lambda: 1.0)
+    pods = [mkpod(f"p{i}") for i in range(3)]
+    for p in pods:
+        tl.event(p, "enqueued")
+    assert len(tl) == 2, "LRU bounded"
+    assert tl.get(name="p0") is None, "oldest evicted"
+    got = tl.get(name="p2")
+    assert got["events"][0]["event"] == "enqueued"
+    assert tl.get(uid=pods[1].metadata.uid)["name"] == "p1"
+    tl.forget(pods[1].metadata.uid)
+    assert tl.get(name="p1") is None
+
+
+def test_timelines_event_cap_keeps_head_and_tail():
+    tl = PodTimelines(now=lambda: 0.0)
+    p = mkpod("stormy")
+    tl.event(p, "enqueued")
+    for i in range(200):
+        tl.event(p, "popped", f"attempt {i}")
+    events = tl.get(name="stormy")["events"]
+    assert len(events) <= PodTimelines.MAX_EVENTS_PER_POD
+    assert events[0]["event"] == "enqueued", "timeline anchor survives"
+    assert events[-1]["detail"] == "attempt 199", "newest tail survives"
+
+
+def test_timelines_diagnosis():
+    tl = PodTimelines(now=lambda: 5.0)
+    p = mkpod("sick")
+    tl.diagnose(p, {"NodeResourcesFit": 12}, {"VolumeZone": 1},
+                "no feasible node")
+    d = tl.get(name="sick")["diagnosis"]
+    assert d["device_rejects"] == {"NodeResourcesFit": 12}
+    assert d["host_rejects"] == {"VolumeZone": 1}
+    assert d["at"] == 5.0
+
+
+# -------------------------------------- scheduler integration
+
+
+def test_scheduler_records_cycle_phases_and_timelines():
+    hub = Hub()
+    sched = _sched(hub)
+    try:
+        hub.create_node(mknode(0))
+        for i in range(5):
+            hub.create_pod(mkpod(f"p{i}"))
+        sched.run_until_idle()
+        assert len(sched.flight.ring) >= 1
+        cyc = sched.flight.last(1)[0]
+        for phase in ("queue_pop", "snapshot_sync", "pack",
+                      "device_dispatch", "device_launch", "commit"):
+            assert phase in cyc["phases_ms"], phase
+        assert cyc["scheduled"] >= 1
+        # phase histogram fed (the /metrics surface)
+        m = sched.metrics
+        assert m.phase_duration.count(phase="commit") >= 1
+        # per-plugin timing under the new plugin label
+        assert m.plugin_duration.total_count() >= 1
+        # the reference's e2e pod_scheduling_duration_seconds by attempts
+        assert m.pod_e2e_duration.count(attempts="1") == 5
+        # timelines: enqueued -> popped -> bound
+        t = sched.timelines.get(name="p0")
+        evs = [e["event"] for e in t["events"]]
+        assert evs[0] == "enqueued"
+        assert "popped" in evs and "bound" in evs
+        text = m.registry.render_text()
+        assert "scheduling_phase_duration_seconds_bucket" in text
+        assert "plugin_execution_duration_seconds_bucket" in text
+        assert "pod_scheduling_duration_seconds_bucket" in text
+    finally:
+        sched.close()
+
+
+def test_scheduler_unschedulable_diagnosis():
+    hub = Hub()
+    sched = _sched(hub)
+    try:
+        hub.create_node(mknode(0))
+        hub.create_pod(mkpod("big", cpu="64"))   # never fits the 8-cpu node
+        sched.run_until_idle()
+        t = sched.timelines.get(name="big")
+        assert t is not None
+        evs = [e["event"] for e in t["events"]]
+        assert "unschedulable" in evs and "bound" not in evs
+        d = t["diagnosis"]
+        assert d is not None
+        # the device filter that rejected, from the pulled reject_counts
+        assert "NodeResourcesFit" in d["device_rejects"]
+        assert d["device_rejects"]["NodeResourcesFit"] >= 1
+    finally:
+        sched.close()
+
+
+def test_scheduler_recorder_disabled_still_schedules():
+    hub = Hub()
+    sched = _sched(hub, recorder_capacity=0)
+    try:
+        assert not sched.flight.enabled
+        hub.create_node(mknode(0))
+        hub.create_pod(mkpod("p"))
+        sched.run_until_idle()
+        assert hub.get_pod(
+            [p for p in hub.list_pods()][0].metadata.uid
+        ).spec.node_name, "pod bound with the recorder off"
+        assert len(sched.flight.ring) == 0
+        assert sched.metrics.phase_duration.total_count() == 0
+    finally:
+        sched.close()
+
+
+def test_scheduler_trace_export(tmp_path):
+    path = str(tmp_path / "cycles.jsonl")
+    hub = Hub()
+    sched = _sched(hub, export_path=path)
+    try:
+        hub.create_node(mknode(0))
+        hub.create_pod(mkpod("p"))
+        sched.run_until_idle()
+    finally:
+        sched.close()
+    lines = [json.loads(ln) for ln in open(path)]
+    assert lines and "phases_ms" in lines[0]
+
+
+# --------------------------------------- /debug/trace + /debug/pod
+
+
+def _get(url, token=None):
+    req = urllib.request.Request(url)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    return urllib.request.urlopen(req, timeout=5)
+
+
+def test_debug_trace_and_pod_endpoints_authz():
+    hub = Hub()
+    sched = _sched(hub)
+    try:
+        hub.create_node(mknode(0))
+        hub.create_pod(mkpod("p0"))
+        hub.create_pod(mkpod("big", cpu="64"))
+        sched.run_until_idle()
+
+        # no authz callback: 403 for the whole /debug surface
+        srv = ServingEndpoints(sched, port=0)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            for ep in ("/debug/trace", "/debug/pod?name=p0"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    _get(base + ep)
+                assert ei.value.code == 403, ep
+        finally:
+            srv.stop()
+
+        # token authz: bad/missing bearer 401, good token 200 + data
+        srv = ServingEndpoints(sched, port=0,
+                               debug_auth=token_auth("s3cret"))
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            for ep in ("/debug/trace", "/debug/pod?name=p0"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    _get(base + ep)
+                assert ei.value.code == 401, ep
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    _get(base + ep, token="wrong")
+                assert ei.value.code == 401, ep
+
+            tr = json.loads(_get(f"{base}/debug/trace?n=4",
+                                 token="s3cret").read())
+            assert tr["enabled"] is True
+            assert tr["cycles"], "ring exposed"
+            assert len(tr["cycles"]) <= 4
+            assert "commit" in tr["phases"]
+            assert 0.0 <= tr["host_tail_share"] <= 1.0
+
+            pd = json.loads(_get(f"{base}/debug/pod?name=p0",
+                                 token="s3cret").read())
+            assert pd["name"] == "p0"
+            assert [e["event"] for e in pd["events"]][0] == "enqueued"
+            # the unschedulable pod's diagnosis rides the same endpoint
+            sick = json.loads(_get(f"{base}/debug/pod?name=big",
+                                   token="s3cret").read())
+            assert sick["diagnosis"] is not None
+
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"{base}/debug/pod?name=nope", token="s3cret")
+            assert ei.value.code == 404
+        finally:
+            srv.stop()
+    finally:
+        sched.close()
