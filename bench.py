@@ -37,7 +37,7 @@ BASELINE_PODS_PER_SEC = 270.0  # misc/performance-config.yaml:63
 
 # the committed artifact README.md's bench table is generated from; a
 # new measurement round commits a new artifact and re-points this
-README_BENCH_ARTIFACT = "BENCH_r05_builder.json"
+README_BENCH_ARTIFACT = "BENCH_r06_builder.json"
 _TABLE_BEGIN = "<!-- BENCH_TABLE_BEGIN"
 _TABLE_END = "<!-- BENCH_TABLE_END -->"
 
@@ -125,6 +125,9 @@ BENCH_WORKLOAD_FNS = (
     "scheduling_basic_qhints",
     "preemption_async_enabled",
     "ns_selector_preferred_anti_affinity",
+    "multi_tenant_gang_storm",
+    "quota_exhaustion_churn",
+    "gang_preemption",
 )
 
 # the ROADMAP's sub-10x offenders, profiled with the flight recorder's

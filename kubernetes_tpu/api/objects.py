@@ -704,6 +704,46 @@ class DeviceClass:
     selectors: list[DeviceSelector] = field(default_factory=list)
 
 
+# --- pod groups (gang scheduling / multi-tenant job queues) ---------------------------
+
+# labels binding a pod to its gang and tenant queue (the coscheduling
+# convention of pod-group.scheduling.sigs.k8s.io, namespaced to this build)
+LABEL_POD_GROUP = "scheduling.k8s.io/pod-group"
+LABEL_QUEUE = "scheduling.k8s.io/queue"
+
+
+@dataclass
+class PodGroup:
+    """scheduling.sigs.k8s.io PodGroup analog (the Kant/coscheduling gang
+    contract): pods carrying ``LABEL_POD_GROUP: <name>`` in this namespace
+    form one gang. The job queue releases the gang into the scheduling
+    batch only when ``min_member`` members are present (and the tenant's
+    quota fits them); the gang Permit plugin then holds reserved members
+    in the wait room until ``min_member`` have reserved, committing all
+    binds together — or rolling every reservation back atomically after
+    ``schedule_timeout_seconds``."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    min_member: int = 1
+    queue: str = "default"                # tenant / job-queue name
+    priority: int = 0                     # gang priority (informational;
+                                          # pod spec.priority drives order)
+    schedule_timeout_seconds: float = 30.0
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+def pod_group_key(pod: "Pod") -> Optional[str]:
+    """The gang key ("namespace/groupname") a pod belongs to, or None."""
+    g = pod.metadata.labels.get(LABEL_POD_GROUP)
+    return f"{pod.metadata.namespace}/{g}" if g else None
+
+
 # --- priority class ------------------------------------------------------------------
 
 

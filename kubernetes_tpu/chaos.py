@@ -32,7 +32,9 @@ lost pod, cache–hub convergence. ``run_device_storm()`` provokes the
 fallback ladder + quarantine; ``run_crash_storm()`` is the full
 acceptance storm — device faults + watch cuts + leader kill +
 kill-and-restart over ≥1k pods, every pod bound exactly once.
-``bench.py --chaos-smoke`` runs all three as the red-suite gate.
+``run_gang_storm()`` kills the leader mid-gang-commit and asserts the
+all-or-nothing ledger: every gang lands fully or not at all.
+``bench.py --chaos-smoke`` runs all four as the red-suite gate.
 """
 
 from __future__ import annotations
@@ -813,6 +815,207 @@ def run_crash_storm(pods: int = 1000, nodes: int = 24, seed: int = 13,
     return report
 
 
+# --------------------------------------------------------------------------
+# gang-atomicity storm: leader kill mid-gang-commit (ISSUE 6)
+# --------------------------------------------------------------------------
+
+
+def run_gang_storm(gangs: int = 10, nodes: int = 16, seed: int = 17,
+                   timeout_s: float = 240.0) -> dict:
+    """The gang acceptance storm: two elected schedulers behind chaos
+    proxies, a population of PodGroups with mixed gang sizes, and a
+    leader partition timed to land MID-gang-commit. Every bind is
+    tallied off the hub's own watch stream; ``ok`` iff no pod bound
+    twice (fencing + bind-once), every gang landed **fully** (the
+    all-or-nothing ledger: a gang is either complete or untouched — a
+    rolled-back assembly leaves zero members placed and zero leaked
+    assumed pods), and no surviving daemon crashed."""
+    from kubernetes_tpu.api.objects import (
+        LABEL_POD_GROUP,
+        LABEL_QUEUE,
+        ObjectMeta,
+        PodGroup,
+    )
+    from kubernetes_tpu.config.types import default_config
+    from kubernetes_tpu.hub import EventHandlers, Hub
+    from kubernetes_tpu.hubclient import RemoteHub
+    from kubernetes_tpu.hubserver import HubServer
+    from kubernetes_tpu.leaderelection import LeaderElector
+    from kubernetes_tpu.ops.features import Capacities
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing import MakeNode, MakePod
+
+    hub = Hub()
+    server = HubServer(hub).start()
+    proxies: dict = {}
+    clients: dict = {}
+    scheds: dict = {}
+    electors: dict = {}
+
+    def spawn(ident: str) -> None:
+        proxy = ChaosProxy(server.address,
+                           config=ChaosConfig(seed=seed)).start()
+        client = RemoteHub(proxy.address, timeout=10.0, retry_deadline=3.0,
+                           retry_base=0.01, retry_cap=0.1)
+        cfg = default_config()
+        cfg.batch_size = 32
+        sched = Scheduler(client, cfg,
+                          caps=Capacities(nodes=max(32, nodes * 2),
+                                          pods=1024))
+        elector = LeaderElector(client.leases, ident, lease_duration=2.0,
+                                renew_deadline=1.0, retry_period=0.1)
+        sched.start(elector=elector)
+        proxies[ident], clients[ident] = proxy, client
+        scheds[ident], electors[ident] = sched, elector
+
+    bind_counts: dict[str, int] = {}
+    block = threading.Lock()
+
+    def on_update(old, new) -> None:
+        if not old.spec.node_name and new.spec.node_name:
+            with block:
+                uid = new.metadata.uid
+                bind_counts[uid] = bind_counts.get(uid, 0) + 1
+
+    hub.watch_pods(EventHandlers(on_update=on_update), replay=False)
+    sizes = [2, 3, 4, 6, 8]
+    report: dict = {"gangs": gangs, "nodes": nodes, "seed": seed}
+    gang_of: dict[str, str] = {}        # pod uid -> gang name
+    gang_size: dict[str, int] = {}
+    try:
+        for i in range(nodes):
+            hub.create_node(MakeNode().name(f"gn-{i}")
+                            .capacity(cpu="16", memory="64Gi",
+                                      pods="110").obj())
+        for g in range(gangs):
+            size = sizes[g % len(sizes)]
+            name = f"gang-{g}"
+            gang_size[name] = size
+            hub.create_pod_group(PodGroup(
+                metadata=ObjectMeta(name=name),
+                min_member=size,
+                queue=f"tenant-{g % 2}",
+                schedule_timeout_seconds=10.0))
+        spawn("a")
+        spawn("b")
+        for g in range(gangs):
+            name = f"gang-{g}"
+            for m in range(gang_size[name]):
+                pod = (MakePod().name(f"{name}-m{m}")
+                       .req(cpu="200m").obj())
+                pod.metadata.labels[LABEL_POD_GROUP] = name
+                pod.metadata.labels[LABEL_QUEUE] = f"tenant-{g % 2}"
+                gang_of[pod.metadata.uid] = name
+                hub.create_pod(pod)
+
+        total = sum(gang_size.values())
+
+        def bound_count() -> int:
+            return sum(1 for p in hub.list_pods() if p.spec.node_name)
+
+        def leader():
+            for ident, el in electors.items():
+                if el.is_leader():
+                    return ident
+            return None
+
+        # kill the leader the moment the FIRST gang binds start landing:
+        # that partition window lands mid-commit for whatever gang is in
+        # flight — its fenced stragglers must be rejected, its rollback
+        # must leave no partial placement
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 30.0 and bound_count() < 2:
+            time.sleep(0.05)
+        victim = leader()
+        report["first_leader"] = victim
+        if victim is not None:
+            proxies[victim].partition_for(6.0)
+            others = [i for i in electors if i != victim]
+            takeover = time.monotonic() + 20.0
+            while time.monotonic() < takeover:
+                if any(electors[i].is_leader() for i in others):
+                    break
+                time.sleep(0.05)
+            report["failover"] = True
+        # drain to completion: the survivor (and the healed ex-leader)
+        # re-admit interrupted gangs after their permit timeouts.
+        # Progress extends the deadline — a slow drain on a loaded box
+        # is not an atomicity verdict; only a STALLED storm times out
+        # (and then reports its partially-placed in-flight gangs)
+        deadline = time.monotonic() + timeout_s
+        last = -1
+        while time.monotonic() < deadline:
+            b = bound_count()
+            if b >= total:
+                break
+            if b > last:
+                last = b
+                deadline = max(deadline, time.monotonic() + 60.0)
+            time.sleep(0.25)
+        report["drained"] = bound_count() >= total
+
+        # settle: heal the proxies and let each scheduler's informer
+        # confirm its in-flight assumed pods — an assumed count sampled
+        # mid-fault-injection is reflector lag, not a leak (run_smoke's
+        # settle discipline)
+        for p in proxies.values():
+            p.heal()
+        settle_end = time.monotonic() + 20.0
+        while time.monotonic() < settle_end:
+            if all(s.cache.assumed_pod_count() == 0
+                   for s in scheds.values()):
+                break
+            time.sleep(0.5)
+
+        per_gang: dict[str, int] = {g: 0 for g in gang_size}
+        with block:
+            dup = {uid: n for uid, n in bind_counts.items() if n > 1}
+        for p in hub.list_pods():
+            if p.spec.node_name:
+                per_gang[gang_of[p.metadata.uid]] += 1
+        partial = {g: n for g, n in per_gang.items()
+                   if 0 < n < gang_size[g]}
+        leaked_assumed = {ident: s.cache.assumed_pod_count()
+                          for ident, s in scheds.items()
+                          if s.cache.assumed_pod_count()}
+        daemon_errors = {
+            ident: repr(s.daemon_error) for ident, s in scheds.items()
+            if getattr(s, "daemon_error", None) is not None}
+        report.update({
+            "pods": total, "bound": bound_count(),
+            "duplicate_binds": dup,
+            "partial_gangs": partial,
+            "complete_gangs": sum(1 for g, n in per_gang.items()
+                                  if n == gang_size[g]),
+            "gang_rollbacks": sum(
+                s._gang.stats["rollbacks"] for s in scheds.values()),
+            "fenced_writes": sum(s.stats.get("fenced", 0)
+                                 for s in scheds.values()),
+            "leaked_assumed": leaked_assumed,
+            "daemon_errors": daemon_errors,
+            "ok": (bound_count() == total and not dup and not partial
+                   and not leaked_assumed and not daemon_errors),
+        })
+    finally:
+        for s in scheds.values():
+            try:
+                s.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        for c in clients.values():
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        for p in proxies.values():
+            try:
+                p.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        server.stop()
+    return report
+
+
 def main() -> None:
     import argparse
 
@@ -820,7 +1023,8 @@ def main() -> None:
     ap.add_argument("--pods", type=int, default=40)
     ap.add_argument("--nodes", type=int, default=8)
     ap.add_argument("--seed", type=int, default=7)
-    ap.add_argument("--storm", choices=("smoke", "device", "crash", "all"),
+    ap.add_argument("--storm",
+                    choices=("smoke", "device", "crash", "gang", "all"),
                     default="smoke",
                     help="which storm to run (bench.py --chaos-smoke "
                          "runs 'all')")
@@ -832,12 +1036,15 @@ def main() -> None:
         report = run_device_storm(seed=args.seed)
     elif args.storm == "crash":
         report = run_crash_storm(seed=args.seed)
+    elif args.storm == "gang":
+        report = run_gang_storm(seed=args.seed)
     else:
         report = {
             "smoke": run_smoke(pods=args.pods, nodes=args.nodes,
                                seed=args.seed),
             "device": run_device_storm(seed=args.seed),
             "crash": run_crash_storm(seed=args.seed),
+            "gang": run_gang_storm(seed=args.seed),
         }
         report["ok"] = all(r.get("ok") for r in report.values())
     print(json.dumps(report, default=str))

@@ -104,6 +104,11 @@ class SchedulerConfiguration:
     batch_size: int = 256       # pods scored per XLA launch
     node_capacity: int = 1024   # initial mirror bucket (grows by pow2)
     pod_table_capacity: int = 4096
+    # multi-tenant job queues (backend/jobqueue.py): tenant name ->
+    # {"weight": float, "quota": {resource: quantity}}. Pods carrying
+    # the queue/pod-group labels route through the job-queue layer;
+    # unknown tenants are created on demand with weight 1 and no quota
+    tenants: dict[str, dict] = field(default_factory=dict)
     # flight recorder (always-on per-phase cycle tracing): ring size in
     # cycles; 0 disables the recorder entirely (not recommended — the
     # overhead budget is <2% of cycle time, see bench.py --trace-overhead)
@@ -142,6 +147,7 @@ DEFAULT_MULTI_POINT = (
     ("DefaultPreemption", 0),
     ("NodeResourcesBalancedAllocation", 1),
     ("ImageLocality", 1),
+    ("GangScheduling", 0),
     ("DefaultBinder", 0),
 )
 

@@ -109,6 +109,7 @@ class EventResource(str, enum.Enum):
     CSI_STORAGE_CAPACITY = "CSIStorageCapacity"
     RESOURCE_CLAIM = "ResourceClaim"
     RESOURCE_SLICE = "ResourceSlice"
+    POD_GROUP = "PodGroup"
     WILDCARD = "*"
 
 

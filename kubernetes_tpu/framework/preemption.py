@@ -24,8 +24,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubernetes_tpu.api.labels import label_selector_matches
-from kubernetes_tpu.api.objects import Pod
-from kubernetes_tpu.hub import Unavailable
+from kubernetes_tpu.api.objects import (
+    LABEL_POD_GROUP,
+    Pod,
+    pod_group_key,
+)
+from kubernetes_tpu.hub import Fenced, Unavailable
 from kubernetes_tpu.framework.interface import (
     PostFilterPlugin,
     PreEnqueuePlugin,
@@ -109,6 +113,13 @@ class Evaluator:
         # through ProcessPreemption before selection (preemption.go:335)
         self.extenders_fn = None
         self.metrics = None     # SchedulerMetrics, set by the Scheduler
+        # scheduler-installed fencing: () -> (epoch, lease_name) | ();
+        # queued evictions and nomination clears carry the epoch of the
+        # flush that lands them, so a deposed leader's backlog is
+        # rejected (Fenced) instead of evicting pods the new leader may
+        # have re-planned around
+        self.fencing_fn = None
+        self.fenced_metric = None   # (verb) -> None, set by the Scheduler
         # incremental victim-sweep state per preemptor priority (see
         # _collect_victims): row_gen-keyed victim lists + the resident
         # device cumsum, refreshed by row-scatter between bursts
@@ -529,18 +540,35 @@ class Evaluator:
         # retry API nomination clears a previous outage deferred (the
         # local nominator entries are already gone, so only the status
         # write can be replayed)
+        fargs = self.fencing_fn() if self.fencing_fn is not None else ()
         clears, self._pending_clears = self._pending_clears, []
         for uid in clears:
             try:
-                self.hub.clear_nominated_node(uid)
+                self.hub.clear_nominated_node(uid, *fargs)
             except Unavailable:
                 self._pending_clears.append(uid)
+            except Fenced:
+                self._note_fenced("clear_nominated_node")
+                # deposed: the new leader owns preemption policy now —
+                # drop the clear backlog AND the eviction backlog (a
+                # re-elected leader replaying either under its newer
+                # epoch would launder stale decisions) and ungate every
+                # queued preemptor for the retry path
+                self._pending_clears = []
+                dropped, self._pending = self._pending, []
+                stranded = []
+                for _cand, p in dropped:
+                    self.preempting.discard(p.metadata.uid)
+                    stranded.append(p)
+                if stranded and self.activate_fn is not None:
+                    self.activate_fn(stranded)
+                return 0
             except Exception:  # noqa: BLE001 — pod gone: nothing to clear
                 pass
         work, self._pending = self._pending, []
         stranded = []
         try:
-            self._flush_candidates(work, stranded)
+            self._flush_candidates(work, stranded, fargs)
         finally:
             # the activation of already-processed stranded preemptors
             # must fire even when an outage aborts the flush mid-way:
@@ -550,7 +578,26 @@ class Evaluator:
                 self.activate_fn(stranded)
         return len(work)
 
-    def _flush_candidates(self, work: list, stranded: list) -> None:
+    def _note_fenced(self, verb: str) -> None:
+        if self.fenced_metric is not None:
+            self.fenced_metric(verb)
+        logger.warning("preemption %s rejected: this scheduler's fencing "
+                       "epoch was deposed; dropping the eviction backlog",
+                       verb)
+
+    def _flush_candidates(self, work: list, stranded: list,
+                          fargs: tuple = ()) -> None:
+        # one cluster pod list per FLUSH, fetched lazily on the first
+        # gang victim and shared by every candidate — per-candidate
+        # list_pods() would pay a full-cluster RPC for each gang
+        # eviction in the backlog
+        listed: dict = {}
+
+        def _list_once():
+            if "pods" not in listed:
+                listed["pods"] = self.hub.list_pods()
+            return listed["pods"]
+
         for i, (candidate, pod) in enumerate(work):
             try:
                 # lower-priority nominees on this node must re-evaluate:
@@ -561,27 +608,47 @@ class Evaluator:
                 for nominee in dropped:
                     try:
                         self.hub.clear_nominated_node(
-                            nominee.metadata.uid)
+                            nominee.metadata.uid, *fargs)
                     except Unavailable:
                         # the nominator entry is dropped for good — a
                         # retried candidate would find nothing to clear
                         # — so park the STATUS write itself for replay
                         self._pending_clears.append(nominee.metadata.uid)
-                victims = candidate.victims
+                # whole-gang eviction: a victim that belongs to a gang
+                # takes its ENTIRE gang with it (cluster-wide), never a
+                # partial slice — a half-evicted gang would keep burning
+                # nodes on a job that can no longer run
+                victims, blocked = self._expand_gang_victims(
+                    candidate.victims, pod, _list_once)
+                if blocked:
+                    # a pulled-in co-member is protected (exhausted PDB,
+                    # or outranks the preemptor): the gang cannot be
+                    # evicted whole, so nothing of it is evicted at all —
+                    # strand the preemptor to re-evaluate other nodes
+                    logger.info("gang eviction for %s blocked: %s",
+                                pod.key(), blocked)
+                    self.preempting.discard(pod.metadata.uid)
+                    stranded.append(pod)
+                    continue
                 for victim in victims[:-1]:
                     try:
-                        self.hub.delete_pod(victim.metadata.uid)
+                        self.hub.delete_pod(victim.metadata.uid, *fargs)
                     except Unavailable:
                         raise           # outage ≠ "already gone"
+                    except Fenced:
+                        raise
                     except Exception:  # noqa: BLE001 — gone is fine
                         pass
                 self.preempting.discard(pod.metadata.uid)
                 fired = False
                 if victims:
                     try:
-                        self.hub.delete_pod(victims[-1].metadata.uid)
+                        self.hub.delete_pod(victims[-1].metadata.uid,
+                                            *fargs)
                         fired = True
                     except Unavailable:
+                        raise
+                    except Fenced:
                         raise
                     except Exception:  # noqa: BLE001
                         pass
@@ -598,6 +665,64 @@ class Evaluator:
                 self.preempting.add(pod.metadata.uid)
                 self._pending = work[i:] + self._pending
                 raise
+            except Fenced:
+                # deposed mid-flush: the new leader owns eviction policy.
+                # Drop the WHOLE backlog (replaying it under a newer
+                # epoch would launder stale decisions) and ungate every
+                # affected preemptor so the new leader's informer events
+                # — or their own retries — can pick them back up.
+                self._note_fenced("delete_pod")
+                for _cand, p in work[i:]:
+                    self.preempting.discard(p.metadata.uid)
+                    stranded.append(p)
+                self._pending = []
+                return
+
+    def _expand_gang_victims(self, victims: list[Pod],
+                             preemptor: Pod | None = None,
+                             list_pods=None) -> tuple[list[Pod], str]:
+        """All-or-nothing eviction: victims carrying a gang label pull in
+        every BOUND member of their gang (one hub scan, only when a gang
+        victim is actually present; ``list_pods`` lets the flush share a
+        single scan across its whole backlog). Returns ``(victims,
+        blocked)``: pulled-in co-members bypassed candidate selection, so
+        they get their own guard here — one outranking the preemptor or
+        violating an exhausted PDB blocks the WHOLE gang eviction
+        (partial eviction is never an option)."""
+        keys = {k for v in victims
+                if LABEL_POD_GROUP in v.metadata.labels
+                and (k := pod_group_key(v)) is not None}
+        if not keys:
+            return victims, ""
+        have = {v.metadata.uid for v in victims}
+        extra = []
+        pods = list_pods() if list_pods is not None else \
+            self.hub.list_pods()
+        for p in pods:
+            if p.metadata.uid in have or not p.spec.node_name:
+                continue
+            if pod_group_key(p) in keys:
+                extra.append(p)
+        if extra and preemptor is not None:
+            outranking = [p for p in extra
+                          if p.priority() >= preemptor.priority()]
+            if outranking:
+                return victims, (f"gang co-member {outranking[0].key()} "
+                                 "outranks the preemptor")
+            try:
+                pdbs = self.hub.list_pdbs()
+            except Unavailable:
+                raise
+            # the original victims evict in the same flush, so they draw
+            # the PDB budgets down first — a co-member is only safe
+            # against what remains, not against a fresh budget
+            flags = self._pdb_violation_flags(victims + extra,
+                                              pdbs)[len(victims):]
+            if any(flags):
+                protected = extra[flags.index(True)]
+                return victims, (f"gang co-member {protected.key()} is "
+                                 "protected by an exhausted PDB")
+        return victims + extra, ""
 
     def _reprieve_by_resources(self, victims: list[Pod], pod: Pod,
                                row: int, free_mat: np.ndarray) -> list[Pod]:

@@ -38,6 +38,7 @@ from kubernetes_tpu.api.objects import (
     Pod,
     PodCondition,
     PodDisruptionBudget,
+    PodGroup,
     PriorityClass,
     ResourceClaim,
     ResourceSlice,
@@ -148,6 +149,8 @@ class Hub:
                                       _by_name)
         self._csi_capacities = _Store("CSIStorageCapacity",
                                       "csi_capacities")
+        # gang scheduling: PodGroup declares min_member + tenant queue
+        self._pod_groups = _Store("PodGroup", "pod_groups", _by_key)
         # core/v1 Event analog, deduped by (ref, reason) with a count
         # bump — how controllers surface object-level failures (e.g. a
         # DeviceClass whose CEL selector does not compile)
@@ -160,7 +163,7 @@ class Hub:
                 self._namespaces, self._pdbs, self._pvcs, self._pvs,
                 self._storage_classes, self._claims, self._slices,
                 self._claim_templates, self._device_classes,
-                self._csi_capacities, self._events)}
+                self._csi_capacities, self._pod_groups, self._events)}
         self.journal = Journal(capacity=journal_capacity,
                                wal_path=wal_path)
         if wal_path:
@@ -324,6 +327,10 @@ class Hub:
         return self._watch_store(self._csi_capacities, h, replay,
                                  since_rv)
 
+    def watch_pod_groups(self, h: EventHandlers, replay: bool = True,
+                         since_rv: int | None = None) -> int:
+        return self._watch_store(self._pod_groups, h, replay, since_rv)
+
     def unwatch(self, h: EventHandlers) -> None:
         """Deregister a handler from every store (watch-stream teardown —
         the transport layer's connection close)."""
@@ -368,12 +375,15 @@ class Hub:
 
     def _delete(self, store: _Store, uid: str) -> None:
         with self._lock:
-            old = store.objects.pop(uid, None)
-            if old is None:
-                raise NotFound(f"{store.kind} {uid}")
-            store.index_remove(old)
-            ev = self._commit(store, "delete", old, None)
+            ev = self._delete_locked(store, uid)
         self._dispatch(store, ev)
+
+    def _delete_locked(self, store: _Store, uid: str) -> JournalEvent:
+        old = store.objects.pop(uid, None)
+        if old is None:
+            raise NotFound(f"{store.kind} {uid}")
+        store.index_remove(old)
+        return self._commit(store, "delete", old, None)
 
     # ------------- nodes -------------
 
@@ -402,8 +412,18 @@ class Hub:
     def update_pod(self, pod: Pod) -> None:
         self._update(self._pods, pod)
 
-    def delete_pod(self, uid: str) -> None:
-        self._delete(self._pods, uid)
+    def delete_pod(self, uid: str, epoch: int | None = None,
+                   lease_name: str = "kube-scheduler") -> None:
+        """Pod deletion, optionally fenced: preemption evictions carry the
+        evicting scheduler's epoch so a deposed leader's QUEUED evictions
+        cannot land after failover (the eviction analog of bind fencing —
+        the new leader may have re-planned around those victims). The
+        fence check and the delete share ONE lock acquisition, like bind
+        — a gap between them would let a deposition land in the window."""
+        with self._lock:
+            self._check_fence("delete_pod", epoch, lease_name)
+            ev = self._delete_locked(self._pods, uid)
+        self._dispatch(self._pods, ev)
 
     def get_pod(self, uid: str) -> Optional[Pod]:
         with self._lock:
@@ -487,10 +507,14 @@ class Hub:
             ev = self._swap_pod(stored, new)
         self._dispatch(self._pods, ev)
 
-    def clear_nominated_node(self, uid: str) -> None:
+    def clear_nominated_node(self, uid: str, epoch: int | None = None,
+                             lease_name: str = "kube-scheduler") -> None:
         """Clear status.nominatedNodeName (preemption.go prepareCandidate
-        clears lower nominations via API so they re-evaluate)."""
+        clears lower nominations via API so they re-evaluate); fenced like
+        bind — a deposed leader's queued nomination clears must not undo
+        the new leader's reservations."""
         with self._lock:
+            self._check_fence("clear_nominated_node", epoch, lease_name)
             stored = self._pods.objects.get(uid)
             if stored is None or not stored.status.nominated_node_name:
                 return
@@ -630,6 +654,26 @@ class Hub:
     def list_device_classes(self) -> list:
         with self._lock:
             return list(self._device_classes.objects.values())
+
+    # ------------- pod groups (gang scheduling) -------------
+
+    def create_pod_group(self, pg: PodGroup) -> None:
+        self._create(self._pod_groups, pg)
+
+    def update_pod_group(self, pg: PodGroup) -> None:
+        self._update(self._pod_groups, pg)
+
+    def delete_pod_group(self, uid: str) -> None:
+        self._delete(self._pod_groups, uid)
+
+    def get_pod_group(self, namespace: str, name: str
+                      ) -> Optional[PodGroup]:
+        with self._lock:
+            return self._pod_groups.by_index(f"{namespace}/{name}")
+
+    def list_pod_groups(self) -> list[PodGroup]:
+        with self._lock:
+            return list(self._pod_groups.objects.values())
 
     # ------------- priority classes -------------
 

@@ -61,6 +61,8 @@ CALL_METHODS = frozenset({
     "create_device_class", "get_device_class", "list_device_classes",
     "create_csi_capacity", "update_csi_capacity", "list_csi_capacities",
     "set_pod_claim_statuses",
+    "create_pod_group", "update_pod_group", "delete_pod_group",
+    "get_pod_group", "list_pod_groups",
     "create_priority_class", "list_priority_classes",
     "record_event", "list_events",
     "get_journal_stats",
@@ -69,7 +71,8 @@ CALL_METHODS = frozenset({
 
 WATCH_KINDS = ("pods", "nodes", "namespaces", "pvcs", "pvs",
                "resource_claims", "resource_slices",
-               "resource_claim_templates", "csi_capacities")
+               "resource_claim_templates", "csi_capacities",
+               "pod_groups")
 
 _ERROR_STATUS = {"Conflict": 409, "NotFound": 404, "ValueError": 400,
                  "TypeError": 400, "Fenced": 403}

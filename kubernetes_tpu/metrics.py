@@ -329,6 +329,26 @@ class SchedulerMetrics:
             "scheduler_condition_patches_dropped_total",
             "Pod condition patches dropped (degraded mode or fenced) "
             "instead of wedging the loop", ("reason",)))
+        # gang scheduling + multi-tenant job queues
+        self.gang_admitted = r.register(Counter(
+            "scheduler_gang_admitted_total",
+            "Gangs whose Permit quorum completed (all members released "
+            "to the binding cycle together)"))
+        self.gang_timeouts = r.register(Counter(
+            "scheduler_gang_timeout_total",
+            "Gang assemblies that hit their schedule timeout before "
+            "min_member members reserved"))
+        self.gang_rollbacks = r.register(Counter(
+            "scheduler_gang_rollback_total",
+            "Gang assemblies rolled back atomically (timeout, member "
+            "failure, or poison quarantine) — every held reservation "
+            "released, no partial gang placed"))
+        self.tenant_queue_depth = r.register(Gauge(
+            "scheduler_tenant_queue_depth",
+            "Pods held in the job-queue layer by tenant"))
+        self.tenant_quota_used = r.register(Gauge(
+            "scheduler_tenant_quota_used",
+            "Admission-time quota reservation by tenant and resource"))
         self.queue_incoming_pods = r.register(Counter(
             "queue_incoming_pods_total",
             "Pods added to scheduling queues by event/queue",

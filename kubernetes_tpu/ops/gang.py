@@ -1,0 +1,49 @@
+"""Device kernels for gang admission: the cheap "can min_member possibly
+fit" bound the GangScheduling PreFilter runs before any member burns a
+scheduling cycle.
+
+``gang_capacity`` computes, in one reduction over the mirror's free
+matrix, an UPPER bound on how many identical members of the gang the
+cluster can still hold: per node, the member count is the floor of
+free/request minimized over the resource columns the request actually
+uses (columns with zero request don't bind); the cluster capacity is the
+sum over nodes. A gang whose ``min_member`` exceeds this bound cannot be
+placed by ANY assignment — rejecting it here avoids reserving (and then
+rolling back) members that are doomed, the device-side analog of
+coscheduling's PreFilter quorum check.
+
+The bound is optimistic on purpose (it ignores topology constraints,
+taints, and per-node pod-count interactions with OTHER pods committed in
+the same batch): a false "fits" costs one normal scheduling attempt; a
+false "cannot fit" would wrongly starve a gang, so only the provable
+case rejects.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=())
+def _capacity(free: jnp.ndarray, req: jnp.ndarray) -> jnp.ndarray:
+    """[N, R] free x [R] request -> scalar i32 member-capacity bound."""
+    active = req > 0.0
+    safe_req = jnp.where(active, req, 1.0)
+    per_col = jnp.floor(jnp.maximum(free, 0.0) / safe_req)
+    per_col = jnp.where(active[None, :], per_col, jnp.float32(2 ** 30))
+    per_node = jnp.min(per_col, axis=1)
+    # a request with NO active columns fits anywhere: cap at a big count
+    any_active = jnp.any(active)
+    total = jnp.sum(jnp.clip(per_node, 0.0, 2.0 ** 30))
+    return jnp.where(any_active, total,
+                     jnp.float32(2 ** 30)).astype(jnp.int32)
+
+
+def gang_capacity(free, req) -> int:
+    """Cluster-wide bound on how many ``req``-shaped members still fit
+    (device reduction; one small D2H scalar pull)."""
+    return int(_capacity(jnp.asarray(free, jnp.float32),
+                         jnp.asarray(req, jnp.float32)))

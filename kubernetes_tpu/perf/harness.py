@@ -121,6 +121,14 @@ class Workload:
     # resourceclaim controller runs in kube-controller-manager): needed by
     # claim-TEMPLATE workloads, whose claims the controller materializes
     dra_claim_controller: bool = False
+    # multi-tenant job queues: tenant name -> {"weight", "quota"} merged
+    # onto SchedulerConfiguration.tenants for this workload
+    tenants: dict = field(default_factory=dict)
+    # gang workloads: op counts must stay GANG-ALIGNED, so the uniform
+    # per-op scaling would strand partial gangs behind min_member — the
+    # factory rebuilds the whole workload at the requested scale instead
+    # (capacities/batch stay identical, so jit shapes are preserved)
+    rescale: Optional[Callable[[float], "Workload"]] = None
 
     def __post_init__(self) -> None:
         if not self.baseline:
@@ -203,6 +211,9 @@ def run_workload(w: Workload, now: Callable[[], float] = time.time,
     duration in seconds — exact samples, not bucket-resolution histogram
     reads — for the --trace-overhead on/off comparison.
     """
+    if scale != 1.0 and w.rescale is not None:
+        w = w.rescale(scale)
+        scale = 1.0
     hub = Hub()
     if w.dra_claim_controller:
         from kubernetes_tpu.plugins.dra import ResourceClaimController
@@ -210,6 +221,8 @@ def run_workload(w: Workload, now: Callable[[], float] = time.time,
         ResourceClaimController(hub)
     cfg = copy.deepcopy(config) if config is not None else default_config()
     cfg.batch_size = w.batch_size
+    if w.tenants:
+        cfg.tenants = {**cfg.tenants, **w.tenants}
     cfg.feature_gates.update(w.feature_gates)
     sched = Scheduler(hub, cfg, caps=Capacities(
         nodes=w.node_capacity, pods=w.pod_capacity), now=now)
@@ -333,6 +346,11 @@ def run_workload(w: Workload, now: Callable[[], float] = time.time,
                 m.schedule_attempts._values.values())),
         },
     }
+    if sched.jobqueue.active:
+        # per-tenant admission/fairness accounting for the gang-storm
+        # artifact rows (weights should show up as contended ratios)
+        result["tenants"] = sched.jobqueue.tenant_stats()
+        result["gangs"] = sched._gang.debug_state()["stats"]
     if profile:
         fl = sched.flight
         result["flight"] = {

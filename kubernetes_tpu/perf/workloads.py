@@ -21,6 +21,8 @@ from kubernetes_tpu.api.objects import (
     Affinity,
     Container,
     LABEL_HOSTNAME,
+    LABEL_POD_GROUP,
+    LABEL_QUEUE,
     LABEL_ZONE,
     LabelSelector,
     Node,
@@ -35,6 +37,7 @@ from kubernetes_tpu.api.objects import (
     PodAffinity,
     PodAffinityTerm,
     PodAntiAffinity,
+    PodGroup,
     PodSpec,
     ResourceRequirements,
     TopologySpreadConstraint,
@@ -952,6 +955,149 @@ def ns_selector_preferred_anti_affinity(init_nodes=5000, init_pods=1000,
         ])
 
 
+# ------------------------------------- 26-28. gang / multi-tenant (ISSUE 6)
+# The multi-tenant job-storm workload class the gang subsystem opens
+# (Kant, PAPERS.md): PodGroups with mixed gang sizes 2-64 across weighted
+# tenants, quota exhaustion that must not starve other tenants, and
+# priority preemption of whole gangs. No reference floors exist for
+# these — the thresholds are OUR floors, set from the first measured
+# round and ratcheted like the rest of the table. All three carry a
+# ``rescale`` hook: op counts must stay gang-aligned, so the harness's
+# uniform per-op warmup scaling would strand partial gangs behind
+# min_member; the factory rebuilds the whole workload at the requested
+# scale instead (capacities/batch stay identical, preserving jit shapes).
+
+GANG_SIZES = (2, 4, 8, 16, 32, 64)
+
+
+def _gang_member(name: str, gang: str, tenant: str, cpu: str = "100m",
+                 priority: int | None = None) -> Pod:
+    p = _pod(name, cpu=cpu, mem="200Mi", priority=priority)
+    p.metadata.labels[LABEL_POD_GROUP] = gang
+    p.metadata.labels[LABEL_QUEUE] = tenant
+    return p
+
+
+def _tenant_pod(name: str, tenant: str, cpu: str = "100m") -> Pod:
+    p = _pod(name, cpu=cpu, mem="200Mi")
+    p.metadata.labels[LABEL_QUEUE] = tenant
+    return p
+
+
+def multi_tenant_gang_storm(init_nodes=500,
+                            gangs_per_tenant=24) -> Workload:
+    """Two weighted tenants (2:1), mixed gang sizes 2-64: every gang
+    admits whole through the DRR queue and commits through Permit; the
+    artifact's per-tenant ``contended_admitted`` ratio is the fairness
+    number (≈ the weight ratio while both tenants have backlog)."""
+    plan = []        # (gang name, tenant, size)
+    for tenant in ("tenant-a", "tenant-b"):
+        for g in range(gangs_per_tenant):
+            plan.append((f"{tenant}-job-{g}", tenant,
+                         GANG_SIZES[g % len(GANG_SIZES)]))
+    members = [(f"{gang}-m{m}", gang, tenant)
+               for gang, tenant, size in plan for m in range(size)]
+
+    def mkgroup(i: int) -> PodGroup:
+        gang, tenant, size = plan[i]
+        return PodGroup(metadata=ObjectMeta(name=gang),
+                        min_member=size, queue=tenant,
+                        schedule_timeout_seconds=120.0)
+
+    def mkpod(i: int) -> Pod:
+        name, gang, tenant = members[i]
+        return _gang_member(name, gang, tenant)
+
+    return Workload(
+        name="MultiTenantGangStorm/500Nodes",
+        threshold=25,
+        node_capacity=1024,
+        batch_size=1024,
+        tenants={"tenant-a": {"weight": 2.0},
+                 "tenant-b": {"weight": 1.0}},
+        ops=[
+            CreateNodes(init_nodes, _node),
+            CreateObjects(len(plan), mkgroup,
+                          create_verb="create_pod_group"),
+            CreatePods(len(members), mkpod, collect_metrics=True),
+        ],
+        rescale=lambda s: multi_tenant_gang_storm(
+            init_nodes=max(8, int(init_nodes * s)),
+            gangs_per_tenant=max(1, int(gangs_per_tenant * s))))
+
+
+def quota_exhaustion_churn(init_nodes=200, blocked_pods=400,
+                           quota_pods=100, measure_pods=2000) -> Workload:
+    """A burst tenant whose demand exceeds its pod quota (only
+    ``quota_pods`` admit; the rest hold in its job queue) while an
+    unconstrained steady tenant's measured pods must flow at full rate —
+    the "blocked tenants don't starve others" criterion."""
+    return Workload(
+        name="QuotaExhaustionChurn/200Nodes",
+        threshold=150,
+        node_capacity=1024,
+        batch_size=1024,
+        tenants={"burst": {"quota": {"pods": str(quota_pods)}},
+                 "steady": {}},
+        ops=[
+            CreateNodes(init_nodes, _node),
+            CreatePods(blocked_pods,
+                       lambda i: _tenant_pod(f"burst-{i}", "burst"),
+                       wait=False),    # over-quota tail never schedules
+            CreatePods(measure_pods,
+                       lambda i: _tenant_pod(f"steady-{i}", "steady"),
+                       collect_metrics=True),
+        ],
+        rescale=lambda s: quota_exhaustion_churn(
+            init_nodes=max(8, int(init_nodes * s)),
+            blocked_pods=max(4, int(blocked_pods * s)),
+            quota_pods=max(1, int(quota_pods * s)),
+            measure_pods=max(4, int(measure_pods * s))))
+
+
+def gang_preemption(init_nodes=128, high_gangs=24) -> Workload:
+    """Whole-gang priority preemption: low-priority gangs of 4 saturate
+    the cluster's CPU; measured high-priority gangs of 4 must evict
+    ENTIRE lower gangs (never a slice) to land — the eviction path runs
+    through the fenced flush + _expand_gang_victims."""
+    low_gangs = init_nodes               # 4 x 900m per 4-cpu node
+    low = [(f"low-{g}-m{m}", f"low-{g}") for g in range(low_gangs)
+           for m in range(4)]
+    high = [(f"high-{g}-m{m}", f"high-{g}") for g in range(high_gangs)
+            for m in range(4)]
+
+    def mkgroup(i: int) -> PodGroup:
+        if i < low_gangs:
+            name, prio = f"low-{i}", 0
+        else:
+            name, prio = f"high-{i - low_gangs}", 10
+        return PodGroup(metadata=ObjectMeta(name=name), min_member=4,
+                        queue="jobs", priority=prio,
+                        schedule_timeout_seconds=120.0)
+
+    return Workload(
+        name="GangPreemption/128Nodes",
+        threshold=30,
+        node_capacity=256,
+        batch_size=512,
+        ops=[
+            CreateNodes(init_nodes, _node),
+            CreateObjects(low_gangs + high_gangs, mkgroup,
+                          create_verb="create_pod_group"),
+            CreatePods(len(low),
+                       lambda i: _gang_member(low[i][0], low[i][1],
+                                              "jobs", cpu="900m")),
+            CreatePods(len(high),
+                       lambda i: _gang_member(high[i][0], high[i][1],
+                                              "jobs", cpu="900m",
+                                              priority=10),
+                       collect_metrics=True),
+        ],
+        rescale=lambda s: gang_preemption(
+            init_nodes=max(4, int(init_nodes * s)),
+            high_gangs=max(1, int(high_gangs * s))))
+
+
 # every thresholded reference workload — bench.py runs the whole list,
 # one subprocess each, and publishes every row in its JSON (bench.py
 # mirrors these BY NAME in BENCH_WORKLOAD_FNS —
@@ -984,6 +1130,9 @@ BENCH_WORKLOADS = (
     scheduling_basic_qhints,
     preemption_async_enabled,
     ns_selector_preferred_anti_affinity,
+    multi_tenant_gang_storm,
+    quota_exhaustion_churn,
+    gang_preemption,
 )
 
 ALL_WORKLOADS = BENCH_WORKLOADS

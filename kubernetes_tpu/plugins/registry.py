@@ -200,6 +200,18 @@ def in_tree_registry() -> dict[str, PluginDescriptor]:
         PluginDescriptor(
             name="DefaultBinder", points=("bind",),
             factory=lambda args: DefaultBinder(args.get("binder"))),
+        # gang scheduling: PreFilter capacity bound + Permit quorum
+        # assembly + unreserve-driven atomic rollback (plugins/gang.py);
+        # the shared coordinator is injected by the scheduler
+        PluginDescriptor(
+            name="GangScheduling", points=("filter", "reserve", "permit"),
+            factory=lambda args: args.get("gang_shared"),
+            events=[_ev(R.POD_GROUP, A.ADD | A.UPDATE),
+                    # ADD: a peer's bind advances a parked member's
+                    # quorum (the permit-timeout retry path after
+                    # failover); DELETE: freed capacity + shrunk gangs
+                    _ev(R.ASSIGNED_POD, A.ADD | A.DELETE),
+                    _ev(R.NODE, A.ADD | A.UPDATE_NODE_ALLOCATABLE)]),
         # --- volume family: host Filter plugins (plugins/volume.py) ---
         PluginDescriptor(
             name="VolumeZone", points=("filter",),

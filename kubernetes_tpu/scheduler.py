@@ -24,11 +24,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubernetes_tpu.api.objects import (
+    LABEL_POD_GROUP,
     Node,
     Pod,
     PodCondition,
+    pod_group_key,
 )
 from kubernetes_tpu.backend.cache import Cache
+from kubernetes_tpu.backend.jobqueue import JobQueue
 from kubernetes_tpu.backend.mirror import (
     CapacityError,
     Mirror,
@@ -144,13 +147,25 @@ class Scheduler:
             hub, lambda: self.mirror, lambda: self.caps,
             self._filters_for, self.nominator)
         from kubernetes_tpu.plugins.dra import DynamicResources
+        from kubernetes_tpu.plugins.gang import GangScheduling
 
         self._dra = DynamicResources(hub)
+        # the gang coordinator is shared across profiles like the DRA
+        # manager: quorum counting must see every profile's reservations
+        self._gang = GangScheduling(hub=hub,
+                                    mirror_fn=lambda: self.mirror,
+                                    now=now)
+        # the multi-tenant job-queue layer in front of the activeQ; pods
+        # without tenant/gang labels never touch it (jobqueue.active
+        # gates the per-cycle release step)
+        self.jobqueue = JobQueue(self.config.tenants, now=now,
+                                 bound_fn=self._gang.bound_count)
         extra = {"binder": self._fenced_bind, "hub": hub,
                  "preemption_evaluator": self.preemption,
                  # shared across profiles (SharedDRAManager analog): one
                  # assume overlay must see every profile's allocations
-                 "dra_shared": self._dra}
+                 "dra_shared": self._dra,
+                 "gang_shared": self._gang}
         # one resolved framework per profile (profile/profile.go:47 Map);
         # frameworkForPod routes each pod by spec.schedulerName
         self.frameworks = {
@@ -183,8 +198,17 @@ class Scheduler:
             initial_backoff=self.config.pod_initial_backoff_seconds,
             max_backoff=self.config.pod_max_backoff_seconds,
             now=now)
+        for fw in self.frameworks.values():
+            self._gang.register_waiting_map(fw.waiting_pods)
         self.metrics = SchedulerMetrics(
             pending_fn=self.queue.pending_counts)
+        self._gang.metrics = self.metrics
+        # fenced evictions/nomination-clears: the evaluator's queued hub
+        # writes carry the epoch their flush runs under, so a deposed
+        # leader's backlog is rejected instead of landing after failover
+        self.preemption.fencing_fn = self._fencing_args
+        self.preemption.fenced_metric = (
+            lambda verb: self.metrics.fenced_writes.inc(verb=verb))
         # the always-on flight recorder: every cycle's fine-grained
         # phases into a bounded ring + the phase/plugin histograms
         # (utils/tracing.FlightRecorder); per-pod lifecycle timelines
@@ -382,6 +406,23 @@ class Scheduler:
                         self.queue.move_all_to_active_or_backoff(
                             ClusterEvent(R.CSI_STORAGE_CAPACITY, A.UPDATE),
                             old, new))))
+        self.hub.watch_pod_groups(EventHandlers(
+            on_add=w(lambda g: self._on_group_set(g, A.ADD)),
+            on_update=w(lambda old, new: self._on_group_set(new, A.UPDATE)),
+            on_delete=w(self._on_group_delete)))
+
+    def _on_group_set(self, group, action) -> None:
+        """A PodGroup arrived/changed: the job queue may now release its
+        orphaned members, the gang coordinator refreshes min_member and
+        timeout, and parked members get a requeue chance."""
+        self.jobqueue.set_group(group)
+        self._gang.set_group(group)
+        self.queue.move_all_to_active_or_backoff(
+            ClusterEvent(R.POD_GROUP, action), None, group)
+
+    def _on_group_delete(self, group) -> None:
+        self.jobqueue.remove_group(group.key())
+        self._gang.remove_group(group.key())
 
     def _invalidate_chain(self) -> None:
         """Drop the device-resident usage chain and bump the epoch so a
@@ -444,6 +485,25 @@ class Scheduler:
         re-fetches hub truth, so nothing else to track here."""
         return pod.metadata.uid in self._quarantine
 
+    def _enqueue_fresh(self, pod: Pod) -> None:
+        """Route a pending pod to its queue: tenant/gang pods go through
+        the job-queue layer (DRR + quota + gang gating), everything else
+        straight to the activeQ — two dict probes for plain pods."""
+        if self.jobqueue.wants(pod) \
+                and not self.jobqueue.was_admitted(pod.metadata.uid):
+            self.jobqueue.add(pod)
+        else:
+            self.queue.add(pod)
+
+    def _note_bound_pod(self, pod: Pod) -> None:
+        """Bound-pod observation for the gang/tenant bookkeeping (quorum
+        counting across failover, quota replay after restart)."""
+        if LABEL_POD_GROUP in pod.metadata.labels:
+            self._gang.note_bound(pod)
+        if self.jobqueue.wants(pod):
+            self.jobqueue.remove(pod)       # no longer queued here
+            self.jobqueue.note_bound(pod)
+
     def _on_pod_add(self, pod: Pod) -> None:
         if self._pod_event_stale(pod):
             return
@@ -451,6 +511,7 @@ class Scheduler:
             if not self.cache.is_assumed_pod(pod):
                 self._invalidate_chain()
             self.cache.add_pod(pod)
+            self._note_bound_pod(pod)
             self.queue.move_all_to_active_or_backoff(
                 ClusterEvent(R.ASSIGNED_POD, A.ADD), None, pod)
         elif not self._terminal(pod) and self._ours(pod) \
@@ -462,7 +523,7 @@ class Scheduler:
                 self.nominator.add(pod, pod.status.nominated_node_name)
             if self.flight.enabled:
                 self.timelines.event(pod, "enqueued")
-            self.queue.add(pod)
+            self._enqueue_fresh(pod)
 
     def _on_pod_update(self, old: Pod, new: Pod) -> None:
         if self._pod_event_stale(new):
@@ -482,21 +543,38 @@ class Scheduler:
                 # freshly bound (possibly by us): informer truth confirms
                 self.cache.add_pod(new)
                 self.queue.delete(new)
+                self._note_bound_pod(new)
                 self.queue.move_all_to_active_or_backoff(
                     ClusterEvent(R.ASSIGNED_POD, A.ADD), old, new)
         elif not self._terminal(new) and self._ours(new) \
                 and not self._quarantine_holds(new):
             self.nominator.update(new)
-            self.queue.update(old, new)
+            if self.jobqueue.active \
+                    and self.jobqueue.holds(new.metadata.uid):
+                self.jobqueue.update(new)
+            else:
+                self.queue.update(old, new)
 
     def _on_pod_delete(self, pod: Pod) -> None:
         # deletes always win: tombstone at max rv so a straggling update
         # for the dead pod can't resurrect it in the cache; tombstones age
         # out of a bounded FIFO instead of a wholesale clear
         uid = pod.metadata.uid
-        self._quarantine.pop(uid, None)
+        was_quarantined = self._quarantine.pop(uid, None) is not None
         self._fault_strikes.pop(uid, None)
         self._quarantine_counts.pop(uid, None)
+        if self.jobqueue.active and self.jobqueue.wants(pod):
+            # credit the tenant's quota reservation; drop queued copies
+            self.jobqueue.remove(pod)
+        gang = pod_group_key(pod)
+        if gang is not None:
+            if pod.spec.node_name:
+                self._gang.note_unbound(pod)
+            if was_quarantined:
+                # the poisoned member is gone: the rest of the gang may
+                # schedule again once NO member remains quarantined
+                # (re-offense re-poisons)
+                self._gang.release_poison(gang, uid)
         self._pod_rv[uid] = 2 ** 62
         self._rv_tombstones.append(uid)
         if len(self._rv_tombstones) > 50_000:
@@ -907,6 +985,13 @@ class Scheduler:
         if self.flight.enabled:
             self.timelines.event(qp.pod, "quarantined",
                                  f"{backoff:.0f}s: {reason}")
+        gang = pod_group_key(qp.pod)
+        if gang is not None:
+            # a poisoned member poisons the WHOLE gang: members reject at
+            # PreFilter/Reserve and any assembling reservation rolls back
+            # — a gang placed around its poisoned member would violate
+            # all-or-nothing (released with this pod's quarantine)
+            self._gang.poison(gang, reason, uid)
         logger.error("quarantining pod %s for %.0fs (offense %d): %s",
                      qp.pod.key(), backoff, n, reason)
         try:
@@ -932,10 +1017,13 @@ class Scheduler:
             except Unavailable:
                 self._note_hub_down()
                 continue            # retry on the next tick
-            del self._quarantine[uid]
+            entry = self._quarantine.pop(uid)
+            gang = pod_group_key(entry["qp"].pod)
+            if gang is not None:
+                self._gang.release_poison(gang, uid)
             if stored is not None and not stored.spec.node_name \
                     and not self._terminal(stored):
-                self.queue.add(stored)
+                self._enqueue_fresh(stored)
         self.metrics.quarantined_pods.set(float(len(self._quarantine)))
 
     def quarantined_uids(self) -> set[str]:
@@ -1398,6 +1486,8 @@ class Scheduler:
         with self._lock:
             self._process_deferred_events()
             self._process_waiting()
+            if self.jobqueue.active:
+                self.jobqueue.release(self.queue, self.config.batch_size)
             popped, runnable = self._pop_runnable()
             if popped == 0:
                 self._drain_bind_results(wait=True)
@@ -2034,6 +2124,17 @@ class Scheduler:
             self._mirror_count(f"cel:{src}", n, m.dra_cel_errors,
                                source=src)
         self._mirror_journal_stats()
+        if self.jobqueue.active:
+            for tenant, st in self.jobqueue.tenant_stats().items():
+                m.tenant_queue_depth.set(float(st["depth"]),
+                                         tenant=tenant)
+                u = st["usage"]
+                m.tenant_quota_used.set(float(u["cpu_milli"]),
+                                        tenant=tenant, resource="cpu_milli")
+                m.tenant_quota_used.set(float(u["memory"]),
+                                        tenant=tenant, resource="memory")
+                m.tenant_quota_used.set(float(u["pods"]),
+                                        tenant=tenant, resource="pods")
         cs = getattr(self.hub, "chaos_stats", None)
         if cs is not None:
             for kind, v in cs().items():
@@ -2193,10 +2294,23 @@ class Scheduler:
                 gc_guard.idle_sweep()
             if on_step is not None and on_step():
                 break
+            if self.jobqueue.active:
+                # admit tenant/gang work by DRR + quota before the pop
+                self.jobqueue.release(self.queue, self.config.batch_size)
             popped, runnable = self._pop_runnable()
             if popped == 0:
                 flush_all()
+                # the flush may have completed a gang quorum (Permit
+                # allowed the waiting peers): harvest them into the
+                # binding cycle BEFORE deciding the queue is idle, or a
+                # drain ends with allowed pods stranded in the wait room
+                self._process_waiting()
                 self.queue.flush_backoff_completed()
+                # a drained wait room or a churn event may have refilled
+                # the job queue mid-iteration
+                if self.jobqueue.active:
+                    self.jobqueue.release(self.queue,
+                                          self.config.batch_size)
                 popped, runnable = self._pop_runnable()
                 if popped == 0:
                     break

@@ -79,9 +79,21 @@ class ServingEndpoints:
                     body = json.dumps(sched.cache.dump(), indent=2,
                                       default=str)
                 elif path == "/debug/queue":
-                    body = json.dumps(
-                        {"pending": sched.queue.pending_counts(),
-                         "stats": sched.stats}, indent=2, default=str)
+                    payload = {"pending": sched.queue.pending_counts(),
+                               "stats": sched.stats}
+                    jq = getattr(sched, "jobqueue", None)
+                    if jq is not None and jq.active:
+                        # per-tenant job queues + assembling gangs
+                        payload["job_queue"] = jq.debug_state()
+                    gang = getattr(sched, "_gang", None)
+                    if gang is not None:
+                        payload["gangs"] = gang.debug_state()
+                    payload["waiting_pods"] = {
+                        name: [wp.uid for wp in fw.waiting_pods.iterate()]
+                        for name, fw in getattr(sched, "frameworks",
+                                                {}).items()
+                        if len(fw.waiting_pods)}
+                    body = json.dumps(payload, indent=2, default=str)
                 elif path == "/debug/journal":
                     js_fn = getattr(sched.hub, "get_journal_stats", None)
                     body = json.dumps(js_fn() if js_fn else {}, indent=2,

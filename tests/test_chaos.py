@@ -704,6 +704,71 @@ def test_fenced_error_roundtrips_the_wire(proxied_hub):
     assert hub.get_pod(pod.metadata.uid).spec.node_name == "n"
 
 
+def test_deposed_leader_evictions_and_clears_are_fenced():
+    """Regression (ROADMAP carried-over gap): a deposed leader's QUEUED
+    preemption evictions and nomination clears must be rejected Fenced at
+    the hub — the new leader may have re-planned around those victims —
+    and the whole backlog dropped, not replayed under a newer epoch."""
+    from kubernetes_tpu.framework.preemption import Candidate
+    from kubernetes_tpu.leaderelection import Lease
+
+    hub = Hub()
+    hub.create_node(MakeNode().name("n").capacity(cpu="8").obj())
+    victim = MakePod().name("victim").req(cpu="100m").obj()
+    victim.spec.node_name = "n"
+    hub.create_pod(victim)
+    nominee = MakePod().name("nominee").req(cpu="100m").obj()
+    nominee.status.nominated_node_name = "n"
+    hub.create_pod(nominee)
+    sched = Scheduler(hub, default_config(),
+                      caps=Capacities(nodes=8, pods=64))
+    try:
+        # another scheduler took the lease: our (fake) elector's epoch 0
+        # predates its acquisition — every fenced write must bounce
+        hub.leases.update(Lease(name="kube-scheduler",
+                                holder_identity="other"), None)
+
+        class Tok:
+            epoch = 0
+            lease_name = "kube-scheduler"
+
+        sched._elector = Tok()
+        preemptor = MakePod().name("preemptor").req(cpu="100m").obj()
+        sched.preemption.prepare_candidate(
+            Candidate(node_name="n", row=0, victims=[victim],
+                      pdb_violations=0), preemptor)
+        sched.preemption.flush_evictions()
+        assert hub.get_pod(victim.metadata.uid) is not None, \
+            "a deposed leader's queued eviction must NOT land"
+        assert sched.metrics.fenced_writes.value(verb="delete_pod") == 1
+        assert not sched.preemption._pending, \
+            "the eviction backlog must be dropped, not replayed"
+        assert preemptor.metadata.uid not in sched.preemption.preempting, \
+            "stranded preemptors must be ungated for the retry path"
+        # deferred nomination-clear replays are fenced the same way
+        sched.preemption._pending_clears.append(nominee.metadata.uid)
+        sched.preemption.flush_evictions()
+        assert hub.get_pod(
+            nominee.metadata.uid).status.nominated_node_name == "n", \
+            "a deposed leader's queued nomination clear must NOT land"
+        assert sched.metrics.fenced_writes.value(
+            verb="clear_nominated_node") == 1
+        assert not sched.preemption._pending_clears
+        # re-elected with the CURRENT epoch, the same flush goes through
+        class Tok2:
+            epoch = hub.leases.epoch_of("kube-scheduler")
+            lease_name = "kube-scheduler"
+
+        sched._elector = Tok2()
+        sched.preemption._pending_clears.append(nominee.metadata.uid)
+        sched.preemption.flush_evictions()
+        assert hub.get_pod(
+            nominee.metadata.uid).status.nominated_node_name == ""
+    finally:
+        sched._elector = None
+        sched.close()
+
+
 @pytest.mark.quarantine
 def test_device_fault_storm_ladder_and_quarantine():
     """The device-fault storm gate, small: injected launch errors +
@@ -811,4 +876,17 @@ def test_chaos_crash_storm():
     from kubernetes_tpu.chaos import run_crash_storm
 
     report = run_crash_storm(pods=150, nodes=8, seed=13, timeout_s=120.0)
+    assert report["ok"], report
+
+
+@pytest.mark.slow
+@pytest.mark.gang
+def test_chaos_gang_storm():
+    """Gang atomicity under leader kill mid-commit, scaled down for the
+    suite: every gang lands fully or not at all (zero partial gangs on
+    the bind ledger), no duplicate binds, no leaked assumed pods
+    (bench.py --chaos-smoke runs it at full size)."""
+    from kubernetes_tpu.chaos import run_gang_storm
+
+    report = run_gang_storm(gangs=6, nodes=10, seed=17, timeout_s=150.0)
     assert report["ok"], report

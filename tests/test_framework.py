@@ -37,7 +37,8 @@ def test_default_expansion():
         "NodeUnschedulable", "NodeName", "TaintToleration", "NodeAffinity",
         "NodePorts", "NodeResourcesFit", "VolumeRestrictions",
         "NodeVolumeLimits", "VolumeBinding", "VolumeZone",
-        "DynamicResources", "PodTopologySpread", "InterPodAffinity"]
+        "DynamicResources", "PodTopologySpread", "InterPodAffinity",
+        "GangScheduling"]
     scores = dict(fw.points["score"])
     assert scores["TaintToleration"] == 3
     assert scores["NodeAffinity"] == 2
@@ -52,8 +53,9 @@ def test_disable_star_wipes_point():
     fw = mkfw(lambda p: setattr(p.plugins, "score",
                                 PluginSet(disabled=[Plugin("*")])))
     assert fw.points["score"] == []
-    # filters untouched (8 device + 4 volume + DynamicResources host)
-    assert len(fw.points["filter"]) == 13
+    # filters untouched (8 device + 4 volume + DynamicResources +
+    # GangScheduling host)
+    assert len(fw.points["filter"]) == 14
 
 
 def test_disable_single_filter_reflected_in_device_flags():
