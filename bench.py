@@ -1,4 +1,4 @@
-"""Headline benchmark: production-path scheduling throughput, 25 workloads.
+"""Headline benchmark: production-path scheduling throughput, 30 workloads.
 
 Drives EVERY thresholded reference scheduler_perf workload (BASELINE.md's
 full table: the 5 BASELINE.json headliners plus the affinity, spreading,
@@ -37,7 +37,7 @@ BASELINE_PODS_PER_SEC = 270.0  # misc/performance-config.yaml:63
 
 # the committed artifact README.md's bench table is generated from; a
 # new measurement round commits a new artifact and re-points this
-README_BENCH_ARTIFACT = "BENCH_r06_builder.json"
+README_BENCH_ARTIFACT = "BENCH_r07_builder.json"
 _TABLE_BEGIN = "<!-- BENCH_TABLE_BEGIN"
 _TABLE_END = "<!-- BENCH_TABLE_END -->"
 
@@ -115,6 +115,8 @@ BENCH_WORKLOAD_FNS = (
     "ns_selector_anti_affinity",
     "dra_steady_state",
     "dra_steady_state_templates",
+    "dra_steady_state_cel_in",
+    "dra_multi_request",
     "scheduling_pod_affinity",
     "mixed_scheduling_base_pod",
     "ns_selector_pod_affinity",
@@ -136,6 +138,7 @@ BENCH_WORKLOAD_FNS = (
 PROFILE_WORKLOAD_FNS = (
     "scheduling_daemonset",
     "mixed_churn",
+    "dra_steady_state",
     "dra_steady_state_templates",
 )
 

@@ -147,6 +147,10 @@ class LaunchSpec:
     gid: jnp.ndarray | None = None
     rep: jnp.ndarray | None = None
     g_cap: int = 0
+    # batched DRA allocator inputs (ops.dra.DraBatch), attached by the
+    # Scheduler after prepare_launch when the batch carries device-routed
+    # claim pods; None compiles the DRA kernel out of the launch
+    dra: object | None = None
 
 
 class CapacityError(Exception):

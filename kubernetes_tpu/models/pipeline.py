@@ -178,6 +178,12 @@ class BatchResult:
     # it). A cheap reduction computed on device; the scheduler pulls it
     # with node_row and degrades the batch to the host path when set.
     guard: jax.Array
+    # [B] i32: nodes rejected by the fused DRA device allocator (first-
+    # fail after the static filters; zeros when the launch carried no
+    # DraBatch). Pulled only on failure — the scheduler folds it into
+    # the pod's host_reject_counts under "DynamicResources" so diagnosis
+    # and requeue hints match the host filter path exactly.
+    dra_reject: jax.Array
 
 
 # workload-activity flags (STATIC, host-derived per launch by
@@ -237,7 +243,8 @@ def tie_perturb(b, n: int) -> jnp.ndarray:
 
 def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
                    img, unres, weights, free0, nzr0, host_score=None,
-                   fit_strategy="LeastAllocated", fit_shape=None):
+                   fit_strategy="LeastAllocated", fit_shape=None,
+                   dra_reject=None):
     """Parallel auction replacing the per-pod commit scan when the batch has
     no topology constraints and no host ports: every round, all unplaced
     pods score+argmax in parallel; per node, pods are accepted in BATCH
@@ -329,7 +336,9 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
                        reject_counts=reject_counts,
                        unresolvable_count=unres, free=free, nzr=nzr,
                        pct_start=jnp.int32(0),
-                       guard=_guard_reduction(win, free))
+                       guard=_guard_reduction(win, free),
+                       dra_reject=(jnp.zeros((B,), jnp.int32)
+                                   if dra_reject is None else dra_reject))
 
 
 def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
@@ -351,6 +360,7 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                    fit_shape=None,
                    pct_nodes: int = 0,
                    pct_start: jnp.ndarray | None = None,
+                   dra=None,
                    ) -> BatchResult:
     """Schedule a whole pod batch in one launch, as-if-serial (see module
     docstring for the two-phase structure).
@@ -386,7 +396,13 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
     ``host_ok``/``host_score`` ([B, N] bool / f32) carry HOST plugin
     verdicts (volume family, custom plugins): the host filter mask is ANDed
     into every pod's feasible set, the host score added to the aggregate —
-    the mixed host/device framework's seam (runtime.run_host_filters)."""
+    the mixed host/device framework's seam (runtime.run_host_filters).
+
+    ``dra`` (an ops.dra.DraBatch, or None for launches without device-
+    routed claim pods) fuses the batched DRA allocator into this same
+    program: claim feasibility for every (pod, node) pair is one more
+    vmapped predicate ANDed into the feasible mask, and the per-pod
+    rejected-node count lands in BatchResult.dra_reject."""
     ct = unpack_cluster(cblobs, caps)
     pods = unpack_pods(pblobs, caps, pfields, ptmpl)  # leaves [B, ...]
     free0 = ct.free if state is None else state[0]
@@ -470,6 +486,18 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
     else:
         outs = chunked_vmap(per_pod, pods, B_all)
     (static_ok, static_rejects, taint_raw, aff_raw, img, unres) = outs
+    if dra is not None:
+        # fused batched DRA allocator (ops/dra.py): claim feasibility
+        # for all (pod, node) pairs in this same launch. First-fail
+        # attribution after the static filters; host_ok rejects stay
+        # host-attributed like before.
+        from kubernetes_tpu.ops.dra import batch_feasible
+
+        dra_ok = batch_feasible(dra)                            # [B, N]
+        dra_reject = jnp.sum(static_ok & ~dra_ok, axis=1).astype(jnp.int32)
+        static_ok = static_ok & dra_ok
+    else:
+        dra_reject = jnp.zeros((B_all,), jnp.int32)
     if host_ok is not None:
         # host Filter verdicts AND in here; host rejects are attributed by
         # the Scheduler from its own counts (they never reach reject_counts)
@@ -483,7 +511,8 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                 "serial scan; gate the auction off when the knob is set")
         return _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw,
                               aff_raw, img, unres, weights, free0, nzr0,
-                              host_score, fit_strategy, fit_shape)
+                              host_score, fit_strategy, fit_shape,
+                              dra_reject)
     if enable_topology:
         # ---- phase 1b: topology statics per GROUP (representatives) ----
         pods_rep = jax.tree.map(lambda x: x[rep], pods)  # leaves [G, ...]
@@ -886,7 +915,8 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
     return BatchResult(node_row=rows, score=win_scores, feasible_count=feas,
                        reject_counts=reject_counts, unresolvable_count=unres,
                        free=free_out, nzr=nzr_out, pct_start=start_out,
-                       guard=_guard_reduction(win_scores, free_out))
+                       guard=_guard_reduction(win_scores, free_out),
+                       dra_reject=dra_reject)
 
 
 @partial(jax.jit, static_argnames=("caps", "enable_topology", "d_cap",
@@ -899,12 +929,14 @@ def schedule_batch_jit(cblobs, pblobs, wk, weights, caps,
                        active=None, pfields=None, ptmpl=None,
                        gid=None, rep=None, g_cap=0, host_ok=None,
                        host_score=None, fit_strategy="LeastAllocated",
-                       fit_shape=None, pct_nodes=0, pct_start=None):
+                       fit_shape=None, pct_nodes=0, pct_start=None,
+                       dra=None):
     return schedule_batch(cblobs, pblobs, wk, weights, caps,
                           enable_topology, d_cap, enabled_filters,
                           serial_scan, state, active, pfields, ptmpl,
                           gid, rep, g_cap, host_ok, host_score,
-                          fit_strategy, fit_shape, pct_nodes, pct_start)
+                          fit_strategy, fit_shape, pct_nodes, pct_start,
+                          dra)
 
 
 @partial(jax.jit, static_argnames=("caps",))
@@ -933,4 +965,4 @@ def launch_batch(spec, wk, weights, caps, enabled_filters=None,
         gid=spec.gid, rep=spec.rep, g_cap=spec.g_cap,
         host_ok=host_ok, host_score=host_score,
         fit_strategy=fit_strategy, fit_shape=fit_shape,
-        pct_nodes=pct_nodes, pct_start=pct_start)
+        pct_nodes=pct_nodes, pct_start=pct_start, dra=spec.dra)

@@ -565,6 +565,116 @@ def dra_steady_state_templates(init_nodes=100,
         ])
 
 
+# --------------- 13c. DRA steady-state with CEL `in` membership
+# the first of the previously-unmeasured DRA variants ROADMAP item 1
+# sequences behind the batched allocator: the selector corpus's
+# membership test (dra/performance-config.yaml's attribute-selector
+# shapes) over a heterogeneous device fleet — half the devices match.
+
+def _dra_model_slice(i: int):
+    from kubernetes_tpu.api.objects import Device, ResourceSlice
+
+    node = f"node-{i}"
+    models = ("v4", "v5e", "v5p", "v6e")
+    return ResourceSlice(
+        metadata=ObjectMeta(name=f"slice-{node}"),
+        node_name=node, driver="tpu.example.com", pool=node,
+        devices=[Device(name=f"dev-{d}",
+                        attributes={"model": models[d % 4]})
+                 for d in range(8)])
+
+
+def _dra_cel_in_template(i: int):
+    from kubernetes_tpu.api.objects import (
+        DeviceRequest,
+        DeviceSelector,
+        ResourceClaimSpec,
+        ResourceClaimTemplate,
+    )
+
+    expr = ("device.attributes['tpu.example.com'].model"
+            " in ['v5e', 'v5p']")
+    return ResourceClaimTemplate(
+        metadata=ObjectMeta(name="perf-claim-template"),
+        spec=ResourceClaimSpec(device_requests=[
+            DeviceRequest(name="accel", selectors=[
+                DeviceSelector(cel_expression=expr)])]))
+
+
+def dra_steady_state_cel_in(init_nodes=100, measure_pods=300) -> Workload:
+    return Workload(
+        name="DRASteadyStateCELIn/100Nodes_300Pods",
+        threshold=40,   # template-variant floor: same shape, `in` selector
+        node_capacity=128,
+        pod_capacity=2048,
+        batch_size=256,
+        dra_claim_controller=True,
+        ops=[
+            CreateNodes(init_nodes, _dra_node),
+            CreateObjects(init_nodes, _dra_model_slice,
+                          create_verb="create_resource_slice"),
+            CreateObjects(1, _dra_cel_in_template,
+                          create_verb="create_resource_claim_template"),
+            CreatePods(measure_pods, _dra_template_pod,
+                       collect_metrics=True),
+        ])
+
+
+# --------------- 13d. DRA multi-request claims
+# the second unmeasured variant: each claim carries TWO requests (a
+# class-matched pair + one attribute-selected device, 3 devices per
+# pod), exercising the allocator's greedy multi-request walk — on
+# device, the carried `taken` mask across request slots.
+
+def _dra_multi_slice(i: int):
+    from kubernetes_tpu.api.objects import Device, ResourceSlice
+
+    node = f"node-{i}"
+    return ResourceSlice(
+        metadata=ObjectMeta(name=f"slice-{node}"),
+        node_name=node, driver="tpu.example.com", pool=node,
+        devices=[Device(name=f"dev-{d}", device_class_name="tpu",
+                        attributes={"preallocate": d % 2 == 0})
+                 for d in range(16)])
+
+
+def _dra_multi_template(i: int):
+    from kubernetes_tpu.api.objects import (
+        DeviceRequest,
+        DeviceSelector,
+        ResourceClaimSpec,
+        ResourceClaimTemplate,
+    )
+
+    expr = "device.attributes['tpu.example.com'].preallocate"
+    return ResourceClaimTemplate(
+        metadata=ObjectMeta(name="perf-claim-template"),
+        spec=ResourceClaimSpec(device_requests=[
+            DeviceRequest(name="pair", device_class_name="tpu", count=2),
+            DeviceRequest(name="probe", count=1, selectors=[
+                DeviceSelector(cel_expression=expr)]),
+        ]))
+
+
+def dra_multi_request(init_nodes=100, measure_pods=250) -> Workload:
+    return Workload(
+        name="DRAMultiRequest/100Nodes_250Pods",
+        threshold=40,   # template-variant floor: 3 devices per pod
+        node_capacity=128,
+        pod_capacity=2048,
+        batch_size=256,
+        dra_claim_controller=True,
+        ops=[
+            CreateNodes(init_nodes, _dra_node),
+            CreateObjects(init_nodes, _dra_multi_slice,
+                          create_verb="create_resource_slice"),
+            CreateObjects(1, _dra_multi_template,
+                          create_verb="create_resource_claim_template"),
+            CreatePods(measure_pods, _dra_template_pod,
+                       collect_metrics=True),
+        ])
+
+
 # -------------------------------------- 14. SchedulingPodAffinity
 # affinity/performance-config.yaml:83-148 (5000Nodes_5000Pods, 35 — the
 # reference's SLOWEST headline shape): every node in ONE zone; init and
@@ -1120,6 +1230,8 @@ BENCH_WORKLOADS = (
     ns_selector_anti_affinity,
     dra_steady_state,
     dra_steady_state_templates,
+    dra_steady_state_cel_in,
+    dra_multi_request,
     scheduling_pod_affinity,
     mixed_scheduling_base_pod,
     ns_selector_pod_affinity,
@@ -1138,9 +1250,12 @@ BENCH_WORKLOADS = (
 ALL_WORKLOADS = BENCH_WORKLOADS
 
 # the ROADMAP's sub-10x offenders — the `bench.py --profile` set: each
-# runs with the flight recorder's phase attribution in the artifact
+# runs with the flight recorder's phase attribution in the artifact.
+# Both DRA steady-state rows ride along so the batched device allocator
+# (ops/dra.py) keeps its host-tail collapse visible per phase.
 PROFILE_WORKLOADS = (
     "scheduling_daemonset",
     "mixed_churn",
+    "dra_steady_state",
     "dra_steady_state_templates",
 )

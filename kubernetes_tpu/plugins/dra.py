@@ -15,7 +15,19 @@ as ResourceSlices; the plugin
   that node's remaining devices (structured parameters: per-request CEL
   selectors + DeviceClass selectors, ExactCount/All modes, firstAvailable
   alternatives, adminAccess, matchAttribute constraints), and every
-  already-allocated claim is pinned to its allocation's node,
+  already-allocated claim is pinned to its allocation's node.
+
+  The HOT PATH of that verdict now runs on device: DeviceAllocatorView
+  mirrors the slice inventory into dense tensors with precompiled CEL
+  verdict bitmasks, and the scheduler fuses claim feasibility for the
+  whole batch into the Filter/Score launch (ops/dra.py). Pods routed
+  that way skip this plugin's host Filter (applies() -> False); pods
+  whose claims fall outside the device-expressible subset — constraints,
+  firstAvailable, adminAccess, unparseable selectors — keep the host
+  path below, which is also the wholesale fallback when a device launch
+  faults. The serial allocator remains the single source of truth at
+  Reserve/PreBind (commit-time bookkeeping), so device and host picks
+  can never diverge on what reaches the API,
 - Reserve: run the same allocator on the chosen node and ASSUME the
   allocation (assume overlay — the scheduler-side AssumeCache the
   reference keeps for claims), Unreserve reverts,
@@ -28,11 +40,16 @@ survive replay and allocated devices never double-book.
 
 from __future__ import annotations
 
+import threading
+import time
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
+
+import numpy as np
 
 from kubernetes_tpu.api.objects import (
     ALLOCATION_MODE_ALL,
+    ALLOCATION_MODE_EXACT,
     AllocationResult,
     DeviceAllocationResult,
     ObjectMeta,
@@ -47,7 +64,15 @@ from kubernetes_tpu.framework.interface import (
     ReservePlugin,
     Status,
 )
+from kubernetes_tpu.ops.dra import (
+    MAX_SELECTORS,
+    PIN_ANY,
+    PIN_NONE,
+    SELBIT_WORDS,
+    DraBatch,
+)
 from kubernetes_tpu.utils.cel import CelDevice, CelError, evaluate
+from kubernetes_tpu.utils.cel import _parse as _cel_parse
 
 
 def claim_name_for(pod: Pod, ref) -> str:
@@ -117,6 +142,14 @@ class ResourceClaimController:
         from kubernetes_tpu.hub import EventHandlers
 
         self.hub = hub
+        # pods-by-template index: (namespace, template name) -> {uid: Pod}.
+        # Template stamping is O(changes): a template arriving re-stamps
+        # only the pods that reference it, never the whole cluster (the
+        # old `for pod in hub.list_pods()` scan was O(cluster) per
+        # template event). The lock covers hub dispatch threads racing
+        # pod adds against template adds.
+        self._index_lock = threading.Lock()
+        self._tmpl_index: dict[tuple[str, str], dict[str, Pod]] = {}
         hub.watch_pods(EventHandlers(on_add=self._on_pod_add,
                                      on_delete=self._on_pod_delete))
         # a pod can reference a template created AFTER it (the reference
@@ -125,14 +158,39 @@ class ResourceClaimController:
         hub.watch_resource_claim_templates(EventHandlers(
             on_add=self._on_template_add))
 
+    def _index_pod(self, pod: Pod) -> None:
+        with self._index_lock:
+            for ref in pod.spec.resource_claims:
+                if ref.resource_claim_template_name:
+                    key = (pod.metadata.namespace,
+                           ref.resource_claim_template_name)
+                    self._tmpl_index.setdefault(key, {})[
+                        pod.metadata.uid] = pod
+
+    def _unindex_pod(self, pod: Pod) -> None:
+        with self._index_lock:
+            for ref in pod.spec.resource_claims:
+                if ref.resource_claim_template_name:
+                    key = (pod.metadata.namespace,
+                           ref.resource_claim_template_name)
+                    waiting = self._tmpl_index.get(key)
+                    if waiting is not None:
+                        waiting.pop(pod.metadata.uid, None)
+                        if not waiting:
+                            del self._tmpl_index[key]
+
     def _on_template_add(self, tmpl) -> None:
-        for pod in self.hub.list_pods():
-            if any(ref.resource_claim_template_name == tmpl.metadata.name
-                   and pod.metadata.namespace == tmpl.metadata.namespace
-                   for ref in pod.spec.resource_claims):
-                self._on_pod_add(pod)
+        key = (tmpl.metadata.namespace, tmpl.metadata.name)
+        with self._index_lock:
+            waiting = list(self._tmpl_index.get(key, {}).values())
+        for pod in waiting:
+            self._stamp(pod)
 
     def _on_pod_add(self, pod: Pod) -> None:
+        self._index_pod(pod)
+        self._stamp(pod)
+
+    def _stamp(self, pod: Pod) -> None:
         import copy
 
         statuses: dict[str, str] = {}
@@ -155,6 +213,7 @@ class ResourceClaimController:
             self.hub.set_pod_claim_statuses(pod.metadata.uid, statuses)
 
     def _on_pod_delete(self, pod: Pod) -> None:
+        self._unindex_pod(pod)
         for ref in pod.spec.resource_claims:
             if not ref.resource_claim_template_name:
                 continue
@@ -164,6 +223,328 @@ class ResourceClaimController:
                                                 name)
             if claim is not None:
                 self.hub.delete_resource_claim(claim.metadata.uid)
+
+
+class DeviceAllocatorView:
+    """Dense device-inventory mirror + precompiled CEL selector masks:
+    the host half of the batched device allocator (ops/dra.py).
+
+    What it keeps, and when it pays:
+
+    - a per-node device table derived from the plugin's slice ledger
+      (``_node_bits``): per device, one uint32[SELBIT_WORDS] verdict
+      bitmask over every registered selector. Recomputed only for DIRTY
+      nodes (slice add/remove) or when a NEW selector registers — the
+      steady state does zero CEL evaluation per cycle;
+    - the selector registry (``_sel_bit``): expression -> bit. Entries
+      are ("cel", expression) for CEL selectors and ("class", name) for
+      the legacy direct device_class_name match. Selectors register
+      lazily the first time a claim referencing them is packed —
+      effectively at watch time, since claims/classes arrive by watch.
+      A selector that fails to PARSE routes its claims to the host path
+      (and surfaces the same CELSelectorError Event the host path
+      records); per-device evaluation errors count as no-match with the
+      Event preserved, exactly like the host's _selector_accepts;
+    - the resident [N, D] / [N, D, W] device arrays pushed to HBM,
+      re-assembled only when a node's bits, the mirror's row assignment,
+      or the node capacity changed; the [N, D] in-use mask re-packs per
+      cycle from the allocated-device ledger + the assume overlay.
+
+    Thread model: build() runs on the scheduling-loop thread;
+    invalidate_node() may arrive from hub dispatch threads. ``_lock``
+    (the view's own) orders them; plugin._ledger_lock is only ever taken
+    INSIDE it (view -> ledger), never the other way around.
+    """
+
+    MAX_REQS = 32        # flattened requests per pod beyond -> host path
+
+    def __init__(self, plugin: "DynamicResources"):
+        self.plugin = plugin
+        self._lock = threading.Lock()
+        self._sel_bit: dict[tuple, int] = {}
+        self._sel_bad: set[tuple] = set()        # unparseable expressions
+        self._eval_err: dict[tuple, Exception] = {}  # first eval error
+        # node -> (entries, bits[d, W]); entries mirror _devices_on(node)
+        self._node_bits: dict[str, tuple[list, np.ndarray]] = {}
+        self._dirty: set[str] = set()            # nodes needing rebits
+        self._triple_loc: dict[tuple, tuple[str, int]] = {}
+        self._node_triples: dict[str, list[tuple]] = {}
+        self._row_cache: dict[str, int] = {}     # node -> last packed row
+        self._d_cap = 8                          # pow2 device bucket
+        self._push: Optional[tuple] = None       # (valid, selbits) jnp
+        self._push_n_cap = 0
+        self.stats = {"selectors_compiled": 0, "host_fallback_pods": 0,
+                      "device_pods": 0, "inventory_rebuilds": 0}
+
+    # ------------- slice-watch maintenance -------------
+
+    def invalidate_node(self, node_name: str) -> None:
+        """A ResourceSlice on ``node_name`` changed: its verdict bits and
+        slot map are stale. Called by the plugin's slice handlers AFTER
+        they release the ledger lock."""
+        with self._lock:
+            self._dirty.add(node_name)
+            self._push = None
+
+    # ------------- selector registry -------------
+
+    def _bit_for(self, key: tuple, source: tuple[str, str]
+                 ) -> Optional[int]:
+        """Bit index for one selector key, registering it (and dirtying
+        every node's verdict table) on first sight. None = outside the
+        compilable subset (parse failure or registry full) — the caller
+        routes the claim to the host path."""
+        if key in self._sel_bad:
+            # surface the parse error for THIS source too (the plugin
+            # dedups per (source, expression), like the host path)
+            self.plugin._record_cel_error(
+                source, key[1], self._eval_err.get(
+                    key, CelError("unparseable selector")))
+            return None
+        bit = self._sel_bit.get(key)
+        if bit is None:
+            if len(self._sel_bit) >= MAX_SELECTORS:
+                return None
+            if key[0] == "cel":
+                try:
+                    _cel_parse(key[1])
+                except CelError as e:
+                    self._sel_bad.add(key)
+                    self._eval_err[key] = e
+                    self.plugin._record_cel_error(source, key[1], e)
+                    return None
+            bit = self._sel_bit[key] = len(self._sel_bit)
+            self.stats["selectors_compiled"] += 1
+            self._dirty.update(self._node_bits)
+            self._push = None
+        err = self._eval_err.get(key)
+        if err is not None:
+            # an expression that errored on some device: every source
+            # referencing it gets its own (deduped) Event, host-parity
+            self.plugin._record_cel_error(source, key[1], err)
+        return bit
+
+    def _verdict(self, key: tuple, driver: str, dev) -> bool:
+        """One selector against one device — the precompile-time analog
+        of the host _selector_accepts (same evaluate(), same CelError =
+        no-match semantics; the Event is recorded once per expression
+        here and re-attributed per source by _bit_for)."""
+        if key[0] == "class":
+            return dev.device_class_name == key[1]
+        try:
+            return evaluate(key[1],
+                            CelDevice(driver, dev.attributes, dev.capacity))
+        except CelError as e:
+            self._eval_err.setdefault(key, e)
+            return False
+
+    # ------------- inventory tensors -------------
+
+    def _rebuild_node(self, node: str) -> None:
+        entries = self.plugin._devices_on(node)
+        for t in self._node_triples.pop(node, ()):
+            self._triple_loc.pop(t, None)
+        if not entries:
+            self._node_bits.pop(node, None)
+            self._row_cache.pop(node, None)
+            return
+        while len(entries) > self._d_cap:
+            self._d_cap *= 2
+        bits = np.zeros((len(entries), SELBIT_WORDS), np.uint32)
+        for key, bit in self._sel_bit.items():
+            w, m = bit // 32, np.uint32(1 << (bit % 32))
+            for di, (drv, _pool, dev) in enumerate(entries):
+                if self._verdict(key, drv, dev):
+                    bits[di, w] |= m
+        self._node_bits[node] = (entries, bits)
+        triples = [(drv, pool, dev.name)
+                   for (drv, pool, dev) in entries]
+        self._node_triples[node] = triples
+        for slot, t in enumerate(triples):
+            self._triple_loc[t] = (node, slot)
+
+    def _ensure_inventory(self, row_of: Callable[[str], int], n_cap: int
+                          ) -> tuple:
+        """Refresh dirty nodes' verdict bits and (if anything moved)
+        re-assemble + re-push the resident [N, D(, W)] arrays."""
+        import jax.numpy as jnp
+
+        for node in sorted(self._dirty):
+            self._rebuild_node(node)
+        self._dirty.clear()
+        moved = any(row_of(node) != self._row_cache.get(node, -3)
+                    for node in self._node_bits)
+        if self._push is not None and not moved \
+                and self._push_n_cap == n_cap:
+            return self._push
+        self.stats["inventory_rebuilds"] += 1
+        valid = np.zeros((n_cap, self._d_cap), bool)
+        selbits = np.zeros((n_cap, self._d_cap, SELBIT_WORDS), np.uint32)
+        for node, (entries, bits) in self._node_bits.items():
+            row = row_of(node)
+            self._row_cache[node] = row
+            if row < 0 or row >= n_cap:
+                continue
+            k = len(entries)
+            valid[row, :k] = True
+            selbits[row, :k] = bits
+        self._push = (jnp.asarray(valid), jnp.asarray(selbits))
+        self._push_n_cap = n_cap
+        return self._push
+
+    def _in_use_array(self, n_cap: int) -> np.ndarray:
+        """[N, D] bool from the allocated-device ledger + assume overlay
+        (the batch-start view every pod's host pre_filter used to
+        compute; same-batch capacity races resolve at Reserve exactly as
+        before)."""
+        arr = np.zeros((n_cap, self._d_cap), bool)
+        for t in self.plugin._in_use_view(set()):
+            loc = self._triple_loc.get(t)
+            if loc is None:
+                continue
+            row = self._row_cache.get(loc[0], -1)
+            if 0 <= row < n_cap:
+                arr[row, loc[1]] = True
+        return arr
+
+    # ------------- claim compilation -------------
+
+    def _claim_reqs(self, claim: ResourceClaim
+                    ) -> Optional[list[tuple[np.ndarray, int, bool]]]:
+        """Flatten one unallocated claim into (mask words, count, all)
+        request rows, or None when the claim is outside the
+        device-expressible subset (constraints, firstAvailable,
+        adminAccess, non-positive counts, uncompilable selectors)."""
+        if claim.spec.constraints:
+            return None
+        out = []
+        for req in claim.spec.device_requests:
+            if req.first_available or getattr(req, "admin_access", False):
+                return None
+            if req.allocation_mode not in (ALLOCATION_MODE_EXACT,
+                                           ALLOCATION_MODE_ALL):
+                return None
+            if req.allocation_mode == ALLOCATION_MODE_EXACT \
+                    and req.count <= 0:
+                return None
+            bits: list[int] = []
+            if req.device_class_name:
+                dc = self.plugin.hub.get_device_class(req.device_class_name)
+                if dc is None:
+                    b = self._bit_for(("class", req.device_class_name),
+                                      ("DeviceClass", req.device_class_name))
+                    if b is None:
+                        return None
+                    bits.append(b)
+                else:
+                    for sel in dc.selectors:
+                        b = self._bit_for(
+                            ("cel", sel.cel_expression),
+                            ("DeviceClass", req.device_class_name))
+                        if b is None:
+                            return None
+                        bits.append(b)
+            for sel in req.selectors:
+                b = self._bit_for(("cel", sel.cel_expression),
+                                  ("ResourceClaim", claim.key()))
+                if b is None:
+                    return None
+                bits.append(b)
+            words = np.zeros((SELBIT_WORDS,), np.uint32)
+            for b in bits:
+                words[b // 32] |= np.uint32(1 << (b % 32))
+            is_all = req.allocation_mode == ALLOCATION_MODE_ALL
+            out.append((words, 0 if is_all else req.count, is_all))
+        return out
+
+    def _pod_item(self, pod: Pod, row_of: Callable[[str], int]
+                  ) -> Optional[tuple[list, int]]:
+        """(flattened request rows, pinned row) for one pod, or None when
+        any claim is missing or inexpressible (host path)."""
+        pinned = PIN_ANY
+        reqs: list = []
+        for _ref, claim in self.plugin._pod_claims(pod):
+            if claim is None:
+                return None
+            alloc = claim.status.allocation
+            if alloc is not None:
+                if alloc.node_name:
+                    row = row_of(alloc.node_name)
+                    if row < 0 or pinned not in (PIN_ANY, row):
+                        pinned = PIN_NONE
+                    else:
+                        pinned = row
+                continue
+            creqs = self._claim_reqs(claim)
+            if creqs is None:
+                return None
+            reqs.extend(creqs)
+        if len(reqs) > self.MAX_REQS:
+            return None
+        return reqs, pinned
+
+    # ------------- the per-dispatch build -------------
+
+    def build(self, pods: list[Pod], row_of: Callable[[str], int],
+              n_cap: int, b_cap: int
+              ) -> tuple[Optional[DraBatch], dict]:
+        """Pack one batch's DRA tensors. Returns (DraBatch | None, stats)
+        — None when no pod in the batch is device-evaluable. Also
+        refreshes the plugin's device-routing set: routed pods skip the
+        host DynamicResources filter (applies() -> False) because the
+        fused launch carries their verdict."""
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        stats = {"compile_s": 0.0, "routed": 0, "fallback": 0}
+        with self._lock:
+            items = []
+            routed: set[str] = set()
+            for b, pod in enumerate(pods):
+                if not pod.spec.resource_claims:
+                    continue
+                item = self._pod_item(pod, row_of)
+                if item is None:
+                    stats["fallback"] += 1
+                    continue
+                items.append((b, item[0], item[1]))
+                routed.add(pod.metadata.uid)
+            self.plugin._device_routed = frozenset(routed)
+            stats["routed"] = len(items)
+            self.stats["device_pods"] += len(items)
+            self.stats["host_fallback_pods"] += stats["fallback"]
+            if not items:
+                return None, stats
+            t_c0 = time.perf_counter()
+            dev_valid, dev_selbits = self._ensure_inventory(row_of, n_cap)
+            stats["compile_s"] = time.perf_counter() - t_c0
+            in_use = self._in_use_array(n_cap)
+            q_need = max(1, max(len(reqs) for _b, reqs, _p in items))
+            q_cap = 1
+            while q_cap < q_need:
+                q_cap *= 2
+            req_mask = np.zeros((b_cap, q_cap, SELBIT_WORDS), np.uint32)
+            req_count = np.zeros((b_cap, q_cap), np.int32)
+            req_all = np.zeros((b_cap, q_cap), bool)
+            pinned = np.full((b_cap,), PIN_ANY, np.int32)
+            active = np.zeros((b_cap,), bool)
+            for b, reqs, pin in items:
+                active[b] = True
+                pinned[b] = pin
+                for q, (words, cnt, is_all) in enumerate(reqs):
+                    req_mask[b, q] = words
+                    req_count[b, q] = cnt
+                    req_all[b, q] = is_all
+            batch = DraBatch(
+                dev_valid=dev_valid, dev_selbits=dev_selbits,
+                dev_in_use=jnp.asarray(in_use),
+                req_mask=jnp.asarray(req_mask),
+                req_count=jnp.asarray(req_count),
+                req_all=jnp.asarray(req_all),
+                pinned=jnp.asarray(pinned),
+                active=jnp.asarray(active))
+            stats["build_s"] = time.perf_counter() - t0
+            return batch, stats
 
 
 @dataclass
@@ -220,6 +601,14 @@ class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin,
         # ONE hub Event per object, not one per (pod, node, device)
         self._cel_errors: dict[str, int] = {}
         self._cel_seen: set[tuple] = set()
+        # batched device allocator (ops/dra.py): the view mirrors the
+        # slice inventory into dense tensors + precompiled selector
+        # masks; pods it routes skip the host filter (applies() False)
+        # because the fused launch carries their DRA verdict. The set is
+        # refreshed by every build_device_batch call and cleared when
+        # the scheduler degrades a batch to the host path.
+        self.device_view = DeviceAllocatorView(self)
+        self._device_routed: frozenset[str] = frozenset()
         hub.watch_resource_claims(EventHandlers(
             on_add=self._claim_event,
             on_update=lambda old, new: self._claim_event(new),
@@ -227,9 +616,24 @@ class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin,
         hub.watch_resource_slices(EventHandlers(
             on_add=self._slice_added, on_delete=self._slice_removed))
 
-    @staticmethod
-    def applies(pod: Pod) -> bool:
-        return bool(pod.spec.resource_claims)
+    def applies(self, pod: Pod) -> bool:
+        """Host-filter relevance probe: claims present AND the pod was
+        not routed through the device allocator for the current batch
+        (the fused launch already carries routed pods' verdicts)."""
+        return bool(pod.spec.resource_claims) \
+            and pod.metadata.uid not in self._device_routed
+
+    def set_device_routed(self, uids) -> None:
+        """Scheduler seam: which pods the CURRENT batch evaluates on
+        device. Cleared (empty) before any host-path pass — the host
+        fallback ladder must re-enable the host DRA filter."""
+        self._device_routed = frozenset(uids)
+
+    def build_device_batch(self, pods: list[Pod], row_of, n_cap: int,
+                           b_cap: int):
+        """Pack this batch's DraBatch tensors (or None) + build stats;
+        refreshes the device-routing set as a side effect."""
+        return self.device_view.build(pods, row_of, n_cap, b_cap)
 
     # --- the incremental ledger (claim/slice watch maintenance) ---
 
@@ -291,6 +695,9 @@ class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin,
                                                     sl.driver, sl.pool,
                                                     {d.name
                                                      for d in sl.devices})
+        # outside the ledger lock (view lock -> ledger lock ordering)
+        self.device_view.invalidate_node(sl.node_name)
+
     def _slice_removed(self, sl) -> None:
         with self._ledger_lock:
             meta = self._slice_entries.pop(sl.metadata.uid, None)
@@ -306,6 +713,7 @@ class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin,
             # racing allocator inserts after this clear) and drop the bulk
             self._sel_epoch += 1
             self._sel_cache.clear()
+        self.device_view.invalidate_node(node)
 
     def _in_use_view(self, exclude_keys: set[str]) -> set[tuple]:
         """Triples taken by any claim — ledger truth overlaid with assumed

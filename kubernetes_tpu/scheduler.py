@@ -239,7 +239,13 @@ class Scheduler:
                    # profile's ONLY PostFilter plugin
                    "batch_preempt_ok": [n for n, _ in
                                         fw.points["post_filter"]]
-                   == ["DefaultPreemption"]}
+                   == ["DefaultPreemption"],
+                   # fused device DRA allocation only applies to profiles
+                   # that enable the DynamicResources filter — a profile
+                   # with it disabled must keep scheduling claim pods
+                   # unfiltered, exactly as the host path did
+                   "dra_filter": "DynamicResources" in {
+                       n for n, _ in fw.points["filter"]}}
             for name, fw in self.frameworks.items()}
         self._enabled_filters = self.framework.enabled_filters()
         from kubernetes_tpu.extender import HTTPExtender
@@ -789,6 +795,9 @@ class Scheduler:
                                       self.now() - t_fb0)
 
     def _host_fallback_batch_inner(self, qps: list[QueuedPodInfo]) -> None:
+        # the fallback evaluates on host: re-enable the host DRA filter
+        # for every pod (device routing only holds for a device launch)
+        self._dra.set_device_routed(())
         try:
             self.cache.update_snapshot(self.snapshot)
         except Unavailable:
@@ -1188,6 +1197,39 @@ class Scheduler:
         else:
             raise RuntimeError("mirror re-bucketing did not converge")
 
+        # batched DRA allocator: pack this batch's claim tensors and fuse
+        # the device verdict into the launch (ops/dra.py + the dra arg of
+        # schedule_batch). Pods whose claims sit outside the device-
+        # expressible subset stay on the host filter path — applies()
+        # keeps returning True for exactly those. Gated on the profile
+        # actually enabling the DynamicResources filter (the batch is
+        # single-profile by this point).
+        if pcfg["dra_filter"] \
+                and any(qp.pod.spec.resource_claims for qp in runnable):
+            # claim state must be as settled as the host path saw it:
+            # in-flight binding cycles write allocations (PreBind), so
+            # land them before the in-use mask packs
+            self._drain_bind_results(wait=True)
+            t_dra0 = self.now()
+            dra_batch, dra_stats = self._dra.build_device_batch(
+                [qp.pod for qp in runnable], self.mirror.row_of,
+                self.caps.nodes, spec.pblobs.f32.shape[0])
+            t_dra1 = self.now()
+            spec.dra = dra_batch
+            for qp in runnable:
+                if qp.pod.spec.resource_claims:
+                    # stale attribution from a previous attempt must not
+                    # survive into this cycle's diagnosis
+                    qp.host_reject_counts = {}
+            # dra_mask_compile = selector compilation + inventory
+            # refresh; dra_device_eval = the per-cycle claim/in-use
+            # tensor pack. Both are VIEWS (excluded from the cycle-total
+            # arithmetic); the wall time itself lands in `pack`.
+            tr.add("dra_mask_compile", dra_stats["compile_s"])
+            tr.add("dra_device_eval",
+                   (t_dra1 - t_dra0) - dra_stats["compile_s"])
+            tr.add("pack", t_dra1 - t_dra0)
+
         # commit mode: the parallel-rounds auction whenever the launch has
         # no topology work and no batch pod carries host ports (in-batch
         # port conflicts are impossible without batch host ports; node-side
@@ -1433,7 +1475,16 @@ class Scheduler:
         rejects = None
         if fail_is:
             t_pull0 = self.now()
-            rejects = np.asarray(jax.device_get(out.reject_counts))
+            rejects, dra_rej = jax.device_get((out.reject_counts,
+                                               out.dra_reject))
+            rejects = np.asarray(rejects)
+            # fused DRA rejections fold into host_reject_counts so
+            # diagnosis, requeue hints, and the preemption fast-path
+            # gate behave exactly as they did on the host filter path
+            for i in fail_is:
+                c = int(dra_rej[i])
+                if c:
+                    runnable[i].host_reject_counts["DynamicResources"] = c
             # the rows/guard pull above is inseparable from the device
             # wait (folded into device_launch); this one is a pure
             # post-compute transfer — the honest D2H measurement

@@ -13,10 +13,11 @@ This module evaluates that subset without a CEL engine: the expression is
 tokenized into Python-compatible operators (``&&``/``||``/``!`` →
 ``and``/``or``/``not``), parsed with ``ast.parse``, and walked by a
 restricted evaluator that only admits boolean/compare/arithmetic
-operations, attribute and subscript access on the ``device`` variable,
-and the ``quantity()`` / ``.compareTo()`` / ``.matches()`` helpers. Any
-construct outside the subset raises ``CelError`` — callers surface that
-as an unschedulable status, mirroring the reference's CEL compile errors.
+operations (including ``in`` over list literals), attribute and
+subscript access on the ``device`` variable, and the ``quantity()`` /
+``.compareTo()`` / ``.matches()`` helpers. Any construct outside the
+subset raises ``CelError`` — callers surface that as an unschedulable
+status, mirroring the reference's CEL compile errors.
 
 Semantics notes:
 - ``device.attributes['qualified.name']`` resolves attributes by their
@@ -141,6 +142,14 @@ class _Evaluator(ast.NodeVisitor):
         if isinstance(node.value, (bool, int, float, str)):
             return node.value
         raise CelError(f"unsupported literal {node.value!r}")
+
+    def visit_List(self, node):
+        # CEL list literals, e.g. `device.attributes['d'].model in
+        # ['v5e', 'v5p']` — the membership test the reference's selector
+        # corpus uses heavily
+        return [self.eval(e) for e in node.elts]
+
+    visit_Tuple = visit_List
 
     def visit_Name(self, node):
         if node.id == "device":

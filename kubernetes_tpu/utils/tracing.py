@@ -39,9 +39,9 @@ logger = logging.getLogger("kubernetes_tpu.trace")
 
 # canonical cycle phases, in rough hot-path order. Host-tail share (the
 # bench --profile headline) is the HOST_PHASES fraction of total cycle
-# time; dra_allocator is a VIEW (the DynamicResources slices of
-# host_plugins/commit), not a disjoint phase, so it is excluded from
-# the share arithmetic.
+# time; the dra_* phases are VIEWS (the DynamicResources slices of
+# pack/host_plugins/commit), not disjoint phases, so they are excluded
+# from the share arithmetic.
 CYCLE_PHASES = (
     "queue_pop",          # pop_batch + per-pod hub vetting
     "snapshot_sync",      # cache.update_snapshot + mirror.sync (H2D pack)
@@ -55,12 +55,20 @@ CYCLE_PHASES = (
     "binder_drain",       # collecting finished binding cycles
     "eviction_flush",     # queued preemption evictions
     "host_fallback",      # serial host path after a device fault
-    "dra_allocator",      # DynamicResources plugin time (view, see above)
+    "dra_mask_compile",   # CEL -> bitmask compile + inventory refresh (view)
+    "dra_device_eval",    # per-cycle DRA tensor pack + host-path
+                          # DynamicResources PreFilter/Filter time (view;
+                          # the fused in-launch eval rides device_launch)
+    "dra_commit",         # DynamicResources Reserve/PreBind time (view)
 )
+
+# the dra_* attribution views, excluded from total/host-tail arithmetic
+# (they double-count time already inside pack/host_plugins/commit)
+DRA_VIEW_PHASES = ("dra_mask_compile", "dra_device_eval", "dra_commit")
 
 # phases that are host-side Python work (the "host tail" the ROADMAP's
 # sub-10x offenders ask us to attribute); device_launch is device +
-# transfer, d2h_pull is transfer, dra_allocator double-counts host time
+# transfer, d2h_pull is transfer, the dra_* views double-count host time
 HOST_PHASES = (
     "queue_pop", "snapshot_sync", "host_plugins", "pack", "commit",
     "failure_handling", "binder_drain", "eviction_flush", "host_fallback",
@@ -137,9 +145,9 @@ class CycleTrace:
         self.phases[phase] = self.phases.get(phase, 0.0) + secs
 
     def total(self) -> float:
-        # dra_allocator is a view over host_plugins/commit time
+        # the dra_* phases are views over pack/host_plugins/commit time
         return sum(v for k, v in self.phases.items()
-                   if k != "dra_allocator")
+                   if k not in DRA_VIEW_PHASES)
 
     def to_dict(self) -> dict:
         d = {
@@ -240,8 +248,12 @@ class FlightRecorder:
 
     def plugin_observe(self, plugin: str, point: str, secs: float) -> None:
         """Per-plugin timing from the framework runners; DynamicResources
-        time additionally lands in the current cycle's dra_allocator
-        phase (the ROADMAP's 'DRA allocator Python time' attribution)."""
+        time additionally lands in the current cycle's dra_* view phases
+        (the ROADMAP's 'DRA allocator Python time' attribution, split so
+        future regressions attribute cleanly): host-path PreFilter/Filter
+        evaluation feeds dra_device_eval, Reserve/PreBind commit
+        bookkeeping feeds dra_commit (dra_mask_compile is observed
+        directly by the Scheduler's tensor-build step)."""
         if not self.enabled:
             return
         if self.plugin_hist is not None:
@@ -252,7 +264,8 @@ class FlightRecorder:
             key = f"{plugin}/{point}"
             cur.plugins[key] = cur.plugins.get(key, 0.0) + secs
             if plugin == "DynamicResources":
-                cur.add("dra_allocator", secs)
+                cur.add("dra_commit" if point in ("Reserve", "PreBind")
+                        else "dra_device_eval", secs)
 
     def close(self) -> None:
         if self._export_file is not None:
@@ -314,16 +327,15 @@ class FlightRecorder:
 
     def host_tail_share(self) -> float:
         """Fraction of recorded cycle time spent in host-side phases
-        (HOST_PHASES) vs everything measured except the dra_allocator
-        view — the per-phase attribution headline for the sub-10x
-        workloads."""
+        (HOST_PHASES) vs everything measured except the dra_* views —
+        the per-phase attribution headline for the sub-10x workloads."""
         h = self.phase_hist
         if h is None:
             return 0.0
         host = total = 0.0
         for k in list(h._series):
             phase = dict(k).get("phase", "?")
-            if phase == "dra_allocator":
+            if phase in DRA_VIEW_PHASES:
                 continue
             s = h._series.get(k)
             if not s:

@@ -2,6 +2,10 @@
 pressure, allocation persistence across scheduler restart (reference:
 plugins/dynamicresources/dynamicresources.go:105-888)."""
 
+import pytest
+
+pytestmark = pytest.mark.dra
+
 from kubernetes_tpu.api.objects import (
     Container,
     Device,

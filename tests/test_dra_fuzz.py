@@ -1,0 +1,356 @@
+"""Differential fuzz: the batched device DRA allocator (ops/dra.py +
+plugins.dra.DeviceAllocatorView) against the legacy host serial
+allocator (DynamicResources.allocate_claim), over randomized
+inventories, selectors, claims, and pre-allocated (in-use) devices.
+
+Parity contract: for every pod the builder routes to the device path,
+the device [pod, node] feasibility mask must EQUAL the host filter's
+verdict on every mirrored node. Device CHOICE is allowed to differ only
+among score-ties and is not asserted here — the actual pick still runs
+through the host allocator at Reserve (commit-time bookkeeping), so the
+two can never diverge on what gets written to the API.
+
+Pods the builder refuses (matchAttribute constraints, firstAvailable,
+adminAccess, unparseable selectors) are asserted to carry exactly such a
+feature — the host path (unchanged, covered by test_dra_structured)
+keeps owning them.
+
+Shapes are pinned (8 nodes x <=8 devices, <=2 requests/pod) so the
+whole sweep shares two jitted programs; the tier-1 run covers 200
+seeds, the `slow` sweep 1000.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.objects import (
+    ALLOCATION_MODE_ALL,
+    AllocationResult,
+    Device,
+    DeviceAllocationResult,
+    DeviceClass,
+    DeviceConstraint,
+    DeviceRequest,
+    DeviceSelector,
+    DeviceSubRequest,
+    ObjectMeta,
+    Pod,
+    PodResourceClaim,
+    PodSpec,
+    ResourceClaim,
+    ResourceClaimSpec,
+    ResourceSlice,
+)
+from kubernetes_tpu.hub import Hub
+from kubernetes_tpu.ops.dra import batch_feasible_jit
+from kubernetes_tpu.plugins.dra import DynamicResources
+
+pytestmark = pytest.mark.dra
+
+N_NODES = 8
+DRIVER = "fuzz.example.com"
+MODELS = ("m0", "m1", "m2", "m3")
+CLASSES = ("cls-a", "cls-b")
+
+
+def _mk_device(rng: random.Random, d: int) -> Device:
+    attrs = {}
+    if rng.random() < 0.9:
+        attrs["model"] = rng.choice(MODELS)
+    if rng.random() < 0.7:
+        attrs["flag"] = rng.random() < 0.5
+    if rng.random() < 0.5:
+        attrs["gen"] = rng.randrange(4)
+    cap = {}
+    if rng.random() < 0.6:
+        cap["size"] = str(rng.randrange(1, 5))
+    return Device(name=f"dev-{d}",
+                  device_class_name=rng.choice(("", *CLASSES)),
+                  attributes=attrs, capacity=cap)
+
+
+def _mk_selector(rng: random.Random) -> str:
+    kind = rng.randrange(5)
+    if kind == 0:
+        return f"device.attributes['{DRIVER}'].flag"
+    if kind == 1:
+        return (f"device.attributes['{DRIVER}'].model == "
+                f"'{rng.choice(MODELS)}'")
+    if kind == 2:
+        picks = rng.sample(MODELS, 2)
+        return (f"device.attributes['{DRIVER}'].model in "
+                f"['{picks[0]}', '{picks[1]}']")
+    if kind == 3:
+        return (f"device.capacity['{DRIVER}'].size"
+                f".compareTo(quantity('{rng.randrange(1, 4)}')) >= 0")
+    return f"device.attributes['{DRIVER}'].gen >= {rng.randrange(3)}"
+
+
+def _mk_request(rng: random.Random, name: str, expressible: bool
+                ) -> DeviceRequest:
+    req = DeviceRequest(name=name)
+    roll = rng.random()
+    if roll < 0.35:
+        req.device_class_name = rng.choice(CLASSES)
+    else:
+        req.selectors = [DeviceSelector(cel_expression=_mk_selector(rng))
+                         for _ in range(rng.randrange(1, 3))]
+    if rng.random() < 0.15:
+        req.allocation_mode = ALLOCATION_MODE_ALL
+    else:
+        req.count = rng.randrange(1, 4)
+    if not expressible:
+        # one deliberately inexpressible feature: the builder must
+        # route this pod to the host path
+        feat = rng.randrange(3)
+        if feat == 0:
+            req.admin_access = True
+        elif feat == 1:
+            req.first_available = [DeviceSubRequest(
+                name="alt", device_class_name=CLASSES[0])]
+        else:
+            req.selectors = [DeviceSelector(
+                cel_expression="this is ((( not CEL")]
+    return req
+
+
+def _scenario(seed: int):
+    """One randomized cluster: slices + classes + claims + pods, some
+    devices pre-allocated by blocker claims."""
+    rng = random.Random(seed)
+    hub = Hub()
+    for name in CLASSES:
+        if rng.random() < 0.5:
+            hub.create_device_class(DeviceClass(
+                metadata=ObjectMeta(name=name),
+                selectors=[DeviceSelector(
+                    cel_expression=_mk_selector(rng))]))
+        # else: no class object -> legacy direct device_class_name match
+    node_names = [f"n{i}" for i in range(rng.randrange(3, N_NODES + 1))]
+    all_triples = []
+    for i, node in enumerate(node_names):
+        devs = [_mk_device(rng, d) for d in range(rng.randrange(0, 7))]
+        if devs:
+            hub.create_resource_slice(ResourceSlice(
+                metadata=ObjectMeta(name=f"slice-{node}"),
+                node_name=node, driver=DRIVER, pool=f"pool-{node}",
+                devices=devs))
+            all_triples += [(node, DRIVER, f"pool-{node}", d.name)
+                            for d in devs]
+    plugin = DynamicResources(hub)
+    # blocker claims: pre-allocated devices populate the in-use ledger
+    rng.shuffle(all_triples)
+    n_used = rng.randrange(0, max(1, len(all_triples) // 2 + 1))
+    for k, (node, drv, pool, dev) in enumerate(all_triples[:n_used]):
+        blocker = ResourceClaim(
+            metadata=ObjectMeta(name=f"blocker-{k}"))
+        blocker.status.allocation = AllocationResult(
+            node_name=node,
+            devices=[DeviceAllocationResult(
+                request="r", driver=drv, pool=pool, device=dev)])
+        hub.create_resource_claim(blocker)
+    pods = []
+    for p in range(rng.randrange(1, 5)):
+        expressible = rng.random() < 0.8
+        reqs = [_mk_request(rng, f"r{q}", expressible or q > 0)
+                for q in range(rng.randrange(1, 3))]
+        spec = ResourceClaimSpec(device_requests=reqs)
+        if not expressible and rng.random() < 0.3:
+            spec.constraints = [DeviceConstraint(match_attribute="model")]
+        claim = ResourceClaim(metadata=ObjectMeta(name=f"claim-{p}"),
+                              spec=spec)
+        if rng.random() < 0.15 and all_triples:
+            # pre-allocated claim: the pod is pinned to its node
+            node, drv, pool, dev = rng.choice(all_triples)
+            claim.status.allocation = AllocationResult(
+                node_name=node,
+                devices=[DeviceAllocationResult(
+                    request="r0", driver=drv, pool=pool, device=dev)])
+        hub.create_resource_claim(claim)
+        pods.append((Pod(metadata=ObjectMeta(name=f"pod-{p}"),
+                         spec=PodSpec(resource_claims=[PodResourceClaim(
+                             name="c", resource_claim_name=f"claim-{p}")])),
+                     expressible))
+    return hub, plugin, node_names, pods
+
+
+def _host_mask(plugin: DynamicResources, pod: Pod,
+               node_names: list[str]) -> list[bool]:
+    """The host filter's verdict, claim-for-claim (DynamicResources
+    .filter semantics: pin checks for allocated claims, greedy
+    allocate_claim with local in-use threading for the rest)."""
+    claims = [c for _r, c in plugin._pod_claims(pod)]
+    assert all(c is not None for c in claims)
+    exclude = {c.key() for c in claims if c.status.allocation is None}
+    in_use = plugin._in_use_view(exclude)
+    out = []
+    for node in node_names:
+        ok = True
+        local = set(in_use)
+        for claim in claims:
+            alloc = claim.status.allocation
+            if alloc is not None:
+                if alloc.node_name and alloc.node_name != node:
+                    ok = False
+                    break
+                continue
+            picked = plugin.allocate_claim(claim, node, local)
+            if picked is None:
+                ok = False
+                break
+            local |= {(d.driver, d.pool, d.device)
+                      for d in picked if not d.admin_access}
+        out.append(ok)
+    return out
+
+
+def _run_cases(seeds) -> tuple[int, int]:
+    routed_total = fallback_total = 0
+    for seed in seeds:
+        hub, plugin, node_names, pods = _scenario(seed)
+        row_of = {n: i for i, n in enumerate(node_names)}.__getitem__
+        batch, _stats = plugin.build_device_batch(
+            [p for p, _e in pods],
+            lambda n: row_of(n) if n in set(node_names) else -1,
+            N_NODES, len(pods))
+        routed = plugin._device_routed
+        dev_mask = (np.asarray(batch_feasible_jit(batch))
+                    if batch is not None else None)
+        for b, (pod, expressible) in enumerate(pods):
+            if pod.metadata.uid not in routed:
+                # the builder may only refuse inexpressible pods
+                assert not expressible, \
+                    f"seed {seed}: expressible pod {b} not routed"
+                fallback_total += 1
+                continue
+            routed_total += 1
+            host = _host_mask(plugin, pod, node_names)
+            dev = [bool(dev_mask[b, row_of(n)]) for n in node_names]
+            assert dev == host, (
+                f"seed {seed} pod {b}: device {dev} != host {host}\n"
+                f"claims: {[c.spec for _r, c in plugin._pod_claims(pod)]}")
+    return routed_total, fallback_total
+
+
+def test_allocation_parity_fuzz_200():
+    """Tier-1 sweep: >= 200 randomized scenarios, identical feasible
+    sets between the device kernel and the host serial allocator."""
+    routed, _fallback = _run_cases(range(200))
+    # the generator makes ~80% of pods expressible; demand real coverage
+    assert routed >= 300, f"only {routed} device-routed pods exercised"
+
+
+@pytest.mark.slow
+def test_allocation_parity_fuzz_long():
+    """The long-seed sweep (kept out of tier-1's time budget)."""
+    routed, _fallback = _run_cases(range(200, 1200))
+    assert routed >= 1500
+
+
+def test_inexpressible_features_route_to_host():
+    """Spot-check the routing boundary: constraints / firstAvailable /
+    adminAccess / broken selectors never reach the device kernel."""
+    hub = Hub()
+    hub.create_resource_slice(ResourceSlice(
+        metadata=ObjectMeta(name="s"), node_name="n0", driver=DRIVER,
+        pool="p", devices=[Device(name="d0", device_class_name="cls-a")]))
+    plugin = DynamicResources(hub)
+    specs = [
+        ResourceClaimSpec(device_requests=[DeviceRequest(
+            name="r", device_class_name="cls-a", admin_access=True)]),
+        ResourceClaimSpec(device_requests=[DeviceRequest(
+            name="r", first_available=[DeviceSubRequest(
+                name="a", device_class_name="cls-a")])]),
+        ResourceClaimSpec(
+            device_requests=[DeviceRequest(name="r",
+                                           device_class_name="cls-a")],
+            constraints=[DeviceConstraint(match_attribute="model")]),
+        ResourceClaimSpec(device_requests=[DeviceRequest(
+            name="r", selectors=[DeviceSelector(
+                cel_expression="((not cel")])]),
+        ResourceClaimSpec(device_requests=[DeviceRequest(
+            name="r", device_class_name="cls-a", count=0)]),
+    ]
+    pods = []
+    for i, spec in enumerate(specs):
+        hub.create_resource_claim(ResourceClaim(
+            metadata=ObjectMeta(name=f"c{i}"), spec=spec))
+        pods.append(Pod(metadata=ObjectMeta(name=f"p{i}"),
+                        spec=PodSpec(resource_claims=[PodResourceClaim(
+                            name="c", resource_claim_name=f"c{i}")])))
+    batch, stats = plugin.build_device_batch(
+        pods, lambda n: 0 if n == "n0" else -1, N_NODES, len(pods))
+    assert batch is None and stats["fallback"] == len(specs)
+    assert plugin._device_routed == frozenset()
+    # the broken selector surfaced the same CELSelectorError the host
+    # path records
+    assert plugin.cel_error_stats(), "parse failure must surface"
+
+
+def test_profile_with_dra_disabled_skips_device_allocator():
+    """A profile that disables the DynamicResources filter must keep
+    scheduling claim pods UNFILTERED (pre-device-allocator behavior):
+    the fused gate is per-profile, so no DRA verdict — device or host —
+    may reject its pods."""
+    from kubernetes_tpu.config.types import Plugin, default_config
+    from kubernetes_tpu.ops.features import Capacities
+    from kubernetes_tpu.scheduler import Scheduler
+    from tests.test_dra import mkclaim, mknode, mkpod
+
+    hub = Hub()
+    cfg = default_config()
+    cfg.batch_size = 8
+    # disable the plugin wholesale (the delegation shape: claims handed
+    # to an external component) — multi_point removal takes it out of
+    # filter AND reserve/pre_bind
+    cfg.profiles[0].plugins.multi_point.disabled.append(
+        Plugin(name="DynamicResources"))
+    sched = Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64))
+    assert sched._profile_cfg[sched._profile_name]["dra_filter"] is False
+    hub.create_node(mknode("bare"))     # no slices anywhere
+    hub.create_resource_claim(mkclaim("c1"))
+    pod = mkpod("p", claim="c1")
+    hub.create_pod(pod)
+    sched.run_until_idle()
+    # with the filter disabled the claim is not enforced: the pod lands
+    # on the device-less node instead of parking unschedulable
+    assert hub.get_pod(pod.metadata.uid).spec.node_name == "bare"
+    assert sched._dra.device_view.stats["device_pods"] == 0
+    sched.close()
+
+
+def test_device_fallback_ladder_still_schedules_dra_batch():
+    """Acceptance: a device-path fault on a DRA batch degrades to the
+    host path (which re-enables the host DRA filter) and the pod still
+    lands on the device-backed node — the daemon never dies."""
+    from kubernetes_tpu.chaos import DeviceChaos, DeviceChaosConfig
+    from kubernetes_tpu.config.types import default_config
+    from kubernetes_tpu.ops.features import Capacities
+    from kubernetes_tpu.scheduler import Scheduler
+    from tests.test_dra import mkclaim, mknode, mkpod, mkslice
+
+    hub = Hub()
+    cfg = default_config()
+    cfg.batch_size = 8
+    sched = Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64))
+    chaos = DeviceChaos(DeviceChaosConfig(seed=3, launch_error_rate=1.0))
+    sched.fault_injector = chaos
+    hub.create_node(mknode("plain"))
+    hub.create_node(mknode("accel"))
+    hub.create_resource_slice(mkslice("accel", 2))
+    hub.create_resource_claim(mkclaim("c1"))
+    pod = mkpod("p", claim="c1")
+    hub.create_pod(pod)
+    sched.run_until_idle()
+    assert chaos.stats["injected_launch_errors"] >= 1
+    assert sched.stats["device_fallbacks"] >= 1
+    assert hub.get_pod(pod.metadata.uid).spec.node_name == "accel", \
+        "host fallback must still allocate the claim's device"
+    claim = hub.get_resource_claim("default", "c1")
+    assert claim.status.allocation is not None
+    assert claim.status.allocation.node_name == "accel"
+    sched.close()

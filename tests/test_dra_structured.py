@@ -9,6 +9,10 @@ structured allocator under staging/src/k8s.io/dynamic-resource-allocation,
 and the dra scheduler_perf templates (resourceclaimtemplate*.yaml,
 resourceclaim-with-selector.yaml, deviceclass.yaml)."""
 
+import pytest
+
+pytestmark = pytest.mark.dra
+
 from kubernetes_tpu.api.objects import (
     ALLOCATION_MODE_ALL,
     Container,

@@ -222,6 +222,28 @@ def test_dra_steady_state_tiny():
     assert r["stats"]["unschedulable"] == 0
 
 
+def test_dra_cel_in_tiny():
+    """The CEL `in` membership variant: half the fleet's devices match
+    the selector, every pod still places (device allocator path)."""
+    from kubernetes_tpu.perf.workloads import dra_steady_state_cel_in
+
+    w = small(dra_steady_state_cel_in(init_nodes=4, measure_pods=6))
+    r = run_workload(w)
+    assert r["pods_scheduled"] == 6
+    assert r["stats"]["unschedulable"] == 0
+
+
+def test_dra_multi_request_tiny():
+    """The two-request claim variant: 3 devices per pod across a class
+    match + an attribute selector, greedy multi-request walk."""
+    from kubernetes_tpu.perf.workloads import dra_multi_request
+
+    w = small(dra_multi_request(init_nodes=4, measure_pods=6))
+    r = run_workload(w)
+    assert r["pods_scheduled"] == 6
+    assert r["stats"]["unschedulable"] == 0
+
+
 def _load_bench():
     import importlib.util
     import os
@@ -245,7 +267,7 @@ def test_profile_workload_names_in_sync():
 
 def test_run_workload_profile_breakdown():
     """profile=True: the result carries the flight recorder's per-phase
-    p50/p99 (incl. the dra_allocator view when DRA plugins ran) and the
+    p50/p99 (incl. the dra_* views when DRA plugins ran) and the
     host-tail share — what bench.py --profile publishes per offender."""
     w = small(scheduling_basic(init_nodes=4, init_pods=2, measure_pods=10))
     r = run_workload(w, profile=True)

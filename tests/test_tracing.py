@@ -32,6 +32,7 @@ from kubernetes_tpu.serving import ServingEndpoints, token_auth
 from kubernetes_tpu.utils.tracing import (
     CYCLE_PHASES,
     CycleTrace,
+    DRA_VIEW_PHASES,
     FlightRecorder,
     HOST_PHASES,
     PodTimelines,
@@ -68,18 +69,19 @@ def test_cycle_trace_accumulates_and_totals():
     tr.add("host_plugins", 0.01)
     tr.add("host_plugins", 0.02)   # touched twice: accumulates
     tr.add("device_launch", 0.1)
-    tr.add("dra_allocator", 0.005)  # a VIEW: excluded from total()
+    tr.add("dra_mask_compile", 0.001)  # VIEWS: excluded from total()
+    tr.add("dra_device_eval", 0.004)
     assert abs(tr.phases["host_plugins"] - 0.03) < 1e-12
     assert abs(tr.total() - 0.13) < 1e-12
     d = tr.to_dict()
-    assert d["total_ms"] == 130.0
-    assert d["phases_ms"]["dra_allocator"] == 5.0
+    assert d["phases_ms"]["dra_device_eval"] == 4.0
 
 
 def test_phase_vocabulary():
     # host-tail arithmetic depends on these set relations
     assert set(HOST_PHASES) < set(CYCLE_PHASES)
-    assert "dra_allocator" not in HOST_PHASES
+    assert set(DRA_VIEW_PHASES) < set(CYCLE_PHASES)
+    assert not set(DRA_VIEW_PHASES) & set(HOST_PHASES)
     assert "device_launch" not in HOST_PHASES
 
 
@@ -136,9 +138,11 @@ def test_plugin_observe_feeds_dra_view():
     rec.record(tr)
     # per-plugin timings land on the current cycle...
     assert tr.plugins["NodeAffinity/Filter"] == 0.001
-    # ...and DynamicResources time additionally fills the dra_allocator
-    # phase view
-    assert abs(tr.phases["dra_allocator"] - 0.005) < 1e-12
+    # ...and DynamicResources time additionally fills the split dra_*
+    # phase views: host Filter time -> dra_device_eval, commit-time
+    # Reserve bookkeeping -> dra_commit
+    assert abs(tr.phases["dra_device_eval"] - 0.002) < 1e-12
+    assert abs(tr.phases["dra_commit"] - 0.003) < 1e-12
     assert plugin.count(plugin="DynamicResources",
                         extension_point="Filter") == 1
     keys = set(rec.plugin_percentiles())
@@ -153,8 +157,8 @@ def test_recorder_resume_reattaches_dispatched_cycle():
     assert rec.current is tr_k1
     rec.resume(tr_k)                        # finishing k: plugins land on k
     rec.plugin_observe("DynamicResources", "Reserve", 0.001)
-    assert "dra_allocator" in tr_k.phases
-    assert "dra_allocator" not in tr_k1.phases
+    assert "dra_commit" in tr_k.phases
+    assert "dra_commit" not in tr_k1.phases
     rec.record(tr_k)
     assert rec.current is None or rec.current is tr_k1
 
@@ -166,7 +170,7 @@ def test_host_tail_share():
     tr.add("host_plugins", 0.03)           # host
     tr.add("device_launch", 0.06)          # device
     tr.add("commit", 0.01)                 # host
-    tr.add("dra_allocator", 0.02)          # view: excluded
+    tr.add("dra_device_eval", 0.02)        # view: excluded
     rec.record(tr)
     assert abs(rec.host_tail_share() - 0.4) < 1e-9
 
