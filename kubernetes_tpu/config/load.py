@@ -56,7 +56,10 @@ def config_from_dict(doc: dict) -> SchedulerConfiguration:
     for key in ("parallelism", "percentage_of_nodes_to_score",
                 "pod_initial_backoff_seconds", "pod_max_backoff_seconds",
                 "async_binding", "binding_workers", "batch_size",
-                "node_capacity", "pod_table_capacity"):
+                "node_capacity", "pod_table_capacity",
+                "flight_recorder_capacity", "trace_export_path",
+                "trace_export_max_bytes", "trace_export_features",
+                "tie_break_seed"):
         if key in doc:
             setattr(cfg, key, doc[key])
     profiles = [_profile(p) for p in doc.get("profiles") or []]

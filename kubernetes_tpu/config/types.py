@@ -113,8 +113,23 @@ class SchedulerConfiguration:
     # cycles; 0 disables the recorder entirely (not recommended — the
     # overhead budget is <2% of cycle time, see bench.py --trace-overhead)
     flight_recorder_capacity: int = 256
-    # append each cycle trace as a JSON line here (offline analysis)
+    # append each cycle trace as a JSON line here (offline analysis /
+    # the learned-scorer replay dataset; export format v2 carries
+    # per-pod placement rows)
     trace_export_path: Optional[str] = None
+    # size-based keep-last-1 rotation bound for the trace export file
+    # (0 = unbounded); long trace-collection runs must not fill the disk
+    trace_export_max_bytes: int = 64 * 1024 * 1024
+    # ALSO export each placement's chosen-node learned-feature vector
+    # (the replay-training substrate). Opt-in: it compiles the feature
+    # kernels into every launch and adds per-cycle D2H pulls + export
+    # bytes — phase-timing-only export users should not pay for it
+    trace_export_features: bool = False
+    # explicit tie-break RNG seed for the device pipeline's equal-score
+    # node choice: paired A/B runs (bench --ab-scorer) share a seed so
+    # placement diffs are attributable to the scorer, not the coin.
+    # 0 = the historical default hash stream.
+    tie_break_seed: int = 0
 
     def gate(self, name: str, default: bool = True) -> bool:
         return self.feature_gates.get(name, default)

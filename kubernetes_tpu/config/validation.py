@@ -72,6 +72,10 @@ def validate_config(cfg: SchedulerConfiguration,
         errs.append("mirror capacities must be positive")
     if cfg.flight_recorder_capacity < 0:
         errs.append("flight_recorder_capacity must be >= 0 (0 disables)")
+    if getattr(cfg, "trace_export_max_bytes", 0) < 0:
+        errs.append("trace_export_max_bytes must be >= 0 (0 = unbounded)")
+    if not 0 <= getattr(cfg, "tie_break_seed", 0) < 2 ** 32:
+        errs.append("tie_break_seed must fit in uint32")
     from kubernetes_tpu.config.types import KNOWN_FEATURE_GATES
 
     for gate in cfg.feature_gates:

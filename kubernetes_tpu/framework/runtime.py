@@ -55,6 +55,7 @@ _WEIGHT_FIELD = {
     "ImageLocality": "image_locality",
     "PodTopologySpread": "pod_topology_spread",
     "InterPodAffinity": "inter_pod_affinity",
+    "LearnedScore": "learned",
 }
 
 

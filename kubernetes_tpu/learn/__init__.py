@@ -1,0 +1,35 @@
+"""Learned scoring subsystem: replay-trained MLP scorer for the device
+pipeline.
+
+Three parts (ROADMAP item 5):
+
+- ``learn.replay``: reconstruct training examples from flight-recorder
+  trace exports (per-pod chosen-node feature rows + hand-tuned
+  aggregate scores, export format v2) and outcome labels harvested from
+  the hub's journal/WAL (evictions, topology-spread imbalance,
+  time-to-bind).
+- ``learn.train``: a small pure-JAX MLP trainer — behavior-cloning warm
+  start on the hand-tuned aggregate, then reward-weighted fine-tune on
+  the outcome labels; deterministic given a seed.
+- ``learn.checkpoint``: the versioned on-disk checkpoint format plus the
+  mtime-watching hot-reload helper the scheduler polls at
+  snapshot-sync time.
+
+The serving side lives in ``plugins/learned.py`` (the profile-gated
+LearnedScore manager) and ``ops/learned.py`` (the fused device kernel).
+CLI: ``python -m kubernetes_tpu.learn --help``.
+"""
+
+from kubernetes_tpu.learn.checkpoint import (  # noqa: F401
+    CHECKPOINT_VERSION,
+    CheckpointError,
+    CheckpointWatcher,
+    load_checkpoint,
+    save_checkpoint,
+)
+from kubernetes_tpu.learn.replay import (  # noqa: F401
+    ReplayDataset,
+    build_dataset,
+    synthetic_dataset,
+)
+from kubernetes_tpu.learn.train import TrainConfig, train  # noqa: F401

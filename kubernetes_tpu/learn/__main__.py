@@ -1,0 +1,93 @@
+"""Learned-scorer CLI: ``python -m kubernetes_tpu.learn <cmd>``.
+
+    train     build a replay dataset from trace exports (+ optional WAL)
+              — or --synthetic N — and train a checkpoint
+    identity  write the identity-init checkpoint (reproduces the
+              hand-tuned aggregate; the differential-test fixture)
+    inspect   print a checkpoint's meta + shape chain
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kubernetes-tpu-learn")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_train = sub.add_parser("train", help="train a scorer checkpoint")
+    p_train.add_argument("--traces", nargs="*", default=[],
+                         help="flight-recorder JSON-lines export files "
+                              "(scheduler --trace-export)")
+    p_train.add_argument("--wal", default=None,
+                         help="hub journal WAL for outcome labels")
+    p_train.add_argument("--synthetic", type=int, default=0,
+                         help="train on N synthetic examples instead of "
+                              "trace exports (smoke/CI)")
+    p_train.add_argument("--out", required=True, help="checkpoint path")
+    p_train.add_argument("--seed", type=int, default=0)
+    p_train.add_argument("--hidden", type=int, nargs="*", default=[8])
+    p_train.add_argument("--bc-epochs", type=int, default=300)
+    p_train.add_argument("--ft-epochs", type=int, default=150)
+    p_train.add_argument("--version", type=int, default=1,
+                         help="checkpoint version stamp (monotonic per "
+                              "deployment; surfaced by the "
+                              "scheduler_learned_checkpoint_version gauge)")
+
+    p_id = sub.add_parser("identity", help="identity-init checkpoint")
+    p_id.add_argument("--out", required=True)
+    # version 0 is the checkpoint-version gauge's "none loaded"
+    # sentinel; a deployed identity checkpoint must read as loaded
+    p_id.add_argument("--version", type=int, default=1)
+
+    p_ins = sub.add_parser("inspect", help="print checkpoint meta")
+    p_ins.add_argument("path")
+
+    args = parser.parse_args(argv)
+
+    from kubernetes_tpu.learn import checkpoint as ck
+
+    if args.cmd == "inspect":
+        params, meta = ck.load_checkpoint(args.path)
+        print(json.dumps({
+            "meta": meta,
+            "layers": [{"w": list(w.shape), "b": list(b.shape)}
+                       for w, b in params],
+        }, indent=2, default=str))
+        return 0
+
+    if args.cmd == "identity":
+        from kubernetes_tpu.learn.train import identity_params
+
+        doc = ck.save_checkpoint(args.out, identity_params(),
+                                 meta={"identity": True,
+                                       "version": args.version})
+        print(json.dumps({"written": args.out, "meta": doc["meta"]}))
+        return 0
+
+    # train
+    from kubernetes_tpu.learn.replay import build_dataset, synthetic_dataset
+    from kubernetes_tpu.learn.train import TrainConfig, train
+
+    if args.synthetic:
+        ds = synthetic_dataset(seed=args.seed, n=args.synthetic)
+    elif args.traces:
+        ds = build_dataset(args.traces, wal_path=args.wal)
+    else:
+        print("train needs --traces or --synthetic", file=sys.stderr)
+        return 2
+    cfg = TrainConfig(hidden=tuple(args.hidden), seed=args.seed,
+                      bc_epochs=args.bc_epochs, ft_epochs=args.ft_epochs,
+                      meta={"version": args.version, **ds.meta})
+    params, info = train(ds, cfg)
+    doc = ck.save_checkpoint(args.out, params, meta=info)
+    print(json.dumps({"written": args.out, "meta": doc["meta"]},
+                     default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,237 @@
+"""Replay dataset builder: flight-recorder trace exports + journal/WAL
+-> training examples for the learned scorer.
+
+Example = (feature row, behavior-cloning target, outcome reward):
+
+- **Features** come straight from the trace export (format v2): each
+  cycle line carries per-pod placement rows with the CHOSEN node's
+  feature vector as the device program computed it
+  (``BatchResult.chosen_feat``) — training sees exactly the inference
+  distribution, no host re-derivation drift.
+- **Behavior-cloning target** is the hand-tuned weighted sum over the
+  FEATURE-EXPRESSIBLE plugin scores, reconstructed from the feature row
+  itself (the "Learning to Score" warm start: clone the weighted
+  combination, then move off it). The exported winning aggregate is
+  deliberately NOT the target: it also carries topology/IPA/host terms
+  the feature row cannot express, so on topology-heavy workloads it
+  saturates any fixed rescale at the clip and the BC fit degenerates to
+  a pinned constant. It still rides the dataset as ``agg_score`` — the
+  analysis column and a future richer-feature target.
+- **Outcome rewards** are harvested downstream from the hub's
+  journal/WAL (kubernetes_tpu.storage): a placement whose pod was later
+  evicted/preempted (a bound pod DELETE) is down-weighted, slow
+  time-to-bind (first trace appearance -> bind cycle) and
+  topology-domain crowding (bound-count imbalance of the chosen node's
+  zone/hostname domain at replay end) shade the reward around 1.0.
+
+Everything is host-side numpy over JSON lines — no device work; a few
+hundred thousand examples build in seconds.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator, Optional
+
+import numpy as np
+
+from kubernetes_tpu.ops.learned import NUM_FEATURES, hand_weight_vector
+# the writer's format constant (CycleTrace.to_dict): importing it keeps
+# this reader in lockstep with the export shape
+from kubernetes_tpu.utils.tracing import EXPORT_VERSION
+
+logger = logging.getLogger("kubernetes_tpu.learn")
+
+
+def bc_targets(x: np.ndarray) -> np.ndarray:
+    """[M] behavior-cloning targets in [0, 100]: the hand-tuned
+    weighted sum over the feature-expressible plugin scores,
+    reconstructed from the feature rows (features are score/100, so
+    (x @ w) * 100 / sum(w) is exactly the rescaled aggregate — no
+    clipping, no topology contamination)."""
+    w = hand_weight_vector()
+    return ((x @ w) * (100.0 / w.sum())).astype(np.float32)
+
+EVICT_PENALTY = 0.25          # reward factor for later-evicted placements
+SLOW_BIND_SHADE = 0.25        # shade per unit of above-median bind time
+CROWDING_SHADE = 0.5          # shade per unit of above-mean domain count
+
+ZONE_LABEL = "topology.kubernetes.io/zone"
+HOSTNAME_LABEL = "kubernetes.io/hostname"
+
+
+@dataclass
+class ReplayDataset:
+    """x [M, F] float32 features; y [M] behavior-clone targets in
+    [0, 100]; reward [M] outcome weights around 1.0; agg_score [M] the
+    exported winning aggregate (analysis only — includes topology/host
+    terms the features cannot express)."""
+
+    x: np.ndarray
+    y: np.ndarray
+    reward: np.ndarray
+    agg_score: np.ndarray = None
+    meta: dict = field(default_factory=dict)
+
+    def __len__(self) -> int:
+        return self.x.shape[0]
+
+
+def iter_trace_lines(path: str) -> Iterator[dict]:
+    """Lazily parse one export file; malformed lines (a torn tail from a
+    live scheduler, a rotation boundary) are skipped, not fatal."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except ValueError:
+                continue
+
+
+def _wal_outcomes(wal_path: str) -> tuple[set, dict]:
+    """(evicted_uids, node -> topology domain) from the journal WAL:
+    a bound pod's DELETE is the eviction/preemption signal (victims are
+    deleted by the scheduler's eviction flush; a completed pod exits
+    through the same door — both mean the placement did not stick), and
+    node ADD/UPDATE events carry the labels that map each node to its
+    zone (hostname fallback) domain."""
+    from kubernetes_tpu.utils.wire import from_wire
+
+    evicted: set = set()
+    node_domain: dict = {}
+    with open(wal_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue        # torn tail — storage tolerates it too
+            kind = rec.get("kind")
+            try:
+                if kind == "pods" and rec.get("type") == "delete":
+                    old = from_wire(rec.get("old"))
+                    if old is not None and old.spec.node_name:
+                        evicted.add(old.metadata.uid)
+                elif kind == "nodes" and rec.get("type") in ("add",
+                                                             "update"):
+                    new = from_wire(rec.get("new"))
+                    if new is not None:
+                        labels = new.metadata.labels or {}
+                        node_domain[new.metadata.name] = labels.get(
+                            ZONE_LABEL,
+                            labels.get(HOSTNAME_LABEL,
+                                       new.metadata.name))
+            except Exception:  # noqa: BLE001 — one bad record is data loss,
+                continue       # not a failed build
+    return evicted, node_domain
+
+
+def build_dataset(trace_paths: Iterable[str],
+                  wal_path: Optional[str] = None,
+                  max_examples: int = 500_000) -> ReplayDataset:
+    """Reconstruct a training set from export files (+ optional WAL for
+    outcome labels). Raises ValueError when no usable placement rows are
+    found (exports predating format v2 carry no feature rows)."""
+    feats: list = []
+    scores: list = []
+    uids: list = []
+    nodes: list = []
+    first_seen: dict = {}
+    bind_at: dict = {}
+    lines = 0
+    skipped_old = 0
+    for path in ([trace_paths] if isinstance(trace_paths, str)
+                 else list(trace_paths)):
+        for line in iter_trace_lines(path):
+            lines += 1
+            if line.get("v", 1) < EXPORT_VERSION:
+                skipped_old += 1
+                continue
+            t = float(line.get("start", 0.0))
+            for row in line.get("placements") or []:
+                uid = row.get("uid", "")
+                if uid and uid not in first_seen:
+                    first_seen[uid] = t
+                node = row.get("node")
+                if node is None:
+                    continue    # failed attempt: time-to-bind anchor only
+                feat = row.get("feat")
+                if not feat or len(feat) != NUM_FEATURES:
+                    continue
+                if len(feats) >= max_examples:
+                    continue
+                bind_at.setdefault(uid, t)
+                feats.append(feat)
+                scores.append(float(row.get("score", 0.0)))
+                uids.append(uid)
+                nodes.append(node)
+    if not feats:
+        raise ValueError(
+            f"no v{EXPORT_VERSION} placement rows with feature vectors "
+            f"found ({lines} trace lines, {skipped_old} "
+            f"pre-v{EXPORT_VERSION}); run the scheduler with "
+            "trace_export_path set AND trace_export_features=true "
+            "(the feature export is opt-in)")
+    x = np.asarray(feats, np.float32)
+    y = bc_targets(x)
+    reward = np.ones((len(feats),), np.float32)
+
+    # time-to-bind shading: placements that took longer than the median
+    # pod (first attempt -> bind) carry less weight
+    ttbs = {u: bind_at[u] - first_seen.get(u, bind_at[u]) for u in bind_at}
+    med = float(np.median(list(ttbs.values()))) if ttbs else 0.0
+    if med > 0:
+        for i, uid in enumerate(uids):
+            rel = ttbs.get(uid, med) / med
+            reward[i] /= 1.0 + max(0.0, rel - 1.0) * SLOW_BIND_SHADE
+
+    evicted: set = set()
+    node_domain: dict = {}
+    if wal_path:
+        evicted, node_domain = _wal_outcomes(wal_path)
+        for i, uid in enumerate(uids):
+            if uid in evicted:
+                reward[i] *= EVICT_PENALTY
+    # topology-domain crowding: placements into domains that ended up
+    # holding more than their share of this replay's pods shade down —
+    # the spread-imbalance outcome label
+    domains = [node_domain.get(n, n) for n in nodes]
+    counts: dict = {}
+    for d in domains:
+        counts[d] = counts.get(d, 0) + 1
+    if len(counts) > 1:
+        mean = sum(counts.values()) / len(counts)
+        for i, d in enumerate(domains):
+            imb = counts[d] / mean
+            reward[i] /= 1.0 + max(0.0, imb - 1.0) * CROWDING_SHADE
+    return ReplayDataset(
+        x=x, y=y, reward=reward,
+        agg_score=np.asarray(scores, np.float32),
+        meta={"examples": len(feats), "trace_lines": lines,
+              "skipped_pre_v2": skipped_old, "evicted": len(evicted),
+              "domains": len(counts),
+              "ttb_median_s": round(med, 6)})
+
+
+def synthetic_dataset(seed: int = 0, n: int = 512,
+                      noise: float = 2.0) -> ReplayDataset:
+    """A tiny synthetic replay for smoke training (CI keeps a <30s
+    train on this): features uniform in the unit cube, targets the
+    hand-tuned-shaped combination plus noise, rewards favoring
+    low-utilization placements (a learnable signal distinct from the
+    BC target)."""
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(0.0, 1.0, size=(n, NUM_FEATURES)).astype(np.float32)
+    y = np.clip(bc_targets(x) + rng.normal(0.0, noise, size=n),
+                0.0, 100.0).astype(np.float32)
+    reward = (1.25 - 0.5 * (x[:, 0] + x[:, 1]) / 2.0).astype(np.float32)
+    return ReplayDataset(x=x, y=y, reward=reward,
+                         meta={"examples": n, "synthetic": True,
+                               "seed": seed})

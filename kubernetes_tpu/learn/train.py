@@ -1,0 +1,148 @@
+"""Offline MLP trainer for the learned scorer — pure JAX, deterministic
+given a seed.
+
+Two phases, following the PAPERS shape (behavior-clone the incumbent
+policy, then improve it from recorded outcomes):
+
+1. **Behavior cloning**: full-batch Adam on MSE between the MLP output
+   and the hand-tuned aggregate (rescaled to [0, 100]) — the warm start
+   that guarantees the scorer begins AT the incumbent policy instead of
+   at noise.
+2. **Reward-weighted fine-tune**: targets nudged by each example's
+   outcome advantage (reward minus the batch mean — evictions, slow
+   binds, and domain crowding push a placement's target down, clean
+   fast placements push it up), samples weighted by |advantage| so the
+   informative tail dominates. This is reward-weighted regression, not
+   RL-with-rollouts: the cluster is not available for on-policy
+   exploration, the replay is.
+
+Everything (init, shuffling-free full-batch steps, Adam state) is
+derived from the seed; two runs with the same seed and dataset produce
+bit-identical checkpoints — the property the A/B harness and the
+regression tests lean on.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.learn.replay import ReplayDataset
+from kubernetes_tpu.ops.learned import (
+    MAX_SCORE,
+    NUM_FEATURES,
+    hand_weight_vector,
+    mlp_apply,
+)
+
+
+@dataclass
+class TrainConfig:
+    hidden: tuple = (8,)
+    seed: int = 0
+    bc_epochs: int = 300
+    ft_epochs: int = 150
+    lr: float = 0.03
+    ft_lr: float = 0.005
+    # score points a one-unit outcome advantage moves the target by
+    ft_gain: float = 25.0
+    meta: dict = field(default_factory=dict)
+
+
+def init_params(seed: int, hidden: tuple = (8,),
+                num_features: int = NUM_FEATURES):
+    """He-initialized ((W, b), ...) layer stack, scalar head."""
+    key = jax.random.PRNGKey(seed)
+    sizes = (num_features,) + tuple(hidden) + (1,)
+    params = []
+    for i in range(len(sizes) - 1):
+        key, sub = jax.random.split(key)
+        scale = float(np.sqrt(2.0 / sizes[i]))
+        w = jax.random.normal(sub, (sizes[i], sizes[i + 1]),
+                              jnp.float32) * scale
+        params.append((w, jnp.zeros((sizes[i + 1],), jnp.float32)))
+    return tuple(params)
+
+
+def identity_params():
+    """A single linear layer reproducing the hand-tuned no-topology
+    aggregate (rescaled to [0, 100]): the differential-test fixture —
+    at any positive weight it only rescales the aggregate on
+    topology-free batches, so placements match the baseline exactly."""
+    w = np.zeros((NUM_FEATURES, 1), np.float32)
+    hand = hand_weight_vector()      # live default_weights, feature order
+    # features are score/100, so out = sum(w_i * s_i) / sum(w) in [0,100]
+    w[:, 0] = hand * (MAX_SCORE / hand.sum())
+    return ((w, np.zeros((1,), np.float32)),)
+
+
+def _adam_step(params, grads, m, v, t, lr, b1=0.9, b2=0.999, eps=1e-8):
+    m = jax.tree.map(lambda a, g: b1 * a + (1 - b1) * g, m, grads)
+    v = jax.tree.map(lambda a, g: b2 * a + (1 - b2) * g * g, v, grads)
+    mh = jax.tree.map(lambda a: a / (1 - b1 ** t), m)
+    vh = jax.tree.map(lambda a: a / (1 - b2 ** t), v)
+    params = jax.tree.map(
+        lambda p, a, b: p - lr * a / (jnp.sqrt(b) + eps), params, mh, vh)
+    return params, m, v
+
+
+def _fit(params, x, y, w, epochs, lr):
+    """Full-batch weighted-MSE Adam; returns (params, first_loss,
+    last_loss)."""
+
+    def loss_fn(p):
+        pred = mlp_apply(p, x)
+        return jnp.mean(w * (pred - y) ** 2)
+
+    step = jax.jit(jax.value_and_grad(loss_fn))
+    m = jax.tree.map(jnp.zeros_like, params)
+    v = jax.tree.map(jnp.zeros_like, params)
+    first = last = None
+    for t in range(1, max(epochs, 0) + 1):
+        loss, grads = step(params)
+        params, m, v = _adam_step(params, grads, m, v, t, lr)
+        if first is None:
+            first = float(loss)
+        last = float(loss)
+    return params, first, last
+
+
+def train(ds: ReplayDataset, cfg: Optional[TrainConfig] = None):
+    """Returns (params, info): params a ((W, b), ...) numpy stack ready
+    for learn.checkpoint.save_checkpoint, info the training record that
+    lands in the checkpoint meta."""
+    cfg = cfg or TrainConfig()
+    if len(ds) == 0:
+        raise ValueError("empty replay dataset")
+    x = jnp.asarray(ds.x, jnp.float32)
+    y = jnp.asarray(ds.y, jnp.float32)
+    ones = jnp.ones_like(y)
+    params = init_params(cfg.seed, cfg.hidden, ds.x.shape[1])
+    params, bc_first, bc_last = _fit(params, x, y, ones,
+                                     cfg.bc_epochs, cfg.lr)
+    info = {
+        "seed": cfg.seed,
+        "hidden": list(cfg.hidden),
+        "examples": int(len(ds)),
+        "bc_epochs": cfg.bc_epochs,
+        "bc_loss_first": round(bc_first or 0.0, 4),
+        "bc_loss_last": round(bc_last or 0.0, 4),
+    }
+    info.update(cfg.meta)
+    if cfg.ft_epochs > 0:
+        adv = jnp.asarray(ds.reward, jnp.float32)
+        adv = adv - jnp.mean(adv)
+        target = jnp.clip(y + cfg.ft_gain * adv, 0.0, MAX_SCORE)
+        weight = 1.0 + jnp.abs(adv)
+        params, ft_first, ft_last = _fit(params, x, target, weight,
+                                         cfg.ft_epochs, cfg.ft_lr)
+        info.update(ft_epochs=cfg.ft_epochs,
+                    ft_loss_first=round(ft_first or 0.0, 4),
+                    ft_loss_last=round(ft_last or 0.0, 4))
+    params_np = tuple((np.asarray(w, np.float32), np.asarray(b, np.float32))
+                      for w, b in params)
+    return params_np, info

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 
 from kubernetes_tpu.ops import common as C
 from kubernetes_tpu.ops import filters as FL
+from kubernetes_tpu.ops import learned as LN
 from kubernetes_tpu.ops import scores as SC
 from kubernetes_tpu.ops import topology as T
 from kubernetes_tpu.utils.interner import NONE
@@ -76,6 +77,7 @@ SCORE_PLUGINS = (
     "ImageLocality",              # w=1, 0..100
     "PodTopologySpread",          # w=2, spread-normalized
     "InterPodAffinity",           # w=2, max-min-normalized
+    "LearnedScore",               # w=0 by default (profile-gated MLP term)
 )
 
 # default HardPodAffinityWeight (apis/config/v1/defaults.go)
@@ -131,6 +133,9 @@ class ScoreWeights:
     image_locality: jax.Array
     pod_topology_spread: jax.Array
     inter_pod_affinity: jax.Array
+    # the learned MLP term (ops/learned.py); 0 unless a profile enables
+    # the LearnedScore plugin, so the default aggregate is unchanged
+    learned: jax.Array
 
 
 def default_weights() -> ScoreWeights:
@@ -142,6 +147,7 @@ def default_weights() -> ScoreWeights:
         image_locality=jnp.float32(1.0),
         pod_topology_spread=jnp.float32(2.0),
         inter_pod_affinity=jnp.float32(2.0),
+        learned=jnp.float32(0.0),
     )
 
 
@@ -184,6 +190,15 @@ class BatchResult:
     # the pod's host_reject_counts under "DynamicResources" so diagnosis
     # and requeue hints match the host filter path exactly.
     dra_reject: jax.Array
+    # [] f32: mean |weighted learned-score term| over feasible (pod,
+    # node) pairs this launch (0.0 when the launch carried no learned
+    # params). Pulled only when the learned scorer is active — feeds the
+    # scheduler_learned_score_magnitude histogram.
+    learned_mag: jax.Array
+    # [B, ops.learned.NUM_FEATURES] f32: the CHOSEN node's learned-score
+    # feature row per pod (zeros unless the launch was compiled
+    # with_feats — the flight-recorder export's replay-dataset rows).
+    chosen_feat: jax.Array
 
 
 # workload-activity flags (STATIC, host-derived per launch by
@@ -229,13 +244,22 @@ def static_filters(ct: ClusterTensors, pod: PodFeatures,
     return jnp.stack(masks)
 
 
-def tie_perturb(b, n: int) -> jnp.ndarray:
+def tie_perturb(b, n: int, seed=None) -> jnp.ndarray:
     """[n] pseudo-random f32 in [0,1) keyed by (pod index b, node index):
     the stateless device analog of selectHost's reservoir sampling
     (schedule_one.go:865) — equal-score nodes pick uniformly instead of
-    hotspotting the lowest row. Cheap integer hash; fuses, no RNG state."""
+    hotspotting the lowest row. Cheap integer hash; fuses, no RNG state.
+
+    ``seed`` (config tie_break_seed, a DYNAMIC scalar — changing it never
+    recompiles) mixes an explicit stream into the hash so paired A/B runs
+    are tie-break-deterministic and score diffs attribute to the scorer,
+    not the coin. Seed 0 (and None) is the identity xor: the default
+    launch stays bit-identical to the historical unseeded hash."""
     x = jnp.arange(n, dtype=jnp.uint32) * jnp.uint32(2654435761)
     x = x ^ (jnp.asarray(b).astype(jnp.uint32) * jnp.uint32(40503))
+    if seed is not None:
+        x = x ^ (jnp.asarray(seed).astype(jnp.uint32)
+                 * jnp.uint32(2654435761))
     x = (x ^ (x >> 15)) * jnp.uint32(2246822519)
     x = x ^ (x >> 13)
     return (x >> 8).astype(jnp.float32) / jnp.float32(1 << 24)
@@ -244,7 +268,8 @@ def tie_perturb(b, n: int) -> jnp.ndarray:
 def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
                    img, unres, weights, free0, nzr0, host_score=None,
                    fit_strategy="LeastAllocated", fit_shape=None,
-                   dra_reject=None):
+                   dra_reject=None, learned=None, tie_seed=None,
+                   with_feats=False):
     """Parallel auction replacing the per-pod commit scan when the batch has
     no topology constraints and no host ports: every round, all unplaced
     pods score+argmax in parallel; per node, pods are accepted in BATCH
@@ -264,7 +289,7 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
     B, N = static_ok.shape
     alloc2 = SC.alloc_cpu_mem(ct)
     own = jnp.arange(N)[None, :] == pods.nominated_row[:, None]    # [B, N]
-    perturb = jax.vmap(lambda u: tie_perturb(u, N))(pods.uid_id)
+    perturb = jax.vmap(lambda u: tie_perturb(u, N, tie_seed))(pods.uid_id)
     idx_b = jnp.arange(B)
 
     def fit_all(free):
@@ -272,19 +297,29 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
                + jnp.where(own[..., None], pods.req[:, None, :], 0.0))
         return jnp.all(pods.req[:, None, :] <= eff, axis=-1)       # [B, N]
 
+    def per_pod_scores(nzr, nzreq, t_raw, a_raw, feas):
+        """One pod's normalized per-plugin score arrays against ``nzr``
+        (shared by the round totals and the learned-feature export)."""
+        frac = SC.utilization_fractions(alloc2, nzr, nzreq)
+        least = SC.fit_score_from_fractions(frac, fit_strategy, fit_shape)
+        bal = SC.balanced_allocation_from_fractions(frac)
+        taint = SC.normalize_inverse(t_raw, feas)
+        aff = SC.normalize_max(a_raw, feas)
+        return frac, least, bal, taint, aff
+
     def totals(nzr, feasible):
         def per_pod(nzreq, t_raw, a_raw, im, feas):
-            frac = SC.utilization_fractions(alloc2, nzr, nzreq)
-            least = SC.fit_score_from_fractions(frac, fit_strategy,
-                                                fit_shape)
-            bal = SC.balanced_allocation_from_fractions(frac)
-            taint = SC.normalize_inverse(t_raw, feas)
-            aff = SC.normalize_max(a_raw, feas)
-            return (weights.taint_toleration * taint
-                    + weights.node_affinity * aff
-                    + weights.resources_fit * least
-                    + weights.balanced_allocation * bal
-                    + weights.image_locality * im)
+            frac, least, bal, taint, aff = per_pod_scores(
+                nzr, nzreq, t_raw, a_raw, feas)
+            total = (weights.taint_toleration * taint
+                     + weights.node_affinity * aff
+                     + weights.resources_fit * least
+                     + weights.balanced_allocation * bal
+                     + weights.image_locality * im)
+            if learned is not None:
+                total = total + weights.learned * LN.learned_term(
+                    learned, frac, least, bal, taint, aff, im)
+            return total
         out = jax.vmap(per_pod)(pods.nonzero_req, taint_raw, aff_raw, img,
                                 feasible)
         return out if host_score is None else out + host_score
@@ -332,13 +367,53 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
     reject_counts = jnp.concatenate(
         [static_rejects, fit_rejects[:, None], zeros[:, None],
          zeros[:, None]], axis=1)
+    # learned-score magnitude + chosen-node feature export, attributed
+    # against the END state like the reject diagnostics above (the
+    # per-round states the losers scored against are gone)
+    learned_mag = jnp.float32(0.0)
+    chosen_feat = jnp.zeros((B, LN.NUM_FEATURES), jnp.float32)
+    if learned is not None or with_feats:
+        ok_end = static_ok & fit       # end-state feasible, like rejects
+        rows_c = jnp.clip(placed, 0, N - 1)
+        chosen_oh = ((rows_c[:, None] == jnp.arange(N)[None, :])
+                     & (placed >= 0)[:, None])                # [B, N]
+
+        def pod_feats(nzreq, t_raw, a_raw, im, feas_row, own_row):
+            # subtract the pod's OWN committed usage first —
+            # utilization_fractions re-adds the request, so feeding the
+            # end-state nzr directly would double-count the pod on its
+            # chosen node and skew the exported training distribution
+            # away from what the scorer sees at inference
+            nzr_i = nzr - own_row[:, None] * nzreq[None, :]
+            frac, least, bal, taint, aff = per_pod_scores(
+                nzr_i, nzreq, t_raw, a_raw, feas_row)
+            return LN.feature_rows(frac, least, bal, taint, aff, im)
+        # the chosen node joins its own normalization mask even when
+        # end-state fit excludes it (it WAS feasible when it won)
+        feats = jax.vmap(pod_feats)(pods.nonzero_req, taint_raw, aff_raw,
+                                    img, ok_end | chosen_oh,
+                                    chosen_oh.astype(nzr.dtype))
+        if learned is not None:
+            lterm = jnp.clip(LN.mlp_apply(learned, feats), 0.0,
+                             LN.MAX_SCORE)                    # [B, N]
+            # same feasible-pair definition as the serial path's live
+            # mask (modulo end-state attribution): one histogram, one
+            # metric meaning across commit paths
+            n_ok = jnp.maximum(jnp.sum(ok_end), 1)
+            learned_mag = (jnp.sum(jnp.where(
+                ok_end, jnp.abs(weights.learned * lterm), 0.0))
+                / n_ok.astype(jnp.float32))
+        if with_feats:
+            chosen_feat = jnp.take_along_axis(
+                feats, rows_c[:, None, None], axis=1)[:, 0, :]
     return BatchResult(node_row=placed, score=win, feasible_count=feas,
                        reject_counts=reject_counts,
                        unresolvable_count=unres, free=free, nzr=nzr,
                        pct_start=jnp.int32(0),
                        guard=_guard_reduction(win, free),
                        dra_reject=(jnp.zeros((B,), jnp.int32)
-                                   if dra_reject is None else dra_reject))
+                                   if dra_reject is None else dra_reject),
+                       learned_mag=learned_mag, chosen_feat=chosen_feat)
 
 
 def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
@@ -361,6 +436,9 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                    pct_nodes: int = 0,
                    pct_start: jnp.ndarray | None = None,
                    dra=None,
+                   learned=None,
+                   tie_seed=None,
+                   with_feats: bool = False,
                    ) -> BatchResult:
     """Schedule a whole pod batch in one launch, as-if-serial (see module
     docstring for the two-phase structure).
@@ -402,7 +480,17 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
     routed claim pods) fuses the batched DRA allocator into this same
     program: claim feasibility for every (pod, node) pair is one more
     vmapped predicate ANDed into the feasible mask, and the per-pod
-    rejected-node count lands in BatchResult.dra_reject."""
+    rejected-node count lands in BatchResult.dra_reject.
+
+    ``learned`` (an ops.learned params pytree, or None) fuses the
+    profile-gated MLP scorer into the aggregate as one more weighted
+    term (weights.learned); a NaN-poisoned checkpoint trips the guard
+    reduction like any other device fault. ``tie_seed`` (dynamic scalar)
+    keys the tie-break hash for A/B-deterministic paired runs; seed
+    0/None is the historical hash. ``with_feats`` (STATIC) additionally
+    materializes each pod's chosen-node feature row in
+    BatchResult.chosen_feat — the flight-recorder export's replay rows;
+    off, the field is zeros and the feature kernels are DCE'd."""
     ct = unpack_cluster(cblobs, caps)
     pods = unpack_pods(pblobs, caps, pfields, ptmpl)  # leaves [B, ...]
     free0 = ct.free if state is None else state[0]
@@ -512,7 +600,7 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
         return _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw,
                               aff_raw, img, unres, weights, free0, nzr0,
                               host_score, fit_strategy, fit_shape,
-                              dra_reject)
+                              dra_reject, learned, tie_seed, with_feats)
     if enable_topology:
         # ---- phase 1b: topology statics per GROUP (representatives) ----
         pods_rep = jax.tree.map(lambda x: x[rep], pods)  # leaves [G, ...]
@@ -621,7 +709,8 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
     # uniformly instead of hotspotting the lowest row (selectHost's
     # reservoir sample, schedule_one.go:865)
     perturb_rows = jax.vmap(
-        lambda u: tie_perturb(u, cblobs.node_f32.shape[0]))(pods.uid_id)
+        lambda u: tie_perturb(u, cblobs.node_f32.shape[0],
+                              tie_seed))(pods.uid_id)
     # pairwise hostPort conflicts: pod j can't join a node where an earlier
     # conflicting batch pod was committed (as-if-serial NodePorts)
     port_conf = (FL.pod_pair_port_conflict(pods, wk["wildcard_ip"])
@@ -842,6 +931,15 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                  + weights.image_locality * im
                  + weights.pod_topology_spread * spread
                  + weights.inter_pod_affinity * ipa)
+        if learned is not None:
+            # the fused MLP term, against the SAME live per-step state
+            # the hand-tuned terms see (as-if-serial holds for it too)
+            lterm = weights.learned * LN.learned_term(
+                learned, frac, least, bal, taint, aff, im)
+            total = total + lterm
+            lmag_step = (jnp.sum(jnp.where(feasible, jnp.abs(lterm), 0.0))
+                         / jnp.maximum(jnp.sum(feasible), 1)
+                         .astype(jnp.float32))
         if host_score is not None:
             total = total + host_score[b]
         row = C.masked_argmax_random(total, feasible, ptb)
@@ -871,9 +969,14 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
             out_carry = (free, nzr, committed_rows)
         if pct_nodes:
             out_carry = out_carry + (start,)
-        return out_carry, (
-            row, win, jnp.sum(feasible).astype(jnp.int32),
-            port_rejects, fit_rejects, sp_rejects, ipa_rejects)
+        ys = (row, win, jnp.sum(feasible).astype(jnp.int32),
+              port_rejects, fit_rejects, sp_rejects, ipa_rejects)
+        if learned is not None:
+            ys = ys + (lmag_step,)
+        if with_feats:
+            ys = ys + (LN.feature_row_at(r, frac, least, bal, taint, aff,
+                                         im),)
+        return out_carry, ys
 
     xs = (jnp.arange(B), static_ok, taint_raw, aff_raw, img,
           pods.req, pods.nonzero_req, perturb_rows)
@@ -900,10 +1003,19 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
     # unroll: the body is many small fused kernels; per-iteration dispatch
     # overhead (not FLOPs) is a real cost at these shapes, so unrolling
     # amortizes it
-    (carry_out, (rows, win_scores, feas, port_rejects,
-                 fit_rejects, sp_rejects,
-                 ipa_rejects)) = jax.lax.scan(body, init, xs,
-                                              unroll=scan_unroll())
+    (carry_out, ys_out) = jax.lax.scan(body, init, xs,
+                                       unroll=scan_unroll())
+    (rows, win_scores, feas, port_rejects, fit_rejects, sp_rejects,
+     ipa_rejects) = ys_out[:7]
+    extra = list(ys_out[7:])
+    learned_mag = jnp.float32(0.0)
+    if learned is not None:
+        lmags = extra.pop(0)                                      # [B]
+        n_valid = jnp.maximum(jnp.sum(pods.valid), 1)
+        learned_mag = (jnp.sum(jnp.where(pods.valid, lmags, 0.0))
+                       / n_valid.astype(jnp.float32))
+    chosen_feat = (extra.pop(0) if with_feats
+                   else jnp.zeros((B, LN.NUM_FEATURES), jnp.float32))
     free_out, nzr_out = carry_out[0], carry_out[1]
     start_out = carry_out[-1] if pct_nodes else jnp.int32(0)
 
@@ -916,13 +1028,15 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                        reject_counts=reject_counts, unresolvable_count=unres,
                        free=free_out, nzr=nzr_out, pct_start=start_out,
                        guard=_guard_reduction(win_scores, free_out),
-                       dra_reject=dra_reject)
+                       dra_reject=dra_reject, learned_mag=learned_mag,
+                       chosen_feat=chosen_feat)
 
 
 @partial(jax.jit, static_argnames=("caps", "enable_topology", "d_cap",
                                    "enabled_filters", "serial_scan",
                                    "active", "pfields", "g_cap",
-                                   "fit_strategy", "pct_nodes"))
+                                   "fit_strategy", "pct_nodes",
+                                   "with_feats"))
 def schedule_batch_jit(cblobs, pblobs, wk, weights, caps,
                        enable_topology=True, d_cap=None,
                        enabled_filters=None, serial_scan=True, state=None,
@@ -930,13 +1044,14 @@ def schedule_batch_jit(cblobs, pblobs, wk, weights, caps,
                        gid=None, rep=None, g_cap=0, host_ok=None,
                        host_score=None, fit_strategy="LeastAllocated",
                        fit_shape=None, pct_nodes=0, pct_start=None,
-                       dra=None):
+                       dra=None, learned=None, tie_seed=None,
+                       with_feats=False):
     return schedule_batch(cblobs, pblobs, wk, weights, caps,
                           enable_topology, d_cap, enabled_filters,
                           serial_scan, state, active, pfields, ptmpl,
                           gid, rep, g_cap, host_ok, host_score,
                           fit_strategy, fit_shape, pct_nodes, pct_start,
-                          dra)
+                          dra, learned, tie_seed, with_feats)
 
 
 @partial(jax.jit, static_argnames=("caps",))
@@ -955,7 +1070,9 @@ def extract_state_jit(cblobs, caps):
 def launch_batch(spec, wk, weights, caps, enabled_filters=None,
                  serial_scan=True, state=None, host_ok=None,
                  host_score=None, fit_strategy="LeastAllocated",
-                 fit_shape=None, pct_nodes=0, pct_start=None) -> BatchResult:
+                 fit_shape=None, pct_nodes=0, pct_start=None,
+                 learned=None, tie_seed=None,
+                 with_feats=False) -> BatchResult:
     """schedule_batch_jit driven by a Mirror.prepare_launch LaunchSpec."""
     return schedule_batch_jit(
         spec.cblobs, spec.pblobs, wk, weights, caps,
@@ -965,4 +1082,5 @@ def launch_batch(spec, wk, weights, caps, enabled_filters=None,
         gid=spec.gid, rep=spec.rep, g_cap=spec.g_cap,
         host_ok=host_ok, host_score=host_score,
         fit_strategy=fit_strategy, fit_shape=fit_shape,
-        pct_nodes=pct_nodes, pct_start=pct_start, dra=spec.dra)
+        pct_nodes=pct_nodes, pct_start=pct_start, dra=spec.dra,
+        learned=learned, tie_seed=tie_seed, with_feats=with_feats)

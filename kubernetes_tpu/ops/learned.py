@@ -1,0 +1,122 @@
+"""Learned scoring kernel: a small MLP over per-node score features.
+
+The device half of the learned-scoring subsystem (kubernetes_tpu.learn):
+scoring already runs as vmapped tensors inside the fused Filter/Score
+launch, so the learned scorer is one more vmapped function in the same
+XLA program — zero extra H2D, zero extra launches. Following "Learning
+to Score" (tune the score COMBINATION instead of hand-set weights), the
+feature vector is the per-node signals the hand-tuned weighted sum
+already computes, so the MLP's input is free: the pipeline hands the
+exact arrays it just materialized for the hand-tuned aggregate.
+
+Feature layout (FEATURE_VERSION 1), one row per node, every entry
+in [0, 1]:
+
+    0 frac_cpu        cpu utilization fraction including this pod
+    1 frac_mem        memory utilization fraction including this pod
+    2 fit             NodeResourcesFit strategy score / 100
+    3 balance         balanced-allocation score / 100
+    4 taint           normalized taint-toleration score / 100
+    5 node_affinity   normalized preferred-node-affinity score / 100
+    6 image_locality  image-locality score / 100
+
+The scorer's output is clipped to the same [0, 100] range every other
+normalized plugin score lives in, then weighted into the aggregate by
+``ScoreWeights.learned`` exactly like a hand-tuned term. A NaN anywhere
+in the params propagates through the clip into the aggregate, where the
+launch's guard reduction (pipeline._guard_reduction) trips and the
+scheduler degrades that batch down the device→host fallback ladder to
+hand-tuned weights — a bad checkpoint costs one batch, never a
+placement.
+
+Params are a plain pytree — ``((W0, b0), (W1, b1), ...)`` with relu
+between layers and a scalar output — so swapping checkpoints of the
+same architecture never recompiles (only the leaf VALUES change); a
+different layer stack is a different jit signature and compiles once.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+LEARNED_FEATURES = (
+    "frac_cpu",
+    "frac_mem",
+    "fit",
+    "balance",
+    "taint",
+    "node_affinity",
+    "image_locality",
+)
+NUM_FEATURES = len(LEARNED_FEATURES)
+
+# bumped whenever the feature layout changes; checkpoints record the
+# version they were trained against and the loader rejects a mismatch
+# (a scorer trained on other features would be garbage, not degraded)
+FEATURE_VERSION = 1
+
+MAX_SCORE = 100.0
+
+# Params = tuple[tuple[Array, Array], ...]: ((W, b), ...) layer stack.
+
+
+def feature_rows(frac: jnp.ndarray, fit: jnp.ndarray, bal: jnp.ndarray,
+                 taint: jnp.ndarray, aff: jnp.ndarray,
+                 img: jnp.ndarray) -> jnp.ndarray:
+    """[N, NUM_FEATURES] feature matrix from the per-node arrays the
+    pipeline already computed for the hand-tuned aggregate."""
+    return jnp.stack(
+        [frac[..., 0], frac[..., 1], fit / MAX_SCORE, bal / MAX_SCORE,
+         taint / MAX_SCORE, aff / MAX_SCORE, img / MAX_SCORE], axis=-1)
+
+
+def mlp_apply(params, feats: jnp.ndarray) -> jnp.ndarray:
+    """[..., F] -> [...]: the MLP forward pass (relu hidden layers,
+    linear scalar head)."""
+    x = feats
+    last = len(params) - 1
+    for i, (w, b) in enumerate(params):
+        x = x @ w + b
+        if i < last:
+            x = jax.nn.relu(x)
+    return x[..., 0]
+
+
+def learned_term(params, frac: jnp.ndarray, fit: jnp.ndarray,
+                 bal: jnp.ndarray, taint: jnp.ndarray, aff: jnp.ndarray,
+                 img: jnp.ndarray) -> jnp.ndarray:
+    """[N] learned score in [0, 100] — NaN params stay NaN through the
+    clip so the launch guard owns the containment."""
+    raw = mlp_apply(params, feature_rows(frac, fit, bal, taint, aff, img))
+    return jnp.clip(raw, 0.0, MAX_SCORE)
+
+
+def hand_weight_vector():
+    """The default hand-tuned score weights aligned to LEARNED_FEATURES
+    order (the frac features carry weight 0) — derived from the live
+    pipeline.default_weights, so the learn/ trainer's behavior-cloning
+    scale and the identity-init fixture can never drift from the
+    weights the scheduler actually runs. Lazy import: pipeline imports
+    this module."""
+    import numpy as np
+
+    from kubernetes_tpu.models.pipeline import default_weights
+
+    w = default_weights()
+    return np.array([0.0, 0.0, float(w.resources_fit),
+                     float(w.balanced_allocation),
+                     float(w.taint_toleration),
+                     float(w.node_affinity),
+                     float(w.image_locality)], np.float32)
+
+
+def feature_row_at(row, frac: jnp.ndarray, fit: jnp.ndarray,
+                   bal: jnp.ndarray, taint: jnp.ndarray, aff: jnp.ndarray,
+                   img: jnp.ndarray) -> jnp.ndarray:
+    """[NUM_FEATURES] feature vector of ONE node row (the commit scan
+    exports the chosen node's features for the replay dataset)."""
+    return jnp.stack(
+        [frac[row, 0], frac[row, 1], fit[row] / MAX_SCORE,
+         bal[row] / MAX_SCORE, taint[row] / MAX_SCORE,
+         aff[row] / MAX_SCORE, img[row] / MAX_SCORE])

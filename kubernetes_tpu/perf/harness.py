@@ -329,6 +329,26 @@ def run_workload(w: Workload, now: Callable[[], float] = time.time,
     finally:
         sched.close()  # binder threads released even on failure
     m = sched.metrics
+    # scheduling-quality outcomes for the A/B scorer harness (bench.py
+    # --ab-scorer): preemption count, end-state per-node bound-pod
+    # spread, and time-to-bind tail — the metrics a latency-neutral
+    # learned scorer is supposed to move
+    # seed EVERY node at 0 first: a scorer that hotspots all pods onto
+    # one node must read as maximal imbalance, not perfect spread
+    per_node: dict[str, int] = {n.metadata.name: 0
+                                for n in hub.list_nodes()}
+    for p in hub.list_pods():
+        if p.spec.node_name:
+            per_node[p.spec.node_name] = per_node.get(p.spec.node_name,
+                                                      0) + 1
+    counts = list(per_node.values())
+    if counts:
+        mean = sum(counts) / len(counts)
+        spread_std = (sum((c - mean) ** 2 for c in counts)
+                      / len(counts)) ** 0.5
+        spread_maxmin = max(counts) - min(counts)
+    else:
+        spread_std = spread_maxmin = 0.0
     result = {
         "name": w.name,
         "threshold": w.threshold,
@@ -344,6 +364,13 @@ def run_workload(w: Workload, now: Callable[[], float] = time.time,
                 m.batch_duration.percentile(99) * 1e3, 2),
             "attempts": int(sum(
                 m.schedule_attempts._values.values())),
+        },
+        "quality": {
+            "preemptions": int(sched.stats.get("preemptions", 0)),
+            "spread_stddev": round(spread_std, 3),
+            "spread_max_min": int(spread_maxmin),
+            "time_to_bind_p99_ms": round(
+                m.pod_e2e_duration.percentile(99) * 1e3, 2),
         },
     }
     if sched.jobqueue.active:

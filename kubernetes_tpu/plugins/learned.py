@@ -1,0 +1,113 @@
+"""LearnedScore: the profile-gated host manager for the fused MLP score
+term.
+
+Like every other device score plugin, the per-node math lives in an ops
+kernel (ops/learned.py) fused into the one Filter/Score launch — this
+class is only the HOST seam: it owns the checkpoint watcher (mtime
+hot-reload, polled by the scheduler at snapshot-sync time), converts a
+freshly loaded numpy stack to device arrays once per reload (params
+then ride every launch without re-upload — same-architecture swaps
+never recompile), and surfaces /debug/scorer + metrics state.
+
+Off by default: the plugin is NOT in DEFAULT_MULTI_POINT; a profile
+opts in with
+
+    plugins:  {score: {enabled: [{name: LearnedScore, weight: 1}]}}
+    plugin_config:
+      LearnedScore: {checkpoint_path: /path/to/scorer.json}
+
+With no loadable checkpoint the manager serves params=None and the
+launch compiles the learned kernel out — identical to the plugin being
+disabled. A checkpoint that loads but produces NaNs is contained by the
+launch guard + device->host fallback ladder (that batch schedules on
+hand-tuned weights); a corrupt overwrite of a good checkpoint keeps the
+last good params and counts the error.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+logger = logging.getLogger("kubernetes_tpu.learned")
+
+
+class LearnedScore:
+    """Host manager for the fused learned score term (device_score
+    descriptor; see ops/learned.py for the kernel)."""
+
+    NAME = "LearnedScore"
+
+    def __init__(self, args: Optional[dict] = None):
+        args = args or {}
+        self.checkpoint_path = args.get("checkpoint_path")
+        self._watcher = None
+        if self.checkpoint_path:
+            from kubernetes_tpu.learn.checkpoint import CheckpointWatcher
+
+            self._watcher = CheckpointWatcher(self.checkpoint_path)
+        self._device_params = None
+        self.reloads = 0          # param swaps AFTER the initial load
+
+    def name(self) -> str:
+        return self.NAME
+
+    def maybe_reload(self) -> bool:
+        """mtime-poll the checkpoint (one stat when unchanged); on a
+        fresh load push the params to device. Returns True when the
+        served params changed."""
+        w = self._watcher
+        if w is None:
+            return False
+        if not w.poll():
+            return False
+        import jax.numpy as jnp
+
+        had = self._device_params is not None
+        self._device_params = tuple(
+            (jnp.asarray(wt), jnp.asarray(b)) for wt, b in w.params)
+        if had:
+            self.reloads += 1
+        logger.info("learned scorer checkpoint %s loaded (version %s, "
+                    "fingerprint %s)", self.checkpoint_path,
+                    self.version, self.fingerprint)
+        return True
+
+    def params(self):
+        """The device params pytree, or None when no checkpoint has
+        ever loaded (the launch then compiles the kernel out)."""
+        return self._device_params
+
+    @property
+    def version(self) -> int:
+        w = self._watcher
+        if w is None or not w.meta:
+            return 0
+        try:
+            return int(w.meta.get("version", 0))
+        except (TypeError, ValueError):
+            return 0
+
+    @property
+    def fingerprint(self) -> str:
+        w = self._watcher
+        return (w.meta.get("fingerprint", "") if w is not None else "")
+
+    def stats(self) -> dict:
+        """/debug/scorer payload for one profile."""
+        w = self._watcher
+        out = {
+            "enabled": True,
+            "checkpoint_path": self.checkpoint_path,
+            "loaded": self._device_params is not None,
+            "version": self.version,
+            "fingerprint": self.fingerprint,
+            "reloads": self.reloads,
+        }
+        if w is not None:
+            out.update(loads=w.loads, load_errors=w.load_errors,
+                       last_error=w.last_error)
+            if w.meta:
+                out["meta"] = {k: v for k, v in w.meta.items()
+                               if k not in ("fingerprint",)}
+        return out

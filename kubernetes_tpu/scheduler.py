@@ -217,8 +217,15 @@ class Scheduler:
             phase_hist=self.metrics.phase_duration,
             plugin_hist=self.metrics.plugin_duration,
             capacity=getattr(self.config, "flight_recorder_capacity", 256),
-            export_path=getattr(self.config, "trace_export_path", None))
+            export_path=getattr(self.config, "trace_export_path", None),
+            export_max_bytes=getattr(self.config,
+                                     "trace_export_max_bytes", 0))
         self.timelines = PodTimelines(now=now)
+        # placement FEATURE export (the replay-training substrate) is
+        # opt-in on top of the export itself: phase-timing export users
+        # must not pay the feature kernels + extra D2H + line growth
+        self._export_feats = (self.flight.exporting and getattr(
+            self.config, "trace_export_features", False))
         self._last_pop_s = 0.0
         if self.flight.enabled:
             for fw in self.frameworks.values():
@@ -245,8 +252,18 @@ class Scheduler:
                    # with it disabled must keep scheduling claim pods
                    # unfiltered, exactly as the host path did
                    "dra_filter": "DynamicResources" in {
-                       n for n, _ in fw.points["filter"]}}
+                       n for n, _ in fw.points["filter"]},
+                   # the profile-gated learned scorer's checkpoint
+                   # manager (plugins/learned.py); None unless the
+                   # profile enables the LearnedScore plugin — the
+                   # launch then compiles the MLP term out entirely
+                   "learned": fw.instance("LearnedScore")}
             for name, fw in self.frameworks.items()}
+        # explicit tie-break seed (config) threaded into every launch as
+        # a DYNAMIC scalar: paired A/B runs share a seed so placement
+        # diffs attribute to the scorer, not the coin; 0 = historical
+        self._tie_seed = np.uint32(
+            getattr(self.config, "tie_break_seed", 0))
         self._enabled_filters = self.framework.enabled_filters()
         from kubernetes_tpu.extender import HTTPExtender
 
@@ -1197,6 +1214,32 @@ class Scheduler:
         else:
             raise RuntimeError("mirror re-bucketing did not converge")
 
+        # learned scorer (profile-gated): poll the checkpoint's mtime at
+        # snapshot-sync time — a stat when unchanged, a load + H2D push
+        # when an offline trainer published a new version. Params then
+        # ride this launch as one more weighted term; a reload mid-run
+        # never recompiles (same architecture = same jit signature).
+        learned_params = None
+        mgr = pcfg["learned"]
+        if mgr is not None:
+            t_l0 = self.now()
+            mgr.maybe_reload()
+            learned_params = mgr.params()
+            tr.add("learned_score", self.now() - t_l0)
+            # reloads = swaps AFTER the initial load (the manager's
+            # count); errors delta-mirrored like other external counts
+            self._mirror_count(f"learned_reloads:{prof}", mgr.reloads,
+                               self.metrics.learned_reloads,
+                               profile=prof)
+            w = getattr(mgr, "_watcher", None)
+            if w is not None:
+                self._mirror_count(f"learned_errs:{prof}", w.load_errors,
+                                   self.metrics.learned_load_errors,
+                                   profile=prof)
+            self.metrics.learned_checkpoint_version.set(
+                float(mgr.version if learned_params is not None else 0),
+                profile=prof)
+
         # batched DRA allocator: pack this batch's claim tensors and fuse
         # the device verdict into the launch (ops/dra.py + the dra arg of
         # schedule_batch). Pods whose claims sit outside the device-
@@ -1270,7 +1313,13 @@ class Scheduler:
             # seeded with a concrete 0 (not None) so every launch shares one
             # arg pytree and therefore one trace/compile
             pct_start=(self._pct_start if self._pct_start is not None
-                       else np.int32(0)) if pct else None)
+                       else np.int32(0)) if pct else None,
+            learned=learned_params, tie_seed=self._tie_seed,
+            # chosen-node feature rows only materialize while the
+            # feature export is opted in AND the export file is still
+            # open (a failed rotation disables the export; the feature
+            # kernels must not keep running for output nobody pulls)
+            with_feats=self._export_feats and self.flight.exporting)
         if self.fault_injector is not None:
             out = self.fault_injector.on_result(out)
         if pct:
@@ -1284,7 +1333,8 @@ class Scheduler:
             self._chain = (out.free, out.nzr)
         t_done = self.now()
         tr.add("device_dispatch", t_done - t_disp0)
-        return runnable, out, t_done, t_done - t_cycle0, tr
+        return (runnable, out, t_done, t_done - t_cycle0, tr,
+                learned_params is not None)
 
     def _host_relevant(self, pod: Pod) -> bool:
         if self._host_gates is None:
@@ -1447,13 +1497,36 @@ class Scheduler:
 
     def _finish(self, inflight: tuple) -> None:
         """Pull one dispatched launch's results and commit/fail each pod."""
-        runnable, out, t_dispatched, pack_s, tr = inflight
+        runnable, out, t_dispatched, pack_s, tr, learned_on = inflight
         # re-attach the cycle's trace: the pipelined drain may have
         # dispatched k+1 (opening its trace) before finishing k
         self.flight.resume(tr)
         n = len(runnable)
         t0 = self.now()
-        rows_arr, guard = jax.device_get((out.node_row, out.guard))
+        # ONE blocking pull per cycle: the optional learned-magnitude /
+        # export tensors ride the same host<->device sync as rows+guard
+        # (a second device_get would be a second full round trip)
+        exporting = self.flight.exporting
+        pull = [out.node_row, out.guard]
+        if learned_on:
+            pull.append(out.learned_mag)
+        if exporting:
+            pull.append(out.score)
+            if self._export_feats:
+                pull.append(out.chosen_feat)
+        vals = jax.device_get(tuple(pull))
+        rows_arr, guard = vals[0], vals[1]
+        k = 2
+        lmag = None
+        if learned_on:
+            lmag = vals[k]
+            k += 1
+        scores_arr = feats_arr = None
+        if exporting:
+            scores_arr = vals[k]
+            k += 1
+            if self._export_feats:
+                feats_arr = vals[k]
         if int(guard):
             # the launch's own guard reduction tripped: NaN scores or a
             # poisoned usage chain — nothing below can be trusted; the
@@ -1462,8 +1535,32 @@ class Scheduler:
                 f"launch guard tripped (mask {int(guard):#x}): "
                 f"{'NaN scores ' if int(guard) & 1 else ''}"
                 f"{'poisoned usage state' if int(guard) & 2 else ''}")
+        if lmag is not None:
+            # observed only AFTER the guard check: a NaN-poisoned
+            # checkpoint must not corrupt the magnitude histogram's sum
+            # forever (Histogram.observe accumulates the raw value)
+            self.metrics.learned_magnitude.observe(float(lmag))
         rows = np.asarray(rows_arr)[:n].tolist()
         launch_s = self.now() - t_dispatched
+        if exporting:
+            # export v2 placement rows: (pod, chosen node, aggregate
+            # score[, chosen-node feature vector when
+            # trace_export_features]) — the replay dataset's substrate,
+            # already pulled with rows+guard above. Failed attempts
+            # export node=None (time-to-bind anchors).
+            placements = []
+            for i, (qp, row) in enumerate(zip(runnable, rows)):
+                rec = {"pod": qp.pod.key(), "uid": qp.uid}
+                if row >= 0:
+                    rec["node"] = self.mirror.name_of_row(row)
+                    rec["score"] = round(float(scores_arr[i]), 4)
+                    if feats_arr is not None:
+                        rec["feat"] = [round(float(v), 5)
+                                       for v in feats_arr[i]]
+                else:
+                    rec["node"] = None
+                placements.append(rec)
+            tr.placements = placements
         t1 = self.now()
         # reject attribution is only read on failure; skipping the [B, P]
         # pull when every pod placed keeps the host<->device link to one

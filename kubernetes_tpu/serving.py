@@ -21,6 +21,8 @@ Flight-recorder surface:
   lifecycle timeline (enqueue/pop/bind/park stamps) and its last
   unschedulable diagnosis (which device filter rejected how many nodes,
   which host plugin rejected).
+- ``/debug/scorer`` — per-profile learned-scorer state (active
+  checkpoint version/fingerprint, reload and load-error counts).
 """
 
 from __future__ import annotations
@@ -114,6 +116,17 @@ class ServingEndpoints:
                         "host_tail_share": round(
                             flight.host_tail_share(), 4),
                     }, indent=2, default=str)
+                elif path == "/debug/scorer":
+                    # learned-scorer state per profile: checkpoint
+                    # path/version/fingerprint, reload + load-error
+                    # counts (plugins/learned.py manager stats)
+                    payload = {}
+                    for name, pcfg in getattr(sched, "_profile_cfg",
+                                              {}).items():
+                        mgr = (pcfg or {}).get("learned")
+                        payload[name] = (mgr.stats() if mgr is not None
+                                         else {"enabled": False})
+                    body = json.dumps(payload, indent=2, default=str)
                 elif path == "/debug/pod":
                     timelines = getattr(sched, "timelines", None)
                     if timelines is None:

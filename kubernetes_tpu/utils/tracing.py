@@ -31,6 +31,7 @@ from __future__ import annotations
 import collections
 import json
 import logging
+import os
 import time
 from contextlib import contextmanager
 from typing import Callable, Optional
@@ -60,11 +61,28 @@ CYCLE_PHASES = (
                           # DynamicResources PreFilter/Filter time (view;
                           # the fused in-launch eval rides device_launch)
     "dra_commit",         # DynamicResources Reserve/PreBind time (view)
+    "learned_score",      # learned-scorer checkpoint mtime poll /
+                          # reload / params fetch at snapshot-sync time
+                          # (a REAL exclusive phase, counted in totals —
+                          # a slow checkpoint path must show up in the
+                          # A/B latency gate; the fused MLP eval itself
+                          # rides device_launch)
 )
 
 # the dra_* attribution views, excluded from total/host-tail arithmetic
 # (they double-count time already inside pack/host_plugins/commit)
 DRA_VIEW_PHASES = ("dra_mask_compile", "dra_device_eval", "dra_commit")
+
+# attribution views excluded from cycle totals and the host-tail share.
+# NOTE: learned_score is NOT here — its time is exclusive (nothing else
+# measures the checkpoint poll), so hiding it would let a slow reload
+# path pass the --ab-scorer parity gate unseen
+VIEW_PHASES = DRA_VIEW_PHASES
+
+# trace-export JSON-lines format version (CycleTrace.to_dict "v"):
+# v2 added per-pod placement rows (pod, chosen node, aggregate score,
+# chosen-node learned-feature vector) — the replay-dataset substrate
+EXPORT_VERSION = 2
 
 # phases that are host-side Python work (the "host tail" the ROADMAP's
 # sub-10x offenders ask us to attribute); device_launch is device +
@@ -72,6 +90,7 @@ DRA_VIEW_PHASES = ("dra_mask_compile", "dra_device_eval", "dra_commit")
 HOST_PHASES = (
     "queue_pop", "snapshot_sync", "host_plugins", "pack", "commit",
     "failure_handling", "binder_drain", "eviction_flush", "host_fallback",
+    "learned_score",
 )
 
 
@@ -128,7 +147,7 @@ class CycleTrace:
     phase histogram when the cycle is recorded."""
 
     __slots__ = ("cycle", "start", "pods", "scheduled", "failed",
-                 "chained", "phases", "plugins")
+                 "chained", "phases", "plugins", "placements")
 
     def __init__(self, cycle: int, start: float, pods: int,
                  chained: bool = False):
@@ -140,17 +159,22 @@ class CycleTrace:
         self.chained = chained
         self.phases: dict[str, float] = {}
         self.plugins: dict[str, float] = {}   # "plugin/point" -> secs
+        # per-pod placement rows (export v2): {"pod", "uid", "node",
+        # "score", "feat"} — node None for failed attempts. Populated by
+        # the scheduler only while the export file is open.
+        self.placements: list[dict] | None = None
 
     def add(self, phase: str, secs: float) -> None:
         self.phases[phase] = self.phases.get(phase, 0.0) + secs
 
     def total(self) -> float:
-        # the dra_* phases are views over pack/host_plugins/commit time
+        # the view phases double-count time inside the real phases
         return sum(v for k, v in self.phases.items()
-                   if k not in DRA_VIEW_PHASES)
+                   if k not in VIEW_PHASES)
 
     def to_dict(self) -> dict:
         d = {
+            "v": EXPORT_VERSION,
             "cycle": self.cycle,
             "start": round(self.start, 6),
             "pods": self.pods,
@@ -164,6 +188,8 @@ class CycleTrace:
         if self.plugins:
             d["plugins_ms"] = {k: round(v * 1e3, 3)
                                for k, v in self.plugins.items()}
+        if self.placements is not None:
+            d["placements"] = self.placements
         return d
 
 
@@ -193,7 +219,7 @@ class FlightRecorder:
 
     def __init__(self, phase_hist=None, plugin_hist=None,
                  capacity: int = 256, export_path: Optional[str] = None,
-                 enabled: bool = True):
+                 enabled: bool = True, export_max_bytes: int = 0):
         self.enabled = enabled and capacity > 0
         self.phase_hist = phase_hist
         self.plugin_hist = plugin_hist
@@ -203,8 +229,23 @@ class FlightRecorder:
         self._cycle_seq = 0
         self._export_path = export_path
         self._export_file = None
+        # size-based rotation (keep-last-1): a long trace-collection run
+        # must not fill the disk. 0 = unbounded (tests/offline tooling).
+        self._export_max_bytes = max(0, export_max_bytes)
+        self._export_bytes = 0
         if export_path and self.enabled:
             self._export_file = open(export_path, "a", buffering=1)
+            try:
+                self._export_bytes = os.path.getsize(export_path)
+            except OSError:
+                self._export_bytes = 0
+
+    @property
+    def exporting(self) -> bool:
+        """True while an export file is open — the scheduler's gate for
+        the placement-row pulls (score + feature D2H) that only the
+        offline replay consumer needs."""
+        return self._export_file is not None
 
     # ------------- recording (loop thread) -------------
 
@@ -236,7 +277,38 @@ class FlightRecorder:
             for phase, secs in tr.phases.items():
                 h.observe(secs, phase=phase)
         if self._export_file is not None:
-            self._export_file.write(json.dumps(tr.to_dict()) + "\n")
+            line = json.dumps(tr.to_dict()) + "\n"
+            if self._export_max_bytes \
+                    and self._export_bytes + len(line) \
+                    > self._export_max_bytes \
+                    and self._export_bytes > 0:
+                self._rotate_export()    # may disable the export
+            if self._export_file is not None:
+                self._export_file.write(line)
+                self._export_bytes += len(line)
+
+    def _rotate_export(self) -> None:
+        """Keep-last-1 rotation: the current file becomes ``<path>.1``
+        (replacing any previous rotation) and a fresh file opens, so the
+        on-disk footprint is bounded by ~2x export_max_bytes while the
+        newest traces are always intact. A FAILED rotation (permissions
+        changed, directory vanished) disables the export outright — the
+        bound is the contract; silently resuming unbounded appends would
+        reintroduce the disk-fill this exists to prevent."""
+        try:
+            self._export_file.close()
+            os.replace(self._export_path, self._export_path + ".1")
+            self._export_file = open(self._export_path, "a", buffering=1)
+            self._export_bytes = 0
+        except OSError:
+            logger.error("trace export rotation failed for %s; "
+                         "disabling the export (the size bound is the "
+                         "contract)", self._export_path, exc_info=True)
+            try:
+                self._export_file.close()
+            except OSError:
+                pass
+            self._export_file = None
 
     def observe_phase(self, phase: str, secs: float) -> None:
         """A standalone phase observation outside a cycle (binder drain
@@ -335,7 +407,7 @@ class FlightRecorder:
         host = total = 0.0
         for k in list(h._series):
             phase = dict(k).get("phase", "?")
-            if phase in DRA_VIEW_PHASES:
+            if phase in VIEW_PHASES:
                 continue
             s = h._series.get(k)
             if not s:
