@@ -477,6 +477,30 @@ def main() -> None:
                   f"({r['delta_pct']:+.2f}% > {r['budget_pct']:.0f}%)",
                   file=sys.stderr)
         sys.exit(0 if r["ok"] else 1)
+    if "--fanout-smoke" in sys.argv:
+        # red-suite gate for the control-plane fabric (ISSUE 9): 10k
+        # kubelet-analog reflectors through a 2-level relay tree with
+        # chaos watch cuts on the upstream streams. Invariants: the hub
+        # holds <= relay-count pod sockets, every cut heals by journal
+        # RESUME (0 relists, exact event counts at every subscriber),
+        # downstream reconnects are served from relay rings, slow
+        # subscribers are evicted + recover, the binary codec carries
+        # the storm in <= 1/3 the JSON bytes, and a steady-state drift
+        # sentinel pass issues 0 full LISTs.
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _repo + os.pathsep + env.get("PYTHONPATH", "")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        cmd = [sys.executable, "-m", "kubernetes_tpu.fabric.fanout"]
+        if "--smoke" in sys.argv:
+            cmd.append("--smoke")
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=1200, env=env, cwd=_repo)
+        out = proc.stdout.strip().splitlines()
+        print(out[-1] if out else '{"ok": false, "error": "no output"}')
+        if proc.returncode != 0:
+            print(f"fanout smoke FAILED\n{proc.stderr[-2000:]}",
+                  file=sys.stderr)
+        sys.exit(proc.returncode)
     if "--chaos-smoke" in sys.argv:
         # red-suite gate: the full storm battery — the smoke scenario
         # (call faults + watch cut + partition through the proxy), the

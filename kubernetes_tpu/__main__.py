@@ -33,8 +33,13 @@ def main(argv=None) -> int:
                              "reference's authz-gated debugging handlers)")
     parser.add_argument("--wal", default=None,
                         help="WAL file for the in-process hub's event "
-                             "journal (restart replays it); ignored with "
-                             "--hub")
+                             "journal (restart replays it); with "
+                             "--hub-shards, a WAL DIRECTORY (one file "
+                             "per shard); ignored with --hub")
+    parser.add_argument("--hub-shards", type=int, default=0,
+                        help="shard the in-process hub (fabric."
+                             "sharded.ShardedHub) with N pod shards "
+                             "(0 = single hub); ignored with --hub")
     parser.add_argument("--journal-capacity", type=int, default=16384,
                         help="event-journal ring capacity per resource "
                              "kind (the watch-resume window)")
@@ -88,6 +93,15 @@ def main(argv=None) -> int:
 
         hub = RemoteHub(args.hub)
         print(f"using remote hub {args.hub}", file=sys.stderr)
+    elif args.hub_shards > 0:
+        from kubernetes_tpu.fabric.sharded import ShardedHub
+
+        hub = ShardedHub(pod_shards=args.hub_shards,
+                         journal_capacity=args.journal_capacity,
+                         wal_dir=args.wal)
+        print(f"sharded hub: {args.hub_shards} pod shards + "
+              f"nodes/events/meta (rv={hub.current_rv})",
+              file=sys.stderr)
     else:
         hub = Hub(journal_capacity=args.journal_capacity,
                   wal_path=args.wal)

@@ -23,6 +23,7 @@ from kubernetes_tpu.api.objects import Node, Pod
 from kubernetes_tpu.backend.node_info import NodeInfo, next_generation
 from kubernetes_tpu.backend.node_tree import NodeTree
 from kubernetes_tpu.backend.snapshot import Snapshot
+from kubernetes_tpu.storage import RvTooOld
 
 
 @dataclass
@@ -44,6 +45,11 @@ class DriftReport:
     pods_stale: list = field(default_factory=list)       # Pods, cache-only
     pods_missing: list = field(default_factory=list)     # Pods, hub-only
     pods_misplaced: list = field(default_factory=list)   # (cached, hub) Pods
+    # the hub revision this report is consistent at: the NEXT sentinel
+    # pass diffs journal changes after it instead of re-LISTing the
+    # cluster (None when the hub cannot answer incrementally)
+    rv: object = None
+    incremental: bool = False
 
     def count(self) -> int:
         return (len(self.nodes_stale) + len(self.nodes_missing)
@@ -396,13 +402,35 @@ class Cache:
         with self._lock:
             return len(self._assumed_pods)
 
-    def drift_report(self, hub) -> DriftReport:
+    def drift_report(self, hub, since_rv: Optional[int] = None
+                     ) -> DriftReport:
         """The cache comparer (backend/cache/debugger/comparer.go
         CompareNodes/ComparePods), structured: diff the scheduler's view
         against API truth. Assumed pods are expected to lead the API
         (they are the optimistic writes), so they are exempt from the
-        bound-state checks."""
+        bound-state checks.
+
+        ``since_rv`` switches to INCREMENTAL mode: only objects the
+        hub's journal says changed after that revision are compared —
+        O(changes) instead of two O(cluster) LISTs per sentinel pass.
+        Sound because drift is always the cache mis-applying (or
+        missing) a hub mutation: an entry that was clean at the last
+        full diff can only go bad through an event, and every event is
+        in the journal. Raises RvTooOld when the gap was compacted (or
+        the hub cannot answer) — the caller falls back to the full
+        diff, the same ladder the watch-resume wire climbs. The
+        returned report carries ``rv``, the next pass's resume point."""
+        if since_rv is not None:
+            return self._drift_report_incremental(hub, since_rv)
         report = DriftReport()
+        # the watermark is taken BEFORE the LISTs: changes landing
+        # during the diff re-examine next pass (harmless), never skip
+        stats_fn = getattr(hub, "get_journal_stats", None)
+        if stats_fn is not None:
+            try:
+                report.rv = stats_fn().get("rv")
+            except Exception:  # noqa: BLE001 — stats are optional
+                report.rv = None
         with self._lock:
             cached_nodes = set(self._nodes)
             cached_pods = {uid: st for uid, st in self._pod_states.items()}
@@ -424,6 +452,78 @@ class Cache:
             elif st.pod.spec.node_name != p.spec.node_name \
                     and uid not in assumed:
                 report.pods_misplaced.append((st.pod, p))
+        return report
+
+    def _drift_report_incremental(self, hub, since_rv: int
+                                  ) -> DriftReport:
+        """O(changes) comparer: fetch the journal suffix after
+        ``since_rv`` (``hub.list_changes``), reduce it to the LAST
+        event per object (intermediate states are moot — only the
+        final hub truth can disagree with the cache), and compare just
+        those objects. The finding categories match the full diff
+        exactly, so ``repair_from_hub`` consumes either report."""
+        changes_fn = getattr(hub, "list_changes", None)
+        if changes_fn is None:
+            # a hub without the incremental surface: the caller's
+            # RvTooOld ladder lands on the full diff
+            raise RvTooOld("drift", since_rv, 0)
+        try:
+            res = changes_fn(since_rv, ("pods", "nodes"))
+        except (ValueError, TypeError):
+            # a pre-fabric REMOTE peer: "unknown method list_changes"
+            # crosses the /call wire as its 400 ValueError. Same ladder
+            # as a compacted gap — fall back to the full diff instead
+            # of crashing the maintenance loop every interval.
+            # (Unavailable keeps propagating: that is hub-down, not
+            # version skew.)
+            raise RvTooOld("drift", since_rv, 0) from None
+        if res.get("too_old"):
+            raise RvTooOld("drift", since_rv,
+                           res.get("compacted_rv", 0))
+        report = DriftReport()
+        report.rv = res.get("rv")
+        report.incremental = True
+        # last event per object wins. Nodes reduce by NAME (the full
+        # diff — and the cache — key nodes by name): a delete+recreate
+        # under the same name must collapse to the final add, not
+        # survive as a delete for the old uid that would repair a LIVE
+        # node out of the cache. Pods reduce by uid, their cache key.
+        final: dict[tuple, dict] = {}
+        for ch in res.get("changes", ()):
+            obj = ch.get("obj")
+            if obj is None:
+                continue
+            key = obj.metadata.name if ch["kind"] == "nodes" \
+                else obj.metadata.uid
+            final[(ch["kind"], key)] = ch
+        if not final:
+            return report
+        with self._lock:
+            cached_nodes = set(self._nodes)
+            cached_pods = {uid: st for uid, st
+                           in self._pod_states.items()}
+            assumed = set(self._assumed_pods)
+        for (kind, uid), ch in sorted(final.items(),
+                                      key=lambda kv: kv[1]["rv"]):
+            obj = ch["obj"]
+            if kind == "nodes":
+                name = obj.metadata.name
+                if ch["type"] == "delete":
+                    if name in cached_nodes:
+                        report.nodes_stale.append(name)
+                elif name not in cached_nodes:
+                    report.nodes_missing.append(obj)
+                continue
+            # pods: the full diff compares against BOUND hub pods only
+            st = cached_pods.get(uid)
+            if ch["type"] == "delete" or not obj.spec.node_name:
+                if st is not None and uid not in assumed:
+                    report.pods_stale.append(st.pod)
+            elif st is None:
+                report.pods_missing.append(obj)
+            elif st.pod.spec.node_name != obj.spec.node_name \
+                    and uid not in assumed:
+                report.pods_misplaced.append((st.pod, obj))
         return report
 
     def compare_with_hub(self, hub) -> list[str]:

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import json
 import random
+import re as _re
 import socket
 import threading
 import time
@@ -367,9 +368,17 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         if clock.partitioned:
             self._abort()
             return
+        # the proxy is a JSON-era middlebox: it sniffs sync markers and
+        # re-chunks the stream line-by-line, which would corrupt binary
+        # frames. Strip the client's codec offer so upstream falls back
+        # to the JSON wire — exactly the degradation the fabric codec's
+        # negotiation exists to make safe (and a standing integration
+        # test of it: every chaos scenario crosses a JSON-only hop).
+        path = _re.sub(r"&(?:codec|fp)=[^&]*", "", self.path)
+        path = _re.sub(r"\?(?:codec|fp)=[^&]*&", "?", path)
         try:
             upstream = urllib.request.urlopen(
-                self.upstream + self.path, timeout=30.0)
+                self.upstream + path, timeout=30.0)
         except urllib.error.HTTPError as e:
             self._json(e.code, {"error": "Upstream", "message": str(e)})
             return

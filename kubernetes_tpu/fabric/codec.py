@@ -1,0 +1,371 @@
+"""Binary wire codec: msgpack-style tagged values + struct encoding.
+
+The compact alternative to :mod:`kubernetes_tpu.utils.wire` + JSON on
+the hubserver/hubclient hot path. Two ideas carry the size win:
+
+* **msgpack-style value tags** — small ints, short strings, and small
+  containers encode in one tag byte plus payload; ``None``/booleans are
+  a single byte (JSON spells ``null`` per *field name* per object).
+* **positional structs** — a dataclass encodes as a struct tag, a
+  16-bit kind id, and its field VALUES in dataclass field order. Field
+  names never go on the wire; both ends recover them from the shared
+  class registry (the same one utils.wire uses). That is safe only when
+  both ends agree on every kind's field list, which is exactly what the
+  **registry fingerprint** pins: a hash over every kind name and its
+  ordered field names. Negotiation (hubserver/hubclient) exchanges the
+  fingerprint and falls back to JSON on any mismatch, so a version-
+  skewed peer degrades to the self-describing wire instead of
+  mis-zipping fields.
+
+Framing for streams (the /watch wire): one event per frame, a 4-byte
+big-endian length prefix then the payload — binary-safe (payloads may
+contain newlines), unlike the JSON-lines wire.
+
+The codec is self-contained on purpose: no third-party msgpack, no
+compression (the win here is structural, and stays cheap to reason
+about), and the JSON wire remains fully supported — old clients, the
+WAL, and JSON-era middleboxes (the chaos proxy) keep working.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from dataclasses import fields as dc_fields
+from dataclasses import is_dataclass
+from typing import Any
+
+CODEC_BINARY = "bin1"            # wire name of this codec version
+CODEC_JSON = "json"              # the fallback (utils.wire + JSON)
+WIRE_HEADER = "X-KTPU-Codec"     # negotiation header (see hubserver)
+
+# value tags (msgpack-compatible ranges where it is convenient; the two
+# codecs never interoperate byte-for-byte, the familiarity is for
+# readers)
+_NIL = 0xC0
+_FALSE = 0xC2
+_TRUE = 0xC3
+_BIN8, _BIN16, _BIN32 = 0xC4, 0xC5, 0xC6
+_FLOAT64 = 0xCB
+_UINT8, _UINT16, _UINT32, _UINT64 = 0xCC, 0xCD, 0xCE, 0xCF
+_INT8, _INT16, _INT32, _INT64 = 0xD0, 0xD1, 0xD2, 0xD3
+_STRUCT = 0xD4                   # + uint16 kind id + fields positionally
+_SET = 0xD5                      # + array of members
+_STR8, _STR16, _STR32 = 0xD9, 0xDA, 0xDB
+_ARR16, _ARR32 = 0xDC, 0xDD
+_MAP16, _MAP32 = 0xDE, 0xDF
+_FIXMAP = 0x80                   # 0x80-0x8f: map, len in low nibble
+_FIXARR = 0x90                   # 0x90-0x9f
+_FIXSTR = 0xA0                   # 0xa0-0xbf: str, len in low 5 bits
+_NEGFIX = 0xE0                   # 0xe0-0xff: -32..-1
+
+
+_KINDS: list[tuple[str, type, tuple[str, ...]]] = []   # sorted by name
+_KIND_ID: dict[type, int] = {}
+_FINGERPRINT: str | None = None
+
+
+def _build_registry() -> None:
+    """Freeze the struct table from utils.wire's class registry: kind
+    ids are indices into the name-sorted kind list, field order is the
+    dataclass declaration order. Both ends derive the same table from
+    the same code; the fingerprint proves it before any positional
+    decode happens."""
+    global _FINGERPRINT
+    if _KINDS:
+        return
+    from kubernetes_tpu.utils.wire import _registry
+
+    for name in sorted(_registry()):
+        cls = _registry()[name]
+        fnames = tuple(f.name for f in dc_fields(cls))
+        _KIND_ID[cls] = len(_KINDS)
+        _KINDS.append((name, cls, fnames))
+    h = hashlib.sha256()
+    for name, _, fnames in _KINDS:
+        h.update(name.encode())
+        h.update(b"(" + ",".join(fnames).encode() + b");")
+    _FINGERPRINT = h.hexdigest()[:16]
+
+
+def registry_fingerprint() -> str:
+    """Hash of every wire kind's name + ordered field names. Equal
+    fingerprints make positional struct decode safe; negotiation falls
+    back to JSON on mismatch."""
+    _build_registry()
+    return _FINGERPRINT  # type: ignore[return-value]
+
+
+def offer() -> str:
+    """The client's negotiation header value: "I speak bin1 with this
+    registry shape". Servers confirm (see hubserver) only on an exact
+    fingerprint match."""
+    return f"{CODEC_BINARY};fp={registry_fingerprint()}"
+
+
+# (the server-side parse of the offer — body codec + fingerprint match
+# — lives in hubserver._parse_codec_header, the one consumer)
+
+
+# ------------------------------ encode ------------------------------
+
+
+def _enc_int(out: bytearray, v: int) -> None:
+    if 0 <= v <= 0x7F:
+        out.append(v)
+    elif -32 <= v < 0:
+        out.append(0x100 + v)
+    elif 0 <= v <= 0xFF:
+        out.append(_UINT8)
+        out.append(v)
+    elif 0 <= v <= 0xFFFF:
+        out.append(_UINT16)
+        out += v.to_bytes(2, "big")
+    elif 0 <= v <= 0xFFFFFFFF:
+        out.append(_UINT32)
+        out += v.to_bytes(4, "big")
+    elif 0 <= v <= 0xFFFFFFFFFFFFFFFF:
+        out.append(_UINT64)
+        out += v.to_bytes(8, "big")
+    elif -0x80 <= v < 0:
+        out.append(_INT8)
+        out += v.to_bytes(1, "big", signed=True)
+    elif -0x8000 <= v < 0:
+        out.append(_INT16)
+        out += v.to_bytes(2, "big", signed=True)
+    elif -0x80000000 <= v < 0:
+        out.append(_INT32)
+        out += v.to_bytes(4, "big", signed=True)
+    elif -0x8000000000000000 <= v < 0:
+        out.append(_INT64)
+        out += v.to_bytes(8, "big", signed=True)
+    else:
+        raise OverflowError(f"int {v} exceeds 64 bits")
+
+
+def _enc_len(out: bytearray, n: int, fix_tag: int, fix_max: int,
+             tags: tuple[int, ...]) -> None:
+    """Length header for str/array/map: fix form when it fits, else the
+    8/16/32-bit escape tags."""
+    if n <= fix_max:
+        out.append(fix_tag | n)
+    elif len(tags) == 3 and n <= 0xFF:
+        out.append(tags[0])
+        out.append(n)
+    elif n <= 0xFFFF:
+        out.append(tags[-2])
+        out += n.to_bytes(2, "big")
+    elif n <= 0xFFFFFFFF:
+        out.append(tags[-1])
+        out += n.to_bytes(4, "big")
+    else:
+        raise OverflowError(f"container of {n} items exceeds 32 bits")
+
+
+def _encode(out: bytearray, v: Any) -> None:
+    if v is None:
+        out.append(_NIL)
+    elif v is True:
+        out.append(_TRUE)
+    elif v is False:
+        out.append(_FALSE)
+    elif type(v) is int:
+        _enc_int(out, v)
+    elif type(v) is float:
+        out.append(_FLOAT64)
+        out += struct.pack(">d", v)
+    elif type(v) is str:
+        b = v.encode("utf-8")
+        _enc_len(out, len(b), _FIXSTR, 31, (_STR8, _STR16, _STR32))
+        out += b
+    elif is_dataclass(v) and not isinstance(v, type):
+        kid = _KIND_ID.get(type(v))
+        if kid is None:
+            raise ValueError(f"unknown wire kind {type(v).__name__!r}")
+        out.append(_STRUCT)
+        out += kid.to_bytes(2, "big")
+        for f in _KINDS[kid][2]:
+            _encode(out, getattr(v, f))
+    elif isinstance(v, dict):
+        _enc_len(out, len(v), _FIXMAP, 15, (_MAP16, _MAP32))
+        for k, x in v.items():
+            _encode(out, k)
+            _encode(out, x)
+    elif isinstance(v, (list, tuple)):
+        _enc_len(out, len(v), _FIXARR, 15, (_ARR16, _ARR32))
+        for x in v:
+            _encode(out, x)
+    elif isinstance(v, (set, frozenset)):
+        items = list(v)
+        try:
+            items.sort()               # wire stability, like utils.wire
+        except TypeError:
+            items.sort(key=repr)
+        out.append(_SET)
+        _enc_len(out, len(items), _FIXARR, 15, (_ARR16, _ARR32))
+        for x in items:
+            _encode(out, x)
+    elif isinstance(v, (bytes, bytearray)):
+        n = len(v)
+        if n <= 0xFF:
+            out.append(_BIN8)
+            out.append(n)
+        elif n <= 0xFFFF:
+            out.append(_BIN16)
+            out += n.to_bytes(2, "big")
+        else:
+            out.append(_BIN32)
+            out += n.to_bytes(4, "big")
+        out += v
+    elif isinstance(v, bool):          # numpy-ish bool subclasses
+        out.append(_TRUE if v else _FALSE)
+    elif isinstance(v, int):           # int subclasses (enums)
+        _enc_int(out, int(v))
+    elif isinstance(v, float):
+        out.append(_FLOAT64)
+        out += struct.pack(">d", float(v))
+    else:
+        raise TypeError(f"cannot encode {type(v).__name__}")
+
+
+def encode(v: Any) -> bytes:
+    """Value -> bin1 bytes. Dataclasses from the wire registry encode
+    positionally; everything JSON-able (plus sets/bytes) round-trips."""
+    _build_registry()
+    out = bytearray()
+    _encode(out, v)
+    return bytes(out)
+
+
+# ------------------------------ decode ------------------------------
+
+
+class _Reader:
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        b = self.buf[self.pos:self.pos + n]
+        if len(b) < n:
+            raise ValueError("truncated bin1 payload")
+        self.pos += n
+        return b
+
+    def u(self, n: int) -> int:
+        return int.from_bytes(self.take(n), "big")
+
+
+def _decode(r: _Reader) -> Any:
+    tag = r.u(1)
+    if tag <= 0x7F:
+        return tag
+    if tag >= _NEGFIX:
+        return tag - 0x100
+    if _FIXSTR <= tag <= 0xBF:
+        return r.take(tag & 0x1F).decode("utf-8")
+    if _FIXMAP <= tag <= 0x8F:
+        return {_decode(r): _decode(r) for _ in range(tag & 0x0F)}
+    if _FIXARR <= tag <= 0x9F:
+        return [_decode(r) for _ in range(tag & 0x0F)]
+    if tag == _NIL:
+        return None
+    if tag == _TRUE:
+        return True
+    if tag == _FALSE:
+        return False
+    if tag == _FLOAT64:
+        return struct.unpack(">d", r.take(8))[0]
+    if tag == _UINT8:
+        return r.u(1)
+    if tag == _UINT16:
+        return r.u(2)
+    if tag == _UINT32:
+        return r.u(4)
+    if tag == _UINT64:
+        return r.u(8)
+    if tag == _INT8:
+        return int.from_bytes(r.take(1), "big", signed=True)
+    if tag == _INT16:
+        return int.from_bytes(r.take(2), "big", signed=True)
+    if tag == _INT32:
+        return int.from_bytes(r.take(4), "big", signed=True)
+    if tag == _INT64:
+        return int.from_bytes(r.take(8), "big", signed=True)
+    if tag == _STR8:
+        return r.take(r.u(1)).decode("utf-8")
+    if tag == _STR16:
+        return r.take(r.u(2)).decode("utf-8")
+    if tag == _STR32:
+        return r.take(r.u(4)).decode("utf-8")
+    if tag == _ARR16:
+        return [_decode(r) for _ in range(r.u(2))]
+    if tag == _ARR32:
+        return [_decode(r) for _ in range(r.u(4))]
+    if tag == _MAP16:
+        return {_decode(r): _decode(r) for _ in range(r.u(2))}
+    if tag == _MAP32:
+        return {_decode(r): _decode(r) for _ in range(r.u(4))}
+    if tag == _BIN8:
+        return r.take(r.u(1))
+    if tag == _BIN16:
+        return r.take(r.u(2))
+    if tag == _BIN32:
+        return r.take(r.u(4))
+    if tag == _SET:
+        arr = _decode(r)
+        return set(arr)
+    if tag == _STRUCT:
+        kid = r.u(2)
+        if kid >= len(_KINDS):
+            raise ValueError(f"unknown bin1 kind id {kid}")
+        _, cls, fnames = _KINDS[kid]
+        return cls(**{f: _decode(r) for f in fnames})
+    raise ValueError(f"bad bin1 tag 0x{tag:02x}")
+
+
+def decode(data: bytes) -> Any:
+    """bin1 bytes -> value. The inverse of :func:`encode`; only safe
+    against payloads from a fingerprint-matched peer (negotiation
+    guarantees that before this is ever called on the wire)."""
+    _build_registry()
+    r = _Reader(data)
+    v = _decode(r)
+    if r.pos != len(data):
+        raise ValueError(f"{len(data) - r.pos} trailing bytes "
+                         "after bin1 value")
+    return v
+
+
+# ------------------------------ framing ------------------------------
+
+
+def frame(payload: bytes) -> bytes:
+    """Length-prefix one stream frame (4-byte big-endian length)."""
+    return len(payload).to_bytes(4, "big") + payload
+
+
+def read_frame(fp) -> bytes | None:
+    """Read one frame off a stream supporting ``read(n)``; None on a
+    clean or torn EOF (a cut stream ends mid-frame — callers treat both
+    as the connection dying, exactly like a cut JSON line)."""
+    hdr = _read_exact(fp, 4)
+    if hdr is None:
+        return None
+    return _read_exact(fp, int.from_bytes(hdr, "big"))
+
+
+def _read_exact(fp, n: int) -> bytes | None:
+    if n == 0:
+        return b""
+    chunks = []
+    got = 0
+    while got < n:
+        b = fp.read(n - got)
+        if not b:
+            return None
+        chunks.append(b)
+        got += len(b)
+    return b"".join(chunks)

@@ -1,0 +1,497 @@
+"""Watch relay tree: fan one upstream stream out to thousands of clients.
+
+The hub (or a parent relay) should hold one socket per RELAY, not one
+per kubelet-analog reflector — at 10k clients the difference is the
+control plane staying up. A relay node:
+
+* subscribes UPSTREAM once for its whole kind set (one multiplexed
+  ``RemoteHub.watch_kinds`` connection riding the client's full
+  resume/reconnect machinery — a cut between relay and hub costs one
+  journal resume, invisible to every downstream subscriber);
+* mirrors upstream state per kind (uid -> newest object) so it can
+  serve downstream LIST replays itself, and keeps a bounded ring
+  journal of recent events so downstream reconnects resume from their
+  cursor (``since_rv``) without touching the hub;
+* fans each event out to its subscribers through bounded queues with
+  **slow-subscriber eviction**: a consumer that stops draining gets its
+  stream cut (counted in ``slow_evictions``) instead of wedging the
+  relay's memory — it reconnects and resumes, or relists through the
+  relay's state mirror if its cursor fell off the ring. Backpressure
+  never propagates upstream.
+
+Continuity: if the relay's OWN upstream connection falls back to a full
+relist (410: the hub compacted its gap), the reflector's relist diff
+already re-emits exactly the missed adds/updates/deletes as ordinary
+events, so subscribers stay continuous; the relay just resets its ring
+at the new sync revision (``EventHandlers.on_sync``) because the events
+replayed DURING a relist arrive in LIST order, not rv order, and must
+not serve resumes.
+
+:class:`RelayServer` is the HTTP face: hubserver's exact /watch wire
+(kind/kinds/since_rv/replay + binary-codec negotiation) so any
+``RemoteHub`` can point at a relay instead of the hub, ``POST /call``
+proxied upstream (the relay is a read fan-out, writes pass through),
+and token-gated ``/debug/fabric`` (topology, ring stats, per-subscriber
+cursors). Relays chain: a level-2 relay's upstream URL is a level-1
+relay's address.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from kubernetes_tpu.fabric import codec as binwire
+from kubernetes_tpu.hub import EventHandlers
+from kubernetes_tpu.hubserver import (
+    FRAMES_CONTENT_TYPE,
+    make_stream_writers,
+    parse_watch_query,
+)
+from kubernetes_tpu.storage import Journal, JournalEvent, RvTooOld
+
+
+class Subscriber:
+    """One downstream consumer: a bounded event queue + resume cursor.
+    The producer (the relay's upstream reflector thread) appends and
+    signals; the consumer (an HTTP handler thread, or the fanout
+    smoke's in-process reflector) drains. ``evicted`` flips when the
+    queue hit its bound — the consumer must tear down and reconnect."""
+
+    __slots__ = ("kinds", "queue", "event", "cursor", "evicted",
+                 "limit", "ident")
+
+    def __init__(self, kinds: tuple[str, ...], limit: int,
+                 cursor: int, ident: int):
+        self.kinds = kinds
+        self.queue: deque = deque()
+        self.event = threading.Event()
+        self.cursor = cursor           # newest rv enqueued for us
+        self.evicted = False
+        self.limit = limit
+        self.ident = ident
+
+    def drain(self) -> list[dict]:
+        """Consumer side: take everything queued (thread-safe against
+        the producer's appends — deque ops are atomic)."""
+        out = []
+        while True:
+            try:
+                out.append(self.queue.popleft())
+            except IndexError:
+                return out
+
+
+class RelayCore:
+    """Transport-agnostic relay engine. ``RelayServer`` wraps it for
+    HTTP subscribers; the fanout smoke attaches in-process subscribers
+    directly (10k bounded queues need no 10k sockets)."""
+
+    def __init__(self, upstream_url: str, kinds: tuple[str, ...] = ("pods",),
+                 ring_capacity: int = 8192, queue_limit: int = 4096,
+                 client_factory: Optional[Callable] = None,
+                 timeout: float = 30.0):
+        from kubernetes_tpu.hubclient import RemoteHub
+
+        self.upstream_url = upstream_url
+        self.kinds = tuple(kinds)
+        self.queue_limit = queue_limit
+        self._ring_capacity = ring_capacity
+        self._lock = threading.Lock()
+        self._journal = Journal(capacity=ring_capacity)
+        self._state: dict[str, dict[str, tuple[int, object]]] = \
+            {k: {} for k in self.kinds}
+        self._subs: dict[str, list[Subscriber]] = \
+            {k: [] for k in self.kinds}
+        self._next_ident = 0
+        self.last_rv = 0
+        # ring integrity: appends must be rv-ascending for changes_after
+        # to mean "everything after your cursor". An upstream RELIST
+        # replays in LIST order — the moment an out-of-order rv arrives
+        # the ring is SUSPECT: resumes answer RvTooOld (downstream
+        # relists from the state mirror, which is safe) until the sync
+        # marker resets the ring. Events still fan out live either way.
+        self._ring_rv = 0
+        self._ring_suspect = False
+        self._synced = threading.Event()
+        # counters (relay_* metrics / the fanout smoke's gates)
+        self.slow_evictions = 0
+        self.resume_serves = 0         # downstream (re)connects off the ring
+        self.relist_serves = 0         # downstream LIST replays served
+        self.events_in = 0
+        self.events_out = 0
+        factory = client_factory or (
+            lambda url: RemoteHub(url, timeout=timeout))
+        self.client = factory(upstream_url)
+        # ONE upstream connection for the whole kind set — the property
+        # the tree exists for: the hub's socket count scales with
+        # relays, not with subscribers
+        self.client.watch_kinds(
+            {k: EventHandlers(
+                on_event=self._make_on_event(k),
+                on_sync=self._on_sync) for k in self.kinds},
+            replay=True)
+
+    # ------------- upstream side (reflector callbacks) -------------
+
+    def _make_on_event(self, kind: str):
+        def on_event(ev: JournalEvent) -> None:
+            d = {"type": ev.type, "rv": ev.rv, "kind": kind,
+                 "old": ev.old, "new": ev.new}
+            with self._lock:
+                state = self._state[kind]
+                if ev.type == "delete":
+                    state.pop(ev.old.metadata.uid, None)
+                else:
+                    state[ev.new.metadata.uid] = (ev.rv, ev.new)
+                if ev.rv > self._ring_rv:
+                    self._journal.append(JournalEvent(
+                        rv=ev.rv, kind=kind, type=ev.type,
+                        old=ev.old, new=ev.new))
+                    self._ring_rv = ev.rv
+                else:
+                    # LIST-ordered arrival (upstream relist replay):
+                    # the ring can no longer serve gapless resumes
+                    self._ring_suspect = True
+                if ev.rv > self.last_rv:
+                    self.last_rv = ev.rv
+                self.events_in += 1
+                self._fan_out(kind, d)
+        return on_event
+
+    def _on_sync(self, rv: int, relisted: bool) -> None:
+        """Upstream sync marker. After a RELIST (first connect, or a
+        410 fallback) the events just replayed arrived in LIST order —
+        the ring cannot serve rv-ordered resumes from them, so it
+        resets with its floor at the sync revision: a downstream cursor
+        below the floor answers 410 and relists from the state mirror,
+        which IS consistent. Journal resumes (the common reconnect)
+        keep the ring."""
+        with self._lock:
+            if relisted or self._ring_suspect:
+                self._journal = Journal(capacity=self._ring_capacity)
+                self._journal.compact_floor = rv
+                self._ring_suspect = False
+                self._ring_rv = max(self._ring_rv, rv)
+            if rv > self.last_rv:
+                self.last_rv = rv
+        self._synced.set()
+
+    def _fan_out(self, kind: str, d: dict) -> None:
+        # caller holds the lock; eviction rebuilds the list after the
+        # sweep so iteration stays cheap (no copy per event)
+        subs = self._subs[kind]
+        evicted_any = False
+        for sub in subs:
+            if sub.evicted:
+                evicted_any = True
+                continue
+            if len(sub.queue) >= sub.limit:
+                # backpressure verdict: this consumer stopped draining.
+                # Cut it (it will reconnect-and-resume, or relist) —
+                # never buffer unboundedly, never stall the siblings,
+                # never push back upstream.
+                sub.evicted = True
+                sub.event.set()
+                self.slow_evictions += 1
+                evicted_any = True
+                continue
+            sub.queue.append(d)
+            if d["rv"] > sub.cursor:
+                sub.cursor = d["rv"]
+            self.events_out += 1
+            sub.event.set()
+        if evicted_any:
+            self._subs[kind] = [s for s in subs if not s.evicted]
+
+    # ------------- downstream side -------------
+
+    def subscribe(self, kinds: tuple[str, ...] | None = None,
+                  since_rv: int | None = None, replay: bool = True,
+                  queue_limit: int | None = None) -> Subscriber:
+        """Register a downstream reflector. ``since_rv`` resumes off
+        the relay's ring (RvTooOld when the cursor fell off it — the
+        caller relists, exactly the hub's contract); otherwise
+        ``replay`` serves a LIST from the state mirror. Backlog and
+        registration are atomic under the relay lock, so the
+        subscriber's stream is gapless from its sync point."""
+        kinds = tuple(kinds or self.kinds)
+        for k in kinds:
+            if k not in self._state:
+                raise ValueError(f"relay does not carry kind {k!r}")
+        if not self._synced.wait(timeout=30.0):
+            raise RuntimeError("relay upstream never synced")
+        with self._lock:
+            sub = Subscriber(kinds, queue_limit or self.queue_limit,
+                             self.last_rv, self._next_ident)
+            self._next_ident += 1
+            if since_rv is not None:
+                if self._ring_suspect:
+                    # mid-relist window: the ring holds LIST-ordered
+                    # events and cannot promise a gapless suffix —
+                    # send this consumer to the state mirror instead
+                    raise RvTooOld(kinds[0], since_rv, self.last_rv)
+                evs = self._journal.changes_after(kinds, since_rv)
+                for ev in evs:
+                    sub.queue.append({"type": ev.type, "rv": ev.rv,
+                                      "kind": ev.kind, "old": ev.old,
+                                      "new": ev.new})
+                self.resume_serves += 1
+            elif replay:
+                for kind in kinds:
+                    for rv, obj in self._state[kind].values():
+                        sub.queue.append({"type": "add", "rv": rv,
+                                          "kind": kind, "old": None,
+                                          "new": obj})
+                self.relist_serves += 1
+            for kind in kinds:
+                self._subs[kind].append(sub)
+            if sub.queue:
+                sub.event.set()
+            return sub
+
+    def unsubscribe(self, sub: Subscriber) -> None:
+        with self._lock:
+            for kind in sub.kinds:
+                try:
+                    self._subs[kind].remove(sub)
+                except ValueError:
+                    pass
+
+    def subscriber_count(self) -> int:
+        with self._lock:
+            return len({id(s) for subs in self._subs.values()
+                        for s in subs})
+
+    def stats(self) -> dict:
+        up = {}
+        rs = getattr(self.client, "resilience_stats", None)
+        if rs is not None:
+            up = rs()
+        with self._lock:
+            return {"upstream": self.upstream_url,
+                    "kinds": list(self.kinds),
+                    "last_rv": self.last_rv,
+                    "subscribers": len({id(s) for subs in
+                                        self._subs.values()
+                                        for s in subs}),
+                    "slow_evictions": self.slow_evictions,
+                    "resume_serves": self.resume_serves,
+                    "relist_serves": self.relist_serves,
+                    "events_in": self.events_in,
+                    "events_out": self.events_out,
+                    "upstream_client": up}
+
+    def debug_state(self, max_subscribers: int = 200) -> dict:
+        """/debug/fabric payload: topology + per-subscriber cursors."""
+        with self._lock:
+            subs = sorted({id(s): s for subs in self._subs.values()
+                           for s in subs}.values(),
+                          key=lambda s: s.ident)
+            listed = [{"id": s.ident, "kinds": list(s.kinds),
+                       "cursor": s.cursor, "queued": len(s.queue),
+                       "evicted": s.evicted}
+                      for s in subs[:max_subscribers]]
+            ring = {k: {"depth": v["depth"],
+                        "compacted_rv": v["compacted_rv"]}
+                    for k, v in self._journal.stats().items()}
+        st = self.stats()
+        st.update({"ring": ring, "subscriber_cursors": listed,
+                   "subscribers_total": st["subscribers"]})
+        return st
+
+    def close(self) -> None:
+        self.client.close()
+        with self._lock:
+            for subs in self._subs.values():
+                for s in subs:
+                    s.evicted = True
+                    s.event.set()
+            self._subs = {k: [] for k in self.kinds}
+
+
+# --------------------------------------------------------------------------
+# HTTP face: hubserver's /watch wire + /call passthrough + /debug/fabric
+# --------------------------------------------------------------------------
+
+
+class _RelayHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "kubernetes-tpu-relay/1"
+
+    def log_message(self, *args) -> None:  # quiet
+        pass
+
+    @property
+    def core(self) -> RelayCore:
+        return self.server.core           # type: ignore[attr-defined]
+
+    def _json(self, status: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        """Write passthrough: the relay fans reads out; writes go to
+        the hub. Codec headers forward verbatim — the relay is
+        negotiation-transparent (both ends share its fingerprint or
+        settle to JSON on their own)."""
+        if self.path != "/call":
+            self._json(404, {"error": "NotFound", "message": self.path})
+            return
+        length = int(self.headers.get("Content-Length", "0"))
+        body = self.rfile.read(length)
+        headers = {"Content-Type": self.headers.get(
+            "Content-Type", "application/json")}
+        offered = self.headers.get(binwire.WIRE_HEADER)
+        if offered:
+            headers[binwire.WIRE_HEADER] = offered
+        req = urllib.request.Request(
+            self.core.upstream_url + self.path, data=body,
+            headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=30.0) as resp:
+                payload = resp.read()
+                status = resp.status
+                codec_hdr = resp.headers.get(binwire.WIRE_HEADER)
+                ctype = resp.headers.get("Content-Type",
+                                         "application/json")
+        except urllib.error.HTTPError as e:
+            payload = e.read()
+            status = e.code
+            codec_hdr = None
+            ctype = "application/json"
+        except OSError:
+            self._json(503, {"error": "Upstream",
+                             "message": "relay upstream unreachable"})
+            return
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        if codec_hdr:
+            self.send_header(binwire.WIRE_HEADER, codec_hdr)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self) -> None:  # noqa: N802
+        from urllib.parse import parse_qs, urlparse
+
+        path = urlparse(self.path)
+        q = parse_qs(path.query)
+        if path.path == "/debug/fabric":
+            auth = self.server.debug_auth     # type: ignore[attr-defined]
+            if auth is None:
+                self._send_text(403, "debug endpoints disabled "
+                                     "(no debug_auth configured)")
+                return
+            if not auth(self.headers.get("Authorization", "")):
+                self._send_text(401, "unauthorized")
+                return
+            self._json(200, self.core.debug_state())
+            return
+        if path.path != "/watch":
+            self._json(404, {"error": "NotFound", "message": self.path})
+            return
+        params, err = parse_watch_query(q)
+        if params is None:
+            self._json(400, {"error": "ValueError", "message": err})
+            return
+        mux, use_bin = params.mux, params.use_bin
+        try:
+            sub = self.core.subscribe(tuple(params.kinds),
+                                      since_rv=params.since_rv,
+                                      replay=params.replay)
+        except RvTooOld as e:
+            # cursor fell off the relay ring: the 410 that sends the
+            # client back for a relist — which the relay itself serves
+            self._json(410, {"error": "RvTooOld", "message": str(e),
+                             "compacted_rv": e.compacted_rv})
+            return
+        except ValueError as e:
+            self._json(400, {"error": "ValueError", "message": str(e)})
+            return
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         FRAMES_CONTENT_TYPE if use_bin
+                         else "application/jsonlines")
+        if use_bin:
+            self.send_header(binwire.WIRE_HEADER, binwire.offer())
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        write_obj, write_event = make_stream_writers(self.wfile,
+                                                     use_bin, mux)
+
+        def write_all(ds: list[dict]) -> None:
+            for d in ds:
+                write_event(d["kind"], d["type"], d["rv"],
+                            d["old"], d["new"])
+
+        try:
+            write_all(sub.drain())        # the subscribe-time backlog
+            write_obj({"synced": True, "rv": sub.cursor})
+            while not self.server.stopping:  # type: ignore[attr-defined]
+                if sub.evicted:
+                    # slow-subscriber eviction: cut the stream; the
+                    # client reconnects with resume (or relists)
+                    return
+                if not sub.event.wait(timeout=1.0):
+                    write_obj({})         # keepalive
+                    continue
+                sub.event.clear()
+                write_all(sub.drain())
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            self.core.unsubscribe(sub)
+
+    def _send_text(self, code: int, text: str) -> None:
+        data = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+class RelayServer:
+    """relay = RelayServer(RelayCore(hub_url)).start(); point RemoteHub
+    clients (or child relays) at ``relay.address``."""
+
+    def __init__(self, core: RelayCore, host: str = "127.0.0.1",
+                 port: int = 0,
+                 debug_auth: Optional[Callable[[str], bool]] = None):
+        self.core = core
+        self._httpd = ThreadingHTTPServer((host, port), _RelayHandler)
+        self._httpd.daemon_threads = True
+        self._httpd.core = core               # type: ignore[attr-defined]
+        self._httpd.debug_auth = debug_auth   # type: ignore[attr-defined]
+        self._httpd.stopping = False          # type: ignore[attr-defined]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "RelayServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="watch-relay")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.stopping = True           # type: ignore[attr-defined]
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.core.close()

@@ -1,0 +1,372 @@
+"""ShardedHub: the hub sharded by kind + namespace-hash, one API.
+
+The apiserver/etcd analog outgrew one lock and one WAL: every mutation
+of every kind serialized through a single ``Hub``. The fabric shards it
+the way the real control plane does (etcd per resource group,
+apiserver request fan-out):
+
+* **by kind** — nodes, events, and "meta" (every other non-pod kind)
+  each get their own shard: a full :class:`~kubernetes_tpu.hub.Hub`
+  with its own lock, journal rings, and WAL file, so node heartbeats
+  never contend with event recording or claim churn;
+* **by namespace-hash within the pod kind** — pods (the hot kind) hash
+  across ``pod_shards`` shards by ``crc32(namespace)``, a deterministic
+  mapping (NOT Python's randomized ``hash``) so a restarted hub replays
+  each shard's WAL into the same layout.
+
+One **revision space** spans all shards: a shared allocator stamps
+every commit, so resume points travel freely — a client that saw rv N
+on a pod event can resume ANY kind's watch at N, exactly as against the
+single hub. Each shard's journal retains its kinds' complete suffix
+above its own watermark (per-kind rv gaps were already the journal's
+contract). Cross-shard pod watches register on every pod shard; replay
+is rv-consistent per shard, per-object ordering holds globally because
+a pod lives on exactly one shard.
+
+Fencing is hub-wide: all shards share one ``LeaseStore``, so a deposed
+leader's epoch is stale on every shard at once.
+
+The router preserves the single-hub surface — ``HubServer(ShardedHub())``
+and every ``RemoteHub`` client work unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import zlib
+from typing import Optional
+
+from kubernetes_tpu.hub import Hub, NotFound
+from kubernetes_tpu.hubserver import WATCH_KINDS
+from kubernetes_tpu.leaderelection import LeaseStore
+from kubernetes_tpu.storage import RvTooOld
+
+
+class _RvAllocator:
+    """The shared revision counter: one monotonic space across shards.
+    Its own lock (never taken while holding another allocator's — it IS
+    the innermost lock: shards call ``next()`` under their shard lock,
+    and the allocator takes nothing further)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.last = 0
+
+    def next(self) -> int:
+        with self._lock:
+            self.last += 1
+            return self.last
+
+    def advance_to(self, rv: int) -> None:
+        with self._lock:
+            if rv > self.last:
+                self.last = rv
+
+
+class _ShardHub(Hub):
+    """One shard: a full Hub drawing revisions from the shared
+    allocator. It carries every store (empty ones cost nothing) so the
+    router can forward ANY hub method to the owning shard without
+    per-method glue; only its assigned kinds ever populate."""
+
+    def __init__(self, name: str, alloc: _RvAllocator,
+                 journal_capacity: int, wal_path: str | None):
+        self.shard_name = name
+        self._alloc = alloc
+        self.commits = 0
+        super().__init__(journal_capacity=journal_capacity,
+                         wal_path=wal_path)
+
+    def _next_rv(self) -> int:
+        rv = self._alloc.next()
+        self._last_rv = rv
+        return rv
+
+    def _newest_rv(self) -> int:
+        # resume checks and sync markers speak the GLOBAL space: a
+        # client's since_rv may have been minted by another shard
+        return self._alloc.last
+
+    def _commit(self, store, etype, old, new):
+        self.commits += 1
+        return super()._commit(store, etype, old, new)
+
+
+# watch kind -> the by-kind shard that owns it ("pods" is special-cased
+# onto the hashed shard set)
+_NODE_KINDS = ("nodes",)
+_EVENT_KINDS = ("events",)
+
+# single-kind hub methods, routed whole to the owning shard
+_NODE_METHODS = frozenset({"create_node", "update_node", "delete_node",
+                           "get_node", "list_nodes", "watch_nodes"})
+_EVENT_METHODS = frozenset({"record_event", "list_events",
+                            "watch_events"})
+# pod methods that carry the Pod object (route by namespace hash)
+_POD_OBJ_METHODS = frozenset({"create_pod", "update_pod", "bind",
+                              "patch_pod_condition"})
+# pod methods that carry only a uid (route by probe — the uid index is
+# per shard, and P dict probes beat a router-level mirror of every pod)
+_POD_UID_METHODS = frozenset({"delete_pod", "get_pod",
+                              "set_pod_claim_statuses",
+                              "clear_nominated_node"})
+
+
+class ShardedHub:
+    """``Hub``-shaped router over kind shards + hashed pod shards.
+
+    ``wal_dir`` (instead of the single hub's ``wal_path``) gives every
+    shard its own WAL file under one directory; a restart replays each
+    independently and the allocator resumes past the newest revision
+    any shard saw."""
+
+    def __init__(self, pod_shards: int = 4,
+                 journal_capacity: int = 16384,
+                 wal_dir: str | None = None) -> None:
+        if pod_shards < 1:
+            raise ValueError("pod_shards must be >= 1")
+        if wal_dir:
+            if os.path.isfile(wal_dir):
+                # the single hub's --wal names a FILE; sharding needs a
+                # directory (one WAL per shard), and a single-hub WAL
+                # cannot replay into shards anyway — say so instead of
+                # dying on makedirs' FileExistsError
+                raise ValueError(
+                    f"wal_dir {wal_dir!r} is an existing file: a "
+                    "sharded hub needs a WAL directory (one file per "
+                    "shard), and a single-hub WAL does not replay "
+                    "into shards")
+            os.makedirs(wal_dir, exist_ok=True)
+        self._alloc = _RvAllocator()
+
+        def mk(name: str) -> _ShardHub:
+            wal = os.path.join(wal_dir, f"{name}.wal") if wal_dir \
+                else None
+            return _ShardHub(name, self._alloc, journal_capacity, wal)
+
+        self._nodes_shard = mk("nodes")
+        self._events_shard = mk("events")
+        self._meta_shard = mk("meta")
+        self._pod_shards = [mk(f"pods-{i}") for i in range(pod_shards)]
+        self._shards: list[_ShardHub] = [
+            self._nodes_shard, self._events_shard, self._meta_shard,
+            *self._pod_shards]
+        # WAL replay ran inside each shard's __init__ with original
+        # revisions; the shared space resumes past the newest any saw
+        self._alloc.advance_to(max(s._last_rv for s in self._shards))
+        # ONE lease store: fencing epochs are a property of the control
+        # plane, not of a shard — a deposed epoch is stale everywhere
+        self.leases = LeaseStore()
+        for s in self._shards:
+            s.leases = self.leases
+
+    # ------------- revision space -------------
+
+    @property
+    def current_rv(self) -> int:
+        return self._alloc.last
+
+    def _newest_rv(self) -> int:
+        return self._alloc.last
+
+    # ------------- routing -------------
+
+    def _pod_shard(self, namespace: str) -> _ShardHub:
+        h = zlib.crc32(namespace.encode("utf-8"))
+        return self._pod_shards[h % len(self._pod_shards)]
+
+    def _pod_shard_of_uid(self, uid: str) -> Optional[_ShardHub]:
+        for s in self._pod_shards:
+            if s.get_pod(uid) is not None:
+                return s
+        return None
+
+    def __getattr__(self, name: str):
+        # single-shard methods forward whole; the meta shard owns every
+        # kind the tables above don't claim. Defined-on-class methods
+        # (pods, watches, stats) never reach here.
+        if name in _NODE_METHODS:
+            return getattr(self._nodes_shard, name)
+        if name in _EVENT_METHODS:
+            return getattr(self._events_shard, name)
+        if not name.startswith("_") and hasattr(Hub, name):
+            return getattr(self._meta_shard, name)
+        raise AttributeError(name)
+
+    # ------------- pods (hashed across shards) -------------
+
+    def create_pod(self, pod) -> None:
+        self._pod_shard(pod.metadata.namespace).create_pod(pod)
+
+    def update_pod(self, pod) -> None:
+        self._pod_shard(pod.metadata.namespace).update_pod(pod)
+
+    def bind(self, pod, node_name: str, epoch: int | None = None,
+             lease_name: str = "kube-scheduler") -> None:
+        self._pod_shard(pod.metadata.namespace).bind(
+            pod, node_name, epoch, lease_name)
+
+    def patch_pod_condition(self, pod, condition,
+                            nominated_node: str | None = None,
+                            epoch: int | None = None,
+                            lease_name: str = "kube-scheduler") -> None:
+        self._pod_shard(pod.metadata.namespace).patch_pod_condition(
+            pod, condition, nominated_node, epoch, lease_name)
+
+    def delete_pod(self, uid: str, epoch: int | None = None,
+                   lease_name: str = "kube-scheduler") -> None:
+        s = self._pod_shard_of_uid(uid)
+        if s is None:
+            raise NotFound(f"Pod {uid}")
+        # a concurrent delete between probe and call re-raises NotFound
+        # from the shard — same verdict the single hub gives
+        s.delete_pod(uid, epoch, lease_name)
+
+    def get_pod(self, uid: str):
+        for s in self._pod_shards:
+            p = s.get_pod(uid)
+            if p is not None:
+                return p
+        return None
+
+    def set_pod_claim_statuses(self, uid: str,
+                               statuses: dict[str, str]) -> None:
+        s = self._pod_shard_of_uid(uid)
+        if s is not None:
+            s.set_pod_claim_statuses(uid, statuses)
+
+    def clear_nominated_node(self, uid: str, epoch: int | None = None,
+                             lease_name: str = "kube-scheduler") -> None:
+        s = self._pod_shard_of_uid(uid)
+        if s is not None:
+            s.clear_nominated_node(uid, epoch, lease_name)
+
+    def list_pods(self) -> list:
+        out: list = []
+        for s in self._pod_shards:
+            out.extend(s.list_pods())
+        return out
+
+    def watch_pods(self, h, replay: bool = True,
+                   since_rv: int | None = None) -> int:
+        """Cross-shard pod watch: register on EVERY pod shard.
+        Registration+replay is atomic per shard (each under its shard
+        lock), so per-object ordering is exact — a pod lives on one
+        shard. Cross-object interleave across shards during replay is
+        registration-ordered, which is all the informer contract
+        promises for a LIST anyway. A compacted gap on ANY shard
+        unregisters the rest and raises: a watch must never resume
+        half-sharded."""
+        registered: list[_ShardHub] = []
+        cur = 0
+        try:
+            for s in self._pod_shards:
+                cur = max(cur, s.watch_pods(h, replay=replay,
+                                            since_rv=since_rv))
+                registered.append(s)
+        except RvTooOld:
+            for s in registered:
+                s.unwatch(h)
+            raise
+        return cur
+
+    def unwatch(self, h) -> None:
+        for s in self._shards:
+            s.unwatch(h)
+
+    # ------------- incremental LIST (drift sentinel) -------------
+
+    def list_changes(self, since_rv: int,
+                     kinds: tuple = ("pods", "nodes")) -> dict:
+        """Merged across the owning shards; any shard's too-old verdict
+        is the whole answer's (a partial incremental diff would hide
+        the unresumable shard's history).
+
+        The consistency revision is read BEFORE the first shard scan:
+        shards are read sequentially without a global lock, so a commit
+        landing on an already-scanned shard mid-merge is absent from
+        ``changes`` — advertising a later rv would make the caller's
+        next resume skip it forever. Advertising the earlier rv instead
+        means any such straggler (and any included event above it) is
+        merely re-examined next pass, which is harmless."""
+        rv0 = self._alloc.last
+        merged: list[dict] = []
+        for s in self._shards_for_kinds(kinds):
+            res = s.list_changes(since_rv, kinds)
+            if res.get("too_old"):
+                return {"too_old": True,
+                        "compacted_rv": res["compacted_rv"],
+                        "rv": rv0}
+            merged.extend(res["changes"])
+        merged.sort(key=lambda c: c["rv"])
+        return {"too_old": False, "rv": rv0, "changes": merged}
+
+    def _shards_for_kinds(self, kinds) -> list[_ShardHub]:
+        out: list[_ShardHub] = []
+        for s in self._shards:
+            if s in self._pod_shards:
+                if "pods" in kinds:
+                    out.append(s)
+            elif s is self._nodes_shard:
+                if any(k in _NODE_KINDS for k in kinds):
+                    out.append(s)
+            elif s is self._events_shard:
+                if any(k in _EVENT_KINDS for k in kinds):
+                    out.append(s)
+            elif any(k not in _NODE_KINDS and k not in _EVENT_KINDS
+                     and k != "pods" for k in kinds):
+                out.append(s)
+        return out
+
+    # ------------- stats / lifecycle -------------
+
+    def get_journal_stats(self) -> dict:
+        """The single hub's shape (rv/capacity/wal/kinds) with per-kind
+        stats merged across shards, plus a ``shards`` map for the
+        hub_shard_* gauges and /debug/fabric."""
+        kinds: dict = {}
+        shards: dict = {}
+        wal = False
+        cap = 0
+        for s in self._shards:
+            st = s.get_journal_stats()
+            wal = wal or st["wal"]
+            cap = max(cap, st["capacity"])
+            for kind, ks in st["kinds"].items():
+                # a hashed kind ("pods") appears on several shards:
+                # depth sums, watermark/last_rv take the max (the real
+                # serviceable floor is the worst shard's, matching
+                # list_changes' any-shard-too-old rule)
+                agg = kinds.get(kind)
+                if agg is None:
+                    kinds[kind] = dict(ks)
+                else:
+                    agg["depth"] += ks["depth"]
+                    agg["compacted_rv"] = max(agg["compacted_rv"],
+                                              ks["compacted_rv"])
+                    agg["last_rv"] = max(agg["last_rv"], ks["last_rv"])
+            shards[s.shard_name] = {
+                "kinds": sorted(st["kinds"]),
+                "depth": sum(k["depth"] for k in st["kinds"].values()),
+                "compacted_rv": max(
+                    [k["compacted_rv"] for k in st["kinds"].values()],
+                    default=0),
+                "commits": s.commits,
+                "rv": st["rv"],
+            }
+        return {"rv": self._alloc.last, "capacity": cap, "wal": wal,
+                "kinds": kinds, "shards": shards}
+
+    def shard_map(self) -> dict:
+        """kind -> shard name (pods list every hashed shard): the
+        /debug/fabric topology surface."""
+        out = {kind: "meta" for kind in WATCH_KINDS}
+        out["nodes"] = "nodes"
+        out["events"] = "events"
+        out["pods"] = [s.shard_name for s in self._pod_shards]
+        return out
+
+    def close(self) -> None:
+        for s in self._shards:
+            s.close()

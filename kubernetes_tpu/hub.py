@@ -52,12 +52,21 @@ class EventHandlers:
     """cache.ResourceEventHandler equivalent. ``on_event``, when set,
     receives the full :class:`JournalEvent` (rv included) INSTEAD of the
     typed callbacks — the transport layer uses it to put revisions on
-    the wire; informer-style consumers keep the typed trio."""
+    the wire, and the watch relay tree (fabric.relay) uses it to keep
+    its ring journal; informer-style consumers keep the typed trio.
+
+    ``on_sync(rv, relisted)`` fires on RemoteHub streams at each sync
+    marker: ``relisted`` is True when the connection replayed a full
+    LIST (first connect or a 410 fallback) rather than a journal
+    resume — the relay resets its ring there, because its event
+    continuity broke. The in-process hub never reconnects, so it never
+    calls it."""
 
     on_add: Optional[Callable] = None
     on_update: Optional[Callable] = None       # (old, new)
     on_delete: Optional[Callable] = None
     on_event: Optional[Callable] = None        # (JournalEvent)
+    on_sync: Optional[Callable] = None         # (rv, relisted: bool)
 
 
 def _deliver(h: EventHandlers, ev: JournalEvent) -> None:
@@ -186,6 +195,14 @@ class Hub:
         with self._lock:
             return self._last_rv
 
+    def _newest_rv(self) -> int:
+        """The newest revision that exists anywhere in this hub's
+        revision space. For a standalone hub that is its own counter; a
+        fabric shard (fabric.sharded._ShardHub) overrides it to the
+        SHARED allocator's value, because resume points and sync
+        markers travel between shards through their clients."""
+        return self._last_rv
+
     def _commit(self, store: _Store, etype: str, old, new) -> JournalEvent:
         """Stamp one revision, journal the event (WAL included). Caller
         holds the lock and has already mutated ``store.objects`` — the
@@ -242,6 +259,40 @@ class Hub:
         events.sort(key=lambda e: e.rv)
         self.journal.rewrite_wal(self._last_rv, events)
 
+    def list_changes(self, since_rv: int,
+                     kinds: tuple = ("pods", "nodes")) -> dict:
+        """Incremental LIST: every journal event of ``kinds`` after
+        ``since_rv``, rv-sorted, plus the revision the answer is
+        consistent at — the O(changes) read the drift sentinel diffs
+        against instead of re-LISTing the cluster. An unserviceable
+        resume point (compacted, or from another revision space)
+        answers ``{"too_old": True}`` with the watermark INSTEAD of
+        raising: the verdict must survive the /call wire, where mapped
+        exceptions reconstruct poorly, and the caller's answer (fall
+        back to a full LIST) is the same either way."""
+        with self._lock:
+            rv = self._newest_rv()
+            if since_rv > rv:
+                return {"too_old": True, "compacted_rv": rv, "rv": rv}
+            try:
+                events = self.journal.changes_after(kinds, since_rv)
+            except RvTooOld as e:
+                return {"too_old": True,
+                        "compacted_rv": e.compacted_rv, "rv": rv}
+            return {"too_old": False, "rv": rv,
+                    "changes": [{"rv": ev.rv, "kind": ev.kind,
+                                 "type": ev.type,
+                                 "obj": ev.new if ev.new is not None
+                                 else ev.old}
+                                for ev in events]}
+
+    def shard_map(self) -> dict:
+        """kind -> owning shard, the /debug/fabric topology surface. A
+        single hub is one shard ("hub") for every kind; the fabric's
+        ShardedHub overrides with its real layout."""
+        with self._lock:
+            return {kind: "hub" for kind in self._stores}
+
     def get_journal_stats(self) -> dict:
         """Journal depth/watermark per kind (the hub_journal_* gauges)."""
         with self._lock:
@@ -267,13 +318,13 @@ class Hub:
         Returns the current global revision (the wire's sync marker)."""
         with self._lock:
             if since_rv is not None:
-                if since_rv > self._last_rv:
+                if since_rv > self._newest_rv():
                     # a resume point from a FUTURE revision means the
                     # client watched a different revision space (a hub
                     # reborn without its WAL): "no events" here would be
                     # a lie that pins phantom state in the client forever
                     raise RvTooOld(store.watch_kind, since_rv,
-                                   self._last_rv)
+                                   self._newest_rv())
                 events = self.journal.events_after(store.watch_kind,
                                                    since_rv)
                 store.handlers.append(h)
@@ -286,7 +337,7 @@ class Hub:
                         _deliver(h, JournalEvent(
                             rv=o.metadata.resource_version,
                             kind=store.watch_kind, type="add", new=o))
-            return self._last_rv
+            return self._newest_rv()
 
     def watch_nodes(self, h: EventHandlers, replay: bool = True,
                     since_rv: int | None = None) -> int:

@@ -1,14 +1,23 @@
 """RemoteHub: the client-go analog — a Hub implementation over HTTP.
 
 Speaks hubserver's wire: typed verbs via ``POST /call``, informers via
-``GET /watch`` streams (one reflector thread per watch, LIST replay +
-synced marker + live events). A Scheduler constructed with a RemoteHub
-runs unmodified against a hub in another process/host — the same
-swap the reference makes between fake clientsets and a real apiserver.
+``GET /watch`` streams (one reflector thread per watch connection, LIST
+replay + synced marker + live events). A Scheduler constructed with a
+RemoteHub runs unmodified against a hub in another process/host — the
+same swap the reference makes between fake clientsets and a real
+apiserver.
 
 Server-side Conflict/NotFound round-trip as the hub's own exception
 types, so optimistic-concurrency handling (bind conflicts, requeues)
 behaves identically on both transports.
+
+Wire codec (fabric.codec): the client offers the compact binary codec
+on every call and watch; the server confirms only on an exact registry-
+fingerprint match, and the client pins whichever codec the first /call
+answer arrived in. A ``CodecMismatch`` verdict (server restarted with a
+different registry shape) re-pins JSON and retries — negotiation is a
+per-connection property, never a correctness risk. ``wire_stats()``
+counts messages and bytes per codec for the ``wire_codec_*`` metrics.
 
 Resilience (client-go's retry/reflector discipline, SURVEY §5.3/§5.8):
 
@@ -33,7 +42,10 @@ storm. Only when the server answers 410 (``RvTooOld``: the gap was
 compacted) does the reflector fall back to the full relist, whose
 replay is DIFFED against local state so missed deletes still surface.
 ``resilience_stats()`` counts both paths (``watch_resumes`` /
-``watch_relists``) for the hub_watch_*_total metrics.
+``watch_relists``) — per CONNECTION, not per kind: a multiplexed watch
+(``watch_kinds``, the relay tree's downstream shape) carries many kinds
+on one socket, and a cut of that socket is ONE resume, not one per
+kind.
 """
 
 from __future__ import annotations
@@ -46,6 +58,7 @@ import time
 import urllib.error
 import urllib.request
 
+from kubernetes_tpu.fabric import codec as binwire
 from kubernetes_tpu.hub import (
     Conflict,
     EventHandlers,
@@ -53,7 +66,12 @@ from kubernetes_tpu.hub import (
     NotFound,
     Unavailable,
 )
-from kubernetes_tpu.hubserver import CALL_METHODS, WATCH_KINDS
+from kubernetes_tpu.hubserver import (
+    CALL_METHODS,
+    FRAMES_CONTENT_TYPE,
+    WATCH_KINDS,
+)
+from kubernetes_tpu.storage import JournalEvent
 from kubernetes_tpu.utils.backoff import Backoff, RetryBudget
 from kubernetes_tpu.utils.wire import from_wire, to_wire
 
@@ -95,7 +113,8 @@ class RemoteHub:
                  retry_deadline: float = 8.0,
                  retry_base: float = 0.05, retry_cap: float = 1.0,
                  retry_budget: float = 20.0,
-                 retry_refill_per_sec: float = 4.0):
+                 retry_refill_per_sec: float = 4.0,
+                 codec: str | None = None):
         self._base = base_url.rstrip("/")
         self._timeout = timeout
         self._retry_deadline = retry_deadline
@@ -107,6 +126,10 @@ class RemoteHub:
         self._threads: list[threading.Thread] = []
         self._closed = threading.Event()
         self._wlock = threading.Lock()     # guards _watchers
+        # wire codec: None = negotiate (offer bin1, pin whatever the
+        # first /call answer arrives in); "json" forces the legacy wire
+        self._pin: str | None = \
+            binwire.CODEC_JSON if codec == binwire.CODEC_JSON else None
         # degraded-state tracking (stats lock; hot path touches it only
         # on failure or on the first success after a failure)
         self._slock = threading.Lock()
@@ -116,6 +139,11 @@ class RemoteHub:
         self._watch_reconnects = 0
         self._watch_resumes = 0    # reconnects served from the journal
         self._watch_relists = 0    # reconnects that fell back to LIST
+        # per-codec message/byte accounting (wire_codec_* metrics)
+        self._wire = {binwire.CODEC_JSON: {"msgs": 0, "bytes_sent": 0,
+                                           "bytes_recv": 0},
+                      binwire.CODEC_BINARY: {"msgs": 0, "bytes_sent": 0,
+                                             "bytes_recv": 0}}
         # reflectors currently disconnected (watch health is tracked
         # apart from call health: RPCs can succeed while every stream is
         # down, and informer-confirm-dependent logic must see THAT)
@@ -138,9 +166,35 @@ class RemoteHub:
                     self._degraded_since
                 self._degraded_since = None
 
+    def _count_wire(self, codec: str, sent: int = 0, recv: int = 0,
+                    msgs: int = 1) -> None:
+        with self._slock:
+            w = self._wire[codec]
+            w["msgs"] += msgs
+            w["bytes_sent"] += sent
+            w["bytes_recv"] += recv
+
+    def _count_call(self, body_codec: str, sent: int,
+                    resp_codec: str, recv: int) -> None:
+        """Both halves of one RPC under ONE lock acquisition (the
+        request and answer may ride different codecs mid-negotiation)."""
+        with self._slock:
+            w = self._wire[body_codec]
+            w["msgs"] += 1
+            w["bytes_sent"] += sent
+            w = self._wire[resp_codec]
+            w["msgs"] += 1
+            w["bytes_recv"] += recv
+
     @property
     def connected(self) -> bool:
         return self._degraded_since is None
+
+    @property
+    def codec(self) -> str:
+        """The pinned wire codec ("bin1"/"json"); "json" while still
+        probing (the probe itself goes out on the JSON wire)."""
+        return self._pin or binwire.CODEC_JSON
 
     @property
     def watches_healthy(self) -> bool:
@@ -161,26 +215,58 @@ class RemoteHub:
                     "watch_relists": self._watch_relists,
                     "watches_down": self._watch_down,
                     "degraded_seconds": degraded_s,
-                    "degraded": self._degraded_since is not None}
+                    "degraded": self._degraded_since is not None,
+                    "codec": self._pin or "negotiating",
+                    "wire": {c: dict(w) for c, w in self._wire.items()}}
 
     # ------------- RPC -------------
 
     def _call(self, method: str, *args):
-        body = json.dumps({"method": method,
-                           "args": [to_wire(a) for a in args]}).encode()
         idempotent = method in IDEMPOTENT_METHODS
         bo = Backoff(self._retry_base, self._retry_cap)
         t_end = time.monotonic() + self._retry_deadline
         while True:
+            pin = self._pin
+            if pin == binwire.CODEC_BINARY:
+                body = binwire.encode({"method": method,
+                                       "args": list(args)})
+                headers = {"Content-Type": "application/x-ktpu-bin",
+                           binwire.WIRE_HEADER: binwire.offer()}
+                body_codec = binwire.CODEC_BINARY
+            else:
+                body = json.dumps({
+                    "method": method,
+                    "args": [to_wire(a) for a in args]}).encode()
+                headers = {"Content-Type": "application/json"}
+                if pin is None:
+                    # the probe: JSON body, "I can read bin1" offer —
+                    # the answer's codec pins the connection
+                    headers[binwire.WIRE_HEADER] = \
+                        f"json;accept={binwire.CODEC_BINARY};" \
+                        f"fp={binwire.registry_fingerprint()}"
+                body_codec = binwire.CODEC_JSON
             req = urllib.request.Request(
-                self._base + "/call", data=body,
-                headers={"Content-Type": "application/json"})
+                self._base + "/call", data=body, headers=headers)
             try:
                 with urllib.request.urlopen(
                         req, timeout=self._timeout) as resp:
-                    payload = json.loads(resp.read())
+                    raw = resp.read()
+                    resp_bin = resp.headers.get(
+                        binwire.WIRE_HEADER, "").startswith(
+                            binwire.CODEC_BINARY)
                 self._mark_connected()
-                return from_wire(payload["result"])
+                self._count_call(body_codec, len(body),
+                                 binwire.CODEC_BINARY if resp_bin
+                                 else binwire.CODEC_JSON, len(raw))
+                if self._pin is None:
+                    # the server's answer codec IS the negotiation
+                    # verdict (it confirms bin1 only on fingerprint
+                    # match); pin it for every later call
+                    self._pin = binwire.CODEC_BINARY if resp_bin \
+                        else binwire.CODEC_JSON
+                if resp_bin:
+                    return binwire.decode(raw)["result"]
+                return from_wire(json.loads(raw)["result"])
             except urllib.error.HTTPError as e:
                 if e.code in _RETRYABLE_HTTP:
                     err = f"HTTP {e.code}"
@@ -196,6 +282,13 @@ class RemoteHub:
                         payload = json.loads(e.read())
                     except (ValueError, OSError):
                         payload = {"error": f"HTTP {e.code}", "message": ""}
+                    if payload.get("error") == "CodecMismatch" \
+                            and pin != binwire.CODEC_JSON:
+                        # the server's registry shape changed under us
+                        # (restart with different code): re-pin JSON and
+                        # retry — deterministic fix, not a fault
+                        self._pin = binwire.CODEC_JSON
+                        continue
                     exc = _ERRORS.get(payload.get("error", ""))
                     msg = payload.get("message", "")
                     if exc is not None:
@@ -226,11 +319,26 @@ class RemoteHub:
 
     # ------------- watch (reflector threads) -------------
 
+    def watch_kinds(self, handlers: dict[str, EventHandlers],
+                    replay: bool = True) -> None:
+        """MULTIPLEXED watch: every kind in ``handlers`` rides ONE
+        connection (the hubserver/relay ``kinds=`` wire), each event
+        dispatched to its kind's handlers. One socket instead of one
+        per kind is what lets 10k kubelet-analog clients hang off a
+        relay without 10k×kinds upstream streams — and the
+        resume/relist counters stay accurate because they count
+        CONNECTIONS, not kinds."""
+        self._watch_multi(dict(handlers), replay)
+
     def _watch(self, kind: str, h: EventHandlers, replay: bool) -> None:
-        """One reflector: LIST(replay)+WATCH with resourceVersion dedup,
-        reconnect-with-RESUME on stream failure (client-go's reflector
-        discipline over the hub's etcd-analog journal). ``state`` tracks
-        uid -> (rv, obj) so
+        self._watch_multi({kind: h}, replay)
+
+    def _watch_multi(self, handlers: dict[str, EventHandlers],
+                     replay: bool) -> None:
+        """One reflector CONNECTION: LIST(replay)+WATCH with
+        resourceVersion dedup, reconnect-with-RESUME on stream failure
+        (client-go's reflector discipline over the hub's etcd-analog
+        journal). Per kind, ``state`` tracks uid -> (rv, obj) so
 
         * duplicate adds from the replay/live race are dropped by rv,
         * orphan deletes (object gone before we ever listed it) are
@@ -240,38 +348,68 @@ class RemoteHub:
           absent from the relist dispatch as deletes (the events missed
           during the gap).
 
-        ``last_rv`` tracks the newest journal revision this reflector
-        has seen (event rv fields and sync markers). Reconnects dial
-        ``since_rv=last_rv`` first: the hub replays only the missed
-        journal suffix — no relist, no diff needed. A 410 answer
-        (RvTooOld: the gap was compacted) falls back to the full-relist
-        path above. ``watch_resumes``/``watch_relists`` count the split.
+        ``last_rv`` tracks the newest journal revision this connection
+        has seen (event rv fields and sync markers; the revision space
+        is global, so one cursor serves every kind on the stream).
+        Reconnects dial ``since_rv=last_rv`` first: the hub replays
+        only the missed journal suffix — no relist, no diff needed. A
+        410 answer (RvTooOld: the gap was compacted) falls back to the
+        full-relist path above. ``watch_resumes``/``watch_relists``
+        count the split once per reconnect.
 
         When the caller asked replay=False (live-only consumers), the
         first connection's replay still runs but only SEEDS state without
-        dispatching, so reconnects can't replay ancient history at it."""
+        dispatching, so reconnects can't replay ancient history at it.
+
+        Handlers with ``on_event`` set receive JournalEvents (rv
+        included) instead of the typed trio — dedup and relist-diff
+        still apply first; ``on_sync(rv, relisted)`` fires at each sync
+        marker (the relay tree's continuity signal)."""
+        kinds = sorted(handlers)
+        mux = len(kinds) > 1
         synced = threading.Event()
-        state: dict[str, tuple[int, object]] = {}
-        current: list = [None]   # this reflector's live response handle
+        states: dict[str, dict[str, tuple[int, object]]] = \
+            {k: {} for k in kinds}
+        current: list = [None]   # this connection's live response handle
         last_rv = [0]            # newest journal revision seen
 
         def note_rv(rv) -> None:
             if rv and rv > last_rv[0]:
                 last_rv[0] = rv
 
-        def dispatch(ev: dict, suppress: bool, live: set) -> None:
+        def deliver(h: EventHandlers, etype: str, rv: int, kind: str,
+                    old, new) -> None:
+            if h.on_event is not None:
+                h.on_event(JournalEvent(rv=rv, kind=kind, type=etype,
+                                        old=old, new=new))
+            elif etype == "delete":
+                if h.on_delete:
+                    h.on_delete(old)
+            elif etype == "add":
+                if h.on_add:
+                    h.on_add(new)
+            elif h.on_update:
+                h.on_update(old, new)
+
+        def dispatch(ev: dict, suppress: bool,
+                     live: dict[str, set]) -> None:
+            kind = ev.get("kind") or kinds[0]
+            state = states.get(kind)
+            if state is None:
+                return                      # unknown kind on the stream
+            h = handlers[kind]
             etype = ev.get("type")
             if etype == "delete":
                 old = from_wire(ev.get("old"))
                 uid = old.metadata.uid
-                if state.pop(uid, None) is not None and h.on_delete \
-                        and not suppress:
-                    h.on_delete(old)
+                if state.pop(uid, None) is not None and not suppress:
+                    deliver(h, "delete", ev.get("rv") or 0, kind,
+                            old, None)
                 return
             new = from_wire(ev.get("new"))
             uid = new.metadata.uid
             rv = new.metadata.resource_version
-            live.add(uid)
+            live[kind].add(uid)
             prev = state.get(uid)
             if prev is not None and rv <= prev[0]:
                 return                      # duplicate (replay/live race)
@@ -279,16 +417,19 @@ class RemoteHub:
             if suppress:
                 return
             if prev is None:
-                if h.on_add:
-                    h.on_add(new)
-            elif h.on_update:
-                h.on_update(prev[1], new)
+                deliver(h, "add", rv, kind, None, new)
+            else:
+                deliver(h, "update", rv, kind, prev[1], new)
 
         def connect(since_rv: int | None = None):
+            kq = f"kinds={','.join(kinds)}" if mux else f"kind={kinds[0]}"
             if since_rv is not None:
-                url = f"{self._base}/watch?kind={kind}&since_rv={since_rv}"
+                url = f"{self._base}/watch?{kq}&since_rv={since_rv}"
             else:
-                url = f"{self._base}/watch?kind={kind}&replay=1"
+                url = f"{self._base}/watch?{kq}&replay=1"
+            if self._pin != binwire.CODEC_JSON:
+                url += f"&codec={binwire.CODEC_BINARY}" \
+                       f"&fp={binwire.registry_fingerprint()}"
             resp = urllib.request.urlopen(url, timeout=self._timeout)
             with self._wlock:
                 # swap, don't leak: the previous connection's response
@@ -300,6 +441,50 @@ class RemoteHub:
                 self._watchers.append(resp)
             return resp
 
+        def stream_events(resp):
+            """Yield decoded event dicts in the stream's codec. Binary
+            frames carry real objects (dispatch's from_wire passes them
+            through); JSON lines carry tagged dicts. Wire accounting is
+            batched (local counters, flushed every 64 events and at
+            stream end): a per-event lock acquisition would contend the
+            stats lock at relay-storm event rates."""
+            ctype = resp.headers.get("Content-Type", "")
+            is_bin = ctype.startswith(FRAMES_CONTENT_TYPE)
+            codec_name = binwire.CODEC_BINARY if is_bin \
+                else binwire.CODEC_JSON
+            pend_msgs = pend_bytes = 0
+            try:
+                if is_bin:
+                    while True:
+                        payload = binwire.read_frame(resp)
+                        if payload is None:
+                            return
+                        pend_msgs += 1
+                        pend_bytes += len(payload) + 4
+                        if pend_msgs >= 64:
+                            self._count_wire(codec_name,
+                                             recv=pend_bytes,
+                                             msgs=pend_msgs)
+                            pend_msgs = pend_bytes = 0
+                        yield binwire.decode(payload)
+                else:
+                    for raw in resp:
+                        line = raw.strip()
+                        if not line:
+                            continue
+                        pend_msgs += 1
+                        pend_bytes += len(raw)
+                        if pend_msgs >= 64:
+                            self._count_wire(codec_name,
+                                             recv=pend_bytes,
+                                             msgs=pend_msgs)
+                            pend_msgs = pend_bytes = 0
+                        yield json.loads(line)
+            finally:
+                if pend_msgs:
+                    self._count_wire(codec_name, recv=pend_bytes,
+                                     msgs=pend_msgs)
+
         def consume(resp, suppress_replay: bool,
                     progressed: list[bool], resumed: bool) -> None:
             # a resumed stream replays the JOURNAL SUFFIX, not a LIST:
@@ -307,14 +492,10 @@ class RemoteHub:
             # suppressed, never relist-diffed at the sync marker)
             in_replay = not resumed
             sync_seen = False
-            live: set[str] = set()
-            for raw in resp:
+            live: dict[str, set] = {k: set() for k in kinds}
+            for ev in stream_events(resp):
                 if self._closed.is_set():
                     return
-                line = raw.strip()
-                if not line:
-                    continue
-                ev = json.loads(line)
                 if sync_seen and ev and not ev.get("synced"):
                     # a LIVE event arrived: the stream genuinely worked,
                     # so the next outage's backoff restarts from base.
@@ -329,10 +510,21 @@ class RemoteHub:
                         # relist diff: anything tracked but absent from
                         # this replay was deleted while we weren't
                         # watching
-                        for uid in [u for u in state if u not in live]:
-                            _, obj = state.pop(uid)
-                            if h.on_delete and not suppress_replay:
-                                h.on_delete(obj)
+                        for kind in kinds:
+                            state = states[kind]
+                            seen = live[kind]
+                            for uid in [u for u in state
+                                        if u not in seen]:
+                                _, obj = state.pop(uid)
+                                if not suppress_replay:
+                                    deliver(handlers[kind], "delete",
+                                            ev.get("rv") or last_rv[0],
+                                            kind, obj, None)
+                    for kind in kinds:
+                        h = handlers[kind]
+                        if h.on_sync is not None:
+                            h.on_sync(ev.get("rv") or last_rv[0],
+                                      in_replay)
                     in_replay = False
                     sync_seen = True
                     synced.set()
@@ -357,7 +549,7 @@ class RemoteHub:
             stream_ok = [True]
 
             def set_down(down: bool) -> None:
-                # per-reflector edge-triggered contribution to the
+                # per-connection edge-triggered contribution to the
                 # client-wide watch-health gauge (watches_healthy):
                 # call health alone can't see a dead stream, and
                 # informer-confirm-dependent logic needs to
@@ -375,10 +567,14 @@ class RemoteHub:
                     progressed = [False]
                     try:
                         consume(resp, suppress, progressed, resumed)
-                    except (OSError, ValueError, AttributeError):
+                    except (OSError, ValueError, AttributeError,
+                            http.client.HTTPException):
                         # close() from another thread nulls the fp
                         # mid-read (AttributeError); a dying server
-                        # surfaces OSError
+                        # surfaces OSError on the line reader but
+                        # IncompleteRead (HTTPException) on the frame
+                        # reader's exact-length read; a torn frame/line
+                        # raises ValueError
                         pass
                     finally:
                         synced.set()
@@ -429,7 +625,7 @@ class RemoteHub:
                             # hammering the server
                             logger.error("watch %s rejected by server "
                                          "(HTTP %s); reflector stopping",
-                                         kind, code)
+                                         ",".join(kinds), code)
                             return
                         except _TRANSPORT_ERRORS:
                             continue
@@ -446,6 +642,10 @@ class RemoteHub:
                     suppress = False
                     set_down(False)
                     self._mark_connected()
+                    # ONE reconnect = ONE resume-or-relist, however many
+                    # kinds ride the connection (a relay-tree client
+                    # multiplexes them all; counting per kind would
+                    # overstate every cut by the kind count)
                     with self._slock:
                         self._watch_reconnects += 1
                         if resumed:
@@ -471,7 +671,8 @@ class RemoteHub:
                     # the server ANSWERED: surface its verdict instead
                     # of blind-retrying a doomed request to its deadline
                     raise RemoteError(
-                        f"watch {kind}: HTTP {e.code}") from None
+                        f"watch {','.join(kinds)}: HTTP {e.code}") \
+                        from None
                 err: Exception = e
                 try:
                     e.close()       # don't leak one socket per retry
@@ -482,10 +683,11 @@ class RemoteHub:
             self._mark_degraded()
             remaining = t_end - time.monotonic()
             if remaining <= 0 or self._closed.is_set():
-                raise Unavailable(f"watch {kind}: {err!r}") from None
+                raise Unavailable(
+                    f"watch {','.join(kinds)}: {err!r}") from None
             time.sleep(min(bo.next(), max(remaining, 0.0)))
         t = threading.Thread(target=run, args=(resp0,), daemon=True,
-                             name=f"reflector-{kind}")
+                             name=f"reflector-{'-'.join(kinds)}")
         t.start()
         self._threads.append(t)
         # WaitForCacheSync: watch_X returns only after the LIST replay has

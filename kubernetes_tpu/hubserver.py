@@ -4,21 +4,37 @@ Serves a Hub over real HTTP so a scheduler in another process/host talks
 LIST+WATCH exactly like the reference's client-go does to its apiserver
 (SURVEY.md §5.8):
 
-* ``POST /call`` — JSON-RPC for every public Hub method (the typed REST
+* ``POST /call`` — RPC for every public Hub method (the typed REST
   verbs; Conflict/NotFound map to 409/404 like the apiserver's status
   codes).
-* ``GET /watch?kind=pods&replay=1`` — chunked JSON-lines event stream
-  (the WATCH verb): with replay, the current objects arrive as synthetic
-  adds under the hub lock (a consistent LIST) followed by a
+* ``GET /watch?kind=pods&replay=1`` — chunked event stream (the WATCH
+  verb): with replay, the current objects arrive as synthetic adds
+  under the hub lock (a consistent LIST) followed by a
   ``{"synced": true, "rv": N}`` marker (WaitForCacheSync's signal, N =
   the global revision the stream is consistent at), then live events for
-  the life of the connection. Every event line carries its journal
-  revision (``"rv"``) so clients can track their resume point.
+  the life of the connection. Every event carries its journal revision
+  (``"rv"``) so clients can track their resume point.
 * ``GET /watch?kind=pods&since_rv=N`` — watch-RESUME: instead of a full
   LIST, journal events after revision N replay (then the sync marker,
   then live events). When the gap has been compacted away the server
   answers **410** ``{"error": "RvTooOld"}`` — the apiserver's "too old
   resource version" — and the client falls back to a relist.
+* ``GET /watch?kinds=pods,nodes`` — MULTIPLEXED watch: one connection
+  carries several kinds' streams, each event tagged with its ``kind``.
+  One relay (or reflector bundle) holds one upstream socket instead of
+  one per kind; ``since_rv`` applies to every kind at once because the
+  revision space is global.
+
+Wire codec (fabric.codec): the client may offer the compact binary
+codec — ``X-KTPU-Codec: bin1;fp=<registry fingerprint>`` on /call,
+``codec=bin1&fp=<fp>`` on /watch. The server answers in binary (and
+says so: response header / ``application/x-ktpu-frames`` content type)
+ONLY on an exact fingerprint match and when the codec is enabled
+(``HubServer(codecs=...)``); anything else falls back to the
+self-describing JSON wire, so old clients, JSON-only servers, and
+JSON-era middleboxes (the chaos proxy strips the offer) all keep
+working. A binary /call body against a fingerprint-mismatched server
+answers 400 ``CodecMismatch`` and the client re-pins JSON.
 
 The in-process Hub stays the fast path for benchmarks; this transport
 exists so "real list/watch client" is an actual network boundary, not an
@@ -32,6 +48,7 @@ import queue
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from kubernetes_tpu.fabric import codec as binwire
 from kubernetes_tpu.hub import (
     Conflict,
     EventHandlers,
@@ -66,6 +83,8 @@ CALL_METHODS = frozenset({
     "create_priority_class", "list_priority_classes",
     "record_event", "list_events",
     "get_journal_stats",
+    "shard_map",
+    "list_changes",
     "leases.get", "leases.update",
 })
 
@@ -75,12 +94,109 @@ WATCH_KINDS = ("pods", "nodes", "namespaces", "pvcs", "pvs",
                "pod_groups")
 
 _ERROR_STATUS = {"Conflict": 409, "NotFound": 404, "ValueError": 400,
-                 "TypeError": 400, "Fenced": 403}
+                 "TypeError": 400, "Fenced": 403, "CodecMismatch": 400}
+
+FRAMES_CONTENT_TYPE = "application/x-ktpu-frames"
+
+
+class WatchParams:
+    """Parsed /watch query: shared by the hub's handler and the relay's
+    (fabric.relay) so the two servers cannot drift apart on the wire."""
+
+    __slots__ = ("kinds", "mux", "replay", "since_rv", "use_bin")
+
+    def __init__(self, kinds, mux, replay, since_rv, use_bin):
+        self.kinds = kinds
+        self.mux = mux
+        self.replay = replay
+        self.since_rv = since_rv
+        self.use_bin = use_bin
+
+
+def parse_watch_query(q: dict, codecs=(binwire.CODEC_BINARY,
+                                       binwire.CODEC_JSON)):
+    """parse_qs dict -> (WatchParams, None) or (None, error message).
+    ``kinds=a,b`` selects the multiplexed wire (events kind-tagged);
+    binary framing applies only when offered AND the registry
+    fingerprints match AND the server speaks it."""
+    kinds_raw = q.get("kinds", [""])[0]
+    if kinds_raw:
+        kinds = [k for k in kinds_raw.split(",") if k]
+        mux = True
+    else:
+        kinds = [q.get("kind", [""])[0]]
+        mux = False
+    for kind in kinds:
+        if kind not in WATCH_KINDS:
+            return None, f"unknown watch kind {kind!r}"
+    since_raw = q.get("since_rv", [""])[0]
+    try:
+        since_rv = int(since_raw) if since_raw else None
+    except ValueError:
+        return None, f"bad since_rv {since_raw!r}"
+    use_bin = (binwire.CODEC_BINARY in codecs
+               and q.get("codec", [""])[0] == binwire.CODEC_BINARY
+               and q.get("fp", [""])[0]
+               == binwire.registry_fingerprint())
+    return WatchParams(kinds, mux, q.get("replay", ["1"])[0] == "1",
+                       since_rv, use_bin), None
+
+
+def make_stream_writers(wfile, use_bin: bool, mux: bool):
+    """-> (write_obj, write_event): the chunked watch-stream writers,
+    one implementation for every server speaking this wire (hub and
+    relay). ``write_obj`` emits markers/keepalives; ``write_event``
+    takes (kind, type, rv, old, new) with RAW objects and serializes
+    per the stream's codec."""
+    def write_chunk(blob: bytes) -> None:
+        wfile.write(f"{len(blob):x}\r\n".encode() + blob + b"\r\n")
+        wfile.flush()
+
+    def write_obj(obj: dict) -> None:
+        if use_bin:
+            write_chunk(binwire.frame(binwire.encode(obj)))
+        else:
+            write_chunk(json.dumps(obj).encode() + b"\n")
+
+    def write_event(kind: str, etype: str, rv: int, old, new) -> None:
+        d = {"type": etype, "rv": rv}
+        if mux:
+            d["kind"] = kind
+        if use_bin:
+            d["old"], d["new"] = old, new
+            write_chunk(binwire.frame(binwire.encode(d)))
+        else:
+            d["old"], d["new"] = to_wire(old), to_wire(new)
+            write_chunk(json.dumps(d).encode() + b"\n")
+
+    return write_obj, write_event
+
+
+class CodecMismatch(Exception):
+    """A binary /call body arrived but the registry fingerprints (or
+    enabled codecs) disagree: the positional struct layout cannot be
+    trusted. The client re-pins JSON on this verdict."""
+
+
+def _parse_codec_header(value: str | None) -> tuple[str, bool]:
+    """-> (body_codec, offered_binary). ``X-KTPU-Codec: bin1;fp=X`` is a
+    binary body; ``json;accept=bin1;fp=X`` is a JSON body whose sender
+    can READ binary (the probe). Either form offers binary only when
+    the fingerprint matches ours exactly."""
+    if not value:
+        return "json", False
+    parts = [p.strip() for p in value.split(";")]
+    body = parts[0] if parts[0] in (binwire.CODEC_BINARY,
+                                    binwire.CODEC_JSON) else "json"
+    fp = next((p[3:] for p in parts[1:] if p.startswith("fp=")), None)
+    accept = body == binwire.CODEC_BINARY or any(
+        p == f"accept={binwire.CODEC_BINARY}" for p in parts[1:])
+    return body, accept and fp == binwire.registry_fingerprint()
 
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
-    server_version = "kubernetes-tpu-hub/1"
+    server_version = "kubernetes-tpu-hub/2"
 
     def log_message(self, *args) -> None:  # quiet
         pass
@@ -88,6 +204,11 @@ class _Handler(BaseHTTPRequestHandler):
     @property
     def hub(self) -> Hub:
         return self.server.hub  # type: ignore[attr-defined]
+
+    @property
+    def _bin_enabled(self) -> bool:
+        return binwire.CODEC_BINARY in \
+            self.server.codecs  # type: ignore[attr-defined]
 
     def _json(self, status: int, payload: dict) -> None:
         body = json.dumps(payload).encode()
@@ -102,22 +223,43 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(404, {"error": "NotFound", "message": self.path})
             return
         length = int(self.headers.get("Content-Length", "0"))
+        body_codec, negotiated = _parse_codec_header(
+            self.headers.get(binwire.WIRE_HEADER))
+        negotiated = negotiated and self._bin_enabled
         try:
-            req = json.loads(self.rfile.read(length))
+            raw = self.rfile.read(length)
+            if body_codec == binwire.CODEC_BINARY:
+                if not negotiated:
+                    raise CodecMismatch(
+                        "binary body without a fingerprint match "
+                        f"(server fp {binwire.registry_fingerprint()})")
+                req = binwire.decode(raw)
+                args = list(req.get("args", []))
+            else:
+                req = json.loads(raw)
+                args = [from_wire(a) for a in req.get("args", [])]
             method = req["method"]
             if method not in CALL_METHODS:
                 raise ValueError(f"unknown method {method!r}")
             target = self.hub
             for part in method.split("."):
                 target = getattr(target, part)
-            args = [from_wire(a) for a in req.get("args", [])]
             result = target(*args)
         except Exception as e:  # noqa: BLE001 — mapped to wire errors
             name = type(e).__name__
             self._json(_ERROR_STATUS.get(name, 500),
                        {"error": name, "message": str(e)})
             return
-        self._json(200, {"result": to_wire(result)})
+        if negotiated:
+            out = binwire.encode({"result": result})
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ktpu-bin")
+            self.send_header(binwire.WIRE_HEADER, binwire.offer())
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+        else:
+            self._json(200, {"result": to_wire(result)})
 
     def do_GET(self) -> None:  # noqa: N802
         if not self.path.startswith("/watch"):
@@ -126,88 +268,103 @@ class _Handler(BaseHTTPRequestHandler):
         from urllib.parse import parse_qs, urlparse
 
         q = parse_qs(urlparse(self.path).query)
-        kind = q.get("kind", [""])[0]
-        replay = q.get("replay", ["1"])[0] == "1"
-        since_raw = q.get("since_rv", [""])[0]
-        try:
-            since_rv = int(since_raw) if since_raw else None
-        except ValueError:
-            self._json(400, {"error": "ValueError",
-                             "message": f"bad since_rv {since_raw!r}"})
+        params, err = parse_watch_query(
+            q, self.server.codecs)  # type: ignore[attr-defined]
+        if params is None:
+            self._json(400, {"error": "ValueError", "message": err})
             return
-        if kind not in WATCH_KINDS:
-            self._json(400, {"error": "ValueError",
-                             "message": f"unknown watch kind {kind!r}"})
-            return
+        kinds, mux = params.kinds, params.mux
+        replay, since_rv = params.replay, params.since_rv
+        use_bin = params.use_bin
         events: queue.Queue = queue.Queue(maxsize=100000)
         overflow = threading.Event()
 
-        def push(ev):
-            try:
-                events.put_nowait({"type": ev.type, "rv": ev.rv,
-                                   "old": to_wire(ev.old),
-                                   "new": to_wire(ev.new)})
-            except queue.Full:
-                # a silent gap would be an undetectable stale cache; close
-                # the stream instead — the client reflector reconnects,
-                # resuming from its last-seen rv (or relisting when the
-                # journal has compacted the gap away)
-                overflow.set()
+        def make_push(kind: str):
+            def push(ev):
+                try:
+                    events.put_nowait((kind, ev))
+                except queue.Full:
+                    # a silent gap would be an undetectable stale cache;
+                    # close the stream instead — the client reflector
+                    # reconnects, resuming from its last-seen rv (or
+                    # relisting when the journal compacted the gap away)
+                    overflow.set()
+            return push
 
-        h = EventHandlers(on_event=push)
         # registration under the hub lock makes replay a consistent LIST
-        # (or, with since_rv, a consistent journal suffix): replayed
-        # events land in the queue before any live event
+        # (or, with since_rv, a consistent journal suffix) PER KIND:
+        # replayed events land in the queue before any live event of
+        # that kind. A multiplexed registration is kind-by-kind — the
+        # informer contract needs per-object (hence per-kind) ordering,
+        # not a cross-kind snapshot.
+        handlers: list[EventHandlers] = []
+        cur_rv = 0
         try:
-            cur_rv = getattr(self.hub, f"watch_{kind}")(
-                h, replay=replay, since_rv=since_rv)
+            for kind in kinds:
+                h = EventHandlers(on_event=make_push(kind))
+                rv = getattr(self.hub, f"watch_{kind}")(
+                    h, replay=replay, since_rv=since_rv)
+                handlers.append(h)
+                cur_rv = max(cur_rv, rv)
         except RvTooOld as e:
             # the 410-Gone analog: this resume point was compacted away
+            for h in handlers:
+                self.hub.unwatch(h)
             self._json(410, {"error": "RvTooOld", "message": str(e),
                              "compacted_rv": e.compacted_rv})
             return
         self.send_response(200)
-        self.send_header("Content-Type", "application/jsonlines")
+        self.send_header("Content-Type",
+                         FRAMES_CONTENT_TYPE if use_bin
+                         else "application/jsonlines")
+        if use_bin:
+            self.send_header(binwire.WIRE_HEADER, binwire.offer())
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
 
-        def write_line(obj) -> None:
-            line = json.dumps(obj).encode() + b"\n"
-            self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
-            self.wfile.flush()
-
+        write_obj, write_event = make_stream_writers(self.wfile,
+                                                     use_bin, mux)
         try:
             if replay or since_rv is not None:
                 # drain the synchronous replay (LIST or journal suffix),
                 # then mark sync
                 while True:
                     try:
-                        write_line(events.get_nowait())
+                        kind, ev = events.get_nowait()
                     except queue.Empty:
                         break
-            write_line({"synced": True, "rv": cur_rv})
+                    write_event(kind, ev.type, ev.rv, ev.old, ev.new)
+            write_obj({"synced": True, "rv": cur_rv})
             while not self.server.stopping \
                     and not overflow.is_set():  # type: ignore[attr-defined]
                 try:
-                    ev = events.get(timeout=1.0)
+                    kind, ev = events.get(timeout=1.0)
                 except queue.Empty:
-                    write_line({})  # keepalive; also detects dead peers
+                    write_obj({})  # keepalive; also detects dead peers
                     continue
-                write_line(ev)
+                write_event(kind, ev.type, ev.rv, ev.old, ev.new)
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:
-            self.hub.unwatch(h)
+            for h in handlers:
+                self.hub.unwatch(h)
 
 
 class HubServer:
-    """hub = Hub(); HubServer(hub).start() -> serve on 127.0.0.1:port."""
+    """hub = Hub(); HubServer(hub).start() -> serve on 127.0.0.1:port.
 
-    def __init__(self, hub: Hub, host: str = "127.0.0.1", port: int = 0):
+    ``codecs`` lists the wire codecs this server speaks; dropping
+    ``bin1`` makes a JSON-only server (how the negotiation tests model
+    an old peer — binary clients must degrade transparently)."""
+
+    def __init__(self, hub: Hub, host: str = "127.0.0.1", port: int = 0,
+                 codecs: tuple[str, ...] = (binwire.CODEC_BINARY,
+                                            binwire.CODEC_JSON)):
         self.hub = hub
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.hub = hub                 # type: ignore[attr-defined]
+        self._httpd.codecs = codecs           # type: ignore[attr-defined]
         self._httpd.stopping = False          # type: ignore[attr-defined]
         self._thread: threading.Thread | None = None
 

@@ -44,8 +44,14 @@ class HollowNodes:
 
     def __init__(self, hub, count: int, prefix: str = "hollow",
                  cpu: str = "4", memory: str = "32Gi", pods: str = "110",
-                 zones: int = 0):
+                 zones: int = 0, watch_hub=None):
+        """``watch_hub`` splits the read fan-out from the write path:
+        pod WATCHES go to it (typically a fabric.relay node, so 10k
+        hollow kubelets cost the hub one socket per relay) while
+        writes — node registration, heartbeats, status acks — still go
+        straight to ``hub``. Default: watch the same hub."""
         self.hub = hub
+        self.watch_hub = watch_hub or hub
         self.prefix = prefix
         self.names: set[str] = set()
         self.acked: set[str] = set()        # pod uids driven to Running
@@ -67,7 +73,7 @@ class HollowNodes:
         # one of OUR nodes gets its status driven to Running
         # (hollow_kubelet runs a real kubelet loop against a fake runtime;
         # the scheduler-visible effect is exactly this status update)
-        self.hub.watch_pods(EventHandlers(
+        self.watch_hub.watch_pods(EventHandlers(
             on_add=self._maybe_ack,
             on_update=lambda old, new: self._maybe_ack(new)))
 
@@ -142,6 +148,10 @@ def main() -> None:
 
     ap = argparse.ArgumentParser(description="kubemark hollow-node feeder")
     ap.add_argument("--hub", required=True, help="hub URL")
+    ap.add_argument("--relay", default=None,
+                    help="watch-relay URL (fabric.relay): pod watches "
+                         "go through the relay tree, writes go to "
+                         "--hub — the 10k-kubelet fan-in shape")
     ap.add_argument("--nodes", type=int, default=100)
     ap.add_argument("--prefix", default="hollow")
     ap.add_argument("--zones", type=int, default=0)
@@ -149,8 +159,9 @@ def main() -> None:
                     help="node heartbeat interval seconds (0 = off)")
     args = ap.parse_args()
     client = RemoteHub(args.hub)
+    watch_client = RemoteHub(args.relay) if args.relay else None
     hollow = HollowNodes(client, args.nodes, prefix=args.prefix,
-                         zones=args.zones)
+                         zones=args.zones, watch_hub=watch_client)
     if args.heartbeat:
         hollow.start_heartbeat(args.heartbeat)
     print(f"kubemark: {args.nodes} hollow nodes registered", flush=True)
@@ -159,6 +170,8 @@ def main() -> None:
             time.sleep(3600)
     except KeyboardInterrupt:
         hollow.stop()
+        if watch_client is not None:
+            watch_client.close()
 
 
 if __name__ == "__main__":

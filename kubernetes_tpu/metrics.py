@@ -289,6 +289,27 @@ class SchedulerMetrics:
             "dra_cel_errors_total",
             "CEL selector compile/eval errors by source object",
             ("source",)))
+        # control-plane fabric (sharded hub + binary wire codec):
+        # per-shard journal state mirrored from ShardedHub stats, and
+        # per-codec wire traffic mirrored by delta from the hub
+        # client's accounting (true counters — rate() stays honest)
+        self.hub_shard_depth = r.register(Gauge(
+            "hub_shard_depth",
+            "Journal ring depth by hub shard (sharded hubs only)"))
+        self.hub_shard_compacted_rv = r.register(Gauge(
+            "hub_shard_compacted_rv",
+            "Journal compaction watermark by hub shard"))
+        self.hub_shard_commits = r.register(Counter(
+            "hub_shard_commits_total",
+            "Mutations committed by hub shard", ("shard",)))
+        self.wire_codec_messages = r.register(Counter(
+            "wire_codec_messages_total",
+            "Hub-client wire messages by codec (bin1 = the fabric's "
+            "binary codec, json = the fallback wire)", ("codec",)))
+        self.wire_codec_bytes = r.register(Counter(
+            "wire_codec_bytes_total",
+            "Hub-client wire bytes by codec and direction",
+            ("codec", "direction")))
         self.chaos_injected_faults = r.register(Gauge(
             "chaos_injected_faults",
             "Faults injected by an attached chaos layer, by kind"))

@@ -54,6 +54,7 @@ from kubernetes_tpu.framework.runtime import Framework
 from kubernetes_tpu.framework.interface import Code
 from kubernetes_tpu.framework.waiting import WaitingPod
 from kubernetes_tpu.hub import EventHandlers, Fenced, Hub, Unavailable
+from kubernetes_tpu.storage import RvTooOld
 from kubernetes_tpu.utils.backoff import Backoff
 from kubernetes_tpu.utils.gcguard import guard as gc_guard
 from kubernetes_tpu.utils.tracing import FlightRecorder, PodTimelines
@@ -288,7 +289,8 @@ class Scheduler:
                       "batches": 0, "attempts": 0,
                       "parked_unreachable": 0, "fenced": 0,
                       "device_fallbacks": 0, "quarantined": 0,
-                      "drift_repairs": 0}
+                      "drift_repairs": 0, "drift_full_lists": 0,
+                      "drift_incremental": 0}
         # poison-pod quarantine: uid -> {"qp", "until", "reason"};
         # strike/quarantine counts survive release so a re-offender's
         # backoff keeps escalating
@@ -296,10 +298,14 @@ class Scheduler:
         self._fault_strikes: dict[str, int] = {}
         self._quarantine_counts: dict[str, int] = {}
         # drift sentinel cadence (0 disables); strikes gate the
-        # full-rebuild last resort
+        # full-rebuild last resort. _drift_rv is the journal revision
+        # the last report was consistent at: steady-state passes diff
+        # O(changes) after it instead of re-LISTing the cluster, and
+        # fall back to the full diff only on RvTooOld (compacted gap)
         self.drift_check_interval = 30.0
         self._last_drift_check = 0.0
         self._drift_strikes = 0
+        self._drift_rv: int | None = None
         # degraded mode: the hub is unreachable (transport Unavailable).
         # Work parks with backoff instead of erroring; assumed pods are
         # preserved (their confirm events cannot arrive); the informer's
@@ -2216,10 +2222,24 @@ class Scheduler:
             return
         self._last_drift_check = now
         try:
-            report = self.cache.drift_report(self.hub)
+            report = None
+            if self._drift_rv is not None:
+                # steady state: O(changes) journal diff — ZERO cluster
+                # LISTs when nothing (or little) changed
+                try:
+                    report = self.cache.drift_report(
+                        self.hub, since_rv=self._drift_rv)
+                    self.stats["drift_incremental"] += 1
+                except RvTooOld:
+                    report = None   # compacted gap: full diff below
+            if report is None:
+                report = self.cache.drift_report(self.hub)
+                self.stats["drift_full_lists"] += 1
         except Unavailable:
             self._note_hub_down()
             return
+        rep_rv = getattr(report, "rv", None)
+        self._drift_rv = rep_rv if isinstance(rep_rv, int) else None
         n = report.count()
         if n == 0:
             self._drift_strikes = 0
@@ -2268,6 +2288,19 @@ class Scheduler:
                                m.hub_watch_resumes)
             self._mirror_count("watch_relists", s.get("watch_relists", 0),
                                m.hub_watch_relists)
+            for codec_name, w in s.get("wire", {}).items():
+                self._mirror_count(f"wire_msgs:{codec_name}",
+                                   w.get("msgs", 0),
+                                   m.wire_codec_messages,
+                                   codec=codec_name)
+                self._mirror_count(f"wire_sent:{codec_name}",
+                                   w.get("bytes_sent", 0),
+                                   m.wire_codec_bytes,
+                                   codec=codec_name, direction="sent")
+                self._mirror_count(f"wire_recv:{codec_name}",
+                                   w.get("bytes_recv", 0),
+                                   m.wire_codec_bytes,
+                                   codec=codec_name, direction="recv")
         for src, n in self._dra.cel_error_stats().items():
             self._mirror_count(f"cel:{src}", n, m.dra_cel_errors,
                                source=src)
@@ -2319,6 +2352,17 @@ class Scheduler:
                 float(st["depth"]), kind=kind)
             self.metrics.hub_journal_compacted_rv.set(
                 float(st["compacted_rv"]), kind=kind)
+        # a sharded hub (fabric.sharded.ShardedHub) reports per-shard
+        # journal state alongside the merged per-kind view
+        for shard, st in js.get("shards", {}).items():
+            self.metrics.hub_shard_depth.set(
+                float(st["depth"]), shard=shard)
+            self.metrics.hub_shard_compacted_rv.set(
+                float(st["compacted_rv"]), shard=shard)
+            self._mirror_count(f"shard_commits:{shard}",
+                               st.get("commits", 0),
+                               self.metrics.hub_shard_commits,
+                               shard=shard)
 
     def run(self, stop: threading.Event, idle_sleep: float = 0.02,
             elector=None) -> None:

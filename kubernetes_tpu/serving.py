@@ -127,6 +127,35 @@ class ServingEndpoints:
                         payload[name] = (mgr.stats() if mgr is not None
                                          else {"enabled": False})
                     body = json.dumps(payload, indent=2, default=str)
+                elif path == "/debug/fabric":
+                    # control-plane fabric surface: the hub's shard map
+                    # + per-shard journal state (ShardedHub), and the
+                    # hub client's wire-codec accounting (RemoteHub).
+                    # Relay topology/cursors live on each RelayServer's
+                    # own token-gated /debug/fabric — relays are their
+                    # own processes; the scheduler only sees its hub.
+                    payload = {}
+                    sm_fn = getattr(sched.hub, "shard_map", None)
+                    if sm_fn is not None:
+                        try:
+                            payload["shard_map"] = sm_fn()
+                        except Exception:  # noqa: BLE001 — hub down or
+                            pass           # a pre-fabric peer
+                    js_fn = getattr(sched.hub, "get_journal_stats",
+                                    None)
+                    if js_fn is not None:
+                        try:
+                            js = js_fn()
+                        except Exception:  # noqa: BLE001 — hub down
+                            js = {}
+                        payload["shards"] = js.get("shards", {})
+                        payload["journal_rv"] = js.get("rv")
+                    rs_fn = getattr(sched.hub, "resilience_stats", None)
+                    if rs_fn is not None:
+                        s = rs_fn()
+                        payload["wire"] = s.get("wire", {})
+                        payload["codec"] = s.get("codec")
+                    body = json.dumps(payload, indent=2, default=str)
                 elif path == "/debug/pod":
                     timelines = getattr(sched, "timelines", None)
                     if timelines is None:

@@ -147,6 +147,19 @@ class Journal:
             return []
         return [e for e in ring.ring if e.rv > since_rv]
 
+    def changes_after(self, kinds, since_rv: int) -> list[JournalEvent]:
+        """Merged multi-kind resume: every retained event of ``kinds``
+        with rv > since_rv in one rv-sorted list, or RvTooOld if ANY of
+        the kinds cannot serve the gap (a partially-resumable answer
+        would silently hide the unresumable kind's history). The drift
+        sentinel's O(changes) comparer and the relay tree's downstream
+        resume both read this shape."""
+        evs: list[JournalEvent] = []
+        for kind in kinds:
+            evs.extend(self.events_after(kind, since_rv))
+        evs.sort(key=lambda e: e.rv)
+        return evs
+
     def compacted_rv(self, kind: str) -> int:
         ring = self._kinds.get(kind)
         return max(ring.compacted_rv if ring else 0, self.compact_floor)

@@ -2,6 +2,7 @@
 (pkg/scheduler/testing equivalents)."""
 
 from kubernetes_tpu.testing.fakes import (
+    CountingHub,
     FakePermitPlugin,
     FakeReservePlugin,
     FakeScorePlugin,
@@ -14,6 +15,7 @@ from kubernetes_tpu.testing.fakes import (
 from kubernetes_tpu.testing.wrappers import MakeNode, MakePod
 
 __all__ = [
+    "CountingHub",
     "FakePermitPlugin",
     "FakeReservePlugin",
     "FakeScorePlugin",

@@ -209,3 +209,25 @@ class FakePVController:
         bound.spec.volume_name = pv_name
         bound.status.phase = "Bound"
         self.hub.update_pvc(bound)
+
+
+class CountingHub:
+    """Forwarding hub wrapper counting the O(cluster) LIST reads — the
+    drift sentinel's zero-LIST gates (tests/test_drift.py and the
+    --fanout-smoke drift phase) both assert against it, so the
+    definition of "a cluster LIST" lives in exactly one place."""
+
+    def __init__(self, hub):
+        self._hub = hub
+        self.lists = 0
+
+    def list_pods(self):
+        self.lists += 1
+        return self._hub.list_pods()
+
+    def list_nodes(self):
+        self.lists += 1
+        return self._hub.list_nodes()
+
+    def __getattr__(self, name):
+        return getattr(self._hub, name)

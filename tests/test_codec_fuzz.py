@@ -1,0 +1,247 @@
+"""Differential fuzz for the fabric binary codec (ISSUE 9 satellite).
+
+Property: for ANY API object, the binary wire and the JSON wire agree —
+``codec.decode(codec.encode(x))`` equals
+``wire.from_wire(json.loads(json.dumps(wire.to_wire(x))))`` equals
+``x``. The two codecs share nothing but the class registry, so a
+divergence here is a positional-field bug (bin) or a tag bug (JSON)
+before it becomes silent wire corruption.
+
+Runs every negotiated kind (Pod, Node, PodGroup, ResourceClaim, Event,
+Lease and the rest of the registry's hub-stored kinds) over randomized
+objects: 200 seeds in tier-1, 1000 more under ``-m slow``.
+
+The size claim is pinned too: the binary wire must carry a
+representative event corpus in ≤ 1/3 the JSON bytes (the --fanout-smoke
+wire_ratio gate's unit-test twin).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import string
+
+import pytest
+
+from kubernetes_tpu.api.objects import (
+    DeviceAllocationResult,
+    DeviceConstraint,
+    DeviceRequest,
+    DeviceSelector,
+    Event,
+    ObjectMeta,
+    Pod,
+    PodCondition,
+    PodGroup,
+    ResourceClaim,
+    ResourceClaimSpec,
+    ResourceClaimStatus,
+    AllocationResult,
+)
+from kubernetes_tpu.fabric import codec
+from kubernetes_tpu.leaderelection import Lease
+from kubernetes_tpu.testing import MakeNode, MakePod
+from kubernetes_tpu.utils.wire import from_wire, to_wire
+
+# strings exercising escaping, unicode, and the fixstr/str8+ boundary
+_NASTY = ["", "a", 'quo"te', "back\\slash", "new\nline", "tab\there",
+          "ünïcødé-✓", "x" * 31, "y" * 32, "z" * 300,
+          "{\"json\": [1,2]}"]
+
+
+def _rs(rng: random.Random, n: int = 12) -> str:
+    if rng.random() < 0.25:
+        return rng.choice(_NASTY)
+    return "".join(rng.choices(string.ascii_lowercase + string.digits
+                               + "-./_", k=rng.randint(1, n)))
+
+
+def _labels(rng: random.Random) -> dict:
+    return {_rs(rng): _rs(rng) for _ in range(rng.randint(0, 4))}
+
+
+def _meta(rng: random.Random) -> ObjectMeta:
+    return ObjectMeta(name=_rs(rng), namespace=_rs(rng, 8),
+                      labels=_labels(rng), annotations=_labels(rng),
+                      creation_timestamp=rng.random() * 2e9,
+                      resource_version=rng.randint(0, 2**48))
+
+
+def _pod(rng: random.Random) -> Pod:
+    mk = MakePod().name(_rs(rng)).namespace(_rs(rng, 8)) \
+        .labels(_labels(rng))
+    if rng.random() < 0.8:
+        mk = mk.req(cpu=f"{rng.randint(1, 4000)}m",
+                    memory=f"{rng.randint(1, 64)}Gi")
+    if rng.random() < 0.3:
+        mk = mk.priority(rng.randint(-100, 10**9))
+    if rng.random() < 0.3:
+        mk = mk.node_name(_rs(rng))
+    if rng.random() < 0.25:
+        mk = mk.toleration(key=_rs(rng), value=_rs(rng),
+                           effect="NoSchedule")
+    if rng.random() < 0.2:
+        mk = mk.node_affinity_in(_rs(rng), [_rs(rng), _rs(rng)])
+    if rng.random() < 0.2:
+        mk = mk.pod_anti_affinity("zone", {_rs(rng): _rs(rng)})
+    if rng.random() < 0.2:
+        mk = mk.spread_constraint(rng.randint(1, 5), "zone",
+                                  "DoNotSchedule",
+                                  {_rs(rng): _rs(rng)})
+    pod = mk.obj()
+    pod.metadata.annotations = _labels(rng)
+    if rng.random() < 0.3:
+        pod.status.phase = rng.choice(["Pending", "Running", "Failed"])
+        pod.status.nominated_node_name = _rs(rng)
+        pod.status.conditions = [PodCondition(
+            type="PodScheduled",
+            status=rng.choice(["True", "False", "Unknown"]),
+            reason=_rs(rng), message=_rs(rng, 40),
+            last_transition_time=rng.random() * 2e9)]
+    if rng.random() < 0.2:
+        pod.status.resource_claim_statuses = _labels(rng)
+    return pod
+
+
+def _node(rng: random.Random):
+    mk = MakeNode().name(_rs(rng)).capacity(
+        cpu=str(rng.randint(1, 256)),
+        memory=f"{rng.randint(1, 2048)}Gi",
+        pods=str(rng.randint(1, 500)))
+    for k, v in _labels(rng).items():
+        mk = mk.label(k, v)
+    if rng.random() < 0.3:
+        mk = mk.taint(_rs(rng), _rs(rng), "NoSchedule")
+    if rng.random() < 0.15:
+        mk = mk.unschedulable()
+    if rng.random() < 0.2:
+        mk = mk.image(_rs(rng, 30), rng.randint(0, 2**40))
+    return mk.obj()
+
+
+def _pod_group(rng: random.Random) -> PodGroup:
+    return PodGroup(metadata=_meta(rng),
+                    min_member=rng.randint(1, 64),
+                    queue=_rs(rng, 8), priority=rng.randint(-10, 10),
+                    schedule_timeout_seconds=rng.random() * 300)
+
+
+def _claim(rng: random.Random) -> ResourceClaim:
+    reqs = [DeviceRequest(
+        name=_rs(rng, 6), device_class_name=_rs(rng, 8),
+        count=rng.randint(1, 8),
+        selectors=[DeviceSelector(cel_expression=_rs(rng, 40))
+                   for _ in range(rng.randint(0, 2))],
+        admin_access=rng.random() < 0.1)
+        for _ in range(rng.randint(0, 3))]
+    cons = [DeviceConstraint(requests=[r.name for r in reqs],
+                             match_attribute=_rs(rng))
+            for _ in range(rng.randint(0, 1))]
+    status = ResourceClaimStatus()
+    if rng.random() < 0.4:
+        status = ResourceClaimStatus(
+            allocation=AllocationResult(
+                node_name=_rs(rng),
+                devices=[DeviceAllocationResult(
+                    request=_rs(rng, 6), driver=_rs(rng, 8),
+                    pool=_rs(rng, 6), device=_rs(rng, 6))]),
+            reserved_for=[_rs(rng) for _ in range(rng.randint(0, 3))])
+    return ResourceClaim(metadata=_meta(rng),
+                         spec=ResourceClaimSpec(device_requests=reqs,
+                                                constraints=cons),
+                         status=status)
+
+
+def _event(rng: random.Random) -> Event:
+    return Event(metadata=_meta(rng), ref_kind=_rs(rng, 10),
+                 ref_key=f"{_rs(rng, 8)}/{_rs(rng, 8)}",
+                 reason=_rs(rng), message=_rs(rng, 60),
+                 count=rng.randint(1, 10**6))
+
+
+def _lease(rng: random.Random) -> Lease:
+    return Lease(name=_rs(rng), holder_identity=_rs(rng),
+                 lease_duration_seconds=rng.random() * 60,
+                 acquire_time=rng.random() * 2e9,
+                 renew_time=rng.random() * 2e9,
+                 lease_transitions=rng.randint(0, 1000),
+                 epoch=rng.randint(0, 2**40))
+
+
+_GENS = (_pod, _node, _pod_group, _claim, _event, _lease)
+
+
+def _one_round(seed: int) -> None:
+    rng = random.Random(seed)
+    for gen in _GENS:
+        obj = gen(rng)
+        # the JSON path (the wire the hub already speaks)
+        via_json = from_wire(json.loads(json.dumps(to_wire(obj))))
+        # the binary path
+        blob = codec.encode(obj)
+        via_bin = codec.decode(blob)
+        assert via_bin == obj, f"bin1 diverged on {gen.__name__}[{seed}]"
+        assert via_json == obj, f"JSON diverged on {gen.__name__}[{seed}]"
+        assert via_bin == via_json
+
+
+@pytest.mark.fabric
+@pytest.mark.parametrize("seed", range(200))
+def test_codec_differential_tier1(seed):
+    _one_round(seed)
+
+
+@pytest.mark.fabric
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(200, 1200))
+def test_codec_differential_slow(seed):
+    _one_round(seed)
+
+
+@pytest.mark.fabric
+def test_codec_event_dicts_roundtrip():
+    """The watch wire's envelope shape (event dicts wrapping objects,
+    sync markers, keepalives) — what actually crosses the stream."""
+    rng = random.Random(7)
+    pod = _pod(rng)
+    for env in ({"type": "add", "rv": 12, "old": None, "new": pod},
+                {"type": "delete", "rv": 2**33, "kind": "pods",
+                 "old": pod, "new": None},
+                {"synced": True, "rv": 99},
+                {}):
+        assert codec.decode(codec.encode(env)) == env
+
+
+@pytest.mark.fabric
+def test_codec_wire_size_at_least_3x_smaller():
+    """The fanout smoke's wire_ratio gate, unit-sized: a representative
+    pod/node event corpus must shrink >= 3x on the binary wire."""
+    rng = random.Random(11)
+    jb = bb = 0
+    for i in range(60):
+        obj = (_pod if i % 2 else _node)(rng)
+        ev = {"type": "add", "rv": i + 1, "old": None, "new": obj}
+        jb += len(json.dumps(to_wire(ev)).encode()) + 1
+        bb += len(codec.frame(codec.encode(ev)))
+    assert jb / bb >= 3.0, f"ratio {jb / bb:.2f} < 3.0 ({jb}/{bb})"
+
+
+@pytest.mark.fabric
+def test_codec_rejects_unknown_kind_and_trailing_bytes():
+    class NotRegistered:
+        pass
+
+    with pytest.raises(TypeError):
+        codec.encode(NotRegistered())
+    with pytest.raises(ValueError):
+        codec.decode(codec.encode({"a": 1}) + b"\x00")
+
+
+@pytest.mark.fabric
+def test_codec_scalar_edge_values():
+    for v in (0, 1, 127, 128, 255, 256, 65535, 65536, 2**32 - 1, 2**32,
+              2**63 - 1, -1, -32, -33, -128, -129, -2**31, -2**63,
+              0.0, -0.5, 1e300, True, False, None,
+              [], {}, set(), b"", b"\x00\xff" * 200):
+        assert codec.decode(codec.encode(v)) == v

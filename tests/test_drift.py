@@ -182,3 +182,122 @@ def test_drift_check_interval_zero_disables():
 
 if __name__ == "__main__":
     raise SystemExit(pytest.main([__file__, "-q"]))
+
+
+# ---------------- incremental diffing (ISSUE 9 satellite) ----------------
+
+
+def test_incremental_drift_report_finds_changed_divergence():
+    """drift_report(since_rv=) compares ONLY journal-changed objects and
+    finds the same divergence classes the full diff would."""
+    hub = Hub()
+    cache = Cache()
+    for i in range(3):
+        node = MakeNode().name(f"inc-n{i}").capacity(cpu="8").obj()
+        hub.create_node(node)
+        cache.add_node(node)
+    base = cache.drift_report(hub)
+    assert base.count() == 0 and isinstance(base.rv, int)
+    # divergences that all surface as journal events after base.rv:
+    fresh = MakeNode().name("inc-new").obj()
+    hub.create_node(fresh)                       # missing from cache
+    p = _bound_pod("inc-p", "inc-n0")
+    hub.create_pod(p)                            # bound pod cache missed
+    moved = _bound_pod("inc-m", "inc-n1")
+    hub.create_pod(moved)
+    cached_moved = moved.clone()
+    cached_moved.spec.node_name = "inc-n0"
+    cache.add_pod(cached_moved)                  # cache has stale node
+    report = cache.drift_report(hub, since_rv=base.rv)
+    assert report.incremental
+    assert [n.metadata.name for n in report.nodes_missing] \
+        == ["inc-new"]
+    assert [x.metadata.name for x in report.pods_missing] == ["inc-p"]
+    assert [(c.metadata.name, h.spec.node_name)
+            for c, h in report.pods_misplaced] == [("inc-m", "inc-n1")]
+    # repair consumes the incremental report unchanged
+    repaired = cache.repair_from_hub(hub, report)
+    assert repaired == 3
+    follow = cache.drift_report(hub, since_rv=report.rv)
+    assert follow.count() == 0
+    # deletes surface too: remove the node and its pods from hub
+    hub.delete_pod(p.metadata.uid)
+    report2 = cache.drift_report(hub, since_rv=follow.rv)
+    assert [x.metadata.name for x in report2.pods_stale] == ["inc-p"]
+
+
+def test_incremental_drift_falls_back_on_compacted_gap():
+    from kubernetes_tpu.storage import RvTooOld
+
+    hub = Hub(journal_capacity=4)
+    cache = Cache()
+    base = cache.drift_report(hub)
+    for i in range(10):                   # blow past the tiny ring
+        hub.create_node(MakeNode().name(f"cp-{i}").obj())
+    with pytest.raises(RvTooOld):
+        cache.drift_report(hub, since_rv=base.rv)
+
+
+def test_steady_state_maintenance_pass_issues_zero_lists():
+    """THE regression gate: after the first full diff, a steady-state
+    drift-sentinel pass must issue ZERO cluster LIST calls — repair
+    cost is O(changes), not O(cluster)."""
+
+    from kubernetes_tpu.testing import CountingHub
+
+    hub = Hub()
+    counting = CountingHub(hub)
+    for i in range(4):
+        hub.create_node(MakeNode().name(f"zl-{i}").capacity(
+            cpu="16").obj())
+    sched = Scheduler(counting, default_config(),
+                      caps=Capacities(nodes=16, pods=64))
+    try:
+        for i in range(6):
+            hub.create_pod(MakePod().name(f"zp-{i}").req(
+                cpu="100m").obj())
+        sched.run_until_idle()
+        sched.drift_check_interval = 1e-9
+        sched._last_drift_check = 0.0
+        sched._run_drift_sentinel()               # first pass: full
+        assert counting.lists > 0
+        assert isinstance(sched._drift_rv, int)
+        counting.lists = 0
+        sched._last_drift_check = 0.0
+        sched._run_drift_sentinel()               # steady state
+        assert counting.lists == 0, \
+            "steady-state sentinel pass must not LIST the cluster"
+        assert sched.stats["drift_incremental"] == 1
+        # a change keeps it incremental: still zero LISTs
+        hub.create_pod(MakePod().name("zp-late").req(cpu="100m").obj())
+        sched.run_until_idle()
+        counting.lists = 0
+        sched._last_drift_check = 0.0
+        sched._run_drift_sentinel()
+        assert counting.lists == 0
+        assert sched.stats["drift_full_lists"] == 1
+    finally:
+        sched.close()
+        hub.close()
+
+
+def test_incremental_drift_node_recreated_same_name_is_not_stale():
+    """A node deleted and recreated under the same name (new uid)
+    between passes must NOT surface as stale: node events reduce by
+    NAME, like the cache and the full diff — a uid-keyed reduction
+    would let the old uid's delete repair a LIVE node out of the
+    cache."""
+    hub = Hub()
+    cache = Cache()
+    node = MakeNode().name("reborn").capacity(cpu="8").obj()
+    hub.create_node(node)
+    cache.add_node(node)
+    base = cache.drift_report(hub)
+    assert base.count() == 0
+    hub.delete_node(node.metadata.uid)
+    node2 = MakeNode().name("reborn").capacity(cpu="8").obj()
+    hub.create_node(node2)                 # same name, fresh uid
+    cache.remove_node(node)                # informer applied both
+    cache.add_node(node2)
+    report = cache.drift_report(hub, since_rv=base.rv)
+    assert report.count() == 0, report.render()

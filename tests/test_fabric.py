@@ -1,0 +1,613 @@
+"""Control-plane fabric (ISSUE 9): sharded hub, codec negotiation, and
+the watch relay tree.
+
+Covers the three pillars end to end: a ShardedHub behind a HubServer
+with RemoteHub clients (and a full Scheduler) behaving exactly like the
+single hub; binary-vs-JSON codec negotiation in every skew direction;
+relay nodes serving LIST/resume/live downstream from ONE upstream
+socket, with slow-subscriber eviction and a 2-level chain.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.fabric import codec as binwire
+from kubernetes_tpu.fabric.relay import RelayCore, RelayServer
+from kubernetes_tpu.fabric.sharded import ShardedHub
+from kubernetes_tpu.hub import Conflict, EventHandlers, Hub, NotFound
+from kubernetes_tpu.hubclient import RemoteHub
+from kubernetes_tpu.hubserver import HubServer
+from kubernetes_tpu.storage import RvTooOld
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+pytestmark = pytest.mark.fabric
+
+
+# ------------------------------- sharded hub -------------------------------
+
+
+def test_sharded_hub_routes_and_merges():
+    hub = ShardedHub(pod_shards=3)
+    for i in range(4):
+        hub.create_node(MakeNode().name(f"n{i}").obj())
+    pods = [MakePod().name(f"p{i}").namespace(f"ns-{i % 5}").obj()
+            for i in range(10)]
+    for p in pods:
+        hub.create_pod(p)
+    assert len(hub.list_nodes()) == 4
+    assert len(hub.list_pods()) == 10
+    # uid routing probes the right shard
+    got = hub.get_pod(pods[3].metadata.uid)
+    assert got is not None and got.metadata.name == "p3"
+    hub.bind(got, "n0")
+    assert hub.get_pod(got.metadata.uid).spec.node_name == "n0"
+    with pytest.raises(Conflict):
+        hub.bind(got, "n1")
+    hub.delete_pod(pods[4].metadata.uid)
+    with pytest.raises(NotFound):
+        hub.delete_pod(pods[4].metadata.uid)
+    assert len(hub.list_pods()) == 9
+    # namespaces land on deterministic shards: same ns, same shard
+    a = hub._pod_shard("ns-1")
+    assert a is hub._pod_shard("ns-1")
+    # commits spread across shards (5 namespaces over 3 shards)
+    js = hub.get_journal_stats()
+    pod_commits = [v["commits"] for k, v in js["shards"].items()
+                   if k.startswith("pods-")]
+    assert sum(pod_commits) == 12            # 10 adds + bind + delete
+    assert sum(1 for c in pod_commits if c) >= 2, \
+        "namespace hashing must actually spread pods over shards"
+    assert js["shards"]["nodes"]["commits"] == 4
+    assert js["rv"] == hub.current_rv == 16
+    hub.close()
+
+
+def test_sharded_hub_merged_pod_watch_and_resume():
+    hub = ShardedHub(pod_shards=3)
+    for i in range(6):
+        hub.create_pod(MakePod().name(f"w{i}")
+                       .namespace(f"ns-{i % 3}").obj())
+    seen: list[str] = []
+    h = EventHandlers(on_add=lambda o: seen.append(o.metadata.name))
+    rv = hub.watch_pods(h)
+    assert sorted(seen) == [f"w{i}" for i in range(6)]
+    assert rv == hub.current_rv
+    # live events from every shard reach the one handler
+    hub.create_pod(MakePod().name("live-a").namespace("ns-0").obj())
+    hub.create_pod(MakePod().name("live-b").namespace("ns-1").obj())
+    assert "live-a" in seen and "live-b" in seen
+    hub.unwatch(h)
+    # cross-shard resume: events after rv arrive rv-tagged, merged
+    resumed: list[int] = []
+    h2 = EventHandlers(on_event=lambda ev: resumed.append(ev.rv))
+    hub.watch_pods(h2, since_rv=rv)
+    assert len(resumed) == 2 and resumed == sorted(resumed)
+    hub.unwatch(h2)
+    # a future resume point is a revision-space reset: relist
+    with pytest.raises(RvTooOld):
+        hub.watch_pods(EventHandlers(), since_rv=hub.current_rv + 10)
+    hub.close()
+
+
+def test_sharded_hub_wal_restart(tmp_path):
+    wal_dir = str(tmp_path / "shards")
+    hub = ShardedHub(pod_shards=2, wal_dir=wal_dir)
+    hub.create_node(MakeNode().name("n1").obj())
+    pods = [MakePod().name(f"r{i}").namespace(f"ns-{i}").obj()
+            for i in range(4)]
+    for p in pods:
+        hub.create_pod(p)
+    hub.bind(pods[0], "n1")
+    rv = hub.current_rv
+    hub.close()
+    hub2 = ShardedHub(pod_shards=2, wal_dir=wal_dir)
+    assert hub2.current_rv == rv, "allocator must resume past every shard"
+    assert len(hub2.list_pods()) == 4
+    assert hub2.get_pod(pods[0].metadata.uid).spec.node_name == "n1"
+    assert hub2.get_node("n1") is not None
+    # the revision space continues, not restarts
+    hub2.create_pod(MakePod().name("post").obj())
+    assert hub2.current_rv == rv + 1
+    hub2.close()
+
+
+def test_sharded_hub_fencing_is_hub_wide():
+    from kubernetes_tpu.hub import Fenced
+
+    hub = ShardedHub(pod_shards=2)
+    pod = MakePod().name("fence").namespace("a").obj()
+    hub.create_pod(pod)
+    # acquire epoch 1 then depose it with a new holder (epoch 2)
+    from kubernetes_tpu.leaderelection import Lease
+
+    hub.leases.update(Lease(name="kube-scheduler", holder_identity="x",
+                            renew_time=1.0, acquire_time=1.0), None)
+    hub.leases.update(Lease(name="kube-scheduler", holder_identity="y",
+                            renew_time=2.0, acquire_time=2.0), "x")
+    with pytest.raises(Fenced):
+        hub.bind(pod, "n1", epoch=1)
+    with pytest.raises(Fenced):
+        hub.delete_pod(pod.metadata.uid, epoch=1)
+    hub.bind(pod, "n1", epoch=hub.leases.epoch_of("kube-scheduler"))
+    hub.close()
+
+
+def test_scheduler_schedules_against_sharded_hub_over_wire():
+    """The tentpole's API-preservation claim: HubServer(ShardedHub()) +
+    RemoteHub + a full Scheduler, pods across namespaces (hence
+    shards), all bound."""
+    from kubernetes_tpu.config.types import default_config
+    from kubernetes_tpu.ops.features import Capacities
+    from kubernetes_tpu.scheduler import Scheduler
+
+    hub = ShardedHub(pod_shards=3)
+    server = HubServer(hub).start()
+    client = RemoteHub(server.address)
+    try:
+        for i in range(4):
+            client.create_node(MakeNode().name(f"sn-{i}").obj())
+        cfg = default_config()
+        cfg.batch_size = 8
+        sched = Scheduler(client, cfg, caps=Capacities(nodes=16,
+                                                       pods=64))
+        pods = [MakePod().name(f"sp-{i}").namespace(f"ns-{i % 3}")
+                .req(cpu="500m").obj() for i in range(9)]
+        for p in pods:
+            client.create_pod(p)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            sched.run_until_idle()
+            if all(hub.get_pod(p.metadata.uid).spec.node_name
+                   for p in pods):
+                break
+            time.sleep(0.05)
+        assert all(hub.get_pod(p.metadata.uid).spec.node_name
+                   for p in pods), "every pod binds through the router"
+        # the wire negotiated the binary codec for the hot path
+        assert client.codec == binwire.CODEC_BINARY
+        sched.close()
+    finally:
+        client.close()
+        server.stop()
+        hub.close()
+
+
+# ---------------------------- codec negotiation ----------------------------
+
+
+def _wire_msgs(client: RemoteHub, codec_name: str) -> int:
+    return client.resilience_stats()["wire"][codec_name]["msgs"]
+
+
+def test_negotiation_binary_both_ends():
+    hub = Hub()
+    server = HubServer(hub).start()
+    client = RemoteHub(server.address)
+    try:
+        client.create_node(MakeNode().name("b1").obj())
+        assert client.codec == binwire.CODEC_BINARY
+        assert client.get_node("b1").metadata.name == "b1"
+        assert _wire_msgs(client, binwire.CODEC_BINARY) > 0
+        # watches ride the binary frames too
+        seen = []
+        client.watch_nodes(EventHandlers(
+            on_add=lambda o: seen.append(o.metadata.name)))
+        assert seen == ["b1"]
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_negotiation_binary_client_json_only_server():
+    """An old (JSON-only) server: the offer is ignored, the client pins
+    JSON, everything works — version skew degrades, never breaks."""
+    hub = Hub()
+    server = HubServer(hub, codecs=(binwire.CODEC_JSON,)).start()
+    client = RemoteHub(server.address)
+    try:
+        client.create_node(MakeNode().name("j1").obj())
+        assert client.codec == binwire.CODEC_JSON
+        assert _wire_msgs(client, binwire.CODEC_BINARY) == 0
+        seen = []
+        client.watch_nodes(EventHandlers(
+            on_add=lambda o: seen.append(o.metadata.name)))
+        assert seen == ["j1"]
+        hub.create_node(MakeNode().name("j2").obj())
+        deadline = time.time() + 5
+        while "j2" not in seen and time.time() < deadline:
+            time.sleep(0.02)
+        assert "j2" in seen
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_negotiation_json_client_binary_server():
+    """A JSON-pinned client against a binary-capable server: no offer,
+    JSON responses."""
+    hub = Hub()
+    server = HubServer(hub).start()
+    client = RemoteHub(server.address, codec=binwire.CODEC_JSON)
+    try:
+        client.create_pod(MakePod().name("jj").obj())
+        assert client.codec == binwire.CODEC_JSON
+        assert client.get_pod(
+            client.list_pods()[0].metadata.uid) is not None
+        assert _wire_msgs(client, binwire.CODEC_BINARY) == 0
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_binary_errors_still_map_to_typed_exceptions():
+    hub = Hub()
+    server = HubServer(hub).start()
+    client = RemoteHub(server.address)
+    try:
+        pod = MakePod().name("e1").obj()
+        client.create_pod(pod)
+        assert client.codec == binwire.CODEC_BINARY
+        with pytest.raises(Conflict):
+            client.create_pod(pod)
+        with pytest.raises(NotFound):
+            client.delete_pod("nope")
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_multiplexed_watch_one_socket_counts_once():
+    """watch_kinds: several kinds on ONE connection; a server restart
+    is ONE resume/relist in resilience_stats, not one per kind (the
+    satellite fix)."""
+    import socket
+
+    hub = Hub()
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    server = HubServer(hub, port=port).start()
+    client = RemoteHub(f"http://127.0.0.1:{port}", timeout=10.0,
+                       retry_base=0.01, retry_cap=0.2)
+    try:
+        hub.create_node(MakeNode().name("m-n").obj())
+        hub.create_pod(MakePod().name("m-p").obj())
+        added = {"pods": [], "nodes": []}
+        client.watch_kinds({
+            "pods": EventHandlers(
+                on_add=lambda o: added["pods"].append(o.metadata.name)),
+            "nodes": EventHandlers(
+                on_add=lambda o: added["nodes"].append(
+                    o.metadata.name))})
+        assert added == {"pods": ["m-p"], "nodes": ["m-n"]}
+        # one connection only
+        assert len(client._watchers) == 1
+        server.stop()
+        hub.create_pod(MakePod().name("m-p2").obj())
+        hub.create_node(MakeNode().name("m-n2").obj())
+        server2 = HubServer(hub, port=port).start()
+        deadline = time.time() + 15
+        while time.time() < deadline and (
+                "m-p2" not in added["pods"]
+                or "m-n2" not in added["nodes"]):
+            time.sleep(0.05)
+        assert "m-p2" in added["pods"] and "m-n2" in added["nodes"]
+        stats = client.resilience_stats()
+        assert stats["watch_reconnects"] == 1, \
+            "one cut of a multiplexed socket must count ONCE"
+        assert stats["watch_resumes"] + stats["watch_relists"] == 1
+        assert len(client._watchers) == 1, "stale handles must prune"
+        server2.stop()
+    finally:
+        client.close()
+
+
+# ------------------------------- relay tree -------------------------------
+
+
+@pytest.fixture()
+def relayed_hub():
+    hub = Hub()
+    server = HubServer(hub).start()
+    core = RelayCore(server.address, kinds=("pods",), ring_capacity=256)
+    relay = RelayServer(core).start()
+    yield hub, server, core, relay
+    relay.stop()
+    server.stop()
+    hub.close()
+
+
+def test_relay_serves_list_resume_and_live(relayed_hub):
+    hub, server, core, relay = relayed_hub
+    p0 = MakePod().name("r0").obj()
+    hub.create_pod(p0)
+    # downstream reflector through the relay's HTTP face
+    client = RemoteHub(relay.address)
+    try:
+        added, deleted = [], []
+        client.watch_pods(EventHandlers(
+            on_add=lambda o: added.append(o.metadata.name),
+            on_delete=lambda o: deleted.append(o.metadata.name)))
+        assert added == ["r0"], "relay must serve the LIST itself"
+        hub.create_pod(MakePod().name("r1").obj())
+        hub.delete_pod(p0.metadata.uid)
+        deadline = time.time() + 10
+        while time.time() < deadline and ("r1" not in added
+                                          or "r0" not in deleted):
+            time.sleep(0.02)
+        assert "r1" in added and deleted == ["r0"]
+        # writes pass through the relay to the hub
+        client.create_node(MakeNode().name("via-relay").obj())
+        assert hub.get_node("via-relay") is not None
+        # the hub carries ONE pod watcher (the relay), not one per client
+        assert len(hub._pods.handlers) == 1
+    finally:
+        client.close()
+
+
+def test_relay_downstream_resume_from_ring(relayed_hub):
+    hub, server, core, relay = relayed_hub
+    for i in range(3):
+        hub.create_pod(MakePod().name(f"ring-{i}").obj())
+    deadline = time.time() + 10
+    while core.last_rv < hub.current_rv and time.time() < deadline:
+        time.sleep(0.02)                  # relay catches up upstream
+    sub = core.subscribe(("pods",))
+    backlog = sub.drain()
+    assert len(backlog) == 3
+    cursor = sub.cursor
+    core.unsubscribe(sub)
+    hub.create_pod(MakePod().name("gap").obj())
+    deadline = time.time() + 5
+    while core.last_rv <= cursor and time.time() < deadline:
+        time.sleep(0.02)
+    sub2 = core.subscribe(("pods",), since_rv=cursor)
+    got = [d["new"].metadata.name for d in sub2.drain()]
+    assert got == ["gap"], "resume must replay exactly the gap"
+    assert core.resume_serves == 1
+    # a cursor below the ring FLOOR answers RvTooOld -> caller relists.
+    # A relay that syncs via LIST cannot serve resumes from before its
+    # sync revision (LIST replay is not rv-ordered), so a fresh core's
+    # floor is the hub's current revision
+    late = RelayCore(server.address, kinds=("pods",), ring_capacity=256)
+    try:
+        with pytest.raises(RvTooOld):
+            late.subscribe(("pods",), since_rv=0)
+        # ...and the relist it forces is served from the state mirror
+        relisted = late.subscribe(("pods",))
+        assert len(relisted.drain()) == 4     # ring-0/1/2 + gap live
+    finally:
+        late.close()
+
+
+def test_relay_slow_subscriber_evicted_not_wedged(relayed_hub):
+    hub, server, core, relay = relayed_hub
+    slow = core.subscribe(("pods",), queue_limit=2)
+    fast = core.subscribe(("pods",), queue_limit=1000)
+    for i in range(6):
+        hub.create_pod(MakePod().name(f"flood-{i}").obj())
+    deadline = time.time() + 10
+    while not slow.evicted and time.time() < deadline:
+        time.sleep(0.02)
+    assert slow.evicted, "a consumer that stops draining must be cut"
+    assert core.slow_evictions == 1
+    # the fast sibling saw everything; backpressure never spread
+    deadline = time.time() + 10
+    while time.time() < deadline and \
+            sum(1 for _ in fast.queue) < 6:
+        time.sleep(0.02)
+    assert len(fast.drain()) == 6
+    # the evicted consumer reconnects and resumes where it stood
+    back = core.subscribe(("pods",), since_rv=slow.cursor)
+    assert len(back.drain()) >= 4, "the missed flood resumes in"
+
+
+def test_relay_chain_two_levels(relayed_hub):
+    hub, server, core, relay = relayed_hub
+    l2 = RelayCore(relay.address, kinds=("pods",), ring_capacity=256)
+    try:
+        hub.create_pod(MakePod().name("deep").obj())
+        sub = l2.subscribe(("pods",))
+        deadline = time.time() + 10
+        names = []
+        while time.time() < deadline and "deep" not in names:
+            sub.event.wait(0.1)
+            names += [d["new"].metadata.name for d in sub.drain()
+                      if d["new"] is not None]
+        hub.create_pod(MakePod().name("deep2").obj())
+        deadline = time.time() + 10
+        while time.time() < deadline and "deep2" not in names:
+            sub.event.wait(0.1)
+            names += [d["new"].metadata.name for d in sub.drain()
+                      if d["new"] is not None]
+        assert "deep" in names and "deep2" in names
+        # one upstream socket per level: hub sees the L1 relay only
+        assert len(hub._pods.handlers) == 1
+    finally:
+        l2.close()
+
+
+def test_relay_debug_fabric_authz():
+    from kubernetes_tpu.serving import token_auth
+
+    hub = Hub()
+    server = HubServer(hub).start()
+    core = RelayCore(server.address, kinds=("pods",))
+    relay = RelayServer(core, debug_auth=token_auth("s3cret")).start()
+    try:
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        url = relay.address + "/debug/fabric"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url)
+        assert ei.value.code == 401
+        req = urllib.request.Request(
+            url, headers={"Authorization": "Bearer s3cret"})
+        with urllib.request.urlopen(req) as resp:
+            payload = _json.loads(resp.read())
+        assert payload["upstream"] == server.address
+        assert payload["kinds"] == ["pods"]
+        assert "subscriber_cursors" in payload
+    finally:
+        relay.stop()
+        server.stop()
+
+
+def test_scheduler_debug_fabric_surface():
+    """Authz-gated /debug/fabric on the scheduler's serving endpoints:
+    shard map + per-shard journal state for a sharded hub."""
+    import json as _json
+    import urllib.request
+
+    from kubernetes_tpu.config.types import default_config
+    from kubernetes_tpu.ops.features import Capacities
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.serving import ServingEndpoints, token_auth
+
+    hub = ShardedHub(pod_shards=2)
+    hub.create_node(MakeNode().name("dbg-n").obj())
+    sched = Scheduler(hub, default_config(),
+                      caps=Capacities(nodes=8, pods=32))
+    serving = ServingEndpoints(sched, debug_auth=token_auth("tok"))
+    serving.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{serving.port}/debug/fabric",
+            headers={"Authorization": "Bearer tok"})
+        with urllib.request.urlopen(req) as resp:
+            payload = _json.loads(resp.read())
+        assert payload["shard_map"]["nodes"] == "nodes"
+        assert payload["shard_map"]["pods"] == ["pods-0", "pods-1"]
+        assert "nodes" in payload["shards"]
+    finally:
+        serving.stop()
+        sched.close()
+        hub.close()
+
+
+def test_fanout_smoke_small():
+    """The --fanout-smoke battery at unit scale: every gate (resume-
+    only reconnects, exact fan-out counts, socket accounting, eviction,
+    wire ratio, drift zero-LIST) on 150 subscribers."""
+    from kubernetes_tpu.fabric.fanout import run_fanout_smoke
+
+    r = run_fanout_smoke(subscribers=150, l1_count=2, l2_count=3,
+                         pods=25, churn=30, cuts=3, resub=30,
+                         timeout_s=120)
+    assert r["ok"], r
+    assert r["upstream_relists"] == 0
+    assert r["event_count_min"] == r["event_count_max"] \
+        == r["pod_events"]
+    assert r["hub_pod_watchers"] <= 2
+    assert r["wire_ratio"] >= 3.0
+    assert r["drift"]["steady_lists"] == 0
+
+
+def test_sharded_list_changes_rv_precedes_mid_scan_commits():
+    """The merged incremental LIST advertises a consistency rv read
+    BEFORE the shard scan: a commit landing on an already-scanned shard
+    mid-merge must be re-examined by the next resume, never skipped."""
+    hub = ShardedHub(pod_shards=2)
+    hub.create_pod(MakePod().name("pre").namespace("a").obj())
+    base = hub.list_changes(0, ("pods",))
+    # interleave: while one shard is being scanned, commit to a shard
+    # the router may already have passed
+    victim = hub._pod_shards[1]
+    orig = victim.list_changes
+    sneaky = MakePod().name("sneak").namespace("z").obj()
+
+    def racing(since_rv, kinds=("pods", "nodes")):
+        hub.create_pod(sneaky)             # lands on SOME shard now
+        return orig(since_rv, kinds)
+
+    victim.list_changes = racing
+    res = hub.list_changes(base["rv"], ("pods",))
+    victim.list_changes = orig
+    assert not res["too_old"]
+    missed = [c for c in res["changes"]
+              if c["obj"].metadata.name == "sneak"]
+    if not missed:
+        # the racer's event is absent from this answer: the advertised
+        # rv must leave it visible to the NEXT resume
+        follow = hub.list_changes(res["rv"], ("pods",))
+        assert any(c["obj"].metadata.name == "sneak"
+                   for c in follow["changes"]), \
+            "a mid-scan commit must never vanish between resumes"
+    hub.close()
+
+
+def test_relay_ring_suspect_during_upstream_relist_window():
+    """While an upstream RELIST is replaying (LIST order, not rv
+    order), the relay must refuse ring resumes (RvTooOld -> state-
+    mirror relist) instead of serving a suffix with holes; the sync
+    marker resets the ring and resumes work again."""
+    from kubernetes_tpu.storage import JournalEvent
+
+    hub = Hub()
+    server = HubServer(hub).start()
+    core = RelayCore(server.address, kinds=("pods",), ring_capacity=64)
+    try:
+        on_event = core._make_on_event("pods")
+        p5 = MakePod().name("p5").obj()
+        p3 = MakePod().name("p3").obj()
+        p5.metadata.resource_version = 5
+        p3.metadata.resource_version = 3
+        on_event(JournalEvent(rv=5, kind="pods", type="add", new=p5))
+        on_event(JournalEvent(rv=3, kind="pods", type="add", new=p3))
+        assert core._ring_suspect, "out-of-order rv = relist in flight"
+        with pytest.raises(RvTooOld):
+            core.subscribe(("pods",), since_rv=5)
+        core._on_sync(6, relisted=True)
+        assert not core._ring_suspect
+        # resumes from the new floor serve again; below it, 410
+        sub = core.subscribe(("pods",), since_rv=6)
+        assert sub.drain() == []
+        with pytest.raises(RvTooOld):
+            core.subscribe(("pods",), since_rv=5)
+    finally:
+        core.close()
+        server.stop()
+
+
+def test_sharded_journal_stats_merge_sums_hashed_kind():
+    hub = ShardedHub(pod_shards=3)
+    for i in range(9):
+        hub.create_pod(MakePod().name(f"js-{i}")
+                       .namespace(f"ns-{i}").obj())
+    js = hub.get_journal_stats()
+    # the merged per-kind view must SUM depth across the hashed shards
+    # (dict.update would report only the last shard's slice)
+    assert js["kinds"]["pods"]["depth"] == 9
+    assert js["kinds"]["pods"]["last_rv"] == hub.current_rv
+    hub.close()
+
+
+def test_incremental_drift_falls_back_on_pre_fabric_peer():
+    """A remote hub without list_changes answers the /call wire's 400
+    ValueError — the comparer must translate that to RvTooOld (full-
+    diff fallback), not crash the maintenance loop."""
+    from kubernetes_tpu.backend.cache import Cache
+
+    class PreFabricHub:
+        def list_changes(self, since_rv, kinds=()):
+            raise ValueError("unknown method 'list_changes'")
+
+    cache = Cache()
+    with pytest.raises(RvTooOld):
+        cache.drift_report(PreFabricHub(), since_rv=7)
+
+
+def test_sharded_wal_dir_rejects_existing_file(tmp_path):
+    """Upgrading a single-hub deployment's --wal FILE to --hub-shards
+    must fail with a clear verdict, not makedirs' FileExistsError."""
+    wal_file = tmp_path / "hub.wal"
+    wal_file.write_text("{}\n")
+    with pytest.raises(ValueError, match="WAL directory"):
+        ShardedHub(pod_shards=2, wal_dir=str(wal_file))
