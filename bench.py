@@ -140,6 +140,7 @@ PROFILE_WORKLOAD_FNS = (
     "mixed_churn",
     "dra_steady_state",
     "dra_steady_state_templates",
+    "multi_tenant_gang_storm",
 )
 
 # the always-on recorder's cost ceiling: what makes "every cycle, every
@@ -375,6 +376,47 @@ def run_profile(smoke: bool = False) -> dict:
                 print(f"  {key:<34} {p['p50_ms']:>9.3f} "
                       f"{p['p99_ms']:>9.3f} {p['total_s']:>9.3f}",
                       file=sys.stderr)
+        dev = fl.get("device")
+        if dev:
+            # the DeviceProfiler column: compiles by attributed cause +
+            # resident HBM footprint — the "why does the device path
+            # stall" answer next to the phase table
+            causes = ", ".join(f"{k}={v}" for k, v in
+                               sorted(dev["compile_causes"].items()))
+            print(f"  device: {dev['launches']} launches, "
+                  f"{dev['compiles']} compiles ({causes or 'none'}), "
+                  f"{len(dev['shapes'])} shapes, "
+                  f"{dev['buffer_total_mib']} MiB resident",
+                  file=sys.stderr)
+    # the fabric row: fanout smoke (small variant) — e2e joined-trace
+    # SLO (created->acked p99) + fleet health next to the host tails
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "kubernetes_tpu.fabric.fanout",
+             "--smoke"],
+            capture_output=True, text=True, timeout=600, env=env,
+            cwd=_repo)
+        if proc.returncode == 0 and proc.stdout.strip():
+            fr = json.loads(proc.stdout.strip().splitlines()[-1])
+            out["FanoutSmoke"] = {
+                "name": "FanoutSmoke",
+                "e2e": fr.get("e2e"),
+                "events_traced_frac": fr.get("events_traced_frac"),
+                "ok": fr.get("ok"),
+            }
+            e2e = fr.get("e2e", {})
+            lat = e2e.get("created_to_acked", {})
+            print(f"\nFanoutSmoke: created->acked p99 "
+                  f"{lat.get('p99_s', '?')}s over {lat.get('count', 0)} "
+                  f"pods, joinable {e2e.get('joinable_frac', 0):.0%}, "
+                  f"fleet {e2e.get('fleet', {}).get('healthy', 0)}/"
+                  f"{e2e.get('fleet', {}).get('endpoints', 0)} healthy",
+                  file=sys.stderr)
+        else:
+            print(f"fanout smoke (profile row): FAILED\n"
+                  f"{proc.stderr[-1500:]}", file=sys.stderr)
+    except subprocess.TimeoutExpired:
+        print("fanout smoke (profile row): TIMEOUT", file=sys.stderr)
     return {
         "metric": "phase_profile",
         "unit": "ms",

@@ -54,6 +54,12 @@ def main(argv=None) -> int:
                         help="leader election identity")
     parser.add_argument("--feature-gates", default="",
                         help="comma-separated gate=bool overrides")
+    parser.add_argument("--fleet-endpoint", action="append", default=[],
+                        metavar="COMPONENT[/SHARD]=URL",
+                        help="register a fabric component with the "
+                             "fleet collector (repeatable); serves the "
+                             "merged exposition at /metrics/fleet and "
+                             "the health summary at /debug/fleet")
     parser.add_argument("--validate-only", action="store_true",
                         help="load + validate the config, then exit")
     args = parser.parse_args(argv)
@@ -109,6 +115,23 @@ def main(argv=None) -> int:
             print(f"hub journal WAL at {args.wal} "
                   f"(replayed rv={hub.current_rv})", file=sys.stderr)
     sched = Scheduler(hub, cfg)
+
+    if args.fleet_endpoint:
+        from kubernetes_tpu.telemetry.fleet import FleetView
+
+        endpoints = []
+        for spec in args.fleet_endpoint:
+            name, _, url = spec.partition("=")
+            if not url:
+                print(f"bad --fleet-endpoint {spec!r} (want "
+                      "COMPONENT[/SHARD]=URL)", file=sys.stderr)
+                return 1
+            component, _, shard = name.partition("/")
+            endpoints.append({"component": component, "shard": shard,
+                              "url": url})
+        sched.fleet = FleetView(endpoints)
+        print(f"fleet view over {len(endpoints)} endpoints "
+              "(/metrics/fleet, /debug/fleet)", file=sys.stderr)
 
     serving = None
     if args.secure_port:

@@ -97,6 +97,107 @@ def _drift_steady_state(nodes: int = 16, pods: int = 32) -> dict:
         hub.close()
 
 
+def _e2e_traced_pipeline(hub, relay_url: str, server_address: str,
+                         l1_servers, nodes: int = 16, pods: int = 48,
+                         timeout_s: float = 90.0) -> dict:
+    """The end-to-end SLO phase (ISSUE-10): a scheduler against the
+    hub, hollow kubelets whose pod WATCHES ride the relay tree, and a
+    per-pod joined timeline — hub commit (created) -> relay hop
+    (kubelet_recv carries the hop count) -> scheduler cycle -> bind
+    commit (bound) -> kubelet ack commit (acked). Gates: every pod
+    binds, >= 99% of bound pods have a COMPLETE joined trace including
+    the relay leg, and the run reports a created->acked p99.
+
+    Also scrapes the fleet while every component is alive: FleetView
+    over the hub server, each L1 relay, and the kubemark feeder — all
+    healthy, and the merged exposition re-parses strictly."""
+    from kubernetes_tpu.config.types import default_config
+    from kubernetes_tpu.hubclient import RemoteHub
+    from kubernetes_tpu.kubemark import HollowNodes
+    from kubernetes_tpu.ops.features import Capacities
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.telemetry.fleet import FleetView
+    from kubernetes_tpu.telemetry.trace import latency_summary
+    from kubernetes_tpu.testing import MakePod
+
+    prof_name = "e2e-sched"      # leave the storm's fan/churn pods alone
+    cfg = default_config()
+    cfg.profiles[0].scheduler_name = prof_name
+    watch_client = RemoteHub(relay_url, timeout=10.0)
+    hollow = HollowNodes(hub, nodes, prefix="e2e", cpu="32",
+                         watch_hub=watch_client)
+    sched = Scheduler(hub, cfg,
+                      caps=Capacities(nodes=64, pods=256))
+    created: list[str] = []
+    try:
+        for i in range(pods):
+            p = MakePod().name(f"e2e-{i}").namespace("e2e") \
+                .scheduler_name(prof_name).req(cpu="100m").obj()
+            hub.create_pod(p)
+            created.append(p.metadata.uid)
+
+        def complete() -> int:
+            return sum(1 for uid in created
+                       if sched.timelines.joined(uid) is not None)
+
+        deadline = time.monotonic() + timeout_s
+        while complete() < pods and time.monotonic() < deadline:
+            sched.run_until_idle()
+            time.sleep(0.05)
+        joins = [j for j in (sched.timelines.joined(uid)
+                             for uid in created) if j is not None]
+        bound = sum(1 for uid in created
+                    if (hub.get_pod(uid) is not None
+                        and hub.get_pod(uid).spec.node_name))
+        with_relay_leg = sum(1 for j in joins
+                             if "bind_to_kubelet_s" in j)
+        lat = latency_summary([j["create_to_ack_s"] for j in joins])
+        out = {
+            "pods": pods, "bound": bound,
+            "joinable": len(joins),
+            "joinable_frac": round(len(joins) / max(bound, 1), 4),
+            "relay_leg_frac": round(with_relay_leg / max(bound, 1), 4),
+            "relay_hops_max": max((j["relay_hops"] for j in joins),
+                                  default=0),
+            "created_to_acked": lat,
+            "ok": (bound == pods
+                   and len(joins) >= 0.99 * bound
+                   and with_relay_leg >= 0.99 * bound
+                   and lat.get("p99_s") is not None),
+        }
+
+        # fleet aggregation, scraped while everything is alive
+        feeder_ep = hollow.serve_metrics()
+        endpoints = [{"component": "hub", "shard": "hub",
+                      "url": server_address}]
+        endpoints += [{"component": "relay", "shard": f"l1-{i}",
+                       "url": s.address}
+                      for i, s in enumerate(l1_servers)]
+        endpoints.append({"component": "kubemark", "shard": "feeder",
+                          "url": feeder_ep.address})
+        fleet = FleetView(endpoints)
+        records = fleet.scrape()        # ONE round of HTTP round-trips
+        summary = fleet.summary(records)
+        merged = fleet.render_text(records)
+        from kubernetes_tpu.telemetry.fleet import parse_exposition
+
+        merged_exp = parse_exposition(merged)   # strict: raises on rot
+        labeled = all("component" in s.labels
+                      for s in merged_exp.samples)
+        out["fleet"] = {
+            "endpoints": summary["total"],
+            "healthy": summary["healthy"],
+            "merged_samples": len(merged_exp.samples),
+            "ok": summary["ok"] and labeled
+            and len(merged_exp.samples) > 0,
+        }
+        return out
+    finally:
+        sched.close()
+        hollow.stop()
+        watch_client.close()
+
+
 def run_fanout_smoke(subscribers: int = 10000, l1_count: int = 2,
                      l2_count: int = 8, pods: int = 120,
                      churn: int = 60, cuts: int = 10,
@@ -221,11 +322,25 @@ def run_fanout_smoke(subscribers: int = 10000, l1_count: int = 2,
         report["pod_events"] = expected
         # exact-count check on the never-reconnected subscribers: a
         # relay tree that drops or duplicates would show here
-        counts = [len(s.drain())
-                  for i, s in enumerate(subs) if i not in resubbed]
+        drained = [s.drain() for i, s in enumerate(subs)
+                   if i not in resubbed]
+        counts = [len(evs) for evs in drained]
         report["event_count_min"] = min(counts)
         report["event_count_max"] = max(counts)
         exact = min(counts) == max(counts) == expected
+        # trace propagation: every live event reaching an L2 subscriber
+        # crossed exactly two relay hops, stamp intact (chaos proxy on
+        # the upstream leg strips the CODEC, never the in-body trace)
+        total_evs = traced = 0
+        for evs in drained:
+            for d in evs:
+                total_evs += 1
+                tr = d.get("trace")
+                if tr is not None and tr.hops == 2 \
+                        and tr.origin == "hub" and tr.ts > 0:
+                    traced += 1
+        report["events_traced_frac"] = round(
+            traced / max(total_evs, 1), 4)
         report["fanout_elapsed_s"] = round(time.monotonic() - t0, 2)
 
         # ---- phase 5: slow-subscriber eviction ----
@@ -275,11 +390,21 @@ def run_fanout_smoke(subscribers: int = 10000, l1_count: int = 2,
         # ---- phase 8: drift sentinel steady state ----
         report["drift"] = _drift_steady_state()
 
+        # ---- phase 9: e2e joined-trace SLO + fleet aggregation ----
+        # scheduler + hollow kubelets (watching through the relay tree)
+        # over the SAME storm-worn fabric: >= 99% of bound pods must
+        # join a complete created -> bound -> acked trace with the
+        # relay leg measured, and every component's /metrics + /healthz
+        # must merge into one healthy fleet exposition
+        report["e2e"] = _e2e_traced_pipeline(
+            hub, l1_servers[0].address, server.address, l1_servers)
+
         report["ok"] = bool(
             report["upstream_resumes"] >= cuts
             and report["upstream_relists"] == 0
             and lagging == 0
             and exact
+            and report["events_traced_frac"] >= 0.99
             and report["resub_ring_410s"] == 0
             and report["relay_resume_serves"] >= len(resubbed)
             and report["slow_evicted"]
@@ -287,7 +412,9 @@ def run_fanout_smoke(subscribers: int = 10000, l1_count: int = 2,
             and report["evicted_recovered"]
             and report["hub_pod_watchers"] <= l1_count
             and report["wire_ratio"] >= 3.0
-            and report["drift"]["ok"])
+            and report["drift"]["ok"]
+            and report["e2e"]["ok"]
+            and report["e2e"]["fleet"]["ok"])
     finally:
         for c in l2_cores:
             c.close()

@@ -141,8 +141,14 @@ class RelayCore:
 
     def _make_on_event(self, kind: str):
         def on_event(ev: JournalEvent) -> None:
+            # trace propagation: this relay is one hop — every event
+            # fans out (and journals) with the stamp's hop count bumped,
+            # so a downstream consumer sees how many relays its copy
+            # crossed. An unstamped event (pre-telemetry upstream, LIST
+            # replay) stays unstamped: hop data degrades, events flow.
+            trace = ev.trace.hop() if ev.trace is not None else None
             d = {"type": ev.type, "rv": ev.rv, "kind": kind,
-                 "old": ev.old, "new": ev.new}
+                 "old": ev.old, "new": ev.new, "trace": trace}
             with self._lock:
                 state = self._state[kind]
                 if ev.type == "delete":
@@ -152,7 +158,7 @@ class RelayCore:
                 if ev.rv > self._ring_rv:
                     self._journal.append(JournalEvent(
                         rv=ev.rv, kind=kind, type=ev.type,
-                        old=ev.old, new=ev.new))
+                        old=ev.old, new=ev.new, trace=trace))
                     self._ring_rv = ev.rv
                 else:
                     # LIST-ordered arrival (upstream relist replay):
@@ -240,14 +246,17 @@ class RelayCore:
                 for ev in evs:
                     sub.queue.append({"type": ev.type, "rv": ev.rv,
                                       "kind": ev.kind, "old": ev.old,
-                                      "new": ev.new})
+                                      "new": ev.new, "trace": ev.trace})
                 self.resume_serves += 1
             elif replay:
+                # state-mirror LIST replay: objects, not events — the
+                # commit stamps are gone, so these carry trace=None
+                # (the documented degradation; nothing is withheld)
                 for kind in kinds:
                     for rv, obj in self._state[kind].values():
                         sub.queue.append({"type": "add", "rv": rv,
                                           "kind": kind, "old": None,
-                                          "new": obj})
+                                          "new": obj, "trace": None})
                 self.relist_serves += 1
             for kind in kinds:
                 self._subs[kind].append(sub)
@@ -386,6 +395,19 @@ class _RelayHandler(BaseHTTPRequestHandler):
 
         path = urlparse(self.path)
         q = parse_qs(path.query)
+        if path.path in ("/healthz", "/livez"):
+            # fleet health: relays answer like every fabric component,
+            # 503 until the upstream reflector has synced once
+            if self.core._synced.is_set():
+                self._send_text(200, "ok")
+            else:
+                self._send_text(503, "upstream not synced")
+            return
+        if path.path == "/metrics":
+            from kubernetes_tpu.telemetry.fleet import relay_metrics_text
+
+            self._send_text(200, relay_metrics_text(self.core))
+            return
         if path.path == "/debug/fabric":
             auth = self.server.debug_auth     # type: ignore[attr-defined]
             if auth is None:
@@ -433,7 +455,7 @@ class _RelayHandler(BaseHTTPRequestHandler):
         def write_all(ds: list[dict]) -> None:
             for d in ds:
                 write_event(d["kind"], d["type"], d["rv"],
-                            d["old"], d["new"])
+                            d["old"], d["new"], d.get("trace"))
 
         try:
             write_all(sub.drain())        # the subscribe-time backlog

@@ -73,6 +73,9 @@ class _ShardHub(Hub):
     def __init__(self, name: str, alloc: _RvAllocator,
                  journal_capacity: int, wal_path: str | None):
         self.shard_name = name
+        # trace stamps name the committing shard, so a joined timeline
+        # attributes each commit to its shard without a lookup
+        self.origin = name
         self._alloc = alloc
         self.commits = 0
         super().__init__(journal_capacity=journal_capacity,

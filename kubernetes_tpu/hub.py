@@ -45,6 +45,7 @@ from kubernetes_tpu.api.objects import (
     StorageClass,
 )
 from kubernetes_tpu.storage import Journal, JournalEvent, RvTooOld  # noqa: F401  (re-exported: transport + tests import RvTooOld from here)
+from kubernetes_tpu.telemetry.trace import new_context
 
 
 @dataclass
@@ -137,6 +138,10 @@ class _Store:
 
 
 class Hub:
+    # the commit trace stamp's origin component; fabric shards override
+    # with their shard name (telemetry.trace.TraceContext.origin)
+    origin = "hub"
+
     def __init__(self, journal_capacity: int = 16384,
                  wal_path: str | None = None) -> None:
         self._lock = threading.RLock()
@@ -207,12 +212,16 @@ class Hub:
         """Stamp one revision, journal the event (WAL included). Caller
         holds the lock and has already mutated ``store.objects`` — the
         journal append must land before any later revision is stamped,
-        so ring suffixes stay complete per kind."""
+        so ring suffixes stay complete per kind. Every commit also gets
+        a telemetry trace stamp (origin component + commit timestamp +
+        hop count 0) that rides the event across the wire and relay
+        tree (telemetry.trace)."""
         rv = self._next_rv()
         if new is not None:
             new.metadata.resource_version = rv
         ev = JournalEvent(rv=rv, kind=store.watch_kind, type=etype,
-                          old=old, new=new)
+                          old=old, new=new,
+                          trace=new_context(self.origin))
         self.journal.append(ev)
         return ev
 

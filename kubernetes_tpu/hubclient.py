@@ -378,10 +378,10 @@ class RemoteHub:
                 last_rv[0] = rv
 
         def deliver(h: EventHandlers, etype: str, rv: int, kind: str,
-                    old, new) -> None:
+                    old, new, trace=None) -> None:
             if h.on_event is not None:
                 h.on_event(JournalEvent(rv=rv, kind=kind, type=etype,
-                                        old=old, new=new))
+                                        old=old, new=new, trace=trace))
             elif etype == "delete":
                 if h.on_delete:
                     h.on_delete(old)
@@ -399,12 +399,18 @@ class RemoteHub:
                 return                      # unknown kind on the stream
             h = handlers[kind]
             etype = ev.get("type")
+            # the commit's trace stamp: already a TraceContext on the
+            # binary wire, a tagged dict on JSON; absent from a
+            # pre-telemetry peer (hop data degrades, events never drop)
+            trace = ev.get("trace")
+            if isinstance(trace, dict):
+                trace = from_wire(trace)
             if etype == "delete":
                 old = from_wire(ev.get("old"))
                 uid = old.metadata.uid
                 if state.pop(uid, None) is not None and not suppress:
                     deliver(h, "delete", ev.get("rv") or 0, kind,
-                            old, None)
+                            old, None, trace)
                 return
             new = from_wire(ev.get("new"))
             uid = new.metadata.uid
@@ -417,9 +423,9 @@ class RemoteHub:
             if suppress:
                 return
             if prev is None:
-                deliver(h, "add", rv, kind, None, new)
+                deliver(h, "add", rv, kind, None, new, trace)
             else:
-                deliver(h, "update", rv, kind, prev[1], new)
+                deliver(h, "update", rv, kind, prev[1], new, trace)
 
         def connect(since_rv: int | None = None):
             kq = f"kinds={','.join(kinds)}" if mux else f"kind={kinds[0]}"
@@ -493,54 +499,64 @@ class RemoteHub:
             in_replay = not resumed
             sync_seen = False
             live: dict[str, set] = {k: set() for k in kinds}
-            for ev in stream_events(resp):
-                if self._closed.is_set():
-                    return
-                if sync_seen and ev and not ev.get("synced"):
-                    # a LIVE event arrived: the stream genuinely worked,
-                    # so the next outage's backoff restarts from base.
-                    # (Keying on any bytes would reset on every replay —
-                    # a reconnect/relist storm the backoff exists to
-                    # damp. consume() normally ENDS via an exception, so
-                    # a return-based signal would never fire.)
-                    progressed[0] = True
-                if ev.get("synced"):
-                    note_rv(ev.get("rv"))
-                    if in_replay:
-                        # relist diff: anything tracked but absent from
-                        # this replay was deleted while we weren't
-                        # watching
+            gen = stream_events(resp)
+            try:
+                for ev in gen:
+                    if self._closed.is_set():
+                        return
+                    if sync_seen and ev and not ev.get("synced"):
+                        # a LIVE event arrived: the stream genuinely worked,
+                        # so the next outage's backoff restarts from base.
+                        # (Keying on any bytes would reset on every replay —
+                        # a reconnect/relist storm the backoff exists to
+                        # damp. consume() normally ENDS via an exception, so
+                        # a return-based signal would never fire.)
+                        progressed[0] = True
+                    if ev.get("synced"):
+                        note_rv(ev.get("rv"))
+                        if in_replay:
+                            # relist diff: anything tracked but absent from
+                            # this replay was deleted while we weren't
+                            # watching
+                            for kind in kinds:
+                                state = states[kind]
+                                seen = live[kind]
+                                for uid in [u for u in state
+                                            if u not in seen]:
+                                    _, obj = state.pop(uid)
+                                    if not suppress_replay:
+                                        deliver(handlers[kind], "delete",
+                                                ev.get("rv") or last_rv[0],
+                                                kind, obj, None)
                         for kind in kinds:
-                            state = states[kind]
-                            seen = live[kind]
-                            for uid in [u for u in state
-                                        if u not in seen]:
-                                _, obj = state.pop(uid)
-                                if not suppress_replay:
-                                    deliver(handlers[kind], "delete",
-                                            ev.get("rv") or last_rv[0],
-                                            kind, obj, None)
-                    for kind in kinds:
-                        h = handlers[kind]
-                        if h.on_sync is not None:
-                            h.on_sync(ev.get("rv") or last_rv[0],
-                                      in_replay)
-                    in_replay = False
-                    sync_seen = True
-                    synced.set()
-                    continue
-                if not ev:
-                    continue                # keepalive
-                if not in_replay:
-                    # the resume point advances ONLY along rv-ordered
-                    # streams: live events, journal suffixes, and sync
-                    # markers. LIST replay is insertion-ordered — a cut
-                    # mid-replay could leave last_rv beyond objects never
-                    # delivered, and a resume from there would skip them
-                    # silently forever; leaving last_rv untouched makes
-                    # that reconnect retry/relist instead
-                    note_rv(ev.get("rv"))
-                dispatch(ev, suppress_replay and in_replay, live)
+                            h = handlers[kind]
+                            if h.on_sync is not None:
+                                h.on_sync(ev.get("rv") or last_rv[0],
+                                          in_replay)
+                        in_replay = False
+                        sync_seen = True
+                        synced.set()
+                        continue
+                    if not ev:
+                        continue                # keepalive
+                    if not in_replay:
+                        # the resume point advances ONLY along rv-ordered
+                        # streams: live events, journal suffixes, and sync
+                        # markers. LIST replay is insertion-ordered — a cut
+                        # mid-replay could leave last_rv beyond objects never
+                        # delivered, and a resume from there would skip them
+                        # silently forever; leaving last_rv untouched makes
+                        # that reconnect retry/relist instead
+                        note_rv(ev.get("rv"))
+                    dispatch(ev, suppress_replay and in_replay, live)
+            finally:
+                # flush the batched wire counters DETERMINISTICALLY on
+                # every exit — disconnect, EOF, close(), a dispatch
+                # raise. Leaving the suspended generator to GC would
+                # run its flushing finally "eventually" (refcount
+                # timing), and a short stream's tail (< the 64-event
+                # batch) would be missing from wire_codec_* until then
+                gen.close()
 
         def run(first_resp) -> None:
             resp, suppress = first_resp, not replay

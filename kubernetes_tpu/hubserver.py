@@ -146,8 +146,10 @@ def make_stream_writers(wfile, use_bin: bool, mux: bool):
     """-> (write_obj, write_event): the chunked watch-stream writers,
     one implementation for every server speaking this wire (hub and
     relay). ``write_obj`` emits markers/keepalives; ``write_event``
-    takes (kind, type, rv, old, new) with RAW objects and serializes
-    per the stream's codec."""
+    takes (kind, type, rv, old, new[, trace]) with RAW objects and
+    serializes per the stream's codec; ``trace`` (the commit's
+    TraceContext) rides inside the event body on BOTH codecs, so a
+    JSON-era middlebox re-chunking the stream passes it through."""
     def write_chunk(blob: bytes) -> None:
         wfile.write(f"{len(blob):x}\r\n".encode() + blob + b"\r\n")
         wfile.flush()
@@ -158,15 +160,20 @@ def make_stream_writers(wfile, use_bin: bool, mux: bool):
         else:
             write_chunk(json.dumps(obj).encode() + b"\n")
 
-    def write_event(kind: str, etype: str, rv: int, old, new) -> None:
+    def write_event(kind: str, etype: str, rv: int, old, new,
+                    trace=None) -> None:
         d = {"type": etype, "rv": rv}
         if mux:
             d["kind"] = kind
         if use_bin:
             d["old"], d["new"] = old, new
+            if trace is not None:
+                d["trace"] = trace
             write_chunk(binwire.frame(binwire.encode(d)))
         else:
             d["old"], d["new"] = to_wire(old), to_wire(new)
+            if trace is not None:
+                d["trace"] = to_wire(trace)
             write_chunk(json.dumps(d).encode() + b"\n")
 
     return write_obj, write_event
@@ -218,6 +225,14 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _text(self, status: int, body: str) -> None:
+        data = body.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
         if self.path != "/call":
             self._json(404, {"error": "NotFound", "message": self.path})
@@ -262,6 +277,17 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(200, {"result": to_wire(result)})
 
     def do_GET(self) -> None:  # noqa: N802
+        path = self.path.partition("?")[0]
+        if path in ("/healthz", "/livez"):
+            # fleet health: every fabric component answers /healthz so
+            # the FleetView collector (telemetry.fleet) can probe it
+            self._text(200, "ok")
+            return
+        if path == "/metrics":
+            from kubernetes_tpu.telemetry.fleet import hub_metrics_text
+
+            self._text(200, hub_metrics_text(self.hub))
+            return
         if not self.path.startswith("/watch"):
             self._json(404, {"error": "NotFound", "message": self.path})
             return
@@ -333,7 +359,8 @@ class _Handler(BaseHTTPRequestHandler):
                         kind, ev = events.get_nowait()
                     except queue.Empty:
                         break
-                    write_event(kind, ev.type, ev.rv, ev.old, ev.new)
+                    write_event(kind, ev.type, ev.rv, ev.old, ev.new,
+                                ev.trace)
             write_obj({"synced": True, "rv": cur_rv})
             while not self.server.stopping \
                     and not overflow.is_set():  # type: ignore[attr-defined]
@@ -342,7 +369,8 @@ class _Handler(BaseHTTPRequestHandler):
                 except queue.Empty:
                     write_obj({})  # keepalive; also detects dead peers
                     continue
-                write_event(kind, ev.type, ev.rv, ev.old, ev.new)
+                write_event(kind, ev.type, ev.rv, ev.old, ev.new,
+                                ev.trace)
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:

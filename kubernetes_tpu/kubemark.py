@@ -72,12 +72,19 @@ class HollowNodes:
         # ack bindings: the kubelet side of the contract — a pod bound to
         # one of OUR nodes gets its status driven to Running
         # (hollow_kubelet runs a real kubelet loop against a fake runtime;
-        # the scheduler-visible effect is exactly this status update)
+        # the scheduler-visible effect is exactly this status update).
+        # on_event (not the typed trio) so the bound event's trace stamp
+        # is visible: the ack carries it back as baggage, closing the
+        # hub -> relay -> kubelet leg of the end-to-end pod timeline.
         self.watch_hub.watch_pods(EventHandlers(
-            on_add=self._maybe_ack,
-            on_update=lambda old, new: self._maybe_ack(new)))
+            on_event=self._on_pod_event))
 
-    def _maybe_ack(self, pod: Pod) -> None:
+    def _on_pod_event(self, ev) -> None:
+        if ev.type == "delete":
+            return
+        self._maybe_ack(ev.new, ev.trace)
+
+    def _maybe_ack(self, pod: Pod, trace=None) -> None:
         if pod.spec.node_name not in self.names:
             return
         if pod.status.phase == PHASE_RUNNING:
@@ -91,6 +98,25 @@ class HollowNodes:
             return
         new = fresh.clone()
         new.status.phase = PHASE_RUNNING
+        if trace is not None:
+            # trace baggage: when the bound event arrived here, and how
+            # many relay hops it crossed — the scheduler's timeline join
+            # reads this off the ack's update event (telemetry.trace).
+            # clone() shares the annotations dict with the stored object
+            # (only labels are copied), so copy before writing: mutating
+            # it in place would annotate the hub's committed pod with no
+            # commit — and permanently, if the update below fails.
+            from kubernetes_tpu.telemetry.trace import (
+                ACK_TRACE_ANNOTATION,
+                TraceContext,
+                format_ack_trace,
+            )
+
+            new.metadata.annotations = dict(new.metadata.annotations)
+            new.metadata.annotations[ACK_TRACE_ANNOTATION] = \
+                format_ack_trace(TraceContext(
+                    origin=trace.origin, ts=time.time(),
+                    hops=trace.hops))
         try:
             self.hub.update_pod(new)
         except Exception:  # noqa: BLE001 — pod vanished mid-ack; the
@@ -135,10 +161,26 @@ class HollowNodes:
                                     name="kubemark-heartbeat")
         self._hb.start()
 
+    def serve_metrics(self, host: str = "127.0.0.1", port: int = 0):
+        """Mount /metrics + /healthz for this feeder (the fleet scrape
+        surface every fabric component answers; telemetry.fleet)."""
+        from kubernetes_tpu.telemetry.fleet import (
+            ComponentEndpoints,
+            kubemark_metrics_text,
+        )
+
+        self._endpoints = ComponentEndpoints(
+            lambda: kubemark_metrics_text(self),
+            host=host, port=port).start()
+        return self._endpoints
+
     def stop(self) -> None:
         self._stop.set()
         if self._hb is not None:
             self._hb.join(timeout=5)
+        ep = getattr(self, "_endpoints", None)
+        if ep is not None:
+            ep.stop()
 
 
 def main() -> None:
@@ -157,6 +199,9 @@ def main() -> None:
     ap.add_argument("--zones", type=int, default=0)
     ap.add_argument("--heartbeat", type=float, default=0.0,
                     help="node heartbeat interval seconds (0 = off)")
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="serve /metrics + /healthz on this port "
+                         "(0 = ephemeral; -1 = off)")
     args = ap.parse_args()
     client = RemoteHub(args.hub)
     watch_client = RemoteHub(args.relay) if args.relay else None
@@ -164,6 +209,9 @@ def main() -> None:
                          zones=args.zones, watch_hub=watch_client)
     if args.heartbeat:
         hollow.start_heartbeat(args.heartbeat)
+    if args.metrics_port >= 0:
+        ep = hollow.serve_metrics(port=args.metrics_port)
+        print(f"kubemark: metrics at {ep.address}/metrics", flush=True)
     print(f"kubemark: {args.nodes} hollow nodes registered", flush=True)
     try:
         while True:

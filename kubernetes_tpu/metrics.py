@@ -331,6 +331,20 @@ class SchedulerMetrics:
             "scheduler_device_fallbacks_total",
             "Batches degraded from the fused device launch to the host "
             "Filter/Score path after a device fault"))
+        # device-launch profiler (telemetry/profiler.py): XLA compile
+        # attribution per bucket-shape transition + resident HBM bytes
+        self.device_compiles = r.register(Counter(
+            "scheduler_device_compiles_total",
+            "XLA compiles of the fused launch, by attributed cause "
+            "(first / rebucket / batch_bucket / topology_bucket / "
+            "flags / unattributed)", ("cause",)))
+        self.device_launch_shapes = r.register(Gauge(
+            "scheduler_device_launch_shapes",
+            "Distinct launch bucket shapes this process has dispatched"))
+        self.device_live_buffer_bytes = r.register(Gauge(
+            "scheduler_device_live_buffer_bytes",
+            "Resident device-buffer bytes by buffer family (cluster "
+            "tensors, pod batch, DRA inventories, learned params)"))
         self.drift_detected = r.register(Counter(
             "scheduler_drift_detected_total",
             "Cache/mirror-vs-hub discrepancies found by the drift "

@@ -1067,6 +1067,21 @@ def extract_state_jit(cblobs, caps):
     return ct.free, ct.nonzero_requested
 
 
+def launch_cache_size() -> int | None:
+    """Executable-cache entries behind the fused launch (schedule_batch_jit
+    plus the state-extraction seed): the DeviceProfiler reads this after
+    each dispatch — growth means a real XLA compile happened while
+    tracing that launch. None when this jax build doesn't expose the
+    introspection hook (the profiler then skips compile counting)."""
+    total = 0
+    for fn in (schedule_batch_jit, extract_state_jit):
+        size = getattr(fn, "_cache_size", None)
+        if size is None:
+            return None
+        total += size()
+    return total
+
+
 def launch_batch(spec, wk, weights, caps, enabled_filters=None,
                  serial_scan=True, state=None, host_ok=None,
                  host_score=None, fit_strategy="LeastAllocated",

@@ -386,6 +386,10 @@ def run_workload(w: Workload, now: Callable[[], float] = time.time,
             "phases": fl.phase_percentiles(),
             "plugins": fl.plugin_percentiles(),
             "host_tail_share": round(fl.host_tail_share(), 4),
+            # the device-launch profiler column: compiles by attributed
+            # cause, per-shape walltime, resident buffer bytes
+            "device": (sched.profiler.snapshot()
+                       if sched.profiler is not None else None),
         }
     if summary is not None:
         result.update(summary.to_dict())

@@ -1258,4 +1258,9 @@ PROFILE_WORKLOADS = (
     "mixed_churn",
     "dra_steady_state",
     "dra_steady_state_templates",
+    # gang/fabric host tails measured, not guessed (ISSUE-10): the
+    # multi-tenant gang storm rides the same per-phase attribution;
+    # bench --profile additionally runs the fanout smoke for the
+    # fabric-side numbers
+    "multi_tenant_gang_storm",
 )

@@ -231,6 +231,19 @@ class Scheduler:
         if self.flight.enabled:
             for fw in self.frameworks.values():
                 fw.plugin_timer = self.flight.plugin_observe
+        # the device-launch profiler (telemetry.profiler): XLA compiles
+        # per bucket shape, recompile attribution to re-bucket churn,
+        # per-shape walltime, live HBM buffer bytes. Rides the flight
+        # recorder's enable switch — one observability budget.
+        self.profiler = None
+        if self.flight.enabled:
+            from kubernetes_tpu.telemetry.profiler import DeviceProfiler
+
+            self.profiler = DeviceProfiler(metrics=self.metrics, now=now)
+        # optional fleet collector (telemetry.fleet.FleetView) attached
+        # by the operator/harness; serving exposes /debug/fleet and the
+        # merged /metrics/fleet exposition when set
+        self.fleet = None
         # gate opener of last resort: a flush that deleted nothing (empty
         # or already-gone victim sets) fires no cluster event, so the
         # evaluator re-activates those preemptors directly
@@ -393,10 +406,12 @@ class Scheduler:
             on_add=w(self._on_node_add),
             on_update=w(self._on_node_update),
             on_delete=w(self._on_node_delete)))
+        # pods ride the on_event shape: the full JournalEvent carries
+        # the commit's TraceContext, which the timeline join needs (the
+        # typed trio would drop it); dedup/relist-diff still apply
+        # upstream on both transports
         self.hub.watch_pods(EventHandlers(
-            on_add=w(self._on_pod_add),
-            on_update=w(self._on_pod_update),
-            on_delete=w(self._on_pod_delete)))
+            on_event=w(self._on_pod_event)))
         self.hub.watch_namespaces(EventHandlers(
             on_add=w(self._on_ns_set),
             on_update=w(lambda old, new: self._on_ns_set(new)),
@@ -532,6 +547,56 @@ class Scheduler:
         if self.jobqueue.wants(pod):
             self.jobqueue.remove(pod)       # no longer queued here
             self.jobqueue.note_bound(pod)
+
+    def _on_pod_event(self, ev) -> None:
+        """Pod watch dispatch (JournalEvent-shaped): join the commit's
+        wire trace stamp into the pod timeline, then run the typed
+        handler. Events without a stamp (LIST replays, pre-telemetry
+        peers) flow identically — hop data degrades, never the event."""
+        if ev.type == "delete":
+            self._on_pod_delete(ev.old)
+            return
+        if self.flight.enabled:
+            self._stamp_wire_trace(ev)
+        if ev.type == "add":
+            self._on_pod_add(ev.new)
+        else:
+            self._on_pod_update(ev.old, ev.new)
+
+    def _stamp_wire_trace(self, ev) -> None:
+        """The cross-wire timeline join (telemetry.trace): ``created``
+        from the pod's add commit, ``bound`` from the bind commit,
+        ``acked`` from the kubelet's status-Running commit, and
+        ``kubelet_recv`` from the ack's trace-baggage annotation (the
+        bound event's arrival stamp after its relay hops) — one
+        end-to-end hub -> relay -> scheduler -> bind -> ack timeline
+        per pod, served at /debug/pod."""
+        from kubernetes_tpu.telemetry.trace import (
+            ACK_TRACE_ANNOTATION,
+            parse_ack_trace,
+        )
+
+        pod, tr, tl = ev.new, ev.trace, self.timelines
+        if not self._ours(pod):
+            return
+        if ev.type == "add":
+            if tr is not None and not pod.spec.node_name:
+                tl.wire_stamp(pod, "created", tr.ts, tr.origin, tr.hops)
+            return
+        old = ev.old
+        if tr is not None and pod.spec.node_name \
+                and (old is None or not old.spec.node_name):
+            tl.wire_stamp(pod, "bound", tr.ts, tr.origin, tr.hops)
+        if pod.status.phase == "Running" \
+                and (old is None or old.status.phase != "Running"):
+            if tr is not None:
+                tl.wire_stamp(pod, "acked", tr.ts, tr.origin, tr.hops)
+            baggage = pod.metadata.annotations.get(ACK_TRACE_ANNOTATION)
+            if baggage:
+                bt = parse_ack_trace(baggage)
+                if bt is not None:
+                    tl.wire_stamp(pod, "kubelet_recv", bt.ts,
+                                  bt.origin, bt.hops)
 
     def _on_pod_add(self, pod: Pod) -> None:
         if self._pod_event_stale(pod):
@@ -1339,8 +1404,36 @@ class Scheduler:
             self._chain = (out.free, out.nzr)
         t_done = self.now()
         tr.add("device_dispatch", t_done - t_disp0)
+        # device-launch profiler: the jit call above traced (and, on a
+        # new bucket shape, COMPILED) synchronously before dispatching,
+        # so reading the executable-cache size here attributes any
+        # growth to exactly this launch's shape
+        pshape = None
+        compiled = False
+        prof = self.profiler
+        if prof is not None:
+            from kubernetes_tpu.telemetry.profiler import (
+                shape_key,
+                tree_nbytes,
+            )
+
+            pshape = shape_key(
+                self.caps, spec.pblobs.f32.shape[0],
+                spec.enable_topology, spec.d_cap, spec.g_cap,
+                not use_auction, spec.dra is not None,
+                learned_params is not None,
+                self._export_feats and self.flight.exporting)
+            compiled = prof.note_launch(pshape)
+            if compiled or prof.launches == 1:
+                # buffer footprints are bucket-static: re-measure only
+                # when a compile (= a bucket/flag change) happened
+                prof.note_buffers({
+                    "cluster": tree_nbytes(spec.cblobs),
+                    "pods": tree_nbytes(spec.pblobs),
+                    "dra": tree_nbytes(spec.dra),
+                    "learned": tree_nbytes(learned_params)})
         return (runnable, out, t_done, t_done - t_cycle0, tr,
-                learned_params is not None)
+                learned_params is not None, pshape, compiled)
 
     def _host_relevant(self, pod: Pod) -> bool:
         if self._host_gates is None:
@@ -1503,7 +1596,8 @@ class Scheduler:
 
     def _finish(self, inflight: tuple) -> None:
         """Pull one dispatched launch's results and commit/fail each pod."""
-        runnable, out, t_dispatched, pack_s, tr, learned_on = inflight
+        (runnable, out, t_dispatched, pack_s, tr, learned_on,
+         pshape, compiled) = inflight
         # re-attach the cycle's trace: the pipelined drain may have
         # dispatched k+1 (opening its trace) before finishing k
         self.flight.resume(tr)
@@ -1565,6 +1659,13 @@ class Scheduler:
                                        for v in feats_arr[i]]
                 else:
                     rec["node"] = None
+                # the wire-trace stamps known at commit time (the
+                # "created" hub-commit stamp and its hop count join
+                # offline analysis to the cluster's commit clock; the
+                # ack stamps land later via /debug/pod)
+                wire = self.timelines.wire_of(qp.uid)
+                if wire:
+                    rec["wire"] = wire
                 placements.append(rec)
             tr.placements = placements
         t1 = self.now()
@@ -1606,6 +1707,13 @@ class Scheduler:
         commit_s = self.now() - t1
         cycle_s = pack_s + launch_s + commit_s
         tr.add("device_launch", launch_s)
+        if self.profiler is not None and pshape is not None:
+            self.profiler.observe_walltime(pshape, launch_s)
+            if compiled:
+                # attribution view: this cycle's launch walltime was
+                # (mostly) an XLA compile — the stall MixedChurn's
+                # re-bucketing pays, now visible per phase
+                tr.add("device_compile", launch_s)
         tr.scheduled = n - n_fail
         tr.failed = n_fail
         self.flight.record(tr)

@@ -109,12 +109,18 @@ class ServingEndpoints:
                         n = int(query.get("n", ["32"])[0])
                     except ValueError:
                         n = 32
+                    prof = getattr(sched, "profiler", None)
                     body = json.dumps({
                         "enabled": flight.enabled,
                         "cycles": flight.last(n),
                         "phases": flight.phase_percentiles(),
                         "host_tail_share": round(
                             flight.host_tail_share(), 4),
+                        # the device-launch profiler rides the trace
+                        # surface: compiles per bucket shape, recompile
+                        # causes, resident HBM buffer bytes
+                        "device": (prof.snapshot() if prof is not None
+                                   else None),
                     }, indent=2, default=str)
                 elif path == "/debug/scorer":
                     # learned-scorer state per profile: checkpoint
@@ -156,6 +162,16 @@ class ServingEndpoints:
                         payload["wire"] = s.get("wire", {})
                         payload["codec"] = s.get("codec")
                     body = json.dumps(payload, indent=2, default=str)
+                elif path == "/debug/fleet":
+                    # fleet topology + health: the FleetView collector's
+                    # summary (one row per fabric component endpoint,
+                    # healthz verdicts + strict-parse scrape errors)
+                    fleet = getattr(sched, "fleet", None)
+                    if fleet is None:
+                        self._send(404, "no fleet view attached")
+                        return
+                    body = json.dumps(fleet.summary(), indent=2,
+                                      default=str)
                 elif path == "/debug/pod":
                     timelines = getattr(sched, "timelines", None)
                     if timelines is None:
@@ -180,6 +196,15 @@ class ServingEndpoints:
                 path, _, rawq = self.path.partition("?")
                 if path == "/metrics":
                     self._send(200, sched.metrics.registry.render_text())
+                elif path == "/metrics/fleet":
+                    # the merged fleet exposition: every component's
+                    # samples re-labeled with component/shard — one
+                    # scrape target for the whole fabric
+                    fleet = getattr(sched, "fleet", None)
+                    if fleet is None:
+                        self._send(404, "no fleet view attached")
+                    else:
+                        self._send(200, fleet.render_text())
                 elif path == "/readyz":
                     # degraded (hub unreachable) = alive but NOT ready:
                     # load balancers should drain, probes should not kill

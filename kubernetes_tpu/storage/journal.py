@@ -76,13 +76,18 @@ class RvTooOld(Exception):
 class JournalEvent:
     """One committed mutation: rv is the global revision stamped by the
     hub; ``old``/``new`` carry the object before/after (None on the
-    add/delete side respectively), exactly what a watch dispatches."""
+    add/delete side respectively), exactly what a watch dispatches.
+    ``trace`` (telemetry.trace.TraceContext, optional) is the commit's
+    trace stamp — origin component, commit timestamp, relay hop count —
+    carried with the event across the wire and relay tree; None on
+    synthetic events (LIST replays, pre-telemetry WALs/peers)."""
 
     rv: int
     kind: str                     # watch kind, e.g. "pods"
     type: str                     # "add" | "update" | "delete"
     old: object = None
     new: object = None
+    trace: object = None          # TraceContext | None
 
 
 class _KindRing:
@@ -178,8 +183,13 @@ class Journal:
     def _wal_record(ev: JournalEvent) -> str:
         from kubernetes_tpu.utils.wire import to_wire
 
-        return json.dumps({"rv": ev.rv, "kind": ev.kind, "type": ev.type,
-                           "old": to_wire(ev.old), "new": to_wire(ev.new)})
+        rec = {"rv": ev.rv, "kind": ev.kind, "type": ev.type,
+               "old": to_wire(ev.old), "new": to_wire(ev.new)}
+        if ev.trace is not None:
+            # the commit's trace stamp persists so a restarted hub's
+            # ring resumes still serve stamped events
+            rec["trace"] = to_wire(ev.trace)
+        return json.dumps(rec)
 
     def _wal_decode(self, rec: dict) -> Optional[JournalEvent]:
         from kubernetes_tpu.utils.wire import from_wire
@@ -191,7 +201,8 @@ class Journal:
         return JournalEvent(rv=rec["rv"], kind=rec["kind"],
                             type=rec["type"],
                             old=from_wire(rec.get("old")),
-                            new=from_wire(rec.get("new")))
+                            new=from_wire(rec.get("new")),
+                            trace=from_wire(rec.get("trace")))
 
     def replay_wal(self) -> Iterator[JournalEvent]:
         """Yield the WAL's events oldest-first, lazily — one line in

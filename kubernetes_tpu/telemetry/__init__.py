@@ -1,0 +1,27 @@
+"""Fleet telemetry plane: wire trace propagation, fleet-wide metrics
+aggregation, and the device-launch profiler.
+
+Three pillars (ISSUE 10, after the Kant unified-observability argument
+— arXiv:2510.01256 — that large-AI-cluster schedulers need fleet-level
+views, not per-component counters):
+
+* :mod:`kubernetes_tpu.telemetry.trace` — :class:`TraceContext`, the
+  compact per-commit trace stamp (origin component, commit timestamp,
+  relay hop count) carried inside every :class:`JournalEvent`, threaded
+  through both wire codecs and relay hops so `PodTimelines` can join
+  hub/relay/scheduler/binder/kubelet-ack stamps into one end-to-end
+  timeline per pod.
+* :mod:`kubernetes_tpu.telemetry.fleet` — the strict exposition-format
+  parser, per-component `/metrics` renderers (hub, relay, kubemark),
+  and :class:`FleetView`, the collector that pulls every fabric
+  component's `/metrics`+`/healthz` and merges them into one exposition
+  with ``component``/``shard`` labels (`/debug/fleet`).
+* :mod:`kubernetes_tpu.telemetry.profiler` — :class:`DeviceProfiler`,
+  the device-launch instrument: XLA compiles per bucket shape,
+  recompile attribution to re-bucket churn, per-launch walltime, and
+  live device-buffer bytes (`scheduler_device_*` metrics).
+"""
+
+from kubernetes_tpu.telemetry.trace import TraceContext, new_context
+
+__all__ = ["TraceContext", "new_context"]

@@ -1,0 +1,391 @@
+"""Fleet-wide metrics aggregation: strict exposition parsing + FleetView.
+
+Every fabric component answers ``/metrics`` (Prometheus text exposition)
+and ``/healthz``: the hub server and relay servers serve them off their
+existing HTTP handlers, the kubemark feeder mounts
+:class:`ComponentEndpoints`. :class:`FleetView` is the collector — it
+pulls every endpoint, re-labels each sample with ``component``/``shard``
+and merges everything into ONE exposition (the fleet scrape target) plus
+a ``/debug/fleet`` topology-and-health summary.
+
+The parser here is deliberately STRICT (``parse_exposition``): names and
+labels must match the Prometheus grammar, label values must use the
+spec's three escapes, values must be floats. It is both the merge's
+ingest (a component emitting garbage is a loud per-endpoint error, not a
+corrupted fleet exposition) and the metrics-lint test's oracle — the
+scheduler's own ``/metrics`` body must round-trip through it, which
+locks in the PR-4 escaping fix for every future metric.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+# one sample line: name{labels} value  (timestamp deliberately rejected
+# — nothing in this stack emits one, so accepting it would just mask a
+# component printing garbage that happens to look like a timestamp)
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r" (?P<value>[^ ]+)$")
+
+# one label pair inside the braces; values are quoted with ONLY the
+# spec's escapes (\\, \", \n) permitted
+_LABEL_RE = re.compile(
+    r'(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>(?:[^"\\]|\\\\|\\"|\\n)*)"')
+
+
+@dataclass
+class Sample:
+    name: str
+    labels: dict[str, str]
+    value: float
+
+
+@dataclass
+class Exposition:
+    """Parsed exposition: samples plus the HELP/TYPE metadata per
+    metric family (family = the name without _bucket/_sum/_count)."""
+
+    samples: list[Sample] = field(default_factory=list)
+    help: dict[str, str] = field(default_factory=dict)
+    type: dict[str, str] = field(default_factory=dict)
+
+
+def _unescape_label(v: str) -> str:
+    return v.replace("\\\\", "\x00").replace('\\"', '"') \
+        .replace("\\n", "\n").replace("\x00", "\\")
+
+
+def _parse_labels(raw: str) -> dict[str, str]:
+    labels: dict[str, str] = {}
+    pos = 0
+    while pos < len(raw):
+        m = _LABEL_RE.match(raw, pos)
+        if m is None:
+            raise ValueError(f"bad label pair at {raw[pos:pos + 40]!r}")
+        labels[m.group("k")] = _unescape_label(m.group("v"))
+        pos = m.end()
+        if pos < len(raw):
+            if raw[pos] != ",":
+                raise ValueError(f"expected ',' at {raw[pos:pos + 20]!r}")
+            pos += 1
+    return labels
+
+
+def parse_exposition(text: str) -> Exposition:
+    """Strictly parse a Prometheus text exposition; raises ValueError on
+    ANY malformed line (the lint contract — silently skipping a bad line
+    is how escaping bugs survive)."""
+    out = Exposition()
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):]
+            name, _, help_ = rest.partition(" ")
+            if not METRIC_NAME_RE.match(name):
+                raise ValueError(f"bad HELP metric name {name!r}")
+            out.help[name] = help_
+            continue
+        if line.startswith("# TYPE "):
+            rest = line[len("# TYPE "):]
+            name, _, mtype = rest.partition(" ")
+            if not METRIC_NAME_RE.match(name):
+                raise ValueError(f"bad TYPE metric name {name!r}")
+            if mtype not in ("counter", "gauge", "histogram", "summary",
+                             "untyped"):
+                raise ValueError(f"bad TYPE {mtype!r} for {name}")
+            out.type[name] = mtype
+            continue
+        if line.startswith("#"):
+            continue                      # plain comment
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"unparseable sample line {line!r}")
+        name = m.group("name")
+        labels = _parse_labels(m.group("labels")) \
+            if m.group("labels") else {}
+        for k in labels:
+            if not LABEL_NAME_RE.match(k):
+                raise ValueError(f"bad label name {k!r} on {name}")
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            raise ValueError(
+                f"bad sample value {m.group('value')!r} on {name}") \
+                from None
+        out.samples.append(Sample(name, labels, value))
+    return out
+
+
+def _escape_label_value(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_sample(s: Sample) -> str:
+    if not s.labels:
+        return f"{s.name} {s.value}"
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"'
+                     for k, v in sorted(s.labels.items()))
+    return f"{s.name}{{{inner}}} {s.value}"
+
+
+def merge_expositions(parts: list[tuple[dict, Exposition]]) -> str:
+    """Merge parsed expositions into one body, each part's samples
+    re-labeled with its injected labels (component/shard). TYPE/HELP
+    come from the first part that declares them; injected labels keep
+    same-named series from different components distinct."""
+    help_: dict[str, str] = {}
+    type_: dict[str, str] = {}
+    by_family: dict[str, list[Sample]] = {}
+    order: list[str] = []
+    for inject, exp in parts:
+        for name, h in exp.help.items():
+            help_.setdefault(name, h)
+        for name, t in exp.type.items():
+            type_.setdefault(name, t)
+        for s in exp.samples:
+            fam = re.sub(r"_(bucket|sum|count)$", "", s.name)
+            fam = fam if fam in exp.type else s.name
+            if fam not in by_family:
+                by_family[fam] = []
+                order.append(fam)
+            by_family[fam].append(
+                Sample(s.name, {**s.labels, **inject}, s.value))
+    lines: list[str] = []
+    for fam in order:
+        if fam in help_:
+            lines.append(f"# HELP {fam} {help_[fam]}")
+        if fam in type_:
+            lines.append(f"# TYPE {fam} {type_[fam]}")
+        lines.extend(_fmt_sample(s) for s in by_family[fam])
+    return "\n".join(lines) + "\n"
+
+
+# ------------------------- component renderers -------------------------
+#
+# Each fabric component renders its own small Registry on demand; the
+# metric sets are deliberately tiny (the scheduler's full set lives on
+# its own /metrics — these are the FABRIC-side counters a fleet scrape
+# needs to see per component).
+
+
+def hub_metrics_text(hub) -> str:
+    """The hub server's /metrics: revision space + per-kind journal
+    depth, plus per-shard commits for a ShardedHub."""
+    from kubernetes_tpu.metrics import Counter, Gauge, Registry
+
+    r = Registry()
+    rv = r.register(Gauge("hub_rv", "Newest committed revision"))
+    depth = r.register(Gauge("hub_journal_depth",
+                             "Event journal ring depth by resource kind"))
+    compacted = r.register(Gauge(
+        "hub_journal_compacted_rv",
+        "Journal compaction watermark by resource kind"))
+    commits = r.register(Counter("hub_shard_commits_total",
+                                 "Mutations committed by hub shard",
+                                 ("shard",)))
+    st = hub.get_journal_stats()
+    rv.set(float(st.get("rv", 0)))
+    for kind, ks in st.get("kinds", {}).items():
+        depth.set(float(ks["depth"]), kind=kind)
+        compacted.set(float(ks["compacted_rv"]), kind=kind)
+    for shard, ss in st.get("shards", {}).items():
+        commits.inc(float(ss.get("commits", 0)), shard=shard)
+    return r.render_text()
+
+
+def relay_metrics_text(core) -> str:
+    """A relay node's /metrics: fan-out counters + subscriber state."""
+    from kubernetes_tpu.metrics import Counter, Gauge, Registry
+
+    r = Registry()
+    st = core.stats()
+    subs = r.register(Gauge("relay_subscribers",
+                            "Downstream subscribers attached"))
+    last = r.register(Gauge("relay_last_rv",
+                            "Newest upstream revision relayed"))
+    g_in = r.register(Counter("relay_events_in_total",
+                              "Events received from upstream"))
+    g_out = r.register(Counter("relay_events_out_total",
+                               "Events fanned out to subscribers"))
+    ev = r.register(Counter("relay_slow_evictions_total",
+                            "Slow subscribers evicted (bounded queues)"))
+    res = r.register(Counter("relay_resume_serves_total",
+                             "Downstream reconnects served off the ring"))
+    rel = r.register(Counter("relay_relist_serves_total",
+                             "Downstream LIST replays served from the "
+                             "state mirror"))
+    subs.set(float(st["subscribers"]))
+    last.set(float(st["last_rv"]))
+    g_in.inc(float(st["events_in"]))
+    g_out.inc(float(st["events_out"]))
+    ev.inc(float(st["slow_evictions"]))
+    res.inc(float(st["resume_serves"]))
+    rel.inc(float(st["relist_serves"]))
+    return r.render_text()
+
+
+def kubemark_metrics_text(hollow) -> str:
+    """The kubemark feeder's /metrics: hollow-node count + acks."""
+    from kubernetes_tpu.metrics import Counter, Gauge, Registry
+
+    r = Registry()
+    nodes = r.register(Gauge("kubemark_hollow_nodes",
+                             "Hollow nodes registered by this feeder"))
+    acked = r.register(Counter("kubemark_acked_pods_total",
+                               "Pods this feeder drove to Running"))
+    nodes.set(float(len(hollow.names)))
+    acked.inc(float(hollow.ack_count()))
+    return r.render_text()
+
+
+class ComponentEndpoints:
+    """A tiny /metrics + /healthz server for components without their
+    own HTTP face (the kubemark feeder). ``metrics_fn`` renders the
+    exposition body; ``healthz_fn`` (optional) returns True when
+    healthy."""
+
+    def __init__(self, metrics_fn: Callable[[], str],
+                 healthz_fn: Optional[Callable[[], bool]] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args) -> None:  # quiet
+                pass
+
+            def _send(self, code: int, body: str) -> None:
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type",
+                                 "text/plain; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802 (stdlib API)
+                path = self.path.partition("?")[0]
+                if path == "/metrics":
+                    self._send(200, outer.metrics_fn())
+                elif path in ("/healthz", "/livez"):
+                    ok = outer.healthz_fn() if outer.healthz_fn else True
+                    self._send(200 if ok else 503,
+                               "ok" if ok else "unhealthy")
+                else:
+                    self._send(404, "not found")
+
+        self.metrics_fn = metrics_fn
+        self.healthz_fn = healthz_fn
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ComponentEndpoints":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="component-endpoints")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+# ------------------------------ FleetView ------------------------------
+
+
+class FleetView:
+    """The fleet collector: a static endpoint topology (component,
+    shard, url), scraped on demand. ``render_text()`` is the merged
+    exposition; ``summary()`` is the /debug/fleet payload (topology +
+    per-endpoint health + scrape errors)."""
+
+    def __init__(self, endpoints: list[dict], timeout: float = 5.0,
+                 fetch: Optional[Callable[[str, float], str]] = None):
+        for ep in endpoints:
+            if "component" not in ep or "url" not in ep:
+                raise ValueError(
+                    f"fleet endpoint needs component+url: {ep!r}")
+        self.endpoints = [dict(ep) for ep in endpoints]
+        self.timeout = timeout
+        self._fetch = fetch or self._http_fetch
+
+    @staticmethod
+    def _http_fetch(url: str, timeout: float) -> str:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.read().decode("utf-8", "replace")
+
+    def scrape(self) -> list[dict]:
+        """Pull every endpoint's /healthz and /metrics. Per-endpoint
+        failures are REPORTED, never raised — one dead relay must not
+        take down the fleet view of the living ones."""
+        out: list[dict] = []
+        for ep in self.endpoints:
+            base = ep["url"].rstrip("/")
+            rec = {"component": ep["component"],
+                   "shard": ep.get("shard", ""),
+                   "url": base, "healthy": False, "error": None,
+                   "exposition": None, "scraped_at": time.time()}
+            try:
+                health = self._fetch(base + "/healthz", self.timeout)
+                rec["healthy"] = health.strip().startswith("ok")
+            except Exception as e:  # noqa: BLE001 — per-endpoint verdict
+                rec["error"] = f"healthz: {e}"
+                out.append(rec)
+                continue
+            try:
+                body = self._fetch(base + "/metrics", self.timeout)
+                rec["exposition"] = parse_exposition(body)
+                rec["samples"] = len(rec["exposition"].samples)
+            except Exception as e:  # noqa: BLE001 — strict parse verdict
+                rec["error"] = f"metrics: {e}"
+            out.append(rec)
+        return out
+
+    def render_text(self, records: Optional[list[dict]] = None) -> str:
+        """The merged fleet exposition: every component's samples with
+        ``component``/``shard`` labels injected. Pass ``records`` (a
+        prior ``scrape()`` result) to derive both this and
+        ``summary()`` from ONE round of HTTP round-trips."""
+        parts = []
+        for rec in (records if records is not None else self.scrape()):
+            if rec["exposition"] is None:
+                continue
+            inject = {"component": rec["component"]}
+            if rec["shard"]:
+                inject["shard"] = rec["shard"]
+            parts.append((inject, rec["exposition"]))
+        return merge_expositions(parts)
+
+    def summary(self, records: Optional[list[dict]] = None) -> dict:
+        """/debug/fleet: topology plus health, one row per endpoint."""
+        rows = []
+        for rec in (records if records is not None else self.scrape()):
+            rows.append({k: rec[k] for k in
+                         ("component", "shard", "url", "healthy",
+                          "error")}
+                        | {"samples": rec.get("samples", 0)})
+        return {"endpoints": rows,
+                "healthy": sum(1 for r in rows if r["healthy"]),
+                "total": len(rows),
+                "ok": all(r["healthy"] and not r["error"]
+                          for r in rows)}

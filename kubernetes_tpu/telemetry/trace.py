@@ -1,0 +1,115 @@
+"""Cross-wire trace context: one compact stamp per committed mutation.
+
+Every hub ``_commit`` stamps the :class:`JournalEvent` it journals with a
+:class:`TraceContext` — origin component (``"hub"`` or the fabric shard
+name), the commit wall-clock timestamp, and a relay hop count. The stamp
+travels with the event through both wire codecs (a registered wire
+dataclass: positional on ``bin1``, a tagged dict on the JSON fallback —
+a JSON-era middlebox like the chaos proxy passes it through untouched
+because it lives INSIDE the event body, not in a header) and through
+relay hops, each relay incrementing ``hops`` as it fans the event out.
+
+Degradation contract: a peer or path that cannot carry the context (a
+pre-telemetry server, a relay state-mirror LIST replay — mirrors keep
+objects, not events) delivers the event with ``trace=None``. Hop data
+degrades; events are never dropped or withheld over missing telemetry.
+
+Clock note: ``ts`` is ``time.time()`` (wall clock), not a monotonic
+reading — the stamp's whole purpose is to be compared against OTHER
+components' stamps (scheduler cycle stamps, kubelet acks), and
+monotonic clocks are not comparable across processes. Within one host
+(every deployment this repo drives) wall-clock deltas between
+components are exact; across hosts they are NTP-grade, same as the
+reference's Event timestamps.
+
+``joined_latency`` is the read side: given one pod's ``/debug/pod``
+timeline (PodTimelines.get), it reduces the wire stamps into the
+end-to-end created -> bound -> acked latencies the ``--fanout-smoke``
+SLO gate aggregates into a p99.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """One commit's trace stamp. Frozen — a relay NEVER mutates the
+    stamp it received; ``hop()`` derives the next hop's copy."""
+
+    origin: str = ""      # committing component ("hub", "pods-2", ...)
+    ts: float = 0.0       # commit wall-clock stamp (time.time())
+    hops: int = 0         # relay hops crossed since the commit
+
+    def hop(self) -> "TraceContext":
+        """The stamp one relay hop downstream."""
+        return TraceContext(self.origin, self.ts, self.hops + 1)
+
+
+def new_context(origin: str) -> TraceContext:
+    return TraceContext(origin=origin, ts=time.time(), hops=0)
+
+
+# the wire stamps a complete end-to-end pod trace joins (PodTimelines
+# "wire" dict keys): created = the pod's hub add commit, bound = the
+# bind's hub commit, acked = the kubelet status-Running commit;
+# kubelet_recv (optional) = the bound event's arrival at the kubelet
+# after its relay hops, threaded back through the ack's annotation
+JOIN_REQUIRED = ("created", "bound", "acked")
+
+# annotation the kubelet ack carries its received bind-event trace in
+# (the baggage header of this wire): "hops@ts@origin"
+ACK_TRACE_ANNOTATION = "telemetry.ktpu.io/ack-trace"
+
+
+def format_ack_trace(tr: TraceContext) -> str:
+    return f"{tr.hops}@{tr.ts:.6f}@{tr.origin}"
+
+
+def parse_ack_trace(value: str) -> TraceContext | None:
+    try:
+        hops, ts, origin = value.split("@", 2)
+        return TraceContext(origin=origin, ts=float(ts), hops=int(hops))
+    except (ValueError, AttributeError):
+        return None     # malformed baggage degrades, never raises
+
+
+def joined_latency(timeline: dict | None) -> dict | None:
+    """Reduce one pod timeline's wire stamps to the joined end-to-end
+    latencies. Returns None when the timeline is missing or incomplete
+    (one of ``JOIN_REQUIRED`` absent — the pod is not "joinable")."""
+    if not timeline:
+        return None
+    wire = timeline.get("wire") or {}
+    if any(k not in wire for k in JOIN_REQUIRED):
+        return None
+    created, bound, acked = (wire[k]["t"] for k in JOIN_REQUIRED)
+    out = {
+        "created_ts": round(created, 6),
+        "create_to_bind_s": round(bound - created, 6),
+        "create_to_ack_s": round(acked - created, 6),
+        "bind_to_ack_s": round(acked - bound, 6),
+        "relay_hops": max(int(s.get("hops", 0)) for s in wire.values()),
+    }
+    kr = wire.get("kubelet_recv")
+    if kr is not None:
+        out["bind_to_kubelet_s"] = round(kr["t"] - bound, 6)
+    return out
+
+
+def latency_summary(latencies: list[float]) -> dict:
+    """p50/p99/max over joined latencies (exact-sample percentiles, the
+    --fanout-smoke SLO report)."""
+    if not latencies:
+        return {"count": 0}
+    xs = sorted(latencies)
+
+    def pct(q: float) -> float:
+        return xs[min(len(xs) - 1, int(q / 100.0 * len(xs)))]
+
+    return {"count": len(xs),
+            "p50_s": round(pct(50), 6),
+            "p99_s": round(pct(99), 6),
+            "max_s": round(xs[-1], 6)}

@@ -67,6 +67,11 @@ CYCLE_PHASES = (
                           # a slow checkpoint path must show up in the
                           # A/B latency gate; the fused MLP eval itself
                           # rides device_launch)
+    "device_compile",     # launch walltime of a cycle whose dispatch
+                          # triggered an XLA compile (view: the same
+                          # seconds already sit in device_launch — the
+                          # DeviceProfiler's attribution of WHY that
+                          # launch stalled)
 )
 
 # the dra_* attribution views, excluded from total/host-tail arithmetic
@@ -77,7 +82,7 @@ DRA_VIEW_PHASES = ("dra_mask_compile", "dra_device_eval", "dra_commit")
 # NOTE: learned_score is NOT here — its time is exclusive (nothing else
 # measures the checkpoint poll), so hiding it would let a slow reload
 # path pass the --ab-scorer parity gate unseen
-VIEW_PHASES = DRA_VIEW_PHASES
+VIEW_PHASES = DRA_VIEW_PHASES + ("device_compile",)
 
 # trace-export JSON-lines format version (CycleTrace.to_dict "v"):
 # v2 added per-pod placement rows (pod, chosen node, aggregate score,
@@ -441,7 +446,7 @@ class PodTimelines:
         if e is None:
             e = {"uid": uid, "name": pod.metadata.name,
                  "namespace": pod.metadata.namespace,
-                 "events": [], "diagnosis": None}
+                 "events": [], "diagnosis": None, "wire": {}}
             self._pods[uid] = e
             self._by_name[f"{pod.metadata.namespace}/"
                           f"{pod.metadata.name}"] = uid
@@ -477,6 +482,44 @@ class PodTimelines:
             "message": message,
         }
 
+    def wire_stamp(self, pod, stamp: str, t: float, origin: str = "",
+                   hops: int = 0) -> None:
+        """Record one cross-wire trace stamp (telemetry.trace) on this
+        pod's timeline: ``created`` (the pod's hub add commit),
+        ``bound`` (the bind's hub commit), ``acked`` (the kubelet's
+        status-Running commit), ``kubelet_recv`` (the bound event's
+        arrival at the kubelet after its relay hops). Last stamp wins —
+        a relist replaying an event re-stamps identically. Also logged
+        as an ordinary timeline event so /debug/pod reads as one
+        story."""
+        e = self._entry(pod)
+        e["wire"][stamp] = {"t": round(t, 6), "origin": origin,
+                            "hops": hops}
+        detail = f"origin={origin} hops={hops}" if origin else ""
+        ev = e["events"]
+        ev.append((t, f"wire:{stamp}", detail))
+        if len(ev) > self.MAX_EVENTS_PER_POD:
+            del ev[8:len(ev) - self.MAX_EVENTS_PER_POD + 8]
+
+    def wire_of(self, uid: str) -> Optional[dict]:
+        """The raw wire stamps recorded so far for one pod (None when
+        the pod is untracked or unstamped) — the export rows' trace
+        column reads this at commit time."""
+        e = self._pods.get(uid)
+        return (e["wire"] or None) if e else None
+
+    def joined(self, uid: str) -> Optional[dict]:
+        """The joined end-to-end trace for one pod (or None while
+        incomplete) — telemetry.trace.joined_latency over the wire
+        stamps."""
+        from kubernetes_tpu.telemetry.trace import joined_latency
+
+        e = self._pods.get(uid)
+        return joined_latency(e) if e else None
+
+    def uids(self) -> list[str]:
+        return list(self._pods)
+
     def get(self, name: str = "", uid: str = "",
             namespace: str = "default") -> Optional[dict]:
         if not uid and name:
@@ -484,12 +527,16 @@ class PodTimelines:
         e = self._pods.get(uid)
         if e is None:
             return None
+        from kubernetes_tpu.telemetry.trace import joined_latency
+
         return {
             "uid": e["uid"], "name": e["name"],
             "namespace": e["namespace"],
             "events": [{"t": round(t, 6), "event": ev, "detail": d}
                        for t, ev, d in e["events"]],
             "diagnosis": e["diagnosis"],
+            "wire": dict(e["wire"]),
+            "joined": joined_latency(e),
         }
 
     def forget(self, uid: str) -> None:

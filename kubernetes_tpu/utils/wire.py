@@ -20,12 +20,17 @@ def _registry() -> dict[str, type]:
     if not _REGISTRY:
         import kubernetes_tpu.api.objects as objects
         from kubernetes_tpu.leaderelection import Lease
+        from kubernetes_tpu.telemetry.trace import TraceContext
 
         for mod_attr in vars(objects).values():
             if dataclasses.is_dataclass(mod_attr) and isinstance(mod_attr,
                                                                  type):
                 _REGISTRY[mod_attr.__name__] = mod_attr
         _REGISTRY["Lease"] = Lease
+        # the per-commit trace stamp rides inside watch events on both
+        # codecs (a new kind = a bin1 registry-fingerprint bump; the
+        # negotiation's JSON fallback covers fingerprint-skewed peers)
+        _REGISTRY["TraceContext"] = TraceContext
     return _REGISTRY
 
 

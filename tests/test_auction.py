@@ -164,3 +164,8 @@ def test_subset_blobs_with_tolerations_and_affinity():
         name = mirror.name_of_row(r)
         node_zone = int(name.split("-")[1]) % 2
         assert node_zone == i % 2, "nodeSelector zone must be honored"
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.core

@@ -233,3 +233,8 @@ def test_cache_comparer_against_hub():
     assumed.spec.node_name = "n0"
     cache.assume_pod(assumed)
     assert cache.compare_with_hub(hub) == []
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.core

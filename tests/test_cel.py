@@ -93,3 +93,7 @@ def test_errors_raise_cel_error():
         evaluate("(lambda: 1)()", d)
     with pytest.raises(CelError):
         evaluate("device.driver == ", d)
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+pytestmark = pytest.mark.core

@@ -240,3 +240,8 @@ def test_daemon_thread_schedules_and_stops():
     finally:
         sched.stop()
     assert sched._daemon is None
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.core

@@ -301,3 +301,7 @@ def test_incremental_drift_node_recreated_same_name_is_not_stale():
     cache.add_node(node2)
     report = cache.drift_report(hub, since_rv=base.rv)
     assert report.count() == 0, report.render()
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+pytestmark = pytest.mark.core

@@ -626,3 +626,8 @@ def test_extender_preempt_meta_victims_for_cache_capable():
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.core

@@ -200,3 +200,8 @@ def test_validation_deep():
     cfg = default_config()
     cfg.binding_workers = 0
     assert any("binding_workers" in e for e in validate_config(cfg))
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.core

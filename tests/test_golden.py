@@ -451,3 +451,7 @@ def test_affinity_namespaces_respected():
     pod2 = _mkpod("p2", affinity=_aff(zone_sel=SEL(app="db"),
                                       ns=["other"]))
     assert feasible_set(pod2, nodes, [other_ns]) == {"node-a", "node-b"}
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+pytestmark = pytest.mark.core

@@ -183,3 +183,7 @@ def test_node_ports_golden(name, want_ports, existing_ports, want):
     if not want:
         _, plugins = reject_plugins(pod, [node], existing)
         assert "NodePorts" in plugins, name
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+pytestmark = pytest.mark.core

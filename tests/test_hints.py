@@ -324,3 +324,8 @@ def test_end_to_end_pvc_event_requeues_exactly_owner():
     assert hub.get_pod(b.metadata.uid).spec.node_name in ("", None), \
         "the stranger stayed parked"
     sched.close()
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.core

@@ -602,3 +602,7 @@ def test_journal_metrics_exported_on_scheduler():
         assert "dra_cel_errors_total" in text
     finally:
         sched.close()
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+pytestmark = pytest.mark.core

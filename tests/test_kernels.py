@@ -330,3 +330,7 @@ def test_image_locality_score():
     s = np.asarray(OS.image_locality(rig.ct, pf, jnp.int32(2)))
     by = {n: s[r] for n, r in zip(rig.names, rig.rows)}
     assert by["has"] > by["not"] == 0.0
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+pytestmark = pytest.mark.core

@@ -91,3 +91,8 @@ def test_hollow_nodes_feed_scheduler_across_processes():
             feeder.terminate()
             feeder.wait(timeout=10)
         server.stop()
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.fabric

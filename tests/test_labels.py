@@ -119,3 +119,8 @@ def test_interner():
     import math
     assert math.isnan(it.numeric(a))
     assert it.string(0) == ""
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.core

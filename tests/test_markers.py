@@ -1,0 +1,69 @@
+"""CI/tooling satellite (ISSUE 10): marker discipline cannot rot.
+
+Two static checks over the test tree, no imports (importing 40+ test
+modules to introspect them would drag jax into a lint):
+
+* every test module carries at least one marker REGISTERED in
+  pyproject.toml (module-level ``pytestmark`` or a mark decorator) —
+  so tier-1 vs slow vs area membership is an explicit, greppable
+  property of each module as the suite grows;
+* every marker USED anywhere in tests/ is registered — a typo'd
+  ``slwo`` would otherwise silently run in tier-1 instead of being
+  excluded (``--strict-markers`` in pyproject enforces this at collect
+  time too; this test makes the failure message name the file).
+"""
+
+import re
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.core
+
+TESTS_DIR = Path(__file__).parent
+PYPROJECT = TESTS_DIR.parent / "pyproject.toml"
+
+# pytest.mark.<name> and pytest.mark.<name>(...) both count; so does
+# a pytestmark list assignment
+_MARK_RE = re.compile(r"pytest\.mark\.([A-Za-z_][A-Za-z0-9_]*)")
+
+# built-in marks that need no registration
+_BUILTIN = {"skip", "skipif", "xfail", "parametrize", "usefixtures",
+            "filterwarnings", "timeout"}
+
+
+def registered_markers() -> set[str]:
+    text = PYPROJECT.read_text()
+    m = re.search(r"markers\s*=\s*\[(.*?)\]", text, re.DOTALL)
+    assert m, "pyproject.toml lost its [tool.pytest.ini_options] markers"
+    names = re.findall(r'"([A-Za-z_][A-Za-z0-9_]*)\s*:', m.group(1))
+    assert names, "no registered markers parsed from pyproject.toml"
+    return set(names)
+
+
+def module_marks() -> dict[str, set[str]]:
+    out = {}
+    for path in sorted(TESTS_DIR.glob("test_*.py")):
+        marks = set(_MARK_RE.findall(path.read_text())) - _BUILTIN
+        out[path.name] = marks
+    return out
+
+
+def test_every_test_module_carries_a_registered_marker():
+    registered = registered_markers()
+    missing = [name for name, marks in module_marks().items()
+               if not (marks & registered)]
+    assert not missing, (
+        f"test modules without any registered marker {sorted(registered)}: "
+        f"{missing} — add a module-level `pytestmark = pytest.mark.<area>` "
+        "so suite-tier discipline stays explicit")
+
+
+def test_every_used_marker_is_registered():
+    registered = registered_markers()
+    rogue = {name: sorted(marks - registered)
+             for name, marks in module_marks().items()
+             if marks - registered}
+    assert not rogue, (
+        f"unregistered markers in use (typo'd marks silently run in "
+        f"tier-1): {rogue}; register in pyproject.toml or fix the name")

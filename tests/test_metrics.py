@@ -249,3 +249,64 @@ def test_slow_cycle_emits_trace(caplog):
         sched.run_until_idle()
     assert any("Trace[schedule_cycle]" in r.message for r in caplog.records)
     sched.close()
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.observability
+
+
+# -------------------------- metrics lint (ISSUE-10 satellite) --------
+
+
+def test_registry_metric_names_and_labels_conform():
+    """Every metric registered in metrics.py obeys the Prometheus
+    grammar — name [a-zA-Z_:][a-zA-Z0-9_:]*, labels
+    [a-zA-Z_][a-zA-Z0-9_]* — and mirrored-gauge vs true-counter naming
+    stays honest (_total only on Counters)."""
+    from kubernetes_tpu.metrics import (
+        Counter as MCounter,
+        Histogram as MHistogram,
+        SchedulerMetrics,
+    )
+    from kubernetes_tpu.telemetry.fleet import (
+        LABEL_NAME_RE,
+        METRIC_NAME_RE,
+    )
+
+    m = SchedulerMetrics()
+    for name, metric in m.registry._metrics.items():
+        assert METRIC_NAME_RE.match(name), name
+        assert name == metric.name
+        for ln in getattr(metric, "label_names", ()) or ():
+            assert LABEL_NAME_RE.match(ln), f"{name}{{{ln}}}"
+        if name.endswith("_total"):
+            assert isinstance(metric, MCounter), (
+                f"{name}: _total is reserved for true counters")
+        if isinstance(metric, MHistogram):
+            assert not name.endswith(("_total", "_bucket", "_sum",
+                                      "_count")), name
+
+
+def test_full_exposition_round_trips_strict_parser():
+    """The complete /metrics body — histograms, escaped label values,
+    callback gauges — re-parses under telemetry.fleet's strict parser
+    (locks in the PR-4 escaping fix; the fleet merge ingests this)."""
+    from kubernetes_tpu.metrics import SchedulerMetrics
+    from kubernetes_tpu.telemetry.fleet import parse_exposition
+
+    m = SchedulerMetrics(pending_fn=lambda: {"activeQ": 3})
+    m.schedule_attempts.inc(result='nasty "quotes" and \\slashes\n',
+                            profile="default")
+    m.phase_duration.observe(0.004, phase="device_launch")
+    m.pod_e2e_duration.observe(0.5, attempts="2")
+    m.device_compiles.inc(cause="rebucket")
+    m.device_live_buffer_bytes.set(1024.0, buffer="cluster")
+    exp = parse_exposition(m.registry.render_text())
+    names = {s.name for s in exp.samples}
+    assert "scheduler_device_compiles_total" in names
+    assert "scheduling_phase_duration_seconds_bucket" in names
+    assert "pending_pods" in names
+    # the nasty label survived the escape/unescape round trip
+    assert any(s.labels.get("result") == 'nasty "quotes" and '
+               "\\slashes\n" for s in exp.samples)

@@ -68,3 +68,8 @@ def test_non_mi_granular_quantities_round_conservatively():
     req2 = mirror._res_row(pod_request(pod2))
     assert float(req2[COL_MEM]) == tib16 / MI
     assert bool(req2[COL_MEM] <= free_mem)
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.core

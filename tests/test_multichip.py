@@ -148,3 +148,7 @@ def test_production_scheduler_mesh_parity_1k_nodes():
     # every pod (including later-evicted victims) was scheduled at least once
     assert s_sh.stats["scheduled"] == 64 + 32 + 8 + 4
     assert s_sh.stats["scheduled"] == s_base.stats["scheduled"]
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+pytestmark = pytest.mark.core

@@ -141,3 +141,7 @@ def test_heap_degrades_on_wide_sort_key():
     assert h._nh is None, "3-tuple sort key must degrade, not truncate"
     h.add(("b", 1.0, 1.0, 1.0))
     assert h.pop()[0] == "b"
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+pytestmark = pytest.mark.core

@@ -300,3 +300,7 @@ def test_auction_vs_scan_property_1k_nodes(seed):
     # balance: neither mode may hotspot relative to the other
     assert abs(int(results["scan"]["per_node"].max())
                - int(results["auction"]["per_node"].max())) <= 3
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+pytestmark = pytest.mark.core

@@ -325,3 +325,8 @@ def test_ns_selector_preferred_anti_affinity_tiny():
     # PREFERRED anti-affinity: soft avoidance only, everything schedules
     assert r["pods_scheduled"] == 5
     assert r["stats"]["unschedulable"] == 0
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.perf

@@ -193,3 +193,8 @@ def test_pct_nodes_start_carries_across_launches():
         assert rows2[0] >= start1, (start1, rows2[0])
     # and the seeded trajectory ends at a different offset
     assert int(out2.pct_start) != start1
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.core

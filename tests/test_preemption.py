@@ -365,3 +365,8 @@ def test_sweep_never_drops_inactive_resource_constraint():
     for v in victims:
         assert hub.get_pod(v.metadata.uid) is not None, \
             "no victim may be evicted for an unresolvable preemptor"
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.core

@@ -26,3 +26,8 @@ def test_bytes():
 def test_pods():
     assert parse_int("110") == 110
     assert parse_int("1k") == 1000
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.core

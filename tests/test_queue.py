@@ -174,3 +174,8 @@ def test_error_backoff_separate_counter():
     qp = QueuedPodInfo(pod=mkpod("p"), timestamp=clock.now())
     qp.consecutive_errors_count = 3
     assert q.backoff_remaining(qp) == 4.0
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.core

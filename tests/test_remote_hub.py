@@ -227,3 +227,7 @@ def test_reflector_reconnects_and_relists():
     finally:
         client.close()
         server2.stop()
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+pytestmark = pytest.mark.fabric

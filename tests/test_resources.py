@@ -71,3 +71,8 @@ def test_non_zero_defaults():
     nz = pod_request(pod, non_zero=True)
     assert nz.milli_cpu == DEFAULT_MILLI_CPU_REQUEST
     assert nz.memory == DEFAULT_MEMORY_REQUEST
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.core

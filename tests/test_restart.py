@@ -217,3 +217,8 @@ def test_scheduling_under_node_churn():
                         and "in cache but not in apiserver" in x)]
     assert not problems, problems
     sched.close()
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.core

@@ -208,3 +208,8 @@ def test_node_deleted_while_pods_pending():
     sched.run_until_idle()
     assert bound_node(hub, p2) == ""
     assert sched.stats["unschedulable"] >= 1
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.core

@@ -287,3 +287,8 @@ def test_host_oracle_matches_device_semantics():
     # nil selector matches nothing
     nil_term = PodAffinityTerm(topology_key=LABEL_ZONE)
     assert not m.term_matches_pod(nil_term, owner, mkpod("t5", {}))
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.core

@@ -173,3 +173,8 @@ def test_two_profiles_different_strategies_in_one_drain():
     sched.run_until_idle()
     assert hub.get_pod(spread_pod.metadata.uid).spec.node_name != busy_node
     assert hub.get_pod(pack_pod.metadata.uid).spec.node_name == busy_node
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.core

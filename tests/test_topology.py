@@ -327,3 +327,8 @@ def test_preferred_anti_affinity_scores():
     cl = Cluster(ZONES, [mkpod("db", {"app": "db"}, node="n1")])
     names, _ = cl.run([mkpod("p", affinity=w)])
     assert names == ["n3"]
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.core

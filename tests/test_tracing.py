@@ -252,11 +252,17 @@ def test_scheduler_records_cycle_phases_and_timelines():
         assert m.plugin_duration.total_count() >= 1
         # the reference's e2e pod_scheduling_duration_seconds by attempts
         assert m.pod_e2e_duration.count(attempts="1") == 5
-        # timelines: enqueued -> popped -> bound
+        # timelines: wire-created -> enqueued -> popped -> bound (the
+        # hub commit's trace stamp now anchors the timeline)
         t = sched.timelines.get(name="p0")
         evs = [e["event"] for e in t["events"]]
-        assert evs[0] == "enqueued"
+        assert evs[0] == "wire:created"
+        assert evs[1] == "enqueued"
         assert "popped" in evs and "bound" in evs
+        # the cross-wire join: created + bound stamps present (no
+        # kubelet in this harness, so no ack — joined stays None)
+        assert "created" in t["wire"] and "bound" in t["wire"]
+        assert t["joined"] is None
         text = m.registry.render_text()
         assert "scheduling_phase_duration_seconds_bucket" in text
         assert "plugin_execution_duration_seconds_bucket" in text
@@ -372,7 +378,8 @@ def test_debug_trace_and_pod_endpoints_authz():
             pd = json.loads(_get(f"{base}/debug/pod?name=p0",
                                  token="s3cret").read())
             assert pd["name"] == "p0"
-            assert [e["event"] for e in pd["events"]][0] == "enqueued"
+            assert [e["event"] for e in pd["events"]][:2] \
+                == ["wire:created", "enqueued"]
             # the unschedulable pod's diagnosis rides the same endpoint
             sick = json.loads(_get(f"{base}/debug/pod?name=big",
                                    token="s3cret").read())
@@ -385,3 +392,7 @@ def test_debug_trace_and_pod_endpoints_authz():
             srv.stop()
     finally:
         sched.close()
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+pytestmark = pytest.mark.observability

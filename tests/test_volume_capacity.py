@@ -213,3 +213,8 @@ def test_capacity_event_requeues_parked_pod():
         sched.queue.flush_backoff_completed()
     sched.run_until_idle()
     assert bound(hub, p) == "n1"
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.core

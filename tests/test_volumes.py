@@ -286,3 +286,8 @@ def test_volume_pod_and_plain_pods_mix():
     assert bound_node(hub, vol_pod) == "node-0"
     assert all(bound_node(hub, p) for p in plain)
     assert sched.stats["scheduled"] == 6
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.core

@@ -53,3 +53,8 @@ def test_unknown_kind_raises():
     import pytest
     with pytest.raises(ValueError):
         from_wire({"__kind__": "NoSuchKind"})
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.fabric

@@ -88,3 +88,8 @@ def test_fake_reserve_failure_unreserves_and_requeues():
     assert fake.unreserved == fake.reserved, \
         "failed reserve must roll back via unreserve (schedule_one.go:212)"
     sched.close()
+
+
+# suite-tier discipline (tests/test_markers.py): area marker
+import pytest  # noqa: E402
+pytestmark = pytest.mark.core
