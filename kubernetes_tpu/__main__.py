@@ -40,6 +40,20 @@ def main(argv=None) -> int:
                         help="shard the in-process hub (fabric."
                              "sharded.ShardedHub) with N pod shards "
                              "(0 = single hub); ignored with --hub")
+    parser.add_argument("--fabric", type=int, default=0,
+                        help="spawn the OUT-OF-PROCESS control-plane "
+                             "fabric with N pod-shard processes (plus "
+                             "the shared-state shard, nodes/events/"
+                             "meta shards, and a stateless router, "
+                             "each its own OS process; fabric."
+                             "supervisor); the scheduler connects "
+                             "through the router. --wal names the "
+                             "shard WAL directory (bin1 codec). "
+                             "Ignored with --hub")
+    parser.add_argument("--fabric-wal-codec", default="bin1",
+                        choices=("json", "bin1"),
+                        help="journal WAL codec for --fabric shard "
+                             "processes (bin1 ≈ 6x smaller replay)")
     parser.add_argument("--journal-capacity", type=int, default=16384,
                         help="event-journal ring capacity per resource "
                              "kind (the watch-resume window)")
@@ -91,6 +105,7 @@ def main(argv=None) -> int:
         print("configuration valid")
         return 0
 
+    fabric_cluster = None
     if args.hub:
         # the kubemark/hubserver deployment shape: this process holds no
         # state, it list/watches a hub in another process and rides the
@@ -99,6 +114,22 @@ def main(argv=None) -> int:
 
         hub = RemoteHub(args.hub)
         print(f"using remote hub {args.hub}", file=sys.stderr)
+    elif args.fabric > 0:
+        # process-mode fabric: every shard its own OS process with its
+        # own WAL and port, a stateless router in front; this process
+        # is a pure client of the router (kill -9 a shard and watch
+        # the supervisor + WAL replay + re-registration heal it)
+        from kubernetes_tpu.fabric.supervisor import spawn_local_cluster
+        from kubernetes_tpu.hubclient import RemoteHub
+
+        fabric_cluster = spawn_local_cluster(
+            pod_shards=args.fabric, wal_dir=args.wal,
+            journal_capacity=args.journal_capacity,
+            wal_codec=args.fabric_wal_codec)
+        hub = RemoteHub(fabric_cluster.router_url)
+        print(f"fabric: {args.fabric} pod-shard processes + state/"
+              f"nodes/events/meta + router at "
+              f"{fabric_cluster.router_url}", file=sys.stderr)
     elif args.hub_shards > 0:
         from kubernetes_tpu.fabric.sharded import ShardedHub
 
@@ -203,6 +234,8 @@ def main(argv=None) -> int:
             serving.stop()
         sched.close()
         hub.close()   # RemoteHub: drain streams; local Hub: release WAL
+        if fabric_cluster is not None:
+            fabric_cluster.stop()
     return 0
 
 

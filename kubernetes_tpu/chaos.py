@@ -825,6 +825,156 @@ def run_crash_storm(pods: int = 1000, nodes: int = 24, seed: int = 13,
 
 
 # --------------------------------------------------------------------------
+# process-level crash storm: kill -9 a shard PROCESS (ISSUE 11)
+# --------------------------------------------------------------------------
+
+
+def run_proc_crash_storm(pods: int = 300, nodes: int = 12,
+                         seed: int = 19,
+                         timeout_s: float = 240.0) -> dict:
+    """The out-of-process fabric's crash storm: a scheduler (with
+    leader election) driving the cluster THROUGH the stateless router,
+    shards as separate OS processes, and a ``kill -9`` of a pod-shard
+    process mid-storm followed by a supervisor restart that replays the
+    shard's bin1 WAL onto a NEW port. ``ok`` iff every pod bound
+    EXACTLY once across the process death (the exactly-once ledger,
+    tallied off a watch through the router), the fencing epoch is
+    MONOTONE across the restart (the shared-state shard owns it — a
+    shard process dying must not reset hub-wide fencing), and a write
+    fenced with a stale epoch is still rejected afterwards."""
+    import tempfile
+
+    from kubernetes_tpu.config.types import default_config
+    from kubernetes_tpu.fabric.supervisor import spawn_local_cluster
+    from kubernetes_tpu.hub import EventHandlers, Fenced
+    from kubernetes_tpu.hubclient import RemoteHub
+    from kubernetes_tpu.leaderelection import LeaderElector
+    from kubernetes_tpu.ops.features import Capacities
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing import MakeNode, MakePod
+
+    report: dict = {"pods": pods, "nodes": nodes, "seed": seed,
+                    "procs": True}
+    wal_dir = tempfile.mkdtemp(prefix="proc-crash-wal-")
+    cluster = spawn_local_cluster(pod_shards=2, wal_dir=wal_dir)
+    client = RemoteHub(cluster.router_url, timeout=10.0,
+                       retry_deadline=3.0, retry_base=0.01,
+                       retry_cap=0.2)
+    ledger_client = RemoteHub(cluster.router_url, timeout=10.0)
+    sched = None
+    try:
+        for i in range(nodes):
+            client.create_node(MakeNode().name(f"pn-{i}")
+                               .capacity(cpu="64", memory="256Gi",
+                                         pods="440").obj())
+        # exactly-once ledger off the router's merged watch stream
+        bind_counts: dict[str, int] = {}
+        block = threading.Lock()
+
+        def on_update(old, new) -> None:
+            if not old.spec.node_name and new.spec.node_name:
+                with block:
+                    uid = new.metadata.uid
+                    bind_counts[uid] = bind_counts.get(uid, 0) + 1
+
+        ledger_client.watch_pods(EventHandlers(on_update=on_update),
+                                 replay=False)
+        cfg = default_config()
+        cfg.batch_size = 64
+        sched = Scheduler(client, cfg,
+                          caps=Capacities(nodes=max(32, nodes * 2),
+                                          pods=1024))
+        elector = LeaderElector(client.leases, "proc-a",
+                                lease_duration=2.0, renew_deadline=1.0,
+                                retry_period=0.1)
+        sched.start(elector=elector)
+        for i in range(pods):
+            client.create_pod(MakePod().name(f"pp-{i}")
+                              .namespace(f"ns-{i % 7}")
+                              .req(cpu="50m").obj())
+
+        def bound_count() -> int:
+            try:
+                return sum(1 for p in ledger_client.list_pods()
+                           if p.spec.node_name)
+            except Exception:  # noqa: BLE001 — mid-kill window
+                return -1
+
+        # phase 1: let the storm get going
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 60.0 \
+                and bound_count() < pods // 4:
+            time.sleep(0.2)
+        epoch_before = client.leases.epoch_of("kube-scheduler")
+        report["epoch_before_kill"] = epoch_before
+
+        # phase 2: kill -9 a pod-shard process mid-storm, then restart
+        victim = cluster.pod_shards[seed % len(cluster.pod_shards)]
+        report["killed_shard"] = victim
+        report["killed_pid"] = cluster.sup.kill_shard(victim)
+        time.sleep(1.0)          # the scheduler rides out the outage
+        restarted = cluster.sup.restart_shard(victim)
+        report["restarted_port"] = restarted.port
+
+        # phase 3: drain to completion across the restart
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if bound_count() >= pods:
+                break
+            time.sleep(0.3)
+        bound = bound_count()
+        epoch_after = client.leases.epoch_of("kube-scheduler")
+        report["epoch_after_restart"] = epoch_after
+        # a stale fencing epoch must still be rejected by the restarted
+        # shard (fencing lives on the state shard, not in the WAL).
+        # The probe pod carries a scheduler_name no profile owns, so
+        # the live scheduler never races the check — the gate runs in
+        # EVERY storm, including fully-drained successful ones.
+        probe = MakePod().name("fence-probe").namespace("ns-0") \
+            .scheduler_name("fence-probe-noop").obj()
+        client.create_pod(probe)
+        stale_fenced = False
+        if epoch_after > 0:
+            try:
+                # positional: the /call wire carries no kwargs
+                client.bind(probe, "pn-0", epoch_after - 1)
+            except Fenced:
+                stale_fenced = True
+        try:
+            client.delete_pod(probe.metadata.uid)
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+        with block:
+            dup = {uid: n for uid, n in bind_counts.items() if n > 1}
+        daemon_error = getattr(sched, "daemon_error", None)
+        report.update({
+            "bound": bound, "lost": pods - bound,
+            "duplicate_binds": dup,
+            "stale_epoch_fenced": stale_fenced,
+            "daemon_error": repr(daemon_error) if daemon_error
+            else None,
+            "client_relists":
+                client.resilience_stats()["watch_relists"],
+            "ok": (bound == pods and not dup
+                   and epoch_after >= epoch_before >= 1
+                   and stale_fenced and daemon_error is None),
+        })
+    finally:
+        if sched is not None:
+            try:
+                sched.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        for c in (client, ledger_client):
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        cluster.stop()
+    return report
+
+
+# --------------------------------------------------------------------------
 # gang-atomicity storm: leader kill mid-gang-commit (ISSUE 6)
 # --------------------------------------------------------------------------
 
@@ -1033,7 +1183,8 @@ def main() -> None:
     ap.add_argument("--nodes", type=int, default=8)
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--storm",
-                    choices=("smoke", "device", "crash", "gang", "all"),
+                    choices=("smoke", "device", "crash", "proc",
+                             "gang", "all"),
                     default="smoke",
                     help="which storm to run (bench.py --chaos-smoke "
                          "runs 'all')")
@@ -1045,6 +1196,8 @@ def main() -> None:
         report = run_device_storm(seed=args.seed)
     elif args.storm == "crash":
         report = run_crash_storm(seed=args.seed)
+    elif args.storm == "proc":
+        report = run_proc_crash_storm(seed=args.seed)
     elif args.storm == "gang":
         report = run_gang_storm(seed=args.seed)
     else:
@@ -1053,6 +1206,7 @@ def main() -> None:
                                seed=args.seed),
             "device": run_device_storm(seed=args.seed),
             "crash": run_crash_storm(seed=args.seed),
+            "proc": run_proc_crash_storm(seed=args.seed),
             "gang": run_gang_storm(seed=args.seed),
         }
         report["ok"] = all(r.get("ok") for r in report.values())

@@ -39,6 +39,16 @@ _LAZY = {
     "RelayServer": ("kubernetes_tpu.fabric.relay", "RelayServer"),
     "run_fanout_smoke": ("kubernetes_tpu.fabric.fanout",
                          "run_fanout_smoke"),
+    # out-of-process fabric (ISSUE 11): shard processes, the shared-
+    # state shard, the stateless router, and the local supervisor
+    "StateCore": ("kubernetes_tpu.fabric.cluster", "StateCore"),
+    "ProcShardHub": ("kubernetes_tpu.fabric.cluster", "ProcShardHub"),
+    "ClusterClient": ("kubernetes_tpu.fabric.cluster", "ClusterClient"),
+    "RouterServer": ("kubernetes_tpu.fabric.router", "RouterServer"),
+    "spawn_local_cluster": ("kubernetes_tpu.fabric.supervisor",
+                            "spawn_local_cluster"),
+    "run_fanout_smoke_procs": ("kubernetes_tpu.fabric.fanout",
+                               "run_fanout_smoke_procs"),
 }
 
 

@@ -1,0 +1,697 @@
+"""Out-of-process control-plane fabric: shard processes behind a
+stateless router.
+
+PR 9's ShardedHub proved the shard/wire layers; every shard still lived
+in ONE Python process. This module takes the split the rest of the way
+(ROADMAP item 3 — control-plane capacity that scales with hosts):
+
+* **shard processes** — each hub shard (nodes / events / meta /
+  ``pods-<i>``) runs as its own OS process: its own ``Hub`` with its
+  own lock, journal rings, WAL file (bin1 by default), and HTTP port
+  (:class:`ProcShardHub`, served by the ordinary ``HubServer``);
+* **the shared-state shard** — one tiny process
+  (:class:`StateCore`, also served by ``HubServer``) owns exactly the
+  state that cannot be split: the global **rv allocator** (every commit
+  on every shard draws its revision here, so resume points and sync
+  markers stay comparable across the whole fabric), the **LeaseStore**
+  (fencing epochs are a property of the control plane — a deposed
+  epoch is stale on every shard at once), the **crc32 ring map**
+  (slot → pod-shard, CAS'd by epoch for rebalances), and the
+  component **registries** (shards, routers, relays — the served
+  topology map relays and clients auto-discover through);
+* **the stateless router** (fabric.router) — any number of identical
+  processes fronting the shard set with the single-hub wire: ``/call``
+  routed by method + namespace-crc32 ring, ``/watch`` passed through
+  per shard with source-shard tags so clients keep per-shard resume
+  cursors.
+
+:class:`ClusterClient` is the routing brain (used by the router
+process, and directly by tests): a ``Hub``-shaped facade over one
+``RemoteHub`` per shard plus the state shard.
+
+Why per-shard cursors: each shard's stream is rv-ordered, but the
+cross-shard interleave is not — shard A can commit rv 100 *after*
+shard B commits rv 101, so a client that resumes "everything after my
+max rv 101" would silently lose A's 100 forever. A composite cursor
+(``cursors=pods-0:95,pods-1:101``) resumes every shard at exactly what
+the client saw *from it*; the shared allocator makes the per-shard
+suffixes add up to the complete global suffix. That is what makes
+"zero relists across a shard kill or a live ring rebalance" provable
+rather than probabilistic.
+
+Rebalancing a ring segment (:meth:`ClusterClient.rebalance_segment`)
+moves the segment's pods between live shard processes with **no
+events**: copy to the target (WAL attach record), flip the ring (CAS
+on the state shard), drop from the source (WAL detach record) — all
+under the router's migrate lock so writes to the moving segment wait a
+few milliseconds instead of landing on a stale owner. Watchers never
+see the move; their resume points stay servable because the source
+shard's journal keeps the pre-move history and new commits land on the
+target with fresh (higher) revisions from the shared allocator.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+
+from kubernetes_tpu.hub import Conflict, Hub, NotFound, Unavailable
+from kubernetes_tpu.leaderelection import LeaseStore
+
+RING_SLOTS = 64                  # virtual slots on the namespace ring
+RELAY_TTL_S = 10.0               # a relay missing heartbeats this long
+#                                  drops out of the served topology
+
+# single-kind hub methods, routed whole to the owning shard (mirrors
+# fabric.sharded's tables — the in-process and out-of-process routers
+# must agree on the split)
+_NODE_METHODS = frozenset({"create_node", "update_node", "delete_node",
+                           "get_node", "list_nodes"})
+_EVENT_METHODS = frozenset({"record_event", "list_events"})
+_POD_OBJ_METHODS = frozenset({"create_pod", "update_pod", "bind",
+                              "patch_pod_condition"})
+_POD_UID_METHODS = frozenset({"delete_pod", "get_pod",
+                              "set_pod_claim_statuses",
+                              "clear_nominated_node"})
+# per-shard segment verbs: meaningful only against ONE shard process —
+# the router rejects them (rebalance_segment is its move surface)
+_SHARD_ONLY_METHODS = frozenset({"export_segment", "import_segment",
+                                 "drop_segment", "reconcile_ring"})
+
+
+def ring_slot(namespace: str, ring_size: int = RING_SLOTS) -> int:
+    """Deterministic namespace → ring slot (crc32, NOT Python's
+    randomized hash: the mapping must survive restarts and agree
+    between every router and shard process)."""
+    return zlib.crc32(namespace.encode("utf-8")) % ring_size
+
+
+# --------------------------------------------------------------------------
+# the shared-state shard
+# --------------------------------------------------------------------------
+
+
+class _SharedRv:
+    """The global revision allocator, served over the wire as the
+    ``rv.*`` verbs. Monotonic across every shard process: one counter,
+    one lock, three tiny methods."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._last = 0
+
+    def next(self) -> int:
+        with self._lock:
+            self._last += 1
+            return self._last
+
+    def advance_to(self, rv: int) -> int:
+        """Raise the floor (shard WAL replays resume past the newest
+        revision any shard persisted); returns the current value."""
+        with self._lock:
+            if rv > self._last:
+                self._last = rv
+            return self._last
+
+    def last(self) -> int:
+        with self._lock:
+            return self._last
+
+
+class StateCore:
+    """The fabric's only stateful singleton beyond the shards
+    themselves: rv allocation, lease fencing, the ring map, and the
+    component registries. Deliberately tiny — it serves a handful of
+    sub-millisecond verbs and holds no object data, so it is never the
+    scale bottleneck the split exists to remove.
+
+    Served by the ordinary ``HubServer`` (codec negotiation, typed
+    errors, retries all come for free); it only implements the verbs it
+    owns, and answers ``get_journal_stats`` minimally so /metrics and
+    FleetView health checks work against it."""
+
+    def __init__(self, pod_shards: list[str] | None = None,
+                 ring_slots: int = RING_SLOTS) -> None:
+        self._lock = threading.Lock()
+        self.rv = _SharedRv()
+        self.leases = LeaseStore()
+        self._shards: dict[str, dict] = {}
+        self._routers: dict[str, dict] = {}
+        self._relays: dict[str, dict] = {}
+        names = list(pod_shards or [])
+        self._ring = {"epoch": 1,
+                      "slots": [names[i % len(names)]
+                                for i in range(ring_slots)]} \
+            if names else {"epoch": 0, "slots": []}
+
+    # ------------- registries -------------
+
+    def fabric_register_shard(self, name: str, url: str,
+                              kinds: list | None = None,
+                              pid: int | None = None) -> dict:
+        """A shard process announces itself (startup + heartbeat): the
+        routers resolve shard URLs here, which is how a shard restarted
+        on a NEW port heals the fabric without reconfiguration."""
+        with self._lock:
+            self._shards[name] = {"name": name, "url": url,
+                                  "kinds": list(kinds or []),
+                                  "pid": pid, "ts": time.time()}
+            return {"ring": dict(self._ring)}
+
+    def fabric_register_router(self, name: str, url: str,
+                               pid: int | None = None) -> dict:
+        with self._lock:
+            self._routers[name] = {"name": name, "url": url,
+                                   "pid": pid, "ts": time.time()}
+            return {"ok": True}
+
+    def fabric_register_relay(self, info: dict) -> dict:
+        """Relay heartbeat: name, url, parent, kinds, subscribers. The
+        served topology map is built from these — clients discover and
+        re-parent instead of being pointed by flag."""
+        with self._lock:
+            rec = dict(info)
+            rec["ts"] = time.time()
+            self._relays[rec["name"]] = rec
+            return {"ok": True}
+
+    def fabric_shards(self) -> dict:
+        with self._lock:
+            return {n: dict(s) for n, s in self._shards.items()}
+
+    def fabric_topology(self) -> dict:
+        """The auto-topology surface: live relays (heartbeat within
+        RELAY_TTL_S), routers, shards, and the ring epoch. Served open
+        (no token): it is pure wiring, and clients need it before they
+        have anything else."""
+        now = time.time()
+        with self._lock:
+            relays = [dict(r) for r in self._relays.values()
+                      if now - r["ts"] <= RELAY_TTL_S]
+            return {"routers": [dict(r) for r in
+                                self._routers.values()],
+                    "relays": relays,
+                    "shards": {n: dict(s)
+                               for n, s in self._shards.items()},
+                    "ring_epoch": self._ring["epoch"]}
+
+    # ------------- ring map -------------
+
+    def fabric_ring(self) -> dict:
+        with self._lock:
+            return {"epoch": self._ring["epoch"],
+                    "slots": list(self._ring["slots"])}
+
+    def fabric_set_ring(self, ring: dict, expect_epoch: int) -> bool:
+        """CAS by epoch: two routers racing a rebalance cannot both
+        win — the loser re-reads and retries (or gives up)."""
+        with self._lock:
+            if self._ring["epoch"] != expect_epoch:
+                return False
+            self._ring = {"epoch": int(ring["epoch"]),
+                          "slots": list(ring["slots"])}
+            return True
+
+    # ------------- fleet surface -------------
+
+    def get_journal_stats(self) -> dict:
+        """Minimal stats so /metrics renders against the state shard."""
+        with self._lock:
+            return {"rv": self.rv.last(), "capacity": 0, "wal": False,
+                    "kinds": {},
+                    "shards": {n: {"kinds": s["kinds"], "depth": 0,
+                                   "compacted_rv": 0, "commits": 0,
+                                   "rv": 0}
+                               for n, s in self._shards.items()}}
+
+    def close(self) -> None:
+        pass
+
+
+# --------------------------------------------------------------------------
+# the shard process's hub
+# --------------------------------------------------------------------------
+
+
+class ProcShardHub(Hub):
+    """One shard process's hub: a full ``Hub`` whose revisions, fencing
+    epochs, and lease surface live on the shared-state shard, reached
+    over the wire. Everything else — stores, journal rings, the WAL —
+    is process-local, which is the point: commits on different shards
+    contend only on the state shard's one-line allocator, never on each
+    other's locks or WAL fsyncs.
+
+    ``state`` is a RemoteHub (or anything with ``rv``/``leases``
+    namespaces). ``rv.next`` is retry-safe: a retried draw burns a
+    revision, and per-kind rv gaps are already the journal's
+    contract."""
+
+    def __init__(self, name: str, state, journal_capacity: int = 16384,
+                 wal_path: str | None = None, wal_codec: str = "bin1"):
+        self.shard_name = name
+        self.origin = name       # trace stamps name the committing shard
+        self._state = state
+        self.commits = 0
+        super().__init__(journal_capacity=journal_capacity,
+                         wal_path=wal_path, wal_codec=wal_codec)
+        # WAL replay ran with original revisions; the shared space must
+        # resume past the newest this shard persisted
+        if self._last_rv:
+            state.rv.advance_to(self._last_rv)
+        # fencing + leases are hub-wide: serve them from the state shard
+        # (an elector talking to any shard reaches the same store)
+        self.leases = state.leases
+
+    def _next_rv(self) -> int:
+        rv = self._state.rv.next()
+        self._last_rv = rv
+        return rv
+
+    def _newest_rv(self) -> int:
+        # resume checks and sync markers speak the GLOBAL space: a
+        # client's since_rv may have been minted by another shard
+        return self._state.rv.last()
+
+    def _check_fence(self, verb: str, epoch, lease_name: str) -> None:
+        if epoch is None:
+            return
+        cur = self._state.leases.epoch_of(lease_name)
+        if epoch < cur:
+            from kubernetes_tpu.hub import Fenced
+
+            raise Fenced(f"{verb} from deposed epoch {epoch} "
+                         f"(current {cur}, lease {lease_name!r})")
+
+    def _commit(self, store, etype, old, new):
+        self.commits += 1
+        return super()._commit(store, etype, old, new)
+
+    def get_journal_stats(self) -> dict:
+        st = super().get_journal_stats()
+        st["commits"] = self.commits
+        st["shard"] = self.shard_name
+        return st
+
+
+# --------------------------------------------------------------------------
+# the routing brain (lives inside each router process)
+# --------------------------------------------------------------------------
+
+
+class ClusterClient:
+    """``Hub``-shaped facade over the shard processes: one RemoteHub
+    per shard plus the state shard, routed exactly like the in-process
+    ShardedHub (by kind; namespace-crc32 ring for pods; uid ops by
+    probe). Stateless beyond connection handles and a TTL'd ring
+    cache — run as many of these (routers) as you like.
+
+    A shard restarting on a new port surfaces as ``Unavailable``; the
+    facade re-resolves the shard's URL from the state registry and
+    retries once, so a ``kill -9`` + supervisor restart heals without
+    touching the callers."""
+
+    def __init__(self, state_url: str, timeout: float = 30.0,
+                 client_factory=None, ring_ttl_s: float = 3.0):
+        from kubernetes_tpu.hubclient import RemoteHub
+
+        self._factory = client_factory or (
+            lambda url: RemoteHub(url, timeout=timeout))
+        self.state = self._factory(state_url)
+        self.leases = self.state.leases
+        self.rv = self.state.rv
+        self._lock = threading.RLock()
+        self._clients: dict[str, object] = {}
+        self._registry: dict[str, dict] = {}
+        self._ring: dict | None = None
+        self._ring_ts = 0.0
+        self._ring_ttl = ring_ttl_s
+        # held for the duration of a rebalance; pod WRITE routing takes
+        # it briefly so a write can never land on a stale segment owner
+        self._migrate_lock = threading.RLock()
+        self.refresh_shards()
+
+    # ------------- shard resolution -------------
+
+    def refresh_shards(self) -> None:
+        reg = self.state.fabric_shards()
+        with self._lock:
+            for name, rec in reg.items():
+                old = self._registry.get(name)
+                if old is not None and old["url"] != rec["url"]:
+                    # restarted on a new port: retire the stale client
+                    stale = self._clients.pop(name, None)
+                    if stale is not None:
+                        try:
+                            stale.close()
+                        except Exception:  # noqa: BLE001 — teardown
+                            pass
+                self._registry[name] = rec
+
+    def shard_url(self, name: str) -> str:
+        with self._lock:
+            rec = self._registry.get(name)
+        if rec is None:
+            self.refresh_shards()
+            with self._lock:
+                rec = self._registry.get(name)
+        if rec is None:
+            raise NotFound(f"unknown shard {name!r}")
+        return rec["url"]
+
+    def _client(self, name: str):
+        with self._lock:
+            c = self._clients.get(name)
+            if c is None:
+                c = self._clients[name] = self._factory(
+                    self.shard_url(name))
+            return c
+
+    def _invoke(self, name: str, method: str, *args):
+        try:
+            return getattr(self._client(name), method)(*args)
+        except Unavailable:
+            # maybe the shard restarted on a new port: re-resolve once
+            old = self.shard_url(name)
+            self.refresh_shards()
+            if self.shard_url(name) == old:
+                raise
+            return getattr(self._client(name), method)(*args)
+
+    # ------------- ring / kind routing -------------
+
+    def ring(self, fresh: bool = False) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            if not fresh and self._ring is not None \
+                    and now - self._ring_ts < self._ring_ttl:
+                return self._ring
+        r = self.state.fabric_ring()
+        with self._lock:
+            self._ring, self._ring_ts = r, now
+            return r
+
+    def pod_shard_names(self) -> list[str]:
+        seen: list[str] = []
+        for name in self.ring()["slots"]:
+            if name not in seen:
+                seen.append(name)
+        return seen
+
+    def _pod_shard_name(self, namespace: str) -> str:
+        slots = self.ring()["slots"]
+        if not slots:
+            raise Unavailable("fabric ring is empty (no pod shards)")
+        return slots[ring_slot(namespace, len(slots))]
+
+    def _kind_owner(self, watch_kind: str) -> str:
+        """Owning shard for a non-pod watch kind: exact kinds match in
+        the registry, else the catch-all ('*' = the meta shard)."""
+        with self._lock:
+            fallback = None
+            for name, rec in self._registry.items():
+                if watch_kind in rec.get("kinds", []):
+                    return name
+                if "*" in rec.get("kinds", []):
+                    fallback = name
+        if fallback is None:
+            raise NotFound(f"no shard owns kind {watch_kind!r}")
+        return fallback
+
+    def watch_targets(self, kinds: list[str]) -> dict[str, list[str]]:
+        """{shard name: [watch kinds]} for a /watch request — the
+        router dials each target once, multiplexed."""
+        out: dict[str, list[str]] = {}
+        for kind in kinds:
+            if kind == "pods":
+                for name in self.pod_shard_names():
+                    out.setdefault(name, [])
+                    if "pods" not in out[name]:
+                        out[name].append("pods")
+            else:
+                owner = self._kind_owner(kind)
+                out.setdefault(owner, [])
+                if kind not in out[owner]:
+                    out[owner].append(kind)
+        return out
+
+    # ------------- generic routing -------------
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name in _SHARD_ONLY_METHODS:
+            # these act on ONE shard's store; the router cannot pick a
+            # target for them, and silently hitting the meta shard
+            # would corrupt a manual rebalance — fail loudly with the
+            # supported surface instead
+            def reject(*_args, _m=name):
+                raise ValueError(
+                    f"{_m} is a shard-process verb: call the shard's "
+                    "URL directly, or drive moves through the "
+                    "router's rebalance_segment")
+
+            return reject
+        if name.startswith("fabric_"):
+            # registry/ring/topology verbs live on the state shard; the
+            # router forwards them so admins drive the fabric through
+            # the same URL everything else uses
+            return getattr(self.state, name)
+        if name in _NODE_METHODS:
+            return self._forwarder(self._kind_owner("nodes"), name)
+        if name in _EVENT_METHODS:
+            return self._forwarder(self._kind_owner("events"), name)
+        if not name.startswith("watch") and hasattr(Hub, name):
+            return self._forwarder(self._kind_owner("__meta__"), name)
+        raise AttributeError(name)
+
+    def _forwarder(self, shard: str, method: str):
+        def fwd(*args, _s=shard, _m=method):
+            return self._invoke(_s, _m, *args)
+
+        fwd.__name__ = method
+        return fwd
+
+    # ------------- pods (ring-routed) -------------
+
+    def create_pod(self, pod) -> None:
+        with self._migrate_lock:
+            self._invoke(self._pod_shard_name(pod.metadata.namespace),
+                         "create_pod", pod)
+
+    def update_pod(self, pod) -> None:
+        with self._migrate_lock:
+            self._invoke(self._pod_shard_name(pod.metadata.namespace),
+                         "update_pod", pod)
+
+    def bind(self, pod, node_name: str, epoch=None,
+             lease_name: str = "kube-scheduler") -> None:
+        with self._migrate_lock:
+            self._invoke(self._pod_shard_name(pod.metadata.namespace),
+                         "bind", pod, node_name, epoch, lease_name)
+
+    def patch_pod_condition(self, pod, condition, nominated_node=None,
+                            epoch=None,
+                            lease_name: str = "kube-scheduler") -> None:
+        with self._migrate_lock:
+            self._invoke(self._pod_shard_name(pod.metadata.namespace),
+                         "patch_pod_condition", pod, condition,
+                         nominated_node, epoch, lease_name)
+
+    def _probe_uid(self, uid: str):
+        for name in self.pod_shard_names():
+            if self._invoke(name, "get_pod", uid) is not None:
+                return name
+        return None
+
+    def delete_pod(self, uid: str, epoch=None,
+                   lease_name: str = "kube-scheduler") -> None:
+        with self._migrate_lock:
+            s = self._probe_uid(uid)
+            if s is None:
+                raise NotFound(f"Pod {uid}")
+            self._invoke(s, "delete_pod", uid, epoch, lease_name)
+
+    def get_pod(self, uid: str):
+        for name in self.pod_shard_names():
+            p = self._invoke(name, "get_pod", uid)
+            if p is not None:
+                return p
+        return None
+
+    def set_pod_claim_statuses(self, uid: str, statuses) -> None:
+        with self._migrate_lock:
+            s = self._probe_uid(uid)
+            if s is not None:
+                self._invoke(s, "set_pod_claim_statuses", uid, statuses)
+
+    def clear_nominated_node(self, uid: str, epoch=None,
+                             lease_name: str = "kube-scheduler") -> None:
+        with self._migrate_lock:
+            s = self._probe_uid(uid)
+            if s is not None:
+                self._invoke(s, "clear_nominated_node", uid, epoch,
+                             lease_name)
+
+    def list_pods(self) -> list:
+        # dedupe by uid keeping the newest revision: a rebalance's
+        # copy-before-drop overlap may briefly list a pod on two shards
+        best: dict[str, object] = {}
+        for name in self.pod_shard_names():
+            for p in self._invoke(name, "list_pods"):
+                cur = best.get(p.metadata.uid)
+                if cur is None or p.metadata.resource_version \
+                        >= cur.metadata.resource_version:
+                    best[p.metadata.uid] = p
+        return list(best.values())
+
+    # ------------- merged reads -------------
+
+    def list_changes(self, since_rv: int,
+                     kinds: tuple = ("pods", "nodes")) -> dict:
+        """Merged incremental LIST, consistency rv read from the shared
+        allocator BEFORE the shard scan (the ShardedHub discipline: a
+        commit landing on an already-scanned shard is re-examined next
+        pass, never skipped)."""
+        rv0 = self.state.rv.last()
+        merged: list[dict] = []
+        for shard, shard_kinds in self.watch_targets(list(kinds)).items():
+            res = self._invoke(shard, "list_changes", since_rv,
+                               tuple(shard_kinds))
+            if res.get("too_old"):
+                return {"too_old": True,
+                        "compacted_rv": res["compacted_rv"], "rv": rv0}
+            merged.extend(res["changes"])
+        merged.sort(key=lambda c: c["rv"])
+        return {"too_old": False, "rv": rv0, "changes": merged}
+
+    def get_journal_stats(self) -> dict:
+        kinds: dict = {}
+        shards: dict = {}
+        wal = False
+        cap = 0
+        with self._lock:
+            names = list(self._registry)
+        for name in names:
+            try:
+                st = self._invoke(name, "get_journal_stats")
+            except Unavailable:
+                shards[name] = {"error": "unavailable"}
+                continue
+            wal = wal or st.get("wal", False)
+            cap = max(cap, st.get("capacity", 0))
+            for kind, ks in st.get("kinds", {}).items():
+                agg = kinds.get(kind)
+                if agg is None:
+                    kinds[kind] = dict(ks)
+                else:
+                    agg["depth"] += ks["depth"]
+                    agg["compacted_rv"] = max(agg["compacted_rv"],
+                                              ks["compacted_rv"])
+                    agg["last_rv"] = max(agg["last_rv"], ks["last_rv"])
+            shards[name] = {
+                "kinds": sorted(st.get("kinds", {})),
+                "depth": sum(k["depth"]
+                             for k in st.get("kinds", {}).values()),
+                "compacted_rv": max(
+                    [k["compacted_rv"]
+                     for k in st.get("kinds", {}).values()],
+                    default=0),
+                "commits": st.get("commits", 0),
+                "rv": st.get("rv", 0),
+                "watchers": st.get("watchers", {}),
+            }
+        return {"rv": self.state.rv.last(), "capacity": cap,
+                "wal": wal, "kinds": kinds, "shards": shards}
+
+    def shard_map(self) -> dict:
+        from kubernetes_tpu.hubserver import WATCH_KINDS
+
+        out = {}
+        for kind in WATCH_KINDS:
+            if kind == "pods":
+                out["pods"] = self.pod_shard_names()
+            else:
+                try:
+                    out[kind] = self._kind_owner(kind)
+                except NotFound:
+                    out[kind] = None
+        return out
+
+    @property
+    def current_rv(self) -> int:
+        return self.state.rv.last()
+
+    # ------------- ring rebalance -------------
+
+    def rebalance_segment(self, slots: list, to_shard: str) -> dict:
+        """Move ring ``slots`` onto ``to_shard`` with zero dropped
+        resume points and zero events:
+
+        1. copy the segment's pods to the target (``import_segment``
+           WAL-attaches them with their original uids/revisions — a
+           concurrent LIST sees duplicates, which every client dedups
+           by uid+rv, never a hole);
+        2. CAS the ring map on the state shard (epoch bump);
+        3. drop the segment from the sources (WAL detach; their journal
+           rings keep the pre-move history, so a watch resuming across
+           the move still gets the complete per-shard suffixes).
+
+        The migrate lock is held throughout, so pod writes queue for
+        the few milliseconds the move takes instead of racing the
+        flip. A source dying mid-drop leaves a stale copy that its
+        restart reconciles away (``reconcile_ring``)."""
+        if to_shard not in self.pod_shard_names() \
+                and to_shard not in self._registry:
+            raise NotFound(f"unknown target shard {to_shard!r}")
+        with self._migrate_lock:
+            ring = self.ring(fresh=True)
+            size = len(ring["slots"])
+            moves: dict[str, list[int]] = {}
+            for s in slots:
+                if not 0 <= s < size:
+                    raise ValueError(f"slot {s} outside ring size {size}")
+                src = ring["slots"][s]
+                if src != to_shard:
+                    moves.setdefault(src, []).append(s)
+            moved = {}
+            for src, sl in moves.items():
+                pods = self._invoke(src, "export_segment", sl, size)
+                self._invoke(to_shard, "import_segment", pods)
+                moved[src] = len(pods)
+            new_slots = list(ring["slots"])
+            for s in slots:
+                new_slots[s] = to_shard
+            new_ring = {"epoch": ring["epoch"] + 1, "slots": new_slots}
+            if not self.state.fabric_set_ring(new_ring, ring["epoch"]):
+                raise Conflict("ring epoch moved under the rebalance; "
+                               "re-read and retry")
+            with self._lock:
+                self._ring, self._ring_ts = new_ring, time.monotonic()
+            pending = []
+            for src, sl in moves.items():
+                try:
+                    self._invoke(src, "drop_segment", sl, size)
+                except Unavailable:
+                    # the source died mid-move: its restart replays the
+                    # WAL (resurrecting the stale copy) and then
+                    # reconciles against the flipped ring
+                    pending.append(src)
+            return {"epoch": new_ring["epoch"], "moved": moved,
+                    "pending_drops": pending}
+
+    # ------------- lifecycle -------------
+
+    def close(self) -> None:
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for c in clients:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        try:
+            self.state.close()
+        except Exception:  # noqa: BLE001
+            pass

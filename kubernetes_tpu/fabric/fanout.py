@@ -426,6 +426,365 @@ def run_fanout_smoke(subscribers: int = 10000, l1_count: int = 2,
     return report
 
 
+def _wal_bytes(events: list[dict]) -> tuple[int, int]:
+    """(json_bytes, bin1_bytes) for the same WAL record stream — the
+    replay-size ratio the bin1 journal WAL buys, measured on the
+    storm's own events (the satellite's bench-artifact number)."""
+    from kubernetes_tpu.storage import Journal, JournalEvent
+
+    jb = bb = 0
+    for ev in events:
+        rec = Journal._event_record(JournalEvent(
+            rv=ev["rv"], kind="pods", type=ev["type"],
+            old=ev.get("old"), new=ev.get("new")))
+        jb += len(Journal._json_record(rec).encode()) + 1
+        bb += len(binwire.frame(binwire.encode(rec)))
+    return jb, bb
+
+
+def run_fanout_smoke_procs(subscribers: int = 50000, l1_count: int = 2,
+                           l2_count: int = 4, pods: int = 80,
+                           churn: int = 40, cuts: int = 10,
+                           resub: int = 300, seed: int = 23,
+                           pod_shards: int = 2,
+                           timeout_s: float = 360.0) -> dict:
+    """The PROCESS-MODE storm (ISSUE 11): shards as separate OS
+    processes behind the stateless router, relays discovered through
+    the served topology map (no flags), hollow-kubelet-analog
+    subscribers hanging off the auto-discovered tree. On top of the
+    in-process smoke's gates, this one must survive
+
+    * a watch-cut storm against the L1 relays' upstream streams
+      (healed by composite-cursor RESUME — 0 relists),
+    * one ``kill -9``'d pod-shard process mid-storm, restarted by the
+      supervisor with bin1-WAL replay onto a new port,
+    * one LIVE ring rebalance mid-storm (event-silent, resume points
+      intact),
+
+    with exact per-subscriber event counts, ≤ l1_count router sockets
+    per shard process, and a FleetView scrape showing every process
+    healthy under its own pid/port identity."""
+    import tempfile
+
+    from kubernetes_tpu.fabric.cluster import RING_SLOTS, ring_slot
+    from kubernetes_tpu.fabric.relay import (
+        RelayCore,
+        RelayServer,
+        discover_relay_url,
+    )
+    from kubernetes_tpu.fabric.router import fetch_topology
+    from kubernetes_tpu.fabric.supervisor import spawn_local_cluster
+    from kubernetes_tpu.hub import Unavailable
+    from kubernetes_tpu.hubclient import RemoteHub
+    from kubernetes_tpu.telemetry.fleet import FleetView
+    from kubernetes_tpu.testing import MakePod
+
+    # the exact-count gate needs untouched subscribers left over after
+    # the reconnect wave
+    resub = min(resub, subscribers // 3)
+    report: dict = {"procs": True, "subscribers": subscribers,
+                    "l1": l1_count, "l2": l2_count, "pods": pods,
+                    "cuts": cuts, "seed": seed,
+                    "pod_shards": pod_shards}
+    wal_dir = tempfile.mkdtemp(prefix="fabric-smoke-wal-")
+    cluster = spawn_local_cluster(pod_shards=pod_shards,
+                                  wal_dir=wal_dir)
+    client = RemoteHub(cluster.router_url, timeout=10.0)
+    l1_servers: list[RelayServer] = []
+    l2_cores: list[RelayCore] = []
+
+    def create_retry(pod, deadline_s: float = 30.0) -> None:
+        # the kill -9 window: writes to the dead shard's segment fail
+        # Unavailable until the supervisor restart re-registers it
+        end = time.monotonic() + deadline_s
+        while True:
+            try:
+                client.create_pod(pod)
+                return
+            except Unavailable:
+                if time.monotonic() > end:
+                    raise
+                time.sleep(0.2)
+
+    try:
+        # ---- the tree, discovered not configured ----
+        for i in range(l1_count):
+            core = RelayCore(cluster.router_url, kinds=("pods",),
+                             ring_capacity=65536, timeout=10.0)
+            l1_servers.append(RelayServer(
+                core, advertise={"state_url": cluster.router_url,
+                                 "name": f"l1-{i}",
+                                 "parent": cluster.router_url,
+                                 "interval_s": 0.5}).start())
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            topo = fetch_topology(cluster.router_url)
+            if len(topo.get("relays", [])) >= l1_count:
+                break
+            time.sleep(0.2)
+        report["advertised_relays"] = len(topo.get("relays", []))
+        for i in range(l2_count):
+            # each L2 discovers its parent from the served map
+            url = discover_relay_url(cluster.router_url, seed=i)
+            l2_cores.append(RelayCore(url, kinds=("pods",),
+                                      ring_capacity=65536,
+                                      timeout=10.0))
+        subs = [l2_cores[i % l2_count].subscribe(
+                    ("pods",), queue_limit=2_000_000)
+                for i in range(subscribers)]
+        resubbed: set[int] = set()
+
+        def l1_stats(key: str) -> int:
+            return sum(s.core.client.resilience_stats()[key]
+                       for s in l1_servers)
+
+        # ---- phase 1: pod storm across shards ----
+        t0 = time.monotonic()
+        for i in range(pods):
+            create_retry(MakePod().name(f"fan-{i}")
+                         .namespace(f"ns-{i % 7}")
+                         .req(cpu="100m").obj())
+
+        # ---- phase 2: watch-cut storm on the L1 upstream streams ----
+        base_resumes = l1_stats("watch_resumes")
+        base_relists = l1_stats("watch_relists")
+        ci = 0
+        deadline = time.monotonic() + timeout_s / 3
+        while l1_stats("watch_resumes") - base_resumes < cuts \
+                and time.monotonic() < deadline:
+            if ci % 2 == 0:
+                # cut a relay's upstream socket (no proxy in the
+                # process fabric: the cut IS the failure mode)
+                victim = l1_servers[ci % l1_count].core.client
+                with victim._wlock:
+                    handles = list(victim._watchers)
+                for h in handles:
+                    try:
+                        h.close()
+                    except OSError:
+                        pass
+            create_retry(MakePod().name(f"churn-{ci}")
+                         .namespace("churn").req(cpu="50m").obj())
+            if ci >= 1 and ci % 2 == 0:
+                doomed = [x for x in client.list_pods()
+                          if x.metadata.namespace == "churn"]
+                if doomed:
+                    try:
+                        client.delete_pod(doomed[0].metadata.uid)
+                    except Exception:  # noqa: BLE001 — already gone
+                        pass
+            ci += 1
+            time.sleep(0.05 if ci <= churn else 0.2)
+        report["upstream_resumes"] = l1_stats("watch_resumes") \
+            - base_resumes
+        report["upstream_relists"] = l1_stats("watch_relists") \
+            - base_relists
+
+        # ---- phase 3: kill -9 a shard process mid-storm ----
+        victim_shard = cluster.pod_shards[0]
+        ring_now = client.fabric_ring()
+        live_ns = [f"ns-{i}" for i in range(7)
+                   if ring_now["slots"][ring_slot(
+                       f"ns-{i}", len(ring_now["slots"]))]
+                   != victim_shard]
+        report["killed_pid"] = cluster.sup.kill_shard(victim_shard)
+        # keep committing: the live shard keeps flowing while the dead
+        # one's segment waits out the restart
+        for i in range(6):
+            create_retry(MakePod().name(f"during-kill-{i}")
+                         .namespace(live_ns[i % len(live_ns)])
+                         .req(cpu="50m").obj())
+        restarted = cluster.sup.restart_shard(victim_shard)
+        report["restarted_port"] = restarted.port
+        for i in range(6):
+            create_retry(MakePod().name(f"after-kill-{i}")
+                         .namespace(f"ns-{i % 7}").req(cpu="50m").obj())
+
+        # ---- phase 4: LIVE ring rebalance mid-storm ----
+        ring = client.fabric_ring()
+        slot = ring_slot("ns-0", len(ring["slots"]) or RING_SLOTS)
+        src = ring["slots"][slot]
+        dst = next(n for n in cluster.pod_shards if n != src)
+        report["rebalance"] = client.rebalance_segment([slot], dst)
+        for i in range(4):
+            create_retry(MakePod().name(f"post-move-{i}")
+                         .namespace("ns-0").req(cpu="50m").obj())
+
+        # ---- phase 5: mid-storm downstream reconnect wave ----
+        # composite-cursor resumes off the relay rings: zero 410s even
+        # across the kill and the rebalance
+        ring_410 = 0
+        for i in range(0, min(resub, subscribers)):
+            idx = (i * 37) % subscribers
+            if idx in resubbed:
+                continue
+            core = l2_cores[idx % l2_count]
+            old = subs[idx]
+            core.unsubscribe(old)
+            try:
+                subs[idx] = core.subscribe(
+                    ("pods",), since_rv=old.cursor,
+                    cursors={k: v for k, v in old.cursors.items()
+                             if k},
+                    queue_limit=2_000_000)
+            except Exception:  # noqa: BLE001 — RvTooOld = ring moved
+                ring_410 += 1
+                subs[idx] = core.subscribe(("pods",),
+                                           queue_limit=2_000_000)
+            resubbed.add(idx)
+        report["resub_wave"] = len(resubbed)
+        report["resub_ring_410s"] = ring_410
+        report["relay_resume_serves"] = sum(c.resume_serves
+                                            for c in l2_cores)
+
+        # ---- phase 6: convergence + exact per-subscriber counts ----
+        changes = client.list_changes(0, ("pods",)).get("changes", [])
+        expected = len(changes)
+        stats = client.get_journal_stats()
+        target_curs = {name: st.get("rv", 0)
+                       for name, st in stats["shards"].items()
+                       if name in cluster.pod_shards}
+
+        def lagging_count() -> int:
+            n = 0
+            for s in subs:
+                if s.evicted:
+                    continue
+                for shard, rv in target_curs.items():
+                    if s.cursors.get(shard, 0) < rv:
+                        n += 1
+                        break
+            return n
+
+        deadline = time.monotonic() + timeout_s / 3
+        lagging = subscribers
+        while time.monotonic() < deadline:
+            lagging = lagging_count()
+            if lagging == 0:
+                break
+            time.sleep(0.25)
+        report["lagging_subscribers"] = lagging
+        report["pod_events"] = expected
+        drained = [s.drain() for i, s in enumerate(subs)
+                   if i not in resubbed]
+        counts = [len(evs) for evs in drained]
+        report["event_count_min"] = min(counts)
+        report["event_count_max"] = max(counts)
+        exact = min(counts) == max(counts) == expected
+        shards_seen = {d.get("sh") for evs in drained[:50]
+                       for d in evs}
+        report["shards_seen"] = sorted(s for s in shards_seen if s)
+
+        # ---- phase 7: slow-subscriber eviction + recovery ----
+        evict_before = sum(c.slow_evictions for c in l2_cores)
+        slow = l2_cores[0].subscribe(("pods",), queue_limit=4)
+        for i in range(8):
+            create_retry(MakePod().name(f"evict-{i}")
+                         .namespace("evict").req(cpu="50m").obj())
+        deadline = time.monotonic() + 20.0
+        while not slow.evicted and time.monotonic() < deadline:
+            time.sleep(0.1)
+        report["slow_evicted"] = slow.evicted
+        report["slow_evictions_total"] = \
+            sum(c.slow_evictions for c in l2_cores) - evict_before
+        recovered = l2_cores[0].subscribe(
+            ("pods",), since_rv=slow.cursor,
+            cursors={k: v for k, v in slow.cursors.items() if k},
+            queue_limit=2_000_000)
+        final_curs = {name: st.get("rv", 0) for name, st in
+                      client.get_journal_stats()["shards"].items()
+                      if name in cluster.pod_shards}
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if all(recovered.cursors.get(s, 0) >= rv
+                   for s, rv in final_curs.items()):
+                break
+            time.sleep(0.1)
+        report["evicted_recovered"] = all(
+            recovered.cursors.get(s, 0) >= rv
+            for s, rv in final_curs.items())
+
+        # ---- phase 8: per-shard-process socket accounting ----
+        # each shard process must hold ≤ l1_count pod watch streams —
+        # the router's pass-through conns, one per L1 relay, however
+        # many subscribers hang downstream
+        shard_watchers = {}
+        for name, rec in client.fabric_shards().items():
+            if name not in cluster.pod_shards:
+                continue
+            sc = RemoteHub(rec["url"], timeout=5.0)
+            try:
+                st = sc.get_journal_stats()
+                shard_watchers[name] = st.get("watchers", {}) \
+                    .get("pods", 0)
+            finally:
+                sc.close()
+        report["shard_pod_watchers"] = shard_watchers
+        sockets_ok = all(v <= l1_count
+                         for v in shard_watchers.values())
+
+        # ---- phase 9: WAL replay-size ratio (bin1 vs JSON lines) ----
+        wire_events = [{"rv": c["rv"], "type": c["type"],
+                        "old": c["obj"] if c["type"] == "delete"
+                        else None,
+                        "new": None if c["type"] == "delete"
+                        else c["obj"]}
+                       for c in changes]
+        jb, bb = _wal_bytes(wire_events)
+        report["wal_bytes_json"] = jb
+        report["wal_bytes_bin1"] = bb
+        report["wal_replay_ratio"] = round(jb / max(bb, 1), 2)
+
+        # ---- phase 10: fleet health with per-process identity ----
+        endpoints = [{"component": "state", "shard": "state",
+                      "url": cluster.state_url},
+                     {"component": "router", "shard": "router-0",
+                      "url": cluster.router_url}]
+        endpoints += [{"component": "shard", "shard": name,
+                       "url": rec["url"]}
+                      for name, rec in
+                      client.fabric_shards().items()]
+        endpoints += [{"component": "relay", "shard": f"l1-{i}",
+                       "url": s.address}
+                      for i, s in enumerate(l1_servers)]
+        fleet = FleetView(endpoints)
+        records = fleet.scrape()
+        summary = fleet.summary(records)
+        pids = [r.get("pid") for r in summary["endpoints"]
+                if r["component"] in ("state", "shard", "router")]
+        report["fleet"] = {
+            "endpoints": summary["total"],
+            "healthy": summary["healthy"],
+            "pids_distinct": len(set(pids)) == len(pids)
+            and all(pids),
+            "ok": summary["ok"],
+        }
+        report["fanout_elapsed_s"] = round(time.monotonic() - t0, 2)
+
+        report["ok"] = bool(
+            report["upstream_resumes"] >= cuts
+            and report["upstream_relists"] == 0
+            and lagging == 0
+            and exact
+            and report["resub_ring_410s"] == 0
+            and report["relay_resume_serves"] >= len(resubbed)
+            and report["slow_evicted"]
+            and report["evicted_recovered"]
+            and sockets_ok
+            and len(report["shards_seen"]) >= 2
+            and report["wal_replay_ratio"] >= 3.0
+            and report["fleet"]["ok"]
+            and report["fleet"]["pids_distinct"])
+    finally:
+        for c in l2_cores:
+            c.close()
+        for s in l1_servers:
+            s.stop()
+        client.close()
+        cluster.stop()
+    return report
+
+
 def main() -> None:
     import argparse
 
@@ -434,10 +793,20 @@ def main() -> None:
     ap.add_argument("--subscribers", type=int, default=10000)
     ap.add_argument("--smoke", action="store_true",
                     help="small/fast variant (1k subscribers)")
+    ap.add_argument("--procs", action="store_true",
+                    help="process-mode variant: shard processes + "
+                         "stateless router + auto-discovered relays "
+                         "(50k subscribers unless --subscribers/"
+                         "--smoke)")
     ap.add_argument("--seed", type=int, default=23)
     args = ap.parse_args()
-    n = 1000 if args.smoke else args.subscribers
-    r = run_fanout_smoke(subscribers=n, seed=args.seed)
+    if args.procs:
+        n = 1000 if args.smoke else (
+            args.subscribers if args.subscribers != 10000 else 50000)
+        r = run_fanout_smoke_procs(subscribers=n, seed=args.seed)
+    else:
+        n = 1000 if args.smoke else args.subscribers
+        r = run_fanout_smoke(subscribers=n, seed=args.seed)
     print(json.dumps(r))
     raise SystemExit(0 if r["ok"] else 1)
 

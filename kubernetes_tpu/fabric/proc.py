@@ -1,0 +1,136 @@
+"""Fabric process entrypoint: ``python -m kubernetes_tpu.fabric.proc``.
+
+One binary, three roles — how every fabric process starts, whether the
+local supervisor (fabric.supervisor) spawned it or an operator did on
+another host:
+
+* ``--role state`` — the shared-state shard (rv allocator, lease
+  store, ring map, registries) behind a stock ``HubServer``;
+* ``--role shard --name pods-0 --kinds pods --state URL`` — one hub
+  shard process: a :class:`~kubernetes_tpu.fabric.cluster.ProcShardHub`
+  with its own WAL (bin1 by default) and port, registered with the
+  state shard so routers resolve it (and re-resolve it after a
+  restart lands on a new port);
+* ``--role router --state URL`` — a stateless router
+  (fabric.router.main is equivalent; this keeps one spawn surface).
+
+Every role prints ``LISTENING <port>`` on stdout once bound — the
+supervisor (or an operator's script) reads it instead of guessing
+ports — and keeps a registration heartbeat so the topology map stays
+truthful.
+
+None of this imports JAX: a shard process is a pure-Python storage
+node and starts in well under a second.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+
+def _serve_state(args) -> None:
+    from kubernetes_tpu.fabric.cluster import StateCore
+    from kubernetes_tpu.hubserver import HubServer
+
+    pod_shards = [s for s in (args.pod_shards or "").split(",") if s]
+    core = StateCore(pod_shards=pod_shards,
+                     ring_slots=args.ring_slots)
+    server = HubServer(core, host=args.host, port=args.port).start()
+    print(f"LISTENING {server._httpd.server_address[1]}", flush=True)
+    while True:
+        time.sleep(3600)
+
+
+def _serve_shard(args) -> None:
+    from kubernetes_tpu.fabric.cluster import ProcShardHub
+    from kubernetes_tpu.hubclient import RemoteHub
+    from kubernetes_tpu.hubserver import HubServer
+
+    state = RemoteHub(args.state, timeout=10.0)
+    hub = ProcShardHub(args.name, state,
+                       journal_capacity=args.journal_capacity,
+                       wal_path=args.wal or None,
+                       wal_codec=args.wal_codec)
+    server = HubServer(hub, host=args.host, port=args.port).start()
+    url = f"http://{args.host}:{server._httpd.server_address[1]}"
+    kinds = [k for k in args.kinds.split(",") if k]
+    reg = state.fabric_register_shard(args.name, url, kinds,
+                                      os.getpid())
+    if "pods" in kinds:
+        # killed-mid-rebalance healing: the WAL replay may have
+        # resurrected a segment this shard already handed off — drop
+        # anything the authoritative ring assigns elsewhere
+        ring = reg.get("ring") or state.fabric_ring()
+        slots = ring.get("slots") or []
+        if slots:
+            owned = [i for i, n in enumerate(slots) if n == args.name]
+            dropped = hub.reconcile_ring(owned, len(slots))
+            if dropped:
+                print(f"reconciled ring: dropped {dropped} stray pods",
+                      file=sys.stderr, flush=True)
+    print(f"LISTENING {server._httpd.server_address[1]}", flush=True)
+    while True:
+        time.sleep(args.heartbeat_s)
+        try:
+            state.fabric_register_shard(args.name, url, kinds,
+                                        os.getpid())
+        except Exception:  # noqa: BLE001 — state shard restarting
+            pass
+
+
+def _serve_router(args) -> None:
+    from kubernetes_tpu.fabric.router import RouterServer
+
+    server = RouterServer(args.state, host=args.host, port=args.port,
+                          name=args.name).start()
+    print(f"LISTENING {server.port}", flush=True)
+    while True:
+        time.sleep(3600)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="kubernetes_tpu.fabric.proc",
+        description="fabric process entrypoint (state shard / hub "
+                    "shard / router)")
+    ap.add_argument("--role", required=True,
+                    choices=("state", "shard", "router"))
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--name", default="shard")
+    ap.add_argument("--state", default=None,
+                    help="state-shard URL (shard/router roles)")
+    ap.add_argument("--kinds", default="",
+                    help="comma list of watch kinds this shard owns; "
+                         "'*' = the catch-all meta shard")
+    ap.add_argument("--wal", default=None,
+                    help="this shard's WAL file")
+    ap.add_argument("--wal-codec", default="bin1",
+                    choices=("json", "bin1"))
+    ap.add_argument("--journal-capacity", type=int, default=16384)
+    ap.add_argument("--pod-shards", default="",
+                    help="state role: comma list of pod shard names "
+                         "seeding the ring")
+    ap.add_argument("--ring-slots", type=int, default=64)
+    ap.add_argument("--heartbeat-s", type=float, default=2.0)
+    args = ap.parse_args(argv)
+    if args.role != "state" and not args.state:
+        ap.error(f"--role {args.role} requires --state")
+    try:
+        if args.role == "state":
+            _serve_state(args)
+        elif args.role == "shard":
+            _serve_shard(args)
+        else:
+            _serve_router(args)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,14 +57,21 @@ from kubernetes_tpu.storage import Journal, JournalEvent, RvTooOld
 
 
 class Subscriber:
-    """One downstream consumer: a bounded event queue + resume cursor.
+    """One downstream consumer: a bounded event queue + resume cursors.
     The producer (the relay's upstream reflector thread) appends and
     signals; the consumer (an HTTP handler thread, or the fanout
     smoke's in-process reflector) drains. ``evicted`` flips when the
-    queue hit its bound — the consumer must tear down and reconnect."""
+    queue hit its bound — the consumer must tear down and reconnect.
 
-    __slots__ = ("kinds", "queue", "event", "cursor", "evicted",
-                 "limit", "ident")
+    ``cursors`` is the PER-SOURCE-SHARD resume map (shard "" = an
+    untagged single-hub upstream): through the fabric router, streams
+    are rv-ordered per shard but not across shards, so the scalar
+    ``cursor`` (max rv, kept for display and single-hub callers) is
+    not a safe resume token on its own — reconnects hand ``cursors``
+    back to :meth:`RelayCore.subscribe`."""
+
+    __slots__ = ("kinds", "queue", "event", "cursor", "cursors",
+                 "sync_shards", "evicted", "limit", "ident")
 
     def __init__(self, kinds: tuple[str, ...], limit: int,
                  cursor: int, ident: int):
@@ -72,6 +79,8 @@ class Subscriber:
         self.queue: deque = deque()
         self.event = threading.Event()
         self.cursor = cursor           # newest rv enqueued for us
+        self.cursors: dict[str, int] = {}
+        self.sync_shards: dict[str, int] = {}
         self.evicted = False
         self.limit = limit
         self.ident = ident
@@ -103,21 +112,25 @@ class RelayCore:
         self.queue_limit = queue_limit
         self._ring_capacity = ring_capacity
         self._lock = threading.Lock()
-        self._journal = Journal(capacity=ring_capacity)
-        self._state: dict[str, dict[str, tuple[int, object]]] = \
+        # ring journals PER SOURCE SHARD ("" = untagged single-hub
+        # upstream): each shard's stream is rv-ordered, so each ring
+        # serves gapless per-shard suffixes; a resume merges them
+        self._rings: dict[str, Journal] = {}
+        self._ring_rv: dict[str, int] = {}
+        self._state: dict[str, dict[str, tuple]] = \
             {k: {} for k in self.kinds}
         self._subs: dict[str, list[Subscriber]] = \
             {k: [] for k in self.kinds}
         self._next_ident = 0
         self.last_rv = 0
-        # ring integrity: appends must be rv-ascending for changes_after
-        # to mean "everything after your cursor". An upstream RELIST
-        # replays in LIST order — the moment an out-of-order rv arrives
-        # the ring is SUSPECT: resumes answer RvTooOld (downstream
-        # relists from the state mirror, which is safe) until the sync
-        # marker resets the ring. Events still fan out live either way.
-        self._ring_rv = 0
-        self._ring_suspect = False
+        # ring integrity: appends must be rv-ascending PER SHARD for
+        # changes_after to mean "everything after your cursor". An
+        # upstream RELIST replays in LIST order — the moment an
+        # out-of-order rv arrives that shard's ring is SUSPECT: resumes
+        # answer RvTooOld (downstream relists from the state mirror,
+        # which is safe) until the sync marker resets the rings. Events
+        # still fan out live either way.
+        self._ring_suspect: set[str] = set()
         self._synced = threading.Event()
         # counters (relay_* metrics / the fanout smoke's gates)
         self.slow_evictions = 0
@@ -125,17 +138,23 @@ class RelayCore:
         self.relist_serves = 0         # downstream LIST replays served
         self.events_in = 0
         self.events_out = 0
-        factory = client_factory or (
+        self._factory = client_factory or (
             lambda url: RemoteHub(url, timeout=timeout))
-        self.client = factory(upstream_url)
+        self._handlers = {k: EventHandlers(
+            on_event=self._make_on_event(k),
+            on_sync=self._on_sync) for k in self.kinds}
+        self.client = self._factory(upstream_url)
         # ONE upstream connection for the whole kind set — the property
         # the tree exists for: the hub's socket count scales with
         # relays, not with subscribers
-        self.client.watch_kinds(
-            {k: EventHandlers(
-                on_event=self._make_on_event(k),
-                on_sync=self._on_sync) for k in self.kinds},
-            replay=True)
+        self.client.watch_kinds(self._handlers, replay=True)
+
+    def _ring_for(self, shard: str) -> Journal:
+        ring = self._rings.get(shard)
+        if ring is None:
+            ring = self._rings[shard] = Journal(
+                capacity=self._ring_capacity)
+        return ring
 
     # ------------- upstream side (reflector callbacks) -------------
 
@@ -147,43 +166,63 @@ class RelayCore:
             # crossed. An unstamped event (pre-telemetry upstream, LIST
             # replay) stays unstamped: hop data degrades, events flow.
             trace = ev.trace.hop() if ev.trace is not None else None
+            shard = ev.shard or ""
             d = {"type": ev.type, "rv": ev.rv, "kind": kind,
-                 "old": ev.old, "new": ev.new, "trace": trace}
+                 "old": ev.old, "new": ev.new, "trace": trace,
+                 "sh": ev.shard}
             with self._lock:
                 state = self._state[kind]
                 if ev.type == "delete":
                     state.pop(ev.old.metadata.uid, None)
                 else:
-                    state[ev.new.metadata.uid] = (ev.rv, ev.new)
-                if ev.rv > self._ring_rv:
-                    self._journal.append(JournalEvent(
+                    state[ev.new.metadata.uid] = (ev.rv, ev.new,
+                                                  ev.shard)
+                if ev.rv > self._ring_rv.get(shard, 0):
+                    self._ring_for(shard).append(JournalEvent(
                         rv=ev.rv, kind=kind, type=ev.type,
-                        old=ev.old, new=ev.new, trace=trace))
-                    self._ring_rv = ev.rv
+                        old=ev.old, new=ev.new, trace=trace,
+                        shard=ev.shard))
+                    self._ring_rv[shard] = ev.rv
                 else:
                     # LIST-ordered arrival (upstream relist replay):
-                    # the ring can no longer serve gapless resumes
-                    self._ring_suspect = True
+                    # this shard's ring can't serve gapless resumes
+                    self._ring_suspect.add(shard)
                 if ev.rv > self.last_rv:
                     self.last_rv = ev.rv
                 self.events_in += 1
                 self._fan_out(kind, d)
         return on_event
 
-    def _on_sync(self, rv: int, relisted: bool) -> None:
+    def _on_sync(self, rv: int, relisted: bool, shards=None) -> None:
         """Upstream sync marker. After a RELIST (first connect, or a
         410 fallback) the events just replayed arrived in LIST order —
-        the ring cannot serve rv-ordered resumes from them, so it
-        resets with its floor at the sync revision: a downstream cursor
-        below the floor answers 410 and relists from the state mirror,
-        which IS consistent. Journal resumes (the common reconnect)
-        keep the ring."""
+        the rings cannot serve rv-ordered resumes from them, so each
+        resets with its floor at its shard's sync revision (the
+        marker's ``shards`` map; the global rv when untagged): a
+        downstream cursor below the floor answers 410 and relists from
+        the state mirror, which IS consistent. Journal resumes (the
+        common reconnect) keep the rings."""
         with self._lock:
+            floors = dict(shards or {})
             if relisted or self._ring_suspect:
-                self._journal = Journal(capacity=self._ring_capacity)
-                self._journal.compact_floor = rv
-                self._ring_suspect = False
-                self._ring_rv = max(self._ring_rv, rv)
+                names = set(self._rings) | set(floors) or {""}
+                for shard in names:
+                    ring = Journal(capacity=self._ring_capacity)
+                    ring.compact_floor = floors.get(shard, rv)
+                    self._rings[shard] = ring
+                    self._ring_rv[shard] = max(
+                        self._ring_rv.get(shard, 0),
+                        floors.get(shard, rv))
+                self._ring_suspect.clear()
+            else:
+                # resume sync: rings keep serving; seed floors for any
+                # shard this relay has never heard from, so its cursor
+                # bookkeeping starts at the sync point
+                for shard, srv in floors.items():
+                    if shard not in self._rings:
+                        ring = self._ring_for(shard)
+                        ring.compact_floor = srv
+                        self._ring_rv[shard] = srv
             if rv > self.last_rv:
                 self.last_rv = rv
         self._synced.set()
@@ -192,6 +231,7 @@ class RelayCore:
         # caller holds the lock; eviction rebuilds the list after the
         # sweep so iteration stays cheap (no copy per event)
         subs = self._subs[kind]
+        sh = d.get("sh") or ""
         evicted_any = False
         for sub in subs:
             if sub.evicted:
@@ -210,6 +250,8 @@ class RelayCore:
             sub.queue.append(d)
             if d["rv"] > sub.cursor:
                 sub.cursor = d["rv"]
+            if d["rv"] > sub.cursors.get(sh, 0):
+                sub.cursors[sh] = d["rv"]
             self.events_out += 1
             sub.event.set()
         if evicted_any:
@@ -219,10 +261,15 @@ class RelayCore:
 
     def subscribe(self, kinds: tuple[str, ...] | None = None,
                   since_rv: int | None = None, replay: bool = True,
-                  queue_limit: int | None = None) -> Subscriber:
-        """Register a downstream reflector. ``since_rv`` resumes off
-        the relay's ring (RvTooOld when the cursor fell off it — the
-        caller relists, exactly the hub's contract); otherwise
+                  queue_limit: int | None = None,
+                  cursors: dict[str, int] | None = None) -> Subscriber:
+        """Register a downstream reflector. ``since_rv``/``cursors``
+        resume off the relay's per-shard rings (RvTooOld when any
+        needed cursor fell off its ring — the caller relists, exactly
+        the hub's contract): each source shard's ring replays its own
+        suffix after that shard's cursor (``cursors``; ``since_rv`` is
+        the fallback for shards the caller has no cursor for, and the
+        whole cursor against a single-hub upstream). Otherwise
         ``replay`` serves a LIST from the state mirror. Backlog and
         registration are atomic under the relay lock, so the
         subscriber's stream is gapless from its sync point."""
@@ -232,31 +279,46 @@ class RelayCore:
                 raise ValueError(f"relay does not carry kind {k!r}")
         if not self._synced.wait(timeout=30.0):
             raise RuntimeError("relay upstream never synced")
+        resume = since_rv is not None or cursors is not None
         with self._lock:
             sub = Subscriber(kinds, queue_limit or self.queue_limit,
                              self.last_rv, self._next_ident)
             self._next_ident += 1
-            if since_rv is not None:
-                if self._ring_suspect:
-                    # mid-relist window: the ring holds LIST-ordered
-                    # events and cannot promise a gapless suffix —
-                    # send this consumer to the state mirror instead
-                    raise RvTooOld(kinds[0], since_rv, self.last_rv)
-                evs = self._journal.changes_after(kinds, since_rv)
+            # "complete through here", per shard, at registration time
+            sub.sync_shards = {s: rv for s, rv in self._ring_rv.items()
+                               if s}
+            sub.cursors = dict(self._ring_rv)
+            if resume:
+                evs: list[JournalEvent] = []
+                for shard, ring in self._rings.items():
+                    cur = (cursors or {}).get(shard, since_rv) \
+                        if shard else since_rv
+                    if cur is None or shard in self._ring_suspect:
+                        # no cursor for a shard that has history, or a
+                        # mid-relist window (LIST-ordered ring): a
+                        # gapless suffix cannot be promised — send this
+                        # consumer to the state mirror instead
+                        raise RvTooOld(kinds[0],
+                                       cur if cur is not None else 0,
+                                       self.last_rv)
+                    evs.extend(ring.changes_after(kinds, cur))
+                evs.sort(key=lambda e: e.rv)
                 for ev in evs:
                     sub.queue.append({"type": ev.type, "rv": ev.rv,
                                       "kind": ev.kind, "old": ev.old,
-                                      "new": ev.new, "trace": ev.trace})
+                                      "new": ev.new, "trace": ev.trace,
+                                      "sh": ev.shard})
                 self.resume_serves += 1
             elif replay:
                 # state-mirror LIST replay: objects, not events — the
                 # commit stamps are gone, so these carry trace=None
                 # (the documented degradation; nothing is withheld)
                 for kind in kinds:
-                    for rv, obj in self._state[kind].values():
+                    for rv, obj, shard in self._state[kind].values():
                         sub.queue.append({"type": "add", "rv": rv,
                                           "kind": kind, "old": None,
-                                          "new": obj, "trace": None})
+                                          "new": obj, "trace": None,
+                                          "sh": shard})
                 self.relist_serves += 1
             for kind in kinds:
                 self._subs[kind].append(sub)
@@ -303,16 +365,44 @@ class RelayCore:
                            for s in subs}.values(),
                           key=lambda s: s.ident)
             listed = [{"id": s.ident, "kinds": list(s.kinds),
-                       "cursor": s.cursor, "queued": len(s.queue),
+                       "cursor": s.cursor,
+                       "cursors": {sh: rv for sh, rv
+                                   in s.cursors.items() if sh},
+                       "queued": len(s.queue),
                        "evicted": s.evicted}
                       for s in subs[:max_subscribers]]
-            ring = {k: {"depth": v["depth"],
-                        "compacted_rv": v["compacted_rv"]}
-                    for k, v in self._journal.stats().items()}
+            ring = {}
+            for shard, journal in self._rings.items():
+                for k, v in journal.stats().items():
+                    key = f"{shard}/{k}" if shard else k
+                    ring[key] = {"depth": v["depth"],
+                                 "compacted_rv": v["compacted_rv"]}
         st = self.stats()
         st.update({"ring": ring, "subscriber_cursors": listed,
                    "subscribers_total": st["subscribers"]})
         return st
+
+    def reparent(self, new_upstream_url: str) -> None:
+        """Re-home this relay onto a DIFFERENT parent (a sibling relay
+        or the router) discovered from the topology map, resuming from
+        its per-shard cursors: the shared rv space means a sibling's
+        rings speak the same coordinates, so the move costs a journal
+        resume — no relist, nothing dropped downstream. The old
+        connection closes FIRST (the gap is exactly what the resume
+        replays); a 410 from the new parent degrades to the diffed
+        relist, which keeps downstream continuity by construction."""
+        old = self.client
+        with self._lock:
+            curs = {s: rv for s, rv in self._ring_rv.items() if s}
+            since = self.last_rv if self.last_rv > 0 else None
+            self.upstream_url = new_upstream_url
+        try:
+            old.close()
+        except Exception:  # noqa: BLE001 — the old parent may be dead
+            pass
+        self.client = self._factory(new_upstream_url)
+        self.client.watch_kinds(self._handlers, replay=True,
+                                since_rv=since, cursors=curs or None)
 
     def close(self) -> None:
         self.client.close()
@@ -404,9 +494,14 @@ class _RelayHandler(BaseHTTPRequestHandler):
                 self._send_text(503, "upstream not synced")
             return
         if path.path == "/metrics":
-            from kubernetes_tpu.telemetry.fleet import relay_metrics_text
+            from kubernetes_tpu.telemetry.fleet import (
+                process_identity_text,
+                relay_metrics_text,
+            )
 
-            self._send_text(200, relay_metrics_text(self.core))
+            self._send_text(200, process_identity_text(
+                "relay", self.server.server_address[1])
+                + relay_metrics_text(self.core))
             return
         if path.path == "/debug/fabric":
             auth = self.server.debug_auth     # type: ignore[attr-defined]
@@ -430,7 +525,8 @@ class _RelayHandler(BaseHTTPRequestHandler):
         try:
             sub = self.core.subscribe(tuple(params.kinds),
                                       since_rv=params.since_rv,
-                                      replay=params.replay)
+                                      replay=params.replay,
+                                      cursors=params.cursors)
         except RvTooOld as e:
             # cursor fell off the relay ring: the 410 that sends the
             # client back for a relist — which the relay itself serves
@@ -455,11 +551,15 @@ class _RelayHandler(BaseHTTPRequestHandler):
         def write_all(ds: list[dict]) -> None:
             for d in ds:
                 write_event(d["kind"], d["type"], d["rv"],
-                            d["old"], d["new"], d.get("trace"))
+                            d["old"], d["new"], d.get("trace"),
+                            d.get("sh"))
 
         try:
             write_all(sub.drain())        # the subscribe-time backlog
-            write_obj({"synced": True, "rv": sub.cursor})
+            sync = {"synced": True, "rv": sub.cursor}
+            if sub.sync_shards:
+                sync["shards"] = dict(sub.sync_shards)
+            write_obj(sync)
             while not self.server.stopping:  # type: ignore[attr-defined]
                 if sub.evicted:
                     # slow-subscriber eviction: cut the stream; the
@@ -486,11 +586,20 @@ class _RelayHandler(BaseHTTPRequestHandler):
 
 class RelayServer:
     """relay = RelayServer(RelayCore(hub_url)).start(); point RemoteHub
-    clients (or child relays) at ``relay.address``."""
+    clients (or child relays) at ``relay.address``.
+
+    ``advertise`` opts into auto-topology: ``{"state_url": <state or
+    router URL>, "name": ..., "parent": ...}`` starts a heartbeat that
+    registers this relay (url, parent, kinds, live subscriber count)
+    with the state shard, putting it on the served topology map that
+    clients and child relays discover through (``pick_relay``) instead
+    of being pointed by flag. A relay that dies simply ages out of the
+    map (RELAY_TTL_S)."""
 
     def __init__(self, core: RelayCore, host: str = "127.0.0.1",
                  port: int = 0,
-                 debug_auth: Optional[Callable[[str], bool]] = None):
+                 debug_auth: Optional[Callable[[str], bool]] = None,
+                 advertise: Optional[dict] = None):
         self.core = core
         self._httpd = ThreadingHTTPServer((host, port), _RelayHandler)
         self._httpd.daemon_threads = True
@@ -498,22 +607,99 @@ class RelayServer:
         self._httpd.debug_auth = debug_auth   # type: ignore[attr-defined]
         self._httpd.stopping = False          # type: ignore[attr-defined]
         self._thread: threading.Thread | None = None
+        self._advertise = dict(advertise) if advertise else None
+        self._adv_stop = threading.Event()
+        self._adv_thread: threading.Thread | None = None
 
     @property
     def address(self) -> str:
         host, port = self._httpd.server_address[:2]
         return f"http://{host}:{port}"
 
+    def _heartbeat(self) -> None:
+        from kubernetes_tpu.hubclient import RemoteHub
+
+        adv = self._advertise
+        client = RemoteHub(adv["state_url"], timeout=5.0)
+        interval = adv.get("interval_s", 2.0)
+        try:
+            while True:
+                try:
+                    client.fabric_register_relay({
+                        "name": adv["name"],
+                        "url": self.address,
+                        "parent": adv.get("parent", ""),
+                        "kinds": list(self.core.kinds),
+                        "subscribers":
+                            self.core.subscriber_count()})
+                except Exception:  # noqa: BLE001 — state shard down:
+                    pass           # we age out of the map, correctly
+                if self._adv_stop.wait(interval):
+                    return
+        finally:
+            client.close()
+
     def start(self) -> "RelayServer":
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True, name="watch-relay")
         self._thread.start()
+        if self._advertise:
+            self._adv_thread = threading.Thread(
+                target=self._heartbeat, daemon=True,
+                name=f"relay-advertise-{self._advertise['name']}")
+            self._adv_thread.start()
         return self
 
     def stop(self) -> None:
+        self._adv_stop.set()
         self._httpd.stopping = True           # type: ignore[attr-defined]
         self._httpd.shutdown()
         self._httpd.server_close()
+        if self._adv_thread is not None:
+            self._adv_thread.join(timeout=5)
         if self._thread is not None:
             self._thread.join(timeout=5)
         self.core.close()
+
+
+# --------------------------------------------------------------------------
+# auto-topology discovery
+# --------------------------------------------------------------------------
+
+
+def pick_relay(topology: dict, kind: str = "pods", seed: int = 0,
+               exclude: tuple = ()) -> Optional[dict]:
+    """Choose a relay from a served topology map: prefer LEAF relays
+    (nothing re-parents onto an interior node unless it must), then
+    the least-subscribed, tie-broken by a stable hash so a client
+    population spreads instead of stampeding one relay. Returns the
+    relay record or None (caller falls back to the router)."""
+    import zlib as _z
+
+    relays = [r for r in topology.get("relays", [])
+              if kind in r.get("kinds", ["pods"])
+              and r.get("name") not in exclude]
+    if not relays:
+        return None
+    parents = {r.get("parent", "") for r in relays}
+    leaves = [r for r in relays if r["url"] not in parents]
+    pool = leaves or relays
+    return min(pool, key=lambda r: (
+        r.get("subscribers", 0),
+        _z.crc32(f"{r['name']}:{seed}".encode())))
+
+
+def discover_relay_url(topology_url: str, kind: str = "pods",
+                       seed: int = 0, exclude: tuple = ()) -> str:
+    """Fetch the topology map from a router and return the chosen
+    relay's URL, falling back to the first router (or the topology URL
+    itself) when no relay is advertised yet — a client is never
+    stranded by an empty map."""
+    from kubernetes_tpu.fabric.router import fetch_topology
+
+    topo = fetch_topology(topology_url)
+    chosen = pick_relay(topo, kind=kind, seed=seed, exclude=exclude)
+    if chosen is not None:
+        return chosen["url"]
+    routers = topo.get("routers", [])
+    return routers[0]["url"] if routers else topology_url

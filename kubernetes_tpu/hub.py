@@ -143,7 +143,8 @@ class Hub:
     origin = "hub"
 
     def __init__(self, journal_capacity: int = 16384,
-                 wal_path: str | None = None) -> None:
+                 wal_path: str | None = None,
+                 wal_codec: str = "json") -> None:
         self._lock = threading.RLock()
         self._last_rv = 0
         self._nodes = _Store("Node", "nodes", _by_name)
@@ -179,7 +180,7 @@ class Hub:
                 self._claim_templates, self._device_classes,
                 self._csi_capacities, self._pod_groups, self._events)}
         self.journal = Journal(capacity=journal_capacity,
-                               wal_path=wal_path)
+                               wal_path=wal_path, wal_codec=wal_codec)
         if wal_path:
             self._replay_wal()
         from kubernetes_tpu.leaderelection import LeaseStore
@@ -235,6 +236,12 @@ class Hub:
         max_rv = 0
         n_events = 0
         for ev in self.journal.replay_wal():
+            if isinstance(ev, dict):
+                # control record: a fabric ring-rebalance segment
+                # transfer — applied to the store, never journaled or
+                # dispatched (no watcher ever saw the move as events)
+                self._apply_xfer(ev)
+                continue
             store = self._stores.get(ev.kind)
             if store is not None:
                 if ev.type == "delete":
@@ -253,8 +260,26 @@ class Hub:
         # a WAL rewrite may have compacted past the last surviving event
         self._last_rv = max(max_rv, self.journal.compact_floor)
         live = sum(len(s.objects) for s in self._stores.values())
-        if n_events > max(64, 2 * live):
+        if self.journal.wal_upgrade_pending \
+                or n_events > max(64, 2 * live):
+            # boot compaction doubles as the in-place WAL codec upgrade:
+            # a JSON-era file replayed under wal_codec="bin1" (or vice
+            # versa) is rewritten in the configured format right here
             self._compact_wal()
+
+    def _apply_xfer(self, rec: dict) -> None:
+        """Replay one segment-transfer control record (fabric ring
+        rebalance): 'attach' re-inserts transferred pods with their
+        original revisions, 'detach' removes exported ones."""
+        if rec.get("xfer") == "attach":
+            for pod in rec.get("pods", []):
+                self._pods.objects[pod.metadata.uid] = pod
+                self._pods.index_add(pod)
+        elif rec.get("xfer") == "detach":
+            for uid in rec.get("uids", []):
+                old = self._pods.objects.pop(uid, None)
+                if old is not None:
+                    self._pods.index_remove(old)
 
     def _compact_wal(self) -> None:
         """Snapshot-rewrite the WAL: one add-event per live object,
@@ -303,12 +328,94 @@ class Hub:
             return {kind: "hub" for kind in self._stores}
 
     def get_journal_stats(self) -> dict:
-        """Journal depth/watermark per kind (the hub_journal_* gauges)."""
+        """Journal depth/watermark per kind (the hub_journal_* gauges),
+        plus per-kind watcher counts — the fabric smoke's per-shard
+        socket accounting reads these off a shard process's /metrics."""
         with self._lock:
             return {"rv": self._last_rv,
                     "capacity": self.journal.capacity,
                     "wal": bool(self.journal.wal_path),
-                    "kinds": self.journal.stats()}
+                    "wal_codec": self.journal.wal_codec,
+                    "kinds": self.journal.stats(),
+                    "watchers": {k: len(s.handlers)
+                                 for k, s in self._stores.items()
+                                 if s.handlers}}
+
+    # ------------- segment transfer (fabric ring rebalance) -------------
+    #
+    # Moving a crc32-ring segment between shard PROCESSES must be
+    # invisible in the event stream: no watcher may see a delete+add
+    # storm for pods that merely changed owners. These verbs therefore
+    # bypass _commit entirely — the store mutates, a WAL control record
+    # persists the transfer for restart replay, and the journal RINGS
+    # keep the pods' real history so resumes spanning the move still
+    # serve (the router merges the old shard's pre-move suffix with the
+    # new shard's post-move one; the shared rv space makes both sides of
+    # the cut comparable).
+
+    @staticmethod
+    def _segment_slot(namespace: str, ring_size: int) -> int:
+        # THE ring mapping (fabric.cluster.ring_slot), deferred import:
+        # routers and shard processes must agree byte-for-byte on
+        # namespace -> slot, so there is exactly one implementation
+        from kubernetes_tpu.fabric.cluster import ring_slot
+
+        return ring_slot(namespace, ring_size)
+
+    def export_segment(self, slots: list, ring_size: int) -> list:
+        """Copy (NOT remove) every pod whose namespace hashes into
+        ``slots``: the rebalance copies to the target shard first so a
+        concurrent LIST never finds the segment in neither shard —
+        duplicates during the overlap window are deduped by every
+        client's uid+rv discipline."""
+        want = set(slots)
+        with self._lock:
+            return [p for p in self._pods.objects.values()
+                    if self._segment_slot(p.metadata.namespace,
+                                          ring_size) in want]
+
+    def import_segment(self, pods: list) -> int:
+        """Adopt transferred pods with their ORIGINAL uids and
+        revisions — no events, no new rvs; a WAL attach record makes
+        the adoption survive a restart."""
+        with self._lock:
+            fresh = []
+            for pod in pods:
+                if pod.metadata.uid not in self._pods.objects:
+                    fresh.append(pod)
+                self._pods.objects[pod.metadata.uid] = pod
+                self._pods.index_add(pod)
+            if fresh:
+                self.journal.wal_only({"xfer": "attach", "pods": fresh})
+            return len(fresh)
+
+    def drop_segment(self, slots: list, ring_size: int) -> int:
+        """Release an exported segment after the ring flipped: remove
+        the pods silently (WAL detach record; journal rings untouched so
+        pre-move resumes still serve)."""
+        want = set(slots)
+        with self._lock:
+            doomed = [p for p in self._pods.objects.values()
+                      if self._segment_slot(p.metadata.namespace,
+                                            ring_size) in want]
+            for p in doomed:
+                self._pods.objects.pop(p.metadata.uid, None)
+                self._pods.index_remove(p)
+            if doomed:
+                self.journal.wal_only(
+                    {"xfer": "detach",
+                     "uids": [p.metadata.uid for p in doomed]})
+            return len(doomed)
+
+    def reconcile_ring(self, owned_slots: list, ring_size: int) -> int:
+        """Startup janitor for a shard process: drop any pod whose slot
+        the current ring assigns elsewhere. Heals the
+        killed-mid-rebalance case — a shard that died between the copy
+        and the drop restarts, replays its WAL (resurrecting its stale
+        copy), then reconciles against the authoritative ring."""
+        owned = set(owned_slots)
+        stray = [s for s in range(ring_size) if s not in owned]
+        return self.drop_segment(stray, ring_size) if stray else 0
 
     def close(self) -> None:
         """Release the WAL file handle (no-op for memory-only hubs)."""

@@ -70,6 +70,7 @@ from kubernetes_tpu.hubserver import (
     CALL_METHODS,
     FRAMES_CONTENT_TYPE,
     WATCH_KINDS,
+    format_cursors,
 )
 from kubernetes_tpu.storage import JournalEvent
 from kubernetes_tpu.utils.backoff import Backoff, RetryBudget
@@ -79,10 +80,19 @@ _ERRORS = {"Conflict": Conflict, "NotFound": NotFound, "Fenced": Fenced,
            "ValueError": ValueError, "TypeError": TypeError}
 
 # safe to replay blindly: reads never mutate. The split covers dotted
-# verbs too ("leases.get" -> "get").
+# verbs too ("leases.get" -> "get"). The explicit extras are fabric
+# verbs that are retry-safe without being reads: re-registering a
+# shard/relay is idempotent, advancing the allocator floor is a max(),
+# and a retried rv.next merely burns a revision (gaps in the global rv
+# space are already the journal's contract).
 IDEMPOTENT_METHODS = frozenset(
     m for m in CALL_METHODS
-    if m.split(".")[-1].startswith(("get", "list")))
+    if m.split(".")[-1].startswith(("get", "list"))) | frozenset({
+        "rv.next", "rv.advance_to", "rv.last", "leases.epoch_of",
+        "fabric_register_shard", "fabric_register_relay",
+        "fabric_register_router", "fabric_topology", "fabric_shards",
+        "fabric_ring",
+    })
 
 # a response from these statuses is the PATH failing, not the hub's
 # verdict on the request (gateway/proxy 5xx — chaos injects 503)
@@ -97,14 +107,35 @@ class RemoteError(Exception):
     """Server-side failure with no local exception mapping."""
 
 
-class _RemoteLeases:
-    def __init__(self, call):
+class _RemoteNamespace:
+    """Dotted-verb proxy: ``client.leases.update(...)`` -> the wire's
+    ``leases.update`` — one shape for every namespaced surface (leases,
+    the fabric state shard's ``rv`` allocator)."""
+
+    __slots__ = ("_call", "_prefix")
+
+    def __init__(self, call, prefix: str):
         self._call = call
+        self._prefix = prefix
 
-    def get(self, name: str):
-        return self._call("leases.get", name)
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        method = f"{self._prefix}.{name}"
 
-    def update(self, lease, expect_holder) -> bool:
+        def proxy(*args, _m=method):
+            return self._call(_m, *args)
+
+        proxy.__name__ = name
+        return proxy
+
+
+class _RemoteLeases(_RemoteNamespace):
+    """The wire carries positional args only; ``update`` is pinned
+    here because LeaderElector calls it with ``expect_holder=`` as a
+    keyword."""
+
+    def update(self, lease, expect_holder=None) -> bool:
         return self._call("leases.update", lease, expect_holder)
 
 
@@ -148,7 +179,11 @@ class RemoteHub:
         # apart from call health: RPCs can succeed while every stream is
         # down, and informer-confirm-dependent logic must see THAT)
         self._watch_down = 0
-        self.leases = _RemoteLeases(self._call)
+        self.leases = _RemoteLeases(self._call, "leases")
+        # the fabric state shard's shared revision allocator (rv.next /
+        # rv.last / rv.advance_to); harmless against a plain hub, which
+        # simply doesn't serve the verbs
+        self.rv = _RemoteNamespace(self._call, "rv")
 
     # ------------- degraded-state bookkeeping -------------
 
@@ -320,21 +355,29 @@ class RemoteHub:
     # ------------- watch (reflector threads) -------------
 
     def watch_kinds(self, handlers: dict[str, EventHandlers],
-                    replay: bool = True) -> None:
+                    replay: bool = True, since_rv: int | None = None,
+                    cursors: dict[str, int] | None = None) -> None:
         """MULTIPLEXED watch: every kind in ``handlers`` rides ONE
         connection (the hubserver/relay ``kinds=`` wire), each event
         dispatched to its kind's handlers. One socket instead of one
         per kind is what lets 10k kubelet-analog clients hang off a
         relay without 10k×kinds upstream streams — and the
         resume/relist counters stay accurate because they count
-        CONNECTIONS, not kinds."""
-        self._watch_multi(dict(handlers), replay)
+        CONNECTIONS, not kinds.
+
+        ``since_rv``/``cursors`` make the FIRST dial a resume instead
+        of a LIST (a relay re-parenting onto a sibling carries its
+        cursors over); a 410 on that first dial falls back to the
+        relist path — whose replay is diffed, so continuity holds
+        either way."""
+        self._watch_multi(dict(handlers), replay, since_rv, cursors)
 
     def _watch(self, kind: str, h: EventHandlers, replay: bool) -> None:
         self._watch_multi({kind: h}, replay)
 
     def _watch_multi(self, handlers: dict[str, EventHandlers],
-                     replay: bool) -> None:
+                     replay: bool, init_since: int | None = None,
+                     init_cursors: dict[str, int] | None = None) -> None:
         """One reflector CONNECTION: LIST(replay)+WATCH with
         resourceVersion dedup, reconnect-with-RESUME on stream failure
         (client-go's reflector discipline over the hub's etcd-analog
@@ -371,17 +414,30 @@ class RemoteHub:
         states: dict[str, dict[str, tuple[int, object]]] = \
             {k: {} for k in kinds}
         current: list = [None]   # this connection's live response handle
-        last_rv = [0]            # newest journal revision seen
+        last_rv = [init_since or 0]   # newest journal revision seen
+        # per-SOURCE-SHARD resume cursors (the wire's "sh" tags + the
+        # sync marker's "shards" map): through the fabric router the
+        # stream is rv-ordered per shard but NOT across shards, so
+        # resuming every shard at last_rv could skip a slower shard's
+        # events forever; resuming each at ITS OWN cursor cannot.
+        # Untagged streams (a single hub) leave this empty and resume
+        # by last_rv exactly as before.
+        shard_rvs: dict[str, int] = dict(init_cursors or {})
 
         def note_rv(rv) -> None:
             if rv and rv > last_rv[0]:
                 last_rv[0] = rv
 
+        def note_shard(sh, rv) -> None:
+            if sh and rv and rv > shard_rvs.get(sh, 0):
+                shard_rvs[sh] = rv
+
         def deliver(h: EventHandlers, etype: str, rv: int, kind: str,
-                    old, new, trace=None) -> None:
+                    old, new, trace=None, shard=None) -> None:
             if h.on_event is not None:
                 h.on_event(JournalEvent(rv=rv, kind=kind, type=etype,
-                                        old=old, new=new, trace=trace))
+                                        old=old, new=new, trace=trace,
+                                        shard=shard))
             elif etype == "delete":
                 if h.on_delete:
                     h.on_delete(old)
@@ -399,6 +455,7 @@ class RemoteHub:
                 return                      # unknown kind on the stream
             h = handlers[kind]
             etype = ev.get("type")
+            shard = ev.get("sh")
             # the commit's trace stamp: already a TraceContext on the
             # binary wire, a tagged dict on JSON; absent from a
             # pre-telemetry peer (hop data degrades, events never drop)
@@ -410,7 +467,7 @@ class RemoteHub:
                 uid = old.metadata.uid
                 if state.pop(uid, None) is not None and not suppress:
                     deliver(h, "delete", ev.get("rv") or 0, kind,
-                            old, None, trace)
+                            old, None, trace, shard)
                 return
             new = from_wire(ev.get("new"))
             uid = new.metadata.uid
@@ -423,14 +480,18 @@ class RemoteHub:
             if suppress:
                 return
             if prev is None:
-                deliver(h, "add", rv, kind, None, new, trace)
+                deliver(h, "add", rv, kind, None, new, trace, shard)
             else:
-                deliver(h, "update", rv, kind, prev[1], new, trace)
+                deliver(h, "update", rv, kind, prev[1], new, trace,
+                        shard)
 
-        def connect(since_rv: int | None = None):
+        def connect(since_rv: int | None = None,
+                    curs: dict[str, int] | None = None):
             kq = f"kinds={','.join(kinds)}" if mux else f"kind={kinds[0]}"
             if since_rv is not None:
                 url = f"{self._base}/watch?{kq}&since_rv={since_rv}"
+                if curs:
+                    url += "&cursors=" + format_cursors(curs)
             else:
                 url = f"{self._base}/watch?{kq}&replay=1"
             if self._pin != binwire.CODEC_JSON:
@@ -514,6 +575,11 @@ class RemoteHub:
                         progressed[0] = True
                     if ev.get("synced"):
                         note_rv(ev.get("rv"))
+                        # the router/relay's per-shard sync map seeds
+                        # the composite cursors: "complete through
+                        # these per-shard revisions"
+                        for sh, srv in (ev.get("shards") or {}).items():
+                            note_shard(sh, srv)
                         if in_replay:
                             # relist diff: anything tracked but absent from
                             # this replay was deleted while we weren't
@@ -532,7 +598,8 @@ class RemoteHub:
                             h = handlers[kind]
                             if h.on_sync is not None:
                                 h.on_sync(ev.get("rv") or last_rv[0],
-                                          in_replay)
+                                          in_replay,
+                                          ev.get("shards"))
                         in_replay = False
                         sync_seen = True
                         synced.set()
@@ -546,8 +613,10 @@ class RemoteHub:
                         # mid-replay could leave last_rv beyond objects never
                         # delivered, and a resume from there would skip them
                         # silently forever; leaving last_rv untouched makes
-                        # that reconnect retry/relist instead
+                        # that reconnect retry/relist instead. The same
+                        # discipline governs the per-shard cursors.
                         note_rv(ev.get("rv"))
+                        note_shard(ev.get("sh"), ev.get("rv"))
                     dispatch(ev, suppress_replay and in_replay, live)
             finally:
                 # flush the batched wire counters DETERMINISTICALLY on
@@ -558,9 +627,9 @@ class RemoteHub:
                 # batch) would be missing from wire_codec_* until then
                 gen.close()
 
-        def run(first_resp) -> None:
+        def run(first_resp, first_resumed: bool = False) -> None:
             resp, suppress = first_resp, not replay
-            resumed = False
+            resumed = first_resumed
             bo = Backoff(self._retry_base, self._retry_cap)
             stream_ok = [True]
 
@@ -618,10 +687,17 @@ class RemoteHub:
                     while True:
                         if self._closed.wait(bo.next()):
                             return             # close() during the sleep
+                        if force_relist:
+                            # stale per-shard cursors die with the
+                            # relist; the diff covers the gap and the
+                            # next sync marker re-seeds them
+                            shard_rvs.clear()
                         since = None if force_relist or last_rv[0] <= 0 \
                             else last_rv[0]
                         try:
-                            resp = connect(since)
+                            resp = connect(since, dict(shard_rvs)
+                                           if since is not None
+                                           and shard_rvs else None)
                         except urllib.error.HTTPError as e:
                             code = e.code
                             try:
@@ -677,12 +753,34 @@ class RemoteHub:
         # that is still binding its port (bounded retry, then Unavailable)
         bo = Backoff(self._retry_base, self._retry_cap)
         t_end = time.monotonic() + max(self._retry_deadline, self._timeout)
+        # a caller-supplied resume point (relay re-parent) makes the
+        # first dial a resume; a 410 falls back to the relist wire
+        first_since = init_since if (init_since or init_cursors) \
+            else None
+        if first_since is None and init_cursors:
+            first_since = max(init_cursors.values())
+        first_resumed = False
         while True:
             try:
-                resp0 = connect()
+                resp0 = connect(first_since,
+                                dict(shard_rvs)
+                                if first_since is not None and shard_rvs
+                                else None)
+                first_resumed = first_since is not None
                 self._mark_connected()
                 break
             except urllib.error.HTTPError as e:
+                if e.code == 410 and first_since is not None:
+                    # the resume point was compacted away: relist (the
+                    # diffed replay preserves continuity for on_event
+                    # consumers exactly like any mid-life 410)
+                    first_since = None
+                    shard_rvs.clear()
+                    try:
+                        e.close()
+                    except OSError:
+                        pass
+                    continue
                 if e.code not in _RETRYABLE_HTTP:
                     # the server ANSWERED: surface its verdict instead
                     # of blind-retrying a doomed request to its deadline
@@ -702,7 +800,8 @@ class RemoteHub:
                 raise Unavailable(
                     f"watch {','.join(kinds)}: {err!r}") from None
             time.sleep(min(bo.next(), max(remaining, 0.0)))
-        t = threading.Thread(target=run, args=(resp0,), daemon=True,
+        t = threading.Thread(target=run, args=(resp0, first_resumed),
+                             daemon=True,
                              name=f"reflector-{'-'.join(kinds)}")
         t.start()
         self._threads.append(t)

@@ -86,6 +86,18 @@ CALL_METHODS = frozenset({
     "shard_map",
     "list_changes",
     "leases.get", "leases.update",
+    # fabric (out-of-process control plane): fencing reads, the shared
+    # revision allocator, the shard/relay/router registries + ring map
+    # on the state shard, and the ring-rebalance segment verbs on shard
+    # processes (fabric.cluster)
+    "leases.epoch_of",
+    "rv.next", "rv.advance_to", "rv.last",
+    "fabric_register_shard", "fabric_register_relay",
+    "fabric_register_router", "fabric_topology", "fabric_shards",
+    "fabric_ring", "fabric_set_ring",
+    "export_segment", "import_segment", "drop_segment",
+    "reconcile_ring",
+    "rebalance_segment",
 })
 
 WATCH_KINDS = ("pods", "nodes", "namespaces", "pvcs", "pvs",
@@ -94,23 +106,42 @@ WATCH_KINDS = ("pods", "nodes", "namespaces", "pvcs", "pvs",
                "pod_groups")
 
 _ERROR_STATUS = {"Conflict": 409, "NotFound": 404, "ValueError": 400,
-                 "TypeError": 400, "Fenced": 403, "CodecMismatch": 400}
+                 "TypeError": 400, "Fenced": 403, "CodecMismatch": 400,
+                 # the router's verdict when a shard process is down
+                 # mid-restart: 503 is the retryable gateway answer —
+                 # idempotent reads retry through it, writes surface
+                 # Unavailable to the caller's own reconciliation
+                 "Unavailable": 503}
 
 FRAMES_CONTENT_TYPE = "application/x-ktpu-frames"
 
 
 class WatchParams:
-    """Parsed /watch query: shared by the hub's handler and the relay's
-    (fabric.relay) so the two servers cannot drift apart on the wire."""
+    """Parsed /watch query: shared by the hub's handler, the relay's,
+    and the fabric router's so the servers cannot drift apart on the
+    wire. ``cursors`` is the PER-SHARD resume map (``cursors=
+    pods-0:95,pods-1:101``): shard streams through the router are
+    rv-ordered per shard but not across shards, so a single max-rv
+    resume point could silently skip a slower shard's events — the
+    composite cursor resumes every shard at exactly what this client
+    saw from it. A single hub ignores it (one shard, one cursor)."""
 
-    __slots__ = ("kinds", "mux", "replay", "since_rv", "use_bin")
+    __slots__ = ("kinds", "mux", "replay", "since_rv", "use_bin",
+                 "cursors")
 
-    def __init__(self, kinds, mux, replay, since_rv, use_bin):
+    def __init__(self, kinds, mux, replay, since_rv, use_bin,
+                 cursors=None):
         self.kinds = kinds
         self.mux = mux
         self.replay = replay
         self.since_rv = since_rv
         self.use_bin = use_bin
+        self.cursors = cursors
+
+
+def format_cursors(cursors: dict) -> str:
+    """{shard: rv} -> the wire's ``cursors=`` value."""
+    return ",".join(f"{s}:{r}" for s, r in sorted(cursors.items()))
 
 
 def parse_watch_query(q: dict, codecs=(binwire.CODEC_BINARY,
@@ -134,12 +165,24 @@ def parse_watch_query(q: dict, codecs=(binwire.CODEC_BINARY,
         since_rv = int(since_raw) if since_raw else None
     except ValueError:
         return None, f"bad since_rv {since_raw!r}"
+    cursors_raw = q.get("cursors", [""])[0]
+    cursors = None
+    if cursors_raw:
+        cursors = {}
+        for part in cursors_raw.split(","):
+            shard, sep, rv = part.partition(":")
+            if not sep or not shard:
+                return None, f"bad cursors entry {part!r}"
+            try:
+                cursors[shard] = int(rv)
+            except ValueError:
+                return None, f"bad cursors entry {part!r}"
     use_bin = (binwire.CODEC_BINARY in codecs
                and q.get("codec", [""])[0] == binwire.CODEC_BINARY
                and q.get("fp", [""])[0]
                == binwire.registry_fingerprint())
     return WatchParams(kinds, mux, q.get("replay", ["1"])[0] == "1",
-                       since_rv, use_bin), None
+                       since_rv, use_bin, cursors), None
 
 
 def make_stream_writers(wfile, use_bin: bool, mux: bool):
@@ -161,10 +204,14 @@ def make_stream_writers(wfile, use_bin: bool, mux: bool):
             write_chunk(json.dumps(obj).encode() + b"\n")
 
     def write_event(kind: str, etype: str, rv: int, old, new,
-                    trace=None) -> None:
+                    trace=None, shard=None) -> None:
         d = {"type": etype, "rv": rv}
         if mux:
             d["kind"] = kind
+        if shard is not None:
+            # source-shard tag: the fabric router/relay stamp it so
+            # clients can keep per-shard resume cursors
+            d["sh"] = shard
         if use_bin:
             d["old"], d["new"] = old, new
             if trace is not None:
@@ -284,9 +331,17 @@ class _Handler(BaseHTTPRequestHandler):
             self._text(200, "ok")
             return
         if path == "/metrics":
-            from kubernetes_tpu.telemetry.fleet import hub_metrics_text
+            from kubernetes_tpu.telemetry.fleet import (
+                hub_metrics_text,
+                process_identity_text,
+            )
 
-            self._text(200, hub_metrics_text(self.hub))
+            # identity first: pid + listen port distinguish two shard
+            # processes of the same shard name across a restart
+            self._text(200, process_identity_text(
+                getattr(self.hub, "shard_name", "hub"),
+                self.server.server_address[1])
+                + hub_metrics_text(self.hub))
             return
         if not self.path.startswith("/watch"):
             self._json(404, {"error": "NotFound", "message": self.path})
@@ -360,7 +415,7 @@ class _Handler(BaseHTTPRequestHandler):
                     except queue.Empty:
                         break
                     write_event(kind, ev.type, ev.rv, ev.old, ev.new,
-                                ev.trace)
+                                ev.trace, ev.shard)
             write_obj({"synced": True, "rv": cur_rv})
             while not self.server.stopping \
                     and not overflow.is_set():  # type: ignore[attr-defined]
@@ -370,7 +425,7 @@ class _Handler(BaseHTTPRequestHandler):
                     write_obj({})  # keepalive; also detects dead peers
                     continue
                 write_event(kind, ev.type, ev.rv, ev.old, ev.new,
-                                ev.trace)
+                            ev.trace, ev.shard)
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:

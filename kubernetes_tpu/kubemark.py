@@ -194,6 +194,12 @@ def main() -> None:
                     help="watch-relay URL (fabric.relay): pod watches "
                          "go through the relay tree, writes go to "
                          "--hub — the 10k-kubelet fan-in shape")
+    ap.add_argument("--topology", default=None,
+                    help="auto-topology: discover a relay for pod "
+                         "watches from this router's served topology "
+                         "map (/topology) instead of --relay's "
+                         "explicit URL; falls back to the router "
+                         "itself while no relay is advertised")
     ap.add_argument("--nodes", type=int, default=100)
     ap.add_argument("--prefix", default="hollow")
     ap.add_argument("--zones", type=int, default=0)
@@ -204,7 +210,13 @@ def main() -> None:
                          "(0 = ephemeral; -1 = off)")
     args = ap.parse_args()
     client = RemoteHub(args.hub)
-    watch_client = RemoteHub(args.relay) if args.relay else None
+    relay_url = args.relay
+    if args.topology and not relay_url:
+        from kubernetes_tpu.fabric.relay import discover_relay_url
+
+        relay_url = discover_relay_url(args.topology)
+        print(f"kubemark: discovered relay {relay_url}", flush=True)
+    watch_client = RemoteHub(relay_url) if relay_url else None
     hollow = HollowNodes(client, args.nodes, prefix=args.prefix,
                          zones=args.zones, watch_hub=watch_client)
     if args.heartbeat:

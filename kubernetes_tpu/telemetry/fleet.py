@@ -173,6 +173,44 @@ def merge_expositions(parts: list[tuple[dict, Exposition]]) -> str:
     return "\n".join(lines) + "\n"
 
 
+# ---------------------- per-process identity ----------------------
+
+
+IDENTITY_METRIC = "fabric_process_identity"
+
+
+def process_identity_text(component: str,
+                          port: Optional[int] = None) -> str:
+    """The per-process identity sample every fabric component prefixes
+    to its /metrics: pid + listen port as labels. Two shard processes
+    of the same shard NAME (a restart landed on a new port, or an old
+    incarnation lingers) stay distinguishable in the merged fleet
+    exposition — the name alone used to collide."""
+    import os
+
+    labels = f'pid="{os.getpid()}"'
+    if port is not None:
+        labels += f',port="{port}"'
+    return (f"# HELP {IDENTITY_METRIC} Process identity of this "
+            f"fabric component\n"
+            f"# TYPE {IDENTITY_METRIC} gauge\n"
+            f"{IDENTITY_METRIC}{{{labels}}} 1\n")
+
+
+def identity_of(exp: "Exposition") -> dict:
+    """Extract {pid, port} from a parsed exposition's identity sample
+    (empty when the component predates the identity stamp)."""
+    for s in exp.samples:
+        if s.name == IDENTITY_METRIC:
+            out = {}
+            if "pid" in s.labels:
+                out["pid"] = int(s.labels["pid"])
+            if "port" in s.labels:
+                out["port"] = int(s.labels["port"])
+            return out
+    return {}
+
+
 # ------------------------- component renderers -------------------------
 #
 # Each fabric component renders its own small Registry on demand; the
@@ -259,7 +297,8 @@ class ComponentEndpoints:
 
     def __init__(self, metrics_fn: Callable[[], str],
                  healthz_fn: Optional[Callable[[], bool]] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 component: str = "component"):
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -278,7 +317,10 @@ class ComponentEndpoints:
             def do_GET(self):  # noqa: N802 (stdlib API)
                 path = self.path.partition("?")[0]
                 if path == "/metrics":
-                    self._send(200, outer.metrics_fn())
+                    self._send(200, process_identity_text(
+                        outer.component,
+                        self.server.server_address[1])
+                        + outer.metrics_fn())
                 elif path in ("/healthz", "/livez"):
                     ok = outer.healthz_fn() if outer.healthz_fn else True
                     self._send(200 if ok else 503,
@@ -288,6 +330,7 @@ class ComponentEndpoints:
 
         self.metrics_fn = metrics_fn
         self.healthz_fn = healthz_fn
+        self.component = component
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._thread: threading.Thread | None = None
 
@@ -356,6 +399,9 @@ class FleetView:
                 body = self._fetch(base + "/metrics", self.timeout)
                 rec["exposition"] = parse_exposition(body)
                 rec["samples"] = len(rec["exposition"].samples)
+                # per-process identity: pid + listen port distinguish
+                # two incarnations sharing a component/shard name
+                rec.update(identity_of(rec["exposition"]))
             except Exception as e:  # noqa: BLE001 — strict parse verdict
                 rec["error"] = f"metrics: {e}"
             out.append(rec)
@@ -373,6 +419,12 @@ class FleetView:
             inject = {"component": rec["component"]}
             if rec["shard"]:
                 inject["shard"] = rec["shard"]
+            if rec.get("pid"):
+                # the identity labels ride every sample so a restarted
+                # shard's series never collide with its predecessor's
+                inject["pid"] = str(rec["pid"])
+            if rec.get("port"):
+                inject["port"] = str(rec["port"])
             parts.append((inject, rec["exposition"]))
         return merge_expositions(parts)
 
@@ -383,7 +435,9 @@ class FleetView:
             rows.append({k: rec[k] for k in
                          ("component", "shard", "url", "healthy",
                           "error")}
-                        | {"samples": rec.get("samples", 0)})
+                        | {"samples": rec.get("samples", 0),
+                           "pid": rec.get("pid"),
+                           "port": rec.get("port")})
         return {"endpoints": rows,
                 "healthy": sum(1 for r in rows if r["healthy"]),
                 "total": len(rows),

@@ -606,3 +606,110 @@ def test_journal_metrics_exported_on_scheduler():
 
 # suite-tier discipline (tests/test_markers.py): area marker
 pytestmark = pytest.mark.core
+
+
+# ----------------------- bin1 WAL codec (ISSUE 11) -----------------------
+
+
+def test_bin1_wal_roundtrip_and_size(tmp_path):
+    """The bin1 WAL replays identically to the JSON-lines WAL and is
+    several times smaller on disk (positional structs: field names
+    never hit the file)."""
+    paths = {}
+    for codec in ("json", "bin1"):
+        wal = str(tmp_path / f"h-{codec}.wal")
+        hub = Hub(wal_path=wal, wal_codec=codec)
+        for i in range(20):
+            hub.create_pod(MakePod().name(f"b{i}")
+                           .namespace(f"ns-{i % 3}").obj())
+        hub.bind(hub.list_pods()[0], "n-x")
+        rv = hub.current_rv
+        hub.close()
+        paths[codec] = (wal, rv)
+        hub2 = Hub(wal_path=wal, wal_codec=codec)
+        assert hub2.current_rv == rv
+        assert len(hub2.list_pods()) == 20
+        assert sum(1 for p in hub2.list_pods()
+                   if p.spec.node_name) == 1
+        # rings replayed too: resumes across the restart serve
+        assert hub2.journal.events_after("pods", 0)
+        hub2.close()
+    import os as _os
+
+    jb = _os.path.getsize(paths["json"][0])
+    bb = _os.path.getsize(paths["bin1"][0])
+    assert jb / bb >= 3.0, f"bin1 WAL must be ≥3x smaller ({jb}/{bb})"
+
+
+def test_bin1_wal_torn_tail_tolerated(tmp_path):
+    wal = str(tmp_path / "torn.wal")
+    hub = Hub(wal_path=wal, wal_codec="bin1")
+    for i in range(5):
+        hub.create_pod(MakePod().name(f"t{i}").obj())
+    hub.close()
+    # a frame cut mid-write: bogus length prefix + partial payload
+    with open(wal, "ab") as f:
+        f.write(b"\x00\x00\x02\x00only-part-of-a-frame")
+    hub2 = Hub(wal_path=wal, wal_codec="bin1")
+    assert len(hub2.list_pods()) == 5
+    # repair truncated the tail: the next restart replays cleanly too
+    hub2.create_pod(MakePod().name("after-torn").obj())
+    hub2.close()
+    hub3 = Hub(wal_path=wal, wal_codec="bin1")
+    assert len(hub3.list_pods()) == 6
+    hub3.close()
+
+
+def test_json_wal_upgrades_in_place_to_bin1(tmp_path):
+    """Mixed-format replay: an old JSON-lines WAL opened under
+    wal_codec='bin1' replays fine and is rewritten as bin1 on the
+    spot (the in-place upgrade), preserving revisions and state."""
+    wal = str(tmp_path / "up.wal")
+    hub = Hub(wal_path=wal)            # JSON era
+    for i in range(8):
+        hub.create_pod(MakePod().name(f"u{i}").obj())
+    rv = hub.current_rv
+    hub.close()
+    with open(wal, "rb") as f:
+        assert f.read(1) == b"{"
+    hub2 = Hub(wal_path=wal, wal_codec="bin1")
+    assert hub2.current_rv == rv
+    assert len(hub2.list_pods()) == 8
+    assert hub2.journal.wal_format == "bin1", \
+        "first replay must rewrite the file in the configured codec"
+    with open(wal, "rb") as f:
+        assert f.read(1) != b"{"
+    hub2.create_pod(MakePod().name("post-upgrade").obj())
+    hub2.close()
+    hub3 = Hub(wal_path=wal, wal_codec="bin1")
+    assert len(hub3.list_pods()) == 9
+    assert hub3.current_rv == rv + 1
+    hub3.close()
+
+
+def test_segment_transfer_control_records_replay(tmp_path):
+    """Ring-rebalance segment transfers persist as WAL control
+    records: a restart replays attaches/detaches silently (no events,
+    original revisions)."""
+    wal_a = str(tmp_path / "a.wal")
+    wal_b = str(tmp_path / "b.wal")
+    a = Hub(wal_path=wal_a, wal_codec="bin1")
+    b = Hub(wal_path=wal_b, wal_codec="bin1")
+    for i in range(6):
+        a.create_pod(MakePod().name(f"x{i}").namespace(f"ns-{i}").obj())
+    moved = a.export_segment([0], 1)        # every slot -> slot 0
+    assert len(moved) == 6
+    assert b.import_segment(moved) == 6
+    assert a.drop_segment([0], 1) == 6
+    a.close()
+    b.close()
+    a2 = Hub(wal_path=wal_a, wal_codec="bin1")
+    b2 = Hub(wal_path=wal_b, wal_codec="bin1")
+    assert a2.list_pods() == []
+    got = sorted(p.metadata.name for p in b2.list_pods())
+    assert got == [f"x{i}" for i in range(6)]
+    # original revisions survived the transfer
+    assert {p.metadata.resource_version
+            for p in b2.list_pods()} == set(range(1, 7))
+    a2.close()
+    b2.close()
