@@ -37,7 +37,7 @@ BASELINE_PODS_PER_SEC = 270.0  # misc/performance-config.yaml:63
 
 # the committed artifact README.md's bench table is generated from; a
 # new measurement round commits a new artifact and re-points this
-README_BENCH_ARTIFACT = "BENCH_r07_builder.json"
+README_BENCH_ARTIFACT = "BENCH_r12_builder.json"
 _TABLE_BEGIN = "<!-- BENCH_TABLE_BEGIN"
 _TABLE_END = "<!-- BENCH_TABLE_END -->"
 
@@ -130,6 +130,7 @@ BENCH_WORKLOAD_FNS = (
     "multi_tenant_gang_storm",
     "quota_exhaustion_churn",
     "gang_preemption",
+    "gang_topology_packing",
 )
 
 # the ROADMAP's sub-10x offenders, profiled with the flight recorder's
@@ -141,6 +142,9 @@ PROFILE_WORKLOAD_FNS = (
     "dra_steady_state",
     "dra_steady_state_templates",
     "multi_tenant_gang_storm",
+    "quota_exhaustion_churn",
+    "gang_preemption",
+    "gang_topology_packing",
 )
 
 # the always-on recorder's cost ceiling: what makes "every cycle, every

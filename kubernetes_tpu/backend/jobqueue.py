@@ -19,8 +19,18 @@ Gang-aware release: pods of one PodGroup form a single release **unit**
 that becomes eligible only when the group object is known, at least
 ``min_member`` members are present, and the whole unit fits the
 tenant's remaining quota — the queue half of all-or-nothing admission
-(the Permit half lives in plugins/gang.py). Pods whose group has not
-arrived yet park in an orphan pool and join their tenant when it does.
+(the commit half lives in plugins/gang.py and the device gang packer).
+Pods whose group has not arrived yet park in an orphan pool and join
+their tenant when it does.
+
+Gang-aware backfill: an ELIGIBLE gang waiting only on DRR credit at the
+head of its tenant's queue earmarks the deficit (it accrues for the
+gang, never spent by others — the bounded-wait guarantee), while
+SINGLE-pod jobs behind it flow around on **backfill debt** capped at
+one blocked-gang's cost; the debt repays from the deficit the moment
+the gang releases, so the contended admission ratio converges back to
+the configured weights (sibling gangs never ride debt — the contended
+gang ratio stays the weight ratio).
 
 Pods with neither label never touch this layer: the scheduler routes
 them straight to the PriorityQueue, and the per-cycle release step is
@@ -77,6 +87,18 @@ class _Tenant:
         self.usage = Resource()
         self.usage_pods = 0
         self.deficit = 0.0
+        # gang-aware backfill debt: pods released AROUND a credit-gated
+        # gang at the head of this tenant's queue (charged here, not to
+        # the deficit the gang is accruing), repaid from the deficit
+        # after the gang releases so long-run ratios converge to weight
+        self.backfill_debt = 0.0
+        # a full scan found nothing releasable and nothing awaiting mere
+        # credit (all units quota-blocked or assembling): the tenant's
+        # turn is SKIPPED until an event that could unblock it (a pod
+        # added, its group arriving, a quota credit, a bound member) —
+        # re-probing a 300-unit blocked backlog every DRR rotation was
+        # the QuotaExhaustionChurn hot spot (ISSUE 12)
+        self.idle = False
         # release order within the tenant: FIFO over units
         self.units: "OrderedDict[str, _Unit]" = OrderedDict()  # key -> unit
         # admission bookkeeping
@@ -174,6 +196,7 @@ class JobQueue:
         else:
             t.weight = max(weight, 0.0) or 1.0
             t.quota, t.quota_pods = q, q_pods
+            t.idle = False          # quota change may unblock the scan
         self.active = True
 
     def set_group(self, group: PodGroup) -> None:
@@ -183,6 +206,7 @@ class JobQueue:
         self._groups[key] = group
         self.active = True
         t = self._tenant_for_name(group.queue)
+        t.idle = False              # its gang may now be releasable
         # re-home a unit queued under any OTHER tenant (the group's queue
         # changed, or members routed by pod label before the group
         # arrived): a gang split across tenants can never assemble
@@ -294,6 +318,7 @@ class JobQueue:
                 unit = t.units[gang] = _Unit(gang, self._seq)
             unit.pods[uid] = pod
             self._where[uid] = (t.name, gang)
+            t.idle = False          # the gang may now be assembled
             return
         t = self._tenant_for_name(self._tenant_of(pod, None))
         self._seq += 1
@@ -301,6 +326,7 @@ class JobQueue:
         unit = t.units[key] = _Unit(None, self._seq)
         unit.pods[uid] = pod
         self._where[uid] = (t.name, key)
+        t.idle = False              # fresh releasable work
 
     def update(self, pod: Pod) -> None:
         where = self._where.get(pod.metadata.uid)
@@ -342,6 +368,11 @@ class JobQueue:
             if t is not None:
                 t.usage.sub(req)
                 t.usage_pods -= 1
+                t.idle = False      # quota credit may unblock the scan
+        if where is not None and where[0] is not None:
+            t = self._tenants.get(where[0])
+            if t is not None:
+                t.idle = False      # a shrunk unit may now fit quota
 
     def note_bound(self, pod: Pod) -> None:
         """An already-bound tenant pod surfaced through the informer
@@ -364,6 +395,7 @@ class JobQueue:
         t.usage.add(req)
         t.usage_pods += 1
         self._charged[uid] = (t.name, req)
+        t.idle = False              # bound member: gang quorum moved
 
     # ------------- release (the DRR pop order) -------------
 
@@ -436,12 +468,26 @@ class JobQueue:
         n = len(self._rr)
         while released < budget and stalled_rounds < 2:
             progressed = False
+            # credit fast-forward: rounds until the NEAREST credit-gated
+            # eligible gang could release (DRR rounds are virtual time —
+            # when a rotation releases nothing, spinning real scheduling
+            # cycles to accrue one quantum per call is pure dribble; all
+            # tenants advance the SAME rounds, so ratios are untouched)
+            ff_rounds = None
             for _ in range(n):
                 name = self._rr[self._rr_i % len(self._rr)]
                 self._rr_i += 1
                 t = self._tenants[name]
                 if not t.units:
-                    t.deficit = 0.0     # no backlog: credit must not bank
+                    # no backlog: credit must not bank, and backfill
+                    # debt has no counterparty left to repay
+                    t.deficit = 0.0
+                    t.backfill_debt = 0.0
+                    continue
+                if t.idle:
+                    # fully blocked backlog, nothing changed since the
+                    # last full scan: skip the turn (deficit stays
+                    # zeroed — blocked must not bank credit)
                     continue
                 contended = any(o.units for o in self._tenants.values()
                                 if o is not t)
@@ -450,6 +496,11 @@ class JobQueue:
                 # (an assembling gang must not block singles behind it)
                 any_eligible = False
                 budget_cut = False
+                # gang-aware backfill: the first credit-gated gang on
+                # this turn EARMARKS the deficit (it keeps accruing for
+                # the gang, untouched); strictly smaller units behind it
+                # may still flow, charged to bounded backfill debt
+                gated_cost = 0
                 for key in list(islice(t.units, scan_cap)):
                     if released >= budget:
                         budget_cut = True
@@ -462,25 +513,72 @@ class JobQueue:
                     any_eligible = True
                     cost = len(unit)
                     if contended:
-                        # credit gates releases only under contention —
-                        # fairness has no counterparty when this tenant
-                        # alone has backlog
-                        if t.deficit < 1.0:
-                            break       # eligible work awaits credit
-                        if cost > t.deficit and cost > 1 \
-                                and t.deficit < min(cost, t.weight * 4):
-                            # gang bigger than remaining credit: STOP
-                            # this tenant's turn so credit accrues. A
-                            # `continue` would let same-tenant singles
-                            # behind the gang spend the deficit back to
-                            # zero every round and starve the gang for
-                            # as long as singles keep arriving; waiting
-                            # is bounded (credit grows every round up
-                            # to the weight*4 release threshold)
-                            break
-                        t.deficit -= cost
+                        if gated_cost:
+                            # backfill around the earmarked gang:
+                            # SINGLE-pod jobs only (a sibling gang
+                            # riding debt would bend the contended
+                            # gang-admission ratio off the configured
+                            # weights), on debt capped at one
+                            # blocked-gang's cost — the gang's release
+                            # round is untouched (its deficit accrues
+                            # whole), and the debt is repaid from
+                            # post-release deficit so the contended
+                            # ratio converges back to weight
+                            if unit.gang_key is not None \
+                                    or cost >= gated_cost \
+                                    or t.backfill_debt + cost > gated_cost:
+                                continue
+                            t.backfill_debt += cost
+                        else:
+                            # credit gates releases only under
+                            # contention — fairness has no counterparty
+                            # when this tenant alone has backlog
+                            if t.deficit < 1.0:
+                                # eligible work awaits credit (e.g. the
+                                # deficit is deep negative after a big
+                                # gang's overdraw): record how far the
+                                # virtual clock must advance for THIS
+                                # head unit so an unproductive rotation
+                                # can fast-forward instead of dribbling
+                                need_credit = (min(cost, t.weight * 4)
+                                               if cost > 1 else 1.0) \
+                                    - t.deficit
+                                rounds = need_credit / t.weight
+                                if ff_rounds is None \
+                                        or rounds < ff_rounds:
+                                    ff_rounds = rounds
+                                break
+                            if cost > t.deficit and cost > 1 \
+                                    and t.deficit < min(cost,
+                                                        t.weight * 4):
+                                # gang bigger than remaining credit:
+                                # stop SPENDING (deficit accrues to the
+                                # gang — singles must not spend it back
+                                # to zero every round and starve it) but
+                                # keep scanning for backfill
+                                gated_cost = cost
+                                need_credit = (min(cost, t.weight * 4)
+                                               - t.deficit)
+                                rounds = need_credit / t.weight
+                                if ff_rounds is None or rounds < ff_rounds:
+                                    ff_rounds = rounds
+                                continue
+                            t.deficit -= cost
+                            if unit.gang_key is not None \
+                                    and t.backfill_debt > 0.0:
+                                # a gang released: repay backfill debt
+                                # from what its earmark left behind —
+                                # only from POSITIVE deficit (a big
+                                # gang's overdraw leaves it negative;
+                                # "repaying" from that would forgive
+                                # the overdraw and inflate the debt)
+                                pay = min(max(t.deficit, 0.0),
+                                          t.backfill_debt)
+                                t.deficit -= pay
+                                t.backfill_debt -= pay
                     else:
                         t.deficit = 0.0
+                        t.backfill_debt = 0.0
                     n_rel = self._release_unit(t, key, unit, pq)
                     released += n_rel
                     if contended:
@@ -493,8 +591,29 @@ class JobQueue:
                     # weight ratio the moment its units free up. Credit
                     # persists only while an ELIGIBLE unit awaits it.
                     t.deficit = 0.0
+                    if gated_cost == 0 and len(t.units) <= scan_cap:
+                        # the WHOLE backlog was scanned and every unit
+                        # is quota-blocked or assembling: park the
+                        # tenant until an unblocking event wakes it
+                        t.idle = True
                 if released >= budget:
                     break
+            if not progressed and ff_rounds is not None and ff_rounds > 0:
+                # nothing released but a credit-gated gang is waiting:
+                # fast-forward the virtual clock just far enough that it
+                # releases next rotation — every backlogged tenant
+                # accrues the same rounds, preserving the weight ratios
+                # exactly while cutting the one-quantum-per-call dribble
+                adv = float(int(ff_rounds) + (ff_rounds % 1.0 > 0.0))
+                for name in self._rr:
+                    t = self._tenants[name]
+                    # idle (fully blocked) tenants sit the rounds out:
+                    # crediting them would BANK deficit the moment
+                    # their quota frees — the invariant the zeroed
+                    # unproductive turn enforces
+                    if t.units and not t.idle:
+                        t.deficit += t.weight * DRR_QUANTUM * adv
+                progressed = True
             stalled_rounds = 0 if progressed else stalled_rounds + 1
         return released
 
@@ -517,6 +636,7 @@ class JobQueue:
                 "admitted": t.admitted,
                 "contended_admitted": t.contended_admitted,
                 "quota_blocked": t.quota_blocked,
+                "backfill_debt": round(t.backfill_debt, 3),
                 "usage": {"cpu_milli": t.usage.milli_cpu,
                           "memory": t.usage.memory,
                           "pods": t.usage_pods,

@@ -261,6 +261,8 @@ class Mirror:
         self._dirty_slots: set[int] = set()
         self._dev: dict[str, jax.Array] = {}
         self._last_sync: tuple[int, int] | None = None
+        # (last_sync, hash) memo behind free_fingerprint()
+        self._free_fp: tuple | None = None
         # stable well-known ids, interned up front
         self.wk_unschedulable_key = self._i(TAINT_UNSCHEDULABLE)
         self.wk_wildcard_ip = self._i("0.0.0.0")
@@ -418,6 +420,21 @@ class Mirror:
         node blobs — the base a dry-run adds evicted requests onto."""
         off, size = self.node_codec._f32_off["free"]
         return self.node_f32[:, off:off + size].copy()
+
+    def free_fingerprint(self) -> str:
+        """Content hash of the free matrix, memoized per sync: the gang
+        capacity memo's freshness token. CONTENT-keyed on purpose — a
+        reserve-then-rollback wave bumps the cache version but returns
+        free to identical bytes, and a version-keyed token would churn
+        the memo forever (the async bound would never land while a
+        doomed gang keeps reserving and rolling back)."""
+        if self._free_fp is None or self._free_fp[0] != self._last_sync:
+            import hashlib
+
+            h = hashlib.blake2b(self.free_matrix().tobytes(),
+                                digest_size=8).hexdigest()
+            self._free_fp = (self._last_sync, h)
+        return self._free_fp[1]
 
     def _free_nzr_of(self, info: NodeInfo,
                      alloc64: np.ndarray | None = None
@@ -953,6 +970,23 @@ class Mirror:
         while d < need:
             d *= 2
         return min(d, self.caps.domain_cap)
+
+    def gang_pack_domain(self) -> tuple[int, int]:
+        """(tk, d_bucket) for the gang packer's topology-close fill
+        order: the ZONE topology key's column and a pow2 domain bucket
+        (+1 slot for the pseudo-domain of unlabeled nodes) when any
+        node carries a zone label; (-1, 8) otherwise — the packer then
+        fills capacity-greedy with every node in one shared domain."""
+        from kubernetes_tpu.api.objects import LABEL_ZONE
+
+        tk = self._topo_col.get(LABEL_ZONE)
+        if tk is None or not self._tk_domains[tk]:
+            return -1, 8
+        need = len(self._tk_domains[tk]) + 1
+        d = 8
+        while d < need:
+            d *= 2
+        return tk, min(d, self.caps.domain_cap + 1)
 
     @staticmethod
     def batch_has_topology(pods: list[Pod]) -> bool:

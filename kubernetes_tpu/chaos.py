@@ -1148,6 +1148,18 @@ def run_gang_storm(gangs: int = 10, nodes: int = 16, seed: int = 17,
                                   if n == gang_size[g]),
             "gang_rollbacks": sum(
                 s._gang.stats["rollbacks"] for s in scheds.values()),
+            # the storm runs the DEVICE gang path (default config):
+            # these prove the fused packer carried the commits and the
+            # Permit-quorum machinery stayed the fallback
+            "gang_device_launches": sum(
+                s.stats.get("gang_device_launches", 0)
+                for s in scheds.values()),
+            "gang_device_admitted": sum(
+                s._gang.stats.get("device_admitted", 0)
+                for s in scheds.values()),
+            "gang_fallbacks": sum(
+                s.stats.get("gang_fallbacks", 0)
+                for s in scheds.values()),
             "fenced_writes": sum(s.stats.get("fenced", 0)
                                  for s in scheds.values()),
             "leaked_assumed": leaked_assumed,

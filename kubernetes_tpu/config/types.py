@@ -125,6 +125,12 @@ class SchedulerConfiguration:
     # kernels into every launch and adds per-cycle D2H pulls + export
     # bytes — phase-timing-only export users should not pay for it
     trace_export_features: bool = False
+    # device-side gang packing (ops/gang.pack_gangs): place a whole
+    # PodGroup in one fused launch — all-or-nothing feasibility on
+    # device, one host commit, no per-member Permit round-trips. Off
+    # routes every gang through the host Permit-quorum path (the
+    # differential-test arm; the fallback ladder lands here too)
+    gang_device_packing: bool = True
     # explicit tie-break RNG seed for the device pipeline's equal-score
     # node choice: paired A/B runs (bench --ab-scorer) share a seed so
     # placement diffs are attributable to the scorer, not the coin.

@@ -378,6 +378,15 @@ class SchedulerMetrics:
             "Gang assemblies rolled back atomically (timeout, member "
             "failure, or poison quarantine) — every held reservation "
             "released, no partial gang placed"))
+        self.gang_device_launches = r.register(Counter(
+            "scheduler_gang_device_launches_total",
+            "Fused gang-packing launches dispatched (each places a "
+            "whole wave of PodGroups in ONE device program — O(1) "
+            "launches per gang, not O(members))"))
+        self.gang_fallbacks = r.register(Counter(
+            "scheduler_gang_fallbacks_total",
+            "Gang units routed to the host Permit-quorum path instead "
+            "of the device packer, by reason", ("reason",)))
         self.tenant_queue_depth = r.register(Gauge(
             "scheduler_tenant_queue_depth",
             "Pods held in the job-queue layer by tenant"))

@@ -272,10 +272,17 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
                    with_feats=False):
     """Parallel auction replacing the per-pod commit scan when the batch has
     no topology constraints and no host ports: every round, all unplaced
-    pods score+argmax in parallel; per node, pods are accepted in BATCH
-    INDEX order while their cumulative requests fit (the as-if-serial
-    feasibility invariant — no node is ever overcommitted relative to the
-    serial order); losers re-score against the updated cluster next round.
+    pods score+argmax in parallel; per node, up to K pods are accepted in
+    BATCH INDEX order while their cumulative requests fit (the
+    as-if-serial feasibility invariant — no node is ever overcommitted
+    relative to the serial order); losers re-score against the updated
+    cluster next round. K = ceil(B / valid nodes): 1 on clusters at least
+    batch-sized (the historical one-accept-per-node behavior, bit
+    identical), proportionally higher when the batch outnumbers the
+    nodes — a 1024-pod batch over 200 nodes converges in ~2 rounds
+    instead of the ~B/N rounds one-accept-per-node starves through,
+    while ties still spread (K tracks the per-node share a balanced
+    placement would take anyway).
 
     Placement CHOICES may differ from the serial scan (a pod scores against
     round-start state, not the exact post-predecessor state) but every
@@ -291,11 +298,27 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
     own = jnp.arange(N)[None, :] == pods.nominated_row[:, None]    # [B, N]
     perturb = jax.vmap(lambda u: tie_perturb(u, N, tie_seed))(pods.uid_id)
     idx_b = jnp.arange(B)
+    # STATIC gate for the K-accept rounds: only a batch that outnumbers
+    # the node bucket can need K > 1, and the cumulative-fit cumsums are
+    # [B, N]-sized work the big-cluster shapes must not pay — at B <= N
+    # the historical one-accept-per-node program compiles, bit identical
+    multi_accept = B > N
+    # per-node acceptance budget per round (see docstring): the share a
+    # balanced placement would put on one node anyway (valid pods over
+    # valid nodes — padding rows place nothing)
+    k_accept = jnp.ceil(
+        jnp.sum(pods.valid).astype(jnp.float32) / jnp.maximum(
+            jnp.sum(ct.node_valid).astype(jnp.float32), 1.0)
+    ).astype(jnp.int32) if multi_accept else None
+
+    def eff_all(free):
+        """[B, N, R] per-pod effective free rows (nominated reservations
+        subtracted, the pod's OWN nomination handed back)."""
+        return (free[None] - ct.nominated_req[None]
+                + jnp.where(own[..., None], pods.req[:, None, :], 0.0))
 
     def fit_all(free):
-        eff = (free[None] - ct.nominated_req[None]
-               + jnp.where(own[..., None], pods.req[:, None, :], 0.0))
-        return jnp.all(pods.req[:, None, :] <= eff, axis=-1)       # [B, N]
+        return jnp.all(pods.req[:, None, :] <= eff_all(free), axis=-1)
 
     def per_pod_scores(nzr, nzreq, t_raw, a_raw, feas):
         """One pod's normalized per-plugin score arrays against ``nzr``
@@ -330,22 +353,41 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
 
     def body(state):
         free, nzr, placed, win, _ = state
-        fit = fit_all(free)
+        eff = eff_all(free)                                        # [B, N, R]
+        fit = jnp.all(pods.req[:, None, :] <= eff, axis=-1)
         feasible = static_ok & fit & (placed < 0)[:, None]
         total = totals(nzr, feasible)
         choice = jax.vmap(C.masked_argmax_random)(total, feasible, perturb)
-        # per-node acceptance: ONE pod per node per round (first in batch
-        # index order); colliding losers re-score against the updated
+        # per-node acceptance: up to k_accept pods per node per round,
+        # in batch index order, while their CUMULATIVE requests keep
+        # fitting the pod's own effective free row (exact as-if-serial
+        # feasibility); colliding losers re-score against the updated
         # cluster next round, so utilization scores steer them away from
-        # just-filled nodes and the final balance tracks the serial loop's.
-        # Everything is dense [B, N] reductions / one-hot matmuls — no
-        # scatters, which TPU would serialize per update.
+        # just-filled nodes and the final balance tracks the serial
+        # loop's. Everything is dense [B, N] reductions / cumsums /
+        # one-hot matmuls — no scatters, which TPU would serialize per
+        # update.
         chosen = choice[:, None] == jnp.arange(N)[None, :]         # [B, N]
-        cand_idx = jnp.where(chosen, idx_b[:, None], B)
-        first_idx = jnp.min(cand_idx, axis=0)                      # [N]
-        accept = ((choice >= 0)
-                  & (jnp.take(first_idx, jnp.clip(choice, 0, N - 1))
-                     == idx_b))                                    # [B]
+        if multi_accept:
+            rank = jnp.cumsum(chosen.astype(jnp.int32), axis=0) - 1
+            take = chosen & (rank < k_accept)
+            cum_ok = jnp.ones((B, N), bool)
+            for r in range(pods.req.shape[1]):     # static R unroll
+                cr = jnp.cumsum(jnp.where(take, pods.req[:, r:r + 1],
+                                          0.0), axis=0)
+                cum_ok &= cr <= eff[:, :, r]
+            acc_cell = take & cum_ok
+            accept = (choice >= 0) & jnp.take_along_axis(
+                acc_cell, jnp.clip(choice, 0, N - 1)[:, None],
+                axis=1)[:, 0]
+        else:
+            # one accept per node per round: first chooser in batch
+            # index order (the historical program; K would be 1 anyway)
+            cand_idx = jnp.where(chosen, idx_b[:, None], B)
+            first_idx = jnp.min(cand_idx, axis=0)                  # [N]
+            accept = ((choice >= 0)
+                      & (jnp.take(first_idx, jnp.clip(choice, 0, N - 1))
+                         == idx_b))                                # [B]
         onehot = (accept[:, None] & chosen).astype(free.dtype)     # [B, N]
         free = free - onehot.T @ pods.req                          # [N, R]
         nzr = nzr + onehot.T @ pods.nonzero_req                    # [N, 2]
@@ -1073,8 +1115,13 @@ def launch_cache_size() -> int | None:
     each dispatch — growth means a real XLA compile happened while
     tracing that launch. None when this jax build doesn't expose the
     introspection hook (the profiler then skips compile counting)."""
+    # the gang packer's jit rides the same cache accounting so a
+    # gang-shape recompile is attributed to its launch (imported lazily:
+    # ops.gang traces against this module's static_filters)
+    from kubernetes_tpu.ops.gang import pack_gangs_jit
+
     total = 0
-    for fn in (schedule_batch_jit, extract_state_jit):
+    for fn in (schedule_batch_jit, extract_state_jit, pack_gangs_jit):
         size = getattr(fn, "_cache_size", None)
         if size is None:
             return None

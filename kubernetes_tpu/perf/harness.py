@@ -129,6 +129,12 @@ class Workload:
     # factory rebuilds the whole workload at the requested scale instead
     # (capacities/batch stay identical, so jit shapes are preserved)
     rescale: Optional[Callable[[float], "Workload"]] = None
+    # post-run assertion hook: validate(hub, result) inspects the final
+    # cluster state, may attach extra result fields, and RAISES on a
+    # violated workload invariant (e.g. GangTopologyPacking's
+    # members-land-topology-close criterion) — a red validate fails the
+    # bench row like a missed threshold would
+    validate: Optional[Callable] = None
 
     def __post_init__(self) -> None:
         if not self.baseline:
@@ -391,6 +397,8 @@ def run_workload(w: Workload, now: Callable[[], float] = time.time,
             "device": (sched.profiler.snapshot()
                        if sched.profiler is not None else None),
         }
+    if w.validate is not None:
+        w.validate(hub, result)
     if summary is not None:
         result.update(summary.to_dict())
         result["vs_baseline"] = (

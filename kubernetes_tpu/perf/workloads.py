@@ -1121,7 +1121,7 @@ def multi_tenant_gang_storm(init_nodes=500,
     return Workload(
         name="MultiTenantGangStorm/500Nodes",
         threshold=25,
-        node_capacity=1024,
+        node_capacity=512,     # tracks the 500-node cluster (ISSUE-12)
         batch_size=1024,
         tenants={"tenant-a": {"weight": 2.0},
                  "tenant-b": {"weight": 1.0}},
@@ -1145,7 +1145,9 @@ def quota_exhaustion_churn(init_nodes=200, blocked_pods=400,
     return Workload(
         name="QuotaExhaustionChurn/200Nodes",
         threshold=150,
-        node_capacity=1024,
+        # bucket tracks the 200-node cluster: a 1024-row bucket made
+        # every [B, N] auction round pay 5x dead-row work (ISSUE-12)
+        node_capacity=256,
         batch_size=1024,
         tenants={"burst": {"quota": {"pods": str(quota_pods)}},
                  "steady": {}},
@@ -1208,6 +1210,73 @@ def gang_preemption(init_nodes=128, high_gangs=24) -> Workload:
             high_gangs=max(1, int(high_gangs * s))))
 
 
+def _colocation_validate(hub, result) -> None:
+    """GangTopologyPacking's acceptance criterion: members of each gang
+    land topology-close. Computes per-gang zone spans from the final
+    placements and RAISES when the mean strays — the device packer's
+    domain-major fill keeps each fitting gang inside one zone, while a
+    per-member spreading placement would scatter it."""
+    node_zone = {n.metadata.name: n.metadata.labels.get(LABEL_ZONE)
+                 for n in hub.list_nodes()}
+    by_gang: dict[str, set] = {}
+    for p in hub.list_pods():
+        g = p.metadata.labels.get(LABEL_POD_GROUP)
+        if g and p.spec.node_name:
+            by_gang.setdefault(g, set()).add(node_zone.get(p.spec.node_name))
+    spans = sorted(len(z) for z in by_gang.values())
+    assert spans, "no gang placed anything"
+    mean = sum(spans) / len(spans)
+    result["colocation"] = {
+        "gangs": len(spans),
+        "mean_zone_spans": round(mean, 3),
+        "max_zone_spans": spans[-1],
+        "one_zone_frac": round(
+            sum(1 for s in spans if s == 1) / len(spans), 3),
+    }
+    assert mean <= 1.5, \
+        f"gang members not topology-close: mean zone spans {mean:.2f}"
+
+
+def gang_topology_packing(init_nodes=96, zones=8, gangs=8) -> Workload:
+    """Zoned cluster, gangs sized to FIT one zone, cluster at half
+    demand: every gang must land topology-close (the validate hook
+    asserts mean zone spans <= 1.5 — the device packer's domain-major
+    fill puts each gang in ONE zone, where per-member least-allocated
+    spreading would scatter it across the cluster)."""
+    nodes_per_zone = max(1, init_nodes // zones)
+    zone_cap = nodes_per_zone * 4           # 900m members on 4-cpu nodes
+    size = max(2, zone_cap // 2)            # each gang fits half a zone
+    zone_names = [f"zone-{z}" for z in range(zones)]
+
+    def mkgroup(i: int) -> PodGroup:
+        return PodGroup(metadata=ObjectMeta(name=f"pack-{i}"),
+                        min_member=size, queue="jobs",
+                        schedule_timeout_seconds=120.0)
+
+    def mkpod(i: int) -> Pod:
+        return _gang_member(f"pack-{i // size}-m{i % size}",
+                            f"pack-{i // size}", "jobs", cpu="900m")
+
+    return Workload(
+        name="GangTopologyPacking/96Nodes",
+        # our own floor (first-round cpu measurement ~570 pods/s; the
+        # real acceptance gate is the validate hook's co-location bound)
+        threshold=150,
+        node_capacity=128,
+        batch_size=512,
+        ops=[
+            CreateNodes(init_nodes, lambda i: _node(i, zone_names)),
+            CreateObjects(gangs, mkgroup,
+                          create_verb="create_pod_group"),
+            CreatePods(gangs * size, mkpod, collect_metrics=True),
+        ],
+        validate=_colocation_validate,
+        rescale=lambda s: gang_topology_packing(
+            init_nodes=max(zones * 2, int(init_nodes * s)),
+            zones=zones,
+            gangs=max(2, int(gangs * s))))
+
+
 # every thresholded reference workload — bench.py runs the whole list,
 # one subprocess each, and publishes every row in its JSON (bench.py
 # mirrors these BY NAME in BENCH_WORKLOAD_FNS —
@@ -1245,6 +1314,7 @@ BENCH_WORKLOADS = (
     multi_tenant_gang_storm,
     quota_exhaustion_churn,
     gang_preemption,
+    gang_topology_packing,
 )
 
 ALL_WORKLOADS = BENCH_WORKLOADS
@@ -1258,9 +1328,12 @@ PROFILE_WORKLOADS = (
     "mixed_churn",
     "dra_steady_state",
     "dra_steady_state_templates",
-    # gang/fabric host tails measured, not guessed (ISSUE-10): the
-    # multi-tenant gang storm rides the same per-phase attribution;
-    # bench --profile additionally runs the fanout smoke for the
-    # fabric-side numbers
+    # the whole gang suite rides the per-phase attribution + the
+    # DeviceProfiler's device column (ISSUE-12: launches per gang must
+    # read O(1), gang-shape compiles attributed); bench --profile
+    # additionally runs the fanout smoke for the fabric-side numbers
     "multi_tenant_gang_storm",
+    "quota_exhaustion_churn",
+    "gang_preemption",
+    "gang_topology_packing",
 )

@@ -4,19 +4,27 @@ The plugin half of the gang subsystem (the queue half is
 backend/jobqueue.py). Three extension points on the existing framework:
 
 * **PreFilter** — rejects members of a gang whose remaining
-  ``min_member`` provably cannot fit anywhere: one device reduction over
-  the mirror's free matrix (ops/gang.py) bounds how many request-shaped
-  members the cluster can still hold. Cheap, optimistic (topology
-  ignored), and it returns SKIP on success so the per-node host Filter
-  loop never runs for gang pods.
+  ``min_member`` provably cannot fit anywhere. The bound itself comes
+  from the device: for gangs the fused packer handled, the packer's own
+  capacity reduction lands in the memo (``note_device_cap``); for
+  host-path gangs the reduction is dispatched ASYNC
+  (``ops.gang.gang_capacity_device``) and its D2H pull rides the
+  scheduler's existing one-per-cycle ``device_get`` — PreFilter answers
+  from the memo and returns SKIP (optimistic, one attempt of lag) while
+  a fresh bound is still in flight. No blocking pull, ever.
 
-* **Permit** — the transactional commit point. Each member that clears
-  Reserve WAITs in the framework's wait room (its node reservation held
-  as an assumed pod) until ``min_member`` members have reserved; the
-  member that completes the quorum allows every waiting peer, and all of
-  them proceed to the fenced binder together. A timeout or any member's
-  failure rolls back EVERY reservation atomically via ``unreserve`` —
-  no partial gang ever occupies nodes.
+* **Permit** — the transactional commit point of the HOST-FALLBACK
+  path (gangs the device packer cannot express: topology terms,
+  heterogeneous members, claims/volumes, preemption). Each member that
+  clears Reserve WAITs in the framework's wait room (its node
+  reservation held as an assumed pod) until ``min_member`` members have
+  reserved; the member that completes the quorum allows every waiting
+  peer, and all of them proceed to the fenced binder together. A
+  timeout or any member's failure rolls back EVERY reservation
+  atomically via ``unreserve`` — no partial gang ever occupies nodes.
+  Gangs placed by the device packer bypass the quorum: the scheduler
+  marks them ``device_admit``-ed (the all-or-nothing device verdict IS
+  the quorum) and Permit answers allow immediately.
 
 * **Reserve/Unreserve** — the rollback hook: an unreserved member of an
   assembling gang rejects all waiting peers, whose harvest unreserves
@@ -91,10 +99,20 @@ class GangScheduling(PreFilterPlugin, FilterPlugin, ReservePlugin,
         self._group_probe: dict[str, float] = {}
         # PreFilter capacity-bound memo: gang key -> (token, cap). The
         # bound's inputs are identical for every same-shaped member of a
-        # gang within one mirror sync, so one device reduction + D2H
-        # pull serves the whole gang's batch instead of one per member
+        # gang within one mirror sync, so one device reduction serves
+        # the whole gang's batch. Fed by the device packer's cap column
+        # (note_device_cap) or by an ASYNC reduction whose D2H pull the
+        # scheduler folds into its per-cycle device_get (_pending_caps)
         self._cap_cache: dict[str, tuple] = {}
-        self.stats = {"admitted": 0, "timeouts": 0, "rollbacks": 0}
+        # gang key -> (token, device scalar) awaiting the next cycle's
+        # pull; resolved by Scheduler._finish / the gang dispatch
+        self._pending_caps: dict[str, tuple] = {}
+        # gang key -> uids admitted by the device packer's all-or-nothing
+        # verdict: Permit allows them without quorum assembly (the
+        # verdict IS the quorum); cleared when the unit's commit ends
+        self._device_admitted: dict[str, set[str]] = {}
+        self.stats = {"admitted": 0, "timeouts": 0, "rollbacks": 0,
+                      "device_admitted": 0}
 
     # ------------- scheduler-side wiring -------------
 
@@ -106,12 +124,17 @@ class GangScheduling(PreFilterPlugin, FilterPlugin, ReservePlugin,
         self._groups[group.key()] = group
         self._group_probe.pop(group.key(), None)
 
+    def group_of(self, key: str) -> Optional[PodGroup]:
+        return self._groups.get(key)
+
     def remove_group(self, key: str) -> None:
         self._groups.pop(key, None)
         self._assembling.pop(key, None)
         self._bound.pop(key, None)
         self._poisoned.pop(key, None)
         self._cap_cache.pop(key, None)
+        self._pending_caps.pop(key, None)
+        self._device_admitted.pop(key, None)
 
     def note_bound(self, pod: Pod) -> None:
         key = pod_group_key(pod)
@@ -123,6 +146,46 @@ class GangScheduling(PreFilterPlugin, FilterPlugin, ReservePlugin,
             # without this re-check the member would sit out its permit
             # timeout and park with no event left to wake it
             self._maybe_complete(key)
+
+    # ------------- device-packer wiring -------------
+
+    def cap_token(self, mirror, pod: Pod) -> tuple:
+        """The capacity memo's freshness token: the bound only changes
+        when the free matrix's CONTENT changes or the request shape
+        differs (content-keyed so a reserve/rollback wave that returns
+        free to identical bytes keeps the memo — see
+        Mirror.free_fingerprint)."""
+        row = mirror._res_row(pod_request(pod))
+        return (mirror.free_fingerprint(), row.tobytes())
+
+    def note_device_cap(self, key: str, token: tuple, cap: int) -> None:
+        """The fused packer's capacity column for this gang (pulled with
+        its verdict): seed the PreFilter memo so the host-fallback bound
+        never re-derives what the packer already computed."""
+        self._cap_cache[key] = (token, int(cap))
+        self._pending_caps.pop(key, None)
+
+    def take_pending_caps(self) -> list[tuple]:
+        """(key, token, device scalar) entries awaiting resolution —
+        the scheduler appends the scalars to its one-per-cycle
+        device_get and hands the values back via resolve_cap."""
+        return [(key, token, arr)
+                for key, (token, arr) in self._pending_caps.items()]
+
+    def resolve_cap(self, key: str, token: tuple, cap: int) -> None:
+        pend = self._pending_caps.get(key)
+        if pend is not None and pend[0] == token:
+            del self._pending_caps[key]
+        self._cap_cache[key] = (token, int(cap))
+
+    def device_admit(self, key: str, uids: set) -> None:
+        """Mark a unit the device packer placed: Permit allows these
+        members without quorum assembly (all-or-nothing was already
+        proven in one launch)."""
+        self._device_admitted[key] = set(uids)
+
+    def clear_device_admit(self, key: str) -> None:
+        self._device_admitted.pop(key, None)
 
     def bound_count(self, key: str) -> int:
         """Informer-confirmed bound members of this gang — the single
@@ -227,24 +290,30 @@ class GangScheduling(PreFilterPlugin, FilterPlugin, ReservePlugin,
         # capacity by evicting lower-priority pods (whole lower gangs via
         # the evaluator), so it must reach PostFilter, not park here
         if mirror is not None and pod.priority() <= 0:
-            from kubernetes_tpu.ops.gang import gang_capacity
-
             # one reduction per gang per mirror sync, not per member:
             # the token pins the memo to this request shape and blob
-            # state (free_matrix only changes at mirror.sync; the
-            # member-independent cap is compared against each member's
-            # own remainder)
-            row = mirror._res_row(pod_request(pod))
-            token = (mirror._last_sync, row.tobytes())
+            # state (free_matrix only changes at mirror.sync). The memo
+            # is fed by the device packer's cap column or by an ASYNC
+            # reduction pulled with the scheduler's per-cycle
+            # device_get — a memo miss answers SKIP (optimistic) while
+            # the fresh bound is in flight, never a blocking pull
+            token = self.cap_token(mirror, pod)
             cached = self._cap_cache.get(key)
-            if cached is None or cached[0] != token:
-                cached = (token, gang_capacity(mirror.free_matrix(), row))
-                self._cap_cache[key] = cached
-            cap = cached[1]
-            if cap < need:
-                return Status.unschedulable(
-                    f"gang {key}: cluster capacity bound {cap} < "
-                    f"min_member remainder {need}", plugin=self.NAME)
+            if cached is not None and cached[0] == token:
+                if cached[1] < need:
+                    return Status.unschedulable(
+                        f"gang {key}: cluster capacity bound {cached[1]} "
+                        f"< min_member remainder {need}", plugin=self.NAME)
+            else:
+                pend = self._pending_caps.get(key)
+                if pend is None or pend[0] != token:
+                    from kubernetes_tpu.ops.gang import (
+                        gang_capacity_device,
+                    )
+
+                    self._pending_caps[key] = (token, gang_capacity_device(
+                        mirror.free_matrix(),
+                        mirror._res_row(pod_request(pod))))
         return Status.skip()    # skip => the per-node filter never runs
 
     def filter(self, state, pod: Pod, node_info) -> Status:
@@ -305,6 +374,12 @@ class GangScheduling(PreFilterPlugin, FilterPlugin, ReservePlugin,
         key, group, bad = self._state_of(pod)
         if key is None:
             return Status.skip(), 0.0
+        da = self._device_admitted.get(key)
+        if da is not None and pod.metadata.uid in da:
+            # placed by the fused device packer: the all-or-nothing
+            # verdict already proved the whole unit fits — no quorum
+            # assembly, straight to the fenced binder
+            return Status(), 0.0
         if bad is not None:
             return bad, 0.0
         now = self._now()
@@ -358,5 +433,6 @@ class GangScheduling(PreFilterPlugin, FilterPlugin, ReservePlugin,
                 for key, st in self._assembling.items()},
             "bound_members": {k: len(v) for k, v in self._bound.items()},
             "poisoned": self.poisoned_gangs(),
+            "pending_caps": len(self._pending_caps),
             "stats": dict(self.stats),
         }

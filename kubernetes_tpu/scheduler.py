@@ -271,8 +271,20 @@ class Scheduler:
                    # manager (plugins/learned.py); None unless the
                    # profile enables the LearnedScore plugin — the
                    # launch then compiles the MLP term out entirely
-                   "learned": fw.instance("LearnedScore")}
+                   "learned": fw.instance("LearnedScore"),
+                   # device gang packing only engages for profiles that
+                   # run the GangScheduling plugin at all — without it
+                   # gang labels are inert and members are plain pods
+                   "gang_plugin": any(
+                       n == "GangScheduling"
+                       for pt in ("filter", "permit")
+                       for n, _ in fw.points[pt])}
             for name, fw in self.frameworks.items()}
+        # device-side gang packing (ops/gang.pack_gangs): whole PodGroups
+        # placed in one fused launch; off = every gang takes the host
+        # Permit-quorum path (the differential-test arm)
+        self._gang_device = bool(getattr(
+            self.config, "gang_device_packing", True))
         # explicit tie-break seed (config) threaded into every launch as
         # a DYNAMIC scalar: paired A/B runs share a seed so placement
         # diffs attribute to the scorer, not the coin; 0 = historical
@@ -303,7 +315,8 @@ class Scheduler:
                       "parked_unreachable": 0, "fenced": 0,
                       "device_fallbacks": 0, "quarantined": 0,
                       "drift_repairs": 0, "drift_full_lists": 0,
-                      "drift_incremental": 0}
+                      "drift_incremental": 0,
+                      "gang_device_launches": 0, "gang_fallbacks": 0}
         # poison-pod quarantine: uid -> {"qp", "until", "reason"};
         # strike/quarantine counts survive release so a re-offender's
         # backoff keeps escalating
@@ -1435,6 +1448,446 @@ class Scheduler:
         return (runnable, out, t_done, t_done - t_cycle0, tr,
                 learned_params is not None, pshape, compiled)
 
+    # ------------- device-side gang packing (ISSUE 12) -------------
+    #
+    # A whole PodGroup as ONE device problem: the batch's gang units are
+    # packed into a single fused launch (ops/gang.pack_gangs) — static
+    # filters, member-capacity-per-node, an all-or-nothing feasibility
+    # reduction, and topology-close domain packing, gangs committed
+    # as-if-serial inside the launch. A unit that clears the verdict
+    # commits through the fenced binder as one atomic host step
+    # (reserve-all -> bind-all); the Permit quorum machinery survives
+    # only as the host-fallback path for gangs the kernel cannot express
+    # (topology terms, heterogeneous members, claims/volumes, active
+    # nominations) and as rung 2 of the ladder on any device fault.
+
+    def _gang_unit_fallback_reason(self, key: str,
+                                   qps: list[QueuedPodInfo]
+                                   ) -> Optional[str]:
+        """None = the unit is device-packable; otherwise the reason it
+        must take the host Permit path (the fallback metric's label)."""
+        group = self._gang.group_of(key)
+        if group is None:
+            return "no_group"
+        if self._gang._poison_reason(key) is not None:
+            return "poisoned"
+        pods = [qp.pod for qp in qps]
+        prof = pods[0].spec.scheduler_name
+        if any(p.spec.scheduler_name != prof for p in pods[1:]):
+            return "profiles"
+        pcfg = self._profile_cfg.get(prof)
+        if pcfg is None or not pcfg.get("gang_plugin"):
+            return "no_plugin"
+        # every member present in THIS batch places together; the unit
+        # is packable only if that completes the quorum (bound members
+        # count — failover admits the tail of a half-bound gang)
+        need = max(group.min_member - self._gang.bound_count(key), 0)
+        if len(pods) < need:
+            return "partial"
+        if self.mirror.batch_has_topology(pods):
+            return "topology"
+        if self.mirror.batch_has_host_ports(pods):
+            return "ports"
+        if any(p.spec.resource_claims or p.spec.volumes for p in pods):
+            return "host_filters"
+        if any(ext.is_interested(p) for ext in self._extenders
+               for p in pods):
+            return "extender"
+        if max((p.priority() for p in pods), default=0) > 0:
+            # a preempting gang the packer would reject anyway (the
+            # memoized capacity bound, still fresh by content token,
+            # already proves < need) goes STRAIGHT to the host path —
+            # paying a pack launch + pipeline flush every retry cycle
+            # while victims drain is what regressed GangPreemption
+            cached = self._gang._cap_cache.get(key)
+            if cached is not None and cached[1] < len(pods):
+                try:
+                    if cached[0] == self._gang.cap_token(self.mirror,
+                                                         pods[0]):
+                        return "infeasible_preempting"
+                except CapacityError:
+                    return "capacity"
+        try:
+            from kubernetes_tpu.api.resources import pod_request
+
+            row0 = self.mirror._res_row(pod_request(pods[0])).tobytes()
+            if any(self.mirror._res_row(pod_request(p)).tobytes() != row0
+                   for p in pods[1:]):
+                # the packer places request-IDENTICAL members (one
+                # representative row per gang)
+                return "hetero"
+        except CapacityError:
+            return "capacity"   # normal path re-buckets and retries
+        return None
+
+    def _split_gang_units(self, runnable: list[QueuedPodInfo]
+                          ) -> tuple[list, list[QueuedPodInfo]]:
+        """Partition a popped batch into device-packable gang units and
+        the rest (plain pods + fallback-path gang members)."""
+        by_key: dict[str, list[QueuedPodInfo]] = {}
+        for qp in runnable:
+            key = pod_group_key(qp.pod)
+            if key is not None:
+                by_key.setdefault(key, []).append(qp)
+        if not by_key:
+            return [], runnable
+        units: list[tuple[str, list[QueuedPodInfo]]] = []
+        taken: set[str] = set()
+        unit_prof = None
+        for key, qps in by_key.items():
+            reason = self._gang_unit_fallback_reason(key, qps)
+            if reason is None:
+                prof = qps[0].pod.spec.scheduler_name
+                if unit_prof is None:
+                    unit_prof = prof
+                elif prof != unit_prof:
+                    # one enabled-filter set per launch: units of another
+                    # profile ride the normal path this cycle
+                    reason = "profiles_mixed"
+            if reason is None:
+                units.append((key, qps))
+                taken.update(qp.uid for qp in qps)
+            else:
+                self.stats["gang_fallbacks"] += 1
+                self.metrics.gang_fallbacks.inc(reason=reason)
+        if not units:
+            return [], runnable
+        return units, [qp for qp in runnable if qp.uid not in taken]
+
+    def _schedule_gang_units(self, runnable: list[QueuedPodInfo],
+                             flush_pending=None) -> list[QueuedPodInfo]:
+        """Route the batch's device-packable gang units through the
+        fused packer; returns what the normal path still owns. Faults
+        degrade the units to the host Permit path (the ladder), never
+        kill the cycle."""
+        if not self._gang_device or not runnable:
+            return runnable
+        units, rest = self._split_gang_units(runnable)
+        if not units:
+            return rest
+        if flush_pending is not None:
+            # commit in-flight pipelined launches first: their results
+            # are what the usage chain (or the re-synced mirror) must
+            # already reflect, and a rollback among them invalidates it
+            flush_pending()
+        # fault containment is PER CHUNK: a fault in chunk k may only
+        # degrade chunk k's still-uncommitted members and the chunks
+        # not yet dispatched — units chunk 0 already committed are mid
+        # bind and must never re-enter any scheduling path
+        fallback: list[QueuedPodInfo] = []
+        for i in range(0, len(units), self.GANG_PACK_BUCKET):
+            chunk = units[i:i + self.GANG_PACK_BUCKET]
+            later = units[i + self.GANG_PACK_BUCKET:]
+            try:
+                fallback.extend(self._dispatch_gang_chunk(chunk))
+            except Unavailable:
+                self._note_hub_down()
+                self._invalidate_chain()
+                chunk_qps = [qp for _key, qps in chunk for qp in qps]
+                for qp in self._still_pending(chunk_qps):
+                    self._park_unreachable(qp)
+                for _key, qps in later:
+                    for qp in qps:
+                        self._park_unreachable(qp)
+                return rest + fallback
+            except Exception as e:  # noqa: BLE001 — containment seam:
+                # the Permit-quorum path still schedules these gangs
+                self.stats["device_fallbacks"] += 1
+                self.metrics.device_fallbacks.inc()
+                self._invalidate_chain()
+                degraded = chunk + later
+                logger.warning(
+                    "gang device path failed for %d unit(s) (%r); "
+                    "degrading to the host Permit path", len(degraded), e)
+                for _key, _qps in degraded:
+                    self.stats["gang_fallbacks"] += 1
+                    self.metrics.gang_fallbacks.inc(reason="device_fault")
+                chunk_qps = [qp for _key, qps in chunk for qp in qps]
+                fallback.extend(self._still_pending(chunk_qps))
+                fallback.extend(qp for _key, qps in later for qp in qps)
+                return rest + fallback
+        return rest + fallback
+
+    # gang-pack launch bucket: FIXED so every wave (warmup, first storm
+    # wave, tail) runs ONE compiled program per cluster shape — a
+    # units-count-sized pow2 bucket put a fresh XLA compile in the first
+    # measured wave of every gang bench. Wider waves chunk (the chunks
+    # chain their usage state, still O(1) launches per gang).
+    GANG_PACK_BUCKET = 16
+
+    def _dispatch_gang_chunk(self, units: list) -> list[QueuedPodInfo]:
+        """ONE fused packing launch for a chunk of gang units + the
+        atomic host commit of every unit that cleared the verdict.
+        Returns members that must fall back to the normal path (a
+        preempting gang the packer found infeasible)."""
+        import jax.numpy as jnp
+
+        from kubernetes_tpu.ops.features import PodBlobs
+        from kubernetes_tpu.ops.gang import pack_gangs_jit
+
+        t0 = self.now()
+        epoch = self._chain_epoch
+        state = self._chain
+        need_sync = state is None
+        reps = [qps[0].pod for _key, qps in units]
+        g_bucket = self.GANG_PACK_BUCKET
+        for _attempt in range(16):
+            try:
+                if need_sync:
+                    self.cache.update_snapshot(self.snapshot)
+                    self.mirror.sync(self.snapshot)
+                # nominated reservations must be CURRENT: the packer
+                # subtracts them (and hands back each gang's own)
+                self.mirror.set_nominated(self.nominator.by_node())
+                feats = self.mirror.launch_features(reps)
+                pfields = self.mirror.pod_fields(feats, False)
+                f32, i32 = self.mirror._pack_batch_np(reps, g_bucket,
+                                                      pfields)
+                break
+            except CapacityError as e:
+                self._grow(e)
+                state = None
+                need_sync = True
+        else:
+            raise RuntimeError("mirror re-bucketing did not converge")
+        if self.fault_injector is not None:
+            # chaos seam: poison members / forced faults land here and
+            # degrade the units to the Permit path via the caller
+            self.fault_injector.on_pack(
+                [qp.pod for _key, qps in units for qp in qps])
+        tk, d_bucket = self.mirror.gang_pack_domain()
+        need = np.zeros((g_bucket,), np.int32)
+        own_nom = np.zeros((g_bucket, self.caps.nodes), np.int32)
+        for i, (_key, qps) in enumerate(units):
+            need[i] = len(qps)
+            for qp in qps:
+                nom = qp.pod.status.nominated_node_name
+                if nom:
+                    row = self.mirror.row_of(nom)
+                    if row >= 0:
+                        own_nom[i, row] += 1
+        cblobs = self.mirror.to_blobs()
+        if state is None:
+            state = extract_state_jit(cblobs, self.caps)
+        pcfg = self._profile_cfg[reps[0].spec.scheduler_name]
+        out = pack_gangs_jit(
+            cblobs, PodBlobs(f32=jnp.asarray(f32), i32=jnp.asarray(i32)),
+            self.mirror.well_known(), self.caps, need, np.int32(tk),
+            d_cap=d_bucket, enabled_filters=pcfg["filters"], active=feats,
+            pfields=pfields, ptmpl=self.mirror.pod_template_blobs(),
+            state=state, own_nom=jnp.asarray(own_nom))
+        self.stats["gang_device_launches"] += 1
+        self.metrics.gang_device_launches.inc()
+        pshape = None
+        prof = self.profiler
+        if prof is not None:
+            from kubernetes_tpu.telemetry.profiler import shape_key
+
+            # the "gang" row of the shape key: a packer recompile (new
+            # domain bucket / caps) is attributed, not "unattributed"
+            pshape = shape_key(self.caps, g_bucket, False, d_bucket, 0,
+                               True, False, False, False,
+                               gang=g_bucket)
+            prof.note_launch(pshape)
+        # ONE pull for the whole wave: verdicts + placements + capacity
+        # bounds + spans (+ any PreFilter capacity reductions awaiting
+        # their ride — the folded gang_capacity D2H)
+        cap_pulls = self._gang.take_pending_caps()
+        pull = [out.ok, out.alloc, out.cap, out.spans, out.guard]
+        pull.extend(arr for _key, _tok, arr in cap_pulls)
+        vals = jax.device_get(tuple(pull))
+        ok_arr, alloc_arr, cap_arr, spans_arr, guard = vals[:5]
+        for (ckey, ctok, _arr), v in zip(cap_pulls, vals[5:]):
+            self._gang.resolve_cap(ckey, ctok, int(v))
+        launch_s = self.now() - t0
+        self.flight.observe_phase("gang_device", launch_s)
+        if prof is not None and pshape is not None:
+            prof.observe_walltime(pshape, launch_s)
+        if int(guard):
+            raise DeviceFault(
+                f"gang pack guard tripped (mask {int(guard):#x}): "
+                "poisoned usage state")
+        t_commit0 = self.now()
+        fallback: list[QueuedPodInfo] = []
+        alloc_np = np.asarray(alloc_arr)
+        try:
+            for i, (key, qps) in enumerate(units):
+                # the packer's capacity column seeds the PreFilter memo:
+                # the fallback bound never re-derives what this launch
+                # already proved
+                self._gang.note_device_cap(
+                    key, self._gang.cap_token(self.mirror, qps[0].pod),
+                    int(cap_arr[i]))
+                counts = alloc_np[i]
+                if bool(ok_arr[i]) and int(counts.sum()) == len(qps):
+                    rows = np.repeat(np.arange(counts.shape[0]), counts)
+                    names = [self.mirror.name_of_row(int(r))
+                             for r in rows]
+                    if any(nm is None for nm in names):
+                        self.stats["gang_fallbacks"] += 1
+                        self.metrics.gang_fallbacks.inc(reason="rows")
+                        fallback.extend(qps)
+                        continue
+                    self._commit_gang_unit(key, qps, names)
+                    continue
+                if max((qp.pod.priority() for qp in qps), default=0) > 0:
+                    # a positive-priority gang may open capacity by
+                    # preempting: infeasibility is not provable — the
+                    # host path's PostFilter owns it
+                    self.stats["gang_fallbacks"] += 1
+                    self.metrics.gang_fallbacks.inc(
+                        reason="infeasible_preempting")
+                    fallback.extend(qps)
+                    continue
+                group = self._gang.group_of(key)
+                quorum = (max(group.min_member
+                              - self._gang.bound_count(key), 1)
+                          if group is not None else len(qps))
+                if len(qps) > quorum:
+                    # the packer places ALL present members or none; the
+                    # Permit path can still admit the min_member quorum
+                    # SUBSET when only that fits — don't park what the
+                    # host path would schedule
+                    self.stats["gang_fallbacks"] += 1
+                    self.metrics.gang_fallbacks.inc(
+                        reason="infeasible_partial")
+                    fallback.extend(qps)
+                    continue
+                msg = (f"gang {key}: device packer found no "
+                       f"all-or-nothing placement for {len(qps)} "
+                       f"member(s) (capacity bound {int(cap_arr[i])})")
+                for qp in qps:
+                    qp.host_reject_counts = {}
+                    self._park_unschedulable(qp, {"GangScheduling"}, msg)
+        finally:
+            self.flight.observe_phase("gang_commit",
+                                      self.now() - t_commit0)
+        # the chain advances to the launch's post-batch state unless a
+        # rollback/park above invalidated it (epoch check, like
+        # _dispatch); parked/fallback units were never debited on device
+        if epoch == self._chain_epoch:
+            self._chain = (out.free, out.nzr)
+        return fallback
+
+    def _commit_gang_unit(self, key: str, qps: list[QueuedPodInfo],
+                          node_names: list[str]) -> None:
+        """Atomic host commit of one device-placed gang: reserve EVERY
+        member first; any failure rolls the whole unit back before a
+        single member reaches the binder (all-or-nothing, no Permit
+        round-trips — the device verdict is the quorum)."""
+        fw = self._fw_for(qps[0].pod)
+        reserved: list[tuple] = []
+        failure = None
+        fail_i = len(qps)
+        for i, (qp, node) in enumerate(zip(qps, node_names)):
+            fail_i = i
+            pod = qp.pod
+            assumed = pod.clone()
+            assumed.spec.node_name = node
+            self.cache.assume_pod(assumed)
+            state = CycleState()
+            try:
+                s = fw.run_reserve_plugins(state, pod, node)
+            except Unavailable as e:
+                failure = (qp, state, assumed, node,
+                           f"reserve: {e}", "unreachable")
+                break
+            except Exception as e:  # noqa: BLE001 — poison seam, like
+                # _commit: strike so a repeat offender quarantines
+                self._fault_strikes[qp.uid] = \
+                    self._fault_strikes.get(qp.uid, 0) + 1
+                failure = (qp, state, assumed, node,
+                           f"reserve raised: {e!r}", "")
+                break
+            if not s.is_success():
+                failure = (qp, state, assumed, node,
+                           f"reserve: {s.message()}",
+                           s.plugin if s.is_rejected() else "")
+                break
+            reserved.append((qp, state, assumed, node))
+        if failure is not None:
+            self._gang.stats["rollbacks"] += 1
+            self.metrics.gang_rollbacks.inc()
+            fqp, fstate, fassumed, fnode, msg, tag = failure
+            peer_msg = f"gang {key} rollback: peer {fqp.pod.key()}: {msg}"
+            for qp, state, assumed, node in reserved:
+                self._undo_commit(
+                    qp, state, assumed, node, peer_msg,
+                    rejected_by=("" if tag == "unreachable"
+                                 else "GangScheduling"),
+                    park_unreachable=(tag == "unreachable"))
+            self._undo_commit(
+                fqp, fstate, fassumed, fnode, msg,
+                rejected_by=(tag if tag not in ("", "unreachable")
+                             else ""),
+                park_unreachable=(tag == "unreachable"))
+            # members AFTER the failure never reserved, but they are
+            # part of the all-or-nothing unit: park them with the same
+            # attribution instead of dropping them from the queue
+            for qp in qps[fail_i + 1:]:
+                if tag == "unreachable":
+                    self._park_unreachable(qp)
+                else:
+                    self._park_unschedulable(qp, {"GangScheduling"},
+                                             peer_msg)
+            return
+        # every member reserved: the device verdict IS the quorum —
+        # Permit answers allow for marked uids. Permits run for the
+        # WHOLE unit before any member reaches the binder: a failure
+        # rolls every member back (all-or-nothing holds through the
+        # permit stage too — undoing only the failing member would
+        # leave its peers binding as a partial gang).
+        self._gang.device_admit(key, {qp.uid for qp, *_rest in reserved})
+        verdicts: list[tuple] = []
+        failure = None
+        try:
+            for qp, state, assumed, node in reserved:
+                try:
+                    s, waits = fw.run_permit_plugins(state, qp.pod, node)
+                except Unavailable as e:
+                    failure = (qp, f"permit: {e}", "unreachable")
+                    break
+                except Exception as e:  # noqa: BLE001
+                    self._fault_strikes[qp.uid] = \
+                        self._fault_strikes.get(qp.uid, 0) + 1
+                    failure = (qp, f"permit raised: {e!r}", "")
+                    break
+                if not s.is_success() and s.code != Code.WAIT:
+                    failure = (qp, f"permit: {s.message()}",
+                               s.plugin if s.is_rejected() else "")
+                    break
+                verdicts.append((qp, state, assumed, node, s, waits))
+        finally:
+            self._gang.clear_device_admit(key)
+        if failure is not None:
+            self._gang.stats["rollbacks"] += 1
+            self.metrics.gang_rollbacks.inc()
+            fqp, msg, tag = failure
+            peer_msg = f"gang {key} rollback: peer {fqp.pod.key()}: {msg}"
+            for qp, state, assumed, node in reserved:
+                own = qp.uid == fqp.uid
+                if tag == "unreachable":
+                    rej = ""
+                elif own:
+                    rej = tag    # "" (error class) or rejecting plugin
+                else:
+                    rej = "GangScheduling"
+                self._undo_commit(
+                    qp, state, assumed, node, msg if own else peer_msg,
+                    rejected_by=rej,
+                    park_unreachable=(tag == "unreachable"))
+            return
+        for qp, state, assumed, node, s, waits in verdicts:
+            if s.code == Code.WAIT:
+                # another permit plugin wants the wait room: honor it
+                fw.waiting_pods.add(WaitingPod(qp, node, state, waits,
+                                               self.now()))
+            else:
+                self._start_binding(qp, state, assumed, node)
+        self._gang.stats["admitted"] += 1
+        self._gang.stats["device_admitted"] += 1
+        self.metrics.gang_admitted.inc()
+
     def _host_relevant(self, pod: Pod) -> bool:
         if self._host_gates is None:
             return True
@@ -1614,7 +2067,15 @@ class Scheduler:
             pull.append(out.score)
             if self._export_feats:
                 pull.append(out.chosen_feat)
+        # any PreFilter gang-capacity reductions dispatched this cycle
+        # ride this same sync (the folded gang_capacity D2H — never a
+        # separate blocking pull)
+        cap_pulls = self._gang.take_pending_caps()
+        cap_base = len(pull)
+        pull.extend(arr for _key, _tok, arr in cap_pulls)
         vals = jax.device_get(tuple(pull))
+        for (ckey, ctok, _arr), v in zip(cap_pulls, vals[cap_base:]):
+            self._gang.resolve_cap(ckey, ctok, int(v))
         rows_arr, guard = vals[0], vals[1]
         k = 2
         lmag = None
@@ -1756,6 +2217,10 @@ class Scheduler:
                 self._flush_evictions_safe()
                 self._process_deferred_events()
                 return 0
+            if runnable:
+                # device-packable gang units commit through their own
+                # fused launch first; the normal path keeps the rest
+                runnable = self._schedule_gang_units(runnable)
             if runnable:
                 try:
                     inflight = self._dispatch(
@@ -2615,6 +3080,11 @@ class Scheduler:
                 if popped == 0:
                     break
             total += popped
+            if runnable:
+                # gang units first: their fused launch chains the usage
+                # state the normal launch then builds on
+                runnable = self._schedule_gang_units(
+                    runnable, flush_pending=flush_all)
             if runnable:
                 chained = self._chain_eligible([qp.pod for qp in runnable])
                 if not chained:

@@ -39,15 +39,19 @@ _CAP_FIELDS = ("nodes", "pods", "pod_labels", "node_labels", "domains",
 
 def shape_key(caps, b_bucket: int, enable_topology: bool, d_cap,
               g_cap: int, serial_scan: bool, dra: bool, learned: bool,
-              with_feats: bool) -> tuple:
+              with_feats: bool, gang: int = 0) -> tuple:
     """The launch's compile-relevant shape: static jit args + input
-    shape buckets, as a flat hashable tuple."""
+    shape buckets, as a flat hashable tuple. ``gang`` is the gang-pack
+    launch's gang-row bucket (0 for the normal scheduling launch) — a
+    gang-shape recompile attributes to its own row instead of landing
+    in "unattributed"."""
     cap_t = tuple((f, getattr(caps, f)) for f in _CAP_FIELDS
                   if hasattr(caps, f))
     return (("b", b_bucket), ("topo", bool(enable_topology)),
             ("d_cap", d_cap), ("g_cap", g_cap),
             ("serial", bool(serial_scan)), ("dra", bool(dra)),
             ("learned", bool(learned)), ("feats", bool(with_feats)),
+            ("gang", gang),
             *cap_t)
 
 
@@ -59,6 +63,8 @@ def _diff_cause(prev: Optional[tuple], cur: tuple) -> str:
     changed |= {k for (k, v) in cur if dict(prev).get(k) != v}
     if changed & set(_CAP_FIELDS):
         return "rebucket"                 # capacity growth recompile
+    if "gang" in changed:
+        return "gang"                     # gang-pack bucket transition
     if "b" in changed:
         return "batch_bucket"             # pod-batch bucket transition
     if changed & {"topo", "d_cap", "g_cap"}:
@@ -162,9 +168,11 @@ class DeviceProfiler:
         """The /debug + --profile payload."""
         def label(shape: tuple) -> str:
             d = dict(shape)
-            return (f"b={d.get('b')} nodes={d.get('nodes')} "
+            base = (f"b={d.get('b')} nodes={d.get('nodes')} "
                     f"pods={d.get('pods')} topo={int(d.get('topo', 0))} "
                     f"dra={int(d.get('dra', 0))}")
+            gang = d.get("gang", 0)
+            return f"{base} gang={gang}" if gang else base
 
         return {
             "launches": self.launches,

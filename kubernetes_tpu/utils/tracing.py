@@ -72,6 +72,11 @@ CYCLE_PHASES = (
                           # seconds already sit in device_launch — the
                           # DeviceProfiler's attribution of WHY that
                           # launch stalled)
+    "gang_device",        # fused gang-pack launch: pack + dispatch +
+                          # the verdict pull (device + transfer time,
+                          # the gang analog of device_launch)
+    "gang_commit",        # host commit of device-placed gang units
+                          # (reserve-all -> bind-all, atomic rollback)
 )
 
 # the dra_* attribution views, excluded from total/host-tail arithmetic
@@ -95,7 +100,7 @@ EXPORT_VERSION = 2
 HOST_PHASES = (
     "queue_pop", "snapshot_sync", "host_plugins", "pack", "commit",
     "failure_handling", "binder_drain", "eviction_flush", "host_fallback",
-    "learned_score",
+    "learned_score", "gang_commit",
 )
 
 

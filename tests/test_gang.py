@@ -204,9 +204,15 @@ def test_quota_blocked_counts_once_per_release_call():
     jq.add(tenant_pod("p1", "a"))
     pq = FakePQ()
     jq.release(pq, budget=64)        # p0 admits, p1 quota-denied once
-    jq.release(pq, budget=64)        # p1 denied once more
-    # one denial per unit per release() call, not per DRR scan round
-    assert jq.tenant_stats()["a"]["quota_blocked"] == 2
+    # one denial per unit per release() call, not per DRR scan round —
+    # and a FULLY blocked tenant then parks idle: subsequent calls skip
+    # the re-probe entirely instead of re-counting the same denial
+    assert jq.tenant_stats()["a"]["quota_blocked"] == 1
+    jq.release(pq, budget=64)        # idle: no probe, no new denial
+    assert jq.tenant_stats()["a"]["quota_blocked"] == 1
+    jq.add(tenant_pod("p2", "a"))    # fresh work wakes the tenant
+    jq.release(pq, budget=64)        # p1 + p2 each denied once
+    assert jq.tenant_stats()["a"]["quota_blocked"] == 3
 
 def test_blocked_tenant_does_not_bank_drr_credit():
     """A quota-blocked tenant must not accrue deficit while blocked —

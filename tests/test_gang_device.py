@@ -1,0 +1,699 @@
+"""Device-side gang packing (ISSUE 12): the ops/gang.pack_gangs kernel
+(all-or-nothing verdict, topology-close packing, sequential in-launch
+gang commits, the folded capacity bound) and the scheduler's device gang
+path — differential against the host Permit-quorum path over randomized
+gangs, atomic unit rollback, the async PreFilter bound, and the DRR
+backfill around credit-gated gangs."""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.objects import (
+    LABEL_POD_GROUP,
+    LABEL_QUEUE,
+    LABEL_ZONE,
+    ObjectMeta,
+    PodGroup,
+)
+from kubernetes_tpu.backend.cache import Cache
+from kubernetes_tpu.backend.jobqueue import JobQueue
+from kubernetes_tpu.backend.mirror import Mirror
+from kubernetes_tpu.backend.snapshot import Snapshot
+from kubernetes_tpu.config.types import default_config
+from kubernetes_tpu.hub import Hub
+from kubernetes_tpu.ops.features import Capacities, PodBlobs
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+pytestmark = pytest.mark.gang
+
+
+class Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def now(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+def gang_pod(name, gang, cpu="100m", tenant="t", priority=None):
+    mk = MakePod().name(name).req(cpu=cpu)
+    p = mk.obj()
+    p.metadata.labels[LABEL_POD_GROUP] = gang
+    p.metadata.labels[LABEL_QUEUE] = tenant
+    if priority is not None:
+        p.spec.priority = priority
+    return p
+
+
+def group(name, min_member, timeout=10.0):
+    return PodGroup(metadata=ObjectMeta(name=name), min_member=min_member,
+                    queue="t", schedule_timeout_seconds=timeout)
+
+
+# ------------------------------------------------- the packer kernel
+
+
+def _mini_cluster(node_cpus, zones=None):
+    """(mirror, caps) over nodes with the given cpu strings; zones[i]
+    labels node i's zone when given."""
+    caps = Capacities(nodes=16, pods=128)
+    cache, snap, mirror = Cache(), Snapshot(), Mirror(caps=caps)
+    for i, cpu in enumerate(node_cpus):
+        n = (MakeNode().name(f"n{i}")
+             .capacity(cpu=cpu, memory="32Gi", pods="110").obj())
+        if zones is not None:
+            n.metadata.labels[LABEL_ZONE] = zones[i]
+        cache.add_node(n)
+    cache.update_snapshot(snap)
+    mirror.sync(snap)
+    return mirror, caps
+
+
+def _pack(mirror, caps, reps, needs, g_bucket=4):
+    from kubernetes_tpu.models.pipeline import extract_state_jit
+    from kubernetes_tpu.ops.gang import pack_gangs_jit
+
+    import jax.numpy as jnp
+
+    feats = mirror.launch_features(reps)
+    pfields = mirror.pod_fields(feats, False)
+    f32, i32 = mirror._pack_batch_np(reps, g_bucket, pfields)
+    tk, d_bucket = mirror.gang_pack_domain()
+    need = np.zeros((g_bucket,), np.int32)
+    need[:len(needs)] = needs
+    cblobs = mirror.to_blobs()
+    return pack_gangs_jit(
+        cblobs, PodBlobs(f32=jnp.asarray(f32), i32=jnp.asarray(i32)),
+        mirror.well_known(), caps, need, np.int32(tk), d_cap=d_bucket,
+        enabled_filters=(True,) * 8, active=feats, pfields=pfields,
+        ptmpl=mirror.pod_template_blobs(),
+        state=extract_state_jit(cblobs, caps))
+
+
+def test_packer_all_or_nothing():
+    """A gang past total capacity places NOTHING; a fitting one places
+    exactly `need` members."""
+    mirror, caps = _mini_cluster(["2", "2"])       # 2 nodes x 2 cpu
+    rep = MakePod().name("r").req(cpu="900m").obj()  # 2 fit per node
+    out = _pack(mirror, caps, [rep, rep], [4, 5])
+    ok = np.asarray(out.ok)
+    alloc = np.asarray(out.alloc)
+    assert bool(ok[0]) and alloc[0].sum() == 4
+    # gang 1 runs AFTER gang 0 committed: zero capacity left
+    assert not bool(ok[1]) and alloc[1].sum() == 0
+    assert int(np.asarray(out.cap)[1]) == 0
+
+
+def test_packer_sequential_gangs_chain_usage():
+    mirror, caps = _mini_cluster(["4", "4"])
+    rep = MakePod().name("r").req(cpu="1900m").obj()  # 2 per node
+    out = _pack(mirror, caps, [rep, rep], [2, 2])
+    ok = np.asarray(out.ok)
+    assert bool(ok[0]) and bool(ok[1])
+    # 4 members of 1900m over 2x4cpu: both gangs land, cluster full
+    assert np.asarray(out.alloc)[:2].sum() == 4
+    assert int(np.asarray(out.cap)[1]) == 2   # bound AFTER gang 0 commits
+
+
+def test_packer_topology_close_packing():
+    """A gang that FITS one zone lands in one zone even when spreading
+    would also be feasible — the co-location criterion."""
+    zones = ["z0", "z0", "z1", "z1", "z2", "z2", "z3", "z3"]
+    mirror, caps = _mini_cluster(["4"] * 8, zones=zones)
+    rep = MakePod().name("r").req(cpu="900m").obj()   # 4 per node
+    out = _pack(mirror, caps, [rep], [8])             # one zone holds 8
+    assert bool(np.asarray(out.ok)[0])
+    assert int(np.asarray(out.spans)[0]) == 1
+    # and a gang bigger than any one zone spans exactly two
+    out2 = _pack(mirror, caps, [rep, rep], [12, 0])
+    assert bool(np.asarray(out2.ok)[0])
+    assert int(np.asarray(out2.spans)[0]) == 2
+
+
+def test_packer_respects_static_filters():
+    """A tainted node contributes no member capacity (the bound is
+    static-filter-aware, tighter than the old free-matrix bound)."""
+    from kubernetes_tpu.api.objects import Taint
+
+    caps = Capacities(nodes=16, pods=128)
+    cache, snap, mirror = Cache(), Snapshot(), Mirror(caps=caps)
+    n0 = MakeNode().name("n0").capacity(cpu="4", memory="8Gi",
+                                        pods="110").obj()
+    n1 = MakeNode().name("n1").capacity(cpu="4", memory="8Gi",
+                                        pods="110").obj()
+    n1.spec.taints = [Taint(key="k", value="v", effect="NoSchedule")]
+    cache.add_node(n0)
+    cache.add_node(n1)
+    cache.update_snapshot(snap)
+    mirror.sync(snap)
+    rep = MakePod().name("r").req(cpu="900m").obj()
+    out = _pack(mirror, caps, [rep], [8])      # would fit over both
+    assert not bool(np.asarray(out.ok)[0])     # only n0's 4 count
+    assert int(np.asarray(out.cap)[0]) == 4
+
+
+# ------------------------------------------------- scheduler device path
+
+
+def _sched(hub, clock, nodes=4, cpu="2", device=True, zones=None,
+           batch=64):
+    for i in range(nodes):
+        n = (MakeNode().name(f"n{i}")
+             .capacity(cpu=cpu, memory="8Gi", pods="110").obj())
+        if zones is not None:
+            n.metadata.labels[LABEL_ZONE] = zones[i % len(zones)]
+        hub.create_node(n)
+    cfg = default_config()
+    cfg.batch_size = batch
+    cfg.gang_device_packing = device
+    return Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=256),
+                     now=clock.now)
+
+
+def test_device_path_one_launch_per_gang_wave():
+    """O(1) device launches per gang, not O(members): a 12-member gang
+    binds whole off ONE fused pack launch, no Permit assembly."""
+    hub, clock = Hub(), Clock()
+    sched = _sched(hub, clock, nodes=4, cpu="4")
+    try:
+        hub.create_pod_group(group("big", 12))
+        for i in range(12):
+            hub.create_pod(gang_pod(f"b-{i}", "big", cpu="900m"))
+        sched.run_until_idle()
+        bound = [p for p in hub.list_pods() if p.spec.node_name]
+        assert len(bound) == 12
+        assert sched.stats["gang_device_launches"] == 1
+        assert sched._gang.stats["device_admitted"] == 1
+        assert sched.metrics.gang_device_launches.value() == 1
+        # no quorum assembly happened: nothing ever waited at Permit
+        assert not sched._gang._assembling
+        assert sched.cache.assumed_pod_count() == 0
+    finally:
+        sched.close()
+
+
+def test_device_infeasible_parks_without_reservations():
+    hub, clock = Hub(), Clock()
+    sched = _sched(hub, clock, nodes=2, cpu="1")
+    try:
+        hub.create_pod_group(group("huge", 4))
+        for i in range(4):
+            hub.create_pod(gang_pod(f"x-{i}", "huge", cpu="900m"))
+        sched.run_until_idle()
+        assert all(not p.spec.node_name for p in hub.list_pods())
+        assert sched.cache.assumed_pod_count() == 0
+        assert sum(len(fw.waiting_pods)
+                   for fw in sched.frameworks.values()) == 0
+        assert sched.stats["gang_device_launches"] >= 1
+    finally:
+        sched.close()
+
+
+def test_device_members_land_topology_close():
+    hub, clock = Hub(), Clock()
+    zones = ["z0", "z0", "z1", "z1", "z2", "z2"]
+    sched = _sched(hub, clock, nodes=6, cpu="4", zones=zones)
+    try:
+        hub.create_pod_group(group("co", 8))
+        for i in range(8):
+            hub.create_pod(gang_pod(f"c-{i}", "co", cpu="900m"))
+        sched.run_until_idle()
+        node_zone = {n.metadata.name: n.metadata.labels.get(LABEL_ZONE)
+                     for n in hub.list_nodes()}
+        used = {node_zone[p.spec.node_name] for p in hub.list_pods()
+                if p.spec.node_name}
+        assert len(used) == 1, f"gang spread over zones {used}"
+    finally:
+        sched.close()
+
+
+def test_device_unit_rollback_is_atomic():
+    """A member whose Reserve fails mid-unit rolls the WHOLE unit back
+    before anything reaches the binder: no partial gang, no leaked
+    reservation."""
+    hub, clock = Hub(), Clock()
+    sched = _sched(hub, clock, nodes=4, cpu="4")
+    try:
+        hub.create_pod_group(group("frag", 4))
+        pods = [gang_pod(f"f-{i}", "frag", cpu="500m") for i in range(4)]
+        for p in pods:
+            hub.create_pod(p)
+        victim_uid = pods[2].metadata.uid
+        fw = sched.framework
+        real_reserve = fw.run_reserve_plugins
+
+        def failing_reserve(state, pod, node):
+            if pod.metadata.uid == victim_uid:
+                raise RuntimeError("reserve poison")
+            return real_reserve(state, pod, node)
+
+        fw.run_reserve_plugins = failing_reserve
+        sched.run_until_idle()
+        assert all(not p.spec.node_name for p in hub.list_pods())
+        assert sched.cache.assumed_pod_count() == 0, \
+            "rollback must release every reservation"
+        assert sched._gang.stats["rollbacks"] >= 1
+        assert not sched._gang._device_admitted
+        # and after the poison clears, the gang schedules whole (peers
+        # parked unschedulable-class: the 5-minute park cap re-activates)
+        fw.run_reserve_plugins = real_reserve
+        clock.tick(301.0)
+        sched.queue.flush_backoff_completed()
+        sched.queue.flush_unschedulable_timeout()
+        sched.run_until_idle()
+        assert sum(1 for p in hub.list_pods() if p.spec.node_name) == 4
+    finally:
+        sched.close()
+
+
+def test_device_fault_falls_back_to_permit_path():
+    """A raising pack launch degrades the unit to the host Permit path
+    (the ladder), which still schedules it."""
+    from kubernetes_tpu.ops import gang as G
+
+    hub, clock = Hub(), Clock()
+    sched = _sched(hub, clock, nodes=4, cpu="4")
+    real = G.pack_gangs_jit
+    try:
+        hub.create_pod_group(group("lad", 3))
+        for i in range(3):
+            hub.create_pod(gang_pod(f"l-{i}", "lad", cpu="500m"))
+
+        def boom(*a, **kw):
+            raise RuntimeError("xla fault")
+
+        G.pack_gangs_jit = boom
+        sched.run_until_idle()
+        assert sum(1 for p in hub.list_pods() if p.spec.node_name) == 3
+        assert sched.stats["gang_fallbacks"] >= 1
+        assert sched._gang.stats["device_admitted"] == 0
+        assert sched._gang.stats["admitted"] >= 1   # Permit quorum did it
+    finally:
+        G.pack_gangs_jit = real
+        sched.close()
+
+
+def test_prefilter_bound_rides_cycle_pull():
+    """The host-fallback capacity bound never blocks: PreFilter leaves a
+    pending device scalar, the per-cycle pull resolves it into the memo,
+    and a later attempt under the same token enforces the bound."""
+    import jax
+
+    hub, clock = Hub(), Clock()
+    sched = _sched(hub, clock, nodes=2, cpu="1", device=False)
+    try:
+        hub.create_pod_group(group("cap", 4, timeout=5.0))
+        for i in range(4):
+            hub.create_pod(gang_pod(f"q-{i}", "cap", cpu="900m"))
+        sched.run_until_idle()
+        gang = sched._gang
+        key = "default/cap"
+        # the run resolved the bound through the ride-along pull
+        assert gang._cap_cache.get(key) is not None
+        # settle: time out the two waiting reservations so the free
+        # matrix (and therefore the bound) reflects an empty cluster
+        _settle(sched, clock, waves=1)
+        assert all(not p.spec.node_name for p in hub.list_pods())
+        assert sched.cache.assumed_pod_count() == 0
+        # a fresh attempt under a SETTLED mirror: the first pre_filter
+        # may re-dispatch (token drift from the run's last sync); its
+        # pending scalar resolves through the same public plumbing the
+        # scheduler uses, and the next call rejects from the memo
+        pod = next(p for p in hub.list_pods())
+        gang.pre_filter(None, pod, None)
+        for ckey, ctok, arr in gang.take_pending_caps():
+            gang.resolve_cap(ckey, ctok, int(jax.device_get(arr)))
+        assert not gang._pending_caps
+        s = gang.pre_filter(None, pod, None)
+        assert s.is_rejected()
+        assert "capacity bound 2" in s.message()
+    finally:
+        sched.close()
+
+
+# ------------------------------------------------- differential fuzz
+
+
+def _settle(sched, clock, waves: int = 4) -> None:
+    """Drive the host arm to a settled state: each wave times out any
+    Permit waiters (small ticks past the gang timeout, which re-activate
+    nothing else), then re-activates unschedulable parks past the
+    5-minute cap for another attempt (the capacity-bound memo converges
+    across waves); ends with a waiter-drain so no reservation is held
+    merely because the clock stopped."""
+    def drain_waiters():
+        for _ in range(4):
+            clock.tick(7.0)
+            sched.run_until_idle()
+            waiting = sum(len(fw.waiting_pods)
+                          for fw in sched.frameworks.values())
+            if waiting == 0 and sched.cache.assumed_pod_count() == 0:
+                return
+
+    for _ in range(waves):
+        drain_waiters()
+        clock.tick(301.0)
+        sched.queue.flush_backoff_completed()
+        sched.queue.flush_unschedulable_timeout()
+        sched.run_until_idle()
+    drain_waiters()
+
+
+def _scenario(seed: int):
+    """Randomized but ORDER-INDEPENDENT multi-gang scenario: gangs whose
+    sizes sum under cluster capacity (must all bind, either arm) plus —
+    half the time — one standalone-infeasible gang (must bind nothing).
+    Which-gang-wins-under-contention is legitimately order-dependent
+    between a per-member serial placement and a per-unit packer, so the
+    verdict comparison sticks to the decidable class; the contended
+    class keeps the invariant checks (test below)."""
+    rng = random.Random(seed)
+    nodes = rng.randint(3, 8)
+    node_cpu = rng.choice(["1", "2", "4"])
+    member_cpu = rng.choice(["500m", "900m", "1100m"])
+    per_node = int(node_cpu) * 1000 // int(member_cpu[:-1])
+    capacity = nodes * per_node
+    sizes = []
+    left = capacity
+    for _ in range(rng.randint(1, 3)):
+        if left <= 0:
+            break
+        s = rng.randint(1, min(6, left))
+        sizes.append(s)
+        left -= s
+    if rng.random() < 0.5:
+        sizes.append(capacity + rng.randint(1, 4))
+    rng.shuffle(sizes)
+    return nodes, node_cpu, member_cpu, sizes, capacity
+
+
+def _run_arm(seed: int, device: bool):
+    nodes, node_cpu, member_cpu, sizes, capacity = _scenario(seed)
+    hub, clock = Hub(), Clock()
+    sched = _sched(hub, clock, nodes=nodes, cpu=node_cpu, device=device)
+    try:
+        for g, size in enumerate(sizes):
+            hub.create_pod_group(group(f"g{g}", size, timeout=6.0))
+        for g, size in enumerate(sizes):
+            for m in range(size):
+                hub.create_pod(gang_pod(f"g{g}-m{m}", f"g{g}",
+                                        cpu=member_cpu))
+        sched.run_until_idle()
+        _settle(sched, clock)
+        bound: dict[str, int] = {f"g{g}": 0 for g in range(len(sizes))}
+        for p in hub.list_pods():
+            if p.spec.node_name:
+                bound[p.metadata.labels[LABEL_POD_GROUP]] += 1
+        # invariants shared by both arms: zero partial gangs, zero
+        # leaked reservations
+        assert sched.cache.assumed_pod_count() == 0, f"seed {seed}"
+        for g, size in enumerate(sizes):
+            assert bound[f"g{g}"] in (0, size), \
+                f"seed {seed}: partial gang g{g}: {bound} of {sizes}"
+        return bound, sizes, capacity
+    finally:
+        sched.close()
+
+
+def _differential(seed: int):
+    dev, sizes, capacity = _run_arm(seed, device=True)
+    host, _sizes, _cap = _run_arm(seed, device=False)
+    assert dev == host, (f"seed {seed}: device verdicts {dev} != "
+                         f"host verdicts {host} (sizes {sizes}, "
+                         f"capacity {capacity})")
+    for g, size in enumerate(sizes):
+        want = 0 if size > capacity else size
+        assert dev[f"g{g}"] == want, \
+            (f"seed {seed}: gang g{g} size {size} capacity {capacity}: "
+             f"bound {dev[f'g{g}']}, want {want}")
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_differential_device_vs_permit_path(seed):
+    """Tier-1 slice: same admit/reject verdict per gang under both
+    arms, zero partial gangs, zero leaked reservations."""
+    _differential(seed)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(8, 60))
+def test_differential_device_vs_permit_path_full(seed):
+    _differential(seed)
+
+
+@pytest.mark.parametrize("seed", (101, 102, 103))
+def test_contended_gangs_atomic_in_both_arms(seed):
+    """Over-subscribed contention (sum of sizes past capacity): which
+    gang wins is order-dependent, but BOTH arms must keep every gang
+    all-or-nothing with zero leaked reservations and never place more
+    members than capacity."""
+    rng = random.Random(seed)
+    nodes = rng.randint(2, 5)
+    sizes = [rng.randint(2, 6) for _ in range(3)]
+    capacity = nodes * 2                       # 2-cpu nodes, 900m members
+    for device in (True, False):
+        hub, clock = Hub(), Clock()
+        sched = _sched(hub, clock, nodes=nodes, cpu="2", device=device)
+        try:
+            for g, size in enumerate(sizes):
+                hub.create_pod_group(group(f"g{g}", size, timeout=6.0))
+            for g, size in enumerate(sizes):
+                for m in range(size):
+                    hub.create_pod(gang_pod(f"g{g}-m{m}", f"g{g}",
+                                            cpu="900m"))
+            sched.run_until_idle()
+            _settle(sched, clock, waves=3)
+            bound = {f"g{g}": 0 for g in range(len(sizes))}
+            for p in hub.list_pods():
+                if p.spec.node_name:
+                    bound[p.metadata.labels[LABEL_POD_GROUP]] += 1
+            assert sched.cache.assumed_pod_count() == 0
+            assert sum(bound.values()) <= capacity
+            for g, size in enumerate(sizes):
+                assert bound[f"g{g}"] in (0, size), \
+                    (f"seed {seed} device={device}: partial gang "
+                     f"g{g}: {bound} of {sizes}")
+        finally:
+            sched.close()
+
+
+# ------------------------------------------------- DRR backfill
+
+
+def test_singles_backfill_around_credit_gated_gang():
+    """Small jobs flow around a credit-gated gang the very round it
+    blocks — and the gang still releases within its bounded wait
+    (deficit accrues to the gang, backfill rides bounded debt)."""
+    from tests.test_gang import FakePQ, tenant_pod
+    from tests.test_gang import group as tgroup
+
+    jq = JobQueue({"a": {"weight": 1.0}, "b": {"weight": 1.0}})
+    jq.set_group(tgroup("g8", 8, queue="a"))
+    for i in range(8):
+        jq.add(tenant_pod(f"g-{i}", "a", gang="g8"))
+    for i in range(4):
+        jq.add(tenant_pod(f"s-{i}", "a"))
+        jq.add(tenant_pod(f"b-{i}", "b"))      # persistent contention
+    pq = FakePQ()
+    jq.release(pq, budget=4)
+    names = [p.metadata.name for p in pq.pods]
+    assert any(n.startswith("s-") for n in names), \
+        "singles must backfill around the credit-gated gang"
+    assert not any(n.startswith("g-") for n in names)
+    # the gang's deficit was NOT spent by the backfill: it releases
+    # within the same bounded wait as without backfill
+    for _ in range(12):
+        jq.release(pq, budget=16)
+        if any(p.metadata.name.startswith("g-") for p in pq.pods):
+            break
+    else:
+        raise AssertionError("backfill starved the earmarked gang")
+    assert sum(1 for p in pq.pods
+               if p.metadata.name.startswith("g-")) == 8
+
+
+def test_device_permit_failure_rolls_back_whole_unit():
+    """All-or-nothing holds through the PERMIT stage too: one member's
+    permit rejection undoes every reserved peer before any member
+    reaches the binder (review finding: undoing only the failing member
+    left its peers binding as a partial gang)."""
+    hub, clock = Hub(), Clock()
+    sched = _sched(hub, clock, nodes=4, cpu="4")
+    try:
+        hub.create_pod_group(group("pfail", 4))
+        pods = [gang_pod(f"p-{i}", "pfail", cpu="500m") for i in range(4)]
+        for p in pods:
+            hub.create_pod(p)
+        victim_uid = pods[1].metadata.uid
+        fw = sched.framework
+        real_permit = fw.run_permit_plugins
+
+        def failing_permit(state, pod, node):
+            if pod.metadata.uid == victim_uid:
+                from kubernetes_tpu.framework.interface import Status
+
+                return Status.unschedulable("quota veto",
+                                            plugin="ExtraPermit"), 0.0
+            return real_permit(state, pod, node)
+
+        fw.run_permit_plugins = failing_permit
+        sched.run_until_idle()
+        assert all(not p.spec.node_name for p in hub.list_pods()), \
+            "a permit-stage failure must place NO member"
+        assert sched.cache.assumed_pod_count() == 0
+        assert sched._gang.stats["rollbacks"] >= 1
+        assert sched._gang.stats["device_admitted"] == 0
+        fw.run_permit_plugins = real_permit
+        clock.tick(301.0)
+        sched.queue.flush_backoff_completed()
+        sched.queue.flush_unschedulable_timeout()
+        sched.run_until_idle()
+        assert sum(1 for p in hub.list_pods() if p.spec.node_name) == 4
+    finally:
+        sched.close()
+
+
+def test_chunk_fault_never_redispatches_committed_units():
+    """>GANG_PACK_BUCKET units with a fault in the SECOND chunk: chunk
+    1's committed gangs stay committed (exactly once), only uncommitted
+    members degrade to the Permit path (review finding)."""
+    from kubernetes_tpu.ops import gang as G
+
+    hub, clock = Hub(), Clock()
+    sched = _sched(hub, clock, nodes=10, cpu="4", batch=256)
+    n_units = sched.GANG_PACK_BUCKET + 2
+    real = G.pack_gangs_jit
+    calls = []
+    try:
+        for g in range(n_units):
+            hub.create_pod_group(group(f"ch-{g}", 2))
+        for g in range(n_units):
+            for m in range(2):
+                hub.create_pod(gang_pod(f"ch-{g}-m{m}", f"ch-{g}",
+                                        cpu="100m"))
+
+        def second_chunk_boom(*a, **kw):
+            calls.append(1)
+            if len(calls) == 2:
+                raise RuntimeError("chunk 2 xla fault")
+            return real(*a, **kw)
+
+        G.pack_gangs_jit = second_chunk_boom
+        sched.run_until_idle()
+        bound = {}
+        for p in hub.list_pods():
+            if p.spec.node_name:
+                g = p.metadata.labels[LABEL_POD_GROUP]
+                bound[g] = bound.get(g, 0) + 1
+        # every gang landed exactly once — chunk 1 via the device path,
+        # the faulted tail via the Permit fallback
+        assert all(n == 2 for n in bound.values()), bound
+        assert len(bound) == n_units
+        assert sched.cache.assumed_pod_count() == 0
+        assert sched.stats["gang_fallbacks"] >= 2
+    finally:
+        G.pack_gangs_jit = real
+        sched.close()
+
+
+def test_infeasible_for_all_but_quorum_feasible_falls_back():
+    """min_member=2 with 4 members present and capacity for only 2: the
+    packer cannot place all 4, but the Permit path admits the quorum
+    subset — the unit must FALL BACK, not park (review finding)."""
+    hub, clock = Hub(), Clock()
+    sched = _sched(hub, clock, nodes=2, cpu="1")    # capacity: 2 x 900m
+    try:
+        hub.create_pod_group(group("sub", 2, timeout=8.0))
+        for i in range(4):
+            hub.create_pod(gang_pod(f"s-{i}", "sub", cpu="900m"))
+        sched.run_until_idle()
+        _settle(sched, clock, waves=2)
+        n_bound = sum(1 for p in hub.list_pods() if p.spec.node_name)
+        assert n_bound == 2, \
+            f"the quorum subset must schedule via the fallback ({n_bound})"
+        assert sched.cache.assumed_pod_count() == 0
+    finally:
+        sched.close()
+
+
+def test_ff_does_not_credit_idle_tenant():
+    """The virtual-clock fast-forward must not bank deficit for an
+    idle (fully quota-blocked) tenant (review finding)."""
+    from tests.test_gang import FakePQ, tenant_pod
+    from tests.test_gang import group as tgroup
+
+    jq = JobQueue({"blocked": {"quota": {"pods": "1"}},
+                   "gangs": {"weight": 1.0}})
+    jq.add(tenant_pod("b-keep", "blocked"))
+    pq = FakePQ()
+    jq.release(pq, budget=8)                 # blocked uses its quota
+    for i in range(6):
+        jq.add(tenant_pod(f"b-{i}", "blocked"))   # quota-blocked backlog
+    jq.set_group(tgroup("g8", 8, queue="gangs"))
+    for i in range(8):
+        jq.add(tenant_pod(f"g-{i}", "gangs", gang="g8"))
+    for _ in range(6):
+        jq.release(pq, budget=8)             # ff fires for the gang
+    assert jq._tenants["blocked"].deficit == 0.0, \
+        "fast-forward must not credit an idle tenant"
+    # and the gang did release via the fast-forward
+    assert sum(1 for p in pq.pods
+               if p.metadata.name.startswith("g-")) == 8
+
+
+def test_big_gang_overdraw_survives_debt_repayment():
+    """Repayment only draws from POSITIVE deficit: a big gang's negative
+    post-release overdraw must persist (the fairness penalty), not be
+    forgiven into inflated backfill debt (review finding)."""
+    from tests.test_gang import FakePQ, tenant_pod
+    from tests.test_gang import group as tgroup
+
+    jq = JobQueue({"a": {"weight": 1.0}, "b": {"weight": 1.0}})
+    jq.set_group(tgroup("g20", 20, queue="a"))
+    for i in range(20):
+        jq.add(tenant_pod(f"g-{i}", "a", gang="g20"))
+    for i in range(3):
+        jq.add(tenant_pod(f"s-{i}", "a"))
+        jq.add(tenant_pod(f"b-{i}", "b"))
+    pq = FakePQ()
+    for _ in range(8):
+        jq.release(pq, budget=32)
+        if any(p.metadata.name.startswith("g-") for p in pq.pods):
+            break
+    t = jq._tenants["a"]
+    assert sum(1 for p in pq.pods
+               if p.metadata.name.startswith("g-")) == 20
+    # without the positive-deficit clamp, "repaying" from the gang's
+    # negative overdraw inflated the debt past the one-gang cap (and
+    # forgave the overdraw): debt must stay within [0, gang cost]
+    assert 0.0 <= t.backfill_debt <= 20.0, t.backfill_debt
+
+
+def test_backfill_debt_is_bounded_and_repaid():
+    from tests.test_gang import FakePQ, tenant_pod
+    from tests.test_gang import group as tgroup
+
+    jq = JobQueue({"a": {"weight": 1.0}, "b": {"weight": 1.0}})
+    jq.set_group(tgroup("g6", 6, queue="a"))
+    for i in range(6):
+        jq.add(tenant_pod(f"g-{i}", "a", gang="g6"))
+    for i in range(20):
+        jq.add(tenant_pod(f"s-{i}", "a"))
+        jq.add(tenant_pod(f"b-{i}", "b"))
+    pq = FakePQ()
+    jq.release(pq, budget=4)
+    t = jq._tenants["a"]
+    # debt never exceeds one blocked-gang's cost
+    assert 0.0 < t.backfill_debt <= 6.0
+    for _ in range(20):
+        jq.release(pq, budget=8)
+    # gang released and the debt has been repaid from its surplus
+    assert sum(1 for p in pq.pods
+               if p.metadata.name.startswith("g-")) == 6
+    assert t.backfill_debt == 0.0

@@ -327,6 +327,45 @@ def test_ns_selector_preferred_anti_affinity_tiny():
     assert r["stats"]["unschedulable"] == 0
 
 
+def test_gang_topology_packing_tiny():
+    """The co-location workload's validate hook passes under the device
+    packer: every gang lands in ONE zone (ISSUE-12 acceptance)."""
+    from kubernetes_tpu.perf.workloads import gang_topology_packing
+
+    w = small(gang_topology_packing(init_nodes=16, zones=4, gangs=3))
+    w.batch_size = 64       # a gang unit must fit one pop batch
+    r = run_workload(w)
+    col = r["colocation"]
+    assert col["gangs"] == 3
+    assert col["mean_zone_spans"] == 1.0
+    assert r["gangs"]["device_admitted"] == 3
+
+
+def test_gang_topology_packing_validate_rejects_scatter():
+    """The validate hook is a real gate: a scattered placement raises."""
+    from kubernetes_tpu.api.objects import (
+        LABEL_POD_GROUP,
+        LABEL_ZONE,
+    )
+    from kubernetes_tpu.hub import Hub
+    from kubernetes_tpu.perf.workloads import _colocation_validate
+    from kubernetes_tpu.testing import MakeNode, MakePod
+
+    hub = Hub()
+    for i in range(4):
+        n = MakeNode().name(f"n{i}").capacity(cpu="4", memory="8Gi",
+                                              pods="10").obj()
+        n.metadata.labels[LABEL_ZONE] = f"z{i}"
+        hub.create_node(n)
+    for i in range(4):
+        p = MakePod().name(f"m{i}").req(cpu="100m").obj()
+        p.metadata.labels[LABEL_POD_GROUP] = "scattered"
+        hub.create_pod(p)
+        hub.bind(p, f"n{i}")
+    with pytest.raises(AssertionError):
+        _colocation_validate(hub, {})
+
+
 # suite-tier discipline (tests/test_markers.py): area marker
 import pytest  # noqa: E402
 pytestmark = pytest.mark.perf
