@@ -54,6 +54,12 @@ def main(argv=None) -> int:
                         choices=("json", "bin1"),
                         help="journal WAL codec for --fabric shard "
                              "processes (bin1 ≈ 6x smaller replay)")
+    parser.add_argument("--state-replicas", type=int, default=1,
+                        help="with --fabric: run the shared-state core "
+                             "as an N-member replicated quorum (3 = "
+                             "the etcd model; leader kill -9 fails "
+                             "over without losing rv/fencing/ring "
+                             "state)")
     parser.add_argument("--journal-capacity", type=int, default=16384,
                         help="event-journal ring capacity per resource "
                              "kind (the watch-resume window)")
@@ -125,7 +131,8 @@ def main(argv=None) -> int:
         fabric_cluster = spawn_local_cluster(
             pod_shards=args.fabric, wal_dir=args.wal,
             journal_capacity=args.journal_capacity,
-            wal_codec=args.fabric_wal_codec)
+            wal_codec=args.fabric_wal_codec,
+            state_replicas=args.state_replicas)
         hub = RemoteHub(fabric_cluster.router_url)
         print(f"fabric: {args.fabric} pod-shard processes + state/"
               f"nodes/events/meta + router at "

@@ -975,6 +975,253 @@ def run_proc_crash_storm(pods: int = 300, nodes: int = 12,
 
 
 # --------------------------------------------------------------------------
+# replicated-state storm: kill -9 the state LEADER mid-storm (ISSUE 13)
+# --------------------------------------------------------------------------
+
+
+def run_state_storm(pods: int = 300, nodes: int = 12, seed: int = 29,
+                    timeout_s: float = 300.0) -> dict:
+    """The replicated-state-core battery: a 3-replica state quorum
+    (rv allocation, lease fencing, ring map), shards and a scheduler
+    driving commits through the router, and a ``kill -9`` of the state
+    LEADER mid-storm — landing mid-``rv.next`` (every commit draws a
+    revision), mid-lease-renew (the elector renews continuously), and
+    mid-ring-CAS (a rebalance fires concurrently with the kill).
+
+    ``ok`` iff: a new leader is elected and the killed replica rejoins
+    from its WAL; every pod binds EXACTLY once across the failover
+    (watch-tallied ledger); fencing epochs are monotone and a stale
+    epoch is still Fenced by the new quorum; the journal audit finds
+    **no rv ever reused** (every committed revision is globally
+    unique — the majority-ack-before-release invariant); the
+    concurrent rebalance either completed (ring flipped exactly once)
+    or rolled back (ring unchanged) with zero pods lost either way;
+    and the ledger's watch healed with zero relists."""
+    import tempfile
+
+    from kubernetes_tpu.config.types import default_config
+    from kubernetes_tpu.fabric.cluster import RING_SLOTS, ring_slot
+    from kubernetes_tpu.fabric.replica import ReplicaClient
+    from kubernetes_tpu.fabric.supervisor import spawn_local_cluster
+    from kubernetes_tpu.hub import Conflict, EventHandlers, Fenced
+    from kubernetes_tpu.hubclient import RemoteHub
+    from kubernetes_tpu.leaderelection import LeaderElector
+    from kubernetes_tpu.ops.features import Capacities
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing import MakeNode, MakePod
+
+    report: dict = {"pods": pods, "nodes": nodes, "seed": seed,
+                    "state_replicas": 3}
+    wal_dir = tempfile.mkdtemp(prefix="state-storm-wal-")
+    cluster = spawn_local_cluster(pod_shards=2, wal_dir=wal_dir,
+                                  state_replicas=3)
+    client = RemoteHub(cluster.router_url, timeout=10.0,
+                       retry_deadline=5.0, retry_base=0.01,
+                       retry_cap=0.2)
+    ledger_client = RemoteHub(cluster.router_url, timeout=10.0)
+    state_client = ReplicaClient(cluster.state_urls)
+    sched = None
+
+    def with_retry(fn, deadline_s: float = 30.0):
+        end = time.monotonic() + deadline_s
+        while True:
+            try:
+                return fn()
+            except Exception:  # noqa: BLE001 — failover window
+                if time.monotonic() > end:
+                    raise
+                time.sleep(0.2)
+
+    try:
+        for i in range(nodes):
+            client.create_node(MakeNode().name(f"sn-{i}")
+                               .capacity(cpu="64", memory="256Gi",
+                                         pods="440").obj())
+        bind_counts: dict[str, int] = {}
+        block = threading.Lock()
+
+        def on_update(old, new) -> None:
+            if not old.spec.node_name and new.spec.node_name:
+                with block:
+                    uid = new.metadata.uid
+                    bind_counts[uid] = bind_counts.get(uid, 0) + 1
+
+        ledger_client.watch_pods(EventHandlers(on_update=on_update),
+                                 replay=False)
+        cfg = default_config()
+        cfg.batch_size = 64
+        sched = Scheduler(client, cfg,
+                          caps=Capacities(nodes=max(32, nodes * 2),
+                                          pods=1024))
+        elector = LeaderElector(client.leases, "state-storm-a",
+                                lease_duration=2.0, renew_deadline=1.0,
+                                retry_period=0.1)
+        sched.start(elector=elector)
+        for i in range(pods):
+            with_retry(lambda i=i: client.create_pod(
+                MakePod().name(f"sp-{i}").namespace(f"ns-{i % 7}")
+                .req(cpu="50m").obj()))
+
+        def bound_count() -> int:
+            try:
+                return sum(1 for p in ledger_client.list_pods()
+                           if p.spec.node_name)
+            except Exception:  # noqa: BLE001 — mid-kill window
+                return -1
+
+        # phase 1: let the storm get going (rv.next + lease-renew
+        # traffic is continuous — the kill below lands mid-both)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 60.0 \
+                and bound_count() < pods // 4:
+            time.sleep(0.2)
+        epoch_before = with_retry(
+            lambda: client.leases.epoch_of("kube-scheduler"))
+        report["epoch_before_kill"] = epoch_before
+
+        # phase 2: a rebalance racing the leader kill — the in-flight
+        # ring CAS must complete or roll back, never half-apply
+        ring0 = with_retry(lambda: client.fabric_ring())
+        slot = ring_slot("ns-0", len(ring0["slots"]) or RING_SLOTS)
+        src = ring0["slots"][slot]
+        dst = next(n for n in cluster.pod_shards if n != src)
+        rebalance_outcome: dict = {}
+
+        def rebalance() -> None:
+            # generous timeout: mid-kill, shard commits stall on the
+            # state client's redirect budget before the move proceeds
+            admin = RemoteHub(cluster.router_url, timeout=90.0)
+            try:
+                r = admin.rebalance_segment([slot], dst)
+                rebalance_outcome["result"] = "completed"
+                rebalance_outcome["epoch"] = r["epoch"]
+            except Conflict as e:
+                rebalance_outcome["result"] = "rolled_back"
+                rebalance_outcome["error"] = str(e)
+            except Exception as e:  # noqa: BLE001 — quorum lost window
+                # ambiguous (the answer, not the move, was lost): the
+                # quorum's ring is the verdict — the same resolution
+                # rebalance_segment itself applies to a lost CAS reply
+                rebalance_outcome["error"] = repr(e)
+                try:
+                    cur = with_retry(lambda: client.fabric_ring())
+                    rebalance_outcome["result"] = \
+                        "completed" if cur["slots"][slot] == dst \
+                        else "rolled_back"
+                except Exception:  # noqa: BLE001
+                    rebalance_outcome["result"] = "unavailable"
+            finally:
+                admin.close()
+
+        reb_thread = threading.Thread(target=rebalance, daemon=True)
+
+        # phase 3: kill -9 the state LEADER mid-storm
+        leader = cluster.state_leader()
+        report["killed_leader"] = leader
+        reb_thread.start()
+        time.sleep(0.05)     # let the rebalance reach its CAS window
+        report["killed_pid"] = cluster.sup.kill_shard(leader)
+        reb_thread.join(timeout=120.0)
+        report["rebalance"] = rebalance_outcome
+
+        # a NEW leader must be elected among the survivors
+        new_leader = cluster.state_leader(timeout_s=30.0)
+        report["new_leader"] = new_leader
+        # the killed replica rejoins from its WAL (same port, same log)
+        restarted = cluster.sup.restart_shard(leader)
+        report["restarted_port"] = restarted.port
+
+        # phase 4: drain to completion across the failover
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if bound_count() >= pods:
+                break
+            time.sleep(0.3)
+        bound = bound_count()
+        epoch_after = with_retry(
+            lambda: client.leases.epoch_of("kube-scheduler"))
+        report["epoch_after"] = epoch_after
+        # a deposed scheduler epoch must still be Fenced by the NEW
+        # quorum (fencing state survived the leader kill)
+        probe = MakePod().name("fence-probe").namespace("ns-0") \
+            .scheduler_name("fence-probe-noop").obj()
+        with_retry(lambda: client.create_pod(probe))
+        stale_fenced = False
+        if epoch_after > 0:
+            try:
+                client.bind(probe, "sn-0", epoch_after - 1)
+            except Fenced:
+                stale_fenced = True
+        try:
+            client.delete_pod(probe.metadata.uid)
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+
+        # phase 5: the journal audit — every committed revision in the
+        # fabric is globally unique (no rv reused across the failover;
+        # gaps are the journal's contract, reuse never is)
+        changes = with_retry(
+            lambda: client.list_changes(0, ("pods", "nodes")))
+        rvs = [c["rv"] for c in changes.get("changes", [])]
+        report["journal_events"] = len(rvs)
+        report["rv_reused"] = len(rvs) - len(set(rvs))
+        # ring integrity after the racing rebalance
+        ring_after = with_retry(lambda: client.fabric_ring())
+        if rebalance_outcome.get("result") == "completed":
+            ring_ok = (ring_after["epoch"] >= ring0["epoch"] + 1
+                       and ring_after["slots"][slot] == dst)
+        elif rebalance_outcome.get("result") == "rolled_back":
+            ring_ok = ring_after["slots"][slot] == src
+        else:
+            ring_ok = False
+        report["ring_ok"] = ring_ok
+
+        # replica telemetry: one leader, the restarted member back as
+        # a follower, terms agreeing
+        statuses = state_client.replica_status()
+        report["replica_roles"] = {st.get("name", st.get("url")):
+                                   st.get("role", "dead")
+                                   for st in statuses}
+        leaders = [st for st in statuses
+                   if st.get("role") == "leader"]
+
+        with block:
+            dup = {uid: n for uid, n in bind_counts.items() if n > 1}
+        daemon_error = getattr(sched, "daemon_error", None)
+        relists = ledger_client.resilience_stats()["watch_relists"]
+        report.update({
+            "bound": bound, "lost": pods - bound,
+            "duplicate_binds": dup,
+            "stale_epoch_fenced": stale_fenced,
+            "daemon_error": repr(daemon_error) if daemon_error
+            else None,
+            "client_relists": relists,
+            "ok": (bound == pods and not dup
+                   and epoch_after >= epoch_before >= 1
+                   and stale_fenced and daemon_error is None
+                   and report["rv_reused"] == 0
+                   and ring_ok
+                   and rebalance_outcome.get("result")
+                   in ("completed", "rolled_back")
+                   and len(leaders) == 1
+                   and relists == 0),
+        })
+    finally:
+        if sched is not None:
+            try:
+                sched.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        for c in (client, ledger_client, state_client):
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        cluster.stop()
+    return report
+
+
+# --------------------------------------------------------------------------
 # gang-atomicity storm: leader kill mid-gang-commit (ISSUE 6)
 # --------------------------------------------------------------------------
 
@@ -1196,7 +1443,7 @@ def main() -> None:
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--storm",
                     choices=("smoke", "device", "crash", "proc",
-                             "gang", "all"),
+                             "state", "gang", "all"),
                     default="smoke",
                     help="which storm to run (bench.py --chaos-smoke "
                          "runs 'all')")
@@ -1210,6 +1457,8 @@ def main() -> None:
         report = run_crash_storm(seed=args.seed)
     elif args.storm == "proc":
         report = run_proc_crash_storm(seed=args.seed)
+    elif args.storm == "state":
+        report = run_state_storm(seed=args.seed)
     elif args.storm == "gang":
         report = run_gang_storm(seed=args.seed)
     else:
@@ -1219,6 +1468,7 @@ def main() -> None:
             "device": run_device_storm(seed=args.seed),
             "crash": run_crash_storm(seed=args.seed),
             "proc": run_proc_crash_storm(seed=args.seed),
+            "state": run_state_storm(seed=args.seed),
             "gang": run_gang_storm(seed=args.seed),
         }
         report["ok"] = all(r.get("ok") for r in report.values())

@@ -49,6 +49,12 @@ _LAZY = {
                             "spawn_local_cluster"),
     "run_fanout_smoke_procs": ("kubernetes_tpu.fabric.fanout",
                                "run_fanout_smoke_procs"),
+    # replicated state core (ISSUE 13): the Raft-lite quorum for
+    # rv / fencing / ring, and its leader-routing client
+    "StateReplica": ("kubernetes_tpu.fabric.replica", "StateReplica"),
+    "ReplicaClient": ("kubernetes_tpu.fabric.replica", "ReplicaClient"),
+    "make_state_client": ("kubernetes_tpu.fabric.replica",
+                          "make_state_client"),
 }
 
 

@@ -56,7 +56,13 @@ import threading
 import time
 import zlib
 
-from kubernetes_tpu.hub import Conflict, Hub, NotFound, Unavailable
+from kubernetes_tpu.hub import (
+    Conflict,
+    Hub,
+    NotFound,
+    StaleRing,
+    Unavailable,
+)
 from kubernetes_tpu.leaderelection import LeaseStore
 
 RING_SLOTS = 64                  # virtual slots on the namespace ring
@@ -77,7 +83,8 @@ _POD_UID_METHODS = frozenset({"delete_pod", "get_pod",
 # per-shard segment verbs: meaningful only against ONE shard process —
 # the router rejects them (rebalance_segment is its move surface)
 _SHARD_ONLY_METHODS = frozenset({"export_segment", "import_segment",
-                                 "drop_segment", "reconcile_ring"})
+                                 "drop_segment", "abort_export",
+                                 "reconcile_ring"})
 
 
 def ring_slot(namespace: str, ring_size: int = RING_SLOTS) -> int:
@@ -313,11 +320,17 @@ class ClusterClient:
 
     def __init__(self, state_url: str, timeout: float = 30.0,
                  client_factory=None, ring_ttl_s: float = 3.0):
+        from kubernetes_tpu.fabric.replica import make_state_client
         from kubernetes_tpu.hubclient import RemoteHub
 
         self._factory = client_factory or (
             lambda url: RemoteHub(url, timeout=timeout))
-        self.state = self._factory(state_url)
+        # a comma-separated state URL is a REPLICA SET: the client
+        # resolves the leader, follows NotLeader redirects, and rides
+        # out elections — single URLs keep the classic one-StateCore
+        # path byte-for-byte
+        self.state = make_state_client(state_url, timeout=timeout,
+                                       client_factory=client_factory)
         self.leases = self.state.leases
         self.rv = self.state.rv
         self._lock = threading.RLock()
@@ -329,6 +342,9 @@ class ClusterClient:
         # held for the duration of a rebalance; pod WRITE routing takes
         # it briefly so a write can never land on a stale segment owner
         self._migrate_lock = threading.RLock()
+        # writes redirected by shard-side ring fencing (StaleRing →
+        # re-resolve → retry): the multi-router coordination counter
+        self.stale_ring_retries = 0
         self.refresh_shards()
 
     # ------------- shard resolution -------------
@@ -474,43 +490,98 @@ class ClusterClient:
 
     # ------------- pods (ring-routed) -------------
 
+    # how long a pod write chases a migrating segment before parking:
+    # the flip itself takes milliseconds, the budget rides out a state
+    # failover happening mid-migrate
+    STALE_RING_DEADLINE_S = 5.0
+
+    def _invoke_ns(self, method: str, namespace: str, *args):
+        """Namespace-routed pod write with stale-ring fencing: a
+        StaleRing verdict from the shard (the slot is frozen mid-export
+        or the ring flipped under us) re-reads the ring and retries the
+        CURRENT owner — a write is redirected, never committed onto a
+        segment that is about to be dropped."""
+        end = time.monotonic() + self.STALE_RING_DEADLINE_S
+        while True:
+            with self._migrate_lock:
+                try:
+                    return self._invoke(self._pod_shard_name(namespace),
+                                        method, *args)
+                except StaleRing as e:
+                    err = e
+            self.stale_ring_retries += 1
+            self.ring(fresh=True)
+            if time.monotonic() >= end:
+                raise Unavailable(
+                    f"{method}: segment for {namespace!r} still "
+                    f"migrating ({err})") from None
+            time.sleep(0.02)
+
     def create_pod(self, pod) -> None:
-        with self._migrate_lock:
-            self._invoke(self._pod_shard_name(pod.metadata.namespace),
-                         "create_pod", pod)
+        self._invoke_ns("create_pod", pod.metadata.namespace, pod)
 
     def update_pod(self, pod) -> None:
-        with self._migrate_lock:
-            self._invoke(self._pod_shard_name(pod.metadata.namespace),
-                         "update_pod", pod)
+        self._invoke_ns("update_pod", pod.metadata.namespace, pod)
 
     def bind(self, pod, node_name: str, epoch=None,
              lease_name: str = "kube-scheduler") -> None:
-        with self._migrate_lock:
-            self._invoke(self._pod_shard_name(pod.metadata.namespace),
-                         "bind", pod, node_name, epoch, lease_name)
+        self._invoke_ns("bind", pod.metadata.namespace, pod, node_name,
+                        epoch, lease_name)
 
     def patch_pod_condition(self, pod, condition, nominated_node=None,
                             epoch=None,
                             lease_name: str = "kube-scheduler") -> None:
-        with self._migrate_lock:
-            self._invoke(self._pod_shard_name(pod.metadata.namespace),
-                         "patch_pod_condition", pod, condition,
-                         nominated_node, epoch, lease_name)
+        self._invoke_ns("patch_pod_condition", pod.metadata.namespace,
+                        pod, condition, nominated_node, epoch,
+                        lease_name)
 
-    def _probe_uid(self, uid: str):
-        for name in self.pod_shard_names():
-            if self._invoke(name, "get_pod", uid) is not None:
-                return name
-        return None
+    def _invoke_uid(self, method: str, uid: str, *args,
+                    missing_ok: bool = False):
+        """Uid-routed pod write: any holder may answer the READ (the
+        probe), but the WRITE routes by the ring like every
+        namespace-routed verb. During a migrate's overlap window both
+        shards hold a copy — committing on "whichever copy accepts"
+        would let a pre-flip target swallow a delete that the
+        rollback's drop then discards (resurrecting the pod), so only
+        the ring-assigned owner commits; a frozen source parks the
+        write until the flip or the abort resolves it."""
+        end = time.monotonic() + self.STALE_RING_DEADLINE_S
+        while True:
+            with self._migrate_lock:
+                pod = None
+                for name in self.pod_shard_names():
+                    pod = self._invoke(name, "get_pod", uid)
+                    if pod is not None:
+                        break
+                if pod is None:
+                    if missing_ok:
+                        return None
+                    raise NotFound(f"Pod {uid}")
+                try:
+                    return self._invoke(
+                        self._pod_shard_name(pod.metadata.namespace),
+                        method, uid, *args)
+                except StaleRing as e:
+                    err = e
+                except NotFound:
+                    # a stray copy answered the probe but the
+                    # ring-assigned owner has no such pod: the owner's
+                    # verdict is authoritative (the stray reconciles
+                    # away)
+                    if missing_ok:
+                        return None
+                    raise
+            self.stale_ring_retries += 1
+            self.ring(fresh=True)
+            if time.monotonic() >= end:
+                raise Unavailable(
+                    f"{method}: pod {uid} still migrating "
+                    f"({err})") from None
+            time.sleep(0.02)
 
     def delete_pod(self, uid: str, epoch=None,
                    lease_name: str = "kube-scheduler") -> None:
-        with self._migrate_lock:
-            s = self._probe_uid(uid)
-            if s is None:
-                raise NotFound(f"Pod {uid}")
-            self._invoke(s, "delete_pod", uid, epoch, lease_name)
+        self._invoke_uid("delete_pod", uid, epoch, lease_name)
 
     def get_pod(self, uid: str):
         for name in self.pod_shard_names():
@@ -520,18 +591,13 @@ class ClusterClient:
         return None
 
     def set_pod_claim_statuses(self, uid: str, statuses) -> None:
-        with self._migrate_lock:
-            s = self._probe_uid(uid)
-            if s is not None:
-                self._invoke(s, "set_pod_claim_statuses", uid, statuses)
+        self._invoke_uid("set_pod_claim_statuses", uid, statuses,
+                         missing_ok=True)
 
     def clear_nominated_node(self, uid: str, epoch=None,
                              lease_name: str = "kube-scheduler") -> None:
-        with self._migrate_lock:
-            s = self._probe_uid(uid)
-            if s is not None:
-                self._invoke(s, "clear_nominated_node", uid, epoch,
-                             lease_name)
+        self._invoke_uid("clear_nominated_node", uid, epoch, lease_name,
+                         missing_ok=True)
 
     def list_pods(self) -> list:
         # dedupe by uid keeping the newest revision: a rebalance's
@@ -637,10 +703,21 @@ class ClusterClient:
            rings keep the pre-move history, so a watch resuming across
            the move still gets the complete per-shard suffixes).
 
-        The migrate lock is held throughout, so pod writes queue for
-        the few milliseconds the move takes instead of racing the
-        flip. A source dying mid-drop leaves a stale copy that its
-        restart reconciles away (``reconcile_ring``)."""
+        The migrate lock serializes THIS router's writes around the
+        flip; writes from OTHER routers are fenced shard-side — a
+        frozen/deposed slot answers StaleRing and the writer re-reads
+        the ring — so two routers can never split-brain a segment.
+
+        The flip itself is **complete-or-rollback**: the ring CAS on
+        the state quorum either commits (we finish with the drop) or
+        it doesn't (we drop the target's copy and thaw the source).
+        When the CAS outcome is ambiguous — the state leader was
+        ``kill -9``'d mid-CAS, or a retried CAS answers False because
+        our FIRST attempt already committed — the ring itself is the
+        verdict: we re-read it from the new quorum and match it
+        against our proposed layout. A source dying mid-drop leaves a
+        stale copy that its restart reconciles away
+        (``reconcile_ring``)."""
         if to_shard not in self.pod_shard_names() \
                 and to_shard not in self._registry:
             raise NotFound(f"unknown target shard {to_shard!r}")
@@ -655,19 +732,51 @@ class ClusterClient:
                 if src != to_shard:
                     moves.setdefault(src, []).append(s)
             moved = {}
+            moved_slots: list[int] = []
             for src, sl in moves.items():
+                # export freezes the slots on the source (StaleRing to
+                # concurrent writers) atomically with the copy
                 pods = self._invoke(src, "export_segment", sl, size)
-                self._invoke(to_shard, "import_segment", pods)
+                self._invoke(to_shard, "import_segment", pods, sl, size)
                 moved[src] = len(pods)
+                moved_slots.extend(sl)
             new_slots = list(ring["slots"])
             for s in slots:
                 new_slots[s] = to_shard
             new_ring = {"epoch": ring["epoch"] + 1, "slots": new_slots}
-            if not self.state.fabric_set_ring(new_ring, ring["epoch"]):
-                raise Conflict("ring epoch moved under the rebalance; "
+            try:
+                committed = bool(self.state.fabric_set_ring(
+                    new_ring, ring["epoch"]))
+            except Unavailable:
+                committed = False
+            resolved = None
+            if not committed:
+                # ambiguous or lost: the quorum's ring is the verdict —
+                # judged on OUR slots only, because an unrelated
+                # rebalance committing concurrently moves the epoch and
+                # other slots without saying anything about ours
+                resolved = self._ring_verdict(slots, to_shard,
+                                              ring["epoch"] + 1)
+                committed = resolved is not None
+            if not committed:
+                # rolled back: remove the target's copy, thaw the
+                # sources — the segment never moved, parked writers
+                # land back on the original owner
+                for src, sl in moves.items():
+                    try:
+                        self._invoke(to_shard, "drop_segment", sl, size)
+                    except Unavailable:
+                        pass   # target restart reconciles the stray copy
+                    try:
+                        self._invoke(src, "abort_export", sl, size)
+                    except Unavailable:
+                        pass   # FROZEN_TTL_S + heartbeat thaw it
+                raise Conflict("ring epoch moved under the rebalance "
+                               "(or the CAS lost); rolled back — "
                                "re-read and retry")
             with self._lock:
-                self._ring, self._ring_ts = new_ring, time.monotonic()
+                self._ring = resolved or new_ring
+                self._ring_ts = time.monotonic()
             pending = []
             for src, sl in moves.items():
                 try:
@@ -679,6 +788,30 @@ class ClusterClient:
                     pending.append(src)
             return {"epoch": new_ring["epoch"], "moved": moved,
                     "pending_drops": pending}
+
+    def _ring_verdict(self, slots: list, to_shard: str,
+                      want_epoch: int,
+                      deadline_s: float = 10.0) -> dict | None:
+        """Did OUR move land? Re-read the quorum's ring (riding out a
+        failover) and check that every moved slot points at our target
+        with the epoch at least ours: a retried CAS that answered
+        False after our first attempt committed, or a leader killed
+        mid-CAS, both resolve here. Returns the current ring when the
+        move is in effect, None when it is not (roll back)."""
+        end = time.monotonic() + deadline_s
+        while True:
+            try:
+                cur = self.state.fabric_ring()
+            except Unavailable:
+                if time.monotonic() >= end:
+                    raise
+                time.sleep(0.2)
+                continue
+            if cur["epoch"] >= want_epoch \
+                    and all(cur["slots"][s] == to_shard
+                            for s in slots):
+                return cur
+            return None
 
     # ------------- lifecycle -------------
 

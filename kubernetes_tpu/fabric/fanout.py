@@ -460,9 +460,14 @@ def run_fanout_smoke_procs(subscribers: int = 50000, l1_count: int = 2,
       supervisor with bin1-WAL replay onto a new port,
     * one LIVE ring rebalance mid-storm (event-silent, resume points
       intact),
+    * one ``kill -9``'d **state-core LEADER** mid-storm (the shared
+      rv/fencing/ring quorum — ISSUE 13): a new leader is elected,
+      commits stall briefly and resume, the killed replica rejoins
+      from its WAL, and the stream invariants below still hold,
 
     with exact per-subscriber event counts, ≤ l1_count router sockets
     per shard process, and a FleetView scrape showing every process
+    (incl. all three state replicas, exactly one of them leading)
     healthy under its own pid/port identity."""
     import tempfile
 
@@ -485,10 +490,10 @@ def run_fanout_smoke_procs(subscribers: int = 50000, l1_count: int = 2,
     report: dict = {"procs": True, "subscribers": subscribers,
                     "l1": l1_count, "l2": l2_count, "pods": pods,
                     "cuts": cuts, "seed": seed,
-                    "pod_shards": pod_shards}
+                    "pod_shards": pod_shards, "state_replicas": 3}
     wal_dir = tempfile.mkdtemp(prefix="fabric-smoke-wal-")
     cluster = spawn_local_cluster(pod_shards=pod_shards,
-                                  wal_dir=wal_dir)
+                                  wal_dir=wal_dir, state_replicas=3)
     client = RemoteHub(cluster.router_url, timeout=10.0)
     l1_servers: list[RelayServer] = []
     l2_cores: list[RelayCore] = []
@@ -609,6 +614,23 @@ def run_fanout_smoke_procs(subscribers: int = 50000, l1_count: int = 2,
         for i in range(4):
             create_retry(MakePod().name(f"post-move-{i}")
                          .namespace("ns-0").req(cpu="50m").obj())
+
+        # ---- phase 4b: kill -9 the state-core LEADER mid-storm ----
+        # rv allocation, fencing, and the ring live on the quorum: the
+        # kill costs a brief write stall (redirect-retried), never a
+        # relist, never a lost or duplicated event downstream
+        state_leader = cluster.state_leader()
+        report["state_leader_killed"] = state_leader
+        report["state_leader_pid"] = cluster.sup.kill_shard(state_leader)
+        for i in range(6):
+            create_retry(MakePod().name(f"during-state-kill-{i}")
+                         .namespace(f"ns-{i % 7}").req(cpu="50m").obj())
+        report["state_new_leader"] = cluster.state_leader(timeout_s=30.0)
+        restarted_state = cluster.sup.restart_shard(state_leader)
+        report["state_restarted_port"] = restarted_state.port
+        for i in range(4):
+            create_retry(MakePod().name(f"after-state-kill-{i}")
+                         .namespace(f"ns-{i % 7}").req(cpu="50m").obj())
 
         # ---- phase 5: mid-storm downstream reconnect wave ----
         # composite-cursor resumes off the relay rings: zero 410s even
@@ -736,10 +758,14 @@ def run_fanout_smoke_procs(subscribers: int = 50000, l1_count: int = 2,
         report["wal_replay_ratio"] = round(jb / max(bb, 1), 2)
 
         # ---- phase 10: fleet health with per-process identity ----
-        endpoints = [{"component": "state", "shard": "state",
-                      "url": cluster.state_url},
-                     {"component": "router", "shard": "router-0",
-                      "url": cluster.router_url}]
+        # every state REPLICA is its own endpoint: followers answer
+        # 200-with-role (healthy, not degraded) and the summary rows
+        # carry who leads
+        endpoints = [{"component": "state", "shard": f"state-{i}",
+                      "url": u}
+                     for i, u in enumerate(cluster.state_urls)]
+        endpoints += [{"component": "router", "shard": "router-0",
+                       "url": cluster.router_url}]
         endpoints += [{"component": "shard", "shard": name,
                        "url": rec["url"]}
                       for name, rec in
@@ -752,12 +778,16 @@ def run_fanout_smoke_procs(subscribers: int = 50000, l1_count: int = 2,
         summary = fleet.summary(records)
         pids = [r.get("pid") for r in summary["endpoints"]
                 if r["component"] in ("state", "shard", "router")]
+        state_roles = [r.get("role") for r in summary["endpoints"]
+                       if r["component"] == "state"]
         report["fleet"] = {
             "endpoints": summary["total"],
             "healthy": summary["healthy"],
             "pids_distinct": len(set(pids)) == len(pids)
             and all(pids),
-            "ok": summary["ok"],
+            "state_roles": state_roles,
+            "ok": summary["ok"]
+            and state_roles.count("leader") == 1,
         }
         report["fanout_elapsed_s"] = round(time.monotonic() - t0, 2)
 

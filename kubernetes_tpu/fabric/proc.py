@@ -31,13 +31,32 @@ import time
 
 
 def _serve_state(args) -> None:
-    from kubernetes_tpu.fabric.cluster import StateCore
     from kubernetes_tpu.hubserver import HubServer
 
     pod_shards = [s for s in (args.pod_shards or "").split(",") if s]
-    core = StateCore(pod_shards=pod_shards,
-                     ring_slots=args.ring_slots)
-    server = HubServer(core, host=args.host, port=args.port).start()
+    if args.peers:
+        # replicated state core: one member of the quorum, peers pinned
+        # by name=url (ports pre-assigned by the supervisor / operator,
+        # the etcd static-bootstrap model — a replica restarts onto the
+        # SAME port so its peers need no re-resolution)
+        from kubernetes_tpu.fabric.replica import StateReplica
+
+        peers = dict(p.split("=", 1) for p in args.peers.split(",") if p)
+        core = StateReplica(
+            args.replica_id or args.name, peers=peers,
+            pod_shards=pod_shards, ring_slots=args.ring_slots,
+            wal_path=args.wal or None,
+            heartbeat_s=args.replica_heartbeat_s,
+            election_timeout_s=(args.replica_election_s,
+                                args.replica_election_s * 2))
+        server = HubServer(core, host=args.host, port=args.port).start()
+        core.start()
+    else:
+        from kubernetes_tpu.fabric.cluster import StateCore
+
+        core = StateCore(pod_shards=pod_shards,
+                         ring_slots=args.ring_slots)
+        server = HubServer(core, host=args.host, port=args.port).start()
     print(f"LISTENING {server._httpd.server_address[1]}", flush=True)
     while True:
         time.sleep(3600)
@@ -45,10 +64,14 @@ def _serve_state(args) -> None:
 
 def _serve_shard(args) -> None:
     from kubernetes_tpu.fabric.cluster import ProcShardHub
-    from kubernetes_tpu.hubclient import RemoteHub
+    from kubernetes_tpu.fabric.replica import make_state_client
     from kubernetes_tpu.hubserver import HubServer
 
-    state = RemoteHub(args.state, timeout=10.0)
+    # a comma-separated --state is the replica set: the client resolves
+    # the leader and rides out elections, so a state-leader kill -9
+    # costs this shard a redirect, not a crash
+    state = make_state_client(args.state, timeout=10.0,
+                              redirect_deadline_s=15.0)
     hub = ProcShardHub(args.name, state,
                        journal_capacity=args.journal_capacity,
                        wal_path=args.wal or None,
@@ -74,8 +97,17 @@ def _serve_shard(args) -> None:
     while True:
         time.sleep(args.heartbeat_s)
         try:
-            state.fabric_register_shard(args.name, url, kinds,
-                                        os.getpid())
+            reg = state.fabric_register_shard(args.name, url, kinds,
+                                              os.getpid())
+            if "pods" in kinds:
+                # refresh the slot fence from the authoritative ring:
+                # a slot the ring assigns elsewhere answers StaleRing
+                # here instead of absorbing a misrouted commit
+                slots = (reg.get("ring") or {}).get("slots") or []
+                if slots:
+                    hub.set_ring_view(
+                        [i for i, n in enumerate(slots)
+                         if n == args.name], len(slots))
         except Exception:  # noqa: BLE001 — state shard restarting
             pass
 
@@ -117,6 +149,16 @@ def main(argv=None) -> int:
                          "seeding the ring")
     ap.add_argument("--ring-slots", type=int, default=64)
     ap.add_argument("--heartbeat-s", type=float, default=2.0)
+    ap.add_argument("--peers", default="",
+                    help="state role: comma list of name=url replica "
+                         "peers (self included) — presence selects the "
+                         "REPLICATED state core; --wal names this "
+                         "replica's log WAL")
+    ap.add_argument("--replica-id", default="",
+                    help="state role: this replica's name in --peers")
+    ap.add_argument("--replica-heartbeat-s", type=float, default=0.2)
+    ap.add_argument("--replica-election-s", type=float, default=0.8,
+                    help="minimum election timeout (max is 2x)")
     args = ap.parse_args(argv)
     if args.role != "state" and not args.state:
         ap.error(f"--role {args.role} requires --state")

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 from collections import deque
@@ -104,7 +105,8 @@ class RelayCore:
     def __init__(self, upstream_url: str, kinds: tuple[str, ...] = ("pods",),
                  ring_capacity: int = 8192, queue_limit: int = 4096,
                  client_factory: Optional[Callable] = None,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0,
+                 watchdog: Optional[dict] = None):
         from kubernetes_tpu.hubclient import RemoteHub
 
         self.upstream_url = upstream_url
@@ -148,6 +150,24 @@ class RelayCore:
         # the tree exists for: the hub's socket count scales with
         # relays, not with subscribers
         self.client.watch_kinds(self._handlers, replay=True)
+        # liveness watchdog (ISSUE-13 satellite): probe the upstream on
+        # a heartbeat deadline and auto-reparent through the served
+        # topology map when it dies — cursor-carrying resume, so the
+        # downstream subscribers never relist. Config keys:
+        #   topology_url (required) — where to fetch the topology map
+        #   deadline_s (default 3.0) — continuous-unhealthy budget
+        #   interval_s (default 0.5) — probe cadence
+        #   name (optional) — this relay's advertised name, excluded
+        #     from its own candidate pool
+        self.watchdog_reparents = 0
+        self._wd = dict(watchdog) if watchdog else None
+        self._wd_stop = threading.Event()
+        self._wd_thread: Optional[threading.Thread] = None
+        if self._wd is not None:
+            self._wd_thread = threading.Thread(
+                target=self._watchdog_loop, daemon=True,
+                name="relay-watchdog")
+            self._wd_thread.start()
 
     def _ring_for(self, shard: str) -> Journal:
         ring = self._rings.get(shard)
@@ -356,6 +376,7 @@ class RelayCore:
                     "relist_serves": self.relist_serves,
                     "events_in": self.events_in,
                     "events_out": self.events_out,
+                    "watchdog_reparents": self.watchdog_reparents,
                     "upstream_client": up}
 
     def debug_state(self, max_subscribers: int = 200) -> dict:
@@ -382,6 +403,87 @@ class RelayCore:
                    "subscribers_total": st["subscribers"]})
         return st
 
+    def _upstream_healthy(self) -> bool:
+        """Two liveness signals, either one suffices to call the
+        upstream alive: the multiplexed watch stream is up (the common
+        case), or /healthz answers ok (covers the quiet-cluster window
+        where a reconnect is still backing off)."""
+        if getattr(self.client, "watches_healthy", True):
+            return True
+        try:
+            with urllib.request.urlopen(
+                    self.upstream_url.rstrip("/") + "/healthz",
+                    timeout=1.0) as resp:
+                return resp.status == 200
+        except (OSError, urllib.error.URLError):
+            return False
+
+    def _watchdog_loop(self) -> None:
+        deadline_s = float(self._wd.get("deadline_s", 3.0))
+        interval_s = float(self._wd.get("interval_s", 0.5))
+        down_since: Optional[float] = None
+        while not self._wd_stop.wait(interval_s):
+            try:
+                if self._upstream_healthy():
+                    down_since = None
+                    continue
+                now = time.monotonic()
+                if down_since is None:
+                    down_since = now
+                if now - down_since < deadline_s:
+                    continue
+                if self._reparent_via_topology():
+                    down_since = None
+            except Exception:  # noqa: BLE001 — the watchdog must
+                pass           # survive any transient topology error
+
+    def _reparent_via_topology(self) -> bool:
+        """Pick a new parent from the served topology map — a sibling
+        relay carrying our kinds (the dead parent and ourselves
+        excluded), else a router — and reparent with cursors: the move
+        is a journal RESUME, downstream subscribers keep streaming with
+        zero relists."""
+        from kubernetes_tpu.fabric.router import fetch_topology
+
+        topo = fetch_topology(self._wd["topology_url"], timeout=3.0)
+        relays = topo.get("relays", [])
+        dead = self.upstream_url.rstrip("/")
+        exclude = {r.get("name") for r in relays
+                   if r.get("url", "").rstrip("/") == dead}
+        my_name = self._wd.get("name")
+        if my_name:
+            exclude.add(my_name)
+            # exclude our own DESCENDANTS too: re-homing onto a relay
+            # whose parent chain leads back here would close a watch
+            # cycle with no path to the hub — and because the stream to
+            # the descendant stays "healthy", the watchdog would never
+            # fire again. Walk each candidate's parent pointers.
+            my_urls = {r.get("url", "").rstrip("/") for r in relays
+                       if r.get("name") == my_name}
+            by_url = {r.get("url", "").rstrip("/"): r for r in relays}
+            for r in relays:
+                cur, hops = r, 0
+                while cur is not None and hops < len(relays) + 1:
+                    parent = (cur.get("parent") or "").rstrip("/")
+                    if parent in my_urls:
+                        exclude.add(r.get("name"))
+                        break
+                    cur = by_url.get(parent)
+                    hops += 1
+        chosen = pick_relay(topo, kind=self.kinds[0],
+                            exclude=tuple(n for n in exclude if n))
+        if chosen is not None:
+            new_url = chosen["url"]
+        else:
+            routers = topo.get("routers", [])
+            new_url = routers[0]["url"] if routers \
+                else self._wd["topology_url"]
+        if new_url.rstrip("/") == dead:
+            return False          # nothing better advertised yet
+        self.reparent(new_url)
+        self.watchdog_reparents += 1
+        return True
+
     def reparent(self, new_upstream_url: str) -> None:
         """Re-home this relay onto a DIFFERENT parent (a sibling relay
         or the router) discovered from the topology map, resuming from
@@ -405,6 +507,9 @@ class RelayCore:
                                 since_rv=since, cursors=curs or None)
 
     def close(self) -> None:
+        self._wd_stop.set()
+        if self._wd_thread is not None:
+            self._wd_thread.join(timeout=2)
         self.client.close()
         with self._lock:
             for subs in self._subs.values():

@@ -1,0 +1,1100 @@
+"""Replicated state core: a 3-process quorum for rv / fencing / ring.
+
+PR 11 left one rung on the fabric's failure ladder labelled "restart
+the universe": the StateCore — rv allocation, lease fencing, the crc32
+ring map — was the one stop-the-world process, run like etcd but
+without etcd's Raft. This module closes it with a **Raft-lite**
+replication protocol over the existing bin1 ``/call`` wire:
+
+* **leader election** — replicas heartbeat; a follower that stops
+  hearing from the leader campaigns with a term bump and a log
+  up-to-date check, exactly Raft's vote rule, so the new leader always
+  holds every committed entry;
+* **log replication with majority-ack before release** — every
+  mutating verb (``rv.next``, ``leases.update``, ``fabric_set_ring``,
+  ``rv.advance_to``) is a term-stamped log entry. The leader answers
+  the caller only after a majority has durably appended the entry, so
+  a deposed leader can never have handed out an rv or fencing epoch
+  that the surviving quorum doesn't know about: across a ``kill -9``
+  mid-``rv.next``, the value was either committed (and the new leader
+  re-derives it by applying the same log) or never released (and the
+  caller's retry draws a fresh one — a harmless gap, never a reuse);
+* **per-replica bin1 WALs** — term/vote changes and log entries are
+  length-prefixed binary frames (torn-tail tolerant, like the journal
+  WAL); a ``kill -9``'d replica replays its WAL into log-consistent
+  state and rejoins as a follower, catching up from the leader;
+* **leader-lease reads** — the leader serves reads only while it has
+  majority contact inside the lease window (shorter than the minimum
+  election timeout), so a partitioned ex-leader parks instead of
+  serving stale fencing epochs. Followers serve the *non-fencing*
+  reads (ring, topology, registries, ``rv.last``) with the same
+  staleness bound; fencing reads (``leases.epoch_of``) are
+  leader-only — a lagging follower answering "epoch 3" after the
+  quorum committed 4 would un-fence a deposed scheduler;
+* **NotLeader redirects** — a verb landing on a non-leader answers a
+  typed ``NotLeader`` carrying the leader URL and term; callers
+  (:class:`ReplicaClient`) re-resolve and retry instead of erroring,
+  riding out elections under a deadline.
+
+Registries (shards / routers / relays) stay **soft state**: they are
+heartbeat-refreshed every couple of seconds by their owners, so they
+are gossiped from leader to followers on every heartbeat instead of
+being logged — a new leader starts from its gossip mirror and is
+re-confirmed by the next registration wave.
+
+:class:`ReplicaClient` is the client half: a RemoteHub-shaped facade
+over the replica set (``.rv`` / ``.leases`` namespaces plus the
+``fabric_*`` verbs) that discovers the full replica set from any
+member, caches the leader, follows redirect hints, and rotates
+through candidates during elections. ``ProcShardHub``,
+``ClusterClient``, and the router all speak it transparently — a
+comma-separated state URL is the only deployment-visible change.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+from kubernetes_tpu.fabric import codec as binwire
+from kubernetes_tpu.fabric.cluster import RING_SLOTS, RELAY_TTL_S
+from kubernetes_tpu.hub import NotFound, NotLeader, Unavailable
+from kubernetes_tpu.leaderelection import LeaseStore
+
+ROLE_LEADER = "leader"
+ROLE_FOLLOWER = "follower"
+ROLE_CANDIDATE = "candidate"
+
+
+# --------------------------------------------------------------------------
+# the per-replica WAL: hard state + log entries as bin1 frames
+# --------------------------------------------------------------------------
+
+
+class ReplicaWal:
+    """Append-only bin1 record stream for one replica's durable state:
+
+    * ``{"hs": {"t": term, "v": voted_for}}`` — hard-state change
+      (term bump / vote), persisted BEFORE the RPC answer that makes
+      the promise (Raft's persistence rule);
+    * ``{"e": {"i": index, "t": term, "op": [...]}}`` — one log entry
+      (``i`` is the ABSOLUTE log index);
+    * ``{"tr": index}`` — truncate: entries above ``index`` were
+      overwritten by a newer leader's log;
+    * ``{"snap": {"idx", "term", "state"}}`` — a log-compaction
+      snapshot: the state machine at ``idx``; entries at or below it
+      are gone from the file (the compaction ``rewrite`` emits this
+      first, then the surviving suffix).
+
+    Replay rebuilds (term, voted_for, snapshot, log-suffix). The
+    commit index is NOT persisted (standard Raft): a restarted replica
+    re-learns it from the leader and re-applies from the snapshot —
+    apply is deterministic, so the rebuilt state machine is
+    bit-identical. A torn final frame (the ``kill -9`` landed
+    mid-write) never committed anywhere and is dropped, exactly the
+    journal WAL's tolerance."""
+
+    def __init__(self, path: str | None):
+        self.path = path
+        self._fh = open(path, "ab") if path else None
+
+    def replay(self) -> tuple[int, str | None, dict | None,
+                              list[tuple[int, list]]]:
+        """-> (term, voted_for, snapshot|None, log suffix) from disk.
+        The log list holds entries snapshot.idx+1.. (or 1.. when no
+        snapshot record exists)."""
+        term, voted = 0, None
+        snap: dict | None = None
+        floor = 0
+        log: list[tuple[int, list]] = []
+        if not self.path or not os.path.exists(self.path):
+            return term, voted, snap, log
+        with open(self.path, "rb") as f:
+            size = os.path.getsize(self.path)
+            pos = 0
+            while True:
+                hdr = f.read(4)
+                if len(hdr) < 4:
+                    break                 # clean EOF / torn length
+                n = int.from_bytes(hdr, "big")
+                payload = f.read(n)
+                if len(payload) < n:
+                    break                 # torn frame: never committed
+                end = pos + 4 + n
+                try:
+                    rec = binwire.decode(payload)
+                except ValueError:
+                    if end >= size:
+                        break             # torn final frame
+                    raise                 # interior corruption: loud
+                pos = end
+                if "hs" in rec:
+                    term = int(rec["hs"]["t"])
+                    voted = rec["hs"]["v"]
+                elif "snap" in rec:
+                    snap = dict(rec["snap"])
+                    floor = int(snap["idx"])
+                    log = []
+                elif "tr" in rec:
+                    del log[max(0, int(rec["tr"]) - floor):]
+                elif "e" in rec:
+                    e = rec["e"]
+                    i = int(e["i"])
+                    if i <= floor:
+                        continue          # already inside the snapshot
+                    # an entry record names its ABSOLUTE index: replay
+                    # after a truncate-then-append lands in place
+                    del log[i - 1 - floor:]
+                    log.append((int(e["t"]), list(e["op"])))
+        return term, voted, snap, log
+
+    def _write(self, rec: dict) -> None:
+        if self._fh is not None:
+            self._fh.write(binwire.frame(binwire.encode(rec)))
+            self._fh.flush()
+
+    def hard_state(self, term: int, voted: str | None) -> None:
+        self._write({"hs": {"t": term, "v": voted}})
+
+    def entry(self, index: int, term: int, op: list) -> None:
+        self._write({"e": {"i": index, "t": term, "op": op}})
+
+    def truncate(self, keep: int) -> None:
+        self._write({"tr": keep})
+
+    def rewrite(self, term: int, voted: str | None, snap: dict,
+                entries: list[tuple[int, int, list]]) -> None:
+        """Atomically replace the file with hard state + a snapshot +
+        the surviving log suffix (``entries`` = (index, term, op)):
+        the compaction that keeps the WAL from growing with every rv
+        the fleet ever drew."""
+        if not self.path:
+            return
+        tmp = self.path + ".compact"
+        with open(tmp, "wb") as f:
+            f.write(binwire.frame(binwire.encode(
+                {"hs": {"t": term, "v": voted}})))
+            f.write(binwire.frame(binwire.encode({"snap": snap})))
+            for i, t, op in entries:
+                f.write(binwire.frame(binwire.encode(
+                    {"e": {"i": i, "t": t, "op": op}})))
+            f.flush()
+        if self._fh is not None:
+            self._fh.close()
+        os.replace(tmp, self.path)
+        self._fh = open(self.path, "ab")
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            finally:
+                self._fh = None
+
+
+# --------------------------------------------------------------------------
+# the replica
+# --------------------------------------------------------------------------
+
+
+class StateReplica:
+    """One member of the replicated state core. Serve it with the
+    ordinary ``HubServer`` — the Raft RPCs, the public state verbs,
+    codec negotiation, and typed errors all ride the stock /call wire.
+
+    The applied state machine is exactly StateCore's state: the rv
+    counter, the LeaseStore (fencing epochs), and the ring map — all
+    rebuilt deterministically by applying the committed log in order,
+    which is what makes "no rv reused, epochs monotone" a property of
+    the log rather than of any one process's memory."""
+
+    def __init__(self, name: str, peers: dict[str, str] | None = None,
+                 pod_shards: list[str] | None = None,
+                 ring_slots: int = RING_SLOTS,
+                 wal_path: str | None = None,
+                 heartbeat_s: float = 0.15,
+                 election_timeout_s: tuple[float, float] = (0.6, 1.2),
+                 rpc_timeout: float = 1.5,
+                 client_factory=None, seed: int | None = None,
+                 log_compact_threshold: int = 4096):
+        self.name = name
+        self.shard_name = name               # /metrics identity label
+        self._peers: dict[str, str] = dict(peers or {name: ""})
+        self._heartbeat_s = heartbeat_s
+        self._eto = election_timeout_s
+        # leader lease: shorter than the minimum election timeout, so a
+        # deposed leader's lease expires before a successor can win
+        self._lease_s = election_timeout_s[0] * 0.9
+        self._rpc_timeout = rpc_timeout
+        self._compact_threshold = log_compact_threshold
+        self._rng = random.Random(seed if seed is not None
+                                  else hash(name) & 0xFFFF)
+        self._lock = threading.RLock()
+        self._repl_lock = threading.Lock()   # serializes AE rounds
+        self._wal = ReplicaWal(wal_path)
+        self._term, self._voted_for, snap, self._log = \
+            self._wal.replay()
+        self._role = ROLE_FOLLOWER
+        self._leader: str | None = None
+        # log compaction floor: the log list holds entries
+        # (floor_idx, floor_idx + len]; everything at or below the
+        # floor is summarized by the applied snapshot
+        self._floor_idx = 0
+        self._floor_term = 0
+        self._commit = 0
+        self._applied = 0
+        self._results: dict[int, tuple[int, object]] = {}
+        # per-peer replication state (leader-only)
+        self._next_idx: dict[str, int] = {}
+        self._match_idx: dict[str, int] = {}
+        self._last_ack: dict[str, float] = {}
+        self._last_heard = time.monotonic()
+        self._last_sent = 0.0
+        self._timeout = self._rng.uniform(*self._eto)
+        # ---- the state machine (StateCore's state, log-applied) ----
+        self._sm_rv = 0
+        self._sm_leases = LeaseStore()
+        names = list(pod_shards or [])
+        self._sm_ring = {"epoch": 1,
+                         "slots": [names[i % len(names)]
+                                   for i in range(ring_slots)]} \
+            if names else {"epoch": 0, "slots": []}
+        # ---- soft state (gossiped, never logged) ----
+        self._shards: dict[str, dict] = {}
+        self._routers: dict[str, dict] = {}
+        self._relays: dict[str, dict] = {}
+        self._clients: dict[str, object] = {}
+        if client_factory is None:
+            from kubernetes_tpu.hubclient import RemoteHub
+
+            client_factory = lambda url: RemoteHub(  # noqa: E731
+                url, timeout=self._rpc_timeout,
+                retry_deadline=0.0)      # Raft RPCs never blind-retry
+        self._factory = client_factory
+        self._stop = threading.Event()
+        self._ticker: threading.Thread | None = None
+        # a replayed snapshot re-seeds the state machine at its floor;
+        # the log suffix above it re-applies once the leader tells us
+        # the commit index (or we become leader and commit a barrier)
+        if snap is not None:
+            self._install_snapshot_locked(snap, persist=False)
+        # dotted-verb surfaces (the /call wire's rv.* / leases.*)
+        self.rv = _ReplicaRv(self)
+        self.leases = _ReplicaLeases(self)
+
+    # ------------- log indexing (compaction-floor aware) -------------
+
+    def _last_index(self) -> int:
+        return self._floor_idx + len(self._log)
+
+    def _term_at(self, idx: int) -> int:
+        """Term of the entry at absolute index ``idx`` (the floor's
+        recorded term at the floor itself; 0 when unknown)."""
+        if idx == self._floor_idx:
+            return self._floor_term
+        if idx < self._floor_idx or idx > self._last_index():
+            return 0
+        return self._log[idx - self._floor_idx - 1][0]
+
+    # ------------- lifecycle -------------
+
+    def set_peers(self, peers: dict[str, str]) -> None:
+        """Pin the replica-set map (name -> URL) before ``start()`` —
+        in-thread tests learn ports only after binding servers."""
+        with self._lock:
+            self._peers = dict(peers)
+
+    def start(self) -> "StateReplica":
+        self._ticker = threading.Thread(target=self._tick_loop,
+                                        daemon=True,
+                                        name=f"state-replica-{self.name}")
+        self._ticker.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._ticker is not None:
+            self._ticker.join(timeout=2)
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for c in clients:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        self._wal.close()
+
+    def _client(self, peer: str):
+        with self._lock:
+            c = self._clients.get(peer)
+            if c is None:
+                url = self._peers.get(peer)
+                if not url:
+                    raise NotFound(f"unknown replica {peer!r}")
+                c = self._clients[peer] = self._factory(url)
+            return c
+
+    def _other_peers(self) -> list[str]:
+        return [p for p in self._peers if p != self.name]
+
+    def _majority(self) -> int:
+        return len(self._peers) // 2 + 1
+
+    # ------------- ticker: elections + heartbeats -------------
+
+    def _tick_loop(self) -> None:
+        while not self._stop.wait(0.03):
+            try:
+                with self._lock:
+                    role = self._role
+                    now = time.monotonic()
+                    due = now - self._last_sent >= self._heartbeat_s
+                    timed_out = (role != ROLE_LEADER
+                                 and now - self._last_heard
+                                 >= self._timeout)
+                if role == ROLE_LEADER:
+                    if due:
+                        self._replication_round()
+                elif timed_out:
+                    self._campaign()
+            except Exception:  # noqa: BLE001 — the ticker must survive
+                pass           # any transient RPC/teardown race
+
+    def _campaign(self) -> None:
+        with self._lock:
+            if len(self._peers) == 1:
+                # degenerate single-replica cluster: instant leadership
+                self._term += 1
+                self._voted_for = self.name
+                self._wal.hard_state(self._term, self._voted_for)
+                self._become_leader_locked()
+                return
+            self._term += 1
+            self._voted_for = self.name
+            self._wal.hard_state(self._term, self._voted_for)
+            self._role = ROLE_CANDIDATE
+            self._leader = None
+            self._last_heard = time.monotonic()
+            self._timeout = self._rng.uniform(*self._eto)
+            term = self._term
+            last_idx = self._last_index()
+            last_term = self._term_at(last_idx)
+        votes = [1]          # self
+        done = threading.Event()
+        peers = self._other_peers()
+
+        def ask(peer: str) -> None:
+            try:
+                r = self._client(peer).replica_request_vote(
+                    term, self.name, last_idx, last_term)
+            except Exception:  # noqa: BLE001 — peer down/unreachable
+                return
+            with self._lock:
+                if r.get("term", 0) > self._term:
+                    self._become_follower_locked(r["term"])
+                    done.set()
+                    return
+                if r.get("granted") and self._role == ROLE_CANDIDATE \
+                        and self._term == term:
+                    votes[0] += 1
+                    if votes[0] >= self._majority():
+                        self._become_leader_locked()
+                        done.set()
+
+        threads = [threading.Thread(target=ask, args=(p,), daemon=True)
+                   for p in peers]
+        for t in threads:
+            t.start()
+        done.wait(self._rpc_timeout)
+        if self._is_leader():
+            # commit a barrier no-op in the new term: Raft's rule that
+            # a leader only commits entries of its OWN term — the
+            # barrier drags every prior committed entry with it
+            try:
+                self._propose(["noop"])
+            except (NotLeader, Unavailable):
+                pass
+
+    def _is_leader(self) -> bool:
+        with self._lock:
+            return self._role == ROLE_LEADER
+
+    def _become_leader_locked(self) -> None:
+        self._role = ROLE_LEADER
+        self._leader = self.name
+        now = time.monotonic()
+        self._last_sent = 0.0
+        for p in self._other_peers():
+            self._next_idx[p] = self._last_index() + 1
+            self._match_idx[p] = 0
+            self._last_ack[p] = now   # grace: the vote WAS the contact
+
+    def _become_follower_locked(self, term: int,
+                                leader: str | None = None) -> None:
+        if term > self._term:
+            self._term = term
+            self._voted_for = None
+            self._wal.hard_state(self._term, self._voted_for)
+        self._role = ROLE_FOLLOWER
+        if leader is not None:
+            self._leader = leader
+        self._last_heard = time.monotonic()
+        self._timeout = self._rng.uniform(*self._eto)
+
+    # ------------- replication (leader side) -------------
+
+    def _replication_round(self) -> None:
+        """One append-entries round to every peer (heartbeat when there
+        is nothing to send), advancing the commit index on majority
+        match. Serialized: concurrent proposers share rounds instead of
+        interleaving per-peer cursors."""
+        with self._repl_lock:
+            with self._lock:
+                if self._role != ROLE_LEADER:
+                    return
+                term = self._term
+                commit = self._commit
+                soft = {"shards": {n: dict(s)
+                                   for n, s in self._shards.items()},
+                        "routers": {n: dict(r)
+                                    for n, r in self._routers.items()},
+                        "relays": {n: dict(r)
+                                   for n, r in self._relays.items()}}
+                batches = {}
+                for p in self._other_peers():
+                    ni = self._next_idx.get(p, self._last_index() + 1)
+                    snapshot = None
+                    if ni <= self._floor_idx:
+                        # the peer is behind the compaction floor:
+                        # entries below it no longer exist — install
+                        # the (tiny) state-machine snapshot and ship
+                        # the suffix above the applied index
+                        snapshot = {"idx": self._applied,
+                                    "term": self._term_at(self._applied),
+                                    "state": self._sm_dump_locked()}
+                        prev_idx = self._applied
+                    else:
+                        prev_idx = ni - 1
+                    prev_term = self._term_at(prev_idx)
+                    entries = [{"i": prev_idx + 1 + j, "t": t, "op": op}
+                               for j, (t, op) in enumerate(
+                                   self._log[prev_idx
+                                             - self._floor_idx:])]
+                    batches[p] = (prev_idx, prev_term, entries,
+                                  snapshot)
+                self._last_sent = time.monotonic()
+            replies: dict[str, dict | None] = {}
+
+            def send(peer: str) -> None:
+                prev_idx, prev_term, entries, snapshot = batches[peer]
+                try:
+                    replies[peer] = self._client(peer) \
+                        .replica_append_entries(
+                            term, self.name, prev_idx, prev_term,
+                            entries, commit, soft, snapshot)
+                except Exception:  # noqa: BLE001 — peer down: no ack
+                    replies[peer] = None
+
+            threads = [threading.Thread(target=send, args=(p,),
+                                        daemon=True) for p in batches]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(self._rpc_timeout)
+            with self._lock:
+                if self._role != ROLE_LEADER or self._term != term:
+                    return
+                now = time.monotonic()
+                for p, r in replies.items():
+                    if r is None:
+                        continue
+                    if r.get("term", 0) > self._term:
+                        self._become_follower_locked(r["term"])
+                        return
+                    self._last_ack[p] = now
+                    if r.get("ok"):
+                        m = int(r.get("match", 0))
+                        self._match_idx[p] = max(self._match_idx[p], m)
+                        self._next_idx[p] = self._match_idx[p] + 1
+                    else:
+                        # log mismatch: walk next_idx back (the reply
+                        # hints how far the follower's log reaches)
+                        hint = int(r.get("match",
+                                         self._next_idx[p] - 2))
+                        self._next_idx[p] = max(1, min(
+                            self._next_idx[p] - 1, hint + 1))
+                # majority-match commit, own-term entries only
+                matches = sorted([self._last_index()]
+                                 + list(self._match_idx.values()),
+                                 reverse=True)
+                candidate = matches[self._majority() - 1]
+                if candidate > self._commit and candidate >= 1 \
+                        and self._term_at(candidate) == self._term:
+                    self._commit = candidate
+                    self._apply_locked()
+
+    def _propose(self, op: list, deadline_s: float = 5.0):
+        """Append ``op`` to the log and drive replication until it
+        commits (majority-ack) — only then is the applied result
+        released to the caller. Raises NotLeader off-leader and
+        Unavailable when the quorum cannot be reached in time (writes
+        park; the entry may still commit later, which is why every
+        state verb is either idempotent or gap-burn-safe)."""
+        with self._lock:
+            if self._role != ROLE_LEADER:
+                raise NotLeader("state write on non-leader",
+                                self._leader_url_locked(), self._term)
+            term = self._term
+            self._log.append((term, op))
+            idx = self._last_index()
+            self._wal.entry(idx, term, op)
+            if len(self._peers) == 1:
+                self._commit = idx
+                self._apply_locked()
+                return self._result_of_locked(idx, term)
+        end = time.monotonic() + deadline_s
+        while time.monotonic() < end and not self._stop.is_set():
+            self._replication_round()
+            with self._lock:
+                if self._commit >= idx:
+                    return self._result_of_locked(idx, term)
+                if self._role != ROLE_LEADER or self._term != term:
+                    raise NotLeader("deposed mid-propose",
+                                    self._leader_url_locked(),
+                                    self._term)
+            time.sleep(0.02)
+        raise Unavailable(
+            f"state quorum unavailable ({op[0]}); writes park")
+
+    def _leader_url_locked(self) -> str | None:
+        if self._leader is None:
+            return None
+        return self._peers.get(self._leader) or None
+
+    def _result_of_locked(self, idx: int, term: int):
+        """The applied result of OUR proposal at ``idx`` — judged by
+        the (term, result) record, not the log (which may already be
+        compacted past idx): a differing term means our entry was
+        overwritten before committing and the caller must re-resolve."""
+        rec = self._results.get(idx)
+        if rec is None or rec[0] != term:
+            raise NotLeader("deposed before commit",
+                            self._leader_url_locked(), self._term)
+        return rec[1]
+
+    # ------------- apply (the deterministic state machine) -------------
+
+    def _apply_locked(self) -> None:
+        while self._applied < self._commit:
+            self._applied += 1
+            e_term, op = self._log[self._applied - self._floor_idx - 1]
+            self._results[self._applied] = (e_term, self._apply_op(op))
+            if len(self._results) > 4096:
+                for k in sorted(self._results)[:-2048]:
+                    self._results.pop(k, None)
+        self._maybe_compact_locked()
+
+    def _sm_dump_locked(self) -> dict:
+        return {"rv": self._sm_rv,
+                "ring": {"epoch": self._sm_ring["epoch"],
+                         "slots": list(self._sm_ring["slots"])},
+                "leases": self._sm_leases.dump()}
+
+    def _sm_load_locked(self, state: dict) -> None:
+        self._sm_rv = int(state["rv"])
+        self._sm_ring = {"epoch": int(state["ring"]["epoch"]),
+                         "slots": list(state["ring"]["slots"])}
+        self._sm_leases.restore(state["leases"])
+
+    def _install_snapshot_locked(self, snap: dict,
+                                 persist: bool = True) -> None:
+        """Replace everything at or below the snapshot index with the
+        snapshot's state machine: the lagging-follower catch-up path
+        (a leader whose log no longer reaches back that far) and the
+        WAL-replay boot path."""
+        idx, term = int(snap["idx"]), int(snap["term"])
+        self._sm_load_locked(snap["state"])
+        self._floor_idx, self._floor_term = idx, term
+        self._log = []
+        self._commit = self._applied = idx
+        self._results.clear()
+        if persist:
+            self._wal_rewrite_locked()
+
+    def _maybe_compact_locked(self) -> None:
+        """Drop applied log entries behind a snapshot once the log
+        outgrows the threshold — without this, one entry per rv the
+        whole fleet ever drew accumulates in memory and in the WAL
+        forever. Safe at any point ≤ applied: the state machine IS the
+        summary, and a peer needing older entries gets the snapshot
+        installed instead."""
+        if len(self._log) <= self._compact_threshold:
+            return
+        k = self._applied
+        if k <= self._floor_idx:
+            return
+        self._floor_term = self._term_at(k)
+        del self._log[:k - self._floor_idx]
+        self._floor_idx = k
+        self._wal_rewrite_locked()
+
+    def _wal_rewrite_locked(self) -> None:
+        snap = {"idx": self._floor_idx, "term": self._floor_term,
+                "state": self._sm_dump_locked()}
+        entries = [(self._floor_idx + 1 + j, t, op)
+                   for j, (t, op) in enumerate(self._log)]
+        self._wal.rewrite(self._term, self._voted_for, snap, entries)
+
+    def _apply_op(self, op: list):
+        verb = op[0]
+        if verb == "noop":
+            return None
+        if verb == "rv.next":
+            self._sm_rv += 1
+            return self._sm_rv
+        if verb == "rv.advance_to":
+            if int(op[1]) > self._sm_rv:
+                self._sm_rv = int(op[1])
+            return self._sm_rv
+        if verb == "leases.update":
+            return self._sm_leases.update(op[1], op[2])
+        if verb == "ring.set":
+            ring, expect = op[1], int(op[2])
+            if self._sm_ring["epoch"] != expect:
+                return False
+            self._sm_ring = {"epoch": int(ring["epoch"]),
+                             "slots": list(ring["slots"])}
+            return True
+        raise ValueError(f"unknown replicated op {verb!r}")
+
+    # ------------- Raft RPCs (served over /call) -------------
+
+    def replica_request_vote(self, term: int, candidate: str,
+                             last_idx: int, last_term: int) -> dict:
+        with self._lock:
+            if term > self._term:
+                self._become_follower_locked(term)
+            granted = False
+            if term == self._term \
+                    and self._voted_for in (None, candidate):
+                my_last_idx = self._last_index()
+                my_last_term = self._term_at(my_last_idx)
+                if (last_term, last_idx) >= (my_last_term, my_last_idx):
+                    self._voted_for = candidate
+                    self._wal.hard_state(self._term, self._voted_for)
+                    granted = True
+                    # granting a vote IS leader contact: don't campaign
+                    # against the candidate we just endorsed
+                    self._last_heard = time.monotonic()
+            return {"term": self._term, "granted": granted}
+
+    def replica_append_entries(self, term: int, leader: str,
+                               prev_idx: int, prev_term: int,
+                               entries: list, commit: int,
+                               soft: dict | None = None,
+                               snapshot: dict | None = None) -> dict:
+        with self._lock:
+            if term < self._term:
+                return {"term": self._term, "ok": False, "match": 0}
+            self._become_follower_locked(term, leader)
+            if soft:
+                # registry gossip: the follower mirrors the leader's
+                # soft state so a failover starts from a warm map
+                self._shards = {n: dict(s)
+                                for n, s in soft.get("shards",
+                                                     {}).items()}
+                self._routers = {n: dict(r)
+                                 for n, r in soft.get("routers",
+                                                      {}).items()}
+                self._relays = {n: dict(r)
+                                for n, r in soft.get("relays",
+                                                     {}).items()}
+            if snapshot is not None \
+                    and int(snapshot["idx"]) > self._commit:
+                # the leader compacted past our log: install its state
+                # machine wholesale (committed prefixes are immutable,
+                # so jumping to the snapshot can never un-commit)
+                self._install_snapshot_locked(snapshot)
+            if prev_idx > self._last_index() or (
+                    prev_idx > self._floor_idx
+                    and self._term_at(prev_idx) != prev_term):
+                return {"term": self._term, "ok": False,
+                        "match": min(self._last_index(),
+                                     max(prev_idx - 1, 0))}
+            # prev_idx at or below our floor: that prefix is committed
+            # and compacted here, hence identical — append the part of
+            # the batch above the floor
+            for e in entries:
+                i = int(e["i"])
+                if i <= self._floor_idx:
+                    continue
+                if i <= self._last_index():
+                    if self._term_at(i) == int(e["t"]):
+                        continue          # already have it
+                    # conflicting suffix: a deposed leader's entries
+                    # are overwritten (they never committed)
+                    del self._log[i - self._floor_idx - 1:]
+                    self._wal.truncate(i - 1)
+                self._log.append((int(e["t"]), list(e["op"])))
+                self._wal.entry(i, int(e["t"]), list(e["op"]))
+            new_commit = min(int(commit), self._last_index())
+            if new_commit > self._commit:
+                self._commit = new_commit
+                self._apply_locked()
+            return {"term": self._term, "ok": True,
+                    "match": prev_idx + len(entries)}
+
+    # ------------- read guards -------------
+
+    def _read_guard(self, linearizable: bool = False) -> None:
+        with self._lock:
+            if self._role == ROLE_LEADER:
+                if len(self._peers) == 1:
+                    return
+                now = time.monotonic()
+                fresh = sum(1 for t in self._last_ack.values()
+                            if now - t <= self._lease_s)
+                if fresh + 1 >= self._majority():
+                    return
+                raise Unavailable(
+                    "state leader lost quorum contact; reads and "
+                    "writes park until the lease renews")
+            if not linearizable \
+                    and time.monotonic() - self._last_heard \
+                    <= self._lease_s:
+                return       # follower read inside the staleness bound
+            raise NotLeader(
+                "fencing reads are leader-only" if linearizable
+                else "follower past the leader-lease staleness bound",
+                self._leader_url_locked(), self._term)
+
+    # ------------- public verbs (StateCore's surface) -------------
+
+    def fabric_register_shard(self, name: str, url: str,
+                              kinds: list | None = None,
+                              pid: int | None = None) -> dict:
+        self._require_leader()
+        with self._lock:
+            self._shards[name] = {"name": name, "url": url,
+                                  "kinds": list(kinds or []),
+                                  "pid": pid, "ts": time.time()}
+            return {"ring": dict(self._sm_ring)}
+
+    def fabric_register_router(self, name: str, url: str,
+                               pid: int | None = None) -> dict:
+        self._require_leader()
+        with self._lock:
+            self._routers[name] = {"name": name, "url": url,
+                                   "pid": pid, "ts": time.time()}
+            return {"ok": True}
+
+    def fabric_register_relay(self, info: dict) -> dict:
+        self._require_leader()
+        with self._lock:
+            rec = dict(info)
+            rec["ts"] = time.time()
+            self._relays[rec["name"]] = rec
+            return {"ok": True}
+
+    def _require_leader(self) -> None:
+        with self._lock:
+            if self._role != ROLE_LEADER:
+                raise NotLeader("registration on non-leader",
+                                self._leader_url_locked(), self._term)
+
+    def fabric_shards(self) -> dict:
+        self._read_guard()
+        with self._lock:
+            return {n: dict(s) for n, s in self._shards.items()}
+
+    def fabric_topology(self) -> dict:
+        self._read_guard()
+        now = time.time()
+        with self._lock:
+            relays = [dict(r) for r in self._relays.values()
+                      if now - r["ts"] <= RELAY_TTL_S]
+            return {"routers": [dict(r)
+                                for r in self._routers.values()],
+                    "relays": relays,
+                    "shards": {n: dict(s)
+                               for n, s in self._shards.items()},
+                    "ring_epoch": self._sm_ring["epoch"],
+                    "replicas": self._replica_rows_locked()}
+
+    def _replica_rows_locked(self) -> list[dict]:
+        rows = [{"name": self.name,
+                 "url": self._peers.get(self.name, ""),
+                 "role": self._role, "term": self._term,
+                 "log_index": self._last_index(),
+                 "commit_index": self._commit}]
+        if self._role == ROLE_LEADER:
+            now = time.monotonic()
+            for p in self._other_peers():
+                rows.append({
+                    "name": p, "url": self._peers.get(p, ""),
+                    "role": ROLE_FOLLOWER
+                    if now - self._last_ack.get(p, 0.0)
+                    <= self._lease_s else "unreachable",
+                    "term": self._term,
+                    "log_index": self._match_idx.get(p, 0),
+                    "commit_index": min(self._match_idx.get(p, 0),
+                                        self._commit)})
+        return rows
+
+    def fabric_ring(self) -> dict:
+        self._read_guard()
+        with self._lock:
+            return {"epoch": self._sm_ring["epoch"],
+                    "slots": list(self._sm_ring["slots"])}
+
+    def fabric_set_ring(self, ring: dict, expect_epoch: int) -> bool:
+        return self._propose(["ring.set", dict(ring),
+                              int(expect_epoch)])
+
+    def fabric_replica_status(self) -> dict:
+        """Leader discovery + /debug surface: served by EVERY role with
+        no staleness guard — a caller must be able to ask a confused
+        replica who it thinks leads."""
+        with self._lock:
+            return {"name": self.name, "role": self._role,
+                    "term": self._term, "leader": self._leader,
+                    "leader_url": self._leader_url_locked(),
+                    "log_index": self._last_index(),
+                    "commit_index": self._commit,
+                    "compact_floor": self._floor_idx,
+                    "applied_rv": self._sm_rv,
+                    "replicas": dict(self._peers)}
+
+    # ------------- fleet surface -------------
+
+    def get_journal_stats(self) -> dict:
+        with self._lock:
+            return {"rv": self._sm_rv, "capacity": 0,
+                    "wal": self._wal.path is not None, "kinds": {},
+                    "shards": {n: {"kinds": s["kinds"], "depth": 0,
+                                   "compacted_rv": 0, "commits": 0,
+                                   "rv": 0}
+                               for n, s in self._shards.items()}}
+
+    def healthz(self) -> tuple[int, str]:
+        """200-with-role: a follower is healthy, not degraded — only a
+        replica that can neither lead nor hear a leader reports 503."""
+        with self._lock:
+            role, term = self._role, self._term
+            heard = time.monotonic() - self._last_heard
+        if role == ROLE_LEADER or heard <= max(self._eto) * 2:
+            return 200, f"ok role={role} term={term}"
+        return 503, f"no leader contact role={role} term={term}"
+
+    def extra_metrics_text(self) -> str:
+        from kubernetes_tpu.telemetry.fleet import state_metrics_text
+
+        return state_metrics_text(self)
+
+
+class _ReplicaRv:
+    """The ``rv.*`` verb surface: next/advance_to are replicated ops,
+    last is a leader-lease read (resume checks and sync markers compare
+    against it — a stale-low answer would spuriously 410 a fresh
+    cursor, so it rides the leader lease, not follower gossip)."""
+
+    __slots__ = ("_r",)
+
+    def __init__(self, replica: StateReplica):
+        self._r = replica
+
+    def next(self) -> int:
+        return self._r._propose(["rv.next"])
+
+    def advance_to(self, rv: int) -> int:
+        return self._r._propose(["rv.advance_to", int(rv)])
+
+    def last(self) -> int:
+        self._r._read_guard(linearizable=True)
+        with self._r._lock:
+            return self._r._sm_rv
+
+
+class _ReplicaLeases:
+    """The ``leases.*`` surface. ``epoch_of`` is LEADER-ONLY: fencing
+    is the one read a lagging follower must never answer — an epoch one
+    commit stale would let a deposed scheduler's write through."""
+
+    __slots__ = ("_r",)
+
+    def __init__(self, replica: StateReplica):
+        self._r = replica
+
+    def get(self, name: str):
+        self._r._read_guard(linearizable=True)
+        return self._r._sm_leases.get(name)
+
+    def epoch_of(self, name: str) -> int:
+        self._r._read_guard(linearizable=True)
+        return self._r._sm_leases.epoch_of(name)
+
+    def update(self, lease, expect_holder=None) -> bool:
+        return self._r._propose(["leases.update", lease, expect_holder])
+
+
+# --------------------------------------------------------------------------
+# the client: leader-routing facade over the replica set
+# --------------------------------------------------------------------------
+
+
+class ReplicaClient:
+    """RemoteHub-shaped client for a replica set: caches the leader,
+    follows ``NotLeader`` redirect hints, rotates through candidates
+    during elections, and discovers the full replica set from any
+    member. ``ProcShardHub``/``ClusterClient``/electors use it exactly
+    like a ``RemoteHub`` pointed at a single StateCore."""
+
+    def __init__(self, urls, timeout: float = 10.0,
+                 client_factory=None,
+                 redirect_deadline_s: float = 8.0):
+        from kubernetes_tpu.hubclient import (
+            RemoteHub,
+            _RemoteLeases,
+            _RemoteNamespace,
+        )
+
+        if isinstance(urls, str):
+            urls = urls.split(",")
+        self._urls = [u.strip().rstrip("/") for u in urls if u.strip()]
+        if not self._urls:
+            raise ValueError("ReplicaClient needs at least one URL")
+        self._factory = client_factory or (
+            lambda url: RemoteHub(url, timeout=timeout,
+                                  retry_deadline=1.0))
+        self._lock = threading.Lock()
+        self._clients: dict[str, object] = {}
+        self._leader_url: str | None = None
+        self._deadline = redirect_deadline_s
+        self.rv = _RemoteNamespace(self._call, "rv")
+        self.leases = _RemoteLeases(self._call, "leases")
+
+    def _client(self, url: str):
+        with self._lock:
+            c = self._clients.get(url)
+            if c is None:
+                c = self._clients[url] = self._factory(url)
+            return c
+
+    def _learn(self, urls) -> None:
+        with self._lock:
+            for u in urls:
+                u = u.strip().rstrip("/")
+                if u and u not in self._urls:
+                    self._urls.append(u)
+
+    def _call(self, method: str, *args):
+        from kubernetes_tpu.hub import NotLeader as _NL
+
+        end = time.monotonic() + self._deadline
+        last_err: Exception | None = None
+        i = 0
+        while True:
+            with self._lock:
+                url = self._leader_url or self._urls[i % len(self._urls)]
+            try:
+                return self._client(url)._call(method, *args)
+            except _NL as e:
+                hint = e.leader_url.rstrip("/") if e.leader_url else None
+                with self._lock:
+                    if hint and hint != url:
+                        self._leader_url = hint
+                        if hint not in self._urls:
+                            self._urls.append(hint)
+                    else:
+                        self._leader_url = None
+                        i += 1
+                last_err = e
+            except Unavailable as e:
+                with self._lock:
+                    if self._leader_url == url:
+                        self._leader_url = None
+                i += 1
+                last_err = e
+            if time.monotonic() >= end:
+                raise Unavailable(
+                    f"{method}: no state leader reachable "
+                    f"({last_err!r})") from None
+            time.sleep(0.05)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def proxy(*args, _m=name):
+            return self._call(_m, *args)
+
+        proxy.__name__ = name
+        return proxy
+
+    # ------------- discovery / status -------------
+
+    def replica_status(self) -> list[dict]:
+        """Per-replica status rows (direct, NOT leader-routed): each
+        reachable member answers for itself — the /debug and storm
+        surface for 'who leads, who lags, who is dead'."""
+        rows: list[dict] = []
+        with self._lock:
+            urls = list(self._urls)
+        for url in urls:
+            try:
+                st = self._client(url)._call("fabric_replica_status")
+            except Exception as e:  # noqa: BLE001 — per-replica verdict
+                rows.append({"url": url, "error": repr(e)})
+                continue
+            st = dict(st)
+            st["url"] = url
+            rows.append(st)
+            self._learn(st.get("replicas", {}).values())
+        return rows
+
+    def leader_url(self, refresh: bool = False) -> str | None:
+        """The cached (or freshly resolved) leader URL."""
+        with self._lock:
+            if self._leader_url is not None and not refresh:
+                return self._leader_url
+        for st in self.replica_status():
+            if st.get("role") == ROLE_LEADER:
+                with self._lock:
+                    self._leader_url = st["url"]
+                return st["url"]
+            if st.get("leader_url"):
+                with self._lock:
+                    self._leader_url = st["leader_url"].rstrip("/")
+                return self._leader_url
+        return None
+
+    def close(self) -> None:
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for c in clients:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+
+
+def make_state_client(state_url: str, timeout: float = 10.0,
+                      client_factory=None,
+                      redirect_deadline_s: float = 8.0):
+    """One constructor for both deployments: a comma-separated URL is a
+    replica set (ReplicaClient); a single URL is the classic StateCore
+    (plain RemoteHub). Every fabric component resolves its ``--state``
+    argument through here."""
+    if "," in state_url:
+        return ReplicaClient(state_url, timeout=timeout,
+                             client_factory=client_factory,
+                             redirect_deadline_s=redirect_deadline_s)
+    if client_factory is not None:
+        return client_factory(state_url)
+    from kubernetes_tpu.hubclient import RemoteHub
+
+    return RemoteHub(state_url, timeout=timeout)

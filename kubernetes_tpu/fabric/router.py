@@ -311,7 +311,15 @@ class RouterServer:
             if self._topo_cache is not None \
                     and now - self._topo_cache[0] < self._topo_ttl:
                 return self._topo_cache[1]
-        topo = self.cluster.state.fabric_topology()
+        try:
+            topo = self.cluster.state.fabric_topology()
+        except Exception:
+            # state quorum mid-election: serve the stale map rather
+            # than cutting discovery — wiring degrades, never vanishes
+            with self._topo_lock:
+                if self._topo_cache is not None:
+                    return self._topo_cache[1]
+            raise
         with self._topo_lock:
             self._topo_cache = (now, topo)
         return topo

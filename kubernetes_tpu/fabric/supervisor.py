@@ -157,21 +157,55 @@ class FabricSupervisor:
 
 class LocalCluster:
     """A running process-mode fabric: the supervisor plus the resolved
-    URLs a client needs."""
+    URLs a client needs. ``state_url`` is the comma-joined replica set
+    when the state core is replicated (every fabric client accepts the
+    comma form); ``state_urls`` lists the members individually."""
 
     def __init__(self, sup: FabricSupervisor, state_url: str,
-                 router_url: str, pod_shards: list[str]):
+                 router_url: str, pod_shards: list[str],
+                 state_urls: list[str] | None = None):
         self.sup = sup
         self.state_url = state_url
         self.router_url = router_url
         self.pod_shards = pod_shards
+        self.state_urls = state_urls or [state_url]
 
     def shard_names(self) -> list[str]:
         return [n for n, p in self.sup.procs.items()
                 if p.role == "shard"]
 
+    def state_leader(self, timeout_s: float = 15.0) -> str:
+        """Name of the state replica currently leading (replicated
+        clusters only) — the chaos storms' kill target."""
+        from kubernetes_tpu.fabric.replica import ReplicaClient
+
+        client = ReplicaClient(self.state_urls)
+        try:
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                for st in client.replica_status():
+                    if st.get("role") == "leader":
+                        return st["name"]
+                time.sleep(0.1)
+            raise RuntimeError("no state leader elected in time")
+        finally:
+            client.close()
+
     def stop(self) -> None:
         self.sup.stop()
+
+
+def _free_port() -> int:
+    """Pre-assign a listen port (the replica peer map must be known
+    before any replica starts — etcd's static bootstrap). The tiny
+    race between close and rebind is acceptable on a lab host."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
 
 def spawn_local_cluster(pod_shards: int = 2,
@@ -179,19 +213,50 @@ def spawn_local_cluster(pod_shards: int = 2,
                         journal_capacity: int = 65536,
                         wal_codec: str = "bin1",
                         kind_shards: bool = True,
-                        router: bool = True) -> LocalCluster:
+                        router: bool = True,
+                        state_replicas: int = 1) -> LocalCluster:
     """Bring up the whole fabric on this host. ``kind_shards=False``
     collapses nodes/events/meta into pods-0 (the minimal two-process
-    cluster the tier-1 smoke uses: state + one all-kinds shard)."""
+    cluster the tier-1 smoke uses: state + one all-kinds shard).
+    ``state_replicas=3`` runs the REPLICATED state core: three replica
+    processes with pinned ports and per-replica log WALs; a ``kill
+    -9``'d member restarts onto the same port and catches up from the
+    leader's log."""
     sup = FabricSupervisor()
     pod_names = [f"pods-{i}" for i in range(pod_shards)]
     try:
-        state = sup.spawn("state", "state",
-                          ["--pod-shards", ",".join(pod_names)])
-        sup.wait_healthy(state)
+        if state_replicas > 1:
+            ports = [_free_port() for _ in range(state_replicas)]
+            names = [f"state-{i}" for i in range(state_replicas)]
+            peers = ",".join(f"{n}=http://127.0.0.1:{p}"
+                             for n, p in zip(names, ports))
+            state_procs = []
+            for n, p in zip(names, ports):
+                extra = ["--port", str(p), "--replica-id", n,
+                         "--peers", peers,
+                         "--pod-shards", ",".join(pod_names)]
+                if wal_dir:
+                    os.makedirs(wal_dir, exist_ok=True)
+                    extra += ["--wal",
+                              os.path.join(wal_dir, f"{n}.wal")]
+                state_procs.append(sup.spawn(n, "state", extra))
+            for proc in state_procs:
+                sup.wait_healthy(proc)
+            state_urls = [proc.url for proc in state_procs]
+            state_url = ",".join(state_urls)
+            # shards registering before the first election would burn
+            # their redirect budget: wait for a leader once, here
+            LocalCluster(sup, state_url, "", pod_names,
+                         state_urls).state_leader()
+        else:
+            state = sup.spawn("state", "state",
+                              ["--pod-shards", ",".join(pod_names)])
+            sup.wait_healthy(state)
+            state_urls = [state.url]
+            state_url = state.url
 
         def shard_args(name: str, kinds: str) -> list[str]:
-            extra = ["--state", state.url, "--kinds", kinds,
+            extra = ["--state", state_url, "--kinds", kinds,
                      "--journal-capacity", str(journal_capacity),
                      "--wal-codec", wal_codec]
             if wal_dir:
@@ -218,10 +283,11 @@ def spawn_local_cluster(pod_shards: int = 2,
             sup.wait_healthy(p)
         router_url = ""
         if router:
-            r = sup.spawn("router-0", "router", ["--state", state.url])
+            r = sup.spawn("router-0", "router", ["--state", state_url])
             sup.wait_healthy(r)
             router_url = r.url
-        return LocalCluster(sup, state.url, router_url, pod_names)
+        return LocalCluster(sup, state_url, router_url, pod_names,
+                            state_urls)
     except BaseException:
         sup.stop()
         raise

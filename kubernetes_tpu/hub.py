@@ -24,7 +24,9 @@ the tombstoned object keeps the rv it died with.
 
 from __future__ import annotations
 
+import re
 import threading
+import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
@@ -106,6 +108,39 @@ class Fenced(Exception):
     The write did NOT land; the new leader owns the object now."""
 
 
+class NotLeader(Exception):
+    """A replicated-state verb reached a replica that is not the
+    leader (or a leader that lost its quorum lease). Carries the
+    redirect hint — ``leader_url`` (None while an election is running)
+    and the replica's current ``term`` — encoded into the message so
+    the hint survives the /call wire's {error, message} envelope; the
+    single-arg constructor re-parses it on the client side."""
+
+    _HINT = re.compile(r"\[leader=(?P<url>[^ \]]*) term=(?P<term>\d+)\]")
+
+    def __init__(self, message: str = "", leader_url=None, term=None):
+        if leader_url is not None or term is not None:
+            message = (f"{message} [leader={leader_url or ''} "
+                       f"term={term or 0}]")
+        else:
+            m = self._HINT.search(message)
+            if m is not None:
+                leader_url = m.group("url") or None
+                term = int(m.group("term"))
+        super().__init__(message)
+        self.leader_url = leader_url or None
+        self.term = term or 0
+
+
+class StaleRing(Exception):
+    """A pod write landed on a shard that no longer (or does not yet)
+    own the namespace's ring slot — the caller routed on a stale ring
+    epoch, usually mid-rebalance. The write did NOT land; the caller
+    re-reads the ring and retries against the current owner, so a
+    migrate window can never silently commit onto (and then drop with)
+    a deposed segment owner."""
+
+
 def _by_name(obj) -> str:
     return obj.metadata.name
 
@@ -179,6 +214,17 @@ class Hub:
                 self._storage_classes, self._claims, self._slices,
                 self._claim_templates, self._device_classes,
                 self._csi_capacities, self._pod_groups, self._events)}
+        # ring-slot write fencing (fabric migrate windows): slot ->
+        # "frozen" (export in flight: the copy left, the ring hasn't
+        # flipped) or "gone" (the ring assigns the slot elsewhere). A
+        # pod write into a marked slot answers StaleRing so the caller
+        # re-resolves the ring and retries the true owner — a second
+        # router routing on a stale ring epoch can never commit onto a
+        # segment that is about to be (or was) dropped. Checked under
+        # the hub lock, atomically with the commit.
+        self._slot_marks: dict[int, str] = {}
+        self._slot_mark_ts: dict[int, float] = {}
+        self._mark_ring_size = 0
         self.journal = Journal(capacity=journal_capacity,
                                wal_path=wal_path, wal_codec=wal_codec)
         if wal_path:
@@ -362,23 +408,64 @@ class Hub:
 
         return ring_slot(namespace, ring_size)
 
+    # an abandoned freeze (the rebalancer died with the CAS outcome
+    # unknown) is healed by the registration heartbeat: set_ring_view
+    # clears frozen marks older than this once the authoritative ring
+    # re-confirms ownership — a live migrate takes milliseconds
+    FROZEN_TTL_S = 30.0
+
+    def _mark_slots(self, slots, ring_size: int, mark: str) -> None:
+        """Caller holds the lock."""
+        self._mark_ring_size = ring_size
+        now = time.monotonic()
+        for s in slots:
+            self._slot_marks[int(s)] = mark
+            self._slot_mark_ts[int(s)] = now
+
+    def _clear_slots(self, slots) -> None:
+        for s in slots:
+            self._slot_marks.pop(int(s), None)
+            self._slot_mark_ts.pop(int(s), None)
+
     def export_segment(self, slots: list, ring_size: int) -> list:
         """Copy (NOT remove) every pod whose namespace hashes into
         ``slots``: the rebalance copies to the target shard first so a
         concurrent LIST never finds the segment in neither shard —
         duplicates during the overlap window are deduped by every
-        client's uid+rv discipline."""
+        client's uid+rv discipline. The slots FREEZE under the same
+        lock acquisition as the copy: any write that passed the guard
+        first is in the copy; any write after answers StaleRing until
+        the ring flips (retry lands on the new owner) or the export
+        aborts (retry lands back here)."""
         want = set(slots)
         with self._lock:
+            self._mark_slots(want, ring_size, "frozen")
             return [p for p in self._pods.objects.values()
                     if self._segment_slot(p.metadata.namespace,
                                           ring_size) in want]
 
-    def import_segment(self, pods: list) -> int:
+    def abort_export(self, slots: list, ring_size: int) -> int:
+        """Roll back an export whose rebalance lost the ring CAS:
+        unfreeze the slots so parked writers land here again."""
+        with self._lock:
+            thawed = sum(1 for s in slots
+                         if self._slot_marks.get(int(s)) == "frozen")
+            self._clear_slots([s for s in slots
+                               if self._slot_marks.get(int(s))
+                               == "frozen"])
+            return thawed
+
+    def import_segment(self, pods: list, slots: list | None = None,
+                       ring_size: int | None = None) -> int:
         """Adopt transferred pods with their ORIGINAL uids and
         revisions — no events, no new rvs; a WAL attach record makes
-        the adoption survive a restart."""
+        the adoption survive a restart. ``slots`` (when given) are
+        un-marked here: the target owns them the moment the ring flips,
+        and a post-flip write must not bounce off a stale 'gone'."""
         with self._lock:
+            if slots is not None and ring_size is not None:
+                self._mark_ring_size = ring_size
+                self._clear_slots(slots)
             fresh = []
             for pod in pods:
                 if pod.metadata.uid not in self._pods.objects:
@@ -392,7 +479,9 @@ class Hub:
     def drop_segment(self, slots: list, ring_size: int) -> int:
         """Release an exported segment after the ring flipped: remove
         the pods silently (WAL detach record; journal rings untouched so
-        pre-move resumes still serve)."""
+        pre-move resumes still serve). The slots stay fenced ('gone'):
+        a straggler routing on the pre-flip ring is redirected, never
+        committed into the dropped segment."""
         want = set(slots)
         with self._lock:
             doomed = [p for p in self._pods.objects.values()
@@ -405,17 +494,44 @@ class Hub:
                 self.journal.wal_only(
                     {"xfer": "detach",
                      "uids": [p.metadata.uid for p in doomed]})
+            self._mark_slots(want, ring_size, "gone")
             return len(doomed)
+
+    def set_ring_view(self, owned_slots: list, ring_size: int) -> None:
+        """Refresh this shard's slot fencing from the authoritative
+        ring (registration response / heartbeat): non-owned slots mark
+        'gone', owned slots clear 'gone'. A 'frozen' mark survives
+        unless stale past FROZEN_TTL_S — the heartbeat must not thaw a
+        live export window, but must heal one abandoned by a crashed
+        rebalancer."""
+        owned = set(int(s) for s in owned_slots)
+        with self._lock:
+            self._mark_ring_size = ring_size
+            now = time.monotonic()
+            for s in range(ring_size):
+                mark = self._slot_marks.get(s)
+                if s in owned:
+                    if mark == "gone" or (
+                            mark == "frozen"
+                            and now - self._slot_mark_ts.get(s, now)
+                            > self.FROZEN_TTL_S):
+                        self._clear_slots([s])
+                elif mark != "frozen":
+                    self._slot_marks[s] = "gone"
+                    self._slot_mark_ts[s] = now
 
     def reconcile_ring(self, owned_slots: list, ring_size: int) -> int:
         """Startup janitor for a shard process: drop any pod whose slot
-        the current ring assigns elsewhere. Heals the
-        killed-mid-rebalance case — a shard that died between the copy
-        and the drop restarts, replays its WAL (resurrecting its stale
-        copy), then reconciles against the authoritative ring."""
+        the current ring assigns elsewhere (and fence those slots).
+        Heals the killed-mid-rebalance case — a shard that died between
+        the copy and the drop restarts, replays its WAL (resurrecting
+        its stale copy), then reconciles against the authoritative
+        ring."""
         owned = set(owned_slots)
         stray = [s for s in range(ring_size) if s not in owned]
-        return self.drop_segment(stray, ring_size) if stray else 0
+        dropped = self.drop_segment(stray, ring_size) if stray else 0
+        self.set_ring_view(owned_slots, ring_size)
+        return dropped
 
     def close(self) -> None:
         """Release the WAL file handle (no-op for memory-only hubs)."""
@@ -521,6 +637,8 @@ class Hub:
 
     def _create(self, store: _Store, obj) -> None:
         with self._lock:
+            if store.watch_kind == "pods":
+                self._guard_pod_write(obj.metadata.namespace)
             uid = obj.metadata.uid
             if uid in store.objects:
                 raise Conflict(f"{store.kind} {uid} already exists")
@@ -531,6 +649,8 @@ class Hub:
 
     def _update(self, store: _Store, obj) -> None:
         with self._lock:
+            if store.watch_kind == "pods":
+                self._guard_pod_write(obj.metadata.namespace)
             uid = obj.metadata.uid
             old = store.objects.get(uid)
             if old is None:
@@ -589,6 +709,11 @@ class Hub:
         — a gap between them would let a deposition land in the window."""
         with self._lock:
             self._check_fence("delete_pod", epoch, lease_name)
+            stored = self._pods.objects.get(uid)
+            if stored is not None:
+                # a delete landing on a frozen/deposed segment copy
+                # would be undone when the true owner's copy survives
+                self._guard_pod_write(stored.metadata.namespace)
             ev = self._delete_locked(self._pods, uid)
         self._dispatch(self._pods, ev)
 
@@ -606,6 +731,24 @@ class Hub:
         """Commit a prepared pod revision under the lock, dispatch outside."""
         self._pods.objects[new.metadata.uid] = new
         return self._commit(self._pods, "update", old, new)
+
+    def _guard_pod_write(self, namespace: str) -> None:
+        """Reject (StaleRing) a pod write whose ring slot this hub has
+        frozen (segment export in flight) or handed away (the ring
+        assigns it elsewhere). Caller holds the lock — the verdict is
+        atomic with the commit, so a write racing an export either
+        commits BEFORE the copy (and is included in it) or is sent back
+        to re-resolve; it can never land in the copied-but-not-dropped
+        window and be silently discarded with the segment."""
+        if not self._slot_marks:
+            return
+        slot = self._segment_slot(namespace, self._mark_ring_size)
+        mark = self._slot_marks.get(slot)
+        if mark is not None:
+            raise StaleRing(
+                f"pod write for namespace {namespace!r}: ring slot "
+                f"{slot} is {mark} on this shard (mid-migrate or stale "
+                f"ring); re-resolve the ring and retry the owner")
 
     def _check_fence(self, verb: str, epoch: int | None,
                      lease_name: str) -> None:
@@ -629,6 +772,7 @@ class Hub:
         failover)."""
         with self._lock:
             self._check_fence("bind", epoch, lease_name)
+            self._guard_pod_write(pod.metadata.namespace)
             stored = self._pods.objects.get(pod.metadata.uid)
             if stored is None:
                 raise NotFound(f"pod {pod.key()}")
@@ -649,6 +793,7 @@ class Hub:
         status writes."""
         with self._lock:
             self._check_fence("patch_pod_condition", epoch, lease_name)
+            self._guard_pod_write(pod.metadata.namespace)
             stored = self._pods.objects.get(pod.metadata.uid)
             if stored is None:
                 return
@@ -669,6 +814,7 @@ class Hub:
             stored = self._pods.objects.get(uid)
             if stored is None:
                 return
+            self._guard_pod_write(stored.metadata.namespace)
             new = stored.clone()
             new.status.resource_claim_statuses = dict(statuses)
             ev = self._swap_pod(stored, new)
@@ -685,6 +831,7 @@ class Hub:
             stored = self._pods.objects.get(uid)
             if stored is None or not stored.status.nominated_node_name:
                 return
+            self._guard_pod_write(stored.metadata.namespace)
             new = stored.clone()
             new.status.nominated_node_name = ""
             ev = self._swap_pod(stored, new)

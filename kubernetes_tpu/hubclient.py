@@ -64,6 +64,8 @@ from kubernetes_tpu.hub import (
     EventHandlers,
     Fenced,
     NotFound,
+    NotLeader,
+    StaleRing,
     Unavailable,
 )
 from kubernetes_tpu.hubserver import (
@@ -77,7 +79,10 @@ from kubernetes_tpu.utils.backoff import Backoff, RetryBudget
 from kubernetes_tpu.utils.wire import from_wire, to_wire
 
 _ERRORS = {"Conflict": Conflict, "NotFound": NotFound, "Fenced": Fenced,
-           "ValueError": ValueError, "TypeError": TypeError}
+           "ValueError": ValueError, "TypeError": TypeError,
+           # typed redirects: NotLeader re-parses its leader hint from
+           # the message; StaleRing sends the caller back to the ring
+           "NotLeader": NotLeader, "StaleRing": StaleRing}
 
 # safe to replay blindly: reads never mutate. The split covers dotted
 # verbs too ("leases.get" -> "get"). The explicit extras are fabric
@@ -91,7 +96,7 @@ IDEMPOTENT_METHODS = frozenset(
         "rv.next", "rv.advance_to", "rv.last", "leases.epoch_of",
         "fabric_register_shard", "fabric_register_relay",
         "fabric_register_router", "fabric_topology", "fabric_shards",
-        "fabric_ring",
+        "fabric_ring", "fabric_replica_status",
     })
 
 # a response from these statuses is the PATH failing, not the hub's

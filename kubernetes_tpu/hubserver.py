@@ -96,8 +96,12 @@ CALL_METHODS = frozenset({
     "fabric_register_router", "fabric_topology", "fabric_shards",
     "fabric_ring", "fabric_set_ring",
     "export_segment", "import_segment", "drop_segment",
-    "reconcile_ring",
+    "abort_export", "reconcile_ring",
     "rebalance_segment",
+    # replicated state core (fabric.replica): the Raft-lite RPCs plus
+    # the status verb clients use for leader discovery
+    "replica_append_entries", "replica_request_vote",
+    "fabric_replica_status",
 })
 
 WATCH_KINDS = ("pods", "nodes", "namespaces", "pvcs", "pvs",
@@ -111,7 +115,15 @@ _ERROR_STATUS = {"Conflict": 409, "NotFound": 404, "ValueError": 400,
                  # mid-restart: 503 is the retryable gateway answer —
                  # idempotent reads retry through it, writes surface
                  # Unavailable to the caller's own reconciliation
-                 "Unavailable": 503}
+                 "Unavailable": 503,
+                 # replica-set redirects (421 Misdirected Request): the
+                 # caller re-resolves the leader instead of erroring —
+                 # deliberately NOT in the client's retryable-HTTP set,
+                 # so the typed verdict (with its leader hint) surfaces
+                 "NotLeader": 421,
+                 # a pod write routed on a stale ring epoch: the caller
+                 # re-reads the ring and retries the current owner
+                 "StaleRing": 409}
 
 FRAMES_CONTENT_TYPE = "application/x-ktpu-frames"
 
@@ -327,8 +339,15 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.partition("?")[0]
         if path in ("/healthz", "/livez"):
             # fleet health: every fabric component answers /healthz so
-            # the FleetView collector (telemetry.fleet) can probe it
-            self._text(200, "ok")
+            # the FleetView collector (telemetry.fleet) can probe it.
+            # A hub may override the verdict (a state REPLICA answers
+            # 200-with-role — a follower is healthy, not degraded).
+            hz = getattr(self.hub, "healthz", None)
+            if hz is not None:
+                code, text = hz()
+                self._text(code, text)
+            else:
+                self._text(200, "ok")
             return
         if path == "/metrics":
             from kubernetes_tpu.telemetry.fleet import (
@@ -338,10 +357,16 @@ class _Handler(BaseHTTPRequestHandler):
 
             # identity first: pid + listen port distinguish two shard
             # processes of the same shard name across a restart
-            self._text(200, process_identity_text(
+            body = process_identity_text(
                 getattr(self.hub, "shard_name", "hub"),
-                self.server.server_address[1])
-                + hub_metrics_text(self.hub))
+                self.server.server_address[1]) \
+                + hub_metrics_text(self.hub)
+            extra = getattr(self.hub, "extra_metrics_text", None)
+            if extra is not None:
+                # component-specific gauges (a state replica's
+                # role/term/log-index rows) ride the same exposition
+                body += extra()
+            self._text(200, body)
             return
         if not self.path.startswith("/watch"):
             self._json(404, {"error": "NotFound", "message": self.path})

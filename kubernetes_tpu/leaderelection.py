@@ -71,6 +71,23 @@ class LeaseStore:
         with self._lock:
             return self._epochs.get(name, 0)
 
+    def dump(self) -> dict:
+        """Snapshot the store (the replicated state core's log
+        compaction persists this alongside the rv counter and ring)."""
+        with self._lock:
+            return {"leases": {n: Lease(**vars(lease))
+                               for n, lease in self._leases.items()},
+                    "epochs": dict(self._epochs)}
+
+    def restore(self, snap: dict) -> None:
+        """Replace the store's contents from a ``dump()`` snapshot."""
+        with self._lock:
+            self._leases = {n: Lease(**vars(lease))
+                            for n, lease in snap.get("leases",
+                                                     {}).items()}
+            self._epochs = {n: int(e)
+                            for n, e in snap.get("epochs", {}).items()}
+
     def update(self, lease: Lease, expect_holder: Optional[str]) -> bool:
         """CAS: apply iff the stored holder matches ``expect_holder``
         (None = lease must not exist yet or be the same holder). The

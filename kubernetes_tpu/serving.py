@@ -161,6 +161,19 @@ class ServingEndpoints:
                         s = rs_fn()
                         payload["wire"] = s.get("wire", {})
                         payload["codec"] = s.get("codec")
+                    topo_fn = getattr(sched.hub, "fabric_topology",
+                                      None)
+                    if topo_fn is not None:
+                        # replicated state core: who leads, each
+                        # replica's term and log/commit indexes (served
+                        # through the router's state forwarding; absent
+                        # on pre-replica fabrics)
+                        try:
+                            replicas = topo_fn().get("replicas")
+                            if replicas:
+                                payload["state_replicas"] = replicas
+                        except Exception:  # noqa: BLE001 — quorum
+                            pass           # mid-election / plain hub
                     body = json.dumps(payload, indent=2, default=str)
                 elif path == "/debug/fleet":
                     # fleet topology + health: the FleetView collector's

@@ -265,6 +265,9 @@ def relay_metrics_text(core) -> str:
     rel = r.register(Counter("relay_relist_serves_total",
                              "Downstream LIST replays served from the "
                              "state mirror"))
+    wd = r.register(Counter("relay_watchdog_reparents_total",
+                            "Upstream deaths healed by watchdog "
+                            "auto-reparent (cursor-carrying resume)"))
     subs.set(float(st["subscribers"]))
     last.set(float(st["last_rv"]))
     g_in.inc(float(st["events_in"]))
@@ -272,6 +275,32 @@ def relay_metrics_text(core) -> str:
     ev.inc(float(st["slow_evictions"]))
     res.inc(float(st["resume_serves"]))
     rel.inc(float(st["relist_serves"]))
+    wd.inc(float(st.get("watchdog_reparents", 0)))
+    return r.render_text()
+
+
+def state_metrics_text(replica) -> str:
+    """A state replica's /metrics rows: role (one series per replica,
+    value 1 for the role it holds), term, and log/commit indexes — the
+    fleet scrape's 'who leads, who lags' surface."""
+    from kubernetes_tpu.metrics import Gauge, Registry
+
+    r = Registry()
+    role = r.register(Gauge("fabric_state_replica_role",
+                            "State replica role (1 = holds the "
+                            "labelled role)"))
+    term = r.register(Gauge("fabric_state_term",
+                            "State replication term at this replica"))
+    log_idx = r.register(Gauge("fabric_state_log_index",
+                               "Newest log index at this replica"))
+    commit_idx = r.register(Gauge(
+        "fabric_state_commit_index",
+        "Newest majority-committed log index at this replica"))
+    st = replica.fabric_replica_status()
+    role.set(1.0, replica=st["name"], role=st["role"])
+    term.set(float(st["term"]))
+    log_idx.set(float(st["log_index"]))
+    commit_idx.set(float(st["commit_index"]))
     return r.render_text()
 
 
@@ -402,6 +431,13 @@ class FleetView:
                 # per-process identity: pid + listen port distinguish
                 # two incarnations sharing a component/shard name
                 rec.update(identity_of(rec["exposition"]))
+                # state replicas self-report their role — the summary's
+                # 'who leads' column (a follower is healthy, not
+                # degraded, and the row says which it is)
+                for s in rec["exposition"].samples:
+                    if s.name == "fabric_state_replica_role" \
+                            and s.value == 1:
+                        rec["role"] = s.labels.get("role")
             except Exception as e:  # noqa: BLE001 — strict parse verdict
                 rec["error"] = f"metrics: {e}"
             out.append(rec)
@@ -437,7 +473,8 @@ class FleetView:
                           "error")}
                         | {"samples": rec.get("samples", 0),
                            "pid": rec.get("pid"),
-                           "port": rec.get("port")})
+                           "port": rec.get("port"),
+                           "role": rec.get("role")})
         return {"endpoints": rows,
                 "healthy": sum(1 for r in rows if r["healthy"]),
                 "total": len(rows),

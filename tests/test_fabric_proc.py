@@ -506,6 +506,190 @@ def test_relay_cursor_resume_through_router(cluster):
         client.close()
 
 
+def test_relay_watchdog_auto_reparents_on_upstream_kill(cluster):
+    """ISSUE-13 satellite: an L1 relay dies (SIGKILL analog — its
+    server stops answering mid-stream) and its downstream L2 relay
+    auto-reparents onto the advertised sibling via the liveness
+    watchdog — a cursor-carrying RESUME, so the downstream subscriber
+    sees every later event exactly once with 0 relists."""
+    from kubernetes_tpu.fabric.relay import RelayCore, RelayServer
+
+    client = RemoteHub(cluster.router_url, timeout=10.0)
+    l1a = RelayServer(
+        RelayCore(cluster.router_url, kinds=("pods",), timeout=5.0),
+        advertise={"state_url": cluster.router_url, "name": "l1-a",
+                   "parent": cluster.router_url,
+                   "interval_s": 0.2}).start()
+    l1b = RelayServer(
+        RelayCore(cluster.router_url, kinds=("pods",), timeout=5.0),
+        advertise={"state_url": cluster.router_url, "name": "l1-b",
+                   "parent": cluster.router_url,
+                   "interval_s": 0.2}).start()
+    l2 = None
+    try:
+        for i in range(4):
+            client.create_pod(MakePod().name(f"wd{i}")
+                              .namespace(f"ns-{i}").obj())
+        # both L1s must be on the served map before the kill, so the
+        # watchdog has a sibling to discover
+        from kubernetes_tpu.fabric.router import fetch_topology
+
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if len(fetch_topology(cluster.router_url)
+                   .get("relays", [])) >= 2:
+                break
+            time.sleep(0.1)
+        l2 = RelayCore(l1a.address, kinds=("pods",), timeout=5.0,
+                       watchdog={"topology_url": cluster.router_url,
+                                 "deadline_s": 0.8,
+                                 "interval_s": 0.2})
+        sub = l2.subscribe(("pods",))
+        got = {d["new"].metadata.name for d in sub.drain()
+               if d["new"] is not None}
+        assert len(got) == 4
+        # SIGKILL analog: the upstream stops answering, no drain
+        l1a.stop()
+        # the watchdog must notice, discover l1-b, and resume there
+        deadline = time.time() + 20
+        while time.time() < deadline and l2.watchdog_reparents == 0:
+            time.sleep(0.1)
+        assert l2.watchdog_reparents >= 1, \
+            "watchdog never reparented off the dead upstream"
+        assert l2.upstream_url == l1b.address
+        # later events flow through the new parent, exactly once each
+        for i in range(3):
+            client.create_pod(MakePod().name(f"post-wd{i}")
+                              .namespace(f"ns-{i}").obj())
+        want = {f"post-wd{i}" for i in range(3)}
+        seen: list[str] = []
+        deadline = time.time() + 15
+        while time.time() < deadline and not want <= set(seen):
+            sub.event.wait(0.1)
+            seen.extend(d["new"].metadata.name for d in sub.drain()
+                        if d["new"] is not None)
+        assert want <= set(seen), f"lost events after reparent: {seen}"
+        assert len(seen) == len(set(seen)), f"duplicates: {seen}"
+        # the reparent was a RESUME off the sibling's rings, not a
+        # relist — downstream continuity is the whole point
+        assert l2.client.resilience_stats()["watch_relists"] == 0
+    finally:
+        if l2 is not None:
+            l2.close()
+        try:
+            l1a.stop()
+        except Exception:  # noqa: BLE001 — already stopped
+            pass
+        l1b.stop()
+        client.close()
+
+
+def test_two_router_concurrent_rebalance_fencing(cluster):
+    """ISSUE-13 satellite: a second router keeps writing through its
+    own (deliberately stale — TTL pinned high) ring while the first
+    router migrates the written segment back and forth. Shard-side
+    ring-epoch fencing must redirect every misrouted write (StaleRing
+    → re-resolve → retry): zero pods lost, zero duplicated, and every
+    pod ends on the shard the final ring assigns."""
+    import threading
+
+    from kubernetes_tpu.fabric.router import RouterServer
+
+    # router B re-reads the ring ONLY when fenced: the stale window is
+    # guaranteed, not racy
+    writer_cluster = ClusterClient(cluster.state_url, ring_ttl_s=60.0)
+    router_b = RouterServer(cluster.state_url, name="router-b",
+                            cluster=writer_cluster).start()
+    admin = RemoteHub(cluster.router_url, timeout=10.0)
+    writer = RemoteHub(router_b.address, timeout=10.0,
+                       retry_deadline=10.0)
+    stop = threading.Event()
+    created: list[str] = []
+    errors: list[str] = []
+
+    def write_loop() -> None:
+        i = 0
+        while not stop.is_set():
+            name = f"w2r-{i}"
+            try:
+                writer.create_pod(MakePod().name(name)
+                                  .namespace("two-router").obj())
+                created.append(name)
+            except Exception as e:  # noqa: BLE001 — a write may park
+                errors.append(f"{name}: {e!r}")   # during the window
+            i += 1
+            time.sleep(0.01)
+
+    t = threading.Thread(target=write_loop, daemon=True)
+    try:
+        slot = ring_slot("two-router", RING_SLOTS)
+        t.start()
+        deadline = time.time() + 10
+        while not created and time.time() < deadline:
+            time.sleep(0.02)
+        # migrate the written segment back and forth under the writes
+        for _ in range(4):
+            ring = admin.fabric_ring()
+            src = ring["slots"][slot]
+            dst = next(n for n in cluster.pod_names if n != src)
+            admin.rebalance_segment([slot], dst)
+            time.sleep(0.15)
+        stop.set()
+        t.join(timeout=10)
+        assert created, "writer never landed a pod"
+        assert not errors, f"writes failed outright: {errors[:3]}"
+        # no pod lost or duplicated across the whole churn
+        pods = [p for p in admin.list_pods()
+                if p.metadata.namespace == "two-router"]
+        names = sorted(p.metadata.name for p in pods)
+        assert names == sorted(created), \
+            f"lost={set(created) - set(names)} " \
+            f"extra={set(names) - set(created)}"
+        # the stale writer was actually fenced and redirected at least
+        # once (ring TTL 60s: only StaleRing can have re-resolved it)
+        assert writer_cluster.stale_ring_retries >= 1
+        # final ownership agrees with the final ring: the segment's
+        # pods live ONLY on the assigned shard
+        final_owner = admin.fabric_ring()["slots"][slot]
+        for name, hub in cluster.hubs.items():
+            if not name.startswith("pods-"):
+                continue
+            here = [p.metadata.name for p in hub.list_pods()
+                    if p.metadata.namespace == "two-router"]
+            if name == final_owner:
+                assert sorted(here) == sorted(created)
+            else:
+                assert here == [], \
+                    f"stray segment copy on {name}: {here[:3]}"
+        # and the two routers cannot both win one epoch: a racing CAS
+        # loses cleanly (Conflict → rolled back), never half-applies
+        ring = admin.fabric_ring()
+        src = ring["slots"][slot]
+        dst = next(n for n in cluster.pod_names if n != src)
+        results: list = [None, None]
+
+        def race(idx, client_) -> None:
+            try:
+                results[idx] = client_.rebalance_segment([slot], dst)
+            except Exception as e:  # noqa: BLE001 — the loser's verdict
+                results[idx] = e
+
+        ra = threading.Thread(target=race, args=(0, admin))
+        rb = threading.Thread(target=race, args=(1, writer))
+        ra.start()
+        rb.start()
+        ra.join(15)
+        rb.join(15)
+        wins = [r for r in results if isinstance(r, dict)]
+        assert len(wins) >= 1, results
+        assert len(admin.list_pods()) >= len(created), results
+    finally:
+        stop.set()
+        admin.close()
+        writer.close()
+        router_b.stop()
+
+
 # ----------------------- real OS processes -----------------------
 
 
