@@ -155,16 +155,23 @@ TRACE_OVERHEAD_BUDGET = 0.02   # <2% p50 cycle time
 AB_LATENCY_BUDGET = 0.03       # <3% phase-total delta on SchedulingBasic
 
 
-def run_ab_scorer(smoke: bool = False, scale: float = 0.1) -> dict:
+def run_ab_scorer(smoke: bool = False, scale: float = 0.1,
+                  generations: int = 1) -> dict:
     """--ab-scorer: the learned-scoring quality harness, end to end in
     one process — (1) a hand-tuned collection run of SchedulingBasic
     with the trace export on, (2) replay-train a checkpoint from the
     exported placement rows, (3) paired A/B of hand-tuned vs learned on
     the same workloads with the SAME tie-break seed, reporting latency
     parity (non-view flight-recorder phase totals) and the quality
-    metrics (preemptions, spread imbalance, time-to-bind p99) the
-    harness now records per workload. The artifact rows are shaped for
-    embedding in BENCH_r08+ files (quality columns ride "workloads")."""
+    metrics (preemptions, spread imbalance, time-to-bind p99, and —
+    now that the arms export the v3 alternative rows — per-placement
+    regret mean/p99) the harness records per workload. ``--generations
+    N`` (ROADMAP item 4's gate) additionally closes the loop N-1 more
+    times: each refresh generation re-collects traces under the LIVE
+    learned policy, retrains through the learn-loop daemon body, and
+    passes the promotion gate before the next collection hot-reloads
+    the winner. The artifact rows are shaped for embedding in
+    BENCH_r08+ files (quality columns ride "workloads")."""
     import shutil
     import tempfile
 
@@ -172,12 +179,13 @@ def run_ab_scorer(smoke: bool = False, scale: float = 0.1) -> dict:
     # 64MiB at full scale) + the checkpoint: cleaned on EVERY exit path
     workdir = tempfile.mkdtemp(prefix="ab_scorer_")
     try:
-        return _ab_scorer_run(workdir, smoke, scale)
+        return _ab_scorer_run(workdir, smoke, scale, generations)
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
-def _ab_scorer_run(workdir: str, smoke: bool, scale: float) -> dict:
+def _ab_scorer_run(workdir: str, smoke: bool, scale: float,
+                   generations: int = 1) -> dict:
     from kubernetes_tpu.utils import jaxsetup
 
     jaxsetup.setup(os.path.join(_repo, ".jax_cache"))
@@ -237,10 +245,16 @@ def _ab_scorer_run(workdir: str, smoke: bool, scale: float) -> dict:
     # (feature vectors opted in — they ARE the training substrate;
     # rotation off for this bounded-lifetime run so a >64MiB collection
     # cannot silently rotate early examples out of the dataset)
-    cfg = base_cfg()
-    cfg.trace_export_path = trace_path
-    cfg.trace_export_features = True
-    cfg.trace_export_max_bytes = 0
+    def export_into(c, path):
+        c.trace_export_path = path
+        c.trace_export_features = True
+        # the v3 alternative rows: the regret substrate (and the
+        # learn-loop's counterfactual fine-tune input)
+        c.trace_export_alts = True
+        c.trace_export_max_bytes = 0
+        return c
+
+    cfg = export_into(base_cfg(), trace_path)
     print("ab-scorer: collection run (trace export)...", file=sys.stderr)
     run_workload(collection(), scale=scale, config=cfg)
 
@@ -281,20 +295,32 @@ def _ab_scorer_run(workdir: str, smoke: bool, scale: float) -> dict:
         for arm_name, cfg_fn in (("hand", base_cfg),
                                  ("learned", learned_cfg)):
             # per-arm tiny compile pass, then the measured run — the
-            # learned arm compiles a different program (the MLP term)
+            # learned arm compiles a different program (the MLP term).
+            # BOTH passes export (alts on) so the measured run reuses
+            # the warm pass's with_alts program AND its quality row
+            # carries the regret columns; the export rides both arms
+            # symmetrically, so latency parity is unaffected
             run_workload(factory(), scale=0.05 if smoke else 0.005,
-                         config=cfg_fn())
-            pair[arm_name] = run_workload(factory(), scale=scale,
-                                          config=cfg_fn(), profile=True)
+                         config=export_into(cfg_fn(), os.path.join(
+                             workdir, f"warm_{name}_{arm_name}.jsonl")))
+            pair[arm_name] = run_workload(
+                factory(), scale=scale, profile=True,
+                config=export_into(cfg_fn(), os.path.join(
+                    workdir, f"ab_{name}_{arm_name}.jsonl")))
         hand, learned = arm(pair["hand"]), arm(pair["learned"])
         ht, lt = hand["phase_total_s"], learned["phase_total_s"]
         delta = (lt - ht) / ht if ht > 0 else 0.0
         qd = {}
         better = []
         for k in ("preemptions", "spread_stddev", "spread_max_min",
-                  "time_to_bind_p99_ms"):
-            hv = hand["quality"].get(k, 0)
-            lv = learned["quality"].get(k, 0)
+                  "time_to_bind_p99_ms", "regret_mean", "regret_p99"):
+            if k not in hand["quality"] or k not in learned["quality"]:
+                # a metric missing on EITHER side (e.g. the regret
+                # block failed in one arm) is "no data", never a
+                # default-0 fabricated win
+                continue
+            hv = hand["quality"][k]
+            lv = learned["quality"][k]
             qd[k] = round(lv - hv, 3)
             # "improved" needs a >=1% relative drop — a sub-noise float
             # delta must not satisfy the quality acceptance criterion
@@ -308,13 +334,46 @@ def _ab_scorer_run(workdir: str, smoke: bool, scale: float) -> dict:
         print(f"ab-scorer {name}: phase-total {ht:.3f}s -> {lt:.3f}s "
               f"({delta * 100:+.2f}%), improved: {better or 'none'}",
               file=sys.stderr)
+    # ----- refresh generations (ROADMAP item 4's 3-generation gate):
+    # collect under the LIVE learned policy -> learn-loop body
+    # (retrain + regret fine-tune + promotion gate) -> the next
+    # collection's scheduler loads whatever the gate published
+    gens = []
+    if generations > 1:
+        from kubernetes_tpu.learn.loop import LearnLoop, LoopConfig
+
+        loop_traces = os.path.join(workdir, "loop_traces.jsonl")
+        loop = LearnLoop(LoopConfig(
+            trace_path=loop_traces,
+            staging_dir=os.path.join(workdir, "staging"),
+            live_path=ckpt_path,
+            min_new_rows=32, min_holdout_rows=8,
+            bc_epochs=80 if smoke else 200,
+            ft_epochs=40 if smoke else 100))
+        for _g in range(2, generations + 1):
+            res = run_workload(collection(), scale=scale,
+                               config=export_into(learned_cfg(),
+                                                  loop_traces))
+            rep = loop.run_once()
+            row = {"generation": rep.get("generation"),
+                   "version": rep.get("version"),
+                   "status": rep.get("status"),
+                   "gate": rep.get("gate"),
+                   "regret": rep.get("regret"),
+                   "pods_per_sec": res.get("pods_per_sec"),
+                   "quality": res.get("quality")}
+            gens.append(row)
+            print(f"ab-scorer generation {rep.get('generation')}: "
+                  f"{rep.get('status')} (version {rep.get('version')}, "
+                  f"gate {rep.get('gate')})", file=sys.stderr)
+
     basic = out.get("SchedulingBasic", {})
     # the 3% parity bar is a FULL-SCALE property (phase totals measured
     # in seconds); smoke phase totals are ~0.1s of mostly dispatch
     # overhead, so the smoke bar is advisory-loose — it exists to catch
     # "the learned arm got 2x slower", not to measure parity
     budget = AB_LATENCY_BUDGET if not smoke else 0.15
-    return {
+    result = {
         "metric": "ab_scorer",
         "unit": "quality",
         "smoke": smoke,
@@ -329,6 +388,9 @@ def _ab_scorer_run(workdir: str, smoke: bool, scale: float) -> dict:
         "improved_workloads": improved_any,
         "workloads": out,
     }
+    if gens:
+        result["generations"] = gens
+    return result
 
 
 def run_profile(smoke: bool = False) -> dict:
@@ -499,11 +561,18 @@ def main() -> None:
     if "--ab-scorer" in sys.argv:
         # learned-scoring quality gate: collection -> replay-train ->
         # paired hand-vs-learned A/B with one tie-break seed; artifact
-        # rows carry the quality columns for BENCH_r08+ files
+        # rows carry the quality columns (incl. regret) for BENCH_r08+
+        # files. --generations N additionally exercises N-1 learn-loop
+        # refresh generations (retrain -> gate -> promote -> reload)
         scale = 0.1
         if "--scale" in sys.argv:
             scale = float(sys.argv[sys.argv.index("--scale") + 1])
-        r = run_ab_scorer(smoke="--smoke" in sys.argv, scale=scale)
+        generations = 1
+        if "--generations" in sys.argv:
+            generations = int(
+                sys.argv[sys.argv.index("--generations") + 1])
+        r = run_ab_scorer(smoke="--smoke" in sys.argv, scale=scale,
+                          generations=generations)
         print(json.dumps(r))
         if not r["latency_ok"]:
             print(f"ab-scorer: SchedulingBasic phase-total delta "
@@ -599,6 +668,11 @@ def main() -> None:
         sys.exit(0 if r["ok"] else 1)
     smoke = "--smoke" in sys.argv
     scale = "0.02" if smoke else "1.0"
+    # --regret: every workload row additionally carries the
+    # per-placement regret_mean/regret_p99 quality columns (runs with a
+    # throwaway alt-exporting trace file — opt-in because the alt
+    # top_k + export I/O are a measured-perf change)
+    regret_args = ["--regret"] if "--regret" in sys.argv else []
     results = {}
     headline = None
     env = dict(os.environ)
@@ -630,7 +704,7 @@ def main() -> None:
         try:
             proc = subprocess.run(
                 [sys.executable, "-m", "kubernetes_tpu.perf.run_one", fn,
-                 "--scale", scale],
+                 "--scale", scale, *regret_args],
                 capture_output=True, text=True, timeout=1800, env=env,
                 cwd=_repo)
         except subprocess.TimeoutExpired:

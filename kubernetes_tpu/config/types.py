@@ -125,6 +125,13 @@ class SchedulerConfiguration:
     # kernels into every launch and adds per-cycle D2H pulls + export
     # bytes — phase-timing-only export users should not pay for it
     trace_export_features: bool = False
+    # ALSO export each placement's top-K alternative node scores
+    # (export v3 "alt" rows — the counterfactual substrate behind
+    # per-placement regret and the learn-loop's contextual-bandit
+    # fine-tune). Opt-in like trace_export_features: it compiles a
+    # [B, K] top_k into every launch and rides the existing per-cycle
+    # device_get (no extra sync)
+    trace_export_alts: bool = False
     # device-side gang packing (ops/gang.pack_gangs): place a whole
     # PodGroup in one fused launch — all-or-nothing feasibility on
     # device, one host commit, no per-member Permit round-trips. Off

@@ -1,7 +1,7 @@
 """Learned scoring subsystem: replay-trained MLP scorer for the device
-pipeline.
+pipeline, plus the CLOSED learning loop around it.
 
-Three parts (ROADMAP item 5):
+Five parts (ROADMAP items 5 and 4):
 
 - ``learn.replay``: reconstruct training examples from flight-recorder
   trace exports (per-pod chosen-node feature rows + hand-tuned
@@ -14,6 +14,14 @@ Three parts (ROADMAP item 5):
 - ``learn.checkpoint``: the versioned on-disk checkpoint format plus the
   mtime-watching hot-reload helper the scheduler polls at
   snapshot-sync time.
+- ``learn.regret``: per-placement regret (chosen outcome vs the best
+  exported counterfactual alternative) and the promotion gate's
+  replay scorer.
+- ``learn.loop``: the retrain daemon — tail the rotating trace
+  exports, retrain on a cadence (BC warm start + regret-weighted
+  contextual-bandit fine-tune), gate candidates against the live
+  checkpoint on held-out rows, promote winners, roll back on
+  post-promotion regret regression.
 
 The serving side lives in ``plugins/learned.py`` (the profile-gated
 LearnedScore manager) and ``ops/learned.py`` (the fused device kernel).
@@ -25,11 +33,15 @@ from kubernetes_tpu.learn.checkpoint import (  # noqa: F401
     CheckpointError,
     CheckpointWatcher,
     load_checkpoint,
+    next_version,
     save_checkpoint,
 )
 from kubernetes_tpu.learn.replay import (  # noqa: F401
     ReplayDataset,
     build_dataset,
+    build_dataset_rows,
+    iter_placement_rows,
     synthetic_dataset,
+    wal_outcomes,
 )
 from kubernetes_tpu.learn.train import TrainConfig, train  # noqa: F401

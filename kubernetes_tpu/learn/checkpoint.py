@@ -130,6 +130,19 @@ def load_checkpoint(path: str):
     return tuple(params), meta
 
 
+def next_version(path: str) -> int:
+    """One past the version of the checkpoint currently at ``path``
+    (1 when absent/unreadable) — the auto-bump behind ``learn train``
+    and the loop daemon, so a forgotten ``--version`` flag can never
+    republish version 1 over a live v7 and walk the
+    scheduler_learned_checkpoint_version gauge backwards."""
+    try:
+        _, meta = load_checkpoint(path)
+        return int(meta.get("version", 0)) + 1
+    except (CheckpointError, TypeError, ValueError):
+        return 1
+
+
 class CheckpointWatcher:
     """mtime-polled checkpoint loader: ``poll()`` is a stat + compare
     (the scheduler calls it once per launch at snapshot-sync time); only

@@ -38,11 +38,15 @@ from typing import Iterable, Iterator, Optional
 import numpy as np
 
 from kubernetes_tpu.ops.learned import NUM_FEATURES, hand_weight_vector
-# the writer's format constant (CycleTrace.to_dict): importing it keeps
-# this reader in lockstep with the export shape
-from kubernetes_tpu.utils.tracing import EXPORT_VERSION
 
 logger = logging.getLogger("kubernetes_tpu.learn")
+
+# oldest export format this reader accepts: v2 introduced the placement
+# rows + feature vectors this dataset is built from. v3 (the "alt"
+# top-K alternative scores) is additive, so v2 rows stay valid input —
+# the reader keys on its own floor, NOT the writer's EXPORT_VERSION,
+# so bumping the writer never silently discards yesterday's traces.
+REPLAY_MIN_VERSION = 2
 
 
 def bc_targets(x: np.ndarray) -> np.ndarray:
@@ -93,15 +97,36 @@ def iter_trace_lines(path: str) -> Iterator[dict]:
                 continue
 
 
-def _wal_outcomes(wal_path: str) -> tuple[set, dict]:
-    """(evicted_uids, node -> topology domain) from the journal WAL:
-    a bound pod's DELETE is the eviction/preemption signal (victims are
-    deleted by the scheduler's eviction flush; a completed pod exits
-    through the same door — both mean the placement did not stick), and
-    node ADD/UPDATE events carry the labels that map each node to its
-    zone (hostname fallback) domain."""
+def apply_wal_record(rec: dict, evicted: set, node_domain: dict) -> None:
+    """Fold ONE parsed WAL record into the outcome maps: a bound pod's
+    DELETE is the eviction/preemption signal (victims are deleted by
+    the scheduler's eviction flush; a completed pod exits through the
+    same door — both mean the placement did not stick), and node
+    ADD/UPDATE events carry the labels that map each node to its zone
+    (hostname fallback) domain. Idempotent (sets/last-wins), so the
+    learn-loop's incremental WAL tail can safely re-apply a window."""
     from kubernetes_tpu.utils.wire import from_wire
 
+    kind = rec.get("kind")
+    try:
+        if kind == "pods" and rec.get("type") == "delete":
+            old = from_wire(rec.get("old"))
+            if old is not None and old.spec.node_name:
+                evicted.add(old.metadata.uid)
+        elif kind == "nodes" and rec.get("type") in ("add", "update"):
+            new = from_wire(rec.get("new"))
+            if new is not None:
+                labels = new.metadata.labels or {}
+                node_domain[new.metadata.name] = labels.get(
+                    ZONE_LABEL,
+                    labels.get(HOSTNAME_LABEL, new.metadata.name))
+    except Exception:  # noqa: BLE001 — one bad record is data loss,
+        pass           # not a failed build
+
+
+def wal_outcomes(wal_path: str) -> tuple[set, dict]:
+    """(evicted_uids, node -> topology domain) from the whole journal
+    WAL (apply_wal_record over every line)."""
     evicted: set = set()
     node_domain: dict = {}
     with open(wal_path) as f:
@@ -113,72 +138,67 @@ def _wal_outcomes(wal_path: str) -> tuple[set, dict]:
                 rec = json.loads(line)
             except ValueError:
                 continue        # torn tail — storage tolerates it too
-            kind = rec.get("kind")
-            try:
-                if kind == "pods" and rec.get("type") == "delete":
-                    old = from_wire(rec.get("old"))
-                    if old is not None and old.spec.node_name:
-                        evicted.add(old.metadata.uid)
-                elif kind == "nodes" and rec.get("type") in ("add",
-                                                             "update"):
-                    new = from_wire(rec.get("new"))
-                    if new is not None:
-                        labels = new.metadata.labels or {}
-                        node_domain[new.metadata.name] = labels.get(
-                            ZONE_LABEL,
-                            labels.get(HOSTNAME_LABEL,
-                                       new.metadata.name))
-            except Exception:  # noqa: BLE001 — one bad record is data loss,
-                continue       # not a failed build
+            apply_wal_record(rec, evicted, node_domain)
     return evicted, node_domain
 
 
-def build_dataset(trace_paths: Iterable[str],
-                  wal_path: Optional[str] = None,
-                  max_examples: int = 500_000) -> ReplayDataset:
-    """Reconstruct a training set from export files (+ optional WAL for
-    outcome labels). Raises ValueError when no usable placement rows are
-    found (exports predating format v2 carry no feature rows)."""
+def iter_placement_rows(lines: Iterable[dict]) -> Iterator[dict]:
+    """Flatten trace lines into per-placement row dicts — {"uid",
+    "node", "score", "feat", "alt", "t"} with node None for failed
+    attempts (time-to-bind anchors). Pre-v2 lines yield nothing. The
+    shared substrate of the file-based builder, the learn-loop's
+    in-memory tail, and regret computation."""
+    for line in lines:
+        if not isinstance(line, dict) \
+                or line.get("v", 1) < REPLAY_MIN_VERSION:
+            continue
+        t = float(line.get("start", 0.0))
+        for row in line.get("placements") or []:
+            yield {"uid": row.get("uid", ""), "node": row.get("node"),
+                   "score": float(row.get("score", 0.0)),
+                   "feat": row.get("feat"),
+                   "alt": row.get("alt"), "t": t}
+
+
+def build_dataset_rows(rows: Iterable[dict],
+                       evicted: Optional[set] = None,
+                       node_domain: Optional[dict] = None,
+                       max_examples: int = 500_000) -> ReplayDataset:
+    """The dataset arithmetic over flattened placement rows
+    (iter_placement_rows shape): BC targets from the feature rows,
+    outcome rewards shaded by time-to-bind, evictions, and domain
+    crowding. Raises ValueError when no row carries a feature vector."""
     feats: list = []
     scores: list = []
     uids: list = []
     nodes: list = []
     first_seen: dict = {}
     bind_at: dict = {}
-    lines = 0
-    skipped_old = 0
-    for path in ([trace_paths] if isinstance(trace_paths, str)
-                 else list(trace_paths)):
-        for line in iter_trace_lines(path):
-            lines += 1
-            if line.get("v", 1) < EXPORT_VERSION:
-                skipped_old += 1
-                continue
-            t = float(line.get("start", 0.0))
-            for row in line.get("placements") or []:
-                uid = row.get("uid", "")
-                if uid and uid not in first_seen:
-                    first_seen[uid] = t
-                node = row.get("node")
-                if node is None:
-                    continue    # failed attempt: time-to-bind anchor only
-                feat = row.get("feat")
-                if not feat or len(feat) != NUM_FEATURES:
-                    continue
-                if len(feats) >= max_examples:
-                    continue
-                bind_at.setdefault(uid, t)
-                feats.append(feat)
-                scores.append(float(row.get("score", 0.0)))
-                uids.append(uid)
-                nodes.append(node)
+    rows_seen = 0
+    for row in rows:
+        rows_seen += 1
+        uid = row.get("uid", "")
+        t = float(row.get("t", 0.0))
+        if uid and uid not in first_seen:
+            first_seen[uid] = t
+        node = row.get("node")
+        if node is None:
+            continue        # failed attempt: time-to-bind anchor only
+        feat = row.get("feat")
+        if not feat or len(feat) != NUM_FEATURES:
+            continue
+        if len(feats) >= max_examples:
+            continue
+        bind_at.setdefault(uid, t)
+        feats.append(feat)
+        scores.append(float(row.get("score", 0.0)))
+        uids.append(uid)
+        nodes.append(node)
     if not feats:
         raise ValueError(
-            f"no v{EXPORT_VERSION} placement rows with feature vectors "
-            f"found ({lines} trace lines, {skipped_old} "
-            f"pre-v{EXPORT_VERSION}); run the scheduler with "
-            "trace_export_path set AND trace_export_features=true "
-            "(the feature export is opt-in)")
+            f"no placement rows with feature vectors among {rows_seen} "
+            "rows; run the scheduler with trace_export_path set AND "
+            "trace_export_features=true (the feature export is opt-in)")
     x = np.asarray(feats, np.float32)
     y = bc_targets(x)
     reward = np.ones((len(feats),), np.float32)
@@ -192,13 +212,11 @@ def build_dataset(trace_paths: Iterable[str],
             rel = ttbs.get(uid, med) / med
             reward[i] /= 1.0 + max(0.0, rel - 1.0) * SLOW_BIND_SHADE
 
-    evicted: set = set()
-    node_domain: dict = {}
-    if wal_path:
-        evicted, node_domain = _wal_outcomes(wal_path)
-        for i, uid in enumerate(uids):
-            if uid in evicted:
-                reward[i] *= EVICT_PENALTY
+    evicted = evicted or set()
+    node_domain = node_domain or {}
+    for i, uid in enumerate(uids):
+        if uid in evicted:
+            reward[i] *= EVICT_PENALTY
     # topology-domain crowding: placements into domains that ended up
     # holding more than their share of this replay's pods shade down —
     # the spread-imbalance outcome label
@@ -214,10 +232,49 @@ def build_dataset(trace_paths: Iterable[str],
     return ReplayDataset(
         x=x, y=y, reward=reward,
         agg_score=np.asarray(scores, np.float32),
-        meta={"examples": len(feats), "trace_lines": lines,
-              "skipped_pre_v2": skipped_old, "evicted": len(evicted),
+        meta={"examples": len(feats),
+              "evicted": len(evicted),
               "domains": len(counts),
+              "uids": uids, "nodes": nodes,
               "ttb_median_s": round(med, 6)})
+
+
+def build_dataset(trace_paths: Iterable[str],
+                  wal_path: Optional[str] = None,
+                  max_examples: int = 500_000) -> ReplayDataset:
+    """Reconstruct a training set from export files (+ optional WAL for
+    outcome labels). Raises ValueError when no usable placement rows are
+    found (exports predating format v2 carry no feature rows)."""
+    lines = 0
+    skipped_old = 0
+    raw: list = []
+    for path in ([trace_paths] if isinstance(trace_paths, str)
+                 else list(trace_paths)):
+        for line in iter_trace_lines(path):
+            lines += 1
+            if line.get("v", 1) < REPLAY_MIN_VERSION:
+                skipped_old += 1
+                continue
+            raw.append(line)
+    evicted: set = set()
+    node_domain: dict = {}
+    if wal_path:
+        evicted, node_domain = wal_outcomes(wal_path)
+    try:
+        ds = build_dataset_rows(iter_placement_rows(raw),
+                                evicted=evicted, node_domain=node_domain,
+                                max_examples=max_examples)
+    except ValueError:
+        raise ValueError(
+            f"no v{REPLAY_MIN_VERSION}+ placement rows with feature "
+            f"vectors found ({lines} trace lines, {skipped_old} "
+            f"pre-v{REPLAY_MIN_VERSION}); run the scheduler with "
+            "trace_export_path set AND trace_export_features=true "
+            "(the feature export is opt-in)") from None
+    ds.meta.pop("uids", None)
+    ds.meta.pop("nodes", None)
+    ds.meta.update({"trace_lines": lines, "skipped_pre_v2": skipped_old})
+    return ds
 
 
 def synthetic_dataset(seed: int = 0, n: int = 512,

@@ -401,7 +401,9 @@ class SchedulerMetrics:
         self.learned_reloads = r.register(Counter(
             "scheduler_learned_reloads_total",
             "Learned-scorer checkpoint hot-reloads (mtime change "
-            "observed at snapshot-sync time)", ("profile",)))
+            "observed at snapshot-sync time); generation 0 = a manual "
+            "publish, >0 = the learn-loop's gated promotion",
+            ("profile", "generation")))
         self.learned_load_errors = r.register(Counter(
             "scheduler_learned_load_errors_total",
             "Learned-scorer checkpoint loads rejected (corrupt/"

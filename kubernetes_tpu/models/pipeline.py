@@ -87,6 +87,17 @@ HARD_POD_AFFINITY_WEIGHT = 1.0
 # [chunk, selector-capacity, N] gather footprint for giant drain batches
 PHASE1_CHUNK = 1024
 
+# top-K alternative-candidate export (with_alts; export v3): how many
+# runner-up (node, score) pairs each placement row carries — the
+# counterfactual substrate behind per-placement regret (learn/regret.py).
+# Small and static: a [B, K] top_k fused into the launch, K-1 extra rows
+# per exported placement.
+ALT_K = 4
+# alt_score padding sentinel for infeasible/absent candidate slots;
+# aggregate scores are bounded (a few hundred), so anything below
+# ALT_NONE/2 is "no candidate" on the host side
+ALT_NONE = -1e9
+
 # commit-scan unroll factor (see the lax.scan call): amortizes per-iteration
 # dispatch overhead, which dominates the topology scan at these shapes.
 # 16 on TPU (+15-25% on the topology workloads); 4 on CPU, where the only
@@ -199,6 +210,13 @@ class BatchResult:
     # feature row per pod (zeros unless the launch was compiled
     # with_feats — the flight-recorder export's replay-dataset rows).
     chosen_feat: jax.Array
+    # [B, ALT_K] i32 / f32: the top-K candidate node rows and their
+    # aggregate scores per pod (-1 / ALT_NONE padding unless the launch
+    # was compiled with_alts — the export v3 counterfactual substrate
+    # behind per-placement regret). The chosen node itself rides along
+    # (it is top-1 in the common case); the offline consumer filters it.
+    alt_row: jax.Array
+    alt_score: jax.Array
 
 
 # workload-activity flags (STATIC, host-derived per launch by
@@ -269,7 +287,7 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
                    img, unres, weights, free0, nzr0, host_score=None,
                    fit_strategy="LeastAllocated", fit_shape=None,
                    dra_reject=None, learned=None, tie_seed=None,
-                   with_feats=False):
+                   with_feats=False, with_alts=False):
     """Parallel auction replacing the per-pod commit scan when the batch has
     no topology constraints and no host ports: every round, all unplaced
     pods score+argmax in parallel; per node, up to K pods are accepted in
@@ -414,30 +432,49 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
     # per-round states the losers scored against are gone)
     learned_mag = jnp.float32(0.0)
     chosen_feat = jnp.zeros((B, LN.NUM_FEATURES), jnp.float32)
-    if learned is not None or with_feats:
+    alt_row = jnp.full((B, ALT_K), -1, jnp.int32)
+    alt_score = jnp.full((B, ALT_K), ALT_NONE, jnp.float32)
+    if learned is not None or with_feats or with_alts:
         ok_end = static_ok & fit       # end-state feasible, like rejects
         rows_c = jnp.clip(placed, 0, N - 1)
         chosen_oh = ((rows_c[:, None] == jnp.arange(N)[None, :])
                      & (placed >= 0)[:, None])                # [B, N]
+        # the chosen node joins its own candidate/normalization mask
+        # even when end-state fit excludes it (it WAS feasible when it
+        # won)
+        cand = ok_end | chosen_oh
 
-        def pod_feats(nzreq, t_raw, a_raw, im, feas_row, own_row):
-            # subtract the pod's OWN committed usage first —
-            # utilization_fractions re-adds the request, so feeding the
+        def pod_eval(nzreq, t_raw, a_raw, im, feas_row, own_row):
+            # ONE evaluation feeds every export tail (features, the
+            # fused learned term, the alt totals) — like the serial
+            # scan deriving all three from one per-step state. The
+            # pod's OWN committed usage is subtracted first:
+            # utilization_fractions re-adds the request, so feeding
             # end-state nzr directly would double-count the pod on its
-            # chosen node and skew the exported training distribution
-            # away from what the scorer sees at inference
+            # chosen node — skewing the exported training distribution
+            # away from inference AND deflating exactly the chosen
+            # basis regret compares against the runner-ups
             nzr_i = nzr - own_row[:, None] * nzreq[None, :]
             frac, least, bal, taint, aff = per_pod_scores(
                 nzr_i, nzreq, t_raw, a_raw, feas_row)
-            return LN.feature_rows(frac, least, bal, taint, aff, im)
-        # the chosen node joins its own normalization mask even when
-        # end-state fit excludes it (it WAS feasible when it won)
-        feats = jax.vmap(pod_feats)(pods.nonzero_req, taint_raw, aff_raw,
-                                    img, ok_end | chosen_oh,
-                                    chosen_oh.astype(nzr.dtype))
+            feats_row = LN.feature_rows(frac, least, bal, taint, aff,
+                                        im)                  # [N, F]
+            lterm_row = (jnp.clip(LN.mlp_apply(learned, feats_row),
+                                  0.0, LN.MAX_SCORE)
+                         if learned is not None
+                         else jnp.zeros_like(least))          # [N]
+            total = (weights.taint_toleration * taint
+                     + weights.node_affinity * aff
+                     + weights.resources_fit * least
+                     + weights.balanced_allocation * bal
+                     + weights.image_locality * im
+                     + weights.learned * lterm_row)
+            return feats_row, lterm_row, total
+        # unused outputs are DCE'd per compiled flag combination
+        feats, lterm, tot = jax.vmap(pod_eval)(
+            pods.nonzero_req, taint_raw, aff_raw, img, cand,
+            chosen_oh.astype(nzr.dtype))
         if learned is not None:
-            lterm = jnp.clip(LN.mlp_apply(learned, feats), 0.0,
-                             LN.MAX_SCORE)                    # [B, N]
             # same feasible-pair definition as the serial path's live
             # mask (modulo end-state attribution): one histogram, one
             # metric meaning across commit paths
@@ -448,6 +485,22 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
         if with_feats:
             chosen_feat = jnp.take_along_axis(
                 feats, rows_c[:, None, None], axis=1)[:, 0, :]
+        if with_alts:
+            # top-K candidate nodes + aggregate scores, attributed
+            # against the END state like the feature/reject
+            # diagnostics above (the per-round states the losers
+            # scored against are gone); the chosen node rides the
+            # candidate set so its score is comparable to its
+            # runners-up on ONE basis
+            if host_score is not None:
+                tot = tot + host_score
+            masked = jnp.where(cand, tot, ALT_NONE)
+            k = min(ALT_K, N)
+            a_s, a_r = jax.lax.top_k(masked, k)
+            a_r = jnp.where(a_s > ALT_NONE * 0.5,
+                            a_r.astype(jnp.int32), -1)
+            alt_score = alt_score.at[:, :k].set(a_s)
+            alt_row = alt_row.at[:, :k].set(a_r)
     return BatchResult(node_row=placed, score=win, feasible_count=feas,
                        reject_counts=reject_counts,
                        unresolvable_count=unres, free=free, nzr=nzr,
@@ -455,7 +508,8 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
                        guard=_guard_reduction(win, free),
                        dra_reject=(jnp.zeros((B,), jnp.int32)
                                    if dra_reject is None else dra_reject),
-                       learned_mag=learned_mag, chosen_feat=chosen_feat)
+                       learned_mag=learned_mag, chosen_feat=chosen_feat,
+                       alt_row=alt_row, alt_score=alt_score)
 
 
 def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
@@ -481,6 +535,7 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                    learned=None,
                    tie_seed=None,
                    with_feats: bool = False,
+                   with_alts: bool = False,
                    ) -> BatchResult:
     """Schedule a whole pod batch in one launch, as-if-serial (see module
     docstring for the two-phase structure).
@@ -532,7 +587,12 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
     0/None is the historical hash. ``with_feats`` (STATIC) additionally
     materializes each pod's chosen-node feature row in
     BatchResult.chosen_feat — the flight-recorder export's replay rows;
-    off, the field is zeros and the feature kernels are DCE'd."""
+    off, the field is zeros and the feature kernels are DCE'd.
+    ``with_alts`` (STATIC) materializes the top-ALT_K candidate node
+    rows + aggregate scores per pod in BatchResult.alt_row/.alt_score —
+    the export v3 counterfactual substrate behind per-placement regret
+    (learn/regret.py); off, the fields are padding and the top_k is
+    DCE'd."""
     ct = unpack_cluster(cblobs, caps)
     pods = unpack_pods(pblobs, caps, pfields, ptmpl)  # leaves [B, ...]
     free0 = ct.free if state is None else state[0]
@@ -642,7 +702,8 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
         return _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw,
                               aff_raw, img, unres, weights, free0, nzr0,
                               host_score, fit_strategy, fit_shape,
-                              dra_reject, learned, tie_seed, with_feats)
+                              dra_reject, learned, tie_seed, with_feats,
+                              with_alts)
     if enable_topology:
         # ---- phase 1b: topology statics per GROUP (representatives) ----
         pods_rep = jax.tree.map(lambda x: x[rep], pods)  # leaves [G, ...]
@@ -1018,6 +1079,26 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
         if with_feats:
             ys = ys + (LN.feature_row_at(r, frac, least, bal, taint, aff,
                                          im),)
+        if with_alts:
+            # top-K candidates against the pod's LIVE per-step state —
+            # exactly the alternatives this pod could have taken at its
+            # decision time (the serial path's as-if-serial
+            # counterfactual; top_k breaks ties by row index, so the
+            # tie-perturbed winner need not be slot 0 — the offline
+            # consumer treats its entry as the chosen value's basis
+            # wherever it lands)
+            masked_t = jnp.where(feasible, total, ALT_NONE)
+            k_alt = min(ALT_K, masked_t.shape[0])
+            a_s, a_r = jax.lax.top_k(masked_t, k_alt)
+            if k_alt < ALT_K:
+                a_s = jnp.concatenate(
+                    [a_s, jnp.full((ALT_K - k_alt,), ALT_NONE,
+                                   jnp.float32)])
+                a_r = jnp.concatenate(
+                    [a_r, jnp.full((ALT_K - k_alt,), -1, a_r.dtype)])
+            a_r = jnp.where(a_s > ALT_NONE * 0.5,
+                            a_r.astype(jnp.int32), -1)
+            ys = ys + (a_r, a_s)
         return out_carry, ys
 
     xs = (jnp.arange(B), static_ok, taint_raw, aff_raw, img,
@@ -1058,6 +1139,12 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                        / n_valid.astype(jnp.float32))
     chosen_feat = (extra.pop(0) if with_feats
                    else jnp.zeros((B, LN.NUM_FEATURES), jnp.float32))
+    if with_alts:
+        alt_row = extra.pop(0)                                 # [B, K]
+        alt_score = extra.pop(0)
+    else:
+        alt_row = jnp.full((B, ALT_K), -1, jnp.int32)
+        alt_score = jnp.full((B, ALT_K), ALT_NONE, jnp.float32)
     free_out, nzr_out = carry_out[0], carry_out[1]
     start_out = carry_out[-1] if pct_nodes else jnp.int32(0)
 
@@ -1071,14 +1158,15 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                        free=free_out, nzr=nzr_out, pct_start=start_out,
                        guard=_guard_reduction(win_scores, free_out),
                        dra_reject=dra_reject, learned_mag=learned_mag,
-                       chosen_feat=chosen_feat)
+                       chosen_feat=chosen_feat,
+                       alt_row=alt_row, alt_score=alt_score)
 
 
 @partial(jax.jit, static_argnames=("caps", "enable_topology", "d_cap",
                                    "enabled_filters", "serial_scan",
                                    "active", "pfields", "g_cap",
                                    "fit_strategy", "pct_nodes",
-                                   "with_feats"))
+                                   "with_feats", "with_alts"))
 def schedule_batch_jit(cblobs, pblobs, wk, weights, caps,
                        enable_topology=True, d_cap=None,
                        enabled_filters=None, serial_scan=True, state=None,
@@ -1087,13 +1175,13 @@ def schedule_batch_jit(cblobs, pblobs, wk, weights, caps,
                        host_score=None, fit_strategy="LeastAllocated",
                        fit_shape=None, pct_nodes=0, pct_start=None,
                        dra=None, learned=None, tie_seed=None,
-                       with_feats=False):
+                       with_feats=False, with_alts=False):
     return schedule_batch(cblobs, pblobs, wk, weights, caps,
                           enable_topology, d_cap, enabled_filters,
                           serial_scan, state, active, pfields, ptmpl,
                           gid, rep, g_cap, host_ok, host_score,
                           fit_strategy, fit_shape, pct_nodes, pct_start,
-                          dra, learned, tie_seed, with_feats)
+                          dra, learned, tie_seed, with_feats, with_alts)
 
 
 @partial(jax.jit, static_argnames=("caps",))
@@ -1134,7 +1222,7 @@ def launch_batch(spec, wk, weights, caps, enabled_filters=None,
                  host_score=None, fit_strategy="LeastAllocated",
                  fit_shape=None, pct_nodes=0, pct_start=None,
                  learned=None, tie_seed=None,
-                 with_feats=False) -> BatchResult:
+                 with_feats=False, with_alts=False) -> BatchResult:
     """schedule_batch_jit driven by a Mirror.prepare_launch LaunchSpec."""
     return schedule_batch_jit(
         spec.cblobs, spec.pblobs, wk, weights, caps,
@@ -1145,4 +1233,5 @@ def launch_batch(spec, wk, weights, caps, enabled_filters=None,
         host_ok=host_ok, host_score=host_score,
         fit_strategy=fit_strategy, fit_shape=fit_shape,
         pct_nodes=pct_nodes, pct_start=pct_start, dra=spec.dra,
-        learned=learned, tie_seed=tie_seed, with_feats=with_feats)
+        learned=learned, tie_seed=tie_seed, with_feats=with_feats,
+        with_alts=with_alts)

@@ -24,6 +24,7 @@ production-path throughput.
 from __future__ import annotations
 
 import copy
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -379,6 +380,37 @@ def run_workload(w: Workload, now: Callable[[], float] = time.time,
                 m.pod_e2e_duration.percentile(99) * 1e3, 2),
         },
     }
+    # per-placement regret columns (ISSUE 14): whenever the run exported
+    # the v3 alternative rows, summarize (chosen outcome − best
+    # counterfactual) over this workload's placements into the artifact
+    # row — outcomes harvested from the live hub's journal the same way
+    # replay harvests them from the WAL
+    if getattr(cfg, "trace_export_path", None) \
+            and getattr(cfg, "trace_export_alts", False):
+        try:
+            from kubernetes_tpu.learn import regret as RG
+            from kubernetes_tpu.learn.replay import (
+                iter_placement_rows,
+                iter_trace_lines,
+            )
+
+            paths = [cfg.trace_export_path + ".1", cfg.trace_export_path]
+            rows = [r for p in paths if os.path.exists(p)
+                    for r in iter_placement_rows(iter_trace_lines(p))]
+            evicted, node_domain = RG.harvest_hub_outcomes(hub)
+            # the export opens in APPEND mode: a reused path carries
+            # earlier runs' rows — keep only uids THIS run's (fresh)
+            # hub knows, so the columns summarize this workload only
+            run_uids = {p.metadata.uid for p in hub.list_pods()} \
+                | evicted
+            rows = [r for r in rows if r.get("uid") in run_uids]
+            reg = RG.summarize_regret(
+                RG.compute_regret(rows, evicted, node_domain))
+            result["quality"]["regret_mean"] = reg["regret_mean"]
+            result["quality"]["regret_p99"] = reg["regret_p99"]
+            result["regret"] = reg
+        except Exception:  # noqa: BLE001 — a torn export must not fail
+            pass           # the bench row it decorates
     if sched.jobqueue.active:
         # per-tenant admission/fairness accounting for the gang-storm
         # artifact rows (weights should show up as contended ratios)

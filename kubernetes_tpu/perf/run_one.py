@@ -1,12 +1,15 @@
 """Run ONE perf workload in a fresh process and print its result as JSON.
 
 `python -m kubernetes_tpu.perf.run_one <workload_fn> [--scale X]
- [--profile] [--recorder off]`
+ [--profile] [--recorder off] [--regret]`
 
 --profile includes the flight recorder's per-phase/per-plugin breakdown
 in the JSON result (bench.py --profile consumes it); --recorder off
 disables the always-on recorder (flight_recorder_capacity=0) for the
---trace-overhead on/off comparison.
+--trace-overhead on/off comparison; --regret runs with a throwaway
+trace export + the v3 alternative rows on so the result's quality
+block carries the per-placement regret_mean/regret_p99 columns
+(opt-in: the alt top_k + export I/O are a measured-perf change).
 
 The bench driver (bench.py) shells out here per workload — the same
 isolation the reference harness gets from one integration-test process
@@ -52,13 +55,36 @@ def main() -> None:
 
             config = default_config()
             config.flight_recorder_capacity = 0
+    regret_dir = None
+    if "--regret" in sys.argv:
+        import tempfile
+
+        from kubernetes_tpu.config.types import default_config
+
+        if config is None:
+            config = default_config()
+        regret_dir = tempfile.mkdtemp(prefix="bench_regret_")
+        config.trace_export_path = os.path.join(regret_dir,
+                                                "traces.jsonl")
+        # regret needs scores + alternatives, not feature vectors; the
+        # default keep-last-1 rotation bounds the run's disk footprint
+        # (the summary then covers the newest window)
+        config.trace_export_alts = True
     t0 = time.time()
     run_workload(factory(), scale=0.005,   # compile pass, same shapes
                  config=config)
     t_warm = time.time() - t0
+    if regret_dir is not None:
+        # the measured run's regret summary must not include the warm
+        # pass's placements
+        open(config.trace_export_path, "w").close()
     t0 = time.time()
     r = run_workload(factory(), scale=scale, config=config,
                      profile=profile)
+    if regret_dir is not None:
+        import shutil
+
+        shutil.rmtree(regret_dir, ignore_errors=True)
     r["warm_s"] = round(t_warm, 1)
     r["run_s"] = round(time.time() - t0, 1)
     print(json.dumps(r))

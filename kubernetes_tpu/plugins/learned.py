@@ -68,9 +68,13 @@ class LearnedScore:
             (jnp.asarray(wt), jnp.asarray(b)) for wt, b in w.params)
         if had:
             self.reloads += 1
+        # generation 0 = a manual publish (learn train / identity);
+        # >0 = the learn-loop's gated promotion — the fleet scrape
+        # distinguishes the two via the reloads counter's label
         logger.info("learned scorer checkpoint %s loaded (version %s, "
-                    "fingerprint %s)", self.checkpoint_path,
-                    self.version, self.fingerprint)
+                    "generation %s, fingerprint %s)",
+                    self.checkpoint_path, self.version, self.generation,
+                    self.fingerprint)
         return True
 
     def params(self):
@@ -89,18 +93,33 @@ class LearnedScore:
             return 0
 
     @property
+    def generation(self) -> int:
+        """The learn-loop generation that produced the active
+        checkpoint; 0 for manual publishes (learn train / identity)."""
+        w = self._watcher
+        if w is None or not w.meta:
+            return 0
+        try:
+            return int(w.meta.get("generation", 0))
+        except (TypeError, ValueError):
+            return 0
+
+    @property
     def fingerprint(self) -> str:
         w = self._watcher
         return (w.meta.get("fingerprint", "") if w is not None else "")
 
     def stats(self) -> dict:
-        """/debug/scorer payload for one profile."""
+        """/debug/scorer payload for one profile: checkpoint identity,
+        the learn-loop generation + regret summaries stamped by the
+        promotion gate, reload/error counts."""
         w = self._watcher
         out = {
             "enabled": True,
             "checkpoint_path": self.checkpoint_path,
             "loaded": self._device_params is not None,
             "version": self.version,
+            "generation": self.generation,
             "fingerprint": self.fingerprint,
             "reloads": self.reloads,
         }
@@ -108,6 +127,13 @@ class LearnedScore:
             out.update(loads=w.loads, load_errors=w.load_errors,
                        last_error=w.last_error)
             if w.meta:
-                out["meta"] = {k: v for k, v in w.meta.items()
-                               if k not in ("fingerprint",)}
+                meta = {k: v for k, v in w.meta.items()
+                        if k not in ("fingerprint",)}
+                out["meta"] = meta
+                # the loop's regret view: training-set regret and the
+                # gate's holdout regret ride the promoted meta
+                for k in ("regret", "holdout_regret", "gate_wins",
+                          "promoted", "rolled_back_from"):
+                    if k in meta:
+                        out[k] = meta[k]
         return out

@@ -60,6 +60,7 @@ from kubernetes_tpu.utils.gcguard import guard as gc_guard
 from kubernetes_tpu.utils.tracing import FlightRecorder, PodTimelines
 from kubernetes_tpu.models.pipeline import (
     ADAPTIVE_PCT,
+    ALT_NONE,
     FILTER_PLUGINS,
     BatchResult,
     extract_state_jit,
@@ -227,6 +228,12 @@ class Scheduler:
         # must not pay the feature kernels + extra D2H + line growth
         self._export_feats = (self.flight.exporting and getattr(
             self.config, "trace_export_features", False))
+        # placement ALTERNATIVE export (top-K candidate node scores, the
+        # regret counterfactual substrate): same opt-in discipline — it
+        # compiles a [B, K] top_k into every launch and rides the
+        # existing per-cycle pull
+        self._export_alts = (self.flight.exporting and getattr(
+            self.config, "trace_export_alts", False))
         self._last_pop_s = 0.0
         if self.flight.enabled:
             for fw in self.frameworks.values():
@@ -1312,9 +1319,13 @@ class Scheduler:
             tr.add("learned_score", self.now() - t_l0)
             # reloads = swaps AFTER the initial load (the manager's
             # count); errors delta-mirrored like other external counts
+            # the generation label rides the delta at reload time:
+            # promoted-vs-manual publishes stay distinguishable in the
+            # fleet scrape (generation 0 = manual)
             self._mirror_count(f"learned_reloads:{prof}", mgr.reloads,
                                self.metrics.learned_reloads,
-                               profile=prof)
+                               profile=prof,
+                               generation=str(mgr.generation))
             w = getattr(mgr, "_watcher", None)
             if w is not None:
                 self._mirror_count(f"learned_errs:{prof}", w.load_errors,
@@ -1403,7 +1414,8 @@ class Scheduler:
             # feature export is opted in AND the export file is still
             # open (a failed rotation disables the export; the feature
             # kernels must not keep running for output nobody pulls)
-            with_feats=self._export_feats and self.flight.exporting)
+            with_feats=self._export_feats and self.flight.exporting,
+            with_alts=self._export_alts and self.flight.exporting)
         if self.fault_injector is not None:
             out = self.fault_injector.on_result(out)
         if pct:
@@ -1435,7 +1447,8 @@ class Scheduler:
                 spec.enable_topology, spec.d_cap, spec.g_cap,
                 not use_auction, spec.dra is not None,
                 learned_params is not None,
-                self._export_feats and self.flight.exporting)
+                self._export_feats and self.flight.exporting,
+                alts=self._export_alts and self.flight.exporting)
             compiled = prof.note_launch(pshape)
             if compiled or prof.launches == 1:
                 # buffer footprints are bucket-static: re-measure only
@@ -2067,6 +2080,9 @@ class Scheduler:
             pull.append(out.score)
             if self._export_feats:
                 pull.append(out.chosen_feat)
+            if self._export_alts:
+                pull.append(out.alt_row)
+                pull.append(out.alt_score)
         # any PreFilter gang-capacity reductions dispatched this cycle
         # ride this same sync (the folded gang_capacity D2H — never a
         # separate blocking pull)
@@ -2082,12 +2098,16 @@ class Scheduler:
         if learned_on:
             lmag = vals[k]
             k += 1
-        scores_arr = feats_arr = None
+        scores_arr = feats_arr = alt_rows_arr = alt_scores_arr = None
         if exporting:
             scores_arr = vals[k]
             k += 1
             if self._export_feats:
                 feats_arr = vals[k]
+                k += 1
+            if self._export_alts:
+                alt_rows_arr = vals[k]
+                alt_scores_arr = vals[k + 1]
         if int(guard):
             # the launch's own guard reduction tripped: NaN scores or a
             # poisoned usage chain — nothing below can be trusted; the
@@ -2104,9 +2124,10 @@ class Scheduler:
         rows = np.asarray(rows_arr)[:n].tolist()
         launch_s = self.now() - t_dispatched
         if exporting:
-            # export v2 placement rows: (pod, chosen node, aggregate
+            # export v2/v3 placement rows: (pod, chosen node, aggregate
             # score[, chosen-node feature vector when
-            # trace_export_features]) — the replay dataset's substrate,
+            # trace_export_features][, top-K alternative node scores
+            # when trace_export_alts]) — the replay dataset's substrate,
             # already pulled with rows+guard above. Failed attempts
             # export node=None (time-to-bind anchors).
             placements = []
@@ -2118,6 +2139,23 @@ class Scheduler:
                     if feats_arr is not None:
                         rec["feat"] = [round(float(v), 5)
                                        for v in feats_arr[i]]
+                    if alt_rows_arr is not None:
+                        # the chosen node's own entry RIDES ALONG when
+                        # top_k surfaced it: on the auction path the
+                        # alt scores are end-state attributed while
+                        # "score" is the decision-round win — regret
+                        # must compare chosen vs alternatives on ONE
+                        # basis, so the offline consumer prefers the
+                        # chosen node's in-list score as its value
+                        alt = []
+                        for ar, asc in zip(alt_rows_arr[i],
+                                           alt_scores_arr[i]):
+                            if int(ar) < 0 or float(asc) <= ALT_NONE / 2:
+                                continue
+                            nm = self.mirror.name_of_row(int(ar))
+                            if nm:
+                                alt.append([nm, round(float(asc), 4)])
+                        rec["alt"] = alt
                 else:
                     rec["node"] = None
                 # the wire-trace stamps known at commit time (the

@@ -22,7 +22,9 @@ Flight-recorder surface:
   unschedulable diagnosis (which device filter rejected how many nodes,
   which host plugin rejected).
 - ``/debug/scorer`` — per-profile learned-scorer state (active
-  checkpoint version/fingerprint, reload and load-error counts).
+  checkpoint version/fingerprint, learn-loop generation + the regret
+  summaries stamped by the promotion gate, reload and load-error
+  counts).
 """
 
 from __future__ import annotations
@@ -124,7 +126,8 @@ class ServingEndpoints:
                     }, indent=2, default=str)
                 elif path == "/debug/scorer":
                     # learned-scorer state per profile: checkpoint
-                    # path/version/fingerprint, reload + load-error
+                    # path/version/fingerprint, learn-loop generation
+                    # + promoted-meta regret view, reload + load-error
                     # counts (plugins/learned.py manager stats)
                     payload = {}
                     for name, pcfg in getattr(sched, "_profile_cfg",

@@ -91,8 +91,12 @@ VIEW_PHASES = DRA_VIEW_PHASES + ("device_compile",)
 
 # trace-export JSON-lines format version (CycleTrace.to_dict "v"):
 # v2 added per-pod placement rows (pod, chosen node, aggregate score,
-# chosen-node learned-feature vector) — the replay-dataset substrate
-EXPORT_VERSION = 2
+# chosen-node learned-feature vector) — the replay-dataset substrate;
+# v3 adds the opt-in top-K alternative-node scores per placement
+# ("alt": [[node, score], ...], trace_export_alts) — the counterfactual
+# substrate behind per-placement regret (learn/regret.py). Additive:
+# v2 rows remain valid replay input (learn/replay.py reads >= 2).
+EXPORT_VERSION = 3
 
 # phases that are host-side Python work (the "host tail" the ROADMAP's
 # sub-10x offenders ask us to attribute); device_launch is device +
@@ -169,9 +173,10 @@ class CycleTrace:
         self.chained = chained
         self.phases: dict[str, float] = {}
         self.plugins: dict[str, float] = {}   # "plugin/point" -> secs
-        # per-pod placement rows (export v2): {"pod", "uid", "node",
-        # "score", "feat"} — node None for failed attempts. Populated by
-        # the scheduler only while the export file is open.
+        # per-pod placement rows (export v2+): {"pod", "uid", "node",
+        # "score"[, "feat"][, "alt"]} — node None for failed attempts,
+        # "alt" the v3 top-K alternative (node, score) pairs. Populated
+        # by the scheduler only while the export file is open.
         self.placements: list[dict] | None = None
 
     def add(self, phase: str, secs: float) -> None:

@@ -1,0 +1,546 @@
+"""The closed learning loop (ISSUE 14): export cursor tailing
+(torn lines, rotation, restart resume), per-placement regret, the
+replay-scoring promotion gate, the retrain daemon body (retrain →
+gate → promote / reject / rollback), version auto-bump, and the
+tier-1 one-cycle smoke: export → retrain → gate → promote →
+scheduler hot-reload.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.objects import (
+    Container,
+    LABEL_HOSTNAME,
+    Node,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    ResourceRequirements,
+)
+from kubernetes_tpu.config.types import Plugin, default_config
+from kubernetes_tpu.hub import Hub
+from kubernetes_tpu.learn import regret as RG
+from kubernetes_tpu.learn.checkpoint import (
+    load_checkpoint,
+    next_version,
+    save_checkpoint,
+)
+from kubernetes_tpu.learn.loop import (
+    ExportCursor,
+    LearnLoop,
+    LoopConfig,
+    WalTail,
+)
+from kubernetes_tpu.learn.replay import iter_placement_rows
+from kubernetes_tpu.ops.features import Capacities
+from kubernetes_tpu.ops.learned import NUM_FEATURES
+from kubernetes_tpu.scheduler import Scheduler
+
+pytestmark = pytest.mark.learn_loop
+
+
+def _line(t, placements, v=3):
+    return json.dumps({"v": v, "cycle": 1, "start": t, "pods": 1,
+                       "phases_ms": {}, "placements": placements})
+
+
+def _row(uid, node, score=100.0, alt=None, feat=None):
+    r = {"pod": f"default/{uid}", "uid": uid, "node": node,
+         "score": score}
+    if alt is not None:
+        r["alt"] = alt
+    if feat is not None:
+        r["feat"] = feat
+    return r
+
+
+def _write_lines(path, lines, mode="a"):
+    with open(path, mode) as f:
+        for ln in lines:
+            f.write(ln + "\n")
+
+
+def _feat(hot):
+    f = [0.0] * NUM_FEATURES
+    f[0 if hot else 1] = 1.0
+    return f
+
+
+def _linear_policy(idx, gain=100.0):
+    """((W, b),) scoring feature ``idx`` at ``gain`` — a handcrafted
+    deterministic policy for gate tests (no training involved)."""
+    w = np.zeros((NUM_FEATURES, 1), np.float32)
+    w[idx, 0] = gain
+    return ((w, np.zeros((1,), np.float32)),)
+
+
+# ------------------------------------------------------ export cursor
+
+
+def test_cursor_consumes_only_complete_lines(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    _write_lines(path, [_line(1.0, [_row("a", "n1")])])
+    with open(path, "a") as f:
+        f.write('{"v": 3, "torn')         # a live writer mid-line
+    cur = ExportCursor(path)
+    lines = cur.read_lines()
+    assert len(lines) == 1
+    # the torn tail is NOT consumed; completing it yields exactly it
+    with open(path, "a") as f:
+        f.write('...": 1}\n')
+    assert len(cur.read_lines()) == 1
+    assert cur.read_lines() == []
+
+
+def test_cursor_survives_rotation(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    _write_lines(path, [_line(float(i), [_row(f"u{i}", "n1")])
+                        for i in range(3)])
+    cur = ExportCursor(path)
+    assert len(cur.read_lines()) == 3
+    # two more lines land, then the keep-last-1 rotation happens before
+    # the next poll: the cursor must drain the rotated remainder AND
+    # the fresh file, no gaps, no duplicates
+    _write_lines(path, [_line(3.0, [_row("u3", "n1")])])
+    os.replace(path, path + ".1")
+    _write_lines(path, [_line(4.0, [_row("u4", "n1")])], mode="w")
+    lines = cur.read_lines()
+    uids = [r["uid"] for r in iter_placement_rows(
+        [json.loads(x) for x in lines])]
+    assert uids == ["u3", "u4"]
+    assert cur.missed_rotations == 0
+
+
+def test_cursor_absent_live_file_never_reconsumes_rotated(tmp_path):
+    """Daemon attached before the scheduler created the export (or
+    after a failed rotation disabled it): repeated polls over a lone
+    ``.1`` predecessor must consume it exactly once, not every poll."""
+    path = str(tmp_path / "t.jsonl")
+    _write_lines(path + ".1", [_line(float(i), [_row(f"u{i}", "n1")])
+                               for i in range(3)])
+    cur = ExportCursor(path)
+    assert len(cur.read_lines()) == 3
+    assert cur.read_lines() == []        # the duplicate-storm repro
+    assert cur.read_lines() == []
+    # the live file appearing later attaches cleanly from byte 0
+    _write_lines(path, [_line(9.0, [_row("u9", "n1")])])
+    assert len(cur.read_lines()) == 1
+    # and a restart restores BOTH cursors (live + predecessor)
+    cur2 = ExportCursor(path)
+    cur2.restore(cur.state())
+    assert cur2.read_lines() == []
+
+
+def test_cursor_restart_resumes_without_duplicates(tmp_path):
+    """The satellite: a daemon restart mid-tail restores its cursor
+    from the persisted state and never re-reads consumed rows."""
+    path = str(tmp_path / "t.jsonl")
+    _write_lines(path, [_line(float(i), [_row(f"u{i}", "n1")])
+                        for i in range(5)])
+    cur = ExportCursor(path)
+    assert len(cur.read_lines()) == 5
+    st = cur.state()
+    # "restart": a fresh cursor restored from the persisted state
+    cur2 = ExportCursor(path)
+    cur2.restore(st)
+    assert cur2.read_lines() == []
+    _write_lines(path, [_line(9.0, [_row("u9", "n1")])])
+    assert len(cur2.read_lines()) == 1
+
+
+def test_wal_tail_is_incremental_and_compaction_safe(tmp_path):
+    """The daemon body stays O(new WAL events): a poll with no growth
+    reads nothing, appended records merge in, and a compacted
+    (shrunken) WAL re-merges idempotently from byte 0."""
+    from kubernetes_tpu.utils.wire import to_wire
+
+    wal = str(tmp_path / "hub.wal")
+
+    def rec(uid):
+        p = Pod(metadata=ObjectMeta(name=uid, uid=uid),
+                spec=PodSpec(node_name="n1"))
+        return json.dumps({"kind": "pods", "type": "delete",
+                           "old": to_wire(p)})
+
+    _write_lines(wal, [rec("U1")])
+    t = WalTail(wal)
+    ev, _dom = t.outcomes()
+    assert ev == {"U1"}
+    off = t.offset
+    assert t.outcomes()[0] == {"U1"} and t.offset == off  # no re-read
+    _write_lines(wal, [rec("U2")])
+    assert t.outcomes()[0] == {"U1", "U2"} and t.offset > off
+    # compaction rewrote the WAL smaller: re-merge from 0, keep the
+    # union (apply_wal_record is idempotent)
+    _write_lines(wal, [rec("U3")], mode="w")
+    assert t.outcomes()[0] == {"U1", "U2", "U3"}
+
+
+def test_wal_tail_disables_loudly_on_binary_wal(tmp_path):
+    """A bin1 (fabric-default) WAL must disable outcome harvesting
+    with an error — not silently yield no labels while re-reading the
+    binary bytes every poll."""
+    wal = str(tmp_path / "hub.wal")
+    with open(wal, "wb") as f:
+        f.write(b"\x00\x12\x08binary-frame-no-newline")
+    t = WalTail(wal)
+    assert t.outcomes() == (set(), {})
+    assert t.disabled is True
+    # subsequent polls are O(1): no re-sniff churn, still empty
+    assert t.outcomes() == (set(), {})
+
+
+# ------------------------------------------------------------- regret
+
+
+def test_regret_zero_when_chosen_was_best_and_stuck():
+    rows = [dict(_row("a", "n1", score=90.0,
+                      alt=[["n2", 80.0], ["n3", 70.0]]), t=1.0)]
+    recs = RG.compute_regret(rows)
+    assert len(recs) == 1 and recs[0]["regret"] == 0.0
+
+
+def test_regret_positive_on_eviction_and_better_alternative():
+    rows = [dict(_row("a", "n1", score=90.0, alt=[["n2", 85.0]]), t=1.0),
+            dict(_row("b", "n1", score=60.0, alt=[["n2", 80.0]]), t=1.0)]
+    recs = RG.compute_regret(rows, evicted={"a"})
+    by = {r["uid"]: r for r in recs}
+    # a was evicted: its realized value collapses below the runner-up
+    assert by["a"]["regret"] == pytest.approx(85.0 - 90.0 * 0.25)
+    # b simply chose a worse node than its counterfactual
+    assert by["b"]["regret"] == pytest.approx(20.0)
+    s = RG.summarize_regret(recs)
+    assert s["count"] == 2 and s["regret_mean"] > 0
+    assert s["regret_p99"] >= s["regret_p50"]
+    # rows without alternatives carry no counterfactual: excluded
+    assert RG.summarize_regret(RG.compute_regret(
+        [dict(_row("c", "n1", score=10.0), t=1.0)]))["count"] == 0
+
+
+def _gate_rows():
+    """40 held-out rows: 10 'hot' placements (feature 0) that were
+    evicted AND landed in one crowded domain; 30 clean placements
+    (feature 1) spread over distinct domains."""
+    rows = []
+    node_domain = {}
+    evicted = set()
+    for i in range(10):
+        uid, node = f"bad{i}", f"h{i}"
+        rows.append(dict(_row(uid, node, score=50.0, feat=_feat(True)),
+                         t=float(i)))
+        node_domain[node] = "hot"
+        evicted.add(uid)
+    for i in range(30):
+        uid, node = f"ok{i}", f"c{i}"
+        rows.append(dict(_row(uid, node, score=50.0, feat=_feat(False)),
+                         t=float(10 + i)))
+        node_domain[node] = f"dom-{i}"
+    return rows, evicted, node_domain
+
+
+def test_gate_promotes_candidate_that_avoids_bad_outcomes():
+    rows, evicted, node_domain = _gate_rows()
+    bad = _linear_policy(0)      # prefers the evicted+crowded rows
+    good = _linear_policy(1)     # prefers the clean rows
+    verdict = RG.gate_candidate(good, bad, rows, evicted, node_domain)
+    assert verdict["promote"] is True
+    assert set(verdict["wins"]) >= {"preemptions", "spread"}
+    assert verdict["latency_ok"] is True
+    # and the mirror image is rejected with the same metrics as losses
+    verdict2 = RG.gate_candidate(bad, good, rows, evicted, node_domain)
+    assert verdict2["promote"] is False
+    assert set(verdict2["losses"]) >= {"preemptions", "spread"}
+
+
+def test_gate_time_to_bind_axis_uses_anchor_rows():
+    """Failed-attempt anchor rows (node None, no feat) establish
+    first_seen: with them present, a policy preferring the slow-bound
+    placements scores a worse weighted ttb p99 than one preferring the
+    fast ones — the axis must discriminate, not permanently tie at 0."""
+    rows = []
+    for i in range(8):       # slow pods: first attempt at t, bind at t+9
+        uid = f"slow{i}"
+        # anchor rows deliberately AFTER the bound row (run_once
+        # appends them to the holdout): _ttb_map must be
+        # order-independent for the axis to discriminate
+        rows.append(dict(_row(uid, f"s{i}", score=50.0,
+                              feat=_feat(True)), t=float(i) + 9.0))
+        rows.append({"uid": uid, "node": None, "t": float(i)})
+    for i in range(8):       # fast pods: bind on the first attempt
+        rows.append(dict(_row(f"fast{i}", f"f{i}", score=50.0,
+                              feat=_feat(False)), t=20.0 + i))
+    likes_slow = RG.replay_quality(_linear_policy(0), rows)
+    likes_fast = RG.replay_quality(_linear_policy(1), rows)
+    assert likes_slow["time_to_bind_p99_s"] \
+        > likes_fast["time_to_bind_p99_s"]
+
+
+def test_gate_bootstrap_promotes_without_live():
+    rows, evicted, node_domain = _gate_rows()
+    v = RG.gate_candidate(_linear_policy(1), None, rows, evicted,
+                          node_domain)
+    assert v["promote"] and v["bootstrap"]
+
+
+# ------------------------------------------------------- loop daemon
+
+
+def _loop_cfg(tmp_path, **kw):
+    kw.setdefault("trace_path", str(tmp_path / "traces.jsonl"))
+    kw.setdefault("staging_dir", str(tmp_path / "staging"))
+    kw.setdefault("live_path", str(tmp_path / "live.json"))
+    kw.setdefault("min_new_rows", 8)
+    kw.setdefault("min_holdout_rows", 2)
+    kw.setdefault("bc_epochs", 30)
+    kw.setdefault("ft_epochs", 10)
+    return LoopConfig(**kw)
+
+
+def _trainable_lines(n, start=0.0):
+    lines = []
+    for i in range(n):
+        hot = i % 2 == 0
+        lines.append(_line(start + i, [
+            _row(f"u{i}", f"n{i % 4}", score=50.0 + i,
+                 alt=[[f"n{(i + 1) % 4}", 45.0 + i]],
+                 feat=_feat(hot))]))
+    return lines
+
+
+def test_loop_waits_below_min_rows(tmp_path):
+    cfg = _loop_cfg(tmp_path)
+    _write_lines(cfg.trace_path, _trainable_lines(3))
+    loop = LearnLoop(cfg)
+    rep = loop.run_once()
+    assert rep["status"] == "waiting" and rep["new_trainable"] == 3
+    assert not os.path.exists(cfg.live_path)
+    # cursor state persisted even while waiting: a restarted daemon
+    # does not re-count the same rows (the satellite's no-duplicate
+    # guarantee covers the whole loop, not just the cursor class)
+    loop2 = LearnLoop(_loop_cfg(tmp_path))
+    rep2 = loop2.run_once()
+    assert rep2["new_rows"] == 0
+    # ...but the sub-threshold window SURVIVED the restart (row spool +
+    # persisted pending): one-shot `--once` invocations accumulate to
+    # the retrain threshold instead of dropping every small window
+    assert rep2["pending"] == 3 and rep2["buffer"] == 3
+    _write_lines(cfg.trace_path, _trainable_lines(21, start=50.0))
+    loop3 = LearnLoop(_loop_cfg(tmp_path))
+    rep3 = loop3.run_once()
+    assert rep3["pending"] == 24
+    assert rep3["status"] in ("promoted", "rejected")
+
+
+def test_loop_bootstrap_retrains_and_promotes(tmp_path):
+    cfg = _loop_cfg(tmp_path)
+    _write_lines(cfg.trace_path, _trainable_lines(24))
+    loop = LearnLoop(cfg)
+    rep = loop.run_once()
+    assert rep["status"] == "promoted", rep
+    assert rep["generation"] == 1 and rep["gate"]["bootstrap"]
+    params, meta = load_checkpoint(cfg.live_path)
+    assert meta["generation"] == 1 and meta["promoted"] is True
+    assert meta["version"] == rep["version"] == 1
+    assert "regret" in meta and "holdout_regret" in meta
+    assert loop.metrics.promotions.value() == 1.0
+    # the staged candidate survives next to the promoted copy
+    assert os.path.exists(os.path.join(cfg.staging_dir,
+                                       "scorer-g1.json"))
+    # second round with fresh rows: version strictly advances (the
+    # monotonic guarantee behind the checkpoint-version gauge)
+    _write_lines(cfg.trace_path, _trainable_lines(24, start=100.0))
+    rep2 = loop.run_once()
+    assert rep2["generation"] == 2
+    assert rep2["version"] == 2
+    assert rep2["status"] in ("promoted", "rejected")
+
+
+def test_loop_rejection_leaves_last_good_live(tmp_path, monkeypatch):
+    """The satellite: a regressing candidate generation must leave
+    last-good live and increment rejected_total."""
+    cfg = _loop_cfg(tmp_path)
+    _write_lines(cfg.trace_path, _trainable_lines(24))
+    loop = LearnLoop(cfg)
+    assert loop.run_once()["status"] == "promoted"
+    live_before = open(cfg.live_path).read()
+
+    # next generation regresses: force the gate's verdict (the gate
+    # logic itself is covered by the crafted-policy tests above)
+    def refuse(cand, live, rows, *a, **kw):
+        return {"promote": False, "bootstrap": False, "wins": [],
+                "losses": ["preemptions", "spread"], "latency_ok": True}
+
+    monkeypatch.setattr("kubernetes_tpu.learn.regret.gate_candidate",
+                        refuse)
+    _write_lines(cfg.trace_path, _trainable_lines(24, start=100.0))
+    rep = loop.run_once()
+    assert rep["status"] == "rejected"
+    assert loop.metrics.rejected.value() == 1.0
+    # the live checkpoint is byte-identical: the regressing candidate
+    # never reached the watcher's path
+    assert open(cfg.live_path).read() == live_before
+    # but the candidate WAS staged for inspection
+    assert os.path.exists(os.path.join(cfg.staging_dir,
+                                       "scorer-g2.json"))
+
+
+def test_loop_rolls_back_on_post_promotion_regret_regression(tmp_path):
+    """Generation 2 went live (displacing generation 1 into
+    last-good); the traffic it schedules regresses on regret — the
+    loop republishes last-good with a fresh version bump."""
+    cfg = _loop_cfg(tmp_path, min_rollback_rows=4)
+    loop = LearnLoop(cfg)
+    # the promoted world: gen 2 serving live, gen 1 preserved
+    save_checkpoint(os.path.join(cfg.staging_dir, "last-good.json"),
+                    _linear_policy(1), meta={"version": 1,
+                                             "generation": 1,
+                                             "promoted": True})
+    save_checkpoint(cfg.live_path, _linear_policy(0),
+                    meta={"version": 2, "generation": 2,
+                          "promoted": True})
+    loop.state["generation"] = 2
+    loop.state["version"] = 2
+    loop.state["promoted"] = {"generation": 2, "version": 2,
+                              "regret_mean": 0.0, "at": 0.0}
+    loop._save_state()
+    # traffic scheduled under generation 2 goes bad: every placement's
+    # counterfactual beats the chosen node by a mile
+    # ...at a LOW rate: each poll alone is under min_rollback_rows=4,
+    # but evidence accumulates across polls until the bar is met
+    _write_lines(cfg.trace_path, [_line(200.0 + i, [
+        _row(f"r{i}", "n1", score=10.0, alt=[["n2", 90.0]])])
+        for i in range(2)])
+    rep0 = loop.run_once()
+    assert "rollback" not in rep0       # 2 rows of evidence: not yet
+    _write_lines(cfg.trace_path, [_line(210.0 + i, [
+        _row(f"s{i}", "n1", score=10.0, alt=[["n2", 90.0]])])
+        for i in range(3)])
+    rep = loop.run_once()               # cumulative 5 >= 4: rolls back
+    assert "rollback" in rep, rep
+    assert loop.metrics.rollbacks.value() == 1.0
+    _, meta = load_checkpoint(cfg.live_path)
+    assert meta["rolled_back_from"] == 2
+    assert meta["generation"] == 1      # last-good is serving again
+    # republished with a FRESH version so the watcher's mtime/version
+    # view moves forward, never backwards
+    assert meta["version"] == 3
+    assert loop.state["promoted"] is None
+    # a restarted daemon (same state file) does not rollback again
+    loop2 = LearnLoop(_loop_cfg(tmp_path, min_rollback_rows=4))
+    assert loop2.state["promoted"] is None
+
+
+# ------------------------------------------- version auto-bump (CLI)
+
+
+def test_next_version_and_train_cli_autobump(tmp_path, capsys):
+    from kubernetes_tpu.learn.__main__ import main
+
+    out = str(tmp_path / "ck.json")
+    assert next_version(out) == 1
+    assert main(["train", "--synthetic", "64", "--out", out,
+                 "--bc-epochs", "20", "--ft-epochs", "5"]) == 0
+    v1 = json.loads(capsys.readouterr().out)["meta"]["version"]
+    assert v1 == 1
+    # the forgotten-flag case: retraining over an existing checkpoint
+    # continues its sequence instead of republishing version 1
+    assert main(["train", "--synthetic", "64", "--out", out,
+                 "--bc-epochs", "20", "--ft-epochs", "5"]) == 0
+    v2 = json.loads(capsys.readouterr().out)["meta"]["version"]
+    assert v2 == 2
+    assert next_version(out) == 3
+    # an explicit flag still wins (operator override)
+    assert main(["train", "--synthetic", "64", "--out", out,
+                 "--bc-epochs", "20", "--ft-epochs", "5",
+                 "--version", "9"]) == 0
+    assert json.loads(capsys.readouterr().out)["meta"]["version"] == 9
+
+
+# ----------------------------------------- tier-1 one-cycle smoke ---
+
+
+def _mknode(i):
+    return Node(metadata=ObjectMeta(name=f"node-{i}",
+                                    labels={LABEL_HOSTNAME: f"node-{i}"}),
+                status=NodeStatus(allocatable={"cpu": "8",
+                                               "memory": "16Gi",
+                                               "pods": "110"}))
+
+
+def _mkpod(name):
+    return Pod(metadata=ObjectMeta(name=name),
+               spec=PodSpec(containers=[Container(
+                   name="c", resources=ResourceRequirements(
+                       requests={"cpu": "100m"}))]))
+
+
+def test_one_cycle_closed_loop_smoke(tmp_path):
+    """The ROADMAP-4 proof at seconds scale: a collection run exports
+    v3 rows (features + alternatives), `learn loop --once` retrains
+    from the tail, the gate promotes the candidate into the live path,
+    and the RUNNING scheduler hot-reloads the promoted generation on
+    its next cycle."""
+    export = str(tmp_path / "traces.jsonl")
+    live = str(tmp_path / "live.json")
+    cfg = default_config()
+    cfg.batch_size = 16
+    cfg.trace_export_path = export
+    cfg.trace_export_features = True
+    cfg.trace_export_alts = True
+    prof = cfg.profiles[0]
+    prof.plugins.score.enabled.append(Plugin("LearnedScore", 1.0))
+    prof.plugin_config["LearnedScore"] = {"checkpoint_path": live}
+    hub = Hub()
+    sched = Scheduler(hub, cfg, caps=Capacities(nodes=16, pods=64))
+    try:
+        mgr = sched._profile_cfg["default-scheduler"]["learned"]
+        for i in range(4):
+            hub.create_node(_mknode(i))
+        for i in range(12):
+            hub.create_pod(_mkpod(f"p{i}"))
+        sched.run_until_idle()
+        assert mgr.params() is None      # nothing published yet
+        # the export carries v3 placement rows with feat + alt
+        rows = [r for ln in (json.loads(x) for x in open(export)
+                             if x.strip())
+                for r in ln.get("placements", [])]
+        placed = [r for r in rows if r["node"]]
+        assert placed and all("alt" in r and "feat" in r
+                              for r in placed)
+        # at least one COUNTERFACTUAL (non-chosen) candidate exists;
+        # the chosen node's own entry may ride along (it is the
+        # single-basis chosen value on the auction path)
+        assert any(any(nm != r["node"] for nm, _s in r["alt"])
+                   for r in placed)
+
+        # the daemon body: tail -> retrain -> gate -> promote
+        loop = LearnLoop(LoopConfig(
+            trace_path=export, staging_dir=str(tmp_path / "staging"),
+            live_path=live, min_new_rows=8, min_holdout_rows=2,
+            bc_epochs=30, ft_epochs=10))
+        rep = loop.run_once()
+        assert rep["status"] == "promoted", rep
+        assert os.path.exists(live)
+
+        # the running scheduler hot-reloads the promoted generation
+        os.utime(live, (2e9, 2e9))       # coarse-clock mtime nudge
+        for i in range(4):
+            hub.create_pod(_mkpod(f"q{i}"))
+        sched.run_until_idle()
+        assert mgr.params() is not None
+        assert mgr.version == rep["version"]
+        assert mgr.generation == rep["generation"] == 1
+        # /debug/scorer view: generation + the gate's regret summaries
+        st = mgr.stats()
+        assert st["generation"] == 1
+        assert st["promoted"] is True and "holdout_regret" in st
+        assert sched.metrics.learned_reloads.value(
+            profile="default-scheduler", generation="1") >= 0.0
+        assert sched.stats["device_fallbacks"] == 0
+    finally:
+        sched.close()

@@ -433,8 +433,10 @@ def test_smoke_train_checkpoint_hot_reload_schedule_loop(tmp_path):
         sched.run_until_idle()
         assert _bound_node(hub, "p1")
         assert mgr.version == 2 and mgr.reloads == 1
+        # a manual publish (no loop generation in meta) counts under
+        # generation "0" — the promoted-vs-manual fleet distinction
         assert sched.metrics.learned_reloads.value(
-            profile="default-scheduler") == 1.0
+            profile="default-scheduler", generation="0") == 1.0
     finally:
         sched.close()
 
@@ -473,7 +475,9 @@ def test_export_v2_placements_feed_the_dataset_builder(tmp_path):
     finally:
         sched.close()
     lines = [json.loads(x) for x in open(export) if x.strip()]
-    assert lines and all(ln["v"] == 2 for ln in lines)
+    # writer emits the current format; v2 rows remain valid replay input
+    from kubernetes_tpu.utils.tracing import EXPORT_VERSION
+    assert lines and all(ln["v"] == EXPORT_VERSION for ln in lines)
     rows = [r for ln in lines for r in ln.get("placements", [])]
     placed = [r for r in rows if r["node"]]
     assert len(placed) == 4
