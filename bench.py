@@ -37,7 +37,7 @@ BASELINE_PODS_PER_SEC = 270.0  # misc/performance-config.yaml:63
 
 # the committed artifact README.md's bench table is generated from; a
 # new measurement round commits a new artifact and re-points this
-README_BENCH_ARTIFACT = "BENCH_r12_builder.json"
+README_BENCH_ARTIFACT = "BENCH_r15_builder.json"
 _TABLE_BEGIN = "<!-- BENCH_TABLE_BEGIN"
 _TABLE_END = "<!-- BENCH_TABLE_END -->"
 
@@ -139,6 +139,10 @@ BENCH_WORKLOAD_FNS = (
 PROFILE_WORKLOAD_FNS = (
     "scheduling_daemonset",
     "mixed_churn",
+    "preferred_pod_anti_affinity",
+    "preferred_topology_spreading",
+    "ns_selector_preferred_affinity",
+    "ns_selector_preferred_anti_affinity",
     "dra_steady_state",
     "dra_steady_state_templates",
     "multi_tenant_gang_storm",

@@ -66,9 +66,13 @@ POD_FEATURE_FIELDS = {
     "images": ("image_ids",),
     "ports": ("hp_ip", "hp_proto", "hp_port"),
     "nodeaffinity": (
-        "nodesel_cols", "nodesel_vals", "sel_term_valid", "sel_col",
-        "sel_op", "sel_is_field", "sel_vals", "sel_num", "pref_weight",
-        "pref_col", "pref_op", "pref_is_field", "pref_vals", "pref_num"),
+        "aff_pin", "nodesel_cols", "nodesel_vals", "sel_term_valid",
+        "sel_col", "sel_op", "sel_is_field", "sel_vals", "sel_num",
+        "pref_weight", "pref_col", "pref_op", "pref_is_field", "pref_vals",
+        "pref_num"),
+    # pin-only batches (daemonset shape): ONE i32 per pod instead of the
+    # 14 selector/preferred arrays — the kernels compile to a [N] compare
+    "nodeaffinity_pin": ("aff_pin",),
 }
 # everything the topology kernels read (enable_topology launches)
 POD_TOPO_FIELDS = (
@@ -151,6 +155,12 @@ class LaunchSpec:
     # Scheduler after prepare_launch when the batch carries device-routed
     # claim pods; None compiles the DRA kernel out of the launch
     dra: object | None = None
+    # SOFT-ONLY topology launch: enable_topology is on but no batch pod
+    # carries a required (anti)affinity term or a DoNotSchedule spread
+    # constraint — soft terms are scores, not constraints, so the caller
+    # may run the parallel auction with the fused soft-score terms
+    # (pipeline._soft_statics) instead of the serial commit scan
+    topo_soft: bool = False
 
 
 class CapacityError(Exception):
@@ -167,6 +177,16 @@ class CapacityError(Exception):
 # phase-1 dedup group bucket for no-topology launches (prepare_launch):
 # FIXED so the static g_cap jit key never varies with batch composition
 P1_DEDUP_GROUP_CAP = 8
+
+# bucket hysteresis (ISSUE 15): the topology DOMAIN bucket (a static
+# jit arg) EXPANDS immediately on demand but only SHRINKS after this
+# many consecutive launches needed at most half of it — and the
+# high-water mark survives capacity re-buckets (adopt_hysteresis), so
+# an oscillating cluster size (churn recreating nodes around a growth
+# boundary) stops minting fresh compiled shapes every swing
+# (scheduler_device_compiles_total{cause=rebucket|topology_bucket}
+# stays flat across the oscillation).
+BUCKET_DECAY_LAUNCHES = 64
 
 
 class Mirror:
@@ -252,6 +272,11 @@ class Mirror:
         self._node_of_pod: dict[str, str] = {}   # uid -> node name
         self._free_slots: list[int] = list(range(caps.pods - 1, -1, -1))
         self._row_names: list[str | None] = [None] * caps.nodes
+        # domain-bucket hysteresis high-water mark + decay counter (see
+        # BUCKET_DECAY_LAUNCHES); survives re-bucketing via
+        # adopt_hysteresis so a fresh mirror doesn't re-learn it
+        self._d_hw = 0
+        self._d_low = 0
         # incremental device-mirror dirty tracking: per-row/slot sets feed a
         # scatter-update of the resident HBM buffers (the row-level analog of
         # the reference's generation-diffed UpdateSnapshot, cache.go:186);
@@ -950,6 +975,37 @@ class Mirror:
         convenience; the scheduling pipeline unpacks blobs inside its own jit."""
         return _unpack_cluster_jit(self.to_blobs(), self.caps)
 
+    def _hysteresis(self, hw_attr: str, low_attr: str, need: int) -> int:
+        """Sticky pow2 bucket: expand to ``need`` immediately; shrink by
+        ONE halving only after BUCKET_DECAY_LAUNCHES consecutive launches
+        whose demand fit in half the bucket. The compile-count analog of
+        TCP slow decrease — an oscillating demand signal settles on the
+        high-water program instead of recompiling every swing."""
+        hw = getattr(self, hw_attr)
+        if need >= hw:
+            setattr(self, hw_attr, need)
+            setattr(self, low_attr, 0)
+            return need
+        if need <= hw // 2:
+            low = getattr(self, low_attr) + 1
+            if low >= BUCKET_DECAY_LAUNCHES:
+                hw = max(need, hw // 2)
+                setattr(self, hw_attr, hw)
+                setattr(self, low_attr, 0)
+            else:
+                setattr(self, low_attr, low)
+        else:
+            setattr(self, low_attr, 0)
+        return hw
+
+    def adopt_hysteresis(self, prev: "Mirror") -> None:
+        """Carry the sticky domain-bucket high-water mark across a
+        capacity re-bucket (scheduler._grow builds a FRESH mirror):
+        without this a rebuilt mirror re-derives a smaller bucket from
+        its still-empty domain tables and the next churn swing pays the
+        compile again."""
+        self._d_hw = prev._d_hw
+
     def launch_d_cap(self, enable_topology: bool) -> int:
         """The static d_cap for one launch: the domain bucket when the
         launch runs topology kernels, else a CANONICAL 0 — a no-topology
@@ -957,7 +1013,11 @@ class Mirror:
         would make a scaled-down warmup (fewer nodes -> smaller bucket)
         compile a DIFFERENT program than the full-scale run, paying a
         fresh multi-second XLA compile on the first measured batch."""
-        return self.domain_bucket() if enable_topology else 0
+        if not enable_topology:
+            return 0
+        return min(self._hysteresis("_d_hw", "_d_low",
+                                    self.domain_bucket()),
+                   self.caps.domain_cap)
 
     def domain_bucket(self) -> int:
         """Static scatter-space size for the next launch: power-of-two over
@@ -987,6 +1047,26 @@ class Mirror:
         while d < need:
             d *= 2
         return tk, min(d, self.caps.domain_cap + 1)
+
+    @staticmethod
+    def batch_topology_soft_only(pods: list[Pod]) -> bool:
+        """True when no batch pod carries topology work that CONSTRAINS:
+        required (anti)affinity terms or DoNotSchedule spread. A soft-only
+        batch's topology terms are pure Score work, which the parallel
+        auction can fuse (preferred weights + ScheduleAnyway spread) — the
+        preferred-band workloads stop paying the serial commit scan."""
+        for p in pods:
+            a = p.spec.affinity
+            if a is not None:
+                pa, pan = a.pod_affinity, a.pod_anti_affinity
+                if pa is not None and pa.required:
+                    return False
+                if pan is not None and pan.required:
+                    return False
+            for t in p.spec.topology_spread_constraints:
+                if t.when_unsatisfiable == "DoNotSchedule":
+                    return False
+        return True
 
     @staticmethod
     def batch_has_topology(pods: list[Pod]) -> bool:
@@ -1084,7 +1164,16 @@ class Mirror:
         aff = pod.spec.affinity
         if (aff is not None and aff.node_affinity is not None) \
                 or not active_only:
-            self._pack_node_affinity(pod, out)
+            pin = self._node_affinity_pin(
+                aff.node_affinity if aff is not None else None)
+            if pin is not None and active_only:
+                # daemonset shape: the whole required clause is one
+                # metadata.name In [v] matchFields term — pack the pin id
+                # only; the selector/preferred arrays keep their template
+                # defaults (and a pin-only batch never transfers them)
+                out["aff_pin"] = np.int32(self._i(pin))
+            else:
+                self._pack_node_affinity(pod, out)
         if pod.spec.tolerations or not active_only:
             self._pack_tolerations(pod, out)
         if any(p.host_port > 0 for c in pod.spec.containers
@@ -1116,9 +1205,31 @@ class Mirror:
             self._pod_tmpl = (f32, i32)
         return self._pod_tmpl
 
+    @staticmethod
+    def _node_affinity_pin(na) -> str | None:
+        """The daemonset-controller pattern: required node affinity whose
+        ENTIRE clause is one term holding exactly one matchFields
+        metadata.name In [single value] expression, with no preferred
+        terms riding along. Returns the pinned node name (semantically a
+        NodeName pin under the NodeAffinity plugin), else None."""
+        if na is None or na.preferred or na.required is None:
+            return None
+        terms = na.required.node_selector_terms
+        if len(terms) != 1:
+            return None
+        t = terms[0]
+        if t.match_expressions or len(t.match_fields) != 1:
+            return None
+        f = t.match_fields[0]
+        if f.key != "metadata.name" or f.operator != "In" \
+                or len(f.values) != 1:
+            return None
+        return f.values[0]
+
     def _pack_node_affinity(self, pod: Pod, out: dict[str, np.ndarray]) -> None:
         caps = self.caps
         T, E, V = caps.sel_terms, caps.sel_exprs, caps.sel_vals
+        out["aff_pin"] = np.int32(NONE)
         out["sel_term_valid"] = np.zeros((T,), bool)
         out["sel_col"] = np.full((T, E), NONE, np.int32)
         out["sel_op"] = np.full((T, E), NONE, np.int32)
@@ -1471,11 +1582,23 @@ class Mirror:
         PreFilter-Skip, and the reason a constraint-free drain runs just the
         fit/utilization kernels."""
         feats = []
-        if any(pod.spec.node_selector
-               or (pod.spec.affinity is not None
-                   and pod.spec.affinity.node_affinity is not None)
-               for pod in pods):
+        full_aff = any_pin = False
+        for pod in pods:
+            aff = pod.spec.affinity
+            na = aff.node_affinity if aff is not None else None
+            if pod.spec.node_selector \
+                    or (na is not None
+                        and self._node_affinity_pin(na) is None):
+                full_aff = True
+                break
+            if na is not None:
+                any_pin = True
+        if full_aff:
             feats.append("nodeaffinity")
+        elif any_pin:
+            # every affinity in the batch is a metadata.name pin: compile
+            # only the [N] pin compare (the daemonset fast path)
+            feats.append("nodeaffinity_pin")
         if self._rows_with_taints:
             feats.append("taints")
         if self._rows_with_ports or self.batch_has_host_ports(pods):
@@ -1499,6 +1622,14 @@ class Mirror:
         gid = rep = None
         g_cap = 0
         if enable:
+            # NOTE: g_cap deliberately has NO sticky high-water. Compiled
+            # programs are cached per static key, so flapping between two
+            # SEEN g_cap values costs nothing; padding every launch to a
+            # past batch's group count would pay real per-launch compute
+            # (a 100-namespace init phase would tax the whole homogeneous
+            # measure phase at [G=128] statics). Hysteresis applies where
+            # it prevents NEW shapes: d_cap across mirror rebuilds
+            # (launch_d_cap / adopt_hysteresis).
             gid_np, rep_np, g_cap = self._batch_groups(
                 f32, i32, len(pods), pfields)
             gid = jnp.asarray(gid_np)
@@ -1526,4 +1657,6 @@ class Mirror:
                           d_cap=self.launch_d_cap(enable),
                           active=feats, pfields=pfields,
                           ptmpl=self.pod_template_blobs(),
-                          gid=gid, rep=rep, g_cap=g_cap)
+                          gid=gid, rep=rep, g_cap=g_cap,
+                          topo_soft=(enable and
+                                     self.batch_topology_soft_only(pods)))

@@ -127,6 +127,10 @@ class PriorityQueue:
         self._events: list[tuple[int, ClusterEvent, object, object]] = []
         self._next_seq = 0
         self._moved_cycle = 0
+        # event-burst coalescing window (ISSUE 15): non-None while a
+        # caller batches requeue reaction across a burst (an eviction
+        # flush's multi-delete wave) — see coalescing()
+        self._coalesce: Optional[list] = None
 
     # ------------- backoff (backoff_queue.go:248) -------------
 
@@ -379,6 +383,12 @@ class PriorityQueue:
             self._events.append((self._next_seq, event, old_obj, new_obj))
             self._next_seq += 1
         self._moved_cycle += 1
+        if self._coalesce is not None:
+            # inside a coalescing window: the in-flight log above already
+            # recorded the event; parked-pod reaction happens ONCE at
+            # window close instead of per event
+            self._coalesce.append((event, old_obj, new_obj))
+            return 0
         moved = 0
         # candidates via the inverted index: distinct registered events are
         # few (tens), parked pods can be tens of thousands — only pods
@@ -410,6 +420,63 @@ class PriorityQueue:
                 self._pop_parked(uid)
                 self._requeue(qp)
                 moved += 1
+        return moved
+
+    def coalescing(self):
+        """Context manager batching requeue reaction across an event
+        BURST (an eviction flush's multi-delete wave, ISSUE 15): inside
+        the window move_all_to_active_or_backoff only records events (the
+        in-flight replay log is unaffected); the window close runs one
+        pass where every parked candidate probes the whole burst at most
+        once — O(affected pods) per wave instead of O(events x parked
+        probes), and a gated pod re-runs its PreEnqueue gate once per
+        wave instead of once per deletion."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _window():
+            if self._coalesce is not None:
+                yield               # nested: the outer window owns it
+                return
+            self._coalesce = []
+            try:
+                yield
+            finally:
+                events, self._coalesce = self._coalesce, None
+                self._move_all_batched(events)
+        return _window()
+
+    def _move_all_batched(self, events: list) -> int:
+        if not events:
+            return 0
+        moved = 0
+        cands = set(self._park_all)
+        for (res, action), uids in self._park_index.items():
+            for event, _old, _new in events:
+                if ((res == R.WILDCARD or res == event.resource)
+                        and action & event.action_type):
+                    cands |= uids
+                    break
+        for uid in cands:
+            qp = self._gated.get(uid)
+            if qp is not None:
+                s = self._pre_enqueue(qp.pod)
+                if s.is_success():
+                    self._pop_parked(uid)
+                    qp.gated_plugin = ""
+                    qp.timestamp = self._now()
+                    self._enqueue(qp)
+                    moved += 1
+                continue
+            qp = self._unschedulable.get(uid)
+            if qp is None:
+                continue
+            for event, old_obj, new_obj in events:
+                if self._worth_requeuing(qp, event, old_obj, new_obj):
+                    self._pop_parked(uid)
+                    self._requeue(qp)
+                    moved += 1
+                    break
         return moved
 
     # ------------- periodic flushes (scheduling_queue.go:378-386) -------------

@@ -583,6 +583,23 @@ class ClusterClient:
                    lease_name: str = "kube-scheduler") -> None:
         self._invoke_uid("delete_pod", uid, epoch, lease_name)
 
+    def delete_pods(self, uids: list[str], epoch=None,
+                    lease_name: str = "kube-scheduler") -> list[str]:
+        """Batched eviction wave over the ring: each uid still routes to
+        its owning shard process (ring + probe, StaleRing-retried), so
+        the wave degrades to per-uid calls across shard boundaries —
+        explicit here because __getattr__'s meta-shard forward would
+        silently delete nothing. A NotFound victim is skipped (already
+        gone), matching the single-hub wave."""
+        gone: list[str] = []
+        for uid in uids:
+            try:
+                self._invoke_uid("delete_pod", uid, epoch, lease_name)
+                gone.append(uid)
+            except NotFound:
+                pass
+        return gone
+
     def get_pod(self, uid: str):
         for name in self.pod_shard_names():
             p = self._invoke(name, "get_pod", uid)

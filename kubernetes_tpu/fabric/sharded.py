@@ -226,6 +226,25 @@ class ShardedHub:
         # from the shard — same verdict the single hub gives
         s.delete_pod(uid, epoch, lease_name)
 
+    def delete_pods(self, uids: list[str], epoch: int | None = None,
+                    lease_name: str = "kube-scheduler") -> list[str]:
+        """Batched eviction wave, per owning shard: uids group by the
+        shard that holds them (probe like delete_pod), one wave per
+        shard. Must be explicit — __getattr__ would otherwise forward
+        the whole wave to the META shard, which holds no pods, and the
+        flush would strand every candidate."""
+        by_shard: dict[int, tuple] = {}
+        for uid in uids:
+            s = self._pod_shard_of_uid(uid)
+            if s is None:
+                continue            # already gone: skipped like the Hub
+            ent = by_shard.setdefault(id(s), (s, []))
+            ent[1].append(uid)
+        gone: list[str] = []
+        for s, batch in by_shard.values():
+            gone.extend(s.delete_pods(batch, epoch, lease_name))
+        return gone
+
     def get_pod(self, uid: str):
         for s in self._pod_shards:
             p = s.get_pod(uid)

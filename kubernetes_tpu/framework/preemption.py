@@ -29,7 +29,7 @@ from kubernetes_tpu.api.objects import (
     Pod,
     pod_group_key,
 )
-from kubernetes_tpu.hub import Fenced, Unavailable
+from kubernetes_tpu.hub import Fenced, StaleRing, Unavailable
 from kubernetes_tpu.framework.interface import (
     PostFilterPlugin,
     PreEnqueuePlugin,
@@ -587,6 +587,113 @@ class Evaluator:
 
     def _flush_candidates(self, work: list, stranded: list,
                           fargs: tuple = ()) -> None:
+        """One flush = plan, then ONE multi-delete wave (ISSUE 15).
+
+        Phase A walks the backlog host-side (nomination clears, gang
+        expansion, PDB/priority guards) into per-candidate victim plans;
+        phase B opens every planned preemptor's gate and commits ALL
+        victim deletions as one ``hub.delete_pods`` wave — a single lock
+        acquisition / RPC instead of one per victim; phase C strands any
+        candidate none of whose victims actually produced a deletion
+        event. Hubs without the batched verb (sharded facades, old
+        peers) keep the per-victim path with identical semantics."""
+        batched = getattr(self.hub, "delete_pods", None)
+        if not callable(batched):
+            return self._flush_candidates_serial(work, stranded, fargs)
+        listed: dict = {}
+
+        def _list_once():
+            if "pods" not in listed:
+                listed["pods"] = self.hub.list_pods()
+            return listed["pods"]
+
+        plans: list = []            # (pod, victims) per surviving candidate
+        for i, (candidate, pod) in enumerate(work):
+            try:
+                dropped = self.nominator.clear_for_node_below_priority(
+                    candidate.node_name, pod.priority())
+                for nominee in dropped:
+                    try:
+                        self.hub.clear_nominated_node(
+                            nominee.metadata.uid, *fargs)
+                    except Unavailable:
+                        self._pending_clears.append(nominee.metadata.uid)
+                victims, blocked = self._expand_gang_victims(
+                    candidate.victims, pod, _list_once)
+                if blocked:
+                    logger.info("gang eviction for %s blocked: %s",
+                                pod.key(), blocked)
+                    self.preempting.discard(pod.metadata.uid)
+                    stranded.append(pod)
+                    continue
+                plans.append((pod, victims))
+            except Unavailable:
+                # outage mid-planning: nothing is deleted yet — the
+                # whole backlog (already-planned candidates included)
+                # replays; every planning step is idempotent
+                planned = {p.metadata.uid for (p, _v) in plans}
+                self._pending = (
+                    [w for w in work if w[1].metadata.uid in planned]
+                    + work[i:] + self._pending)
+                raise
+        if not plans:
+            return
+        # phase B: gates open BEFORE any deletion event can fire (the
+        # batched form of preemption.go:528's ordering), then one wave
+        uids: list[str] = []
+        owner: dict[str, int] = {}  # victim uid -> first plan claiming it
+        for i, (pod, victims) in enumerate(plans):
+            self.preempting.discard(pod.metadata.uid)
+            for v in victims:
+                if v.metadata.uid not in owner:
+                    owner[v.metadata.uid] = i
+                    uids.append(v.metadata.uid)
+        try:
+            gone = set(batched(uids, *fargs)) if uids else set()
+        except Unavailable:
+            # the wave's verdict is unknown: re-gate + requeue every
+            # planned candidate; a replayed wave skips already-gone
+            # victims, so replay is idempotent
+            for pod, _v in plans:
+                self.preempting.add(pod.metadata.uid)
+            self._pending = ([w for w in work
+                              if w[1].metadata.uid in
+                              {p.metadata.uid for (p, _v) in plans}]
+                             + self._pending)
+            raise
+        except StaleRing:
+            # a ring slot froze mid-wave (segment export in flight):
+            # partially-committed deletes already dispatched their
+            # events; re-gate + requeue like the Unavailable case —
+            # replay is idempotent — but swallow: the freeze heals on
+            # its own (import / abort / FROZEN_TTL), no outage to note
+            for pod, _v in plans:
+                self.preempting.add(pod.metadata.uid)
+            self._pending = ([w for w in work
+                              if w[1].metadata.uid in
+                              {p.metadata.uid for (p, _v) in plans}]
+                             + self._pending)
+            return
+        except Fenced:
+            self._note_fenced("delete_pod")
+            for pod, _v in plans:
+                stranded.append(pod)
+            self._pending = []
+            return
+        for i, (pod, victims) in enumerate(plans):
+            # a plan is "fired" only by a deletion it OWNS (first claim in
+            # plan order — the serial path's exact discipline): a candidate
+            # whose victims were all claimed by overlapping earlier plans
+            # produces no deletion event of its own, so its preemptor must
+            # be activated explicitly or two preemptors nominating the
+            # same node deadlock in escalating backoff behind each other's
+            # reservations
+            if not any(v.metadata.uid in gone
+                       and owner[v.metadata.uid] == i for v in victims):
+                stranded.append(pod)
+
+    def _flush_candidates_serial(self, work: list, stranded: list,
+                                 fargs: tuple = ()) -> None:
         # one cluster pod list per FLUSH, fetched lazily on the first
         # gang victim and shared by every candidate — per-candidate
         # list_pods() would pay a full-cluster RPC for each gang
@@ -1230,6 +1337,100 @@ class Evaluator:
         return immediate
 
     # ---------------- the whole PostFilter flow ----------------
+
+    def host_preempt(self, pod: Pod, snapshot) -> tuple[str | None, Status]:
+        """Rung-bottom SERIAL preemption (ISSUE 15): pure host-side
+        candidate selection + the queued eviction path, for the fallback
+        ladder's bottom rung — a fully device-dead scheduler used to PARK
+        preemptors (the device sweep was the only candidate source), so
+        it could never free capacity. Covers the static-predicate +
+        resource-fit subset over the snapshot; topology preemptors stay
+        parked for the device retry (the host path cannot evaluate their
+        terms). Victim ordering and candidate selection reuse the
+        evaluator's exact keys (_victim_sort_key, candidate_key), so
+        where both paths apply they pick the same node."""
+        from kubernetes_tpu.api.labels import (
+            find_untolerated_taint,
+            pod_matches_node_selector_and_affinity,
+        )
+        from kubernetes_tpu.api.resources import pod_request
+
+        self.cache_snapshot = snapshot.node_info_map
+        ok, why = self.pod_eligible_to_preempt_others(pod)
+        if not ok:
+            return None, Status.unschedulable(
+                f"not eligible for preemption: {why}",
+                plugin="DefaultPreemption")
+        req = pod_request(pod)
+        prio = pod.priority()
+        pdbs = self.hub.list_pdbs()
+        candidates: list[Candidate] = []
+        for ni in snapshot.node_info_list:
+            node = ni.node
+            if node is None or node.spec.unschedulable:
+                continue
+            if not pod_matches_node_selector_and_affinity(pod, node):
+                continue
+            if find_untolerated_taint(node.spec.taints,
+                                      pod.spec.tolerations) is not None:
+                continue
+            lower = sorted((pi for pi in ni.pods
+                            if pi.pod.priority() < prio),
+                           key=self._victim_sort_key)
+            if not lower:
+                continue
+            alloc = ni.allocatable
+            free_cpu = alloc.milli_cpu - ni.requested.milli_cpu
+            free_mem = alloc.memory - ni.requested.memory
+            free_eph = (alloc.ephemeral_storage
+                        - ni.requested.ephemeral_storage)
+            free_scalar = {k: alloc.scalar.get(k, 0)
+                           - ni.requested.scalar.get(k, 0)
+                           for k in set(alloc.scalar)
+                           | set(ni.requested.scalar)
+                           | set(req.scalar)}
+            victims: list[Pod] = []
+
+            def _fits() -> bool:
+                if (alloc.allowed_pod_number > 0
+                        and len(ni.pods) - len(victims) + 1
+                        > alloc.allowed_pod_number):
+                    return False
+                return (req.milli_cpu <= free_cpu
+                        and req.memory <= free_mem
+                        and req.ephemeral_storage <= free_eph
+                        and all(v <= free_scalar.get(k, 0)
+                                for k, v in req.scalar.items()))
+
+            # minimal prefix, least-important victims first (the resource
+            # fixed point of remove-all-then-reprieve)
+            for pi in lower:
+                if _fits():
+                    break
+                victims.append(pi.pod)
+                free_cpu += pi.request.milli_cpu
+                free_mem += pi.request.memory
+                free_eph += pi.request.ephemeral_storage
+                for k, v in pi.request.scalar.items():
+                    free_scalar[k] = free_scalar.get(k, 0) + v
+            if not _fits():
+                continue
+            if not victims:
+                continue        # fits with no eviction: not a preemption
+            candidates.append(Candidate(
+                node_name=ni.name, row=-1, victims=victims,
+                pdb_violations=self._pdb_violations(victims, pdbs)))
+        best = self.select_candidate(candidates)
+        if best is None:
+            return None, Status.unschedulable(
+                "no preemption candidates (host mini-path)",
+                plugin="DefaultPreemption")
+        if self.metrics is not None:
+            self.metrics.preemption_attempts.inc()
+            self.metrics.preemption_victims.observe(len(best.victims))
+        self.prepare_candidate(best, pod)
+        self.nominator.add(pod, best.node_name)
+        return best.node_name, Status()
 
     def preempt(self, pod: Pod, snapshot,
                 reject_counts=None,

@@ -717,6 +717,35 @@ class Hub:
             ev = self._delete_locked(self._pods, uid)
         self._dispatch(self._pods, ev)
 
+    def delete_pods(self, uids: list[str], epoch: int | None = None,
+                    lease_name: str = "kube-scheduler") -> list[str]:
+        """Batched eviction wave (ISSUE 15): fence-checked ONCE, every
+        delete committed under one lock acquisition, events dispatched in
+        commit order afterwards — the multi-delete analog of delete_pod
+        for preemption flushes that used to dribble one RPC per victim.
+        Already-gone uids are skipped (evictions tolerate them — and that
+        makes a retried wave idempotent); returns the uids actually
+        deleted, so the caller can tell which candidates produced a
+        deletion event."""
+        evs = []
+        done: list[str] = []
+        try:
+            with self._lock:
+                self._check_fence("delete_pod", epoch, lease_name)
+                for uid in uids:
+                    stored = self._pods.objects.get(uid)
+                    if stored is None:
+                        continue
+                    self._guard_pod_write(stored.metadata.namespace)
+                    evs.append(self._delete_locked(self._pods, uid))
+                    done.append(uid)
+        finally:
+            # a StaleRing raised mid-wave must not strand already-
+            # committed deletes undispatched
+            for ev in evs:
+                self._dispatch(self._pods, ev)
+        return done
+
     def get_pod(self, uid: str) -> Optional[Pod]:
         with self._lock:
             return self._pods.objects.get(uid)

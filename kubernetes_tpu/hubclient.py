@@ -93,6 +93,9 @@ _ERRORS = {"Conflict": Conflict, "NotFound": NotFound, "Fenced": Fenced,
 IDEMPOTENT_METHODS = frozenset(
     m for m in CALL_METHODS
     if m.split(".")[-1].startswith(("get", "list"))) | frozenset({
+        # a retried eviction wave skips already-gone victims, so replay
+        # after an ambiguous transport failure is safe
+        "delete_pods",
         "rv.next", "rv.advance_to", "rv.last", "leases.epoch_of",
         "fabric_register_shard", "fabric_register_relay",
         "fabric_register_router", "fabric_topology", "fabric_shards",

@@ -62,7 +62,8 @@ from kubernetes_tpu.utils.wire import from_wire, to_wire
 # controllers use; watch_* goes over /watch instead)
 CALL_METHODS = frozenset({
     "create_node", "update_node", "delete_node", "get_node", "list_nodes",
-    "create_pod", "update_pod", "delete_pod", "get_pod", "list_pods",
+    "create_pod", "update_pod", "delete_pod", "delete_pods", "get_pod",
+    "list_pods",
     "bind", "patch_pod_condition", "clear_nominated_node",
     "create_namespace", "update_namespace", "delete_namespace",
     "list_namespaces",

@@ -225,6 +225,11 @@ class BatchResult:
 # eliminates the whole kernel. The device analog of PreFilter returning
 # Skip for a pod that doesn't use the plugin (framework/interface.go:518).
 ALL_FEATURES = ("nodeaffinity", "taints", "ports", "images")
+# "nodeaffinity_pin" is the cheap sibling of "nodeaffinity": every
+# affinity-bearing pod in the batch reduced to a matchFields
+# metadata.name In [v] pin (the daemonset-controller shape), so only the
+# [N] pin compare compiles — never the [N, T, E, V] selector kernels or
+# the preferred-term scorer (pins carry no preferred terms).
 
 
 def _guard_reduction(scores: jnp.ndarray, free: jnp.ndarray) -> jnp.ndarray:
@@ -249,8 +254,9 @@ def static_filters(ct: ClusterTensors, pod: PodFeatures,
         lambda: FL.node_name(ct, pod),
         lambda: (FL.taint_toleration(ct, pod)
                  if "taints" in active else None),
-        lambda: (FL.node_affinity(ct, pod)
-                 if "nodeaffinity" in active else None),
+        lambda: (FL.node_affinity(ct, pod, full="nodeaffinity" in active)
+                 if ("nodeaffinity" in active
+                     or "nodeaffinity_pin" in active) else None),
         lambda: (FL.node_ports(ct, pod, wk["wildcard_ip"])
                  if "ports" in active else None),
     )
@@ -283,11 +289,229 @@ def tie_perturb(b, n: int, seed=None) -> jnp.ndarray:
     return (x >> 8).astype(jnp.float32) / jnp.float32(1 << 24)
 
 
+@dataclass
+class _SoftTopo:
+    """Everything the auction needs to score SOFT topology terms (preferred
+    pod (anti)affinity + ScheduleAnyway spread) without the serial scan.
+
+    Soft terms never change FEASIBILITY, so a batch whose only topology
+    work is soft keeps the auction's round structure: the static (table)
+    part of each score is per-GROUP phase-1 work, and the in-batch part is
+    recomputed per round from the placed set with dense domain
+    scatters/gathers — "the same gathers with a weight multiply" as the
+    hard-constraint machinery, fused into the same launch."""
+
+    gid: jax.Array          # [B] group id per pod
+    ipa_ok_g: jax.Array     # [G, N] static InterPodAffinity mask (the
+                            # table's required anti-affinity vs each group;
+                            # all-True when the ipa filter is disabled)
+    ipa_raw_g: jax.Array    # [G, N] static ipa score (table terms both
+                            # directions incl. hardPodAffinityWeight)
+    match_static_g: jax.Array  # [G, N, C] static soft-spread match counts
+    tpw_g: jax.Array        # [G, C] topology normalizing weight log(size+2)
+    used_soft_g: jax.Array  # [G, C] soft (ScheduleAnyway) constraint slots
+    dom_ok_g: jax.Array     # [G, N, C] node carries the constraint's key
+    ign_g: jax.Array        # [G, N] node ignored for spread scoring
+    has_soft_g: jax.Array   # [G] any soft constraint
+    skew_g: jax.Array       # [G, C] maxSkew per constraint
+    el_node_g: jax.Array    # [G, N, C] in-batch eligibility of a node as a
+                            # commit target for the group's constraints
+    # per-own-term domain columns: node n's domain under term (g, a)'s key
+    nd_paff: jax.Array      # [N, G, A] i32 (NONE = key absent)
+    nd_panti: jax.Array     # [N, G, A]
+    nd_tsc: jax.Array       # [N, G, C]
+    paff_tk_g: jax.Array    # [G, A]
+    panti_tk_g: jax.Array   # [G, A]
+    tsc_tk_g: jax.Array     # [G, C]
+    paff_w_g: jax.Array     # [G, A] f32
+    panti_w_g: jax.Array    # [G, A] f32
+    M_paff_gg: jax.Array    # [G, A, G] pairwise group term matches
+    M_panti_gg: jax.Array   # [G, A, G]
+    M_tsc_gg: jax.Array     # [G, C, G]
+    topo_dom: jax.Array     # [N, TK]
+    d_cap: int = 0
+
+
+def _soft_statics(ct, pods, pods_rep, gid, g_cap, d_cap, tds, wk,
+                  enabled_filters, act, ipa_on, chunked_vmap):
+    """Per-GROUP static halves of the soft topology scores (the auction's
+    phase-1b): the table's contribution to each group's ipa mask/score and
+    soft-spread counts — placement-independent, computed once per launch."""
+    valid = ct.node_valid
+
+    def per_group_soft(pod: PodFeatures):
+        masks = static_filters(ct, pod, wk, enabled_filters, act)
+        g_static_ok = jnp.all(masks, axis=0) & valid & pod.valid
+        taint_ok, nodeaff_ok = masks[2], masks[3]
+        used_c = pod.tsc_tk != jnp.int32(-1)
+        used_soft = used_c & ~pod.tsc_hard
+        el_soft = T.spread_eligible(ct, pod, nodeaff_ok, taint_ok,
+                                    used_soft)
+        cnt = T.spread_cnt(ct, pod, tds, el_soft, d_cap)         # [C, D]
+        node_dom = T.take_cols(ct.topo_dom, pod.tsc_tk, jnp.int32(-1))
+        ign = jnp.any((node_dom == jnp.int32(-1))
+                      & used_soft[None], axis=1)                 # [N]
+        exists_score = T.spread_exists(
+            ct, pod, (g_static_ok & ~ign)[:, None] & used_soft[None],
+            d_cap)
+        tpw = jnp.log(jnp.sum(exists_score, axis=1)
+                      .astype(jnp.float32) + 2.0)                # [C]
+        match_static = T.gather_rows(cnt, node_dom)              # [N, C]
+        # in-batch commit-target eligibility (policies + key presence);
+        # soft-only batches have no hard constraints to honor
+        pol = (jnp.where(pod.tsc_honor_affinity[None],
+                         (nodeaff_ok & valid)[:, None], True)
+               & jnp.where(pod.tsc_honor_taints[None],
+                           (taint_ok & valid)[:, None], True))   # [N, C]
+        dom_ok = node_dom != jnp.int32(-1)                       # [N, C]
+        all_s = jnp.all(dom_ok | ~used_soft[None], axis=1)       # [N]
+        el_node = pol & all_s[:, None] & dom_ok & used_soft[None]
+        anti_ok, _pres, _any = T.inter_pod_affinity_static(
+            ct, pod, tds, d_cap)
+        ipa_raw = T.inter_pod_affinity_score(
+            ct, pod, tds, d_cap, jnp.float32(HARD_POD_AFFINITY_WEIGHT))
+        return (anti_ok, ipa_raw, match_static, tpw, used_soft,
+                dom_ok, ign, jnp.any(used_soft), el_node)
+
+    (anti_g, ipa_raw_g, match_g, tpw_g, soft_g, dom_ok_g, ign_g,
+     has_soft_g, el_node_g) = chunked_vmap(per_group_soft, pods_rep, g_cap)
+    if not ipa_on:
+        anti_g = jnp.ones_like(anti_g)
+    tk_cap = ct.topo_dom.shape[1]
+
+    def nd_of(tk_g):
+        # [N, G, A]: node n's domain under term (g, a)'s topology key
+        nd = ct.topo_dom[:, jnp.clip(tk_g, 0, tk_cap - 1)]
+        return jnp.where(tk_g[None] != NONE, nd, NONE)
+
+    M_paff_gg = T.pair_term_match(
+        pods_rep.paff_tk, pods_rep.paff_ns, pods_rep.paff_ns_all,
+        pods_rep.paff_sel_cols, pods_rep.paff_sel_ops,
+        pods_rep.paff_sel_vals, pods_rep.plabel_vals, pods_rep.ns,
+        pods_rep.valid)
+    M_panti_gg = T.pair_term_match(
+        pods_rep.panti_tk, pods_rep.panti_ns, pods_rep.panti_ns_all,
+        pods_rep.panti_sel_cols, pods_rep.panti_sel_ops,
+        pods_rep.panti_sel_vals, pods_rep.plabel_vals, pods_rep.ns,
+        pods_rep.valid)
+    M_tsc_gg = T.pair_tsc_match(pods_rep)
+    return _SoftTopo(
+        gid=gid, ipa_ok_g=anti_g, ipa_raw_g=ipa_raw_g,
+        match_static_g=match_g, tpw_g=tpw_g, used_soft_g=soft_g,
+        dom_ok_g=dom_ok_g, ign_g=ign_g, has_soft_g=has_soft_g,
+        skew_g=pods_rep.tsc_max_skew.astype(jnp.float32),
+        el_node_g=el_node_g,
+        nd_paff=nd_of(pods_rep.paff_tk), nd_panti=nd_of(pods_rep.panti_tk),
+        nd_tsc=nd_of(pods_rep.tsc_tk),
+        paff_tk_g=pods_rep.paff_tk, panti_tk_g=pods_rep.panti_tk,
+        tsc_tk_g=pods_rep.tsc_tk,
+        paff_w_g=pods_rep.paff_weight.astype(jnp.float32),
+        panti_w_g=pods_rep.panti_weight.astype(jnp.float32),
+        M_paff_gg=M_paff_gg, M_panti_gg=M_panti_gg, M_tsc_gg=M_tsc_gg,
+        topo_dom=ct.topo_dom, d_cap=d_cap)
+
+
+def _soft_scores(soft: _SoftTopo, placed, gid_oh):
+    """[G, N] live soft scores (static + in-batch halves) for the current
+    placed set: the auction-round analog of the scan's map_updates +
+    queries, recomputed from scratch each round via domain scatter/gather
+    (placed sets are small and rounds are few — no carry maps needed)."""
+    d_cap = soft.d_cap
+    n_cap = soft.topo_dom.shape[0]
+    ok = placed >= 0                                             # [B]
+    r = jnp.clip(placed, 0, n_cap - 1)
+    dom_rows = jnp.where(ok[:, None], soft.topo_dom[r], NONE)    # [B, TK]
+    tk_cap = soft.topo_dom.shape[1]
+
+    def committed_dom(tk_g):
+        # [B, G, A]: committed pod y's domain under term (g, a)'s key
+        dy = dom_rows[:, jnp.clip(tk_g, 0, tk_cap - 1)]
+        return jnp.where(tk_g[None] != NONE, dy, NONE)
+
+    def pair_delta(tk_g, nd, M_gg, w_g):
+        """[G, N] weighted same-domain score mass from placed pods, both
+        directions of the preferred terms (scoring.go processExistingPod's
+        incoming-vs-existing and existing-vs-incoming soft halves).
+
+        Domain ids are validity-checked against d_cap: the padding group's
+        zeroed term rows reference arbitrary topology keys whose domain
+        space can exceed the launch's bucket, and an out-of-range gather
+        index fills NaN — which a zero weight does NOT neutralize."""
+        G, A = tk_g.shape
+        dy = committed_dom(tk_g)                                 # [B, G, A]
+        dy_t = jnp.moveaxis(dy, 0, -1)                           # [G, A, B]
+        dv = (dy_t >= 0) & (dy_t < d_cap) & ok[None, None, :]
+        flat = (jnp.arange(G)[:, None, None] * (A * d_cap)
+                + jnp.arange(A)[None, :, None] * d_cap
+                + jnp.clip(dy_t, 0, d_cap - 1))
+        # b-side: x's own term a matches committed pod y
+        Mg = M_gg[:, :, :] @ gid_oh.T                            # [G, A, B]
+        P_b = jnp.zeros((G * A * d_cap,), jnp.float32).at[
+            flat.reshape(-1)].add(
+                jnp.where(dv, Mg, 0.0).reshape(-1))
+        P_b = P_b.reshape(G, A, d_cap)
+        # j-side: committed pod y's own term a matches group g2
+        own = jnp.moveaxis(gid_oh, 0, -1)                        # [G, B]
+        P_j = jnp.zeros((G * A * d_cap,), jnp.float32).at[
+            flat.reshape(-1)].add(
+                jnp.where(dv, own[:, None, :], 0.0).reshape(-1))
+        P_j = P_j.reshape(G, A, d_cap)
+        nd_g = jnp.moveaxis(nd, 0, -1)                           # [G, A, N]
+        nd_ok = (nd_g >= 0) & (nd_g < d_cap)
+        idx = jnp.clip(nd_g, 0, d_cap - 1)
+        gath_b = jnp.take_along_axis(P_b, idx.reshape(G, A, -1),
+                                     axis=2).reshape(nd_g.shape)
+        gath_j = jnp.take_along_axis(P_j, idx.reshape(G, A, -1),
+                                     axis=2).reshape(nd_g.shape)
+        delta_b = jnp.sum(jnp.where(nd_ok, gath_b, 0.0)
+                          * w_g[:, :, None], axis=1)             # [G, N]
+        delta_j = jnp.einsum("gah,gan->hn", soft_mul(M_gg, w_g),
+                             jnp.where(nd_ok, gath_j, 0.0))
+        return delta_b + delta_j
+
+    def soft_mul(M_gg, w_g):
+        return M_gg.astype(jnp.float32) * w_g[:, :, None]
+
+    ipa_delta = (pair_delta(soft.paff_tk_g, soft.nd_paff,
+                            soft.M_paff_gg.astype(jnp.float32),
+                            soft.paff_w_g)
+                 - pair_delta(soft.panti_tk_g, soft.nd_panti,
+                              soft.M_panti_gg.astype(jnp.float32),
+                              soft.panti_w_g))
+    ipa_live = soft.ipa_raw_g + ipa_delta                        # [G, N]
+
+    # soft spread: in-batch match-count deltas per (group, constraint)
+    G, C = soft.tsc_tk_g.shape
+    dy = committed_dom(soft.tsc_tk_g)                            # [B, G, C]
+    dy_t = jnp.moveaxis(dy, 0, -1)                               # [G, C, B]
+    el_y = jnp.moveaxis(soft.el_node_g[:, r, :], 1, -1)          # [G, C, B]
+    Mg = soft.M_tsc_gg.astype(jnp.float32) @ gid_oh.T            # [G, C, B]
+    val = jnp.where((dy_t >= 0) & (dy_t < d_cap) & ok[None, None, :],
+                    Mg * el_y.astype(jnp.float32), 0.0)
+    flat = (jnp.arange(G)[:, None, None] * (C * d_cap)
+            + jnp.arange(C)[None, :, None] * d_cap
+            + jnp.clip(dy_t, 0, d_cap - 1))
+    P_t = jnp.zeros((G * C * d_cap,), jnp.float32).at[
+        flat.reshape(-1)].add(val.reshape(-1)).reshape(G, C, d_cap)
+    nd_t = jnp.moveaxis(soft.nd_tsc, 0, -1)                      # [G, C, N]
+    gath_t = jnp.take_along_axis(
+        P_t, jnp.clip(nd_t, 0, d_cap - 1).reshape(G, C, -1),
+        axis=2).reshape(nd_t.shape)
+    match = (jnp.moveaxis(soft.match_static_g, 1, -1)
+             + jnp.where((nd_t >= 0) & (nd_t < d_cap), gath_t, 0.0))
+    per_c = match * soft.tpw_g[:, :, None] \
+        + (soft.skew_g[:, :, None] - 1.0)
+    per_c = jnp.where(soft.used_soft_g[:, :, None]
+                      & jnp.moveaxis(soft.dom_ok_g, 1, -1), per_c, 0.0)
+    sp_r = jnp.where(soft.ign_g, 0.0, jnp.sum(per_c, axis=1))    # [G, N]
+    return ipa_live, sp_r
+
+
 def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
                    img, unres, weights, free0, nzr0, host_score=None,
                    fit_strategy="LeastAllocated", fit_shape=None,
                    dra_reject=None, learned=None, tie_seed=None,
-                   with_feats=False, with_alts=False):
+                   with_feats=False, with_alts=False, soft=None):
     """Parallel auction replacing the per-pod commit scan when the batch has
     no topology constraints and no host ports: every round, all unplaced
     pods score+argmax in parallel; per node, up to K pods are accepted in
@@ -316,6 +540,19 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
     own = jnp.arange(N)[None, :] == pods.nominated_row[:, None]    # [B, N]
     perturb = jax.vmap(lambda u: tie_perturb(u, N, tie_seed))(pods.uid_id)
     idx_b = jnp.arange(B)
+    # soft-topology mode: the static ipa mask (the table's required
+    # anti-affinity vs each group) joins the feasible set; the soft score
+    # halves join the round totals below. Soft terms never constrain, so
+    # the auction's round structure is unchanged.
+    if soft is not None:
+        ipa_mask = soft.ipa_ok_g[soft.gid]                         # [B, N]
+        gid_oh = (soft.gid[:, None]
+                  == jnp.arange(soft.ipa_ok_g.shape[0])[None, :]
+                  ).astype(jnp.float32) * pods.valid[:, None]      # [B, G]
+        ign_b = soft.ign_g[soft.gid]                               # [B, N]
+        soft_b = soft.has_soft_g[soft.gid]                         # [B]
+    else:
+        ipa_mask = None
     # STATIC gate for the K-accept rounds: only a batch that outnumbers
     # the node bucket can need K > 1, and the cumulative-fit cumsums are
     # [B, N]-sized work the big-cluster shapes must not pay — at B <= N
@@ -348,8 +585,8 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
         aff = SC.normalize_max(a_raw, feas)
         return frac, least, bal, taint, aff
 
-    def totals(nzr, feasible):
-        def per_pod(nzreq, t_raw, a_raw, im, feas):
+    def totals(nzr, feasible, sp_b=None, ipa_b=None):
+        def per_pod(nzreq, t_raw, a_raw, im, feas, *topo):
             frac, least, bal, taint, aff = per_pod_scores(
                 nzr, nzreq, t_raw, a_raw, feas)
             total = (weights.taint_toleration * taint
@@ -357,12 +594,26 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
                      + weights.resources_fit * least
                      + weights.balanced_allocation * bal
                      + weights.image_locality * im)
+            sp_n = ipa_n = None
+            if topo:
+                # soft-topology mode: normalize + weight the live soft
+                # halves per pod, exactly like the serial scan's step
+                sp_row, ipa_row, ign_row, softp = topo
+                ipa_n = SC.normalize_maxmin(ipa_row, feas)
+                sp_n = jnp.where(softp,
+                                 SC.normalize_spread(sp_row, feas,
+                                                     ign_row), 0.0)
+                total = (total + weights.pod_topology_spread * sp_n
+                         + weights.inter_pod_affinity * ipa_n)
             if learned is not None:
                 total = total + weights.learned * LN.learned_term(
-                    learned, frac, least, bal, taint, aff, im)
+                    learned, frac, least, bal, taint, aff, im, sp_n,
+                    ipa_n)
             return total
-        out = jax.vmap(per_pod)(pods.nonzero_req, taint_raw, aff_raw, img,
-                                feasible)
+        args = (pods.nonzero_req, taint_raw, aff_raw, img, feasible)
+        if sp_b is not None:
+            args = args + (sp_b, ipa_b, ign_b, soft_b)
+        out = jax.vmap(per_pod)(*args)
         return out if host_score is None else out + host_score
 
     def cond(state):
@@ -374,7 +625,16 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
         eff = eff_all(free)                                        # [B, N, R]
         fit = jnp.all(pods.req[:, None, :] <= eff, axis=-1)
         feasible = static_ok & fit & (placed < 0)[:, None]
-        total = totals(nzr, feasible)
+        if ipa_mask is not None:
+            feasible = feasible & ipa_mask
+        if soft is not None:
+            # live soft topology scores against the ROUND-START placed
+            # set (the auction's state discipline, same as utilization)
+            ipa_live_g, sp_r_g = _soft_scores(soft, placed, gid_oh)
+            total = totals(nzr, feasible, sp_b=sp_r_g[soft.gid],
+                           ipa_b=ipa_live_g[soft.gid])
+        else:
+            total = totals(nzr, feasible)
         choice = jax.vmap(C.masked_argmax_random)(total, feasible, perturb)
         # per-node acceptance: up to k_accept pods per node per round,
         # in batch index order, while their CUMULATIVE requests keep
@@ -421,12 +681,19 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
 
     # diagnostics from the final state (unplaced pods' reject attribution)
     fit = fit_all(free)
-    feas = jnp.sum(static_ok & fit, axis=1).astype(jnp.int32)
-    fit_rejects = jnp.sum(static_ok & ~fit, axis=1).astype(jnp.int32)
     zeros = jnp.zeros((B,), jnp.int32)
+    if ipa_mask is not None:
+        feas = jnp.sum(static_ok & fit & ipa_mask,
+                       axis=1).astype(jnp.int32)
+        ipa_rejects = jnp.sum(static_ok & fit & ~ipa_mask,
+                              axis=1).astype(jnp.int32)
+    else:
+        feas = jnp.sum(static_ok & fit, axis=1).astype(jnp.int32)
+        ipa_rejects = zeros
+    fit_rejects = jnp.sum(static_ok & ~fit, axis=1).astype(jnp.int32)
     reject_counts = jnp.concatenate(
         [static_rejects, fit_rejects[:, None], zeros[:, None],
-         zeros[:, None]], axis=1)
+         ipa_rejects[:, None]], axis=1)
     # learned-score magnitude + chosen-node feature export, attributed
     # against the END state like the reject diagnostics above (the
     # per-round states the losers scored against are gone)
@@ -436,6 +703,8 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
     alt_score = jnp.full((B, ALT_K), ALT_NONE, jnp.float32)
     if learned is not None or with_feats or with_alts:
         ok_end = static_ok & fit       # end-state feasible, like rejects
+        if ipa_mask is not None:
+            ok_end = ok_end & ipa_mask
         rows_c = jnp.clip(placed, 0, N - 1)
         chosen_oh = ((rows_c[:, None] == jnp.arange(N)[None, :])
                      & (placed >= 0)[:, None])                # [B, N]
@@ -443,8 +712,21 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
         # even when end-state fit excludes it (it WAS feasible when it
         # won)
         cand = ok_end | chosen_oh
+        if soft is not None:
+            # end-state soft halves ride the export totals (and, via
+            # LN.feature_rows' spread/ipa columns, the feature export)
+            # exactly like the reject diagnostics above
+            ipa_end_g, sp_end_g = _soft_scores(soft, placed, gid_oh)
+            ipa_end_b = ipa_end_g[soft.gid]
+            sp_end_b = sp_end_g[soft.gid]
+        else:
+            ipa_end_b = jnp.zeros((B, N), jnp.float32)
+            sp_end_b = jnp.zeros((B, N), jnp.float32)
+            ign_b = jnp.ones((B, N), bool)
+            soft_b = jnp.zeros((B,), bool)
 
-        def pod_eval(nzreq, t_raw, a_raw, im, feas_row, own_row):
+        def pod_eval(nzreq, t_raw, a_raw, im, feas_row, own_row,
+                     ipa_row, sp_row, ign_row, softp):
             # ONE evaluation feeds every export tail (features, the
             # fused learned term, the alt totals) — like the serial
             # scan deriving all three from one per-step state. The
@@ -457,8 +739,12 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
             nzr_i = nzr - own_row[:, None] * nzreq[None, :]
             frac, least, bal, taint, aff = per_pod_scores(
                 nzr_i, nzreq, t_raw, a_raw, feas_row)
+            ipa_n = SC.normalize_maxmin(ipa_row, feas_row)
+            sp_n = jnp.where(softp,
+                             SC.normalize_spread(sp_row, feas_row,
+                                                 ign_row), 0.0)
             feats_row = LN.feature_rows(frac, least, bal, taint, aff,
-                                        im)                  # [N, F]
+                                        im, sp_n, ipa_n)     # [N, F]
             lterm_row = (jnp.clip(LN.mlp_apply(learned, feats_row),
                                   0.0, LN.MAX_SCORE)
                          if learned is not None
@@ -468,12 +754,15 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
                      + weights.resources_fit * least
                      + weights.balanced_allocation * bal
                      + weights.image_locality * im
+                     + weights.pod_topology_spread * sp_n
+                     + weights.inter_pod_affinity * ipa_n
                      + weights.learned * lterm_row)
             return feats_row, lterm_row, total
         # unused outputs are DCE'd per compiled flag combination
         feats, lterm, tot = jax.vmap(pod_eval)(
             pods.nonzero_req, taint_raw, aff_raw, img, cand,
-            chosen_oh.astype(nzr.dtype))
+            chosen_oh.astype(nzr.dtype),
+            ipa_end_b, sp_end_b, ign_b, soft_b)
         if learned is not None:
             # same feasible-pair definition as the serial path's live
             # mask (modulo end-state attribution): one histogram, one
@@ -536,9 +825,20 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                    tie_seed=None,
                    with_feats: bool = False,
                    with_alts: bool = False,
+                   topo_soft: bool = False,
                    ) -> BatchResult:
     """Schedule a whole pod batch in one launch, as-if-serial (see module
     docstring for the two-phase structure).
+
+    ``topo_soft`` (STATIC): the batch's topology work is SOFT-only (no
+    required terms, no DoNotSchedule spread — LaunchSpec.topo_soft). The
+    serial scan then compiles the reduced soft program: only the
+    weighted-score carries (wscore_n + node-space spread counts) survive
+    — the hard-constraint carry maps (forbid/presence/domain-count
+    tensors, the ones that scale with d_cap) are provably neutral for a
+    soft-only batch and compile out. Same placements, bit-identical
+    scores, a fraction of the per-step kernels. The auction path uses it
+    to fuse the soft-score terms (_soft_statics/_soft_scores).
 
     ``enable_topology`` and ``d_cap`` are STATIC, host-derived launch args —
     the device analog of PreFilter returning Skip (framework/interface.go):
@@ -693,18 +993,41 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
         # the Scheduler from its own counts (they never reach reject_counts)
         static_ok = static_ok & host_ok
     if not serial_scan:
-        if enable_topology:
-            raise ValueError("auction commit requires a no-topology launch")
         if pct_nodes:
             raise ValueError(
                 "percentageOfNodesToScore truncation only exists in the "
                 "serial scan; gate the auction off when the knob is set")
+        soft = None
+        if enable_topology:
+            if not topo_soft:
+                raise ValueError(
+                    "auction commit requires a no-topology or soft-only "
+                    "topology launch; required terms / DoNotSchedule "
+                    "spread need the serial as-if-serial commit scan")
+            # SOFT-ONLY topology launch (the caller gates this on the
+            # batch carrying no required terms and no DoNotSchedule
+            # spread): preferred (anti)affinity weights and ScheduleAnyway
+            # spread are SCORES, not constraints, so the auction's round
+            # structure holds — the table halves are per-group statics,
+            # the in-batch halves recompute per round (_soft_scores)
+            pods_rep = jax.tree.map(lambda x: x[rep], pods)
+            soft = _soft_statics(ct, pods, pods_rep, gid, g_cap, d_cap,
+                                 tds, wk, enabled_filters, act, ipa_on,
+                                 chunked_vmap)
         return _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw,
                               aff_raw, img, unres, weights, free0, nzr0,
                               host_score, fit_strategy, fit_shape,
                               dra_reject, learned, tie_seed, with_feats,
-                              with_alts)
-    if enable_topology:
+                              with_alts, soft=soft)
+    soft_st = None
+    if enable_topology and topo_soft:
+        # ---- phase 1b (SOFT): the reduced per-group statics — exactly
+        # what the soft scores need; none of the hard-constraint maps
+        pods_rep = jax.tree.map(lambda x: x[rep], pods)
+        soft_st = _soft_statics(ct, pods, pods_rep, gid, g_cap, d_cap,
+                                tds, wk, enabled_filters, act, ipa_on,
+                                chunked_vmap)
+    if enable_topology and not topo_soft:
         # ---- phase 1b: topology statics per GROUP (representatives) ----
         pods_rep = jax.tree.map(lambda x: x[rep], pods)  # leaves [G, ...]
 
@@ -872,7 +1195,49 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
         return ((tk[..., None] == arange_tk_f) & (tk[..., None] != NONE)
                 ).astype(jnp.float32)
 
-    if enable_topology:
+    if enable_topology and topo_soft:
+        # soft-scan one-hots + the per-commit update (the soft subset of
+        # map_updates: weighted paff/panti score deltas + node-space
+        # spread match counts; everything else is neutral for a
+        # soft-only batch and never compiles)
+        oh_paff_soft = tk_onehot(soft_st.paff_tk_g)
+        oh_panti_soft = tk_onehot(soft_st.panti_tk_g)
+        oh_tsc_soft = tk_onehot(soft_st.tsc_tk_g)
+        el_node_soft_nr = jnp.transpose(soft_st.el_node_g, (1, 0, 2))
+        M_paff_soft = soft_st.M_paff_gg.astype(jnp.float32)
+        M_panti_soft = soft_st.M_panti_gg.astype(jnp.float32)
+        paff_w_soft = soft_st.paff_w_g
+        panti_w_soft = soft_st.panti_w_g
+
+        def soft_map_updates(g, r, do, wscore_n, cnt_match_n):
+            dom_row = topo_dom[r]                              # [TK]
+            same_dom = ((topo_dom == dom_row[None])
+                        & (dom_row[None] != NONE)
+                        & do).astype(jnp.float32)              # [N, TK]
+            j_side = ((same_dom @ oh_paff_soft[g].T)
+                      @ (M_paff_soft[g] * paff_w_soft[g][:, None])
+                      - (same_dom @ oh_panti_soft[g].T)
+                      @ (M_panti_soft[g]
+                         * panti_w_soft[g][:, None]))          # [N, G]
+            nd_gb_paff = jnp.einsum("nt,gat->nga", same_dom,
+                                    oh_paff_soft)
+            nd_gb_panti = jnp.einsum("nt,gat->nga", same_dom,
+                                     oh_panti_soft)
+            b_side = (jnp.einsum("nga,ga->gn", nd_gb_paff,
+                                 M_paff_soft[:, :, g] * paff_w_soft)
+                      - jnp.einsum("nga,ga->gn", nd_gb_panti,
+                                   M_panti_soft[:, :, g]
+                                   * panti_w_soft))
+            wscore_n = wscore_n + j_side.T + b_side
+            el_r = el_node_soft_nr[r]                          # [G, C]
+            hits_c = soft_st.M_tsc_gg[:, :, g] & el_r
+            nd_gb_tsc = jnp.einsum("nt,gct->ngc", same_dom,
+                                   oh_tsc_soft)
+            cnt_match_n = cnt_match_n + jnp.einsum(
+                "ngc,gc->gcn", nd_gb_tsc,
+                hits_c.astype(jnp.float32))
+            return wscore_n, cnt_match_n
+    if enable_topology and not topo_soft:
         oh_anti_own = tk_onehot(anti_tk_g)  # [G, A, TK] (each group's terms)
         oh_aff_own = tk_onehot(aff_tk_g)
         oh_paff_own = tk_onehot(paff_tk_g)
@@ -949,7 +1314,27 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
     def body(carry, xs):
         if pct_nodes:
             carry, start = carry[:-1], carry[-1]
-        if enable_topology:
+        if enable_topology and topo_soft:
+            # soft scan: the only live topology state is the weighted
+            # score carry + node-space spread counts; feasibility is the
+            # STATIC table mask (in-batch commits cannot constrain)
+            (free, nzr, committed_rows, wscore_n, cnt_match_n) = carry
+            (b, ok_s, t_raw, a_raw, im, req, nzreq, ptb, g) = xs
+            ipa_ok = soft_st.ipa_ok_g[g]
+            sp_ok = jnp.ones_like(ok_s)
+            used_soft = soft_st.used_soft_g[g]
+            match_num = (soft_st.match_static_g[g]
+                         + cnt_match_n[g].T)                   # [N, C]
+            per_c = (match_num * soft_st.tpw_g[g][None]
+                     + (soft_st.skew_g[g][None] - 1.0))
+            per_c = jnp.where(used_soft[None] & soft_st.dom_ok_g[g],
+                              per_c, 0.0)
+            sp_r = jnp.where(soft_st.ign_g[g], 0.0,
+                             jnp.sum(per_c, axis=1))
+            ipa_live = soft_st.ipa_raw_g[g] + wscore_n[g]
+            ign_b = soft_st.ign_g[g]
+            soft_b = soft_st.has_soft_g[g]
+        elif enable_topology:
             (free, nzr, committed_rows, forbid1_n, map2_n, pres_n, any3,
              wscore_n, cntmap, cnt_match_n) = carry
             (b, ok_s, t_raw, a_raw, im, req, nzreq, ptb, g) = xs
@@ -1038,7 +1423,7 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
             # the fused MLP term, against the SAME live per-step state
             # the hand-tuned terms see (as-if-serial holds for it too)
             lterm = weights.learned * LN.learned_term(
-                learned, frac, least, bal, taint, aff, im)
+                learned, frac, least, bal, taint, aff, im, spread, ipa)
             total = total + lterm
             lmag_step = (jnp.sum(jnp.where(feasible, jnp.abs(lterm), 0.0))
                          / jnp.maximum(jnp.sum(feasible), 1)
@@ -1061,7 +1446,12 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
         sp_rejects = jnp.sum(ok_fit & ~sp_ok).astype(jnp.int32)
         ipa_rejects = jnp.sum(ok_sp & ~ipa_ok).astype(jnp.int32)
         win = jnp.where(do, total[r], 0.0)
-        if enable_topology:
+        if enable_topology and topo_soft:
+            wscore_n, cnt_match_n = soft_map_updates(
+                g, r, do, wscore_n, cnt_match_n)
+            out_carry = (free, nzr, committed_rows, wscore_n,
+                         cnt_match_n)
+        elif enable_topology:
             (forbid1_n, map2_n, pres_n, any3, wscore_n, cntmap,
              cnt_match_n) = map_updates(
                 g, r, do, forbid1_n, map2_n, pres_n, any3, wscore_n,
@@ -1078,7 +1468,7 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
             ys = ys + (lmag_step,)
         if with_feats:
             ys = ys + (LN.feature_row_at(r, frac, least, bal, taint, aff,
-                                         im),)
+                                         im, spread, ipa),)
         if with_alts:
             # top-K candidates against the pod's LIVE per-step state —
             # exactly the alternatives this pod could have taken at its
@@ -1104,7 +1494,15 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
     xs = (jnp.arange(B), static_ok, taint_raw, aff_raw, img,
           pods.req, pods.nonzero_req, perturb_rows)
     init = (free0, nzr0, jnp.full((B,), -1, jnp.int32))
-    if enable_topology:
+    if enable_topology and topo_soft:
+        xs = xs + (gid,)
+        n_cap = free0.shape[0]
+        C_cap = soft_st.tsc_tk_g.shape[1]
+        init = init + (
+            jnp.zeros((g_cap, n_cap), jnp.float32),       # wscore_n
+            jnp.zeros((g_cap, C_cap, n_cap), jnp.float32),   # cnt_match_n
+        )
+    elif enable_topology:
         xs = xs + (gid,)
         A_cap = anti_tk_g.shape[1]
         C_cap = tsc_tk_g.shape[1]
@@ -1166,7 +1564,8 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                                    "enabled_filters", "serial_scan",
                                    "active", "pfields", "g_cap",
                                    "fit_strategy", "pct_nodes",
-                                   "with_feats", "with_alts"))
+                                   "with_feats", "with_alts",
+                                   "topo_soft"))
 def schedule_batch_jit(cblobs, pblobs, wk, weights, caps,
                        enable_topology=True, d_cap=None,
                        enabled_filters=None, serial_scan=True, state=None,
@@ -1175,13 +1574,15 @@ def schedule_batch_jit(cblobs, pblobs, wk, weights, caps,
                        host_score=None, fit_strategy="LeastAllocated",
                        fit_shape=None, pct_nodes=0, pct_start=None,
                        dra=None, learned=None, tie_seed=None,
-                       with_feats=False, with_alts=False):
+                       with_feats=False, with_alts=False,
+                       topo_soft=False):
     return schedule_batch(cblobs, pblobs, wk, weights, caps,
                           enable_topology, d_cap, enabled_filters,
                           serial_scan, state, active, pfields, ptmpl,
                           gid, rep, g_cap, host_ok, host_score,
                           fit_strategy, fit_shape, pct_nodes, pct_start,
-                          dra, learned, tie_seed, with_feats, with_alts)
+                          dra, learned, tie_seed, with_feats, with_alts,
+                          topo_soft)
 
 
 @partial(jax.jit, static_argnames=("caps",))
@@ -1234,4 +1635,4 @@ def launch_batch(spec, wk, weights, caps, enabled_filters=None,
         fit_strategy=fit_strategy, fit_shape=fit_shape,
         pct_nodes=pct_nodes, pct_start=pct_start, dra=spec.dra,
         learned=learned, tie_seed=tie_seed, with_feats=with_feats,
-        with_alts=with_alts)
+        with_alts=with_alts, topo_soft=spec.topo_soft)

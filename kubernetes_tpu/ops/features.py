@@ -292,6 +292,7 @@ def pod_schema(caps: Capacities) -> dict[str, tuple[tuple[int, ...], str]]:
         "plabel_vals": ((caps.pod_label_cols,), "i32"),
         "nodesel_cols": ((PL,), "i32"),
         "nodesel_vals": ((PL,), "i32"),
+        "aff_pin": ((), "i32"),
         "sel_term_valid": ((T,), "bool"),
         "sel_col": ((T, E), "i32"),
         "sel_op": ((T, E), "i32"),
@@ -359,6 +360,12 @@ class PodFeatures:
     # val=NONE.
     nodesel_cols: jax.Array      # [PL] i32 label-column index (-1 = key unseen)
     nodesel_vals: jax.Array      # [PL] i32 (-1 = unused slot)
+    # required node affinity, PIN form: the whole required clause reduces
+    # to one matchFields metadata.name In [v] term (the daemonset-controller
+    # shape) — packed as the target's interned name so the filter is ONE
+    # [N] compare instead of the [N, T, E, V] selector kernels (NONE = no
+    # pin; the general form below then applies)
+    aff_pin: jax.Array           # i32 scalar (-1 = no pin)
     # required node affinity: OR over terms, AND within term. Expressions
     # reference label COLUMNS (host-resolved); unused slots have op=NONE.
     sel_term_valid: jax.Array    # [T] bool

@@ -117,9 +117,18 @@ def _selector_match(ct: ClusterTensors, cols, ops, is_field, vals, nums):
     return match  # [N, *cols.shape]
 
 
-def node_affinity(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
+def node_affinity(ct: ClusterTensors, pod: PodFeatures,
+                  full: bool = True) -> jnp.ndarray:
     """spec.nodeSelector (exact pairs, ANDed) AND required node affinity
-    (OR over terms, AND within term)."""
+    (OR over terms, AND within term).
+
+    ``full=False`` (the "nodeaffinity_pin" launch feature) compiles ONLY
+    the single-node pin compare: every affinity-bearing pod in the batch
+    reduced to a matchFields metadata.name In [v] term (the daemonset
+    shape), so the [N, T, E, V] selector kernels never materialize."""
+    pin_ok = (pod.aff_pin == NONE) | (ct.node_name_id == pod.aff_pin)  # [N]
+    if not full:
+        return pin_ok
     # nodeSelector pairs: node's value in the pair's label column must equal
     # the pair's value (col NONE -> key on no node -> never matches)
     node_val = _take_cols(ct.label_col_vals, pod.nodesel_cols, NONE)  # [N, PL]
@@ -135,7 +144,7 @@ def node_affinity(ct: ClusterTensors, pod: PodFeatures) -> jnp.ndarray:
     term_ok = term_ok & term_nonempty[None] & pod.sel_term_valid[None]
     any_term = jnp.any(pod.sel_term_valid)
     affinity_ok = jnp.where(any_term, jnp.any(term_ok, axis=-1), True)
-    return sel_ok & affinity_ok
+    return sel_ok & affinity_ok & pin_ok
 
 
 def node_ports(ct: ClusterTensors, pod: PodFeatures,

@@ -48,13 +48,23 @@ LEARNED_FEATURES = (
     "taint",
     "node_affinity",
     "image_locality",
+    # v3 (ISSUE 15): the topology score terms join the feature rows —
+    # normalized PodTopologySpread and InterPodAffinity (preferred +
+    # hard-weight) scores, now available on BOTH commit paths since the
+    # soft-topology auction computes them fused. Zero for pods/launches
+    # without topology work (the learn loop sees real signal only where
+    # the scheduler did).
+    "spread",
+    "ipa",
 )
 NUM_FEATURES = len(LEARNED_FEATURES)
 
 # bumped whenever the feature layout changes; checkpoints record the
 # version they were trained against and the loader rejects a mismatch
-# (a scorer trained on other features would be garbage, not degraded)
-FEATURE_VERSION = 1
+# (a scorer trained on other features would be garbage, not degraded).
+# 3 = the topology/IPA columns (aligned with trace-export v3, whose
+# placement rows carry these features).
+FEATURE_VERSION = 3
 
 MAX_SCORE = 100.0
 
@@ -63,12 +73,18 @@ MAX_SCORE = 100.0
 
 def feature_rows(frac: jnp.ndarray, fit: jnp.ndarray, bal: jnp.ndarray,
                  taint: jnp.ndarray, aff: jnp.ndarray,
-                 img: jnp.ndarray) -> jnp.ndarray:
+                 img: jnp.ndarray, spread: jnp.ndarray | None = None,
+                 ipa: jnp.ndarray | None = None) -> jnp.ndarray:
     """[N, NUM_FEATURES] feature matrix from the per-node arrays the
-    pipeline already computed for the hand-tuned aggregate."""
+    pipeline already computed for the hand-tuned aggregate. ``spread``/
+    ``ipa`` default to zero columns (no-topology launches)."""
+    zeros = jnp.zeros_like(fit)
+    spread = zeros if spread is None else spread
+    ipa = zeros if ipa is None else ipa
     return jnp.stack(
         [frac[..., 0], frac[..., 1], fit / MAX_SCORE, bal / MAX_SCORE,
-         taint / MAX_SCORE, aff / MAX_SCORE, img / MAX_SCORE], axis=-1)
+         taint / MAX_SCORE, aff / MAX_SCORE, img / MAX_SCORE,
+         spread / MAX_SCORE, ipa / MAX_SCORE], axis=-1)
 
 
 def mlp_apply(params, feats: jnp.ndarray) -> jnp.ndarray:
@@ -85,10 +101,12 @@ def mlp_apply(params, feats: jnp.ndarray) -> jnp.ndarray:
 
 def learned_term(params, frac: jnp.ndarray, fit: jnp.ndarray,
                  bal: jnp.ndarray, taint: jnp.ndarray, aff: jnp.ndarray,
-                 img: jnp.ndarray) -> jnp.ndarray:
+                 img: jnp.ndarray, spread: jnp.ndarray | None = None,
+                 ipa: jnp.ndarray | None = None) -> jnp.ndarray:
     """[N] learned score in [0, 100] — NaN params stay NaN through the
     clip so the launch guard owns the containment."""
-    raw = mlp_apply(params, feature_rows(frac, fit, bal, taint, aff, img))
+    raw = mlp_apply(params, feature_rows(frac, fit, bal, taint, aff, img,
+                                         spread, ipa))
     return jnp.clip(raw, 0.0, MAX_SCORE)
 
 
@@ -108,15 +126,21 @@ def hand_weight_vector():
                      float(w.balanced_allocation),
                      float(w.taint_toleration),
                      float(w.node_affinity),
-                     float(w.image_locality)], np.float32)
+                     float(w.image_locality),
+                     float(w.pod_topology_spread),
+                     float(w.inter_pod_affinity)], np.float32)
 
 
 def feature_row_at(row, frac: jnp.ndarray, fit: jnp.ndarray,
                    bal: jnp.ndarray, taint: jnp.ndarray, aff: jnp.ndarray,
-                   img: jnp.ndarray) -> jnp.ndarray:
+                   img: jnp.ndarray, spread: jnp.ndarray | None = None,
+                   ipa: jnp.ndarray | None = None) -> jnp.ndarray:
     """[NUM_FEATURES] feature vector of ONE node row (the commit scan
     exports the chosen node's features for the replay dataset)."""
+    sp = jnp.float32(0.0) if spread is None else spread[row]
+    ip = jnp.float32(0.0) if ipa is None else ipa[row]
     return jnp.stack(
         [frac[row, 0], frac[row, 1], fit[row] / MAX_SCORE,
          bal[row] / MAX_SCORE, taint[row] / MAX_SCORE,
-         aff[row] / MAX_SCORE, img[row] / MAX_SCORE])
+         aff[row] / MAX_SCORE, img[row] / MAX_SCORE,
+         sp / MAX_SCORE, ip / MAX_SCORE])

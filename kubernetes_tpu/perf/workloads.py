@@ -264,7 +264,13 @@ def _churn_node(i: int) -> object:
 def mixed_churn(init_nodes=5000, measure_pods=10000) -> Workload:
     return Workload(
         name="SchedulingWithMixedChurn/5000Nodes_10000Pods",
-        threshold=265,
+        # ratcheted to LOCK the measured floor (BENCH_r12/r15: 1509
+        # pods/s): paired A/B this round shows the churn tail is the
+        # auction device launch + node-churn resyncs, not host requeue
+        # pressure — the 10x claim the ISSUE hypothesized is not
+        # supported by measurement, so the floor locks what is real
+        threshold=1400,
+        baseline=265,
         ops=[
             CreateNodes(init_nodes, _node),
             Churn([_churn_node, _large_cpu_pod], interval_ms=1000,
@@ -291,7 +297,8 @@ def _daemonset_pod(i: int) -> Pod:
 def scheduling_daemonset(init_nodes=15000, measure_pods=15000) -> Workload:
     return Workload(
         name="SchedulingDaemonset/15000Nodes",
-        threshold=390,
+        threshold=3900,   # ratcheted: 10x the reference 390 floor (ISSUE 15)
+        baseline=390,
         node_capacity=16384,
         pod_capacity=32768,
         ops=[
@@ -365,7 +372,8 @@ def preferred_pod_affinity(init_nodes=5000, init_pods=1000,
                            measure_pods=5000) -> Workload:
     return Workload(
         name="SchedulingPreferredPodAffinity/5000Nodes_5000Pods",
-        threshold=90,
+        threshold=900,   # ratcheted: 10x the reference 90 floor (ISSUE 15)
+        baseline=90,
         pod_capacity=32768,
         ops=[
             CreateNodes(init_nodes,
@@ -381,7 +389,8 @@ def preferred_pod_anti_affinity(init_nodes=5000, init_pods=1000,
                                 measure_pods=5000) -> Workload:
     return Workload(
         name="SchedulingPreferredPodAntiAffinity/5000Nodes_5000Pods",
-        threshold=90,
+        threshold=900,   # ratcheted: 10x the reference 90 floor (ISSUE 15)
+        baseline=90,
         pod_capacity=32768,
         ops=[
             CreateNodes(init_nodes,
@@ -837,7 +846,8 @@ def ns_selector_preferred_affinity(init_nodes=5000, init_namespaces=100,
     return Workload(
         name="SchedulingPreferredAffinityWithNSSelector"
              "/5000Nodes_5000Pods",
-        threshold=90,
+        threshold=900,   # ratcheted: 10x the reference 90 floor (ISSUE 15)
+        baseline=90,
         pod_capacity=32768,
         warm_full_nodes=True,   # hostname topology: domains = nodes
         ops=[
@@ -924,7 +934,8 @@ def preferred_topology_spreading(init_nodes=5000, init_pods=5000,
                                  measure_pods=5000) -> Workload:
     return Workload(
         name="PreferredTopologySpreading/5000Nodes_5000Pods",
-        threshold=125,
+        threshold=1250,  # ratcheted: 10x the reference 125 floor (ISSUE 15)
+        baseline=125,
         pod_capacity=32768,
         ops=[
             CreateNodes(init_nodes, lambda i: _node(
@@ -1048,7 +1059,8 @@ def ns_selector_preferred_anti_affinity(init_nodes=5000, init_pods=1000,
     return Workload(
         name="SchedulingPreferredAntiAffinityWithNSSelector"
              "/5000Nodes_2000Pods",
-        threshold=55,
+        threshold=550,   # ratcheted: 10x the reference 55 floor (ISSUE 15)
+        baseline=55,
         pod_capacity=32768,
         warm_full_nodes=True,   # hostname topology: domains = nodes
         ops=[
@@ -1189,7 +1201,13 @@ def gang_preemption(init_nodes=128, high_gangs=24) -> Workload:
 
     return Workload(
         name="GangPreemption/128Nodes",
-        threshold=30,
+        # ratcheted to LOCK the measured floor (BENCH_r12/r15: 235
+        # pods/s): the eviction flush is now ONE delete_pods wave with
+        # coalesced requeue reaction, but the measured phase is
+        # dominated by victim-drain latency, not flush RPCs — paired
+        # A/B this round reads flat, so the floor locks what is real
+        threshold=220,
+        baseline=30,
         node_capacity=256,
         batch_size=512,
         ops=[
@@ -1326,6 +1344,13 @@ ALL_WORKLOADS = BENCH_WORKLOADS
 PROFILE_WORKLOADS = (
     "scheduling_daemonset",
     "mixed_churn",
+    # the preferred-scoring band (ISSUE 15): soft terms now run fused in
+    # the auction — the per-phase rows prove the host tail stays burned
+    # down
+    "preferred_pod_anti_affinity",
+    "preferred_topology_spreading",
+    "ns_selector_preferred_affinity",
+    "ns_selector_preferred_anti_affinity",
     "dra_steady_state",
     "dra_steady_state_templates",
     # the whole gang suite rides the per-phase attribution + the

@@ -809,7 +809,13 @@ class Scheduler:
         busy = self.preemption.has_pending()
         t0 = self.now() if busy else 0.0
         try:
-            self.preemption.flush_evictions()
+            # the queue's coalescing window batches the wave's delete
+            # events into ONE requeue pass (in-process hubs dispatch
+            # them inline on this thread); the whole wave — deletes AND
+            # requeue reaction — lands under the single eviction_flush
+            # phase observation below, never per-delete
+            with self.queue.coalescing():
+                self.preemption.flush_evictions()
         except Unavailable:
             self._note_hub_down()
         finally:
@@ -931,8 +937,19 @@ class Scheduler:
                 self._quarantine_pod(qp, f"host fallback raised: {e!r}")
                 continue
             if node is None:
-                self._park_unschedulable(qp, plugins,
-                                         "host fallback: no feasible node")
+                # rung-bottom preemption mini-path (ISSUE 15): a fully
+                # device-dead scheduler must still be able to evict —
+                # serial host candidate selection + the queued eviction
+                # flush; the nomination rides the unschedulable park so
+                # the retry (still on the host path if the device stays
+                # dead) claims the vacated room
+                nominated = self._host_preempt_fallback(qp, plugins)
+                if nominated:
+                    self.stats["preemptions"] = self.stats.get(
+                        "preemptions", 0) + 1
+                self._park_unschedulable(
+                    qp, plugins, "host fallback: no feasible node",
+                    nominated=nominated)
             elif node == "":
                 # topology pod: the host path cannot evaluate it — park
                 # error-class and let the next cycle retry the device path
@@ -1063,11 +1080,43 @@ class Scheduler:
             return None, rejects or {"NodeResourcesFit"}
         return best, set()
 
+    def _host_preempt_fallback(self, qp: QueuedPodInfo,
+                               plugins: set[str]) -> Optional[str]:
+        """The host fallback's preemption rung: serial candidate
+        selection over the snapshot (Evaluator.host_preempt) when the
+        rejection is preemption-resolvable. Returns the nominated node
+        name, or None when preemption does not apply / found nothing."""
+        pod = qp.pod
+        if pod.priority() <= 0 \
+                or pod.metadata.uid in self.preemption.preempting:
+            return None
+        # only fit-class rejections are resolvable by eviction; host
+        # plugin rejects (volumes, claims) and pure static rejects are
+        # not — matching the device path's Unresolvable discipline
+        if plugins and "NodeResourcesFit" not in plugins:
+            return None
+        if not self._fw_for(pod).points["post_filter"]:
+            return None         # profile disabled preemption
+        try:
+            node, _status = self.preemption.host_preempt(pod,
+                                                         self.snapshot)
+        except Unavailable:
+            self._note_hub_down()
+            return None
+        except Exception as e:  # noqa: BLE001 — the mini-path must
+            # never take the whole fallback batch down with it
+            logger.warning("host preemption mini-path failed for %s: %r",
+                           pod.key(), e)
+            return None
+        return node
+
     def _park_unschedulable(self, qp: QueuedPodInfo, plugins: set[str],
-                            msg: str) -> None:
-        """Unschedulable park with plugin attribution, minus PostFilter:
-        preemption is a device sweep, which the fallback path must not
-        re-enter (the pod retries the full path after backoff)."""
+                            msg: str, nominated: Optional[str] = None
+                            ) -> None:
+        """Unschedulable park with plugin attribution. Full PostFilter
+        preemption is a device sweep the fallback path must not re-enter;
+        the host mini-path's nomination (if any) rides the condition
+        patch so the preemptor's reservation survives the park."""
         if self.flight.enabled:
             self.timelines.diagnose(qp.pod, {}, qp.host_reject_counts
                                     or {p: -1 for p in plugins}, msg)
@@ -1080,7 +1129,18 @@ class Scheduler:
             result="unschedulable", profile=qp.pod.spec.scheduler_name)
         self._patch_condition_best_effort(qp.pod, PodCondition(
             type="PodScheduled", status="False", reason="Unschedulable",
-            message=msg))
+            message=msg), nominated)
+        if nominated:
+            # park the FRESH object so the packed nominated_row sees
+            # status.nominatedNodeName next attempt (same re-fetch
+            # discipline as _park_failed)
+            try:
+                stored = self.hub.get_pod(qp.uid)
+            except Unavailable:
+                self._note_hub_down()
+                stored = None
+            if stored is not None:
+                qp.pod = stored
         self.queue.add_unschedulable_if_not_present(qp)
 
     # ------------- poison-pod quarantine -------------
@@ -1161,7 +1221,12 @@ class Scheduler:
         while new < err.needed:
             new *= 2
         self.caps = dataclasses.replace(self.caps, **{field: new})
+        prev = self.mirror
         self.mirror = Mirror(caps=self.caps, mesh=self.mesh)
+        # sticky-bucket continuity: the fresh mirror keeps the old one's
+        # shape high-water marks, so re-bucketing doesn't re-learn d_cap/
+        # g_cap from scratch and flap the compiled programs again
+        self.mirror.adopt_hysteresis(prev)
         self.snapshot = Snapshot()
         self._invalidate_chain()
         self.cache.update_snapshot(self.snapshot)
@@ -1381,8 +1446,18 @@ class Scheduler:
         raw = self.config.percentage_of_nodes_to_score
         pct = (0 if raw is None or raw >= 100
                else ADAPTIVE_PCT if raw == 0 else raw)
+        # topology launches may join the auction when the batch's
+        # topology work is SOFT-only (preferred weights / ScheduleAnyway
+        # spread, ISSUE 15): soft terms are scores, so either commit
+        # engine can carry them fused. Engine choice is a backend
+        # heuristic like pipeline.scan_unroll: on accelerators the
+        # auction's few big fused rounds beat B sequential scan steps;
+        # on CPU the soft-serial scan's small per-step kernels beat the
+        # auction's bandwidth-bound [B, N] rounds — measured both ways
+        # on the preferred band (BENCH_r15).
+        soft_auction = spec.topo_soft and jax.default_backend() != "cpu"
         use_auction = (not pct
-                       and not spec.enable_topology
+                       and (not spec.enable_topology or soft_auction)
                        and not self.mirror.batch_has_host_ports(
                            [qp.pod for qp in runnable])
                        and pcfg["filters"][FILTER_PLUGINS.index(
@@ -1448,7 +1523,8 @@ class Scheduler:
                 not use_auction, spec.dra is not None,
                 learned_params is not None,
                 self._export_feats and self.flight.exporting,
-                alts=self._export_alts and self.flight.exporting)
+                alts=self._export_alts and self.flight.exporting,
+                soft=spec.topo_soft)
             compiled = prof.note_launch(pshape)
             if compiled or prof.launches == 1:
                 # buffer footprints are bucket-static: re-measure only

@@ -40,20 +40,21 @@ _CAP_FIELDS = ("nodes", "pods", "pod_labels", "node_labels", "domains",
 def shape_key(caps, b_bucket: int, enable_topology: bool, d_cap,
               g_cap: int, serial_scan: bool, dra: bool, learned: bool,
               with_feats: bool, gang: int = 0,
-              alts: bool = False) -> tuple:
+              alts: bool = False, soft: bool = False) -> tuple:
     """The launch's compile-relevant shape: static jit args + input
     shape buckets, as a flat hashable tuple. ``gang`` is the gang-pack
     launch's gang-row bucket (0 for the normal scheduling launch) — a
     gang-shape recompile attributes to its own row instead of landing
     in "unattributed". ``alts`` is the with_alts static flag (the
-    export v3 top-K candidate kernels)."""
+    export v3 top-K candidate kernels); ``soft`` is the topo_soft
+    static flag (the reduced soft-topology program, ISSUE 15)."""
     cap_t = tuple((f, getattr(caps, f)) for f in _CAP_FIELDS
                   if hasattr(caps, f))
     return (("b", b_bucket), ("topo", bool(enable_topology)),
             ("d_cap", d_cap), ("g_cap", g_cap),
             ("serial", bool(serial_scan)), ("dra", bool(dra)),
             ("learned", bool(learned)), ("feats", bool(with_feats)),
-            ("gang", gang), ("alts", bool(alts)),
+            ("gang", gang), ("alts", bool(alts)), ("soft", bool(soft)),
             *cap_t)
 
 

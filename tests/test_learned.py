@@ -46,7 +46,11 @@ from kubernetes_tpu.learn.train import (
 )
 from kubernetes_tpu.models.pipeline import default_weights, launch_batch
 from kubernetes_tpu.ops.features import Capacities
-from kubernetes_tpu.ops.learned import NUM_FEATURES, mlp_apply
+from kubernetes_tpu.ops.learned import (
+    FEATURE_VERSION,
+    NUM_FEATURES,
+    mlp_apply,
+)
 from kubernetes_tpu.scheduler import Scheduler
 from kubernetes_tpu.utils.tracing import FlightRecorder
 
@@ -113,9 +117,11 @@ def test_checkpoint_roundtrip(tmp_path):
     params = init_params(seed=3, hidden=(8,))
     doc = save_checkpoint(path, params, meta={"version": 7})
     assert doc["meta"]["fingerprint"]
+    from kubernetes_tpu.ops.learned import FEATURE_VERSION
+
     loaded, meta = load_checkpoint(path)
     assert meta["version"] == 7
-    assert meta["feature_version"] == 1
+    assert meta["feature_version"] == FEATURE_VERSION
     assert len(loaded) == 2
     for (w0, b0), (w1, b1) in zip(params, loaded):
         np.testing.assert_array_equal(np.asarray(w0, np.float32), w1)
@@ -127,10 +133,10 @@ def test_checkpoint_roundtrip(tmp_path):
     json.dumps({"format_version": 99, "layers": []}),
     json.dumps({"format_version": 1, "feature_version": 99,
                 "layers": [{"w": [[1.0]], "b": [0.0]}]}),
-    json.dumps({"format_version": 1, "feature_version": 1,
+    json.dumps({"format_version": 1, "feature_version": FEATURE_VERSION,
                 "layers": [{"w": [[1.0] * 3] * NUM_FEATURES,
                             "b": [0.0] * 3}]}),   # head not scalar
-    json.dumps({"format_version": 1, "feature_version": 1,
+    json.dumps({"format_version": 1, "feature_version": FEATURE_VERSION,
                 "layers": [{"w": [[1.0]], "b": [0.0]}]}),  # wrong fan-in
 ], ids=["garbage", "format", "feature", "head", "fanin"])
 def test_checkpoint_corrupt_rejected(tmp_path, payload):
@@ -237,14 +243,22 @@ def test_fine_tune_moves_scorer_toward_outcomes():
 
 def test_identity_params_reproduce_hand_tuned_aggregate():
     # on feature rows where every score is s/100, the identity stack
-    # returns the hand-tuned (non-topology) aggregate rescaled to 0..100
+    # returns the hand-tuned aggregate rescaled to 0..100 (since v3 the
+    # feature vector carries the spread/ipa columns too — derived from
+    # the LIVE hand_weight_vector, so the fixture tracks the layout)
+    from kubernetes_tpu.ops.learned import hand_weight_vector
+
+    n_scores = NUM_FEATURES - 2          # frac_cpu/frac_mem carry w=0
     feats = np.zeros((4, NUM_FEATURES), np.float32)
-    feats[:, 2:] = np.array([[1.0, 1.0, 1.0, 1.0, 1.0],
-                             [0.0, 0.0, 0.0, 0.0, 0.0],
-                             [0.5, 0.5, 0.5, 0.5, 0.5],
-                             [1.0, 0.0, 0.0, 0.0, 0.0]], np.float32)
+    feats[0, 2:] = 1.0
+    feats[2, 2:] = 0.5
+    feats[3, 2] = 1.0                    # fit-only row
+    hand = hand_weight_vector()
+    fit_only = 100.0 * hand[2] / hand.sum()
     out = np.asarray(mlp_apply(identity_params(), jnp.asarray(feats)))
-    np.testing.assert_allclose(out, [100.0, 0.0, 50.0, 12.5], atol=1e-4)
+    np.testing.assert_allclose(out, [100.0, 0.0, 50.0, fit_only],
+                               atol=1e-4)
+    assert n_scores == hand[2:].size
 
 
 # ---------------------------------------------------------- replay ---
