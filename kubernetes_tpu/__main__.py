@@ -77,6 +77,16 @@ def main(argv=None) -> int:
                         default=15.0)
     parser.add_argument("--id", default=None,
                         help="leader election identity")
+    parser.add_argument("--slices", action="store_true",
+                        help="horizontal scale-out: join the scheduler-"
+                             "replica slice ring and drain only pods "
+                             "whose namespace hashes into this "
+                             "replica's owned slices (run N such "
+                             "processes against one --hub; supersedes "
+                             "--leader-elect)")
+    parser.add_argument("--slice-heartbeat", type=float, default=2.0,
+                        help="with --slices: registry heartbeat period "
+                             "seconds (the TTL is 5x this, floor 10s)")
     parser.add_argument("--feature-gates", default="",
                         help="comma-separated gate=bool overrides")
     parser.add_argument("--fleet-endpoint", action="append", default=[],
@@ -192,7 +202,19 @@ def main(argv=None) -> int:
               f"{args.bind_address}:{serving.port}", file=sys.stderr)
 
     elector = None
-    if args.leader_elect:
+    if args.slices:
+        from kubernetes_tpu.leaderelection import SliceManager
+
+        identity = args.id or f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
+        url = (f"http://{args.bind_address}:{serving.port}"
+               if serving is not None else "")
+        elector = SliceManager(
+            hub, identity, url=url,
+            heartbeat_s=args.slice_heartbeat,
+            ttl_s=max(10.0, 5 * args.slice_heartbeat))
+        print(f"slice scale-out enabled, id={identity} "
+              f"(heartbeat {args.slice_heartbeat}s)", file=sys.stderr)
+    elif args.leader_elect:
         from kubernetes_tpu.leaderelection import LeaderElector
 
         identity = args.id or f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"

@@ -374,6 +374,29 @@ class JobQueue:
             if t is not None:
                 t.idle = False      # a shrunk unit may now fit quota
 
+    def drain_unowned(self, owns: Callable[[Pod], bool]) -> list[Pod]:
+        """Scale-out rebalance support: remove and return every queued
+        pod whose UNIT ``owns`` disclaims. Judged per unit, not per
+        member — a gang routes whole by its PodGroup's ring slot
+        (``pod_group_key`` carries the group's namespace, the hash
+        input), so a rebalance mid-assembly re-homes the entire unit to
+        the new owner instead of splitting members across replicas, the
+        same never-split discipline ``set_group`` enforces across
+        tenants. ``remove`` per member keeps the quota credit and
+        pending-bound bookkeeping on the normal path."""
+        out: list[Pod] = []
+        pools = [t.units for t in self._tenants.values()]
+        pools.append(self._orphans)
+        for pool in pools:
+            for unit in list(pool.values()):
+                pods = list(unit.pods.values())
+                if not pods or owns(pods[0]):
+                    continue
+                for pod in pods:
+                    self.remove(pod)
+                    out.append(pod)
+        return out
+
     def note_bound(self, pod: Pod) -> None:
         """An already-bound tenant pod surfaced through the informer
         (startup replay / foreign bind): reserve its quota so admission

@@ -244,6 +244,25 @@ class PriorityQueue:
         self._backoff.delete(uid)
         self._pop_parked(uid)
 
+    def drain_unowned(self, owns: Callable[[Pod], bool]) -> list[Pod]:
+        """Scale-out rebalance support: remove and return every queued
+        pod ``owns`` disclaims — active, backoff, unschedulable, and
+        gated alike. The caller (the scheduler's slice sync) re-homes
+        them; pods mid-cycle in ``_in_flight`` are left to finish and
+        get fenced at bind if the slice really moved."""
+        out: list[Pod] = []
+        for heap in (self._active, self._backoff):
+            for qp in list(heap.list()):
+                if not owns(qp.pod):
+                    heap.delete(qp.uid)
+                    out.append(qp.pod)
+        for pool in (self._unschedulable, self._gated):
+            for uid, qp in list(pool.items()):
+                if not owns(qp.pod):
+                    self._pop_parked(uid)
+                    out.append(qp.pod)
+        return out
+
     # ------------- pop / in-flight -------------
 
     def pop(self) -> Optional[QueuedPodInfo]:

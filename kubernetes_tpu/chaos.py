@@ -1434,6 +1434,224 @@ def run_gang_storm(gangs: int = 10, nodes: int = 16, seed: int = 17,
     return report
 
 
+# --------------------------------------------------------------------------
+# scale-out storm: N scheduler replicas, kill -9 one mid-wave (ISSUE 16)
+# --------------------------------------------------------------------------
+
+
+def run_scaleout_storm(pods: int = 240, nodes: int = 12,
+                       replicas: int = 4, seed: int = 23,
+                       timeout_s: float = 240.0) -> dict:
+    """Horizontal scale-out under fire: ``replicas`` scheduler replicas
+    drain the pending-pod space through the proc fabric, each owning a
+    slice of the namespace ring; one replica is torn down ABRUPTLY
+    (transport severed first, so its graceful release can never reach
+    the board — the in-process analog of kill -9) mid-wave. ``ok`` iff
+    its slices reassign within the registry TTL, every pod still binds
+    EXACTLY once fleet-wide (journal-replay audit + live watch ledger),
+    the slice-fence epoch is monotone across the rebalances, and a bind
+    carrying a stale slice epoch is rejected Fenced."""
+    import tempfile
+
+    from kubernetes_tpu.config.types import default_config
+    from kubernetes_tpu.fabric.supervisor import spawn_local_cluster
+    from kubernetes_tpu.hub import EventHandlers, Fenced
+    from kubernetes_tpu.hubclient import RemoteHub
+    from kubernetes_tpu.leaderelection import (
+        SCHED_SLICE_LEASE,
+        SliceManager,
+        ring_slot,
+    )
+    from kubernetes_tpu.ops.features import Capacities
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing import MakeNode, MakePod, \
+        audit_bind_journal
+
+    namespaces = [f"ns-{i}" for i in range(12)]
+    report: dict = {"pods": pods, "nodes": nodes, "seed": seed,
+                    "replicas": replicas}
+    wal_dir = tempfile.mkdtemp(prefix="scaleout-wal-")
+    cluster = spawn_local_cluster(pod_shards=2, wal_dir=wal_dir)
+    admin = RemoteHub(cluster.router_url, timeout=10.0,
+                      retry_deadline=3.0, retry_base=0.01,
+                      retry_cap=0.2)
+    scheds: dict[str, Scheduler] = {}
+    clients: dict[str, RemoteHub] = {}
+    managers: dict[str, SliceManager] = {}
+    killed: list[Scheduler] = []
+    ttl_s = 2.0
+
+    def spawn(ident: str) -> None:
+        client = RemoteHub(cluster.router_url, timeout=10.0,
+                           retry_deadline=3.0, retry_base=0.01,
+                           retry_cap=0.2)
+        cfg = default_config()
+        cfg.batch_size = 32
+        sched = Scheduler(client, cfg,
+                          caps=Capacities(nodes=max(32, nodes * 2),
+                                          pods=1024))
+        sm = SliceManager(client, ident, heartbeat_s=0.25, ttl_s=ttl_s)
+        sched.start(elector=sm)
+        clients[ident], scheds[ident], managers[ident] = \
+            client, sched, sm
+
+    try:
+        for i in range(nodes):
+            admin.create_node(MakeNode().name(f"sn-{i}")
+                              .capacity(cpu="64", memory="256Gi",
+                                        pods="440").obj())
+        # exactly-once ledger off the router's merged watch stream (the
+        # live counterpart of the journal-replay audit below)
+        bind_counts: dict[str, int] = {}
+        block = threading.Lock()
+
+        def on_update(old, new) -> None:
+            if not old.spec.node_name and new.spec.node_name:
+                with block:
+                    uid = new.metadata.uid
+                    bind_counts[uid] = bind_counts.get(uid, 0) + 1
+
+        admin.watch_pods(EventHandlers(on_update=on_update),
+                         replay=False)
+        for i in range(replicas):
+            spawn(f"sched-{i}")
+
+        uids: list[str] = []
+
+        def create_wave(lo: int, hi: int) -> None:
+            for i in range(lo, hi):
+                pod = MakePod().name(f"sp-{i}") \
+                    .namespace(namespaces[i % len(namespaces)]) \
+                    .req(cpu="50m").obj()
+                uids.append(pod.metadata.uid)
+                admin.create_pod(pod)
+
+        def bound_count() -> int:
+            try:
+                return sum(1 for p in admin.list_pods()
+                           if p.spec.node_name)
+            except Exception:  # noqa: BLE001 — mid-kill window
+                return -1
+
+        # phase 1: first wave drains across the ring's settle-in
+        create_wave(0, pods // 2)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 60.0 \
+                and bound_count() < pods // 8:
+            time.sleep(0.2)
+        epoch_before = admin.leases.epoch_of(SCHED_SLICE_LEASE)
+        ring = admin.fabric_sched_ring()
+        report["epoch_before_kill"] = epoch_before
+        report["ring_epoch_before_kill"] = ring["epoch"]
+        # the victim must own pending work: take the owner of the ring
+        # slot a seed-picked namespace hashes into
+        ns_kill = namespaces[seed % len(namespaces)]
+        slots = ring["slots"]
+        victim = slots[ring_slot(ns_kill, len(slots))] if slots else \
+            f"sched-{seed % replicas}"
+        report["victim"] = victim
+        report["victim_slots"] = sum(1 for s in slots if s == victim)
+
+        # phase 2: second wave lands, then kill -9 the victim mid-wave.
+        # Transport first — its release() and heartbeats can never
+        # reach the board, so recovery happens on the TTL clock alone
+        create_wave(pods // 2, pods)
+        dead = scheds.pop(victim)
+        killed.append(dead)
+        managers.pop(victim)
+        clients.pop(victim).close()
+        if dead._stop is not None:
+            dead._stop.set()
+        t_kill = time.monotonic()
+        reassign_s = None
+        while time.monotonic() - t_kill < ttl_s * 5 + 5.0:
+            try:
+                cur = admin.fabric_sched_ring()["slots"]
+            except Exception:  # noqa: BLE001 — transient
+                time.sleep(0.1)
+                continue
+            if cur and victim not in cur:
+                reassign_s = time.monotonic() - t_kill
+                break
+            time.sleep(0.1)
+        report["slice_reassign_s"] = reassign_s
+
+        # phase 3: survivors drain everything, the victim's slices
+        # included (pen adoption after the rebalance)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if bound_count() >= pods:
+                break
+            time.sleep(0.3)
+        bound = bound_count()
+        epoch_after = admin.leases.epoch_of(SCHED_SLICE_LEASE)
+        report["epoch_after"] = epoch_after
+        report["ring_epoch_after"] = \
+            admin.fabric_sched_ring()["epoch"]
+
+        # a bind carrying a pre-rebalance slice epoch must be rejected
+        # by the fence even now (probe schedulerName: no profile owns
+        # it, so no live replica races the check)
+        probe = MakePod().name("fence-probe").namespace("ns-0") \
+            .scheduler_name("fence-probe-noop").obj()
+        admin.create_pod(probe)
+        stale_fenced = False
+        if epoch_after > 0:
+            try:
+                admin.bind(probe, "sn-0", epoch_after - 1,
+                           SCHED_SLICE_LEASE)
+            except Fenced:
+                stale_fenced = True
+        try:
+            admin.delete_pod(probe.metadata.uid)
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+
+        # journal-replay audit: exactly-once across ALL replicas'
+        # commits, straight off the cluster's own commit record
+        audit = audit_bind_journal(hub=admin, expected_uids=uids)
+        with block:
+            dup = {uid: n for uid, n in bind_counts.items() if n > 1}
+        daemon_errors = {
+            ident: repr(s.daemon_error) for ident, s in scheds.items()
+            if getattr(s, "daemon_error", None) is not None}
+        report.update({
+            "bound": bound, "lost": pods - bound,
+            "duplicate_binds": dup,
+            "audit": {k: audit[k] for k in
+                      ("ok", "binds", "double_binds", "lost",
+                       "too_old")},
+            "stale_epoch_fenced": stale_fenced,
+            "fenced_binds": sum(s.stats.get("fenced", 0)
+                                for s in scheds.values()),
+            "rebalances": {i: m.rebalances
+                           for i, m in managers.items()},
+            "daemon_errors": daemon_errors,
+            "ok": (bound == pods and not dup and audit["ok"]
+                   and reassign_s is not None
+                   and reassign_s <= ttl_s * 5
+                   and epoch_after >= epoch_before >= 1
+                   and stale_fenced and not daemon_errors),
+        })
+    finally:
+        for s in list(scheds.values()) + killed:
+            try:
+                s.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        for c in clients.values():
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            admin.close()
+        except Exception:  # noqa: BLE001
+            pass
+        cluster.stop()
+    return report
+
+
 def main() -> None:
     import argparse
 
@@ -1443,7 +1661,7 @@ def main() -> None:
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--storm",
                     choices=("smoke", "device", "crash", "proc",
-                             "state", "gang", "all"),
+                             "state", "gang", "scaleout", "all"),
                     default="smoke",
                     help="which storm to run (bench.py --chaos-smoke "
                          "runs 'all')")
@@ -1461,6 +1679,8 @@ def main() -> None:
         report = run_state_storm(seed=args.seed)
     elif args.storm == "gang":
         report = run_gang_storm(seed=args.seed)
+    elif args.storm == "scaleout":
+        report = run_scaleout_storm(seed=args.seed)
     else:
         report = {
             "smoke": run_smoke(pods=args.pods, nodes=args.nodes,
@@ -1470,6 +1690,7 @@ def main() -> None:
             "proc": run_proc_crash_storm(seed=args.seed),
             "state": run_state_storm(seed=args.seed),
             "gang": run_gang_storm(seed=args.seed),
+            "scaleout": run_scaleout_storm(seed=args.seed),
         }
         report["ok"] = all(r.get("ok") for r in report.values())
     print(json.dumps(report, default=str))

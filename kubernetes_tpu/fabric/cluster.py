@@ -54,7 +54,6 @@ from __future__ import annotations
 
 import threading
 import time
-import zlib
 
 from kubernetes_tpu.hub import (
     Conflict,
@@ -63,9 +62,13 @@ from kubernetes_tpu.hub import (
     StaleRing,
     Unavailable,
 )
-from kubernetes_tpu.leaderelection import LeaseStore
+from kubernetes_tpu.leaderelection import (
+    RING_SLOTS,
+    LeaseStore,
+    SliceBoard,
+    ring_slot,
+)
 
-RING_SLOTS = 64                  # virtual slots on the namespace ring
 RELAY_TTL_S = 10.0               # a relay missing heartbeats this long
 #                                  drops out of the served topology
 
@@ -87,12 +90,9 @@ _SHARD_ONLY_METHODS = frozenset({"export_segment", "import_segment",
                                  "reconcile_ring"})
 
 
-def ring_slot(namespace: str, ring_size: int = RING_SLOTS) -> int:
-    """Deterministic namespace → ring slot (crc32, NOT Python's
-    randomized hash: the mapping must survive restarts and agree
-    between every router and shard process)."""
-    return zlib.crc32(namespace.encode("utf-8")) % ring_size
-
+# ring_slot / RING_SLOTS live in leaderelection (the bottom of the
+# import graph) since the scheduler slice ring became the crc32 ring's
+# second consumer; re-exported here so fabric code keeps one import path.
 
 # --------------------------------------------------------------------------
 # the shared-state shard
@@ -143,6 +143,9 @@ class StateCore:
         self._lock = threading.Lock()
         self.rv = _SharedRv()
         self.leases = LeaseStore()
+        # scheduler replicas ride the same registry/ring discipline as
+        # shards: heartbeats + TTL, slice map CAS'd by epoch
+        self.slices = SliceBoard(ring_slots=ring_slots)
         self._shards: dict[str, dict] = {}
         self._routers: dict[str, dict] = {}
         self._relays: dict[str, dict] = {}
@@ -201,7 +204,9 @@ class StateCore:
                     "relays": relays,
                     "shards": {n: dict(s)
                                for n, s in self._shards.items()},
-                    "ring_epoch": self._ring["epoch"]}
+                    "schedulers": self.slices.live(),
+                    "ring_epoch": self._ring["epoch"],
+                    "sched_ring_epoch": self.slices.ring()["epoch"]}
 
     # ------------- ring map -------------
 
@@ -219,6 +224,24 @@ class StateCore:
             self._ring = {"epoch": int(ring["epoch"]),
                           "slots": list(ring["slots"])}
             return True
+
+    # ------------- scheduler slice ring (the ring's second consumer) ----
+
+    def fabric_register_scheduler(self, name: str, url: str = "",
+                                  pid: int | None = None) -> dict:
+        return self.slices.register(name, url, pid)
+
+    def fabric_unregister_scheduler(self, name: str) -> dict:
+        return self.slices.unregister(name)
+
+    def fabric_schedulers(self) -> dict:
+        return self.slices.schedulers()
+
+    def fabric_sched_ring(self) -> dict:
+        return self.slices.ring()
+
+    def fabric_set_sched_ring(self, ring: dict, expect_epoch: int) -> bool:
+        return self.slices.set_ring(ring, expect_epoch)
 
     # ------------- fleet surface -------------
 

@@ -61,7 +61,7 @@ import time
 from kubernetes_tpu.fabric import codec as binwire
 from kubernetes_tpu.fabric.cluster import RING_SLOTS, RELAY_TTL_S
 from kubernetes_tpu.hub import NotFound, NotLeader, Unavailable
-from kubernetes_tpu.leaderelection import LeaseStore
+from kubernetes_tpu.leaderelection import SCHEDULER_TTL_S, LeaseStore
 
 ROLE_LEADER = "leader"
 ROLE_FOLLOWER = "follower"
@@ -261,10 +261,15 @@ class StateReplica:
                          "slots": [names[i % len(names)]
                                    for i in range(ring_slots)]} \
             if names else {"epoch": 0, "slots": []}
+        # scheduler slice ring: logged (not soft) — the slice map must
+        # survive a state-leader failover or every scheduler replica
+        # would race a from-scratch rebalance against epoch 0
+        self._sm_sched_ring = {"epoch": 0, "slots": []}
         # ---- soft state (gossiped, never logged) ----
         self._shards: dict[str, dict] = {}
         self._routers: dict[str, dict] = {}
         self._relays: dict[str, dict] = {}
+        self._schedulers: dict[str, dict] = {}
         self._clients: dict[str, object] = {}
         if client_factory is None:
             from kubernetes_tpu.hubclient import RemoteHub
@@ -462,7 +467,9 @@ class StateReplica:
                         "routers": {n: dict(r)
                                     for n, r in self._routers.items()},
                         "relays": {n: dict(r)
-                                   for n, r in self._relays.items()}}
+                                   for n, r in self._relays.items()},
+                        "schedulers": {n: dict(r) for n, r in
+                                       self._schedulers.items()}}
                 batches = {}
                 for p in self._other_peers():
                     ni = self._next_idx.get(p, self._last_index() + 1)
@@ -601,12 +608,19 @@ class StateReplica:
         return {"rv": self._sm_rv,
                 "ring": {"epoch": self._sm_ring["epoch"],
                          "slots": list(self._sm_ring["slots"])},
+                "sched_ring": {
+                    "epoch": self._sm_sched_ring["epoch"],
+                    "slots": list(self._sm_sched_ring["slots"])},
                 "leases": self._sm_leases.dump()}
 
     def _sm_load_locked(self, state: dict) -> None:
         self._sm_rv = int(state["rv"])
         self._sm_ring = {"epoch": int(state["ring"]["epoch"]),
                          "slots": list(state["ring"]["slots"])}
+        # absent in pre-scale-out snapshots/WALs: default to empty
+        sr = state.get("sched_ring") or {"epoch": 0, "slots": []}
+        self._sm_sched_ring = {"epoch": int(sr["epoch"]),
+                               "slots": list(sr["slots"])}
         self._sm_leases.restore(state["leases"])
 
     def _install_snapshot_locked(self, snap: dict,
@@ -668,6 +682,13 @@ class StateReplica:
             self._sm_ring = {"epoch": int(ring["epoch"]),
                              "slots": list(ring["slots"])}
             return True
+        if verb == "sched_ring.set":
+            ring, expect = op[1], int(op[2])
+            if self._sm_sched_ring["epoch"] != expect:
+                return False
+            self._sm_sched_ring = {"epoch": int(ring["epoch"]),
+                                   "slots": list(ring["slots"])}
+            return True
         raise ValueError(f"unknown replicated op {verb!r}")
 
     # ------------- Raft RPCs (served over /call) -------------
@@ -712,6 +733,9 @@ class StateReplica:
                 self._relays = {n: dict(r)
                                 for n, r in soft.get("relays",
                                                      {}).items()}
+                self._schedulers = {n: dict(r)
+                                    for n, r in soft.get("schedulers",
+                                                         {}).items()}
             if snapshot is not None \
                     and int(snapshot["idx"]) > self._commit:
                 # the leader compacted past our log: install its state
@@ -799,6 +823,29 @@ class StateReplica:
             self._relays[rec["name"]] = rec
             return {"ok": True}
 
+    def fabric_register_scheduler(self, name: str, url: str = "",
+                                  pid: int | None = None) -> dict:
+        """Scheduler-replica heartbeat: soft registry (gossiped like
+        relays), but the returned slice ring is log-applied state."""
+        self._require_leader()
+        with self._lock:
+            self._schedulers[name] = {"name": name, "url": url,
+                                      "pid": pid, "ts": time.time()}
+            return {"ring": {
+                "epoch": self._sm_sched_ring["epoch"],
+                "slots": list(self._sm_sched_ring["slots"])}}
+
+    def fabric_unregister_scheduler(self, name: str) -> dict:
+        self._require_leader()
+        with self._lock:
+            self._schedulers.pop(name, None)
+            return {"ok": True}
+
+    def fabric_schedulers(self) -> dict:
+        self._read_guard()
+        with self._lock:
+            return {n: dict(s) for n, s in self._schedulers.items()}
+
     def _require_leader(self) -> None:
         with self._lock:
             if self._role != ROLE_LEADER:
@@ -816,12 +863,16 @@ class StateReplica:
         with self._lock:
             relays = [dict(r) for r in self._relays.values()
                       if now - r["ts"] <= RELAY_TTL_S]
+            scheds = {n: dict(s) for n, s in self._schedulers.items()
+                      if now - s["ts"] <= SCHEDULER_TTL_S}
             return {"routers": [dict(r)
                                 for r in self._routers.values()],
                     "relays": relays,
                     "shards": {n: dict(s)
                                for n, s in self._shards.items()},
+                    "schedulers": scheds,
                     "ring_epoch": self._sm_ring["epoch"],
+                    "sched_ring_epoch": self._sm_sched_ring["epoch"],
                     "replicas": self._replica_rows_locked()}
 
     def _replica_rows_locked(self) -> list[dict]:
@@ -852,6 +903,17 @@ class StateReplica:
 
     def fabric_set_ring(self, ring: dict, expect_epoch: int) -> bool:
         return self._propose(["ring.set", dict(ring),
+                              int(expect_epoch)])
+
+    def fabric_sched_ring(self) -> dict:
+        self._read_guard()
+        with self._lock:
+            return {"epoch": self._sm_sched_ring["epoch"],
+                    "slots": list(self._sm_sched_ring["slots"])}
+
+    def fabric_set_sched_ring(self, ring: dict,
+                              expect_epoch: int) -> bool:
+        return self._propose(["sched_ring.set", dict(ring),
                               int(expect_epoch)])
 
     def fabric_replica_status(self) -> dict:

@@ -163,6 +163,12 @@ class ShardedHub:
         self.leases = LeaseStore()
         for s in self._shards:
             s.leases = self.leases
+        # ONE slice board for the same reason: the scheduler-replica
+        # slice map partitions the whole pending-pod space, so every
+        # shard must serve (and fence against) the same ring
+        self.slices = self._meta_shard.slices
+        for s in self._shards:
+            s.slices = self.slices
 
     # ------------- revision space -------------
 

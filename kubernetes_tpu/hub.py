@@ -229,12 +229,16 @@ class Hub:
                                wal_path=wal_path, wal_codec=wal_codec)
         if wal_path:
             self._replay_wal()
-        from kubernetes_tpu.leaderelection import LeaseStore
+        from kubernetes_tpu.leaderelection import LeaseStore, SliceBoard
 
         # leases are deliberately NOT journaled: leadership is ephemeral
         # by contract (a restarted hub must force re-election, not
         # resurrect a stale holder)
         self.leases = LeaseStore()
+        # scheduler-replica registry + pending-pod slice ring (same
+        # ephemerality argument: a restarted hub forces a re-register +
+        # rebalance, not a resurrected stale slice map)
+        self.slices = SliceBoard()
 
     # ------------- revision space / journal -------------
 
@@ -812,6 +816,27 @@ class Hub:
             new.spec.node_name = node_name
             ev = self._swap_pod(stored, new)
         self._dispatch(self._pods, ev)
+
+    # ------------- scheduler scale-out: slice registry + ring -------------
+    # The same verbs the fabric's StateCore serves, so a SliceManager
+    # works identically against an in-process hub (tests, single-box
+    # multi-replica runs) and the replicated control plane.
+
+    def fabric_register_scheduler(self, name: str, url: str = "",
+                                  pid: int | None = None) -> dict:
+        return self.slices.register(name, url, pid)
+
+    def fabric_unregister_scheduler(self, name: str) -> dict:
+        return self.slices.unregister(name)
+
+    def fabric_schedulers(self) -> dict:
+        return self.slices.schedulers()
+
+    def fabric_sched_ring(self) -> dict:
+        return self.slices.ring()
+
+    def fabric_set_sched_ring(self, ring: dict, expect_epoch: int) -> bool:
+        return self.slices.set_ring(ring, expect_epoch)
 
     def patch_pod_condition(self, pod: Pod, condition: PodCondition,
                             nominated_node: str | None = None,

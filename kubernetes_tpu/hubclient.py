@@ -100,6 +100,11 @@ IDEMPOTENT_METHODS = frozenset(
         "fabric_register_shard", "fabric_register_relay",
         "fabric_register_router", "fabric_topology", "fabric_shards",
         "fabric_ring", "fabric_replica_status",
+        # re-registering (or re-dropping) a scheduler replica is
+        # idempotent like the other registries; fabric_set_sched_ring
+        # is a CAS and deliberately NOT here (same as fabric_set_ring)
+        "fabric_register_scheduler", "fabric_unregister_scheduler",
+        "fabric_schedulers", "fabric_sched_ring",
     })
 
 # a response from these statuses is the PATH failing, not the hub's

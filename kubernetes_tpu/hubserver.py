@@ -96,6 +96,10 @@ CALL_METHODS = frozenset({
     "fabric_register_shard", "fabric_register_relay",
     "fabric_register_router", "fabric_topology", "fabric_shards",
     "fabric_ring", "fabric_set_ring",
+    # scheduler scale-out: replica registry + pending-pod slice ring
+    # (the crc32 ring's second consumer)
+    "fabric_register_scheduler", "fabric_unregister_scheduler",
+    "fabric_schedulers", "fabric_sched_ring", "fabric_set_sched_ring",
     "export_segment", "import_segment", "drop_segment",
     "abort_export", "reconcile_ring",
     "rebalance_segment",

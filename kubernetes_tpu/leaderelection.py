@@ -20,11 +20,29 @@ over within the lease duration instead of waiting out a zombie.
 from __future__ import annotations
 
 import logging
+import os
+import threading
 import time
+import zlib
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 logger = logging.getLogger("kubernetes_tpu.leaderelection")
+
+RING_SLOTS = 64              # virtual slots on the namespace crc32 ring
+SCHEDULER_TTL_S = 10.0       # a scheduler replica missing heartbeats
+#                              this long loses its slices to the others
+SCHED_SLICE_LEASE = "kube-scheduler-slices"   # the slice-map fence lease
+
+
+def ring_slot(namespace: str, ring_size: int = RING_SLOTS) -> int:
+    """Deterministic namespace → ring slot (crc32, NOT Python's
+    randomized hash: the mapping must survive restarts and agree
+    between every router, shard, and scheduler replica). Shared by the
+    pod-shard ring (fabric.cluster) and the scheduler slice ring — the
+    two consumers partition on the same hash so operators reason about
+    one placement function."""
+    return zlib.crc32(namespace.encode("utf-8")) % ring_size
 
 
 @dataclass
@@ -268,3 +286,270 @@ class LeaderElector:
             self._leading = False
             if self.on_stopped_leading:
                 self.on_stopped_leading()
+
+
+# --------------------------------------------------------------------------
+# horizontal scale-out: the slice board + slice-lease manager
+# --------------------------------------------------------------------------
+
+
+class SliceBoard:
+    """The scheduler-replica registry + pending-pod slice ring — the
+    state core's crc32 ring machinery generalized to its second
+    consumer. Replicas heartbeat into the registry (soft state, TTL'd
+    like relays); the ring maps each of the ``RING_SLOTS`` namespace
+    slots to the replica that drains it, CAS'd by epoch so two
+    replicas racing a rebalance cannot both win.
+
+    Lives on the in-process ``Hub`` and the fabric's ``StateCore``.
+    The replicated ``StateReplica`` keeps the RING in its log-applied
+    state machine instead (the ``sched_ring.set`` op — a slice map
+    must survive leader failover) and gossips only the registry."""
+
+    def __init__(self, ring_slots: int = RING_SLOTS) -> None:
+        self._lock = threading.Lock()
+        self.ring_slots = ring_slots
+        self._ring: dict = {"epoch": 0, "slots": []}
+        self._schedulers: dict[str, dict] = {}
+
+    def register(self, name: str, url: str = "",
+                 pid: int | None = None) -> dict:
+        """Heartbeat-register a scheduler replica; returns the current
+        slice ring so one round-trip both announces and refreshes."""
+        with self._lock:
+            self._schedulers[name] = {"name": name, "url": url,
+                                      "pid": pid, "ts": time.time()}
+            return {"ring": {"epoch": self._ring["epoch"],
+                             "slots": list(self._ring["slots"])}}
+
+    def unregister(self, name: str) -> dict:
+        """Graceful departure: drop the registration so peers re-home
+        the replica's slices now instead of waiting out the TTL."""
+        with self._lock:
+            self._schedulers.pop(name, None)
+            return {"ok": True}
+
+    def schedulers(self) -> dict:
+        with self._lock:
+            return {n: dict(s) for n, s in self._schedulers.items()}
+
+    def live(self, ttl_s: float = SCHEDULER_TTL_S) -> dict:
+        """Registrations with a heartbeat inside ``ttl_s`` (the served
+        topology row set)."""
+        now = time.time()
+        with self._lock:
+            return {n: dict(s) for n, s in self._schedulers.items()
+                    if now - s["ts"] <= ttl_s}
+
+    def ring(self) -> dict:
+        with self._lock:
+            return {"epoch": self._ring["epoch"],
+                    "slots": list(self._ring["slots"])}
+
+    def set_ring(self, ring: dict, expect_epoch: int) -> bool:
+        """CAS by epoch — identical discipline to the pod-shard ring."""
+        with self._lock:
+            if self._ring["epoch"] != int(expect_epoch):
+                return False
+            self._ring = {"epoch": int(ring["epoch"]),
+                          "slots": list(ring["slots"])}
+            return True
+
+
+def rebalance_slots(slots: list, live: list[str],
+                    ring_slots: int = RING_SLOTS) -> list:
+    """Minimal-churn slice assignment: every slot owned by a live
+    replica stays put (up to an even ceiling), orphaned and overflow
+    slots go to the least-loaded live replica. Deterministic, so every
+    replica computing the next map from the same inputs proposes the
+    same CAS — racers collide on the epoch, not on divergent maps."""
+    live_sorted = sorted(set(live))
+    if not live_sorted:
+        return list(slots)
+    size = len(slots) or ring_slots
+    target = -(-size // len(live_sorted))      # ceil
+    counts = {r: 0 for r in live_sorted}
+    out = list(slots) + [None] * (size - len(slots))
+    for i, owner in enumerate(out):
+        if owner in counts and counts[owner] < target:
+            counts[owner] += 1
+        else:
+            out[i] = None
+    for i, owner in enumerate(out):
+        if owner is None:
+            r = min(live_sorted, key=lambda x: (counts[x], x))
+            out[i] = r
+            counts[r] += 1
+    return out
+
+
+class SliceManager:
+    """The elector generalized to N concurrent scheduler replicas: each
+    replica heartbeats into the slice board, rebalances the slice ring
+    when the live set changes (join/death — exactly the pod-shard
+    rebalance discipline), and drains only pods whose namespace hashes
+    into its owned slots.
+
+    Presents the ``LeaderElector`` surface (``tick``/``is_leader``/
+    ``release``/``epoch``/``lease_name``/``retry_period``) so
+    ``Scheduler.run`` gates on it unchanged; ``epoch`` is the fencing
+    token of the SLICE lease, whose holder identity encodes the ring
+    epoch — every committed rebalance is a holder change, so the lease
+    store stamps a fresh fencing epoch and every bind submitted under
+    the OLD map loses the fence and requeues (``hub.bind``'s
+    deposed-leader path). Fencing here is the belt; the hub's bind-once
+    ``Conflict`` is the suspenders — correctness never depends on
+    replicas coordinating in-band, so a stale map only costs a requeue.
+
+    Single-replica deployments keep using ``LeaderElector`` (or no
+    elector at all): this class is the scale-out rung, not a
+    replacement for the fallback."""
+
+    is_slice_manager = True
+
+    def __init__(self, hub, identity: str, url: str = "",
+                 lease_name: str = SCHED_SLICE_LEASE,
+                 heartbeat_s: float = 2.0,
+                 ttl_s: float = SCHEDULER_TTL_S,
+                 ring_slots: int = RING_SLOTS,
+                 now: Callable[[], float] = time.time):
+        self.hub = hub
+        self.identity = identity
+        self.url = url
+        self.lease_name = lease_name
+        self.heartbeat_s = heartbeat_s
+        self.retry_period = heartbeat_s   # Scheduler.run's idle wait
+        self.ttl_s = ttl_s
+        self.ring_slots = ring_slots
+        self.now = now
+        # fencing token captured WITH the slice map observation (binds
+        # carry it; a later rebalance bumps the lease past it)
+        self.epoch = 0
+        self.ring_epoch = 0
+        self.owned: frozenset = frozenset()
+        self.generation = 0        # bumps whenever `owned` changes
+        self.rebalances = 0        # maps THIS replica CAS'd in
+        self.transport_errors = 0
+        self._slots: list = []
+        self._leading = False
+        self._last_try = 0.0
+        self._last_ok = 0.0
+
+    # ------------- elector surface -------------
+
+    def is_leader(self) -> bool:
+        return self._leading
+
+    def tick(self) -> bool:
+        """Rate-limited heartbeat + rebalance check. Exception-safe:
+        transport errors keep the CURRENT slices until the TTL runs out
+        (the registry's own expiry clock — a blip must not stall the
+        drain; past the TTL peers have re-homed our slices, so
+        continuing to schedule them would only burn fenced binds)."""
+        now = self.now()
+        if now - self._last_try < self.heartbeat_s:
+            if self._leading and now - self._last_ok > self.ttl_s:
+                self._leading = False
+            return self._leading
+        self._last_try = now
+        try:
+            self._heartbeat(now)
+            self._last_ok = now
+            self._leading = bool(self.owned)
+        except Exception as e:  # noqa: BLE001 — remote board transport
+            self.transport_errors += 1
+            logger.warning("slices: board unreachable (%r)", e)
+            if now - self._last_ok > self.ttl_s:
+                self._leading = False
+        return self._leading
+
+    def release(self) -> None:
+        """Graceful departure: deregister and re-home our slices NOW so
+        peers pick up the pending backlog without waiting out the TTL.
+        Best-effort over an unreachable board — the registration then
+        simply expires and peers rebalance on their own clock."""
+        self._leading = False
+        if self.owned:
+            self.owned = frozenset()
+            self.generation += 1
+        try:
+            hub = self.hub
+            hub.fabric_unregister_scheduler(self.identity)
+            live = [n for n in self._live_replicas(self.now())
+                    if n != self.identity]
+            if live:
+                self._maybe_rebalance(hub.fabric_sched_ring(), live)
+        except Exception as e:  # noqa: BLE001 — TTL expiry heals it
+            self.transport_errors += 1
+            logger.warning("slices: release failed (%r); slices "
+                           "re-home at the registry TTL", e)
+
+    # ------------- partition surface (the scheduler's filter) -------------
+
+    def owns_namespace(self, namespace: str) -> bool:
+        slots = self._slots
+        if not slots:
+            return False
+        return slots[ring_slot(namespace, len(slots))] == self.identity
+
+    def owned_slots(self) -> frozenset:
+        return self.owned
+
+    # ------------- internals -------------
+
+    def _live_replicas(self, now: float) -> list:
+        regs = self.hub.fabric_schedulers()
+        live = [n for n, r in regs.items()
+                if now - float(r.get("ts", 0.0)) <= self.ttl_s]
+        if self.identity not in live:
+            live.append(self.identity)
+        return live
+
+    def _heartbeat(self, now: float) -> None:
+        reg = self.hub.fabric_register_scheduler(
+            self.identity, self.url, os.getpid())
+        ring = reg.get("ring") or {"epoch": 0, "slots": []}
+        ring = self._maybe_rebalance(ring, self._live_replicas(now))
+        # the fence must track the map: a committed rebalance whose
+        # lease bump was lost to a transport blip would leave deposed
+        # owners unfenced (bind-once still protects; this restores the
+        # belt), so the sync re-runs until holder matches ring epoch
+        self._sync_fence(int(ring.get("epoch", 0)), now)
+        self.epoch = int(self.hub.leases.epoch_of(self.lease_name))
+        self.ring_epoch = int(ring.get("epoch", 0))
+        self._slots = list(ring.get("slots") or [])
+        owned = frozenset(i for i, o in enumerate(self._slots)
+                          if o == self.identity)
+        if owned != self.owned:
+            self.owned = owned
+            self.generation += 1
+
+    def _maybe_rebalance(self, ring: dict, live: list) -> dict:
+        slots = list(ring.get("slots") or [])
+        epoch = int(ring.get("epoch", 0))
+        if not live:
+            return ring
+        want = rebalance_slots(slots, live, self.ring_slots)
+        if want == slots:
+            return ring
+        new_ring = {"epoch": epoch + 1, "slots": want}
+        if bool(self.hub.fabric_set_sched_ring(new_ring, epoch)):
+            self.rebalances += 1
+            return new_ring
+        # lost the CAS: a peer rebalanced first — adopt the winner's map
+        return self.hub.fabric_sched_ring()
+
+    def _sync_fence(self, ring_epoch: int, now: float) -> None:
+        """Mirror the slice-map epoch into the slice lease: the lease
+        store stamps fencing epochs on HOLDER change, so the holder
+        identity encodes the ring epoch — each rebalance is exactly one
+        holder change, and a re-applied sync is none."""
+        holder = f"slices@{ring_epoch}"
+        cur = self.hub.leases.get(self.lease_name)
+        cur_holder = cur.holder_identity if cur is not None else None
+        if cur_holder == holder:
+            return
+        self.hub.leases.update(Lease(
+            name=self.lease_name, holder_identity=holder,
+            lease_duration_seconds=self.ttl_s,
+            acquire_time=now, renew_time=now), cur_holder)

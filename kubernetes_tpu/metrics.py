@@ -331,6 +331,19 @@ class SchedulerMetrics:
             "scheduler_device_fallbacks_total",
             "Batches degraded from the fused device launch to the host "
             "Filter/Score path after a device fault"))
+        # horizontal scale-out: this replica's view of the slice ring
+        self.sched_slices_owned = r.register(Gauge(
+            "scheduler_slices_owned",
+            "Namespace-ring slots this scheduler replica currently "
+            "drains (0 = not participating or awaiting a slice)"))
+        self.slice_rebalances = r.register(Counter(
+            "scheduler_slice_rebalances_total",
+            "Slice-map changes this replica converged its queues to "
+            "(join/death of a peer, or its own join)"))
+        self.foreign_pending_pods = r.register(Gauge(
+            "scheduler_foreign_pending_pods",
+            "Pending pods penned because their namespace hashes into "
+            "a peer replica's slice"))
         # device-launch profiler (telemetry/profiler.py): XLA compile
         # attribution per bucket-shape transition + resident HBM bytes
         self.device_compiles = r.register(Counter(

@@ -323,7 +323,18 @@ class Scheduler:
                       "device_fallbacks": 0, "quarantined": 0,
                       "drift_repairs": 0, "drift_full_lists": 0,
                       "drift_incremental": 0,
-                      "gang_device_launches": 0, "gang_fallbacks": 0}
+                      "gang_device_launches": 0, "gang_fallbacks": 0,
+                      "slice_rebalances": 0, "foreign_stashed": 0,
+                      "foreign_adopted": 0}
+        # horizontal scale-out: when run() is handed a SliceManager the
+        # replica drains only pods whose namespace (gang: the GROUP's
+        # namespace) hashes into its owned ring slots. Everything else
+        # waits in the foreign pen — cheap Pod refs, no queue/cache
+        # residency — until a rebalance re-homes the slice here or the
+        # true owner binds it. None = single-replica mode, zero filter.
+        self._slices = None
+        self._slice_gen = -1
+        self._foreign: dict[str, Pod] = {}
         # poison-pod quarantine: uid -> {"qp", "until", "reason"};
         # strike/quarantine counts survive release so a re-offender's
         # backoff keeps escalating
@@ -393,14 +404,26 @@ class Scheduler:
 
     def _wrap(self, fn):
         """Route events raised by the binder pool's own API writes to the
-        deferred queue (replayed on the loop thread); take the scheduler
-        lock for every other caller — the informer-thread contract."""
+        deferred queue (replayed on the loop thread); for every other
+        caller, apply inline under the scheduler lock when it's free
+        and defer when it's contended. Blocking on a contended lock
+        here deadlocks scale-out: two in-process replicas share one
+        hub, so replica A's bind delivers this event on a thread that
+        sits inside A's locked drain while OUR loop holds our lock
+        delivering into A — both hands full, neither lets go. The
+        deferred queue replays on the loop thread either way; per-pod
+        rv dedup absorbs the cross-thread reordering this admits."""
         def handler(*args):
             if threading.get_ident() in self._binder_tids:
                 self._deferred_events.append((fn, args))
                 return
-            with self._lock:
-                fn(*args)
+            if self._lock.acquire(blocking=False):
+                try:
+                    fn(*args)
+                finally:
+                    self._lock.release()
+            else:
+                self._deferred_events.append((fn, args))
         return handler
 
     def _process_deferred_events(self) -> None:
@@ -542,6 +565,33 @@ class Scheduler:
     def _ours(self, pod: Pod) -> bool:
         return pod.spec.scheduler_name in self.frameworks
 
+    def _owns_pod(self, pod: Pod) -> bool:
+        """Scale-out slice filter: does this replica's owned ring slice
+        cover the pod? Single-replica mode (no SliceManager) owns
+        everything. Gang members hash by their GROUP's namespace —
+        ``pod_group_key`` is ``namespace/name``, and members share the
+        group's namespace — so a gang can never straddle replicas."""
+        sm = self._slices
+        if sm is None:
+            return True
+        gang = pod_group_key(pod)
+        ns = (gang.split("/", 1)[0] if gang is not None
+              else pod.metadata.namespace)
+        return sm.owns_namespace(ns)
+
+    def _stash_foreign(self, pod: Pod) -> None:
+        """Pen a pending pod another replica owns: dropped from our
+        queues (it may have been ours before a rebalance), kept as a
+        bare Pod ref so a later rebalance can adopt it without a
+        relist. The pen self-cleans on bind/delete events."""
+        uid = pod.metadata.uid
+        self._foreign[uid] = pod
+        self.queue.delete(pod)
+        self.nominator.delete(uid)
+        if self.jobqueue.active and self.jobqueue.holds(uid):
+            self.jobqueue.remove(pod)
+        self.stats["foreign_stashed"] += 1
+
     def _quarantine_holds(self, pod: Pod) -> bool:
         """A quarantined pod must not re-enter the queue through an
         informer add/update — a controller status patch or relist replay
@@ -622,6 +672,7 @@ class Scheduler:
         if self._pod_event_stale(pod):
             return
         if pod.spec.node_name:
+            self._foreign.pop(pod.metadata.uid, None)
             if not self.cache.is_assumed_pod(pod):
                 self._invalidate_chain()
             self.cache.add_pod(pod)
@@ -631,8 +682,13 @@ class Scheduler:
         elif not self._terminal(pod) and self._ours(pod) \
                 and not self._quarantine_holds(pod):
             # foreign schedulerName pods are another scheduler's business
-            # (schedule_one.go:371); restart/replay: re-seed nominations
-            # from status so reservations survive a scheduler restart
+            # (schedule_one.go:371); foreign SLICE pods belong to a peer
+            # replica — penned, not queued
+            if not self._owns_pod(pod):
+                self._stash_foreign(pod)
+                return
+            # restart/replay: re-seed nominations from status so
+            # reservations survive a scheduler restart
             if pod.status.nominated_node_name:
                 self.nominator.add(pod, pod.status.nominated_node_name)
             if self.flight.enabled:
@@ -643,6 +699,7 @@ class Scheduler:
         if self._pod_event_stale(new):
             return
         if new.spec.node_name:
+            self._foreign.pop(new.metadata.uid, None)
             if not self.cache.is_assumed_pod(new):
                 self._invalidate_chain()
             self.nominator.delete(new.metadata.uid)
@@ -662,6 +719,17 @@ class Scheduler:
                     ClusterEvent(R.ASSIGNED_POD, A.ADD), old, new)
         elif not self._terminal(new) and self._ours(new) \
                 and not self._quarantine_holds(new):
+            if not self._owns_pod(new):
+                self._stash_foreign(new)
+                return
+            if new.metadata.uid in self._foreign:
+                # adopted by an update that arrived after a rebalance
+                # made the pod ours (label change re-hashing its gang,
+                # or a pen refresh): queue it like a fresh add
+                del self._foreign[new.metadata.uid]
+                self.stats["foreign_adopted"] += 1
+                self._enqueue_fresh(new)
+                return
             self.nominator.update(new)
             if self.jobqueue.active \
                     and self.jobqueue.holds(new.metadata.uid):
@@ -674,6 +742,7 @@ class Scheduler:
         # for the dead pod can't resurrect it in the cache; tombstones age
         # out of a bounded FIFO instead of a wholesale clear
         uid = pod.metadata.uid
+        self._foreign.pop(uid, None)
         was_quarantined = self._quarantine.pop(uid, None) is not None
         self._fault_strikes.pop(uid, None)
         self._quarantine_counts.pop(uid, None)
@@ -2808,6 +2877,39 @@ class Scheduler:
 
     # ------------- the daemon (scheduler.go Run + queue flush loops) ----
 
+    def _sync_slices(self) -> None:
+        """Converge the queues to the slice map after a rebalance: pods
+        in slices we lost move to the foreign pen (the new owner's
+        informer already has them), pods in slices we gained move from
+        the pen into the queues. One integer compare when nothing
+        changed — this runs every loop tick. The jobqueue drains by
+        whole unit, so a gang mid-assembly re-homes intact."""
+        sm = self._slices
+        if sm is None or sm.generation == self._slice_gen:
+            return
+        with self._lock:
+            if sm.generation == self._slice_gen:
+                return
+            self._slice_gen = sm.generation
+            for pod in self.queue.drain_unowned(self._owns_pod):
+                self._stash_foreign(pod)
+            if self.jobqueue.active:
+                for pod in self.jobqueue.drain_unowned(self._owns_pod):
+                    self._stash_foreign(pod)
+            adopted = [p for p in self._foreign.values()
+                       if self._owns_pod(p)]
+            for pod in adopted:
+                del self._foreign[pod.metadata.uid]
+                if pod.spec.node_name or self._terminal(pod) \
+                        or self._quarantine_holds(pod):
+                    continue
+                self.stats["foreign_adopted"] += 1
+                self._enqueue_fresh(pod)
+            # ownership moved: any device-resident chain may reflect
+            # binds we are no longer racing for — resync conservatively
+            self._invalidate_chain()
+            self.stats["slice_rebalances"] += 1
+
     def run_maintenance(self) -> None:
         """The background timers the reference runs as goroutines: 1s
         backoff flush, 30s unschedulable-timeout flush (5min park cap,
@@ -2816,6 +2918,7 @@ class Scheduler:
         completion, queued evictions."""
         with self._lock:
             self._process_deferred_events()
+            self._sync_slices()
             now = self.now()
             if now - self._last_backoff_flush >= 1.0:
                 self._last_backoff_flush = now
@@ -2964,6 +3067,12 @@ class Scheduler:
         hub client and chaos layer have no registry of their own)."""
         m = self.metrics
         m.hub_degraded.set(1.0 if self.hub_degraded() else 0.0)
+        if self._slices is not None:
+            m.sched_slices_owned.set(float(len(self._slices.owned)))
+            m.foreign_pending_pods.set(float(len(self._foreign)))
+            self._mirror_count("slice_rebalances",
+                               self.stats["slice_rebalances"],
+                               m.slice_rebalances)
         rs = getattr(self.hub, "resilience_stats", None)
         if rs is not None:
             s = rs()
@@ -3063,10 +3172,21 @@ class Scheduler:
         and keeps serving."""
         self.daemon_error: Optional[BaseException] = None
         self._elector = elector
+        # a SliceManager is the scale-out elector: leadership over a
+        # SLICE of the pending-pod space instead of the whole ring
+        self._slices = (elector if getattr(elector, "is_slice_manager",
+                                           False) else None)
+
+        def tick_gate() -> bool:
+            ok = elector.tick()
+            if ok and self._slices is not None:
+                self._sync_slices()
+            return ok
+
         crash_bo = Backoff(base=0.5, cap=30.0)
         try:
             while not stop.is_set():
-                if elector is not None and not elector.tick():
+                if elector is not None and not tick_gate():
                     stop.wait(min(elector.retry_period, 0.5))
                     continue
                 try:
@@ -3076,7 +3196,7 @@ class Scheduler:
                     # background goroutine; a long drain must not outlive
                     # the lease while still binding pods)
                     on_step = (None if elector is None
-                               else (lambda: not elector.tick()))
+                               else (lambda: not tick_gate()))
                     if self.run_until_idle(on_step=on_step) == 0:
                         stop.wait(idle_sleep)
                     crash_bo.reset()

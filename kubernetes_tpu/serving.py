@@ -172,11 +172,33 @@ class ServingEndpoints:
                         # through the router's state forwarding; absent
                         # on pre-replica fabrics)
                         try:
-                            replicas = topo_fn().get("replicas")
+                            topo = topo_fn()
+                            replicas = topo.get("replicas")
                             if replicas:
                                 payload["state_replicas"] = replicas
+                            scheds = topo.get("schedulers")
+                            if scheds:
+                                # scale-out: the live scheduler-replica
+                                # registry + slice-ring epoch
+                                payload["scheduler_replicas"] = scheds
+                                payload["sched_ring_epoch"] = \
+                                    topo.get("sched_ring_epoch")
                         except Exception:  # noqa: BLE001 — quorum
                             pass           # mid-election / plain hub
+                    sm = getattr(sched, "_slices", None)
+                    if sm is not None:
+                        # this replica's own slice view: which slots it
+                        # drains, under which ring/fencing epochs, and
+                        # how many peer-owned pods wait in the pen
+                        payload["slices"] = {
+                            "identity": sm.identity,
+                            "owned_slots": sorted(sm.owned),
+                            "ring_epoch": sm.ring_epoch,
+                            "fence_epoch": sm.epoch,
+                            "generation": sm.generation,
+                            "rebalances": sm.rebalances,
+                            "foreign_pending": len(
+                                getattr(sched, "_foreign", {}))}
                     body = json.dumps(payload, indent=2, default=str)
                 elif path == "/debug/fleet":
                     # fleet topology + health: the FleetView collector's

@@ -401,6 +401,38 @@ class FleetView:
         self.timeout = timeout
         self._fetch = fetch or self._http_fetch
 
+    @classmethod
+    def from_topology(cls, topology: dict, timeout: float = 5.0,
+                      fetch: Optional[Callable[[str, float], str]] = None
+                      ) -> "FleetView":
+        """Build the endpoint list from a ``fabric_topology()`` payload
+        instead of static config: routers, shards, relays, and (scale-
+        out) the live scheduler-replica registry as role-``scheduler``
+        rows. Components registered without a serving URL (headless
+        test replicas) are skipped — a row that can never answer
+        /healthz is noise, not topology."""
+        endpoints: list[dict] = []
+        for r in topology.get("routers", []):
+            if r.get("url"):
+                endpoints.append({"component": "router",
+                                  "shard": r.get("name", ""),
+                                  "url": r["url"]})
+        for name, s in (topology.get("shards") or {}).items():
+            if s.get("url"):
+                endpoints.append({"component": "hub-shard",
+                                  "shard": name, "url": s["url"]})
+        for r in topology.get("relays", []):
+            if r.get("url"):
+                endpoints.append({"component": "relay",
+                                  "shard": r.get("name", ""),
+                                  "url": r["url"]})
+        for name, s in (topology.get("schedulers") or {}).items():
+            if s.get("url"):
+                endpoints.append({"component": "scheduler",
+                                  "shard": name, "url": s["url"],
+                                  "role": "scheduler"})
+        return cls(endpoints, timeout=timeout, fetch=fetch)
+
     @staticmethod
     def _http_fetch(url: str, timeout: float) -> str:
         with urllib.request.urlopen(url, timeout=timeout) as resp:
@@ -417,6 +449,10 @@ class FleetView:
                    "shard": ep.get("shard", ""),
                    "url": base, "healthy": False, "error": None,
                    "exposition": None, "scraped_at": time.time()}
+            if ep.get("role"):
+                # topology-declared role (scheduler replicas); state
+                # replicas override from their self-reported sample
+                rec["role"] = ep["role"]
             try:
                 health = self._fetch(base + "/healthz", self.timeout)
                 rec["healthy"] = health.strip().startswith("ok")

@@ -1,6 +1,7 @@
 """Test fixtures: fluent wrappers + scripted fake plugins
 (pkg/scheduler/testing equivalents)."""
 
+from kubernetes_tpu.testing.audit import audit_bind_journal
 from kubernetes_tpu.testing.fakes import (
     CountingHub,
     FakePermitPlugin,
@@ -15,6 +16,7 @@ from kubernetes_tpu.testing.fakes import (
 from kubernetes_tpu.testing.wrappers import MakeNode, MakePod
 
 __all__ = [
+    "audit_bind_journal",
     "CountingHub",
     "FakePermitPlugin",
     "FakeReservePlugin",
