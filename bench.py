@@ -774,6 +774,61 @@ def main() -> None:
                              for k in ("inproc", "procs"))
         print(json.dumps(combined))
         sys.exit(rc if rc else (0 if combined["ok"] else 1))
+    if "--scenario" in sys.argv:
+        # replay one named regime (or a trace file) against the real
+        # fabric with trace-time SLO + exactly-once gates; the printed
+        # row carries the scenario SLO columns for BENCH_* artifacts
+        from kubernetes_tpu.scenario.generators import generate
+        from kubernetes_tpu.scenario.replay import replay_trace
+        from kubernetes_tpu.scenario.trace import load_trace
+
+        arg = sys.argv[sys.argv.index("--scenario") + 1]
+        speed = (float(sys.argv[sys.argv.index("--speed") + 1])
+                 if "--speed" in sys.argv else 3.0)
+        seed = (int(sys.argv[sys.argv.index("--seed") + 1])
+                if "--seed" in sys.argv else 0)
+        tr = (load_trace(arg) if os.path.exists(arg)
+              else generate(arg, seed=seed))
+        rep = replay_trace(tr, speed=speed)
+        print(json.dumps({
+            "metric": "scenario_replay",
+            "scenario": rep["name"],
+            "speed": rep["speed"],
+            "time_to_bind_p50_ms": rep["stats"]["time_to_bind_p50_ms"],
+            "time_to_bind_p99_ms": rep["stats"]["time_to_bind_p99_ms"],
+            "time_to_bind_max_ms": rep["stats"]["time_to_bind_max_ms"],
+            "slo_ok": rep["slo"]["ok"],
+            "audit_ok": rep["audit"]["ok"],
+            "hardware_limited": rep["pacing"]["hardware_limited"],
+            "report": rep,
+        }))
+        sys.exit(0 if rep["ok"] else 1)
+    if "--scenario-fuzz" in sys.argv:
+        # EXPLICIT opt-in (not part of any battery): adversarial search
+        # over regime parameter space under a wall-clock budget;
+        # SLO-breaching traces are auto-filed as regression gates
+        from kubernetes_tpu.scenario.fuzz import fuzz
+
+        budget = (float(sys.argv[sys.argv.index("--budget") + 1])
+                  if "--budget" in sys.argv else 120.0)
+        seed = (int(sys.argv[sys.argv.index("--seed") + 1])
+                if "--seed" in sys.argv else 0)
+        objective = ("regret" if "--objective-regret" in sys.argv
+                     else "p99")
+        out_dir = os.path.join(_repo, "tests", "regression_traces")
+        rep = fuzz(budget_s=budget, seed=seed, objective=objective,
+                   out_dir=out_dir,
+                   log=lambda s: print(s, file=sys.stderr, flush=True))
+        print(json.dumps({
+            "metric": "scenario_fuzz",
+            "objective": rep["objective"],
+            "budget_s": rep["budget_s"],
+            "elapsed_s": rep["elapsed_s"],
+            "candidates": rep["candidates"],
+            "worst": rep["worst"],
+            "filed": rep["filed"],
+        }))
+        sys.exit(0)
     if "--chaos-smoke" in sys.argv:
         # red-suite gate: the full storm battery — the smoke scenario
         # (call faults + watch cut + partition through the proxy), the

@@ -113,6 +113,11 @@ class SchedulerConfiguration:
     # cycles; 0 disables the recorder entirely (not recommended — the
     # overhead budget is <2% of cycle time, see bench.py --trace-overhead)
     flight_recorder_capacity: int = 256
+    # per-pod lifecycle timelines LRU (utils/tracing.PodTimelines):
+    # time-to-bind SLO stats (telemetry/slo.py) walk this, so runs that
+    # gate on p50/p99 across >4096 pods must size it to the workload or
+    # the oldest pods silently fall out of the percentile pass
+    timelines_capacity: int = 4096
     # append each cycle trace as a JSON line here (offline analysis /
     # the learned-scorer replay dataset; export format v2 carries
     # per-pod placement rows)

@@ -358,6 +358,19 @@ class SchedulerMetrics:
             "scheduler_device_live_buffer_bytes",
             "Resident device-buffer bytes by buffer family (cluster "
             "tensors, pod batch, DRA inventories, learned params)"))
+        # scenario replay driver (scenario/replay.py): trace events it
+        # injected into this scheduler's hub, SLO-gate breaches, and
+        # the last replay's trace-time bind tail
+        self.scenario_events = r.register(Counter(
+            "scheduler_scenario_events_total",
+            "Trace events injected by the scenario replayer, by kind",
+            ("kind",)))
+        self.scenario_slo_breaches = r.register(Counter(
+            "scheduler_scenario_slo_breaches_total",
+            "Scenario SLO gate breaches, by gated metric", ("metric",)))
+        self.scenario_time_to_bind_p99 = r.register(Gauge(
+            "scheduler_scenario_time_to_bind_p99_seconds",
+            "Trace-time p99 time-to-bind of the last scenario replay"))
         self.drift_detected = r.register(Counter(
             "scheduler_drift_detected_total",
             "Cache/mirror-vs-hub discrepancies found by the drift "

@@ -35,6 +35,7 @@ from kubernetes_tpu.hub import EventHandlers, Hub
 from kubernetes_tpu.ops.features import Capacities
 from kubernetes_tpu.perf.collector import ThroughputCollector
 from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.telemetry.slo import time_to_bind_stats
 
 # ---------------------------------------------------------------- op DSL
 
@@ -159,19 +160,21 @@ class _ChurnState:
 
     def _create(self, hub: Hub, obj, i: int) -> None:
         from kubernetes_tpu.api.objects import Node
+        from kubernetes_tpu.scenario.lifecycle import NodeLifecycle
 
         obj.metadata.name = f"churn-{obj.metadata.name}-{i}"
         if isinstance(obj, Node):
-            hub.create_node(obj)
+            NodeLifecycle(hub).add(obj)
         else:
             hub.create_pod(obj)
 
     def _delete(self, hub: Hub, obj) -> None:
         from kubernetes_tpu.api.objects import Node
+        from kubernetes_tpu.scenario.lifecycle import NodeLifecycle
 
         try:
             if isinstance(obj, Node):
-                hub.delete_node(obj.metadata.uid)
+                NodeLifecycle(hub).remove(obj.metadata.name)
             else:
                 hub.delete_pod(obj.metadata.uid)
         except Exception:  # noqa: BLE001 — already gone is fine
@@ -228,6 +231,11 @@ def run_workload(w: Workload, now: Callable[[], float] = time.time,
         ResourceClaimController(hub)
     cfg = copy.deepcopy(config) if config is not None else default_config()
     cfg.batch_size = w.batch_size
+    # quality rows gate on time-to-bind percentiles over PodTimelines —
+    # the LRU must hold every pod of the run or the oldest (slowest-era)
+    # pods fall out of the percentile pass
+    cfg.timelines_capacity = max(
+        getattr(cfg, "timelines_capacity", 4096), 2 * w.pod_capacity)
     if w.tenants:
         cfg.tenants = {**cfg.tenants, **w.tenants}
     cfg.feature_gates.update(w.feature_gates)
@@ -376,8 +384,11 @@ def run_workload(w: Workload, now: Callable[[], float] = time.time,
             "preemptions": int(sched.stats.get("preemptions", 0)),
             "spread_stddev": round(spread_std, 3),
             "spread_max_min": int(spread_maxmin),
-            "time_to_bind_p99_ms": round(
-                m.pod_e2e_duration.percentile(99) * 1e3, 2),
+            # p50/p99/max from ONE PodTimelines pass — the same helper
+            # the scenario replay driver's SLO gate uses (ISSUE 17),
+            # so bench rows and trace gates cannot drift apart
+            **{k: v for k, v in time_to_bind_stats(
+                sched.timelines).items() if k != "count"},
         },
     }
     # per-placement regret columns (ISSUE 14): whenever the run exported

@@ -1,0 +1,37 @@
+"""Trace-driven scenario engine (ROADMAP item 5).
+
+- ``trace``: versioned trace format (arrival-timestamped pod shapes,
+  node lifecycle, priority/tenant/gang mix, optional DRA objects) with
+  JSON-lines and bin1 codecs.
+- ``generators``: pure seeded params -> Trace functions for named
+  regimes (diurnal ramp, sawtooth churn, zone outage + stampede,
+  quota storm, gang+DRA+preemption crossfire).
+- ``lifecycle``: the one node add/remove/cordon code path shared by the
+  perf-harness Churn op and the replayer.
+- ``replay``: drives a trace against the real Hub + Scheduler at
+  recorded (or K×-compressed) rates, gating on time-to-bind SLOs and
+  journal-audit exactly-once.
+- ``fuzz``: adversarial search over generator parameter space; losing
+  traces are auto-filed under tests/regression_traces/.
+"""
+
+from kubernetes_tpu.scenario.generators import GENERATORS, generate
+from kubernetes_tpu.scenario.lifecycle import NodeLifecycle
+from kubernetes_tpu.scenario.replay import replay_trace
+from kubernetes_tpu.scenario.trace import (
+    Trace,
+    TraceEvent,
+    load_trace,
+    save_trace,
+)
+
+__all__ = [
+    "GENERATORS",
+    "NodeLifecycle",
+    "Trace",
+    "TraceEvent",
+    "generate",
+    "load_trace",
+    "replay_trace",
+    "save_trace",
+]

@@ -1,0 +1,357 @@
+"""Named-regime trace generators: pure seeded functions params -> Trace.
+
+Every generator is deterministic — same params + seed produce a
+byte-identical trace (uids and creation timestamps are stamped from the
+event stream, never from the wall clock; the replayer re-stamps
+creation times at injection). All regimes share ONE set of replay
+capacities (``REPLAY_CONFIG``) so every fuzz candidate compiles the
+same jit shapes and a whole search pays XLA compilation once.
+
+Feasibility discipline: pods in this world never terminate (only
+eviction deletes them), so a regime whose total demand exceeds cluster
+capacity or a tenant's quota wedges forever instead of producing a tail
+— every generator keeps demand under capacity and engineers its p99
+signal through *waiting* (outage windows, quota turn-taking, preemption
+waves), which is speed-invariant in trace time.
+
+Each regime registers fuzzable parameter BOUNDS; its SLO is the intent
+target computed from the DEFAULT params, so default traces gate green
+while fuzzed parameter excursions can breach and get filed.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Callable
+
+from kubernetes_tpu.api.objects import (
+    Affinity,
+    Device,
+    DeviceRequest,
+    LABEL_POD_GROUP,
+    LABEL_QUEUE,
+    LABEL_ZONE,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    ObjectMeta,
+    PodGroup,
+    PodResourceClaim,
+    ResourceClaim,
+    ResourceClaimSpec,
+    ResourceSlice,
+)
+from kubernetes_tpu.perf.workloads import _node, _pod
+from kubernetes_tpu.scenario.trace import Trace, TraceEvent
+from kubernetes_tpu.utils.wire import to_wire
+
+# one shape set for every regime: node/pod capacity and batch are jit
+# STATIC args, so sharing them lets a fuzz run replay dozens of
+# candidate traces against one compile cache
+REPLAY_CONFIG = {"node_capacity": 64, "pod_capacity": 2048,
+                 "batch_size": 32}
+
+# default node template fits 40 default pods (cpu 4 / 100m binds first,
+# before the 65-pod memory and 110-pod slot limits)
+PODS_PER_NODE = 40
+
+
+def _stamp(obj, uid: str):
+    """Deterministic identity: ObjectMeta autogenerates uid and
+    creation_timestamp from the wall clock — fatal for byte-identical
+    traces. The replayer re-stamps creation_timestamp at injection."""
+    obj.metadata.uid = uid
+    obj.metadata.creation_timestamp = 0.0
+    return obj
+
+
+def _ev(t: float, kind: str, data: dict) -> TraceEvent:
+    return TraceEvent(t=round(t, 6), kind=kind, data=data)
+
+
+def _pod_ev(t: float, pod) -> TraceEvent:
+    return _ev(t, "pod", {"pod": to_wire(pod)})
+
+
+def _finish(tr: Trace) -> Trace:
+    tr.events.sort(key=lambda e: e.t)  # stable: ties keep build order
+    return tr
+
+
+# ------------------------------------------------------------ regimes
+
+
+@dataclass
+class Regime:
+    """A registered generator: fn(params, seed) -> Trace, its default
+    params, and per-param (lo, hi) fuzz bounds (ints stay ints)."""
+
+    fn: Callable[[dict, int], Trace]
+    defaults: dict
+    bounds: dict = field(default_factory=dict)
+
+    def generate(self, params: dict | None = None, seed: int = 0) -> Trace:
+        p = {**self.defaults, **(params or {})}
+        return self.fn(p, seed)
+
+
+def diurnal_ramp(p: dict, seed: int) -> Trace:
+    """Sinusoidal arrival rate over ``cycles`` day-cycles: trough load
+    keeps the scheduler warm, each peak is a correlated burst. The tail
+    signal is queueing at the crest."""
+    rng = random.Random(seed)
+    tr = Trace(name=f"diurnal_ramp-s{seed}", generator="diurnal_ramp",
+               seed=seed, params=dict(p), config=dict(REPLAY_CONFIG),
+               slo={"time_to_bind_p99_ms": 2000.0})
+    for i in range(p["nodes"]):
+        tr.events.append(_ev(0.0, "node_up", {
+            "node": to_wire(_stamp(_node(i), f"uid-node-{i}"))}))
+    # inverse-CDF sampling of a 1 + (peak-1)*(sin+1)/2 rate curve: pod i
+    # arrives where the cumulative rate crosses quantile (i+jitter)/N
+    n, dur, peak = p["pods"], float(p["duration"]), float(p["peak_ratio"])
+    grid = 512
+    dens = [1.0 + (peak - 1.0) * 0.5 *
+            (1.0 + math.sin(2.0 * math.pi * p["cycles"] * g / grid
+                            - math.pi / 2.0))
+            for g in range(grid)]
+    cdf, acc = [], 0.0
+    for d in dens:
+        acc += d
+        cdf.append(acc)
+    total = cdf[-1]
+    g = 0
+    for i in range(n):
+        q = (i + rng.random()) / n * total
+        while g < grid - 1 and cdf[g] < q:
+            g += 1
+        t = dur * (g + 1) / grid
+        pod = _stamp(_pod(f"ramp-{i}"), f"uid-ramp-{i}")
+        tr.events.append(_pod_ev(t, pod))
+    return _finish(tr)
+
+
+def sawtooth_churn(p: dict, seed: int) -> Trace:
+    """A fixed fraction of nodes saw-tooths down/up on a period while
+    pods arrive steadily; demand fits the TROUGH capacity so the regime
+    stresses resyncs, not feasibility."""
+    rng = random.Random(seed)
+    tr = Trace(name=f"sawtooth_churn-s{seed}", generator="sawtooth_churn",
+               seed=seed, params=dict(p), config=dict(REPLAY_CONFIG),
+               slo={"time_to_bind_p99_ms": 2000.0})
+    nodes, dur = p["nodes"], float(p["duration"])
+    churned = max(1, int(nodes * p["churn_frac"]))
+    for i in range(nodes):
+        tr.events.append(_ev(0.0, "node_up", {
+            "node": to_wire(_stamp(_node(i), f"uid-node-{i}"))}))
+    period = float(p["period"])
+    for i in range(churned):
+        # per-node phase offset spreads the teeth across the period
+        phase = period * i / churned
+        t = phase + period * 0.5
+        gen = 0
+        while t < dur - period * 0.25:
+            tr.events.append(_ev(t, "node_down", {"name": f"node-{i}"}))
+            up = _stamp(_node(i), f"uid-node-{i}-g{gen + 1}")
+            tr.events.append(_ev(t + period * 0.5, "node_up",
+                                 {"node": to_wire(up)}))
+            t += period
+            gen += 1
+    for i in range(p["pods"]):
+        t = dur * (i + rng.random()) / p["pods"]
+        tr.events.append(_pod_ev(
+            t, _stamp(_pod(f"saw-{i}"), f"uid-saw-{i}")))
+    return _finish(tr)
+
+
+def _zone_affinity(zone: str) -> Affinity:
+    return Affinity(node_affinity=NodeAffinity(
+        required=NodeSelector(
+            node_selector_terms=[NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement(key=LABEL_ZONE, operator="In",
+                                        values=[zone])])])))
+
+
+def zone_outage(p: dict, seed: int) -> Trace:
+    """Zone outage + recovery stampede: one zone's nodes drop at
+    ``outage_start`` and return ``outage_len`` later, together with a
+    thundering-herd pod burst. Pods PINNED to the failed zone arrive
+    during the window and can only bind after recovery, so their
+    time-to-bind is ≈ the remaining window in trace time — a
+    speed-invariant p99 signal."""
+    rng = random.Random(seed)
+    tr = Trace(name=f"zone_outage-s{seed}", generator="zone_outage",
+               seed=seed, params=dict(p), config=dict(REPLAY_CONFIG),
+               # intent target from the DEFAULT window: a pinned pod can
+               # wait the whole default outage; fuzzed longer outages
+               # breach this and get filed
+               slo={"time_to_bind_p99_ms": 6000.0})
+    zones = [f"zone-{z}" for z in range(p["zones"])]
+    npz = p["nodes_per_zone"]
+    t_out = float(p["outage_start"])
+    t_rec = t_out + float(p["outage_len"])
+    dur = float(p["duration"])
+    for i in range(p["zones"] * npz):
+        tr.events.append(_ev(0.0, "node_up", {
+            "node": to_wire(_stamp(_node(i, zones=zones),
+                                   f"uid-node-{i}"))}))
+    # _node assigns zone i % len(zones): zone-0 nodes are i ≡ 0 (mod Z)
+    failed = [i for i in range(p["zones"] * npz) if i % p["zones"] == 0]
+    for i in failed:
+        tr.events.append(_ev(t_out, "node_down", {"name": f"node-{i}"}))
+        back = _stamp(_node(i, zones=zones), f"uid-node-{i}-r1")
+        tr.events.append(_ev(t_rec, "node_up", {"node": to_wire(back)}))
+    for i in range(p["bg_pods"]):
+        t = dur * (i + rng.random()) / p["bg_pods"]
+        tr.events.append(_pod_ev(
+            t, _stamp(_pod(f"bg-{i}"), f"uid-bg-{i}")))
+    # the speed-invariant tail: zone-0-only pods landing inside the window
+    for i in range(p["pinned_pods"]):
+        t = t_out + (t_rec - t_out) * 0.8 * (i + rng.random()) \
+            / p["pinned_pods"]
+        pod = _stamp(_pod(f"pinned-{i}", affinity=_zone_affinity("zone-0")),
+                     f"uid-pinned-{i}")
+        tr.events.append(_pod_ev(t, pod))
+    # recovery stampede: the herd restarting the moment the zone returns
+    for i in range(p["stampede_pods"]):
+        t = t_rec + 0.5 * rng.random()
+        tr.events.append(_pod_ev(
+            t, _stamp(_pod(f"herd-{i}"), f"uid-herd-{i}")))
+    return _finish(tr)
+
+
+def quota_storm(p: dict, seed: int) -> Trace:
+    """Every tenant bursts its full pod quota inside one window; DRR
+    turn-taking and quota admission — not node capacity — set the
+    drain order. Demand is exactly at quota so the storm fully drains
+    (over-quota pods would park forever)."""
+    rng = random.Random(seed)
+    tenants = {f"tenant-{i}": {
+        "weight": 1.0 + (i % 3),  # 1/2/3-weighted classes
+        "quota": {"pods": str(p["pods_per_tenant"])}}
+        for i in range(p["tenants"])}
+    tr = Trace(name=f"quota_storm-s{seed}", generator="quota_storm",
+               seed=seed, params=dict(p),
+               config={**REPLAY_CONFIG, "tenants": tenants},
+               slo={"time_to_bind_p99_ms": 2000.0})
+    for i in range(p["nodes"]):
+        tr.events.append(_ev(0.0, "node_up", {
+            "node": to_wire(_stamp(_node(i), f"uid-node-{i}"))}))
+    window = float(p["window"])
+    for ti in range(p["tenants"]):
+        for j in range(p["pods_per_tenant"]):
+            t = window * rng.random()
+            pod = _pod(f"t{ti}-p{j}", labels={LABEL_QUEUE: f"tenant-{ti}"})
+            tr.events.append(_pod_ev(
+                t, _stamp(pod, f"uid-t{ti}-p{j}")))
+    return _finish(tr)
+
+
+def _crossfire_node(i: int):
+    n = _node(i)
+    n.status.allocatable = {"cpu": "16", "memory": "64Gi", "pods": "110"}
+    return n
+
+
+def gang_dra_crossfire(p: dict, seed: int) -> Trace:
+    """Low-priority fillers soak most of the CPU, then high-priority
+    gangs whose members each claim a TPU device arrive — all-or-nothing
+    gang admission, structured DRA allocation, and preemption sweeps
+    fire in the same wave."""
+    rng = random.Random(seed)
+    tr = Trace(name=f"gang_dra_crossfire-s{seed}",
+               generator="gang_dra_crossfire",
+               seed=seed, params=dict(p), config=dict(REPLAY_CONFIG),
+               slo={"time_to_bind_p99_ms": 8000.0})
+    nodes = p["nodes"]
+    for i in range(nodes):
+        tr.events.append(_ev(0.0, "node_up", {
+            "node": to_wire(_stamp(_crossfire_node(i), f"uid-node-{i}"))}))
+        sl = ResourceSlice(
+            metadata=ObjectMeta(name=f"slice-node-{i}"),
+            node_name=f"node-{i}", driver="tpu.example.com",
+            pool=f"node-{i}",
+            devices=[Device(name=f"dev-{d}", device_class_name="tpu")
+                     for d in range(8)])
+        _stamp(sl, f"uid-slice-{i}")
+        tr.events.append(_ev(0.0, "obj", {
+            "verb": "create_resource_slice", "obj": to_wire(sl)}))
+    fill_end = float(p["filler_window"])
+    for i in range(p["filler_pods"]):
+        t = fill_end * (i + rng.random()) / p["filler_pods"]
+        pod = _pod(f"fill-{i}", cpu="400m", mem="200Mi", priority=0)
+        tr.events.append(_pod_ev(t, _stamp(pod, f"uid-fill-{i}")))
+    t_gang = fill_end + 0.5
+    for g in range(p["gangs"]):
+        size = p["gang_size"]
+        grp = PodGroup(metadata=ObjectMeta(name=f"gang-{g}"),
+                       min_member=size, queue="default",
+                       schedule_timeout_seconds=120.0)
+        _stamp(grp, f"uid-gang-{g}")
+        tr.events.append(_ev(t_gang + g * 0.3, "group",
+                             {"group": to_wire(grp)}))
+        for m in range(size):
+            claim = ResourceClaim(
+                metadata=ObjectMeta(name=f"claim-g{g}-m{m}"),
+                spec=ResourceClaimSpec(device_requests=[
+                    DeviceRequest(name="accel", device_class_name="tpu",
+                                  count=1)]))
+            _stamp(claim, f"uid-claim-g{g}-m{m}")
+            tr.events.append(_ev(t_gang + g * 0.3 + 0.01, "obj", {
+                "verb": "create_resource_claim", "obj": to_wire(claim)}))
+            pod = _pod(f"gang-{g}-m{m}", cpu="500m", mem="200Mi",
+                       priority=100)
+            pod.metadata.labels[LABEL_POD_GROUP] = f"gang-{g}"
+            pod.spec.resource_claims = [PodResourceClaim(
+                name="accel", resource_claim_name=f"claim-g{g}-m{m}")]
+            t = t_gang + g * 0.3 + 0.05 + 0.2 * rng.random()
+            tr.events.append(_pod_ev(t, _stamp(pod, f"uid-gang{g}m{m}")))
+    return _finish(tr)
+
+
+GENERATORS: dict[str, Regime] = {
+    "diurnal_ramp": Regime(
+        diurnal_ramp,
+        defaults={"nodes": 24, "pods": 600, "duration": 12.0,
+                  "peak_ratio": 6.0, "cycles": 2},
+        bounds={"pods": (100, 900), "duration": (4.0, 20.0),
+                "peak_ratio": (1.0, 20.0), "cycles": (1, 4)}),
+    "sawtooth_churn": Regime(
+        sawtooth_churn,
+        defaults={"nodes": 24, "churn_frac": 0.25, "period": 4.0,
+                  "duration": 12.0, "pods": 500},
+        bounds={"churn_frac": (0.05, 0.45), "period": (1.0, 6.0),
+                "pods": (100, 700), "duration": (6.0, 16.0)}),
+    "zone_outage": Regime(
+        zone_outage,
+        defaults={"zones": 4, "nodes_per_zone": 6, "bg_pods": 300,
+                  "pinned_pods": 60, "stampede_pods": 200,
+                  "outage_start": 3.0, "outage_len": 4.0,
+                  "duration": 12.0},
+        bounds={"outage_len": (1.0, 9.0), "pinned_pods": (20, 120),
+                "stampede_pods": (50, 400), "outage_start": (1.0, 4.0)}),
+    "quota_storm": Regime(
+        quota_storm,
+        defaults={"tenants": 100, "pods_per_tenant": 8, "nodes": 24,
+                  "window": 3.0},
+        bounds={"tenants": (10, 150), "pods_per_tenant": (2, 12),
+                "window": (0.5, 6.0)}),
+    "gang_dra_crossfire": Regime(
+        gang_dra_crossfire,
+        defaults={"nodes": 8, "filler_pods": 280, "filler_window": 3.0,
+                  "gangs": 6, "gang_size": 8},
+        bounds={"filler_pods": (100, 330), "gangs": (2, 10),
+                "gang_size": (2, 8), "filler_window": (1.0, 5.0)}),
+}
+
+
+def generate(name: str, params: dict | None = None, seed: int = 0) -> Trace:
+    """Build a named regime's trace. Unknown names raise with the
+    catalog so CLI typos fail helpfully."""
+    reg = GENERATORS.get(name)
+    if reg is None:
+        raise KeyError(
+            f"unknown regime {name!r}; have {sorted(GENERATORS)}")
+    return reg.generate(params, seed)

@@ -1,0 +1,57 @@
+"""Node lifecycle injection — the ONE code path for node add / remove /
+cordon / uncordon, shared by the perf-harness Churn op and the scenario
+trace replayer (ISSUE 17 satellite: MixedChurn used to manipulate the
+hub inline with the drive loop; traces and hand-built workloads now
+inject node events identically).
+
+Deliberately depends only on api + hub so scenario.replay and
+perf.harness can both import it without a cycle.
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api.objects import Node
+
+
+class NodeLifecycle:
+    """Apply node lifecycle events to a hub.
+
+    remove/cordon/uncordon address nodes by NAME (traces don't know
+    uids — the hub assigns them at create); ``add`` returns the created
+    node so harness callers that track live objects can keep doing so.
+    All verbs tolerate already-gone / already-in-state targets: a
+    replayed trace must be idempotent across torn-tail resume, and a
+    churn delete racing an eviction is routine.
+    """
+
+    def __init__(self, hub) -> None:
+        self.hub = hub
+
+    def add(self, node: Node) -> Node:
+        self.hub.create_node(node)
+        return node
+
+    def remove(self, name: str) -> bool:
+        node = self.hub.get_node(name)
+        if node is None:
+            return False
+        try:
+            self.hub.delete_node(node.metadata.uid)
+        except Exception:  # noqa: BLE001 — lost a race with another delete
+            return False
+        return True
+
+    def _set_unschedulable(self, name: str, value: bool) -> bool:
+        node = self.hub.get_node(name)
+        if node is None or node.spec.unschedulable == value:
+            return False
+        patched = node.clone()
+        patched.spec.unschedulable = value
+        self.hub.update_node(patched)
+        return True
+
+    def cordon(self, name: str) -> bool:
+        return self._set_unschedulable(name, True)
+
+    def uncordon(self, name: str) -> bool:
+        return self._set_unschedulable(name, False)

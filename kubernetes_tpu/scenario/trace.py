@@ -1,0 +1,188 @@
+"""The versioned trace format (v1) + JSON-lines / bin1 codecs.
+
+A trace is a header plus a time-ordered list of events. Times are
+*trace time* — seconds since trace start; the replayer maps them onto
+the wall clock at a compression factor (``speed``). Event kinds:
+
+- ``pod``:          {"pod": to_wire(Pod)} — arrival of one pod shape
+- ``node_up``:      {"node": to_wire(Node)}
+- ``node_down``:    {"name": str}
+- ``node_cordon``:  {"name": str}
+- ``node_uncordon``:{"name": str}
+- ``group``:        {"group": to_wire(PodGroup)} — gang registration
+- ``obj``:          {"verb": "create_resource_slice", "obj": to_wire(x)}
+                    — generic typed create (DRA slices/claims, ...)
+
+Typed API objects ride as ``utils.wire`` tagged dicts INSIDE event
+data, so the bin1 codec only ever sees plain values and the fabric's
+registry fingerprint is untouched by this module.
+
+Two on-disk encodings, sniffed on load:
+
+- JSON-lines (git-diffable; the format regression traces are filed
+  in): header line, then one ``{"t","kind","data"}`` object per line.
+- bin1: ``b"KTS1"`` magic, then length-prefixed ``fabric.codec``
+  frames (header first, then events).
+
+Both readers tolerate a torn tail — a trace cut mid-write yields the
+decodable prefix, matching the WAL's crash semantics — EXCEPT a torn
+header, which is an error (there is no trace to salvage).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from dataclasses import dataclass, field
+
+from kubernetes_tpu.fabric.codec import decode, encode, frame, read_frame
+
+TRACE_VERSION = 1
+MAGIC = b"KTS1"
+
+EVENT_KINDS = ("pod", "node_up", "node_down", "node_cordon",
+               "node_uncordon", "group", "obj")
+
+
+@dataclass
+class TraceEvent:
+    t: float     # trace-time seconds since start
+    kind: str    # one of EVENT_KINDS
+    data: dict   # kind-specific payload (plain JSON-able values only)
+
+
+@dataclass
+class Trace:
+    """Header + events. ``config`` carries replay hints (node/pod
+    capacities, batch size, tenants) so every replay of one trace
+    compiles the same jit shapes; ``slo`` is the regime's trace-time
+    intent target; ``gate`` is the enforced ratchet bound a filed
+    regression trace must stay under (observed × headroom at filing
+    time — green after filing, trips on regressions)."""
+
+    name: str
+    events: list[TraceEvent] = field(default_factory=list)
+    generator: str = ""
+    seed: int = 0
+    params: dict = field(default_factory=dict)
+    config: dict = field(default_factory=dict)
+    slo: dict = field(default_factory=dict)
+    gate: dict = field(default_factory=dict)
+    meta: dict = field(default_factory=dict)
+
+    # ------------------------------------------------------ header
+
+    def header(self) -> dict:
+        return {
+            "v": TRACE_VERSION,
+            "name": self.name,
+            "generator": self.generator,
+            "seed": self.seed,
+            "params": self.params,
+            "config": self.config,
+            "slo": self.slo,
+            "gate": self.gate,
+            "meta": self.meta,
+        }
+
+    @classmethod
+    def from_header(cls, hdr: dict) -> "Trace":
+        v = hdr.get("v")
+        if v != TRACE_VERSION:
+            raise ValueError(f"unsupported trace version {v!r}")
+        return cls(name=hdr.get("name", ""),
+                   generator=hdr.get("generator", ""),
+                   seed=int(hdr.get("seed", 0)),
+                   params=hdr.get("params", {}),
+                   config=hdr.get("config", {}),
+                   slo=hdr.get("slo", {}),
+                   gate=hdr.get("gate", {}),
+                   meta=hdr.get("meta", {}))
+
+    def duration(self) -> float:
+        return self.events[-1].t if self.events else 0.0
+
+    def counts(self) -> dict:
+        out: dict[str, int] = {}
+        for e in self.events:
+            out[e.kind] = out.get(e.kind, 0) + 1
+        return out
+
+    # ------------------------------------------------------ codecs
+
+    def to_bytes(self, fmt: str = "jsonl") -> bytes:
+        """Serialize; byte-identical for equal traces (sorted JSON keys,
+        deterministic bin1) — the generator-determinism tests compare
+        these bytes directly."""
+        if fmt == "jsonl":
+            lines = [json.dumps(self.header(), sort_keys=True)]
+            lines += [json.dumps({"t": e.t, "kind": e.kind,
+                                  "data": e.data}, sort_keys=True)
+                      for e in self.events]
+            return ("\n".join(lines) + "\n").encode()
+        if fmt == "bin1":
+            out = bytearray(MAGIC)
+            out += frame(encode(self.header()))
+            for e in self.events:
+                out += frame(encode(
+                    {"t": e.t, "kind": e.kind, "data": e.data}))
+            return bytes(out)
+        raise ValueError(f"unknown trace format {fmt!r}")
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "Trace":
+        """Parse either encoding (sniffed by magic); torn event tails
+        are dropped, a torn/absent header raises."""
+        if raw[:len(MAGIC)] == MAGIC:
+            return cls._from_bin1(raw)
+        return cls._from_jsonl(raw)
+
+    @classmethod
+    def _from_jsonl(cls, raw: bytes) -> "Trace":
+        lines = raw.decode(errors="replace").splitlines()
+        if not lines:
+            raise ValueError("empty trace")
+        tr = cls.from_header(json.loads(lines[0]))
+        for ln in lines[1:]:
+            if not ln.strip():
+                continue
+            try:
+                rec = json.loads(ln)
+            except ValueError:
+                break  # torn tail: keep the decodable prefix
+            tr.events.append(TraceEvent(
+                t=float(rec["t"]), kind=rec["kind"], data=rec["data"]))
+        return tr
+
+    @classmethod
+    def _from_bin1(cls, raw: bytes) -> "Trace":
+        fp = io.BytesIO(raw[len(MAGIC):])
+        hdr = read_frame(fp)
+        if hdr is None:
+            raise ValueError("torn trace header")
+        tr = cls.from_header(decode(hdr))
+        while True:
+            payload = read_frame(fp)
+            if payload is None:
+                break  # clean or torn tail
+            try:
+                rec = decode(payload)
+            except ValueError:
+                break  # corrupt tail frame
+            tr.events.append(TraceEvent(
+                t=float(rec["t"]), kind=rec["kind"], data=rec["data"]))
+        return tr
+
+
+def save_trace(trace: Trace, path: str, fmt: str | None = None) -> None:
+    """Write a trace; format from ``fmt`` or the path suffix
+    (``.jsonl`` -> JSON-lines, anything else -> bin1)."""
+    if fmt is None:
+        fmt = "jsonl" if path.endswith(".jsonl") else "bin1"
+    with open(path, "wb") as f:
+        f.write(trace.to_bytes(fmt))
+
+
+def load_trace(path: str) -> Trace:
+    with open(path, "rb") as f:
+        return Trace.from_bytes(f.read())

@@ -222,7 +222,9 @@ class Scheduler:
             export_path=getattr(self.config, "trace_export_path", None),
             export_max_bytes=getattr(self.config,
                                      "trace_export_max_bytes", 0))
-        self.timelines = PodTimelines(now=now)
+        self.timelines = PodTimelines(
+            capacity=getattr(self.config, "timelines_capacity", 4096),
+            now=now)
         # placement FEATURE export (the replay-training substrate) is
         # opt-in on top of the export itself: phase-timing export users
         # must not pay the feature kernels + extra D2H + line growth

@@ -1,0 +1,70 @@
+"""Time-to-bind SLO computation shared by bench quality rows and the
+scenario replay driver.
+
+One pass over ``PodTimelines.bind_latencies()`` yields the p50/p99/max
+time-to-bind stats; ``evaluate_slo`` turns those stats plus a target
+dict into a pass/fail verdict with per-metric breach details. The
+scenario engine stores SLO targets in *trace time* — replaying a trace
+at K× compression divides measured wall latencies by K before gating,
+so the same filed trace produces the same verdict on a laptop and the
+1-core CI box (``scale`` parameter).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Mapping
+
+
+def percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank-interpolated percentile over an ascending list."""
+    if not sorted_vals:
+        return 0.0
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = (q / 100.0) * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac
+
+
+def time_to_bind_stats(
+    timelines,
+    uids: Iterable[str] | None = None,
+    scale: float = 1.0,
+) -> dict:
+    """p50/p99/max time-to-bind (ms) from a PodTimelines instance.
+
+    ``uids`` restricts the pass to a subset (replay uses it to exclude
+    warmup pods); ``scale`` converts wall latencies to trace time when
+    replaying at a compression factor (trace_ms = wall_ms * scale).
+    """
+    lat = timelines.bind_latencies()
+    if uids is not None:
+        keep = set(uids)
+        lat = {u: v for u, v in lat.items() if u in keep}
+    vals = sorted(v * scale * 1e3 for v in lat.values())
+    return {
+        "count": len(vals),
+        "time_to_bind_p50_ms": round(percentile(vals, 50), 2),
+        "time_to_bind_p99_ms": round(percentile(vals, 99), 2),
+        "time_to_bind_max_ms": round(vals[-1], 2) if vals else 0.0,
+    }
+
+
+def evaluate_slo(stats: Mapping, slo: Mapping | None) -> dict:
+    """Gate ``stats`` against an SLO dict of metric -> max-allowed value.
+
+    SLO keys are stat keys (e.g. ``time_to_bind_p99_ms``); unknown keys
+    are reported as breaches so a typo'd gate fails loudly rather than
+    silently passing. Returns {"ok": bool, "breaches": [...]} where each
+    breach is {"metric", "value", "limit"}.
+    """
+    breaches = []
+    for metric, limit in (slo or {}).items():
+        value = stats.get(metric)
+        if value is None or value > limit:
+            breaches.append(
+                {"metric": metric, "value": value, "limit": limit}
+            )
+    return {"ok": not breaches, "breaches": breaches}

@@ -530,6 +530,27 @@ class PodTimelines:
     def uids(self) -> list[str]:
         return list(self._pods)
 
+    def bind_latencies(self) -> dict[str, float]:
+        """uid -> first-enqueued → first-bound seconds for every tracked
+        pod that bound — the ONE time-to-bind pass behind both the bench
+        quality rows and the scenario replay driver's SLO gate
+        (telemetry.slo). Pods that never bound (or whose enqueue stamp
+        was LRU-evicted) are absent; callers that need full coverage
+        size the timelines to the workload (config.timelines_capacity)."""
+        out: dict[str, float] = {}
+        for uid, e in self._pods.items():
+            enq = bind = None
+            for t, ev, _detail in e["events"]:
+                if enq is None and ev == "enqueued":
+                    enq = t
+                elif bind is None and ev == "bound":
+                    bind = t
+                if enq is not None and bind is not None:
+                    break
+            if enq is not None and bind is not None and bind >= enq:
+                out[uid] = bind - enq
+        return out
+
     def get(self, name: str = "", uid: str = "",
             namespace: str = "default") -> Optional[dict]:
         if not uid and name:
