@@ -803,6 +803,40 @@ def main() -> None:
             "report": rep,
         }))
         sys.exit(0 if rep["ok"] else 1)
+    if "--overload" in sys.argv:
+        # overload row: priority-pod time-to-bind under the best-effort
+        # stampede regime (SLO judged over priority uids only — the
+        # shed best-effort tail is the protection working), plus the
+        # flow-control shed accounting from the overload storm
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from kubernetes_tpu.chaos import run_overload_storm
+        from kubernetes_tpu.scenario.generators import generate
+        from kubernetes_tpu.scenario.replay import replay_trace
+
+        seed = (int(sys.argv[sys.argv.index("--seed") + 1])
+                if "--seed" in sys.argv else 0)
+        tr = generate("overload_stampede", seed=seed)
+        rep = replay_trace(tr, speed=3.0)
+        storm = run_overload_storm(seed=seed)
+        print(json.dumps({
+            "metric": "overload",
+            "scenario": rep["name"],
+            "speed": rep["speed"],
+            "priority_pods": rep["slo_pods"],
+            "pods": rep["pods"],
+            "prio_time_to_bind_p50_ms":
+                rep["stats"]["time_to_bind_p50_ms"],
+            "prio_time_to_bind_p99_ms":
+                rep["stats"]["time_to_bind_p99_ms"],
+            "slo_ok": rep["slo"]["ok"],
+            "audit_ok": rep["audit"]["ok"],
+            "storm_shed_429s": storm["server_rejected"]["best-effort"],
+            "storm_probe_p99_s": storm["probe_p99_s"],
+            "storm_ok": storm["ok"],
+            "hardware_limited": rep["pacing"]["hardware_limited"],
+            "report": rep,
+        }))
+        sys.exit(0 if (rep["ok"] and storm["ok"]) else 1)
     if "--scenario-fuzz" in sys.argv:
         # EXPLICIT opt-in (not part of any battery): adversarial search
         # over regime parameter space under a wall-clock budget;

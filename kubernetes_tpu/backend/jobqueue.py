@@ -177,6 +177,11 @@ class JobQueue:
         # the scheduler's per-cycle gate: True once any tenant/gang pod
         # or group has ever been seen (one attribute read on hot path)
         self.active = False
+        # brownout parking (scheduler overload self-protection): parked
+        # tenants sit out the DRR rotation entirely — no releases, no
+        # credit accrual (parking must not bank deficit the tenant
+        # bursts through the moment pressure clears)
+        self.parked: set[str] = set()
         for name, cfg in (tenants or {}).items():
             self.configure_tenant(name, **cfg)
 
@@ -501,6 +506,9 @@ class JobQueue:
                 name = self._rr[self._rr_i % len(self._rr)]
                 self._rr_i += 1
                 t = self._tenants[name]
+                if name in self.parked:
+                    t.deficit = 0.0     # parked must not bank credit
+                    continue
                 if not t.units:
                     # no backlog: credit must not bank, and backfill
                     # debt has no counterparty left to repay
@@ -634,11 +642,39 @@ class JobQueue:
                     # crediting them would BANK deficit the moment
                     # their quota frees — the invariant the zeroed
                     # unproductive turn enforces
-                    if t.units and not t.idle:
+                    if t.units and not t.idle \
+                            and name not in self.parked:
                         t.deficit += t.weight * DRR_QUANTUM * adv
                 progressed = True
             stalled_rounds = 0 if progressed else stalled_rounds + 1
         return released
+
+    # ------------- brownout parking -------------
+
+    def park_below(self, max_weight: float) -> list[str]:
+        """Park every tenant whose weight is strictly below
+        ``max_weight`` — the best-effort tier by the convention that
+        weight encodes priority class. Parked tenants keep their
+        backlog and quota charges; they simply stop releasing. Returns
+        the names newly parked (sorted, for logs)."""
+        newly = []
+        for name, t in self._tenants.items():
+            if t.weight < max_weight and name not in self.parked:
+                self.parked.add(name)
+                newly.append(name)
+        return sorted(newly)
+
+    def unpark_all(self) -> list[str]:
+        """Brownout exit: every parked tenant rejoins the rotation.
+        Idle flags clear so the next release() re-probes their
+        backlogs. Returns the names freed (sorted)."""
+        freed = sorted(self.parked)
+        self.parked.clear()
+        for name in freed:
+            t = self._tenants.get(name)
+            if t is not None:
+                t.idle = False
+        return freed
 
     # ------------- introspection -------------
 
@@ -655,6 +691,7 @@ class JobQueue:
         for name, t in self._tenants.items():
             out[name] = {
                 "weight": t.weight,
+                "parked": name in self.parked,
                 "depth": t.depth(),
                 "admitted": t.admitted,
                 "contended_admitted": t.contended_admitted,

@@ -1653,6 +1653,209 @@ def run_scaleout_storm(pods: int = 240, nodes: int = 12,
     return report
 
 
+def run_overload_storm(pods: int = 120, nodes: int = 8, seed: int = 31,
+                       overload: int = 10,
+                       timeout_s: float = 150.0) -> dict:
+    """Flow control under a ~10× stampede: a flow-controlled hub serves
+    a real scheduler while ``overload``× its concurrency in anonymous
+    best-effort hammers plus a band of tenant hammers slam the /call
+    wire. ``ok`` iff queue depths stay bounded (never past the
+    configured per-level backlog bound), priority isolation holds
+    (system and scheduler probe p99 inside budget while best-effort
+    sheds with HONEST 429 accounting — every server-side rejection is
+    observed as a typed 429 by exactly one client), every pod binds
+    exactly once (journal-replay audit), and the drain is clean: no
+    watch relists, no daemon error."""
+    from kubernetes_tpu.config.types import default_config
+    from kubernetes_tpu.fabric.flowcontrol import (
+        FlowController,
+        LevelConfig,
+    )
+    from kubernetes_tpu.hub import Hub, TooManyRequests
+    from kubernetes_tpu.hubclient import RemoteHub
+    from kubernetes_tpu.hubserver import HubServer
+    from kubernetes_tpu.ops.features import Capacities
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing import MakeNode, MakePod, \
+        audit_bind_journal
+
+    report: dict = {"pods": pods, "nodes": nodes, "seed": seed,
+                    "overload": overload}
+    hub = Hub()
+    # give every verb a real service time (GIL-released sleep inside
+    # the dispatched call): an in-process hub answers in microseconds,
+    # so without this a seat is always free again before the next
+    # request lands and admission control never sees contention
+    slow_hub = ChaosHub(hub, ChaosConfig(seed=seed, call_latency=0.01))
+    # a small server so the stampede actually saturates: best-effort
+    # gets 1 seat and a shallow queue (shed fast, by design); the
+    # binding and system levels keep their share
+    flow = FlowController(total_concurrency=12, levels={
+        "best-effort": LevelConfig(share=0.08, queues=2, queue_depth=4,
+                                   queue_wait_s=0.05, hand_size=2)})
+    server = HubServer(slow_hub, flow=flow).start()
+
+    def client(identity=None, deadline=6.0):
+        return RemoteHub(server.address, timeout=10.0,
+                         retry_deadline=deadline, retry_base=0.01,
+                         retry_cap=0.2, identity=identity)
+
+    sched_client = client("scheduler-0")
+    clients: list[RemoteHub] = [sched_client]
+    stop_evt = threading.Event()
+    threads: list[threading.Thread] = []
+    lat: dict[str, list[float]] = {"system": [], "scheduler": [],
+                                   "tenant": [], "best-effort": []}
+    lat_lock = threading.Lock()
+
+    def hammer(cl: RemoteHub, cls: str, fn, pause: float = 0.0):
+        def loop():
+            while not stop_evt.is_set():
+                t0 = time.monotonic()
+                try:
+                    fn(cl)
+                    with lat_lock:
+                        lat[cls].append(time.monotonic() - t0)
+                except TooManyRequests:
+                    pass    # the client's throttled_429s counted it
+                except Exception:  # noqa: BLE001 — teardown races
+                    if stop_evt.is_set():
+                        return
+                if pause:
+                    time.sleep(pause)
+        t = threading.Thread(target=loop, daemon=True,
+                             name=f"overload-{cls}")
+        threads.append(t)
+        t.start()
+
+    sched = None
+    try:
+        for i in range(nodes):
+            hub.create_node(MakeNode().name(f"on-{i}")
+                            .capacity(cpu="64", memory="256Gi",
+                                      pods="440").obj())
+        cfg = default_config()
+        cfg.batch_size = 16
+        sched = Scheduler(sched_client, cfg,
+                          caps=Capacities(nodes=max(16, nodes * 2),
+                                          pods=max(256, pods * 2)))
+        sched.start()
+        uids: list[str] = []
+        for i in range(pods):
+            pod = MakePod().name(f"op-{i}").req(cpu="50m").obj()
+            uids.append(pod.metadata.uid)
+            hub.create_pod(pod)
+        probe_uid = uids[0]
+
+        # let the first schedule wave land before unleashing the storm:
+        # the initial device-kernel compile holds the interpreter for
+        # long stretches, and a probe call stalled under a compile
+        # would gate on warmup, not on admission-control isolation
+        warm_end = time.monotonic() + 30.0
+        while time.monotonic() < warm_end:
+            if any(p.spec.node_name for p in hub.list_pods()):
+                break
+            time.sleep(0.1)
+
+        # the stampede: anonymous read hammers (best-effort level),
+        # tenant-attributed read hammers, and the protected probes.
+        # Cheap verbs on purpose — service time is the injected hold,
+        # so the seat contention is real but the hammers don't also
+        # starve the probes of interpreter time encoding huge LISTs
+        for _ in range(overload * 2):
+            cl = client(deadline=0.5)
+            clients.append(cl)
+            hammer(cl, "best-effort", lambda c: c.get_pod(probe_uid))
+        for i in range(max(overload // 2, 3)):
+            cl = client(f"team-{i % 3}", deadline=0.5)
+            clients.append(cl)
+            hammer(cl, "tenant", lambda c: c.list_nodes())
+        sys_probe = client("system-probe", deadline=2.0)
+        clients.append(sys_probe)
+        hammer(sys_probe, "system",
+               lambda c: c.get_pod(probe_uid), pause=0.005)
+        sched_probe = client("sched-probe", deadline=2.0)
+        clients.append(sched_probe)
+        hammer(sched_probe, "scheduler",
+               lambda c: c.get_pod(probe_uid), pause=0.005)
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if sum(1 for p in hub.list_pods()
+                   if p.spec.node_name) >= pods:
+                break
+            time.sleep(0.2)
+        # let the hammers rage a beat past the drain so the shed
+        # accounting below reflects a saturated steady state
+        time.sleep(1.0)
+        stop_evt.set()
+        for t in threads:
+            t.join(timeout=5.0)
+
+        bound = sum(1 for p in hub.list_pods() if p.spec.node_name)
+        audit = audit_bind_journal(hub=hub, expected_uids=uids)
+        fstats = flow.stats()["levels"]
+        depths_bounded = all(
+            lv["depth_peak"] <= lv["queue_depth_bound"]
+            for lv in fstats.values())
+        server_rejected = {
+            name: lv["rejected_full"] + lv["rejected_timeout"]
+            for name, lv in fstats.items()}
+        client_throttled = sum(
+            c.resilience_stats()["throttled_429s"] for c in clients)
+
+        def p99(cls: str) -> float:
+            with lat_lock:
+                xs = sorted(lat[cls])
+            if not xs:
+                return -1.0
+            return xs[min(len(xs) - 1, int(0.99 * len(xs)))]
+
+        p99s = {cls: round(p99(cls), 4) for cls in lat}
+        rs = sched_client.resilience_stats()
+        report.update({
+            "bound": bound,
+            "audit": {k: audit[k] for k in
+                      ("ok", "binds", "double_binds", "lost",
+                       "too_old")},
+            "flow": fstats,
+            "server_rejected": server_rejected,
+            "client_throttled_429s": client_throttled,
+            "probe_p99_s": p99s,
+            "calls_ok": {cls: len(v) for cls, v in lat.items()},
+            "sched_watch_relists": rs["watch_relists"],
+            "sched_throttled": rs["throttled_429s"],
+            "daemon_error": repr(sched.daemon_error)
+            if getattr(sched, "daemon_error", None) else None,
+            "ok": (bound == pods and audit["ok"]
+                   and depths_bounded
+                   # best-effort sheds, with honest typed accounting:
+                   # every server-side 429 reached a client as one
+                   and server_rejected["best-effort"] > 0
+                   and client_throttled == sum(server_rejected.values())
+                   # priority isolation: the protected levels' probes
+                   # stay inside their queue-wait budgets
+                   and 0.0 <= p99s["system"] <= 0.5
+                   and 0.0 <= p99s["scheduler"] <= 0.75
+                   and rs["watch_relists"] == 0
+                   and sched.daemon_error is None),
+        })
+    finally:
+        stop_evt.set()
+        if sched is not None:
+            try:
+                sched.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        for c in clients:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        server.stop()
+    return report
+
+
 def run_scenario_storm(seed: int = 7, speed: float = 3.0) -> dict:
     """Scenario battery (ISSUE 17): replay the zone-outage + recovery-
     stampede named regime, then every fuzzer-filed regression trace
@@ -1709,8 +1912,8 @@ def main() -> None:
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--storm",
                     choices=("smoke", "device", "crash", "proc",
-                             "state", "gang", "scaleout", "scenario",
-                             "all"),
+                             "state", "gang", "scaleout", "overload",
+                             "scenario", "all"),
                     default="smoke",
                     help="which storm to run (bench.py --chaos-smoke "
                          "runs 'all')")
@@ -1730,6 +1933,8 @@ def main() -> None:
         report = run_gang_storm(seed=args.seed)
     elif args.storm == "scaleout":
         report = run_scaleout_storm(seed=args.seed)
+    elif args.storm == "overload":
+        report = run_overload_storm(seed=args.seed)
     elif args.storm == "scenario":
         report = run_scenario_storm(seed=args.seed)
     else:
@@ -1742,6 +1947,7 @@ def main() -> None:
             "state": run_state_storm(seed=args.seed),
             "gang": run_gang_storm(seed=args.seed),
             "scaleout": run_scaleout_storm(seed=args.seed),
+            "overload": run_overload_storm(seed=args.seed),
             "scenario": run_scenario_storm(seed=args.seed),
         }
         report["ok"] = all(r.get("ok") for r in report.values())

@@ -143,6 +143,22 @@ class SchedulerConfiguration:
     # routes every gang through the host Permit-quorum path (the
     # differential-test arm; the fallback ladder lands here too)
     gang_device_packing: bool = True
+    # scheduler brownout (overload protection): when the hub answers a
+    # sustained run of 429s (flow-control rejections) or queue-wait SLO
+    # breaches, the scheduler sheds its own load instead of hammering a
+    # saturated fabric — effective batch shrinks to
+    # max(batch_size // brownout_batch_divisor, brownout_batch_floor),
+    # the drift sentinel stretches its cadence by
+    # brownout_drift_stretch, and best-effort tenants (weight <
+    # brownout_besteffort_weight) are parked in the jobqueue. Exits
+    # after brownout_clear_windows consecutive maintenance windows with
+    # no new throttles. brownout_throttle_threshold <= 0 disables.
+    brownout_throttle_threshold: int = 8
+    brownout_clear_windows: int = 3
+    brownout_batch_divisor: int = 4
+    brownout_batch_floor: int = 8
+    brownout_drift_stretch: float = 4.0
+    brownout_besteffort_weight: float = 0.25
     # explicit tie-break RNG seed for the device pipeline's equal-score
     # node choice: paired A/B runs (bench --ab-scorer) share a seed so
     # placement diffs are attributable to the scorer, not the coin.

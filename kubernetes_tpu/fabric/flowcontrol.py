@@ -1,0 +1,388 @@
+"""Flow control & overload protection: the fabric's APF analog.
+
+The reference kube-apiserver bounds overload with API Priority &
+Fairness (staging/src/k8s.io/apiserver/pkg/util/flowcontrol): every
+request is classified into a priority level, each level owns a bounded
+share of the server's concurrency, and requests beyond the share wait
+in shuffle-sharded fair queues with bounded depth and a queue-wait
+deadline — past either bound the answer is a typed 429 with a
+Retry-After hint, never unbounded queue growth. This module is that
+discipline for the fabric's ``/call`` wire (hub, shard, router — every
+server built on hubserver's handler).
+
+Priority levels, strictly ordered by what must survive a stampede:
+
+* ``system``      — fabric liveness: leases, rv allocation, ring/
+                    registry verbs, replica RPCs. Losing these loses
+                    the control plane itself.
+* ``scheduler``   — the binding path: bind, status patches, nominated-
+                    node clears. Losing these stops cluster progress.
+* ``tenant``      — namespaced object traffic with an extractable
+                    tenant (flow id = namespace): fair-queued so one
+                    noisy tenant cannot starve the rest of its level.
+* ``best-effort`` — everything anonymous: unattributed reads, probes,
+                    crawlers. First to shed, by design.
+
+Each level's seat count is ``share × total_concurrency`` (strict caps:
+isolation is the property the overload storm gates on, so levels never
+borrow from each other). A full level fair-queues the request: the
+flow id's *hand* of candidate queues is drawn by deterministic shuffle
+sharding and the shortest is chosen, so a hot flow collides with a
+different small subset of flows on every level reconfiguration while a
+mouse flow almost always finds an empty queue. Seats released by
+finishing requests hand off directly to queued waiters round-robin
+across queues (fair dispatch); a waiter that outlives its level's
+queue-wait deadline answers 429 like a rejected one.
+
+The controller is transport-agnostic — ``admission()`` is a context
+manager around any callable — and clock-injectable for tests.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+import zlib
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass
+
+from kubernetes_tpu.hub import TooManyRequests
+
+PRIORITY_LEVELS = ("system", "scheduler", "tenant", "best-effort")
+
+# method → level when the caller carries no identity header. Prefixes
+# cover the fabric verb families (hubserver.CALL_METHODS); the
+# scheduler set is the binding path any component may drive.
+_SYSTEM_PREFIXES = ("leases.", "rv.", "fabric_", "replica_")
+_SYSTEM_METHODS = frozenset({
+    "export_segment", "import_segment", "drop_segment", "abort_export",
+    "reconcile_ring", "rebalance_segment", "shard_map",
+    "get_journal_stats",
+})
+_SCHEDULER_METHODS = frozenset({
+    "bind", "patch_pod_condition", "clear_nominated_node",
+    "set_pod_claim_statuses",
+})
+# identity prefixes → level (the X-KTPU-Identity header; RemoteHub
+# stamps it from its ``identity=`` arg, the same name the telemetry
+# plane uses for the component)
+_SYSTEM_IDENTITIES = ("relay", "router", "shard", "state", "fabric",
+                      "system", "hub")
+_SCHEDULER_IDENTITIES = ("scheduler", "sched")
+
+
+# watch-path backpressure (fabric.relay): the fraction of its queue
+# bound a subscriber may fill while the relay is under global backlog
+# pressure — best-effort cut first, the binding/system streams keep
+# their full bound
+PRIORITY_SHED_FACTORS = {"system": 1.0, "scheduler": 1.0,
+                         "tenant": 0.5, "best-effort": 0.25}
+
+
+def watch_priority(identity: str | None = None) -> str:
+    """Priority level for a watch subscription, from the dial's
+    ``identity=`` (same names as the /call header): fabric components
+    ride system, schedulers ride scheduler, any other attributed
+    consumer is a tenant, anonymous is best-effort."""
+    ident = (identity or "").strip().lower()
+    if ident.startswith(_SYSTEM_IDENTITIES):
+        return "system"
+    if ident.startswith(_SCHEDULER_IDENTITIES):
+        return "scheduler"
+    if ident:
+        return "tenant"
+    return "best-effort"
+
+
+def classify_call(method: str, args=None, identity: str | None = None):
+    """-> (level, flow_id). Identity outranks the verb — a scheduler's
+    LIST is scheduler traffic, not best-effort — and the verb outranks
+    anonymity, so an unidentified bind still rides the binding level
+    (progress over protocol)."""
+    ident = (identity or "").strip()
+    if ident:
+        low = ident.lower()
+        if low.startswith(_SYSTEM_IDENTITIES):
+            return "system", ident
+        if low.startswith(_SCHEDULER_IDENTITIES):
+            return "scheduler", ident
+    if method.startswith(_SYSTEM_PREFIXES) or method in _SYSTEM_METHODS:
+        return "system", ident or "system"
+    if method in _SCHEDULER_METHODS:
+        return "scheduler", ident or "scheduler"
+    ns = _namespace_of(args)
+    if ns:
+        return "tenant", ns
+    if ident:
+        return "tenant", ident
+    return "best-effort", "anon"
+
+
+def _namespace_of(args) -> str | None:
+    """Best-effort tenant extraction from a /call arg list: a typed
+    object's metadata.namespace, or the ``ns/name`` key string the get
+    verbs take. Never raises — unattributable stays unattributed."""
+    if not args:
+        return None
+    for a in args[:2]:
+        meta = getattr(a, "metadata", None)
+        ns = getattr(meta, "namespace", None)
+        if isinstance(ns, str) and ns:
+            return ns
+        if isinstance(a, str) and "/" in a:
+            head = a.split("/", 1)[0]
+            if head:
+                return head
+    return None
+
+
+@dataclass
+class LevelConfig:
+    """One priority level's bounds. ``share`` of total concurrency
+    becomes the level's seat count; ``queues`` × ``queue_depth`` bounds
+    its total backlog; ``queue_wait_s`` is the deadline past which a
+    queued request answers 429; ``hand_size`` is the shuffle-shard hand
+    (1 = plain FIFO per level, >1 = per-flow fairness)."""
+
+    share: float
+    queues: int = 1
+    queue_depth: int = 64
+    queue_wait_s: float = 1.0
+    hand_size: int = 1
+
+
+DEFAULT_LEVELS: dict[str, LevelConfig] = {
+    "system": LevelConfig(share=0.35, queues=1, queue_depth=128,
+                          queue_wait_s=2.0, hand_size=1),
+    "scheduler": LevelConfig(share=0.35, queues=2, queue_depth=128,
+                             queue_wait_s=1.0, hand_size=1),
+    "tenant": LevelConfig(share=0.22, queues=16, queue_depth=32,
+                          queue_wait_s=0.5, hand_size=4),
+    "best-effort": LevelConfig(share=0.08, queues=8, queue_depth=16,
+                               queue_wait_s=0.25, hand_size=2),
+}
+
+
+class _Waiter:
+    __slots__ = ("event", "granted", "qi")
+
+    def __init__(self, qi: int):
+        self.event = threading.Event()
+        self.granted = False
+        self.qi = qi
+
+
+class _Level:
+    __slots__ = ("name", "cfg", "seats", "in_flight", "queues", "rr",
+                 "admitted", "queued", "rejected_full",
+                 "rejected_timeout", "in_flight_peak", "depth_peak")
+
+    def __init__(self, name: str, cfg: LevelConfig, seats: int):
+        self.name = name
+        self.cfg = cfg
+        self.seats = seats
+        self.in_flight = 0
+        self.queues: list[deque] = [deque() for _ in range(cfg.queues)]
+        self.rr = 0
+        self.admitted = 0
+        self.queued = 0
+        self.rejected_full = 0
+        self.rejected_timeout = 0
+        self.in_flight_peak = 0
+        self.depth_peak = 0
+
+    def depth(self) -> int:
+        return sum(len(q) for q in self.queues)
+
+
+class FlowController:
+    """``with flow.admission(method, args, identity): serve()`` —
+    admits within the level's seats, fair-queues within its bounds,
+    raises :class:`~kubernetes_tpu.hub.TooManyRequests` past them."""
+
+    def __init__(self, total_concurrency: int = 64,
+                 levels: dict[str, LevelConfig] | None = None,
+                 clock=time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        cfgs = dict(DEFAULT_LEVELS)
+        if levels:
+            cfgs.update(levels)
+        self.total_concurrency = total_concurrency
+        self._levels: dict[str, _Level] = {}
+        for name in PRIORITY_LEVELS:
+            cfg = cfgs[name]
+            seats = max(1, round(cfg.share * total_concurrency))
+            self._levels[name] = _Level(name, cfg, seats)
+        # flow_id → hand cache (bounded): shuffle sharding is
+        # deterministic per flow, no need to redraw per request
+        self._hands: dict[tuple[str, str], tuple[int, ...]] = {}
+
+    # ------------- classification -------------
+
+    def classify(self, method: str, args=None,
+                 identity: str | None = None):
+        return classify_call(method, args, identity)
+
+    # ------------- admission -------------
+
+    @contextmanager
+    def admission(self, method: str, args=None,
+                  identity: str | None = None):
+        level, flow_id = classify_call(method, args, identity)
+        self.admit(level, flow_id, what=method)
+        try:
+            yield level
+        finally:
+            self.release(level)
+
+    def admit(self, level_name: str, flow_id: str,
+              what: str = "") -> None:
+        """Take a seat at ``level_name`` or wait in ``flow_id``'s fair
+        queue up to the level's queue-wait deadline. Raises
+        TooManyRequests (with a Retry-After hint) on a full queue or a
+        deadline breach. Every successful admit MUST be paired with
+        :meth:`release`."""
+        lv = self._levels[level_name]
+        with self._lock:
+            if lv.in_flight < lv.seats:
+                lv.in_flight += 1
+                lv.in_flight_peak = max(lv.in_flight_peak, lv.in_flight)
+                lv.admitted += 1
+                return
+            qi = self._pick_queue(lv, flow_id)
+            if len(lv.queues[qi]) >= lv.cfg.queue_depth:
+                lv.rejected_full += 1
+                raise TooManyRequests(
+                    f"{level_name} level saturated "
+                    f"({lv.in_flight}/{lv.seats} seats, queue full)"
+                    + (f" serving {what}" if what else ""),
+                    retry_after=self._retry_after(lv))
+            w = _Waiter(qi)
+            lv.queues[qi].append(w)
+            lv.queued += 1
+            lv.depth_peak = max(lv.depth_peak, lv.depth())
+        if w.event.wait(lv.cfg.queue_wait_s):
+            return          # a releaser handed us its seat
+        with self._lock:
+            if w.granted:   # grant raced the deadline: accept it
+                return
+            try:
+                lv.queues[w.qi].remove(w)
+            except ValueError:
+                pass
+            lv.rejected_timeout += 1
+        raise TooManyRequests(
+            f"{level_name} queue-wait deadline "
+            f"({lv.cfg.queue_wait_s:.2f}s) breached"
+            + (f" serving {what}" if what else ""),
+            retry_after=self._retry_after(lv))
+
+    def release(self, level_name: str) -> None:
+        """Return a seat; if the level has queued waiters the seat
+        transfers directly (round-robin across queues — the fair
+        dispatch half of fair queuing)."""
+        lv = self._levels[level_name]
+        with self._lock:
+            for i in range(len(lv.queues)):
+                qi = (lv.rr + i) % len(lv.queues)
+                if lv.queues[qi]:
+                    w = lv.queues[qi].popleft()
+                    lv.rr = (qi + 1) % len(lv.queues)
+                    w.granted = True
+                    lv.admitted += 1
+                    w.event.set()
+                    return   # seat transferred, in_flight unchanged
+            lv.in_flight = max(0, lv.in_flight - 1)
+
+    # ------------- internals -------------
+
+    def _pick_queue(self, lv: _Level, flow_id: str) -> int:
+        """Shuffle sharding: the flow's deterministic hand of candidate
+        queues, shortest wins. Caller holds the lock."""
+        n = len(lv.queues)
+        if n == 1:
+            return 0
+        key = (lv.name, flow_id)
+        hand = self._hands.get(key)
+        if hand is None:
+            rng = random.Random(zlib.crc32(
+                f"{lv.name}/{flow_id}".encode()))
+            hand = tuple(rng.sample(range(n),
+                                    min(lv.cfg.hand_size, n)))
+            if len(self._hands) >= 4096:   # bounded flow memory
+                self._hands.clear()
+            self._hands[key] = hand
+        return min(hand, key=lambda i: len(lv.queues[i]))
+
+    def _retry_after(self, lv: _Level) -> float:
+        """Honest hint: one queue-wait window, stretched by how far
+        over its backlog bound the level is. Caller holds the lock."""
+        bound = max(1, len(lv.queues) * lv.cfg.queue_depth)
+        return round(min(5.0, lv.cfg.queue_wait_s
+                         * (1.0 + lv.depth() / bound)), 3)
+
+    # ------------- introspection -------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            levels = {}
+            for name, lv in self._levels.items():
+                levels[name] = {
+                    "seats": lv.seats,
+                    "in_flight": lv.in_flight,
+                    "in_flight_peak": lv.in_flight_peak,
+                    "queue_depth": lv.depth(),
+                    "queue_depth_bound": len(lv.queues)
+                    * lv.cfg.queue_depth,
+                    "depth_peak": lv.depth_peak,
+                    "admitted": lv.admitted,
+                    "queued": lv.queued,
+                    "rejected_full": lv.rejected_full,
+                    "rejected_timeout": lv.rejected_timeout,
+                }
+            return {"total_concurrency": self.total_concurrency,
+                    "levels": levels}
+
+    def rejected_total(self) -> int:
+        with self._lock:
+            return sum(lv.rejected_full + lv.rejected_timeout
+                       for lv in self._levels.values())
+
+    def debug_state(self) -> dict:
+        out = self.stats()
+        with self._lock:
+            for name, lv in self._levels.items():
+                out["levels"][name]["per_queue"] = [
+                    len(q) for q in lv.queues]
+                out["levels"][name]["queue_wait_s"] = lv.cfg.queue_wait_s
+                out["levels"][name]["hand_size"] = lv.cfg.hand_size
+        return out
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition rows (``hub_flow_*``), appended to the
+        serving component's /metrics by telemetry.fleet."""
+        s = self.stats()
+        lines = [
+            "# TYPE hub_flow_in_flight gauge",
+            "# TYPE hub_flow_queue_depth gauge",
+            "# TYPE hub_flow_seats gauge",
+            "# TYPE hub_flow_admitted_total counter",
+            "# TYPE hub_flow_rejected_total counter",
+        ]
+        for name, lv in sorted(s["levels"].items()):
+            lab = f'{{level="{name}"}}'
+            lines.append(f"hub_flow_seats{lab} {lv['seats']}")
+            lines.append(f"hub_flow_in_flight{lab} {lv['in_flight']}")
+            lines.append(
+                f"hub_flow_queue_depth{lab} {lv['queue_depth']}")
+            lines.append(
+                f"hub_flow_admitted_total{lab} {lv['admitted']}")
+            for reason in ("full", "timeout"):
+                lines.append(
+                    f'hub_flow_rejected_total{{level="{name}",'
+                    f'reason="{reason}"}} '
+                    f"{lv['rejected_' + reason]}")
+        return "\n".join(lines) + "\n"

@@ -48,7 +48,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
 from kubernetes_tpu.fabric import codec as binwire
-from kubernetes_tpu.hub import EventHandlers
+from kubernetes_tpu.fabric.flowcontrol import (
+    PRIORITY_SHED_FACTORS,
+    watch_priority,
+)
+from kubernetes_tpu.hub import EventHandlers, TooManyRequests
 from kubernetes_tpu.hubserver import (
     FRAMES_CONTENT_TYPE,
     make_stream_writers,
@@ -72,10 +76,10 @@ class Subscriber:
     back to :meth:`RelayCore.subscribe`."""
 
     __slots__ = ("kinds", "queue", "event", "cursor", "cursors",
-                 "sync_shards", "evicted", "limit", "ident")
+                 "sync_shards", "evicted", "limit", "ident", "priority")
 
     def __init__(self, kinds: tuple[str, ...], limit: int,
-                 cursor: int, ident: int):
+                 cursor: int, ident: int, priority: str = "tenant"):
         self.kinds = kinds
         self.queue: deque = deque()
         self.event = threading.Event()
@@ -85,6 +89,11 @@ class Subscriber:
         self.evicted = False
         self.limit = limit
         self.ident = ident
+        # flow-control level (fabric.flowcontrol.watch_priority): under
+        # global backlog pressure a subscriber's EFFECTIVE queue bound
+        # is limit × its level's shed factor — best-effort cut first,
+        # system/scheduler streams keep their full bound
+        self.priority = priority
 
     def drain(self) -> list[dict]:
         """Consumer side: take everything queued (thread-safe against
@@ -106,12 +115,18 @@ class RelayCore:
                  ring_capacity: int = 8192, queue_limit: int = 4096,
                  client_factory: Optional[Callable] = None,
                  timeout: float = 30.0,
-                 watchdog: Optional[dict] = None):
+                 watchdog: Optional[dict] = None,
+                 backlog_limit: Optional[int] = None):
         from kubernetes_tpu.hubclient import RemoteHub
 
         self.upstream_url = upstream_url
         self.kinds = tuple(kinds)
         self.queue_limit = queue_limit
+        # global backpressure threshold: when the summed downstream
+        # backlog crosses it, eviction turns priority-aware (shed
+        # factors) and NEW best-effort subscriptions answer 429.
+        # None (default) keeps the legacy flat-eviction behavior.
+        self.backlog_limit = backlog_limit
         self._ring_capacity = ring_capacity
         self._lock = threading.Lock()
         # ring journals PER SOURCE SHARD ("" = untagged single-hub
@@ -140,6 +155,10 @@ class RelayCore:
         self.relist_serves = 0         # downstream LIST replays served
         self.events_in = 0
         self.events_out = 0
+        # pressure-mode counters: evictions below the subscriber's full
+        # bound (per priority level), and new subscriptions shed (429)
+        self.pressure_evictions: dict[str, int] = {}
+        self.subscriptions_shed = 0
         self._factory = client_factory or (
             lambda url: RemoteHub(url, timeout=timeout))
         self._handlers = {k: EventHandlers(
@@ -247,17 +266,37 @@ class RelayCore:
                 self.last_rv = rv
         self._synced.set()
 
+    def _backlog(self) -> int:
+        """Summed downstream backlog (caller holds the lock). A
+        multi-kind subscriber counts once per kind — fine for a
+        pressure heuristic, and it errs toward shedding sooner."""
+        return sum(len(s.queue) for subs in self._subs.values()
+                   for s in subs)
+
+    def _under_pressure(self) -> bool:
+        return self.backlog_limit is not None \
+            and self._backlog() >= self.backlog_limit
+
     def _fan_out(self, kind: str, d: dict) -> None:
         # caller holds the lock; eviction rebuilds the list after the
         # sweep so iteration stays cheap (no copy per event)
         subs = self._subs[kind]
         sh = d.get("sh") or ""
+        pressured = self._under_pressure()
         evicted_any = False
         for sub in subs:
             if sub.evicted:
                 evicted_any = True
                 continue
-            if len(sub.queue) >= sub.limit:
+            limit = sub.limit
+            if pressured:
+                # priority-aware backpressure: under global backlog
+                # pressure a subscriber's effective bound shrinks by
+                # its level's shed factor — best-effort streams are cut
+                # first while system/scheduler keep their full bound
+                limit = max(1, int(limit * PRIORITY_SHED_FACTORS.get(
+                    sub.priority, 0.25)))
+            if len(sub.queue) >= limit:
                 # backpressure verdict: this consumer stopped draining.
                 # Cut it (it will reconnect-and-resume, or relist) —
                 # never buffer unboundedly, never stall the siblings,
@@ -265,6 +304,9 @@ class RelayCore:
                 sub.evicted = True
                 sub.event.set()
                 self.slow_evictions += 1
+                if limit < sub.limit:
+                    self.pressure_evictions[sub.priority] = \
+                        self.pressure_evictions.get(sub.priority, 0) + 1
                 evicted_any = True
                 continue
             sub.queue.append(d)
@@ -282,7 +324,8 @@ class RelayCore:
     def subscribe(self, kinds: tuple[str, ...] | None = None,
                   since_rv: int | None = None, replay: bool = True,
                   queue_limit: int | None = None,
-                  cursors: dict[str, int] | None = None) -> Subscriber:
+                  cursors: dict[str, int] | None = None,
+                  priority: str = "tenant") -> Subscriber:
         """Register a downstream reflector. ``since_rv``/``cursors``
         resume off the relay's per-shard rings (RvTooOld when any
         needed cursor fell off its ring — the caller relists, exactly
@@ -301,8 +344,17 @@ class RelayCore:
             raise RuntimeError("relay upstream never synced")
         resume = since_rv is not None or cursors is not None
         with self._lock:
+            if priority == "best-effort" and self._under_pressure():
+                # shed NEW best-effort subscriptions before degrading
+                # existing streams: the 429 (with a hint) costs the
+                # caller a redial, not a torn stream
+                self.subscriptions_shed += 1
+                raise TooManyRequests(
+                    "relay under backlog pressure: best-effort "
+                    "subscriptions shed", retry_after=1.0)
             sub = Subscriber(kinds, queue_limit or self.queue_limit,
-                             self.last_rv, self._next_ident)
+                             self.last_rv, self._next_ident,
+                             priority=priority)
             self._next_ident += 1
             # "complete through here", per shard, at registration time
             sub.sync_shards = {s: rv for s, rv in self._ring_rv.items()
@@ -376,6 +428,10 @@ class RelayCore:
                     "relist_serves": self.relist_serves,
                     "events_in": self.events_in,
                     "events_out": self.events_out,
+                    "backlog": self._backlog(),
+                    "backlog_limit": self.backlog_limit,
+                    "pressure_evictions": dict(self.pressure_evictions),
+                    "subscriptions_shed": self.subscriptions_shed,
                     "watchdog_reparents": self.watchdog_reparents,
                     "upstream_client": up}
 
@@ -390,6 +446,7 @@ class RelayCore:
                        "cursors": {sh: rv for sh, rv
                                    in s.cursors.items() if sh},
                        "queued": len(s.queue),
+                       "priority": s.priority,
                        "evicted": s.evicted}
                       for s in subs[:max_subscribers]]
             ring = {}
@@ -535,11 +592,15 @@ class _RelayHandler(BaseHTTPRequestHandler):
     def core(self) -> RelayCore:
         return self.server.core           # type: ignore[attr-defined]
 
-    def _json(self, status: int, payload: dict) -> None:
+    def _json(self, status: int, payload: dict,
+              headers: Optional[dict] = None) -> None:
         body = json.dumps(payload).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if headers:
+            for k, v in headers.items():
+                self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -631,7 +692,17 @@ class _RelayHandler(BaseHTTPRequestHandler):
             sub = self.core.subscribe(tuple(params.kinds),
                                       since_rv=params.since_rv,
                                       replay=params.replay,
-                                      cursors=params.cursors)
+                                      cursors=params.cursors,
+                                      priority=watch_priority(
+                                          q.get("identity", [""])[0]))
+        except TooManyRequests as e:
+            # backlog pressure: new best-effort subscriptions shed with
+            # an honest hint instead of degrading existing streams
+            self._json(429, {"error": "TooManyRequests",
+                             "message": str(e)},
+                       headers={"Retry-After":
+                                f"{e.retry_after:.3f}"})
+            return
         except RvTooOld as e:
             # cursor fell off the relay ring: the 410 that sends the
             # client back for a relist — which the relay itself serves

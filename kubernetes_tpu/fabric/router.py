@@ -37,7 +37,8 @@ import urllib.request
 
 from kubernetes_tpu.fabric import codec as binwire
 from kubernetes_tpu.fabric.cluster import ClusterClient
-from kubernetes_tpu.hub import NotFound
+from kubernetes_tpu.fabric.flowcontrol import watch_priority
+from kubernetes_tpu.hub import NotFound, TooManyRequests
 from kubernetes_tpu.hubserver import (
     FRAMES_CONTENT_TYPE,
     _Handler,
@@ -70,9 +71,13 @@ class _RouterHandler(_Handler):
                 process_identity_text,
             )
 
-            self._text(200, process_identity_text(
-                "router", self.server.server_address[1])
-                + hub_metrics_text(self.cluster))
+            body = process_identity_text(
+                "router", self.server.server_address[1]) \
+                + hub_metrics_text(self.cluster)
+            flow = getattr(self.server, "flow", None)
+            if flow is not None:
+                body += flow.metrics_text()
+            self._text(200, body)
             return
         if path == "/topology":
             topo = self.server.topology()  # type: ignore[attr-defined]
@@ -87,7 +92,38 @@ class _RouterHandler(_Handler):
         if params is None:
             self._json(400, {"error": "ValueError", "message": err})
             return
-        self._watch_passthrough(params)
+        srv = self.server
+        limit = getattr(srv, "watch_limit", None)
+        if limit is None:
+            self._watch_passthrough(params)
+            return
+        # admission before the expensive part: each passthrough opens
+        # one upstream socket per owning shard, so NEW best-effort
+        # subscriptions shed at the bound — existing streams (and any
+        # attributed priority) are never cut to make room
+        priority = watch_priority(q.get("identity", [""])[0])
+        with srv.watch_lock:                # type: ignore[attr-defined]
+            if priority == "best-effort" \
+                    and srv.watch_active >= limit:
+                srv.watch_sheds += 1
+                shed = True
+            else:
+                srv.watch_active += 1
+                shed = False
+        if shed:
+            e = TooManyRequests(
+                "router watch capacity: best-effort subscriptions "
+                "shed", retry_after=1.0)
+            self._json(429, {"error": "TooManyRequests",
+                             "message": str(e)},
+                       headers={"Retry-After":
+                                f"{e.retry_after:.3f}"})
+            return
+        try:
+            self._watch_passthrough(params)
+        finally:
+            with srv.watch_lock:            # type: ignore[attr-defined]
+                srv.watch_active -= 1
 
     # ------------- the pass-through merge -------------
 
@@ -281,18 +317,29 @@ class RouterServer:
                  codecs: tuple[str, ...] = (binwire.CODEC_BINARY,
                                             binwire.CODEC_JSON),
                  cluster: ClusterClient | None = None,
-                 topology_ttl_s: float = 1.0):
+                 topology_ttl_s: float = 1.0,
+                 flow=None, watch_limit: int | None = None):
         import os
 
         from http.server import ThreadingHTTPServer
 
         self.cluster = cluster or ClusterClient(state_url)
         self.name = name
+        self.flow = flow
         self._httpd = ThreadingHTTPServer((host, port), _RouterHandler)
         self._httpd.daemon_threads = True
         self._httpd.hub = self.cluster        # type: ignore[attr-defined]
         self._httpd.codecs = codecs           # type: ignore[attr-defined]
         self._httpd.stopping = False          # type: ignore[attr-defined]
+        # flow control: ``flow`` bounds /call admission (the inherited
+        # hubserver handler reads it); ``watch_limit`` bounds live
+        # passthrough streams — past it, new best-effort watch
+        # subscriptions answer 429 (None = legacy unbounded)
+        self._httpd.flow = flow               # type: ignore[attr-defined]
+        self._httpd.watch_limit = watch_limit  # type: ignore[attr-defined]
+        self._httpd.watch_active = 0          # type: ignore[attr-defined]
+        self._httpd.watch_sheds = 0           # type: ignore[attr-defined]
+        self._httpd.watch_lock = threading.Lock()  # type: ignore[attr-defined]
         self._httpd.topology = self._topology  # type: ignore[attr-defined]
         self._topo_cache: tuple[float, dict] | None = None
         self._topo_ttl = topology_ttl_s
@@ -332,6 +379,11 @@ class RouterServer:
     @property
     def port(self) -> int:
         return self._httpd.server_address[1]
+
+    @property
+    def watch_sheds(self) -> int:
+        """Best-effort watch subscriptions answered 429 (watch_limit)."""
+        return self._httpd.watch_sheds    # type: ignore[attr-defined]
 
     def start(self) -> "RouterServer":
         self._thread = threading.Thread(target=self._httpd.serve_forever,

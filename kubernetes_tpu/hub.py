@@ -141,6 +141,29 @@ class StaleRing(Exception):
     a deposed segment owner."""
 
 
+class TooManyRequests(Exception):
+    """Flow control rejected the request: the caller's priority level
+    is at its concurrency share and its fair queue is full (or the
+    queue-wait deadline passed). HTTP 429 + Retry-After on the wire.
+    The request did NOT run. Carries ``retry_after`` (seconds, the
+    server's honest backoff hint) encoded into the message so it
+    survives the /call wire's {error, message} envelope, exactly like
+    NotLeader's redirect hint; the single-arg constructor re-parses it
+    client-side."""
+
+    _HINT = re.compile(r"\[retry-after=(?P<s>[0-9.]+)s\]")
+
+    def __init__(self, message: str = "", retry_after=None):
+        if retry_after is not None:
+            message = f"{message} [retry-after={retry_after:.3f}s]"
+        else:
+            m = self._HINT.search(message)
+            if m is not None:
+                retry_after = float(m.group("s"))
+        super().__init__(message)
+        self.retry_after = retry_after or 0.0
+
+
 def _by_name(obj) -> str:
     return obj.metadata.name
 

@@ -56,6 +56,7 @@ import logging
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 
 from kubernetes_tpu.fabric import codec as binwire
@@ -66,6 +67,7 @@ from kubernetes_tpu.hub import (
     NotFound,
     NotLeader,
     StaleRing,
+    TooManyRequests,
     Unavailable,
 )
 from kubernetes_tpu.hubserver import (
@@ -82,7 +84,11 @@ _ERRORS = {"Conflict": Conflict, "NotFound": NotFound, "Fenced": Fenced,
            "ValueError": ValueError, "TypeError": TypeError,
            # typed redirects: NotLeader re-parses its leader hint from
            # the message; StaleRing sends the caller back to the ring
-           "NotLeader": NotLeader, "StaleRing": StaleRing}
+           "NotLeader": NotLeader, "StaleRing": StaleRing,
+           # flow control: re-parses its retry_after hint the same way
+           # (429s are handled before this map for idempotent verbs —
+           # the entry covers writes surfacing the typed verdict)
+           "TooManyRequests": TooManyRequests}
 
 # safe to replay blindly: reads never mutate. The split covers dotted
 # verbs too ("leases.get" -> "get"). The explicit extras are fabric
@@ -158,9 +164,15 @@ class RemoteHub:
                  retry_base: float = 0.05, retry_cap: float = 1.0,
                  retry_budget: float = 20.0,
                  retry_refill_per_sec: float = 4.0,
-                 codec: str | None = None):
+                 codec: str | None = None,
+                 identity: str | None = None):
         self._base = base_url.rstrip("/")
         self._timeout = timeout
+        # the caller's component identity (scheduler/relay/...): rides
+        # every /call (X-KTPU-Identity) and watch dial (identity=) so
+        # flow control classifies the flow instead of guessing from
+        # the verb; None = unattributed (best-effort level)
+        self._identity = identity
         self._retry_deadline = retry_deadline
         self._retry_base = retry_base
         self._retry_cap = retry_cap
@@ -180,6 +192,8 @@ class RemoteHub:
         self._degraded_since: float | None = None
         self._degraded_accum = 0.0
         self._retries = 0
+        self._throttled = 0          # 429 answers (calls + watch dials)
+        self._throttle_retries = 0   # 429s retried with the server hint
         self._watch_reconnects = 0
         self._watch_resumes = 0    # reconnects served from the journal
         self._watch_relists = 0    # reconnects that fell back to LIST
@@ -258,6 +272,8 @@ class RemoteHub:
             if self._degraded_since is not None:
                 degraded_s += time.monotonic() - self._degraded_since
             return {"retries": self._retries,
+                    "throttled_429s": self._throttled,
+                    "throttle_retries": self._throttle_retries,
                     "watch_reconnects": self._watch_reconnects,
                     "watch_resumes": self._watch_resumes,
                     "watch_relists": self._watch_relists,
@@ -293,6 +309,8 @@ class RemoteHub:
                         f"json;accept={binwire.CODEC_BINARY};" \
                         f"fp={binwire.registry_fingerprint()}"
                 body_codec = binwire.CODEC_JSON
+            if self._identity:
+                headers["X-KTPU-Identity"] = self._identity
             req = urllib.request.Request(
                 self._base + "/call", data=body, headers=headers)
             try:
@@ -316,6 +334,42 @@ class RemoteHub:
                     return binwire.decode(raw)["result"]
                 return from_wire(json.loads(raw)["result"])
             except urllib.error.HTTPError as e:
+                if e.code == 429:
+                    # flow control shed us: the server is HEALTHY and
+                    # answered with a hint — not degraded transport.
+                    # Idempotent verbs retry with max(hint, jitter)
+                    # inside the normal budget + deadline; writes
+                    # surface the typed verdict (a non-idempotent verb
+                    # is never replayed blindly, throttled or not).
+                    self._mark_connected()
+                    hint = 0.0
+                    try:
+                        hint = float(e.headers.get("Retry-After")
+                                     or 0.0)
+                    except (TypeError, ValueError):
+                        hint = 0.0
+                    try:
+                        msg = json.loads(e.read()).get("message", "")
+                    except (ValueError, OSError):
+                        msg = ""
+                    try:
+                        e.close()
+                    except OSError:
+                        pass
+                    with self._slock:
+                        self._throttled += 1
+                    exc = TooManyRequests(msg)
+                    hint = hint or exc.retry_after
+                    remaining = t_end - time.monotonic()
+                    if not idempotent or remaining <= 0 \
+                            or not self._budget.try_spend():
+                        raise exc from None
+                    with self._slock:
+                        self._retries += 1
+                        self._throttle_retries += 1
+                    time.sleep(min(max(hint, bo.next()),
+                                   max(remaining, 0.0)))
+                    continue
                 if e.code in _RETRYABLE_HTTP:
                     err = f"HTTP {e.code}"
                     try:
@@ -510,6 +564,9 @@ class RemoteHub:
             if self._pin != binwire.CODEC_JSON:
                 url += f"&codec={binwire.CODEC_BINARY}" \
                        f"&fp={binwire.registry_fingerprint()}"
+            if self._identity:
+                url += "&identity=" + urllib.parse.quote(
+                    self._identity, safe="")
             resp = urllib.request.urlopen(url, timeout=self._timeout)
             with self._wlock:
                 # swap, don't leak: the previous connection's response
@@ -697,9 +754,11 @@ class RemoteHub:
                     # dials until a connection holds, so consume() is
                     # never re-entered with a dead handle.
                     force_relist = False
+                    hint = 0.0
                     while True:
-                        if self._closed.wait(bo.next()):
+                        if self._closed.wait(max(hint, bo.next())):
                             return             # close() during the sleep
+                        hint = 0.0
                         if force_relist:
                             # stale per-shard cursors die with the
                             # relist; the diff covers the gap and the
@@ -713,6 +772,8 @@ class RemoteHub:
                                            and shard_rvs else None)
                         except urllib.error.HTTPError as e:
                             code = e.code
+                            ra = e.headers.get("Retry-After") \
+                                if e.headers else None
                             try:
                                 e.close()      # no socket leak per retry
                             except OSError:
@@ -721,6 +782,18 @@ class RemoteHub:
                                 # journal compacted past our resume
                                 # point: relist on the next dial
                                 force_relist = True
+                                continue
+                            if code == 429:
+                                # shed under watch-admission pressure:
+                                # an honest throttle from a healthy
+                                # server, not a verdict — redial after
+                                # its Retry-After hint
+                                try:
+                                    hint = float(ra or 0.0)
+                                except (TypeError, ValueError):
+                                    hint = 0.0
+                                with self._slock:
+                                    self._throttled += 1
                                 continue
                             if code in _RETRYABLE_HTTP:
                                 continue       # gateway blip: redial
@@ -773,6 +846,7 @@ class RemoteHub:
         if first_since is None and init_cursors:
             first_since = max(init_cursors.values())
         first_resumed = False
+        hint = 0.0
         while True:
             try:
                 resp0 = connect(first_since,
@@ -794,25 +868,45 @@ class RemoteHub:
                     except OSError:
                         pass
                     continue
-                if e.code not in _RETRYABLE_HTTP:
+                if e.code == 429:
+                    # the server shed this subscription under pressure:
+                    # an answer from a healthy server (not degraded
+                    # transport) — redial after its Retry-After hint
+                    try:
+                        hint = float(e.headers.get("Retry-After")
+                                     or 0.0)
+                    except (TypeError, ValueError):
+                        hint = 0.0
+                    with self._slock:
+                        self._throttled += 1
+                    err = RemoteError(
+                        f"watch {','.join(kinds)}: HTTP 429")
+                    try:
+                        e.close()
+                    except OSError:
+                        pass
+                elif e.code not in _RETRYABLE_HTTP:
                     # the server ANSWERED: surface its verdict instead
                     # of blind-retrying a doomed request to its deadline
                     raise RemoteError(
                         f"watch {','.join(kinds)}: HTTP {e.code}") \
                         from None
-                err: Exception = e
-                try:
-                    e.close()       # don't leak one socket per retry
-                except OSError:
-                    pass
+                else:
+                    err = e
+                    try:
+                        e.close()   # don't leak one socket per retry
+                    except OSError:
+                        pass
+                    self._mark_degraded()
             except _TRANSPORT_ERRORS as e:
                 err = e
-            self._mark_degraded()
+                self._mark_degraded()
             remaining = t_end - time.monotonic()
             if remaining <= 0 or self._closed.is_set():
                 raise Unavailable(
                     f"watch {','.join(kinds)}: {err!r}") from None
-            time.sleep(min(bo.next(), max(remaining, 0.0)))
+            time.sleep(min(max(hint, bo.next()), max(remaining, 0.0)))
+            hint = 0.0
         t = threading.Thread(target=run, args=(resp0, first_resumed),
                              daemon=True,
                              name=f"reflector-{'-'.join(kinds)}")

@@ -128,7 +128,14 @@ _ERROR_STATUS = {"Conflict": 409, "NotFound": 404, "ValueError": 400,
                  "NotLeader": 421,
                  # a pod write routed on a stale ring epoch: the caller
                  # re-reads the ring and retries the current owner
-                 "StaleRing": 409}
+                 "StaleRing": 409,
+                 # flow control (fabric.flowcontrol): the caller's
+                 # priority level is past its concurrency + queue
+                 # bounds — Retry-After rides the response header AND
+                 # the message (surviving the {error, message}
+                 # envelope); idempotent verbs retry with the hint,
+                 # writes surface the typed verdict
+                 "TooManyRequests": 429}
 
 FRAMES_CONTENT_TYPE = "application/x-ktpu-frames"
 
@@ -281,11 +288,15 @@ class _Handler(BaseHTTPRequestHandler):
         return binwire.CODEC_BINARY in \
             self.server.codecs  # type: ignore[attr-defined]
 
-    def _json(self, status: int, payload: dict) -> None:
+    def _json(self, status: int, payload: dict,
+              headers: dict | None = None) -> None:
         body = json.dumps(payload).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if headers:
+            for k, v in headers.items():
+                self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -323,11 +334,25 @@ class _Handler(BaseHTTPRequestHandler):
             target = self.hub
             for part in method.split("."):
                 target = getattr(target, part)
-            result = target(*args)
+            flow = getattr(self.server, "flow", None)
+            if flow is not None:
+                # admission AFTER arg decode (classification reads the
+                # args' tenant) but AROUND the dispatch, so a queued
+                # request holds no hub lock while it waits for a seat
+                with flow.admission(method, args,
+                                    self.headers.get("X-KTPU-Identity")):
+                    result = target(*args)
+            else:
+                result = target(*args)
         except Exception as e:  # noqa: BLE001 — mapped to wire errors
             name = type(e).__name__
+            headers = None
+            if name == "TooManyRequests":
+                ra = getattr(e, "retry_after", 0.0) or 0.0
+                headers = {"Retry-After": f"{ra:.3f}"}
             self._json(_ERROR_STATUS.get(name, 500),
-                       {"error": name, "message": str(e)})
+                       {"error": name, "message": str(e)},
+                       headers=headers)
             return
         if negotiated:
             out = binwire.encode({"result": result})
@@ -371,6 +396,10 @@ class _Handler(BaseHTTPRequestHandler):
                 # component-specific gauges (a state replica's
                 # role/term/log-index rows) ride the same exposition
                 body += extra()
+            flow = getattr(self.server, "flow", None)
+            if flow is not None:
+                # admission-control rows (hub_flow_*) for this server
+                body += flow.metrics_text()
             self._text(200, body)
             return
         if not self.path.startswith("/watch"):
@@ -468,17 +497,33 @@ class HubServer:
 
     ``codecs`` lists the wire codecs this server speaks; dropping
     ``bin1`` makes a JSON-only server (how the negotiation tests model
-    an old peer — binary clients must degrade transparently)."""
+    an old peer — binary clients must degrade transparently).
+
+    ``flow`` (a :class:`fabric.flowcontrol.FlowController`) bounds
+    /call admission per priority level; None (the default) keeps the
+    historical unbounded-admission wire."""
 
     def __init__(self, hub: Hub, host: str = "127.0.0.1", port: int = 0,
                  codecs: tuple[str, ...] = (binwire.CODEC_BINARY,
-                                            binwire.CODEC_JSON)):
+                                            binwire.CODEC_JSON),
+                 flow=None):
         self.hub = hub
-        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.flow = flow
+
+        class _Server(ThreadingHTTPServer):
+            # a deep accept backlog: overload shedding is the flow
+            # controller's job (typed 429 + Retry-After the client can
+            # account for), and the stdlib default of 5 turns a client
+            # stampede into silent kernel SYN drops — an untyped
+            # rejection that surfaces as a 1s connect retransmit
+            request_queue_size = 128
+
+        self._httpd = _Server((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.hub = hub                 # type: ignore[attr-defined]
         self._httpd.codecs = codecs           # type: ignore[attr-defined]
         self._httpd.stopping = False          # type: ignore[attr-defined]
+        self._httpd.flow = flow               # type: ignore[attr-defined]
         self._thread: threading.Thread | None = None
 
     @property

@@ -279,6 +279,23 @@ class SchedulerMetrics:
         self.hub_watch_relists = r.register(Counter(
             "hub_watch_relists_total",
             "Watch reconnects that fell back to a full relist"))
+        # flow control + brownout (overload protection): 429s mirrored
+        # by delta from the hub client; brownout is the scheduler's own
+        # load-shed mode (enter/exit in scheduler._evaluate_brownout)
+        self.hub_client_throttled = r.register(Counter(
+            "hub_client_throttled_total",
+            "Hub calls answered 429 by server-side flow control"))
+        self.hub_client_throttle_retries = r.register(Counter(
+            "hub_client_throttle_retries_total",
+            "Throttled idempotent calls retried after the server's "
+            "Retry-After hint"))
+        self.brownout = r.register(Gauge(
+            "scheduler_brownout",
+            "1 while the scheduler sheds load (brownout mode)"))
+        self.brownout_transitions = r.register(Counter(
+            "scheduler_brownout_transitions_total",
+            "Brownout mode transitions by phase (enter/exit)",
+            ("phase",)))
         self.hub_journal_depth = r.register(Gauge(
             "hub_journal_depth",
             "Event journal ring depth by resource kind"))

@@ -311,6 +311,51 @@ def gang_dra_crossfire(p: dict, seed: int) -> Trace:
     return _finish(tr)
 
 
+def overload_stampede(p: dict, seed: int) -> Trace:
+    """Overload storm: a mass best-effort burst plus a tenant quota
+    slam land on top of a small priority workload. Every best-effort
+    tenant dumps its full pod quota inside one tiny window while
+    low-weight (``weight`` 0.1 — the brownout best-effort tier) DRR
+    turn-taking meters them out; the priority tenant (weight 8) keeps
+    cutting through. The SLO gates the PRIORITY pods only
+    (``slo_uid_prefix``): best-effort pods are SUPPOSED to wait — their
+    p99 is the shed, not the regression. Demand stays under node
+    capacity and exactly at quota so the storm fully drains."""
+    rng = random.Random(seed)
+    tenants = {"prio": {"weight": 8.0}}
+    for i in range(p["be_tenants"]):
+        tenants[f"be-{i}"] = {
+            "weight": 0.1,
+            "quota": {"pods": str(p["pods_per_tenant"])}}
+    tr = Trace(name=f"overload_stampede-s{seed}",
+               generator="overload_stampede",
+               seed=seed, params=dict(p),
+               config={**REPLAY_CONFIG, "tenants": tenants,
+                       "slo_uid_prefix": "uid-prio-"},
+               slo={"time_to_bind_p99_ms": 2500.0})
+    for i in range(p["nodes"]):
+        tr.events.append(_ev(0.0, "node_up", {
+            "node": to_wire(_stamp(_node(i), f"uid-node-{i}"))}))
+    dur = float(p["duration"])
+    burst_at = float(p["burst_at"])
+    window = float(p["burst_window"])
+    # the protected class: high-priority pods spread over the WHOLE
+    # duration, so some land before, inside, and after the stampede
+    for i in range(p["prio_pods"]):
+        t = dur * (i + rng.random()) / p["prio_pods"]
+        pod = _pod(f"prio-{i}", labels={LABEL_QUEUE: "prio"},
+                   priority=100)
+        tr.events.append(_pod_ev(t, _stamp(pod, f"uid-prio-{i}")))
+    # the stampede: every best-effort tenant slams its full quota into
+    # one window — a correlated burst of be_tenants × pods_per_tenant
+    for ti in range(p["be_tenants"]):
+        for j in range(p["pods_per_tenant"]):
+            t = burst_at + window * rng.random()
+            pod = _pod(f"be{ti}-p{j}", labels={LABEL_QUEUE: f"be-{ti}"})
+            tr.events.append(_pod_ev(t, _stamp(pod, f"uid-be{ti}-p{j}")))
+    return _finish(tr)
+
+
 GENERATORS: dict[str, Regime] = {
     "diurnal_ramp": Regime(
         diurnal_ramp,
@@ -344,6 +389,16 @@ GENERATORS: dict[str, Regime] = {
                   "gangs": 6, "gang_size": 8},
         bounds={"filler_pods": (100, 330), "gangs": (2, 10),
                 "gang_size": (2, 8), "filler_window": (1.0, 5.0)}),
+    # fuzz bounds keep peak demand under capacity at the extremes:
+    # 20 tenants × 40 pods + 80 priority = 880 < 24 nodes × 40
+    "overload_stampede": Regime(
+        overload_stampede,
+        defaults={"nodes": 24, "be_tenants": 12, "pods_per_tenant": 30,
+                  "prio_pods": 40, "burst_at": 2.0, "burst_window": 0.5,
+                  "duration": 10.0},
+        bounds={"be_tenants": (5, 20), "pods_per_tenant": (10, 40),
+                "prio_pods": (20, 80), "burst_window": (0.1, 2.0),
+                "burst_at": (1.0, 4.0)}),
 }
 
 

@@ -52,17 +52,56 @@ class ReplayStuck(Exception):
     """The trace could not drain within the wall timeout."""
 
 
+def _peak_nodes(events) -> int:
+    """Peak CONCURRENT node count over the trace: the warmup node set
+    must reach it, because the topology domain bucket (``d_cap``, a
+    static jit arg with a sticky high-water) grows with distinct
+    domains — a trace whose node wave first crosses a pow2 domain
+    boundary MID-replay pays that compile inside the paced window."""
+    cur = peak = 0
+    for e in events:
+        if e.kind == "node_up":
+            cur += 1
+            peak = max(peak, cur)
+        elif e.kind == "node_down":
+            cur -= 1
+    return max(peak, 2)
+
+
+def _trace_zones(events) -> list[str]:
+    """Distinct zone labels across the trace's nodes (build order):
+    warmup nodes cycle the same zones so the domain count — hostnames
+    plus zones — lands in the same pow2 bucket the replay will use."""
+    from kubernetes_tpu.api.objects import LABEL_ZONE
+
+    zones: list[str] = []
+    for e in events:
+        if e.kind != "node_up":
+            continue
+        n = from_wire(e.data["node"])
+        z = n.metadata.labels.get(LABEL_ZONE)
+        if z and z not in zones:
+            zones.append(z)
+    return zones
+
+
 def _warmup(hub: Hub, sched: Scheduler, now, sleep,
-            kinds: set | None = None) -> None:
-    """Compile the device programs before the paced clock starts: bind a
-    few throwaway pods on throwaway nodes, then remove every trace.
+            kinds: set | None = None, peak_nodes: int = 2,
+            zones: list[str] | None = None, batch: int = 0) -> None:
+    """Compile the device programs before the paced clock starts: bind
+    throwaway pods on throwaway nodes, then remove every trace.
 
     Coverage matters more than count — a program that first compiles
     MID-replay stalls injection for ~a second, and that lag directly
     distorts trace-time waits (a pod injected late against an on-time
     recovery measures a shorter wait than the trace engineered). So the
-    warmup pod set deliberately touches the zone-affinity, priority,
-    DRA-claim, and gang programs, not just the plain-fit path."""
+    warmup covers the trace's SHAPE FAMILIES, not just the plain-fit
+    path: the node set is sized to the trace's peak concurrent node
+    count and cycles its zones (the topology domain bucket, sticky via
+    hysteresis, reaches replay size here), a full-batch wave of plain
+    pods drives one launch at the production batch shape, and the
+    zone-affinity / priority / DRA-claim / gang pods compile their
+    field-subset programs when the trace uses those kinds."""
     from kubernetes_tpu.api.objects import (
         LABEL_HOSTNAME,
         LABEL_POD_GROUP,
@@ -78,17 +117,21 @@ def _warmup(hub: Hub, sched: Scheduler, now, sleep,
     )
     from kubernetes_tpu.scenario.generators import _zone_affinity
 
+    zones = zones or ["warmup-zone"]
     life = NodeLifecycle(hub)
     nodes = []
-    for i in range(2):
-        n = _node(i, zones=["warmup-zone"])
+    for i in range(max(peak_nodes, 2)):
+        n = _node(i, zones=zones)
         n.metadata.name = f"warmup-node-{i}"
         n.metadata.labels[LABEL_HOSTNAME] = n.metadata.name
-        n.metadata.labels[LABEL_ZONE] = "warmup-zone"
+        n.metadata.labels[LABEL_ZONE] = zones[i % len(zones)]
         nodes.append(life.add(n))
-    pods = [_pod(f"warmup-pod-{i}") for i in range(3)]
+    # the full-batch wave: enough plain pods that one pop fills the
+    # production batch (padding is to batch_size, so this compiles the
+    # same [B]-shaped programs the replay's own waves will launch)
+    pods = [_pod(f"warmup-pod-{i}") for i in range(max(batch, 3))]
     pods.append(_pod("warmup-aff",
-                     affinity=_zone_affinity("warmup-zone")))
+                     affinity=_zone_affinity(zones[0])))
     pods.append(_pod("warmup-prio", priority=100))
     kinds = kinds or set()
     if "obj" in kinds:   # trace creates slices/claims: warm DRA
@@ -215,7 +258,12 @@ def replay_trace(trace: Trace, speed: float = 10.0, warmup: bool = True,
     try:
         if warmup:
             _warmup(hub, sched, now, sleep,
-                    kinds={e.kind for e in events})
+                    kinds={e.kind for e in events},
+                    peak_nodes=_peak_nodes(events),
+                    zones=_trace_zones(events),
+                    batch=cfg.batch_size)
+        prof = sched.profiler
+        warm_compiles = prof.compiles if prof is not None else 0
         wall_start = now()
         idx = [0]
 
@@ -264,9 +312,19 @@ def replay_trace(trace: Trace, speed: float = 10.0, warmup: bool = True,
     finally:
         sched.close()
 
-    # stats in wall AND trace time; the gates read trace time
-    stats_wall = time_to_bind_stats(sched.timelines, uids=trace_pod_uids)
-    stats = time_to_bind_stats(sched.timelines, uids=trace_pod_uids,
+    # stats in wall AND trace time; the gates read trace time. A trace
+    # may scope its SLO to a uid prefix (overload regimes: best-effort
+    # pods are SUPPOSED to wait — gating their p99 would punish correct
+    # shedding; the priority pods are the protected class the SLO is
+    # about). The audit and survivor counts still cover every pod.
+    slo_uids = trace_pod_uids
+    slo_prefix = tcfg.get("slo_uid_prefix")
+    if slo_prefix:
+        scoped = {u for u in trace_pod_uids if u.startswith(slo_prefix)}
+        if scoped:
+            slo_uids = scoped
+    stats_wall = time_to_bind_stats(sched.timelines, uids=slo_uids)
+    stats = time_to_bind_stats(sched.timelines, uids=slo_uids,
                                scale=speed)
     slo_verdict = evaluate_slo(stats, trace.slo)
     gate_verdict = evaluate_slo(stats, trace.gate)
@@ -295,8 +353,19 @@ def replay_trace(trace: Trace, speed: float = 10.0, warmup: bool = True,
         "wall_s": round(wall_s, 3),
         "trace_s": round(trace.duration(), 3),
         "pods": len(trace_pod_uids),
+        "slo_pods": len(slo_uids),
         "survivors": sum(1 for p in live
                          if p.metadata.uid in trace_pod_uids),
+        # the shape-family warmup's contract: every compile happened
+        # BEFORE the paced clock started (a mid-replay compile stalls
+        # injection and silently distorts trace-time waits)
+        "device": {
+            "warmup_compiles": warm_compiles,
+            "mid_replay_compiles": (
+                (prof.compiles - warm_compiles)
+                if prof is not None else None),
+            "launches": prof.launches if prof is not None else None,
+        },
         "stats": stats,             # trace-time ms (gated)
         "stats_wall": stats_wall,   # wall ms (informational)
         "slo": {**slo_verdict, "target": dict(trace.slo)},

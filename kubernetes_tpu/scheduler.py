@@ -327,7 +327,8 @@ class Scheduler:
                       "drift_incremental": 0,
                       "gang_device_launches": 0, "gang_fallbacks": 0,
                       "slice_rebalances": 0, "foreign_stashed": 0,
-                      "foreign_adopted": 0}
+                      "foreign_adopted": 0,
+                      "brownout_enters": 0, "brownout_exits": 0}
         # horizontal scale-out: when run() is handed a SliceManager the
         # replica drains only pods whose namespace (gang: the GROUP's
         # namespace) hashes into its owned ring slots. Everything else
@@ -352,6 +353,18 @@ class Scheduler:
         self._last_drift_check = 0.0
         self._drift_strikes = 0
         self._drift_rv: int | None = None
+        # scheduler brownout (overload self-protection): a sustained run
+        # of hub flow-control rejections (429s — the hub's queue-wait
+        # SLO breaches surface as rejected-timeout 429s through the same
+        # counter) trips a load-shedding mode: the effective batch
+        # shrinks, the drift sentinel stretches its cadence, and
+        # best-effort tenants park in the jobqueue. Exits after
+        # brownout_clear_windows consecutive clean ~1s windows.
+        self.brownout = False
+        self._brownout_clean = 0
+        self._brownout_throttled_seen = 0.0
+        self._last_brownout_eval = 0.0
+        self._drift_interval_base: float | None = None
         # degraded mode: the hub is unreachable (transport Unavailable).
         # Work parks with backoff instead of erroring; assumed pods are
         # preserved (their confirm events cannot arrive); the informer's
@@ -785,7 +798,12 @@ class Scheduler:
                 wp.state, wp.qp.pod, wp.node_name)
             assumed = wp.qp.pod.clone()
             assumed.spec.node_name = wp.node_name
-            self.cache.forget_pod(assumed)
+            # guard like _undo_commit: a foreign bind may have CONFIRMED
+            # this reservation through the informer before the delete
+            # arrived — forget_pod would raise on a confirmed pod, and
+            # the assigned-pod branch below already removes it
+            if self.cache.is_assumed_pod(assumed):
+                self.cache.forget_pod(assumed)
             self._invalidate_chain()
             self.queue.done(uid)
         self.nominator.delete(uid)
@@ -1315,7 +1333,7 @@ class Scheduler:
         t_pop0 = self.now()
         deferred, self._deferred = self._deferred, []
         batch = deferred + self.queue.pop_batch(
-            self.config.batch_size - len(deferred))
+            self._effective_batch() - len(deferred))
         runnable: list[QueuedPodInfo] = []
         for i, qp in enumerate(batch):
             try:
@@ -2395,7 +2413,7 @@ class Scheduler:
             self._process_deferred_events()
             self._process_waiting()
             if self.jobqueue.active:
-                self.jobqueue.release(self.queue, self.config.batch_size)
+                self.jobqueue.release(self.queue, self._effective_batch())
             popped, runnable = self._pop_runnable()
             if popped == 0:
                 self._drain_bind_results(wait=True)
@@ -2435,6 +2453,18 @@ class Scheduler:
         pod = qp.pod
         assumed = pod.clone()
         assumed.spec.node_name = node_name
+        if self.cache.get_pod(assumed) is not None \
+                and not self.cache.is_assumed_pod(assumed):
+            # the pod is already in the cache CONFIRMED: a sibling
+            # replica's bind landed through our informer between the
+            # pop and this commit (scale-out post-rebalance race).
+            # assume_pod would raise ("already in cache") and take the
+            # whole device batch down the host-fallback ladder — the
+            # pod is placed and theirs; drop our attempt exactly like
+            # _undo_commit's foreign-confirm path
+            self._invalidate_chain()
+            self.queue.done(qp.uid)
+            return
         self.cache.assume_pod(assumed)
         state = CycleState()
         fw = self._fw_for(pod)
@@ -2514,6 +2544,20 @@ class Scheduler:
             # hub-side claim state reconciles via informer truth after
             # the outage; the local overlay cleanup below is what matters
             self._note_hub_down()
+        if not self.cache.is_assumed_pod(assumed) \
+                and self.cache.get_pod(assumed) is not None:
+            # the pod is in the cache CONFIRMED, not assumed: another
+            # actor's bind landed through our informer while this
+            # attempt was failing (scale-out: a sibling replica won a
+            # post-rebalance race and add_pod's informer-truth-wins
+            # replaced our assumed state; our own bind then answered
+            # Conflict). The pod is placed and theirs — forget_pod
+            # would raise ("confirmed, cannot forget") and requeueing
+            # would re-schedule a bound pod. Drop our claim instead,
+            # exactly like _finish_fenced's foreign-confirm path.
+            self._invalidate_chain()
+            self.queue.done(qp.uid)
+            return
         self.cache.forget_pod(assumed)
         # the device chain assumed this placement; force a re-sync
         self._invalidate_chain()
@@ -2951,6 +2995,7 @@ class Scheduler:
             self._process_deferred_events()
             self.recorder.flush(force=False)
             self._probe_hub()
+            self._evaluate_brownout()
             self._run_drift_sentinel()
             self.metrics.cache_size.set(self.cache.pod_count(), type="pods")
             self.metrics.cache_size.set(self.cache.assumed_pod_count(),
@@ -3064,11 +3109,108 @@ class Scheduler:
             self.cache.update_snapshot(self.snapshot)
             self._drift_strikes = 0
 
+    # ------------- brownout (overload self-protection) -------------
+
+    def _effective_batch(self) -> int:
+        """Pop/release budget for this cycle: the configured batch, or
+        the brownout-shrunk batch while shedding load. Launch packing
+        keeps its configured capacity hints — the smaller batch pads
+        down to an already-warm smaller bucket, so the shrink does not
+        force recompiles."""
+        cfg = self.config
+        if not self.brownout:
+            return cfg.batch_size
+        return max(cfg.batch_size // max(cfg.brownout_batch_divisor, 1),
+                   min(cfg.brownout_batch_floor, cfg.batch_size))
+
+    def _evaluate_brownout(self) -> None:
+        """Watch the hub client's 429 counter and shed our own load
+        while the fabric is saturated: a scheduler that answers flow
+        control by hammering full batches at full cadence converts one
+        overloaded component into a fleet-wide retry storm. Evaluated
+        at most once per second; enters on brownout_throttle_threshold
+        throttles in a window, exits after brownout_clear_windows
+        consecutive windows with zero new throttles."""
+        cfg = self.config
+        threshold = getattr(cfg, "brownout_throttle_threshold", 0)
+        if threshold <= 0:
+            return
+        rs = getattr(self.hub, "resilience_stats", None)
+        if rs is None:
+            return      # in-process hub: no flow-controlled transport
+        now = self.now()
+        if now - self._last_brownout_eval < 1.0:
+            return
+        self._last_brownout_eval = now
+        throttled = float(rs().get("throttled_429s", 0))
+        delta = throttled - self._brownout_throttled_seen
+        self._brownout_throttled_seen = throttled
+        if not self.brownout:
+            if delta >= threshold:
+                self._enter_brownout(delta)
+            return
+        if delta > 0:
+            self._brownout_clean = 0
+            return
+        self._brownout_clean += 1
+        if self._brownout_clean >= max(cfg.brownout_clear_windows, 1):
+            self._exit_brownout()
+
+    def _enter_brownout(self, rate: float) -> None:
+        cfg = self.config
+        self.brownout = True
+        self._brownout_clean = 0
+        self.stats["brownout_enters"] += 1
+        # capture the CURRENT cadence, not the constructor default:
+        # tests and operators retune drift_check_interval post-init
+        self._drift_interval_base = self.drift_check_interval
+        if self.drift_check_interval > 0:
+            self.drift_check_interval *= max(cfg.brownout_drift_stretch,
+                                             1.0)
+        parked: list[str] = []
+        if self.jobqueue.active:
+            parked = self.jobqueue.park_below(
+                cfg.brownout_besteffort_weight)
+        self.metrics.brownout.set(1.0)
+        self.metrics.brownout_transitions.inc(phase="enter")
+        logger.warning(
+            "brownout ENTER: %d hub throttles in the last window "
+            "(threshold %d): batch %d -> %d, drift cadence %.0fs, "
+            "parked best-effort tenants %s",
+            int(rate), cfg.brownout_throttle_threshold, cfg.batch_size,
+            self._effective_batch(), self.drift_check_interval, parked)
+
+    def _exit_brownout(self) -> None:
+        self.brownout = False
+        self._brownout_clean = 0
+        self.stats["brownout_exits"] += 1
+        if self._drift_interval_base is not None:
+            self.drift_check_interval = self._drift_interval_base
+            self._drift_interval_base = None
+        freed = self.jobqueue.unpark_all()
+        self.metrics.brownout.set(0.0)
+        self.metrics.brownout_transitions.inc(phase="exit")
+        logger.info("brownout EXIT: pressure clear; batch restored to "
+                    "%d, unparked tenants %s",
+                    self.config.batch_size, freed)
+
+    def brownout_state(self) -> dict:
+        """The /debug/fleet brownout surface."""
+        return {"active": self.brownout,
+                "enters": self.stats["brownout_enters"],
+                "exits": self.stats["brownout_exits"],
+                "clean_windows": self._brownout_clean,
+                "effective_batch": self._effective_batch(),
+                "drift_check_interval": self.drift_check_interval,
+                "parked_tenants": sorted(
+                    getattr(self.jobqueue, "parked", ()))}
+
     def _export_resilience_metrics(self) -> None:
         """Mirror hub-client and chaos counters into the registry (the
         hub client and chaos layer have no registry of their own)."""
         m = self.metrics
         m.hub_degraded.set(1.0 if self.hub_degraded() else 0.0)
+        m.brownout.set(1.0 if self.brownout else 0.0)
         if self._slices is not None:
             m.sched_slices_owned.set(float(len(self._slices.owned)))
             m.foreign_pending_pods.set(float(len(self._foreign)))
@@ -3086,6 +3228,12 @@ class Scheduler:
                                m.hub_watch_resumes)
             self._mirror_count("watch_relists", s.get("watch_relists", 0),
                                m.hub_watch_relists)
+            self._mirror_count("throttled_429s",
+                               s.get("throttled_429s", 0),
+                               m.hub_client_throttled)
+            self._mirror_count("throttle_retries",
+                               s.get("throttle_retries", 0),
+                               m.hub_client_throttle_retries)
             for codec_name, w in s.get("wire", {}).items():
                 self._mirror_count(f"wire_msgs:{codec_name}",
                                    w.get("msgs", 0),
@@ -3297,7 +3445,7 @@ class Scheduler:
                 break
             if self.jobqueue.active:
                 # admit tenant/gang work by DRR + quota before the pop
-                self.jobqueue.release(self.queue, self.config.batch_size)
+                self.jobqueue.release(self.queue, self._effective_batch())
             popped, runnable = self._pop_runnable()
             if popped == 0:
                 flush_all()
@@ -3311,7 +3459,7 @@ class Scheduler:
                 # the job queue mid-iteration
                 if self.jobqueue.active:
                     self.jobqueue.release(self.queue,
-                                          self.config.batch_size)
+                                          self._effective_batch())
                 popped, runnable = self._pop_runnable()
                 if popped == 0:
                     break

@@ -208,7 +208,14 @@ class ServingEndpoints:
                     if fleet is None:
                         self._send(404, "no fleet view attached")
                         return
-                    body = json.dumps(fleet.summary(), indent=2,
+                    payload = fleet.summary()
+                    # this scheduler's own overload state rides the
+                    # fleet view: brownout is exactly the fact an
+                    # operator opens /debug/fleet to find
+                    bs_fn = getattr(sched, "brownout_state", None)
+                    if bs_fn is not None:
+                        payload["scheduler_brownout"] = bs_fn()
+                    body = json.dumps(payload, indent=2,
                                       default=str)
                 elif path == "/debug/pod":
                     timelines = getattr(sched, "timelines", None)

@@ -486,6 +486,81 @@ def test_two_replicas_partition_and_bind_everything():
         hub.close()
 
 
+def test_undo_commit_survives_foreign_confirm_race():
+    """Regression for the scaleout-storm flake: a sibling replica wins
+    a post-rebalance race — its bind lands through our informer
+    (add_pod replaces our ASSUMED entry with confirmed truth) while
+    our own bind attempt is failing with Conflict. The failure path's
+    forget_pod would raise KeyError("confirmed, cannot forget"); the
+    guard must instead drop our claim and retire the pod unrequeued —
+    the pod is placed, and it is the sibling's."""
+    from kubernetes_tpu.backend.queue import QueuedPodInfo
+    from kubernetes_tpu.framework.cycle_state import CycleState
+
+    hub = Hub()
+    hub.create_node(MakeNode().name("n1").capacity(cpu="64").obj())
+    hub.create_node(MakeNode().name("n2").capacity(cpu="64").obj())
+    cfg = default_config()
+    sched = Scheduler(hub, cfg, caps=Capacities(nodes=8, pods=64))
+    try:
+        # the pod stays off the hub: creating it there would have the
+        # informer enqueue it, muddying the requeue assertion below
+        pod = MakePod().name("racy").req(cpu="100m").obj()
+        assumed = pod.clone()
+        assumed.spec.node_name = "n1"
+        sched.cache.assume_pod(assumed)
+        # the sibling's bind arrives via the informer: truth wins,
+        # the assumed entry becomes a CONFIRMED placement on n2
+        foreign = pod.clone()
+        foreign.spec.node_name = "n2"
+        sched.cache.add_pod(foreign)
+        assert not sched.cache.is_assumed_pod(assumed)
+        assert sched.cache.get_pod(assumed) is not None
+        # now our own bind answers Conflict and unwinds — this raised
+        # KeyError("confirmed, cannot forget") before the guard
+        qp = QueuedPodInfo(pod=pod)
+        sched._undo_commit(qp, CycleState(), assumed, "n1",
+                           "bind failed: Conflict")
+        # the foreign placement survived untouched, and the pod was
+        # NOT requeued for a re-schedule of an already-bound pod
+        assert sched.cache.get_pod(assumed).spec.node_name == "n2"
+        assert sched.queue.pop_batch(8) == []
+    finally:
+        sched.close()
+        hub.close()
+
+
+def test_commit_drops_attempt_when_foreign_bind_confirmed_first():
+    """The commit-side half of the same race: the sibling's bind
+    confirms through our informer BETWEEN the pop and _commit.
+    assume_pod would raise KeyError("already in cache") — which took
+    whole device batches down the host-fallback ladder in the storm —
+    so _commit must drop the attempt instead of assuming."""
+    from kubernetes_tpu.backend.queue import QueuedPodInfo
+
+    hub = Hub()
+    hub.create_node(MakeNode().name("n1").capacity(cpu="64").obj())
+    hub.create_node(MakeNode().name("n2").capacity(cpu="64").obj())
+    cfg = default_config()
+    sched = Scheduler(hub, cfg, caps=Capacities(nodes=8, pods=64))
+    try:
+        pod = MakePod().name("racy2").req(cpu="100m").obj()
+        foreign = pod.clone()
+        foreign.spec.node_name = "n2"
+        sched.cache.add_pod(foreign)       # sibling's confirmed bind
+        qp = QueuedPodInfo(pod=pod)
+        sched._commit(qp, "n1")            # raised KeyError before
+        # no assumed state leaked, no binder-pool work was enqueued
+        assumed = pod.clone()
+        assumed.spec.node_name = "n1"
+        assert not sched.cache.is_assumed_pod(assumed)
+        assert sched.cache.get_pod(foreign).spec.node_name == "n2"
+        assert sched.queue.pop_batch(8) == []
+    finally:
+        sched.close()
+        hub.close()
+
+
 # ------------------------------------------------ the kill -9 storm
 
 

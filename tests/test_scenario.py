@@ -296,6 +296,33 @@ def test_replay_smoke_seconds_scale():
     # wall stats scale to trace-time stats by exactly `speed`
     assert rep["stats"]["time_to_bind_p99_ms"] == pytest.approx(
         rep["stats_wall"]["time_to_bind_p99_ms"] * rep["speed"], abs=0.05)
+    # the warmup pre-compiled every shape family this trace exercises:
+    # a mid-replay compile would poison latency SLOs with a one-off
+    # multi-second stall that is a HARNESS artifact, not a regression
+    assert rep["device"]["warmup_compiles"] > 0
+    assert rep["device"]["mid_replay_compiles"] == 0, rep["device"]
+
+
+def test_overload_stampede_gates_priority_pods_only():
+    """Tier-1 overload smoke: a shrunken best-effort stampede replays
+    green — the time-to-bind SLO is judged over the priority pods ONLY
+    (``slo_uid_prefix``), because best-effort pods waiting out the
+    storm is the shed working, not a regression — while the journal
+    audit still covers every pod exactly-once."""
+    tr = generate("overload_stampede",
+                  {"nodes": 8, "be_tenants": 4, "pods_per_tenant": 8,
+                   "prio_pods": 12, "burst_at": 1.0, "burst_window": 0.5,
+                   "duration": 4.0}, seed=9)
+    assert tr.config["slo_uid_prefix"] == "uid-prio-"
+    rep = replay_trace(tr, speed=3.0, timeout_s=120.0)
+    assert rep["completed"], rep
+    assert rep["audit"]["ok"], rep["audit"]
+    assert rep["slo"]["ok"], rep["slo"]
+    # the SLO was scoped: 12 priority pods judged, all 44 audited
+    assert rep["pods"] == 4 * 8 + 12
+    assert rep["slo_pods"] == 12
+    assert rep["stats"]["count"] == 12
+    assert rep["device"]["mid_replay_compiles"] == 0, rep["device"]
 
 
 def test_replay_gates_on_filed_regression_traces():
