@@ -37,7 +37,7 @@ BASELINE_PODS_PER_SEC = 270.0  # misc/performance-config.yaml:63
 
 # the committed artifact README.md's bench table is generated from; a
 # new measurement round commits a new artifact and re-points this
-README_BENCH_ARTIFACT = "BENCH_r15_builder.json"
+README_BENCH_ARTIFACT = "BENCH_r19_builder.json"
 _TABLE_BEGIN = "<!-- BENCH_TABLE_BEGIN"
 _TABLE_END = "<!-- BENCH_TABLE_END -->"
 
@@ -431,6 +431,13 @@ def run_profile(smoke: bool = False) -> dict:
               f"host-tail share {fl.get('host_tail_share', 0):.1%}, "
               f"{fl.get('cycles_recorded', 0)} cycles recorded",
               file=sys.stderr)
+        occ = fl.get("occupancy") or {}
+        if occ:
+            # pipelined waves: how much of each cycle's wall the device
+            # launch actually covered (mean near 1.0 = pipeline full)
+            print(f"  occupancy: mean {occ['mean']:.1%}, "
+                  f"p50 {occ['p50']:.1%}, p99 {occ['p99']:.1%} "
+                  f"over {occ['n']} cycles", file=sys.stderr)
         print(f"  {'phase':<18} {'p50_ms':>9} {'p99_ms':>9} "
               f"{'count':>7} {'total_s':>9}", file=sys.stderr)
         for phase, p in sorted(fl.get("phases", {}).items(),

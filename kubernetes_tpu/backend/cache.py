@@ -173,6 +173,18 @@ class Cache:
                 self._remove_from_list(item)
                 del self._nodes[node.metadata.name]
 
+    def node_info(self, name: str):
+        """The LIVE NodeInfo aggregate for one node, or None when the cache
+        has never seen it. A node-less info (node deleted, assumed pods
+        still draining) is returned as-is with ``info.node is None`` — the
+        caller (Mirror.patch_node) treats that like a removal, matching
+        update_snapshot's exclusion of node-less infos. The object is the
+        cache's mutable truth: read it under the scheduler's event lock
+        and don't hold it across handler returns."""
+        with self._lock:
+            item = self._nodes.get(name)
+            return item.info if item is not None else None
+
     # ---------------- namespace ops ----------------
 
     def set_namespace(self, name: str, labels: dict[str, str]) -> None:

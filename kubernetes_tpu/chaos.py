@@ -208,6 +208,11 @@ class DeviceChaosConfig:
     launch_error_rate: float = 0.0     # P(raise at pack/launch) per batch
     capacity_error_rate: float = 0.0   # P(forced CapacityError) per batch
     nan_rate: float = 0.0              # P(NaN-poison the result) per batch
+    # P(raise inside the COMMIT THREAD's device pull) per batch: the
+    # pipelined scheduler's off-thread jax.device_get — the exception
+    # must surface through fut.result() in _finish and take the same
+    # _finish_contained fallback ladder as an inline launch fault
+    commit_pull_error_rate: float = 0.0
 
 
 class DeviceChaos:
@@ -226,7 +231,8 @@ class DeviceChaos:
         self._lock = threading.Lock()
         self.stats = {"injected_launch_errors": 0,
                       "injected_capacity_errors": 0,
-                      "injected_nans": 0, "batches_seen": 0}
+                      "injected_nans": 0, "injected_pull_errors": 0,
+                      "batches_seen": 0}
 
     def set_fault(self, **kw) -> None:
         with self._lock:
@@ -256,6 +262,15 @@ class DeviceChaos:
             with self._lock:
                 self.stats["injected_capacity_errors"] += 1
             raise CapacityError("__chaos__", 2 ** 30)
+
+    def on_commit_pull(self) -> None:
+        """Runs on the COMMIT THREAD at the top of the launch pull; a
+        raise here propagates through the wave's future into _finish,
+        exercising exactly-once containment under threaded commit."""
+        if self._draw(self.config.commit_pull_error_rate):
+            with self._lock:
+                self.stats["injected_pull_errors"] += 1
+            raise RuntimeError("chaos: injected commit-thread pull failure")
 
     def on_result(self, out):
         if not self._draw(self.config.nan_rate):
@@ -602,22 +617,28 @@ def run_device_storm(pods: int = 80, nodes: int = 8, seed: int = 11,
     sched.fault_injector = chaos
     report: dict = {"pods": pods, "nodes": nodes, "seed": seed}
     poison = make_poison_pod("poison-0")
-    all_knobs = ("nan_rate", "launch_error_rate", "capacity_error_rate")
+    all_knobs = ("nan_rate", "launch_error_rate", "capacity_error_rate",
+                 "commit_pull_error_rate")
     try:
-        # three deterministic fault phases — every rung of the ladder is
+        # four deterministic fault phases — every rung of the ladder is
         # provoked at least once regardless of scale — then a clean drain.
         # The poison pod lands in phase 1: its pack-time exception must
         # not eclipse phase 0's NaN injection (which needs a launch that
-        # actually completes to poison its result).
-        third = max(1, pods // 3)
+        # actually completes to poison its result). Phase 3 faults the
+        # COMMIT THREAD's device pull: containment must be identical to
+        # an inline launch fault even though the raise crosses a future.
+        share = max(1, pods // 4)
         phases = ({"nan_rate": 1.0}, {"launch_error_rate": 1.0},
-                  {"capacity_error_rate": 1.0})
+                  {"capacity_error_rate": 1.0},
+                  {"commit_pull_error_rate": 1.0})
         for n, knobs in enumerate(phases):
             chaos.set_fault(**{k: 0.0 for k in all_knobs})
             chaos.set_fault(**knobs)
             if n == 1:
                 hub.create_pod(poison)
-            for i in range(n * third, pods if n == 2 else (n + 1) * third):
+            lo, hi = n * share, (pods if n == len(phases) - 1
+                                 else (n + 1) * share)
+            for i in range(lo, hi):
                 hub.create_pod(
                     MakePod().name(f"dp-{i}").req(cpu="100m").obj())
             sched.run_until_idle()
@@ -651,6 +672,7 @@ def run_device_storm(pods: int = 80, nodes: int = 8, seed: int = 11,
                    and chaos.stats["injected_nans"] >= 1
                    and chaos.stats["injected_launch_errors"] >= 1
                    and chaos.stats["injected_capacity_errors"] >= 1
+                   and chaos.stats["injected_pull_errors"] >= 1
                    and not sched.cache.compare_with_hub(hub)),
         })
     finally:

@@ -143,6 +143,17 @@ class SchedulerConfiguration:
     # routes every gang through the host Permit-quorum path (the
     # differential-test arm; the fallback ladder lands here too)
     gang_device_packing: bool = True
+    # pipelined scheduling waves: keep PIPELINE_DEPTH launches in flight
+    # (wave N's commit pull rides a commit thread and overlaps wave N+1's
+    # device time), patch informer churn into the device-resident
+    # free/nzr chain in place of whole-chain invalidation, and re-dispatch
+    # preemptors into the next wave the moment their eviction flush fires
+    # (nominated reservations protect the slots). Off restores strict
+    # launch->commit alternation with whole-chain invalidation on every
+    # informer event — the differential A/B arm; placements are identical
+    # under a fixed tie seed on churn-free workloads (the chain is the
+    # same state either way, only its lifetime differs)
+    pipelined_waves: bool = True
     # scheduler brownout (overload protection): when the hub answers a
     # sustained run of 429s (flow-control rejections) or queue-wait SLO
     # breaches, the scheduler sheds its own load instead of hammering a

@@ -109,6 +109,17 @@ class Evaluator:
         # deletion event (empty/already-deleted victim sets) — the gate
         # opener of last resort (see flush_evictions)
         self.activate_fn = None
+        # scheduler-installed (pipelined waves): when True, a preemptor
+        # whose eviction wave FIRED is also activated explicitly at flush
+        # end — it re-probes on the very next wave instead of waiting out
+        # the deletion event's backoff routing (its nominated reservation
+        # protects the freed slot meanwhile)
+        self.activate_flushed = False
+        # scheduler-installed (pipelined waves): () -> live device free
+        # matrix (the scheduler's resident free/nzr chain) or None. When
+        # set and live, the sweep/probe fit baselines see in-flight waves
+        # the snapshot free matrix has not absorbed yet
+        self.live_free_fn = None
         # scheduler-installed: () -> [HTTPExtender]; candidates pass
         # through ProcessPreemption before selection (preemption.go:335)
         self.extenders_fn = None
@@ -167,9 +178,11 @@ class Evaluator:
 
         pblobs = mirror.pack_batch_blobs([pod], 1)
         cblobs = mirror.to_blobs()
+        live_free = (self.live_free_fn()
+                     if self.live_free_fn is not None else None)
         kmin = np.asarray(preempt_sweep_jit(
             cblobs, pblobs, mirror.well_known(), cumsum, vic_cols, caps,
-            self._get_enabled_filters(pod)))[0]
+            self._get_enabled_filters(pod), free=live_free))[0]
         self._kmin = kmin                     # reused by _minimize_victims
         self._victims_by_row = victims_by_row
 
@@ -265,7 +278,13 @@ class Evaluator:
         mirror = self._get_mirror()
         caps = self._get_caps()
         tval = mirror.table_valid_mask(exclude_uids)
-        free = mirror.free_matrix()
+        live_free = (self.live_free_fn()
+                     if self.live_free_fn is not None else None)
+        # live chain wins when present: the probe's fit baseline then
+        # includes waves still in flight (np.array forces a writable
+        # host copy off the device buffer)
+        free = (np.array(live_free, np.float32) if live_free is not None
+                else mirror.free_matrix())
         for row, vec in freed_by_row.items():
             free[row] = free[row] + vec
         pblobs = mirror.pack_batch_blobs([pod], 1)
@@ -688,8 +707,14 @@ class Evaluator:
             # be activated explicitly or two preemptors nominating the
             # same node deadlock in escalating backoff behind each other's
             # reservations
-            if not any(v.metadata.uid in gone
-                       and owner[v.metadata.uid] == i for v in victims):
+            fired = any(v.metadata.uid in gone
+                        and owner[v.metadata.uid] == i for v in victims)
+            # pipelined waves: a FIRED preemptor is activated too — its
+            # re-probe rides the very next scheduling wave instead of
+            # waiting for the deletion event's backoff routing (the
+            # nominated reservation keeps the freed slot protected, and
+            # queue.activate is a no-op for pods already runnable)
+            if not fired or self.activate_flushed:
                 stranded.append(pod)
 
     def _flush_candidates_serial(self, work: list, stranded: list,
@@ -759,7 +784,9 @@ class Evaluator:
                         raise
                     except Exception:  # noqa: BLE001
                         pass
-                if not fired:
+                # pipelined waves: activate fired preemptors too (see the
+                # batched path) so the re-probe rides the next wave
+                if not fired or self.activate_flushed:
                     stranded.append(pod)
             except Unavailable:
                 # hub outage mid-candidate: requeue it and the whole

@@ -121,6 +121,31 @@ def scan_unroll() -> int:
         _SCAN_UNROLL = max(1, n)
     return _SCAN_UNROLL
 
+
+# auction-round unroll factor (see _rounds_commit): how many K-accept
+# rounds one while_loop iteration fuses. The loop condition is
+# data-dependent, so every iteration costs a device round trip on the
+# progress flag; fusing U rounds into the body cuts that U-fold while
+# lax.cond skips the work of rounds past convergence (the body is
+# idempotent at its fixed point, so an extra executed round is a no-op).
+# Auctions converge in a handful of rounds, so a small U covers most
+# drains in ONE iteration. Resolved lazily like scan_unroll;
+# KUBERNETES_TPU_AUCTION_UNROLL overrides (>=1).
+_AUCTION_UNROLL = None
+
+
+def auction_unroll() -> int:
+    global _AUCTION_UNROLL
+    if _AUCTION_UNROLL is None:
+        try:
+            n = int(_os.environ.get("KUBERNETES_TPU_AUCTION_UNROLL", "0"))
+        except ValueError:
+            n = 0
+        if n <= 0:
+            n = 4
+        _AUCTION_UNROLL = max(1, n)
+    return _AUCTION_UNROLL
+
 # minFeasibleNodesToFind (schedule_one.go:39-45): below this cluster-wide
 # feasible count the percentageOfNodesToScore early-exit never truncates
 MIN_FEASIBLE_NODES_TO_FIND = 100
@@ -511,7 +536,8 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
                    img, unres, weights, free0, nzr0, host_score=None,
                    fit_strategy="LeastAllocated", fit_shape=None,
                    dra_reject=None, learned=None, tie_seed=None,
-                   with_feats=False, with_alts=False, soft=None):
+                   with_feats=False, with_alts=False, soft=None,
+                   unroll=None):
     """Parallel auction replacing the per-pod commit scan when the batch has
     no topology constraints and no host ports: every round, all unplaced
     pods score+argmax in parallel; per node, up to K pods are accepted in
@@ -677,7 +703,24 @@ def _rounds_commit(ct, pods, static_ok, static_rejects, taint_raw, aff_raw,
 
     init = (free0, nzr0, jnp.full((B,), -1, jnp.int32),
             jnp.zeros((B,), jnp.float32), jnp.bool_(True))
-    free, nzr, placed, win, _ = jax.lax.while_loop(cond, body, init)
+    # fused multi-round body: the while condition is data-dependent, so
+    # every loop iteration costs a host<->device round trip on the
+    # progress flag. Running `unroll` rounds per iteration cuts that
+    # U-fold with fixed shapes (no recompiles). Rounds past convergence
+    # are skipped by lax.cond on the progress flag — and even an executed
+    # extra round is a no-op, because at the fixed point the feasible set
+    # admits no accept (the body is idempotent), so the final state is
+    # bit-identical to the one-round-per-iteration program.
+    unroll = auction_unroll() if unroll is None else max(1, int(unroll))
+    if unroll == 1:
+        fused = body
+    else:
+        def fused(state):
+            state = body(state)
+            for _ in range(unroll - 1):
+                state = jax.lax.cond(state[4], body, lambda s: s, state)
+            return state
+    free, nzr, placed, win, _ = jax.lax.while_loop(cond, fused, init)
 
     # diagnostics from the final state (unplaced pods' reject attribution)
     fit = fit_all(free)
@@ -826,6 +869,7 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                    with_feats: bool = False,
                    with_alts: bool = False,
                    topo_soft: bool = False,
+                   auction_unroll: int | None = None,
                    ) -> BatchResult:
     """Schedule a whole pod batch in one launch, as-if-serial (see module
     docstring for the two-phase structure).
@@ -1018,7 +1062,7 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                               aff_raw, img, unres, weights, free0, nzr0,
                               host_score, fit_strategy, fit_shape,
                               dra_reject, learned, tie_seed, with_feats,
-                              with_alts, soft=soft)
+                              with_alts, soft=soft, unroll=auction_unroll)
     soft_st = None
     if enable_topology and topo_soft:
         # ---- phase 1b (SOFT): the reduced per-group statics — exactly
@@ -1565,7 +1609,7 @@ def schedule_batch(cblobs: ClusterBlobs, pblobs: PodBlobs,
                                    "active", "pfields", "g_cap",
                                    "fit_strategy", "pct_nodes",
                                    "with_feats", "with_alts",
-                                   "topo_soft"))
+                                   "topo_soft", "auction_unroll"))
 def schedule_batch_jit(cblobs, pblobs, wk, weights, caps,
                        enable_topology=True, d_cap=None,
                        enabled_filters=None, serial_scan=True, state=None,
@@ -1575,14 +1619,14 @@ def schedule_batch_jit(cblobs, pblobs, wk, weights, caps,
                        fit_shape=None, pct_nodes=0, pct_start=None,
                        dra=None, learned=None, tie_seed=None,
                        with_feats=False, with_alts=False,
-                       topo_soft=False):
+                       topo_soft=False, auction_unroll=None):
     return schedule_batch(cblobs, pblobs, wk, weights, caps,
                           enable_topology, d_cap, enabled_filters,
                           serial_scan, state, active, pfields, ptmpl,
                           gid, rep, g_cap, host_ok, host_score,
                           fit_strategy, fit_shape, pct_nodes, pct_start,
                           dra, learned, tie_seed, with_feats, with_alts,
-                          topo_soft)
+                          topo_soft, auction_unroll)
 
 
 @partial(jax.jit, static_argnames=("caps",))
@@ -1598,6 +1642,83 @@ def extract_state_jit(cblobs, caps):
     return ct.free, ct.nonzero_requested
 
 
+@jax.jit
+def _chain_set_rows_jit(free, nzr, idx, free_rows, nzr_rows):
+    return free.at[idx].set(free_rows), nzr.at[idx].set(nzr_rows)
+
+
+@jax.jit
+def _chain_add_rows_jit(free, nzr, idx, free_rows, nzr_rows):
+    return free.at[idx].add(free_rows), nzr.at[idx].add(nzr_rows)
+
+
+def patch_chain(free, nzr, set_rows=(), add_rows=()):
+    """Scatter node-row patches into the device-resident (free, nzr) usage
+    chain IN PLACE of a full snapshot resync — the device half of
+    chain-surviving churn. This generalizes the gang packer's free/nzr
+    chunk-chaining protocol (ops.gang.pack_gangs ``state=``): the chain is
+    the single mutable device truth between launches, and everyone who
+    learns something about a node — a committed chunk, an informer event —
+    folds it in rather than rebuilding the world.
+
+    ``set_rows`` carries absolute repacks (node add/update/remove):
+    ``(row, free_row [R], nzr_row [2])`` tuples whose rows REPLACE the
+    chain's. ``add_rows`` carries commutative usage deltas (foreign pod
+    bind/delete): ``(row, dfree [R], dnzr [2])`` tuples ADDED to the
+    chain's rows, so they compose with in-flight waves' device commits in
+    either order. Row lists are padded host-side to the next power of two
+    (sets duplicate their last entry — idempotent; adds pad zero rows —
+    identity) so launch shapes stay in a tiny bucket family and a drain
+    never recompiles on patch count. Donation is deliberately off: the
+    input chain may still be referenced by an in-flight wave's pending
+    tuple. Returns the patched (free, nzr)."""
+    import numpy as _np
+
+    def _pad(rows, dup):
+        k = len(rows)
+        cap = 1
+        while cap < k:
+            cap *= 2
+        idx = _np.empty((cap,), _np.int32)
+        fr = _np.zeros((cap, free.shape[1]), _np.float32)
+        nz = _np.zeros((cap, nzr.shape[1]), _np.float32)
+        for i, (r, f, n) in enumerate(rows):
+            idx[i] = r
+            fr[i] = f
+            nz[i] = n
+        for i in range(k, cap):
+            idx[i] = rows[-1][0]
+            if dup:
+                fr[i] = rows[-1][1]
+                nz[i] = rows[-1][2]
+        return idx, fr, nz
+    if set_rows:
+        free, nzr = _chain_set_rows_jit(free, nzr, *_pad(set_rows, True))
+    if add_rows:
+        free, nzr = _chain_add_rows_jit(free, nzr, *_pad(add_rows, False))
+    return free, nzr
+
+
+def warm_patch_chain(free, nzr, max_bucket: int = 256) -> None:
+    """Pre-compile every patch-scatter bucket the scheduler can ever
+    launch against this chain shape (pow2 buckets up to the scheduler's
+    patch cap, beyond which it falls back to a full resync). Called once
+    per chain shape at first install so churn patches never trigger an
+    XLA compile mid-drain — the patch kernels ride launch_cache_size, so
+    the bench's flat-cache assertion would catch a miss here."""
+    import numpy as _np
+
+    cap = 1
+    while cap <= max_bucket:
+        idx = _np.zeros((cap,), _np.int32)
+        fr = _np.zeros((cap, free.shape[1]), _np.float32)
+        nz = _np.zeros((cap, nzr.shape[1]), _np.float32)
+        a = _chain_set_rows_jit(free, nzr, idx, fr, nz)
+        b = _chain_add_rows_jit(free, nzr, idx, fr, nz)
+        jax.block_until_ready((a, b))
+        cap *= 2
+
+
 def launch_cache_size() -> int | None:
     """Executable-cache entries behind the fused launch (schedule_batch_jit
     plus the state-extraction seed): the DeviceProfiler reads this after
@@ -1610,7 +1731,8 @@ def launch_cache_size() -> int | None:
     from kubernetes_tpu.ops.gang import pack_gangs_jit
 
     total = 0
-    for fn in (schedule_batch_jit, extract_state_jit, pack_gangs_jit):
+    for fn in (schedule_batch_jit, extract_state_jit, pack_gangs_jit,
+               _chain_set_rows_jit, _chain_add_rows_jit):
         size = getattr(fn, "_cache_size", None)
         if size is None:
             return None

@@ -44,8 +44,8 @@ from kubernetes_tpu.utils.interner import NONE
 def preempt_sweep(cblobs: ClusterBlobs, pblobs: PodBlobs,
                   wk: dict[str, jnp.ndarray], vic_cumsum: jnp.ndarray,
                   vic_cols: jnp.ndarray, caps: Capacities,
-                  enabled_filters: tuple[bool, ...] | None = None
-                  ) -> jnp.ndarray:
+                  enabled_filters: tuple[bool, ...] | None = None,
+                  free: jnp.ndarray | None = None) -> jnp.ndarray:
     """[P, N] i32: minimal victim count k (1..K) making each pod fit on
     each node; NONE where preemption cannot help (static filter fails,
     request exceeds allocatable, or even evicting every victim is not
@@ -59,7 +59,14 @@ def preempt_sweep(cblobs: ClusterBlobs, pblobs: PodBlobs,
     the host->device cumsum transfer ~R/C-fold (74 -> ~4 columns on the
     PreemptionAsync shape — ~20MB to ~1MB on the tunnel). Padding entries
     of vic_cols may alias column 0: their cumsum rows are +BIG so they
-    never constrain."""
+    never constrain.
+
+    ``free`` overrides the snapshot free matrix (ct.free) as the fit
+    baseline: the pipelined scheduler passes its live device-resident
+    chain here so a preemptor's sweep sees waves still in flight —
+    without it, the sweep would nominate slots an uncommitted wave has
+    already claimed and the verification launch would bounce the plan a
+    cycle later."""
     if enabled_filters is None:
         enabled_filters = (True,) * NUM_FILTER_PLUGINS
     ct = unpack_cluster(cblobs, caps)
@@ -79,7 +86,8 @@ def preempt_sweep(cblobs: ClusterBlobs, pblobs: PodBlobs,
         # effective free as the pipeline's fit check (nominated
         # reservations subtracted, own nomination handed back): [N, K+1]
         own = (jnp.arange(ct.free.shape[0]) == pod.nominated_row)
-        base = (ct.free - ct.nominated_req
+        base_free = ct.free if free is None else free
+        base = (base_free - ct.nominated_req
                 + jnp.where(own[:, None], pod.req[None], 0.0))
         fit0 = pod.req[None] <= base                           # [N, R]
         ok_rest = jnp.all(fit0 | col_freed[None], axis=-1)     # [N]
@@ -99,9 +107,9 @@ def preempt_sweep(cblobs: ClusterBlobs, pblobs: PodBlobs,
 
 @partial(jax.jit, static_argnames=("caps", "enabled_filters"))
 def preempt_sweep_jit(cblobs, pblobs, wk, vic_cumsum, vic_cols, caps,
-                      enabled_filters=None):
+                      enabled_filters=None, free=None):
     return preempt_sweep(cblobs, pblobs, wk, vic_cumsum, vic_cols, caps,
-                         enabled_filters)
+                         enabled_filters, free)
 
 
 def preempt_feasible(cblobs: ClusterBlobs, pblobs: PodBlobs,

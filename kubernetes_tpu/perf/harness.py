@@ -435,6 +435,10 @@ def run_workload(w: Workload, now: Callable[[], float] = time.time,
             "phases": fl.phase_percentiles(),
             "plugins": fl.plugin_percentiles(),
             "host_tail_share": round(fl.host_tail_share(), 4),
+            # pipelined waves: per-cycle device occupancy (launch span
+            # over cycle wall) — the pipelining win shows up here as a
+            # mean close to 1.0 while the strict-alternation arm idles
+            "occupancy": fl.occupancy_stats(),
             # the device-launch profiler column: compiles by attributed
             # cause, per-shape walltime, resident buffer bytes
             "device": (sched.profiler.snapshot()

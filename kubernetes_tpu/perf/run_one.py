@@ -1,7 +1,7 @@
 """Run ONE perf workload in a fresh process and print its result as JSON.
 
 `python -m kubernetes_tpu.perf.run_one <workload_fn> [--scale X]
- [--profile] [--recorder off] [--regret]`
+ [--profile] [--recorder off] [--regret] [--pipelined on|off]`
 
 --profile includes the flight recorder's per-phase/per-plugin breakdown
 in the JSON result (bench.py --profile consumes it); --recorder off
@@ -55,6 +55,19 @@ def main() -> None:
 
             config = default_config()
             config.flight_recorder_capacity = 0
+    if "--pipelined" in sys.argv:
+        # the pipelined-waves A/B arm selector (paired threshold-ratchet
+        # instrumentation): off = strict launch->commit alternation with
+        # whole-chain invalidation on every informer event
+        idx = sys.argv.index("--pipelined")
+        mode = sys.argv[idx + 1] if idx + 1 < len(sys.argv) else ""
+        if mode not in ("on", "off"):
+            sys.exit("--pipelined expects 'on' or 'off'")
+        if config is None:
+            from kubernetes_tpu.config.types import default_config
+
+            config = default_config()
+        config.pipelined_waves = mode == "on"
     regret_dir = None
     if "--regret" in sys.argv:
         import tempfile
@@ -78,9 +91,16 @@ def main() -> None:
         # the measured run's regret summary must not include the warm
         # pass's placements
         open(config.trace_export_path, "w").close()
+    from kubernetes_tpu.models.pipeline import launch_cache_size
+
     t0 = time.time()
+    # zero-recompile gate: the warm pass (and the chain-patch warmup it
+    # triggers) must have compiled every kernel the measured phase needs —
+    # a non-zero delta here is a mid-drain recompile eating measured time
+    compiles_pre = launch_cache_size()
     r = run_workload(factory(), scale=scale, config=config,
                      profile=profile)
+    r["measured_compiles"] = launch_cache_size() - compiles_pre
     if regret_dir is not None:
         import shutil
 

@@ -264,12 +264,15 @@ def _churn_node(i: int) -> object:
 def mixed_churn(init_nodes=5000, measure_pods=10000) -> Workload:
     return Workload(
         name="SchedulingWithMixedChurn/5000Nodes_10000Pods",
-        # ratcheted to LOCK the measured floor (BENCH_r12/r15: 1509
-        # pods/s): paired A/B this round shows the churn tail is the
-        # auction device launch + node-churn resyncs, not host requeue
-        # pressure — the 10x claim the ISSUE hypothesized is not
-        # supported by measurement, so the floor locks what is real
-        threshold=1400,
+        # ratcheted off the r15 lock (1400) by pipelined waves
+        # (BENCH_r19): chain-surviving churn keeps the device-resident
+        # free/nzr chain alive across the 1s recreate-churn (patches
+        # instead of whole-chain invalidation + resync), zero measured-
+        # phase recompiles. Paired same-box A/B best-of-3 reads 1.29x
+        # (on 429.4 vs off 334.1 pods/s on the throttled 2-CPU box) but
+        # the on-arm single-run swing is ±50%, so the ratchet is the
+        # modest, defensible slice of it
+        threshold=1500,
         baseline=265,
         ops=[
             CreateNodes(init_nodes, _node),
@@ -1201,12 +1204,15 @@ def gang_preemption(init_nodes=128, high_gangs=24) -> Workload:
 
     return Workload(
         name="GangPreemption/128Nodes",
-        # ratcheted to LOCK the measured floor (BENCH_r12/r15: 235
-        # pods/s): the eviction flush is now ONE delete_pods wave with
-        # coalesced requeue reaction, but the measured phase is
-        # dominated by victim-drain latency, not flush RPCs — paired
-        # A/B this round reads flat, so the floor locks what is real
-        threshold=220,
+        # ratcheted off the r15 lock (220) by pipelined waves
+        # (BENCH_r19): preemptor re-probes ride the next wave the
+        # moment the eviction flush fires (activation instead of
+        # backoff routing), attacking exactly the victim-drain-latency
+        # residue r15 documented. Paired same-box A/B best-of-3 reads
+        # 5.19x (on 421.0 vs off 81.2 pods/s; even the WORST on-arm
+        # sample beats the best off-arm 3.7x, and the win is wait
+        # elimination, not CPU, so it does not ride the box's throttle)
+        threshold=800,
         baseline=30,
         node_capacity=256,
         batch_size=512,

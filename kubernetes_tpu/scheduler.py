@@ -33,6 +33,7 @@ from kubernetes_tpu.api.objects import (
 from kubernetes_tpu.backend.cache import Cache
 from kubernetes_tpu.backend.jobqueue import JobQueue
 from kubernetes_tpu.backend.mirror import (
+    MI,
     CapacityError,
     Mirror,
 )
@@ -65,9 +66,11 @@ from kubernetes_tpu.models.pipeline import (
     BatchResult,
     extract_state_jit,
     launch_batch,
+    patch_chain,
+    warm_patch_chain,
 )
 from kubernetes_tpu.metrics import AsyncRecorder, SchedulerMetrics
-from kubernetes_tpu.ops.features import Capacities
+from kubernetes_tpu.ops.features import COL_PODS, Capacities
 
 logger = logging.getLogger("kubernetes_tpu.scheduler")
 
@@ -79,6 +82,16 @@ SLOW_CYCLE_SECONDS = 0.1
 # commit batch k-1 while launches k and k+1 queue on the device, which
 # hides the device wait entirely when host commit time ~ device time
 PIPELINE_DEPTH = 2
+
+# chain-surviving churn bounds: above CHAIN_PATCH_MAX pending patches a
+# full resync is cheaper than the scatter (and the pow2 patch buckets are
+# pre-warmed only up to this cap — see warm_patch_chain); after
+# CHAIN_DELTA_RESYNC accumulated per-pod delta applications the chain is
+# resynced once for float hygiene (per-pod rounded-up f32 requests only
+# ever UNDERSTATE free, but a delete re-credits at most 1 ulp more than
+# the add took for non-representable quantities — bound the drift)
+CHAIN_PATCH_MAX = 256
+CHAIN_DELTA_RESYNC = 100_000
 
 # poison-pod quarantine: a pod in this many faulted batches (or raising
 # in its own serial host-fallback evaluation) is parked out of the
@@ -328,7 +341,9 @@ class Scheduler:
                       "gang_device_launches": 0, "gang_fallbacks": 0,
                       "slice_rebalances": 0, "foreign_stashed": 0,
                       "foreign_adopted": 0,
-                      "brownout_enters": 0, "brownout_exits": 0}
+                      "brownout_enters": 0, "brownout_exits": 0,
+                      "chain_patches": 0, "chain_patch_rows": 0,
+                      "chain_patch_fallbacks": 0}
         # horizontal scale-out: when run() is handed a SliceManager the
         # replica drains only pods whose namespace (gang: the GROUP's
         # namespace) hashes into its owned ring slots. Everything else
@@ -382,6 +397,49 @@ class Scheduler:
         # invalidates it (set to None) and forces a full re-sync.
         self._chain: Optional[tuple] = None
         self._chain_epoch = 0
+        # pipelined scheduling waves (config.pipelined_waves): chain
+        # patching + off-thread commit + immediate preemptor re-dispatch.
+        # Off = the strict-alternation differential arm.
+        self._pipelined = bool(getattr(config, "pipelined_waves", True))
+        # chain-surviving churn bookkeeping. Instead of invalidating the
+        # device chain on every informer event, handlers register the
+        # event's EFFECT and the next dispatch scatters it into the chain
+        # (models/pipeline.patch_chain): _chain_dirty names nodes whose
+        # row must be absolutely repacked from the live cache (node
+        # add/update/delete — applied after in-flight waves flush, the
+        # conservative form of "touched node intersects an in-flight
+        # wave's packed set"); _chain_deltas accumulates commutative
+        # (d_free, d_nzr) per node from foreign pod binds/deletes —
+        # deltas compose with in-flight device commits in either order,
+        # so they need NO flush. Both clear on invalidate and right
+        # after a full mirror sync (which subsumes them).
+        self._chain_dirty: set[str] = set()
+        self._chain_deltas: dict[str, list[np.ndarray]] = {}
+        self._chain_delta_count = 0
+        self._patch_warmed = False
+        # off-thread commit: wave N's blocking D2H pull runs on this
+        # one-thread pool so it overlaps wave N+1's device time. The
+        # commit thread does ONLY jax.device_get (+ the chaos seam) —
+        # host mutation (assume/bind/queue/timeline) stays on the
+        # single-mutator loop thread, preserving the _wrap threading
+        # model; exceptions surface in _finish via fut.result() and ride
+        # the existing _finish_contained blast-radius ladder.
+        self._commit_pool: Optional[ThreadPoolExecutor] = (
+            ThreadPoolExecutor(max_workers=1, thread_name_prefix="commit")
+            if self._pipelined else None)
+        # preemptor re-probes ride the next wave: after an eviction flush
+        # fires, nominated reservations already protect the slots, so the
+        # evaluator re-activates the flushed preemptors immediately
+        # instead of letting them wait out backoff until victim-deletion
+        # events land (framework/preemption.Evaluator.flush_evictions)
+        self.preemption.activate_flushed = self._pipelined
+        # preemption dry-runs read the LIVE chain when one exists: under
+        # pipelining the mirror's host free matrix lags by the in-flight
+        # waves, and a dry-run against it would over-evict
+        self.preemption.live_free_fn = (
+            lambda: self._chain[0] if (self._pipelined
+                                       and self._chain is not None)
+            else None)
         # percentageOfNodesToScore rotating offset, persisted across
         # launches (schedule_one.go:620 nextStartNodeIndex); device scalar
         self._pct_start = None
@@ -529,9 +587,75 @@ class Scheduler:
     def _invalidate_chain(self) -> None:
         """Drop the device-resident usage chain and bump the epoch so a
         dispatch that raced with the invalidation (e.g. a bind failure
-        drained while packing) does not re-install a stale chain."""
+        drained while packing) does not re-install a stale chain. Pending
+        chain patches die with the chain — the full resync that follows
+        subsumes them."""
         self._chain = None
         self._chain_epoch += 1
+        self._chain_dirty.clear()
+        self._chain_deltas.clear()
+
+    def _chain_note_node(self, name: str) -> None:
+        """A node add/update/delete touched the cluster: instead of
+        invalidating the chain, mark the node's row for an absolute
+        repack from the live cache at next dispatch (chain-surviving
+        churn). Falls back to whole-chain invalidation when pipelining is
+        off, no chain exists, or the pending patch set outgrows the
+        pre-warmed scatter buckets (a resync is cheaper then anyway)."""
+        if not self._pipelined or self._chain is None:
+            self._invalidate_chain()
+            return
+        # an absolute repack includes every pod on the node — pending
+        # deltas for it are subsumed
+        self._chain_deltas.pop(name, None)
+        self._chain_dirty.add(name)
+        if len(self._chain_dirty) + len(self._chain_deltas) \
+                > CHAIN_PATCH_MAX:
+            self.stats["chain_patch_fallbacks"] += 1
+            self._invalidate_chain()
+
+    def _chain_note_pod(self, pod: Pod, sign: int) -> None:
+        """A FOREIGN bound pod appeared (+1) or vanished (-1): accumulate
+        its request as a commutative (free, nzr) delta against its node's
+        chain row. Deltas compose with in-flight waves' device commits in
+        either order (the chain already carries every dispatched commit),
+        so unlike node repacks they apply without a pipeline flush. Pods
+        with host ports route to the absolute-repack path instead: the
+        mirror's port columns must move with them, and a row repack is
+        the only operation that does that."""
+        if not self._pipelined or self._chain is None:
+            self._invalidate_chain()
+            return
+        node = pod.spec.node_name
+        if node in self._chain_dirty:
+            return                    # repack at apply time covers it
+        from kubernetes_tpu.api.resources import pod_request
+
+        if self.mirror.batch_has_host_ports([pod]):
+            self._chain_note_node(node)
+            return
+        try:
+            row = self.mirror._res_row(pod_request(pod)).copy()
+        except CapacityError:
+            self._invalidate_chain()
+            return
+        row[COL_PODS] = 1.0
+        nz = pod_request(pod, non_zero=True)
+        acc = self._chain_deltas.get(node)
+        if acc is None:
+            acc = self._chain_deltas[node] = [
+                np.zeros_like(row), np.zeros((2,), np.float32)]
+        # free MOVES OPPOSITE the pod: an added pod consumes its request
+        acc[0] -= np.float32(sign) * row
+        acc[1] += np.float32(sign) * np.asarray(
+            [nz.milli_cpu, nz.memory / MI], np.float32)
+        self._chain_delta_count += 1
+        if len(self._chain_dirty) + len(self._chain_deltas) \
+                > CHAIN_PATCH_MAX \
+                or self._chain_delta_count > CHAIN_DELTA_RESYNC:
+            self.stats["chain_patch_fallbacks"] += 1
+            self._chain_delta_count = 0
+            self._invalidate_chain()
 
     def _on_ns_set(self, ns) -> None:
         self._invalidate_chain()
@@ -542,19 +666,21 @@ class Scheduler:
         self.cache.remove_namespace(ns.metadata.name)
 
     def _on_node_add(self, node: Node) -> None:
-        self._invalidate_chain()
+        self._chain_note_node(node.metadata.name)
         self.cache.add_node(node)
         self.queue.move_all_to_active_or_backoff(
             ClusterEvent(R.NODE, A.ADD), None, node)
 
     def _on_node_update(self, old: Node, new: Node) -> None:
-        self._invalidate_chain()
+        self._chain_note_node(new.metadata.name)
+        if old.metadata.name != new.metadata.name:
+            self._chain_note_node(old.metadata.name)
         self.cache.update_node(old, new)
         self.queue.move_all_to_active_or_backoff(
             ClusterEvent(R.NODE, _node_update_action(old, new)), old, new)
 
     def _on_node_delete(self, node: Node) -> None:
-        self._invalidate_chain()
+        self._chain_note_node(node.metadata.name)
         self.cache.remove_node(node)
         self.queue.move_all_to_active_or_backoff(
             ClusterEvent(R.NODE, A.DELETE), node, None)
@@ -689,7 +815,9 @@ class Scheduler:
         if pod.spec.node_name:
             self._foreign.pop(pod.metadata.uid, None)
             if not self.cache.is_assumed_pod(pod):
-                self._invalidate_chain()
+                # a pod WE placed is already in the chain (its launch
+                # committed it on device); only foreign binds move it
+                self._chain_note_pod(pod, +1)
             self.cache.add_pod(pod)
             self._note_bound_pod(pod)
             self.queue.move_all_to_active_or_backoff(
@@ -716,7 +844,13 @@ class Scheduler:
         if new.spec.node_name:
             self._foreign.pop(new.metadata.uid, None)
             if not self.cache.is_assumed_pod(new):
-                self._invalidate_chain()
+                if old.spec.node_name:
+                    # bound-pod mutation: the chain moves by the request
+                    # DIFFERENCE (labels-only updates cancel to zero)
+                    self._chain_note_pod(old, -1)
+                    self._chain_note_pod(new, +1)
+                else:
+                    self._chain_note_pod(new, +1)
             self.nominator.delete(new.metadata.uid)
             if old.spec.node_name:
                 self.cache.update_pod(old, new)
@@ -804,11 +938,13 @@ class Scheduler:
             # the assigned-pod branch below already removes it
             if self.cache.is_assumed_pod(assumed):
                 self.cache.forget_pod(assumed)
-            self._invalidate_chain()
+                # the reservation WAS committed on device by its launch:
+                # hand the freed request back to the chain
+                self._chain_note_pod(assumed, -1)
             self.queue.done(uid)
         self.nominator.delete(uid)
         if pod.spec.node_name:
-            self._invalidate_chain()
+            self._chain_note_pod(pod, -1)
             self.cache.remove_pod(pod)
             self.queue.move_all_to_active_or_backoff(
                 ClusterEvent(R.ASSIGNED_POD, A.DELETE), pod, None)
@@ -898,6 +1034,14 @@ class Scheduler:
         busy = self.preemption.has_pending()
         t0 = self.now() if busy else 0.0
         try:
+            if busy:
+                # evictions fire only over durably-bound state: a victim
+                # whose own bind still rides the binder backlog would be
+                # deleted BEFORE its bind lands, losing the pod (the
+                # bind-after-delete fails and the deleted pod can't
+                # requeue). The strict path orders wait-drain before
+                # flush for the same reason (schedule_one_batch).
+                self._drain_bind_results(wait=True)
             # the queue's coalescing window batches the wave's delete
             # events into ONE requeue pass (in-process hubs dispatch
             # them inline on this thread); the whole wave — deletes AND
@@ -1386,6 +1530,69 @@ class Scheduler:
                 and not (self._has_host_filters
                          and any(self._host_relevant(p) for p in pods)))
 
+    def _apply_chain_patches(self, flush_pending=None) -> bool:
+        """Fold the pending churn patches into the live device chain
+        (chain-surviving churn, models/pipeline.patch_chain). Deltas
+        commute with in-flight waves' device commits, so they scatter
+        straight in; absolute node repacks read cache truth, so when any
+        are pending the in-flight waves flush FIRST — the conservative
+        form of "invalidate only when a touched node intersects an
+        in-flight wave's packed set" (every in-flight wave's packed set
+        came from the pre-event mirror, so a flush is the cheap safe
+        answer; per-wave set intersection would save a flush only on the
+        churn-while-deep-pipeline overlap, which the bench shows is
+        rare). Returns False when the chain must fall back to a full
+        resync (mirror capacity overflow, vanished rows, a flush fault
+        that invalidated the chain) — the caller dispatches unchained."""
+        if not self._chain_dirty and not self._chain_deltas:
+            return True
+        if self._chain is None:
+            self._chain_dirty.clear()
+            self._chain_deltas.clear()
+            return False
+        if self._chain_dirty and flush_pending is not None:
+            flush_pending()
+            if self._chain is None:     # a flush fault killed the chain
+                self._chain_dirty.clear()
+                self._chain_deltas.clear()
+                return False
+        # snapshot + clear AFTER the flush: events the flush delivered
+        # inline (eviction deletes, binder confirms) registered more
+        # patches, and this application must carry them too
+        dirty = sorted(self._chain_dirty)
+        deltas = [(nm, acc) for nm, acc in self._chain_deltas.items()
+                  if nm not in self._chain_dirty]
+        self._chain_dirty.clear()
+        self._chain_deltas.clear()
+        set_rows: list[tuple] = []
+        add_rows: list[tuple] = []
+        try:
+            for name in dirty:
+                patched = self.mirror.patch_node(
+                    name, self.cache.node_info(name))
+                if patched is not None:
+                    set_rows.append(patched)
+            for name, (dfree, dnzr) in deltas:
+                row = self.mirror.row_of(name)
+                if row < 0:
+                    # a delta for a node the mirror never packed: the
+                    # chain has no row to move — resync is the only
+                    # consistent answer
+                    self.stats["chain_patch_fallbacks"] += 1
+                    self._invalidate_chain()
+                    return False
+                add_rows.append((row, dfree, dnzr))
+        except CapacityError:
+            self.stats["chain_patch_fallbacks"] += 1
+            self._invalidate_chain()
+            return False
+        if set_rows or add_rows:
+            free, nzr = self._chain
+            self._chain = patch_chain(free, nzr, set_rows, add_rows)
+            self.stats["chain_patches"] += 1
+            self.stats["chain_patch_rows"] += len(set_rows) + len(add_rows)
+        return True
+
     def _dispatch(self, runnable: list[QueuedPodInfo], chained: bool,
                   flush_pending=None) -> Optional[tuple]:
         """Pack + launch one batch (async dispatch; no host<->device block).
@@ -1395,6 +1602,16 @@ class Scheduler:
         chained dispatch that has to re-bucket never syncs a cache missing
         the previous batch's placements."""
         t_cycle0 = self.now()
+        # chain-surviving churn: fold pending informer patches into the
+        # live chain BEFORE this launch packs against it. On fallback
+        # (patch set too large, mirror capacity overflow) the chain is
+        # invalidated and this dispatch takes the full-sync path.
+        t_patch = 0.0
+        if chained and (self._chain_dirty or self._chain_deltas):
+            t_p0 = self.now()
+            if not self._apply_chain_patches(flush_pending):
+                chained = False
+            t_patch = self.now() - t_p0
         epoch = self._chain_epoch
         if len(self.frameworks) > 1:
             # one profile per launch: enabled filters / weights / scoring
@@ -1427,6 +1644,8 @@ class Scheduler:
         tr = self.flight.begin(t_cycle0, len(runnable), chained)
         tr.add("queue_pop", self._last_pop_s)
         self._last_pop_s = 0.0
+        if t_patch:
+            tr.add("chain_patch", t_patch)
         state = self._chain if chained else None
         need_sync = not chained
         for attempt in range(16):  # one capacity field may grow per attempt
@@ -1438,6 +1657,11 @@ class Scheduler:
                     t_sync0 = self.now()
                     self.cache.update_snapshot(self.snapshot)
                     self.mirror.sync(self.snapshot)
+                    # a full sync subsumes every pending chain patch:
+                    # handlers mutate the cache synchronously before
+                    # registering, and the sync read that cache
+                    self._chain_dirty.clear()
+                    self._chain_deltas.clear()
                     tr.add("snapshot_sync", self.now() - t_sync0)
                 t_pack0 = self.now()
                 self.mirror.set_nominated(self.nominator.by_node())
@@ -1558,6 +1782,13 @@ class Scheduler:
             host_ok, host_score = self._run_host_plugins(runnable)
             tr.add("host_plugins", self.now() - t_host0)
         fit_strategy, fit_shape = pcfg["fit"]
+        # export-pull flags captured ONCE: the launch compiles against
+        # them and the commit thread pulls against them, so they must be
+        # the same observation (a rotation-disabled export mid-cycle
+        # must not desync the pull list from the launch outputs)
+        exporting = self.flight.exporting
+        want_feats = self._export_feats and exporting
+        want_alts = self._export_alts and exporting
         if state is None:
             # seed the usage chain from the freshly synced mirror so every
             # launch carries explicit state: one jit signature for chained
@@ -1578,8 +1809,7 @@ class Scheduler:
             # feature export is opted in AND the export file is still
             # open (a failed rotation disables the export; the feature
             # kernels must not keep running for output nobody pulls)
-            with_feats=self._export_feats and self.flight.exporting,
-            with_alts=self._export_alts and self.flight.exporting)
+            with_feats=want_feats, with_alts=want_alts)
         if self.fault_injector is not None:
             out = self.fault_injector.on_result(out)
         if pct:
@@ -1591,6 +1821,12 @@ class Scheduler:
         # external events reset it via the handlers
         if epoch == self._chain_epoch:
             self._chain = (out.free, out.nzr)
+            if self._pipelined and not self._patch_warmed:
+                # pre-compile every patch-scatter bucket for this chain
+                # shape, once per scheduler: churn patches must never
+                # trigger an XLA compile mid-drain
+                self._patch_warmed = True
+                warm_patch_chain(out.free, out.nzr, CHAIN_PATCH_MAX)
         t_done = self.now()
         tr.add("device_dispatch", t_done - t_disp0)
         # device-launch profiler: the jit call above traced (and, on a
@@ -1610,10 +1846,8 @@ class Scheduler:
                 self.caps, spec.pblobs.f32.shape[0],
                 spec.enable_topology, spec.d_cap, spec.g_cap,
                 not use_auction, spec.dra is not None,
-                learned_params is not None,
-                self._export_feats and self.flight.exporting,
-                alts=self._export_alts and self.flight.exporting,
-                soft=spec.topo_soft)
+                learned_params is not None, want_feats,
+                alts=want_alts, soft=spec.topo_soft)
             compiled = prof.note_launch(pshape)
             if compiled or prof.launches == 1:
                 # buffer footprints are bucket-static: re-measure only
@@ -1623,8 +1857,17 @@ class Scheduler:
                     "pods": tree_nbytes(spec.pblobs),
                     "dra": tree_nbytes(spec.dra),
                     "learned": tree_nbytes(learned_params)})
+        # off-thread commit: the wave's blocking D2H pull rides the
+        # commit thread from HERE, so it overlaps whatever the loop (and
+        # the device) does next; _finish harvests the future. The flags
+        # tuple snapshots what the launch actually compiled so the pull
+        # list matches its outputs.
+        flags = (learned_params is not None, exporting,
+                 want_feats, want_alts)
+        fut = (self._commit_pool.submit(self._pull_launch, out, flags)
+               if self._commit_pool is not None else None)
         return (runnable, out, t_done, t_done - t_cycle0, tr,
-                learned_params is not None, pshape, compiled)
+                flags, pshape, compiled, fut)
 
     # ------------- device-side gang packing (ISSUE 12) -------------
     #
@@ -1804,6 +2047,14 @@ class Scheduler:
         from kubernetes_tpu.ops.gang import pack_gangs_jit
 
         t0 = self.now()
+        # chain-surviving churn: pending patches fold in before the pack
+        # reads the chain (the caller already flushed the pipeline, so
+        # no flush closure is needed for absolute repacks)
+        if self._chain is not None \
+                and (self._chain_dirty or self._chain_deltas):
+            t_p0 = self.now()
+            self._apply_chain_patches()
+            self.flight.observe_phase("chain_patch", self.now() - t_p0)
         epoch = self._chain_epoch
         state = self._chain
         need_sync = state is None
@@ -1814,6 +2065,8 @@ class Scheduler:
                 if need_sync:
                     self.cache.update_snapshot(self.snapshot)
                     self.mirror.sync(self.snapshot)
+                    self._chain_dirty.clear()
+                    self._chain_deltas.clear()
                 # nominated reservations must be CURRENT: the packer
                 # subtracts them (and hands back each gang's own)
                 self.mirror.set_nominated(self.nominator.by_node())
@@ -2225,38 +2478,66 @@ class Scheduler:
                         host_score[i, row] += sc
         return host_ok, host_score
 
-    def _finish(self, inflight: tuple) -> None:
-        """Pull one dispatched launch's results and commit/fail each pod."""
-        (runnable, out, t_dispatched, pack_s, tr, learned_on,
-         pshape, compiled) = inflight
-        # re-attach the cycle's trace: the pipelined drain may have
-        # dispatched k+1 (opening its trace) before finishing k
-        self.flight.resume(tr)
-        n = len(runnable)
-        t0 = self.now()
-        # ONE blocking pull per cycle: the optional learned-magnitude /
-        # export tensors ride the same host<->device sync as rows+guard
-        # (a second device_get would be a second full round trip)
-        exporting = self.flight.exporting
+    def _pull_launch(self, out: BatchResult, flags: tuple) -> tuple:
+        """The commit-thread half of _finish: ONE blocking D2H pull of the
+        launch's verdict tensors (rows + guard + the flag-gated
+        learned-magnitude / export tensors — a second device_get would be
+        a second full round trip). Under pipelined waves this runs on the
+        commit thread so the transfer wait — the wave's actual
+        serialization — overlaps the next wave's device time. It touches
+        NO host state (the single-mutator invariant: assume/bind/queue
+        mutation stays on the loop thread) and takes no locks; exceptions
+        (including the chaos commit_pull seam) surface in _finish via
+        fut.result() and ride the normal containment ladder. Returns
+        (vals, t_ready) — t_ready timestamps verdict availability, the
+        honest end of the device span."""
+        learned_on, exporting, want_feats, want_alts = flags
+        fi = self.fault_injector
+        if fi is not None:
+            hook = getattr(fi, "on_commit_pull", None)
+            if hook is not None:
+                hook()          # chaos seam: may raise
         pull = [out.node_row, out.guard]
         if learned_on:
             pull.append(out.learned_mag)
         if exporting:
             pull.append(out.score)
-            if self._export_feats:
+            if want_feats:
                 pull.append(out.chosen_feat)
-            if self._export_alts:
+            if want_alts:
                 pull.append(out.alt_row)
                 pull.append(out.alt_score)
-        # any PreFilter gang-capacity reductions dispatched this cycle
-        # ride this same sync (the folded gang_capacity D2H — never a
-        # separate blocking pull)
-        cap_pulls = self._gang.take_pending_caps()
-        cap_base = len(pull)
-        pull.extend(arr for _key, _tok, arr in cap_pulls)
         vals = jax.device_get(tuple(pull))
-        for (ckey, ctok, _arr), v in zip(cap_pulls, vals[cap_base:]):
-            self._gang.resolve_cap(ckey, ctok, int(v))
+        return vals, self.now()
+
+    def _finish(self, inflight: tuple) -> None:
+        """Pull one dispatched launch's results and commit/fail each pod."""
+        (runnable, out, t_dispatched, pack_s, tr, flags,
+         pshape, compiled, fut) = inflight
+        learned_on, exporting, want_feats, want_alts = flags
+        # re-attach the cycle's trace: the pipelined drain may have
+        # dispatched k+1 (opening its trace) before finishing k
+        self.flight.resume(tr)
+        n = len(runnable)
+        t0 = self.now()
+        if fut is not None:
+            # off-thread commit: the pull has been running on the commit
+            # thread since dispatch; a commit-thread exception re-raises
+            # HERE and rides the same _finish_contained blast-radius
+            # ladder an inline fault would
+            vals, t_ready = fut.result()
+        else:
+            vals, t_ready = self._pull_launch(out, flags)
+        # PreFilter gang-capacity reductions cannot ride the commit
+        # thread's pull (they register on the loop thread, possibly
+        # after dispatch); rare — gang PreFilter only — so they get
+        # their own small transfer when present
+        cap_pulls = self._gang.take_pending_caps()
+        if cap_pulls:
+            cvals = jax.device_get(
+                tuple(arr for _key, _tok, arr in cap_pulls))
+            for (ckey, ctok, _arr), v in zip(cap_pulls, cvals):
+                self._gang.resolve_cap(ckey, ctok, int(v))
         rows_arr, guard = vals[0], vals[1]
         k = 2
         lmag = None
@@ -2267,10 +2548,10 @@ class Scheduler:
         if exporting:
             scores_arr = vals[k]
             k += 1
-            if self._export_feats:
+            if want_feats:
                 feats_arr = vals[k]
                 k += 1
-            if self._export_alts:
+            if want_alts:
                 alt_rows_arr = vals[k]
                 alt_scores_arr = vals[k + 1]
         if int(guard):
@@ -2287,7 +2568,11 @@ class Scheduler:
             # forever (Histogram.observe accumulates the raw value)
             self.metrics.learned_magnitude.observe(float(lmag))
         rows = np.asarray(rows_arr)[:n].tolist()
-        launch_s = self.now() - t_dispatched
+        # the device span ends when the verdict pull completed (t_ready,
+        # stamped by whichever thread ran it) — under pipelining the loop
+        # may harvest the future long after, and that host overlap time
+        # must not masquerade as device time
+        launch_s = max(t_ready - t_dispatched, 0.0)
         if exporting:
             # export v2/v3 placement rows: (pod, chosen node, aggregate
             # score[, chosen-node feature vector when
@@ -2380,6 +2665,11 @@ class Scheduler:
                 tr.add("device_compile", launch_s)
         tr.scheduled = n - n_fail
         tr.failed = n_fail
+        # device occupancy: launch-in-flight fraction of this cycle's
+        # wall (dispatch open -> commit done). 1.0 = the device never
+        # sat idle waiting on host work — the pipelining headline.
+        tr.occupancy = max(0.0, min(
+            1.0, launch_s / max(self.now() - tr.start, 1e-9)))
         self.flight.record(tr)
         m = self.metrics
         m.algorithm_duration.observe(launch_s)
@@ -3387,6 +3677,9 @@ class Scheduler:
             self._process_deferred_events()
             self._binder.shutdown(wait=True)
             self._binder = None
+        if self._commit_pool is not None:
+            self._commit_pool.shutdown(wait=True)
+            self._commit_pool = None
         self.flight.close()
 
     # ------------- driving -------------
@@ -3454,6 +3747,14 @@ class Scheduler:
                 # binding cycle BEFORE deciding the queue is idle, or a
                 # drain ends with allowed pods stranded in the wait room
                 self._process_waiting()
+                if self._pipelined:
+                    # the flush may also have planned evictions (the
+                    # failed wave's PostFilter ran in _finish): fire them
+                    # NOW so the activated preemptor rides the next wave
+                    # of this same drain instead of waiting out a backoff
+                    # into the next one (its nominated reservation holds
+                    # the freed slot either way)
+                    self._flush_evictions_safe()
                 self.queue.flush_backoff_completed()
                 # a drained wait room or a churn event may have refilled
                 # the job queue mid-iteration
@@ -3464,6 +3765,7 @@ class Scheduler:
                 if popped == 0:
                     break
             total += popped
+            nxt = None
             if runnable:
                 # gang units first: their fused launch chains the usage
                 # state the normal launch then builds on
@@ -3471,8 +3773,12 @@ class Scheduler:
                     runnable, flush_pending=flush_all)
             if runnable:
                 chained = self._chain_eligible([qp.pod for qp in runnable])
-                if not chained:
-                    flush_all()   # next launch needs the synced cache
+                # a non-chainable batch does NOT drain the pipeline here:
+                # _dispatch's own need_sync path flushes lazily (through
+                # flush_pending) right before the snapshot sync, so the
+                # in-flight waves keep their device head start and
+                # pipelining resumes at full depth after the host-path
+                # batch commits
                 try:
                     nxt = self._dispatch(runnable, chained,
                                          flush_pending=flush_all)
@@ -3487,10 +3793,23 @@ class Scheduler:
                     nxt = None
                 if nxt is not None:
                     pending.append(nxt)
+                    # pipeline-depth observability: how many waves were
+                    # in flight right after this dispatch (tr is tuple
+                    # element 4) — the stall detector for satellite runs
+                    nxt[4].depth = len(pending)
             # keep up to PIPELINE_DEPTH launches outstanding: batch k-1 is
             # committed only after k AND k+1 are queued, so the device gets
-            # a full iteration (dispatch + commit) of head start
-            flush_to(PIPELINE_DEPTH)
+            # a full iteration (dispatch + commit) of head start. The
+            # off-arm (pipelined_waves=False) commits every wave before
+            # the next dispatch — strict launch->commit alternation.
+            flush_to(PIPELINE_DEPTH if self._pipelined else 0)
+            if nxt is not None and pending and pending[-1] is nxt:
+                # settle the recorded depth to the post-trim count (the
+                # ring keeps the live trace object): a full pipeline
+                # reads PIPELINE_DEPTH, a stalled one 1. Waves the trim
+                # itself committed (the off arm) keep their dispatch-time
+                # depth of 1.
+                nxt[4].depth = len(pending)
             # async preemption evictions run between cycles (kep 4832)
             self._flush_evictions_safe()
         flush_all()

@@ -46,6 +46,9 @@ logger = logging.getLogger("kubernetes_tpu.trace")
 CYCLE_PHASES = (
     "queue_pop",          # pop_batch + per-pod hub vetting
     "snapshot_sync",      # cache.update_snapshot + mirror.sync (H2D pack)
+    "chain_patch",        # churn deltas scattered into the live chain
+                          # (chain-surviving churn: the cheap substitute
+                          # for a whole-chain invalidate + snapshot_sync)
     "host_plugins",       # host PreFilter/Filter/Score + extenders
     "pack",               # mirror.prepare_launch (pod-side H2D)
     "device_dispatch",    # async launch_batch dispatch
@@ -102,7 +105,8 @@ EXPORT_VERSION = 3
 # sub-10x offenders ask us to attribute); device_launch is device +
 # transfer, d2h_pull is transfer, the dra_* views double-count host time
 HOST_PHASES = (
-    "queue_pop", "snapshot_sync", "host_plugins", "pack", "commit",
+    "queue_pop", "snapshot_sync", "chain_patch", "host_plugins", "pack",
+    "commit",
     "failure_handling", "binder_drain", "eviction_flush", "host_fallback",
     "learned_score", "gang_commit",
 )
@@ -161,7 +165,8 @@ class CycleTrace:
     phase histogram when the cycle is recorded."""
 
     __slots__ = ("cycle", "start", "pods", "scheduled", "failed",
-                 "chained", "phases", "plugins", "placements")
+                 "chained", "phases", "plugins", "placements",
+                 "occupancy", "depth")
 
     def __init__(self, cycle: int, start: float, pods: int,
                  chained: bool = False):
@@ -171,6 +176,14 @@ class CycleTrace:
         self.scheduled = 0
         self.failed = 0
         self.chained = chained
+        # device occupancy: fraction of this cycle's wall (dispatch ->
+        # finish) with its launch in flight — the pipelining instrument
+        # (1.0 = the device never waited on host commit work). None until
+        # the cycle finishes; stays None for host-fallback cycles.
+        self.occupancy: float | None = None
+        # pipeline depth observed right after this cycle dispatched
+        # (how many waves were in flight, the stall detector)
+        self.depth = 0
         self.phases: dict[str, float] = {}
         self.plugins: dict[str, float] = {}   # "plugin/point" -> secs
         # per-pod placement rows (export v2+): {"pod", "uid", "node",
@@ -196,10 +209,13 @@ class CycleTrace:
             "scheduled": self.scheduled,
             "failed": self.failed,
             "chained": self.chained,
+            "depth": self.depth,
             "total_ms": round(self.total() * 1e3, 3),
             "phases_ms": {k: round(v * 1e3, 3)
                           for k, v in self.phases.items()},
         }
+        if self.occupancy is not None:
+            d["occupancy"] = round(self.occupancy, 4)
         if self.plugins:
             d["plugins_ms"] = {k: round(v * 1e3, 3)
                                for k, v in self.plugins.items()}
@@ -239,6 +255,11 @@ class FlightRecorder:
         self.phase_hist = phase_hist
         self.plugin_hist = plugin_hist
         self.ring: collections.deque = collections.deque(
+            maxlen=max(1, capacity))
+        # device-occupancy ring (floats, same capacity): record() copies
+        # each finished cycle's occupancy here so occupancy_stats() needn't
+        # walk CycleTrace objects under the readers' snapshot
+        self._occ: collections.deque = collections.deque(
             maxlen=max(1, capacity))
         self.current: Optional[CycleTrace] = None
         self._cycle_seq = 0
@@ -287,6 +308,8 @@ class FlightRecorder:
         if self.current is tr:
             self.current = None
         self.ring.append(tr)
+        if tr.occupancy is not None:
+            self._occ.append(tr.occupancy)
         h = self.phase_hist
         if h is not None:
             for phase, secs in tr.phases.items():
@@ -324,6 +347,23 @@ class FlightRecorder:
             except OSError:
                 pass
             self._export_file = None
+
+    def occupancy_stats(self) -> dict:
+        """Device-occupancy summary over the ring: mean/p50/p99 fraction
+        of cycle wall with a launch in flight. The pipelining headline —
+        a mean near 1.0 means commit work fully overlapped device time;
+        strict alternation (pipelined_waves off) sits at launch/(launch +
+        commit). Empty dict when no device cycle has finished yet."""
+        vals = sorted(self._occ)
+        n = len(vals)
+        if n == 0:
+            return {}
+        return {
+            "n": n,
+            "mean": round(sum(vals) / n, 4),
+            "p50": round(vals[n // 2], 4),
+            "p99": round(vals[min(n - 1, int(n * 0.99))], 4),
+        }
 
     def observe_phase(self, phase: str, secs: float) -> None:
         """A standalone phase observation outside a cycle (binder drain

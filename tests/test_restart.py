@@ -102,6 +102,11 @@ def test_restart_preserves_nominations():
     hub = Hub()
     clock = Clock()
     s1 = mksched(hub, clock)
+    # strict-alternation arm: the pipelined default fires the eviction
+    # flush and re-dispatches the activated preemptor inside the same
+    # drain, so "crashed after nominating but before binding" is only
+    # constructible with next-wave activation off
+    s1.preemption.activate_flushed = False
     hub.create_node(mknode(0, cpu="2"))
     low = [mkpod(f"low{i}", cpu="1") for i in range(2)]
     for p in low:
