@@ -506,7 +506,12 @@ def trace_overhead_smoke(pairs: int = 4) -> dict:
     cycle-time cost. One process (shared compile cache), a fixed-seed
     shrunk SchedulingBasic, alternating recorder-off/on runs, EXACT raw
     per-cycle durations pooled per arm (the histogram's power-of-2
-    buckets would quantize a 2% delta away), medians compared."""
+    buckets would quantize a 2% delta away), medians compared. The ON
+    arm also runs the SLO watchdog + an armed autopsy store, so the
+    budget covers the whole observability stack: recorder, timelines,
+    incident hooks, and breach detection."""
+    import tempfile
+
     from kubernetes_tpu.utils import jaxsetup
 
     jaxsetup.setup(os.path.join(_repo, ".jax_cache"))
@@ -524,10 +529,19 @@ def trace_overhead_smoke(pairs: int = 4) -> dict:
         w.batch_size = 16
         return w
 
+    autopsy_dir = tempfile.mkdtemp(prefix="bench-trace-autopsy-")
+
     def cfg(recorder_on: bool):
         c = default_config()
         if not recorder_on:
             c.flight_recorder_capacity = 0
+        else:
+            # the full observability stack on the measured arm: the
+            # watchdog evaluates every maintenance pass and the store
+            # is armed (no breaches expected on this clean workload,
+            # but the hot-path hook checks are what the budget prices)
+            c.autopsy_dir = autopsy_dir
+            c.watchdog_interval_s = 0.0
         return c
 
     run_workload(make(), scale=0.1, config=cfg(True))   # compile pass

@@ -605,12 +605,19 @@ def run_device_storm(pods: int = 80, nodes: int = 8, seed: int = 11,
     from kubernetes_tpu.scheduler import Scheduler
     from kubernetes_tpu.testing import MakeNode, MakePod
 
+    import tempfile
+
     hub = Hub()
     for i in range(nodes):
         hub.create_node(MakeNode().name(f"dn-{i}")
                         .capacity(cpu="64", pods="440").obj())
     cfg = default_config()
     cfg.batch_size = 16
+    # every injected incident class must leave a parseable black box
+    # (and a clean control run below must leave none)
+    autopsy_dir = tempfile.mkdtemp(prefix="chaos-autopsy-")
+    cfg.autopsy_dir = autopsy_dir
+    cfg.autopsy_rate_limit_s = 2.0
     sched = Scheduler(hub, cfg, caps=Capacities(nodes=max(16, nodes * 2),
                                                 pods=max(128, pods * 2)))
     chaos = DeviceChaos(DeviceChaosConfig(seed=seed))
@@ -655,6 +662,10 @@ def run_device_storm(pods: int = 80, nodes: int = 8, seed: int = 11,
         bound = sum(1 for p in hub.list_pods() if p.spec.node_name)
         q_events = [e for e in hub.list_events(ref_kind="Pod")
                     if e.reason == "Quarantined"]
+        # autopsy gate: every injected incident class filed >=1 bundle
+        # that parses strictly with the matching trigger recorded
+        autopsy = audit_autopsy_bundles(
+            autopsy_dir, expect_kinds=("device_fallback", "quarantine"))
         report.update({
             "bound": bound, "lost": pods - bound,
             "poison_bound": bool(
@@ -664,6 +675,7 @@ def run_device_storm(pods: int = 80, nodes: int = 8, seed: int = 11,
             "device_fallbacks": sched.stats["device_fallbacks"],
             "device_chaos": dict(chaos.stats),
             "cache_vs_hub": sched.cache.compare_with_hub(hub),
+            "autopsy": autopsy,
             "ok": (bound == pods
                    and not hub.get_pod(poison.metadata.uid).spec.node_name
                    and sched.stats["quarantined"] >= 1
@@ -673,11 +685,82 @@ def run_device_storm(pods: int = 80, nodes: int = 8, seed: int = 11,
                    and chaos.stats["injected_launch_errors"] >= 1
                    and chaos.stats["injected_capacity_errors"] >= 1
                    and chaos.stats["injected_pull_errors"] >= 1
-                   and not sched.cache.compare_with_hub(hub)),
+                   and not sched.cache.compare_with_hub(hub)
+                   and autopsy["ok"]),
         })
     finally:
         sched.close()
+    # false-positive control: an identical (smaller) drain with NO
+    # chaos attached must file ZERO bundles — breach detection that
+    # fires on a healthy system is itself a defect
+    report["autopsy_control"] = _autopsy_clean_control()
+    report["ok"] = bool(report.get("ok")) \
+        and report["autopsy_control"]["ok"]
     return report
+
+
+def audit_autopsy_bundles(directory: str,
+                          expect_kinds: tuple = ()) -> dict:
+    """Strict-parse every bundle in ``directory`` and check each
+    expected incident class filed at least one. The chaos storms' proof
+    that the watchdog's black boxes actually capture what was injected
+    (``telemetry autopsy show`` uses the same strict reader)."""
+    from kubernetes_tpu.telemetry.autopsy import list_bundles, load_bundle
+
+    rows = list_bundles(directory)
+    torn = [r["name"] for r in rows if "error" in r]
+    kinds: dict[str, int] = {}
+    for r in rows:
+        if "error" in r:
+            continue
+        # re-load through the strict reader (list already parsed once;
+        # this is the same path the CLI's `show` takes)
+        doc = load_bundle(os.path.join(directory, r["name"]))
+        k = doc.get("trigger", {}).get("kind", "?")
+        kinds[k] = kinds.get(k, 0) + 1
+    missing = [k for k in expect_kinds if not kinds.get(k)]
+    return {"bundles": len(rows), "torn": torn, "kinds": kinds,
+            "missing": missing,
+            "ok": not torn and not missing}
+
+
+def _autopsy_clean_control(pods: int = 24, nodes: int = 4) -> dict:
+    """A chaos-free mini-drain with the watchdog + autopsy store armed
+    exactly like the storm: it must bind everything and file ZERO
+    bundles (no false-positive incidents on a healthy system)."""
+    import tempfile
+
+    from kubernetes_tpu.config.types import default_config
+    from kubernetes_tpu.hub import Hub
+    from kubernetes_tpu.ops.features import Capacities
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.testing import MakeNode, MakePod
+
+    hub = Hub()
+    for i in range(nodes):
+        hub.create_node(MakeNode().name(f"cn-{i}")
+                        .capacity(cpu="64", pods="440").obj())
+    cfg = default_config()
+    cfg.batch_size = 16
+    autopsy_dir = tempfile.mkdtemp(prefix="chaos-autopsy-clean-")
+    cfg.autopsy_dir = autopsy_dir
+    cfg.autopsy_rate_limit_s = 0.0
+    cfg.watchdog_interval_s = 0.0     # poll every maintenance tick
+    sched = Scheduler(hub, cfg, caps=Capacities(nodes=max(16, nodes * 2),
+                                                pods=max(64, pods * 2)))
+    try:
+        for i in range(pods):
+            hub.create_pod(MakePod().name(f"cp-{i}")
+                           .req(cpu="100m").obj())
+        sched.run_until_idle()
+        sched.run_maintenance()
+        bound = sum(1 for p in hub.list_pods() if p.spec.node_name)
+    finally:
+        sched.close()
+    audit = audit_autopsy_bundles(autopsy_dir)
+    return {"bound": bound, "pods": pods,
+            "bundles": audit["bundles"], "kinds": audit["kinds"],
+            "ok": bound == pods and audit["bundles"] == 0}
 
 
 # --------------------------------------------------------------------------
@@ -1473,7 +1556,10 @@ def run_scaleout_storm(pods: int = 240, nodes: int = 12,
     its slices reassign within the registry TTL, every pod still binds
     EXACTLY once fleet-wide (journal-replay audit + live watch ledger),
     the slice-fence epoch is monotone across the rebalances, and a bind
-    carrying a stale slice epoch is rejected Fenced."""
+    carrying a stale slice epoch is rejected Fenced. Each replica runs
+    its own autopsy store; ``ok`` also requires ≥1 strictly-parseable
+    ``slice_reparent`` black-box bundle across the survivors (filed
+    when a survivor adopts another replica's penned pods)."""
     import tempfile
 
     from kubernetes_tpu.config.types import default_config
@@ -1494,6 +1580,10 @@ def run_scaleout_storm(pods: int = 240, nodes: int = 12,
     report: dict = {"pods": pods, "nodes": nodes, "seed": seed,
                     "replicas": replicas}
     wal_dir = tempfile.mkdtemp(prefix="scaleout-wal-")
+    # one autopsy store per replica (stores own their dir's seq space):
+    # after the kill, at least one survivor must file a slice_reparent
+    # black box when it adopts the victim's penned pods
+    autopsy_root = tempfile.mkdtemp(prefix="chaos-autopsy-scaleout-")
     cluster = spawn_local_cluster(pod_shards=2, wal_dir=wal_dir)
     admin = RemoteHub(cluster.router_url, timeout=10.0,
                       retry_deadline=3.0, retry_base=0.01,
@@ -1510,6 +1600,8 @@ def run_scaleout_storm(pods: int = 240, nodes: int = 12,
                            retry_cap=0.2)
         cfg = default_config()
         cfg.batch_size = 32
+        cfg.autopsy_dir = os.path.join(autopsy_root, ident)
+        cfg.autopsy_rate_limit_s = 1.0
         sched = Scheduler(client, cfg,
                           caps=Capacities(nodes=max(32, nodes * 2),
                                           pods=1024))
@@ -1638,6 +1730,21 @@ def run_scaleout_storm(pods: int = 240, nodes: int = 12,
         daemon_errors = {
             ident: repr(s.daemon_error) for ident, s in scheds.items()
             if getattr(s, "daemon_error", None) is not None}
+        # black-box gate: every survivor's bundles must re-parse
+        # strictly, and at least one survivor filed a slice_reparent
+        # (the pen adoption of the victim's pods IS the incident)
+        per_replica = {ident: audit_autopsy_bundles(
+            os.path.join(autopsy_root, ident))
+            for ident in scheds}
+        reparent_seen = sum(
+            a["kinds"].get("slice_reparent", 0)
+            for a in per_replica.values())
+        autopsy = {
+            "per_replica": per_replica,
+            "slice_reparent_bundles": reparent_seen,
+            "ok": (reparent_seen >= 1
+                   and all(a["ok"] for a in per_replica.values())),
+        }
         report.update({
             "bound": bound, "lost": pods - bound,
             "duplicate_binds": dup,
@@ -1650,7 +1757,9 @@ def run_scaleout_storm(pods: int = 240, nodes: int = 12,
             "rebalances": {i: m.rebalances
                            for i, m in managers.items()},
             "daemon_errors": daemon_errors,
+            "autopsy": autopsy,
             "ok": (bound == pods and not dup and audit["ok"]
+                   and autopsy["ok"]
                    and reassign_s is not None
                    and reassign_s <= ttl_s * 5
                    and epoch_after >= epoch_before >= 1
@@ -1687,7 +1796,12 @@ def run_overload_storm(pods: int = 120, nodes: int = 8, seed: int = 31,
     sheds with HONEST 429 accounting — every server-side rejection is
     observed as a typed 429 by exactly one client), every pod binds
     exactly once (journal-replay audit), and the drain is clean: no
-    watch relists, no daemon error."""
+    watch relists, no daemon error. The scheduler runs with an
+    unholdable time-to-bind SLO and an autopsy store, so ``ok`` also
+    requires the watchdog to have filed ≥1 strictly-parseable
+    ``slo_breach`` black-box bundle during the stampede."""
+    import tempfile
+
     from kubernetes_tpu.config.types import default_config
     from kubernetes_tpu.fabric.flowcontrol import (
         FlowController,
@@ -1758,6 +1872,15 @@ def run_overload_storm(pods: int = 120, nodes: int = 8, seed: int = 31,
                                       pods="440").obj())
         cfg = default_config()
         cfg.batch_size = 16
+        # autopsy gate: a time-to-bind SLO no stampede can hold (10ms
+        # p99 under seat contention + compile warmup) so the watchdog
+        # MUST file an slo_breach black box; the sustained 429s feed
+        # the throttle_shed counter rule the same window
+        autopsy_dir = tempfile.mkdtemp(prefix="chaos-autopsy-overload-")
+        cfg.autopsy_dir = autopsy_dir
+        cfg.autopsy_rate_limit_s = 2.0
+        cfg.watchdog_interval_s = 1.0
+        cfg.watchdog_slo = {"time_to_bind_p99_ms": 10.0}
         sched = Scheduler(sched_client, cfg,
                           caps=Capacities(nodes=max(16, nodes * 2),
                                           pods=max(256, pods * 2)))
@@ -1835,6 +1958,11 @@ def run_overload_storm(pods: int = 120, nodes: int = 8, seed: int = 31,
 
         p99s = {cls: round(p99(cls), 4) for cls in lat}
         rs = sched_client.resilience_stats()
+        # the watchdog must have filed at least one slo_breach black
+        # box (the injected 10ms p99 limit is unholdable under the
+        # stampede), and every bundle on disk must re-parse strictly
+        autopsy = audit_autopsy_bundles(
+            autopsy_dir, expect_kinds=("slo_breach",))
         report.update({
             "bound": bound,
             "audit": {k: audit[k] for k in
@@ -1849,7 +1977,9 @@ def run_overload_storm(pods: int = 120, nodes: int = 8, seed: int = 31,
             "sched_throttled": rs["throttled_429s"],
             "daemon_error": repr(sched.daemon_error)
             if getattr(sched, "daemon_error", None) else None,
+            "autopsy": autopsy,
             "ok": (bound == pods and audit["ok"]
+                   and autopsy["ok"]
                    and depths_bounded
                    # best-effort sheds, with honest typed accounting:
                    # every server-side 429 reached a client as one

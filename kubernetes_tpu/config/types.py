@@ -170,6 +170,25 @@ class SchedulerConfiguration:
     brownout_batch_floor: int = 8
     brownout_drift_stretch: float = 4.0
     brownout_besteffort_weight: float = 0.25
+    # SLO watchdog (telemetry/watchdog.py): evaluated on the maintenance
+    # cadence, at most every watchdog_interval_s. watchdog_slo is a
+    # telemetry/slo.py target dict over live time-to-bind stats (e.g.
+    # {"time_to_bind_p99_ms": 500}); empty = no SLO rule (containment
+    # incidents still fire). watchdog_min_binds gates the SLO rule until
+    # enough pods bound for percentiles to mean anything
+    watchdog_interval_s: float = 5.0
+    watchdog_slo: dict[str, float] = field(default_factory=dict)
+    watchdog_min_binds: int = 8
+    # incident autopsy (telemetry/autopsy.py): directory for black-box
+    # bundles captured when a watchdog rule trips or a containment site
+    # fires. None disables capture (the watchdog still counts incidents
+    # in scheduler_watchdog_incidents_total). Retention: newest
+    # autopsy_max_bundles bundles / autopsy_max_bytes on disk; at most
+    # one bundle per incident class per autopsy_rate_limit_s
+    autopsy_dir: Optional[str] = None
+    autopsy_max_bundles: int = 32
+    autopsy_max_bytes: int = 16 * 1024 * 1024
+    autopsy_rate_limit_s: float = 30.0
     # explicit tie-break RNG seed for the device pipeline's equal-score
     # node choice: paired A/B runs (bench --ab-scorer) share a seed so
     # placement diffs are attributable to the scorer, not the coin.

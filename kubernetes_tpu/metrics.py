@@ -388,6 +388,31 @@ class SchedulerMetrics:
         self.scenario_time_to_bind_p99 = r.register(Gauge(
             "scheduler_scenario_time_to_bind_p99_seconds",
             "Trace-time p99 time-to-bind of the last scenario replay"))
+        # SLO watchdog + incident autopsy (telemetry/watchdog.py,
+        # telemetry/autopsy.py): incidents by class, bundle capture
+        # accounting, and the on-disk store footprint
+        self.watchdog_evals = r.register(Counter(
+            "scheduler_watchdog_evals_total",
+            "Watchdog rule-set evaluations run on the maintenance "
+            "cadence"))
+        self.watchdog_incidents = r.register(Counter(
+            "scheduler_watchdog_incidents_total",
+            "Incidents raised (watchdog rule trips + direct containment "
+            "hooks), by incident class", ("kind",)))
+        self.watchdog_rules_tripped = r.register(Counter(
+            "scheduler_watchdog_rules_tripped_total",
+            "Watchdog rule trips by rule name", ("rule",)))
+        self.autopsy_bundles = r.register(Counter(
+            "scheduler_autopsy_bundles_total",
+            "Black-box autopsy bundles written to disk, by trigger "
+            "incident class", ("trigger",)))
+        self.autopsy_bundles_dropped = r.register(Counter(
+            "scheduler_autopsy_bundles_dropped_total",
+            "Autopsy captures skipped or bundles pruned, by reason "
+            "(rate_limited / retention / write_error)", ("reason",)))
+        self.autopsy_store_bytes = r.register(Gauge(
+            "scheduler_autopsy_store_bytes",
+            "Bytes currently held by the autopsy bundle store"))
         self.drift_detected = r.register(Counter(
             "scheduler_drift_detected_total",
             "Cache/mirror-vs-hub discrepancies found by the drift "

@@ -334,6 +334,21 @@ def replay_trace(trace: Trace, speed: float = 10.0, warmup: bool = True,
                 metric=f"{tag}:{b['metric']}")
     sched.metrics.scenario_time_to_bind_p99.set(
         stats["time_to_bind_p99_ms"] / 1e3)
+    if (not slo_verdict["ok"] or not gate_verdict["ok"]) \
+            and getattr(sched, "autopsy", None) is not None:
+        # breach → auto-autopsy: the bundle names the filed trace
+        # (name/generator/seed/speed) so the incident points straight
+        # at the replayable reproducer. Post-close is safe — the
+        # flight ring, timelines, and stats are plain host state.
+        sched.watchdog.incident(
+            "scenario_slo_breach",
+            reason=f"replay of trace {trace.name!r} breached its "
+                   f"{'SLO' if not slo_verdict['ok'] else 'gate'}",
+            details={"trace": trace.name, "generator": trace.generator,
+                     "seed": trace.seed, "speed": speed,
+                     "stats": stats,
+                     "slo_breaches": slo_verdict["breaches"],
+                     "gate_breaches": gate_verdict["breaches"]})
 
     live = hub.list_pods()
     audit = audit_bind_journal(

@@ -54,6 +54,7 @@ from kubernetes_tpu.framework.interface import (
 from kubernetes_tpu.framework.runtime import Framework
 from kubernetes_tpu.framework.interface import Code
 from kubernetes_tpu.framework.waiting import WaitingPod
+from kubernetes_tpu import telemetry
 from kubernetes_tpu.hub import EventHandlers, Fenced, Hub, Unavailable
 from kubernetes_tpu.storage import RvTooOld
 from kubernetes_tpu.utils.backoff import Backoff
@@ -266,6 +267,32 @@ class Scheduler:
         # by the operator/harness; serving exposes /debug/fleet and the
         # merged /metrics/fleet exposition when set
         self.fleet = None
+        # SLO watchdog + incident autopsy (telemetry/watchdog.py,
+        # telemetry/autopsy.py): breach rules polled at the end of every
+        # maintenance window; containment sites raise incidents directly
+        # through telemetry.incident(). The watchdog always runs (a
+        # handful of comparisons per window); black-box bundle capture
+        # needs config.autopsy_dir.
+        from kubernetes_tpu.telemetry.watchdog import Watchdog
+
+        self.autopsy = None
+        _autopsy_dir = getattr(self.config, "autopsy_dir", None)
+        if _autopsy_dir:
+            from kubernetes_tpu.telemetry.autopsy import AutopsyStore
+
+            self.autopsy = AutopsyStore(
+                _autopsy_dir,
+                max_bundles=getattr(self.config,
+                                    "autopsy_max_bundles", 32),
+                max_bytes=getattr(self.config, "autopsy_max_bytes",
+                                  16 * 1024 * 1024),
+                rate_limit_s=getattr(self.config,
+                                     "autopsy_rate_limit_s", 30.0),
+                now=now, metrics=self.metrics)
+        self.watchdog = Watchdog(
+            self, store=self.autopsy,
+            interval_s=getattr(self.config, "watchdog_interval_s", 5.0),
+            now=now)
         # gate opener of last resort: a flush that deleted nothing (empty
         # or already-gone victim sets) fires no cluster event, so the
         # evaluator re-activates those preemptors directly
@@ -966,6 +993,8 @@ class Scheduler:
         if not self._hub_down:
             logger.warning(
                 "hub unreachable: entering degraded mode (parking work)")
+            telemetry.incident(self, "hub_degraded",
+                               reason="hub unreachable; parking work")
         self._hub_down = True
 
     def _park_unreachable(self, qp: QueuedPodInfo) -> None:
@@ -1100,6 +1129,8 @@ class Scheduler:
         logger.warning(
             "device path failed for a %d-pod batch (%r); degrading to "
             "the host fallback path", len(runnable), exc)
+        telemetry.incident(self, "device_fallback",
+                           reason=repr(exc), pods=len(runnable))
         pending = self._still_pending(runnable)
         # pods _dispatch deferred before raising (profile split, host
         # volume conflicts) are still in flight via _deferred — the next
@@ -1404,6 +1435,8 @@ class Scheduler:
             self._gang.poison(gang, reason, uid)
         logger.error("quarantining pod %s for %.0fs (offense %d): %s",
                      qp.pod.key(), backoff, n, reason)
+        telemetry.incident(self, "quarantine", reason=reason,
+                           pod=qp.pod.key(), offense=n)
         try:
             self.hub.record_event(
                 "Pod", qp.pod.key(), "Quarantined",
@@ -2489,8 +2522,11 @@ class Scheduler:
         mutation stays on the loop thread) and takes no locks; exceptions
         (including the chaos commit_pull seam) surface in _finish via
         fut.result() and ride the normal containment ladder. Returns
-        (vals, t_ready) — t_ready timestamps verdict availability, the
-        honest end of the device span."""
+        (vals, t_ready, pull_s) — t_ready timestamps verdict
+        availability (the honest end of the device span); pull_s is this
+        thread's own wall inside the pull, booked by _finish as the
+        overlapped commit_pull phase when it ran off-thread."""
+        t_pull0 = self.now()
         learned_on, exporting, want_feats, want_alts = flags
         fi = self.fault_injector
         if fi is not None:
@@ -2508,7 +2544,8 @@ class Scheduler:
                 pull.append(out.alt_row)
                 pull.append(out.alt_score)
         vals = jax.device_get(tuple(pull))
-        return vals, self.now()
+        t_ready = self.now()
+        return vals, t_ready, t_ready - t_pull0
 
     def _finish(self, inflight: tuple) -> None:
         """Pull one dispatched launch's results and commit/fail each pod."""
@@ -2524,10 +2561,14 @@ class Scheduler:
             # off-thread commit: the pull has been running on the commit
             # thread since dispatch; a commit-thread exception re-raises
             # HERE and rides the same _finish_contained blast-radius
-            # ladder an inline fault would
-            vals, t_ready = fut.result()
+            # ladder an inline fault would. wait_s is the loop thread's
+            # ACTUAL blocked time — the wave's serial cost; the commit
+            # thread's pull span (pull_s) overlapped loop-thread work.
+            vals, t_ready, pull_s = fut.result()
+            wait_s = max(self.now() - t0, 0.0)
         else:
-            vals, t_ready = self._pull_launch(out, flags)
+            vals, t_ready, pull_s = self._pull_launch(out, flags)
+            wait_s = None
         # PreFilter gang-capacity reductions cannot ride the commit
         # thread's pull (they register on the loop thread, possibly
         # after dispatch); rare — gang PreFilter only — so they get
@@ -2655,7 +2696,18 @@ class Scheduler:
             tr.add("failure_handling", self.now() - t_commit1)
         commit_s = self.now() - t1
         cycle_s = pack_s + launch_s + commit_s
-        tr.add("device_launch", launch_s)
+        if wait_s is None:
+            # inline pull (pipelining off): the loop thread was blocked
+            # for the whole device span — all of it is serial cost
+            tr.add("device_launch", launch_s)
+        else:
+            # pipelined arm: only the harvest wait serialized the loop
+            # thread; the commit thread's pull span is recorded as the
+            # overlapped commit_pull view (excluded from totals/host-tail
+            # like VIEW_PHASES) so /debug/trace keeps the attribution
+            # without booking concurrent wall time as if serial
+            tr.add("device_launch", wait_s)
+            tr.add("commit_pull", pull_s)
         if self.profiler is not None and pshape is not None:
             self.profiler.observe_walltime(pshape, launch_s)
             if compiled:
@@ -2752,6 +2804,12 @@ class Scheduler:
             # whole device batch down the host-fallback ladder — the
             # pod is placed and theirs; drop our attempt exactly like
             # _undo_commit's foreign-confirm path
+            if self.flight.enabled:
+                self.timelines.event(
+                    qp.pod, "foreign_bound",
+                    f"confirmed on "
+                    f"{self.cache.get_pod(assumed).spec.node_name} "
+                    f"by a sibling replica (pre-commit)")
             self._invalidate_chain()
             self.queue.done(qp.uid)
             return
@@ -2845,6 +2903,12 @@ class Scheduler:
             # would raise ("confirmed, cannot forget") and requeueing
             # would re-schedule a bound pod. Drop our claim instead,
             # exactly like _finish_fenced's foreign-confirm path.
+            if self.flight.enabled:
+                self.timelines.event(
+                    qp.pod, "foreign_bound",
+                    f"confirmed on "
+                    f"{self.cache.get_pod(assumed).spec.node_name} "
+                    f"by a sibling replica (undo-commit)")
             self._invalidate_chain()
             self.queue.done(qp.uid)
             return
@@ -3037,6 +3101,10 @@ class Scheduler:
         it from our queue like any foreign placement."""
         self.stats["fenced"] += 1
         self.metrics.fenced_writes.inc(verb="bind")
+        telemetry.incident(self, "fenced_bind",
+                           reason="in-flight bind rejected by fencing "
+                                  "(leadership deposed)",
+                           pod=qp.pod.key(), node=node_name)
         try:
             self._fw_for(qp.pod).run_unreserve_plugins(state, qp.pod,
                                                        node_name)
@@ -3046,6 +3114,13 @@ class Scheduler:
             # the new leader's bind of this pod already CONFIRMED through
             # our informer (add_pod replaced the assumed state): the pod
             # is theirs, placed and cached — nothing to forget or requeue
+            if self.flight.enabled:
+                cached = self.cache.get_pod(assumed)
+                self.timelines.event(
+                    qp.pod, "foreign_bound",
+                    f"confirmed on "
+                    f"{cached.spec.node_name if cached else '?'} "
+                    f"by the new leader (fenced)")
             self.queue.done(qp.uid)
             return
         self.cache.forget_pod(assumed)
@@ -3234,17 +3309,29 @@ class Scheduler:
                     self._stash_foreign(pod)
             adopted = [p for p in self._foreign.values()
                        if self._owns_pod(p)]
+            n_adopted = 0
             for pod in adopted:
                 del self._foreign[pod.metadata.uid]
                 if pod.spec.node_name or self._terminal(pod) \
                         or self._quarantine_holds(pod):
                     continue
                 self.stats["foreign_adopted"] += 1
+                n_adopted += 1
                 self._enqueue_fresh(pod)
             # ownership moved: any device-resident chain may reflect
             # binds we are no longer racing for — resync conservatively
             self._invalidate_chain()
             self.stats["slice_rebalances"] += 1
+            if n_adopted:
+                # pods re-homed here mid-flight: a peer lost its slices
+                # (deposed or dead) and this replica inherited live work
+                # — the scale-out incident worth a black box
+                telemetry.incident(
+                    self, "slice_reparent",
+                    reason=f"adopted {n_adopted} pending pod(s) on "
+                           f"ring generation {sm.generation}",
+                    adopted=n_adopted, generation=sm.generation,
+                    ring_epoch=sm.ring_epoch)
 
     def run_maintenance(self) -> None:
         """The background timers the reference runs as goroutines: 1s
@@ -3291,6 +3378,10 @@ class Scheduler:
             self.metrics.cache_size.set(self.cache.assumed_pod_count(),
                                         type="assumed_pods")
             self._export_resilience_metrics()
+            # LAST: the watchdog reads the counters/stats everything
+            # above just finished updating (self-throttled to
+            # watchdog_interval_s, so most ticks cost one comparison)
+            self.watchdog.poll()
 
     def _drain_assumed_requeue(self) -> None:
         """Requeue expired assumed pods whose hub-side object is still
@@ -3376,6 +3467,11 @@ class Scheduler:
         logger.warning("drift sentinel: %d cache-vs-hub discrepancies "
                        "(strike %d): %s", n, self._drift_strikes,
                        report.render()[:5])
+        telemetry.incident(self, "drift",
+                           reason=f"{n} cache-vs-hub discrepancies "
+                                  f"(strike {self._drift_strikes})",
+                           discrepancies=n, strike=self._drift_strikes,
+                           sample=report.render()[:5])
         try:
             repaired = self.cache.repair_from_hub(self.hub, report)
         except Unavailable:
@@ -3394,6 +3490,11 @@ class Scheduler:
                          "targeted repairs; rebuilding mirror + snapshot",
                          self._drift_strikes)
             self.metrics.drift_rebuilds.inc()
+            telemetry.incident(
+                self, "drift_rebuild",
+                reason=f"persistent drift after "
+                       f"{self._drift_strikes} targeted repairs",
+                strikes=self._drift_strikes)
             self.mirror = Mirror(caps=self.caps, mesh=self.mesh)
             self.snapshot = Snapshot()
             self.cache.update_snapshot(self.snapshot)
@@ -3469,6 +3570,12 @@ class Scheduler:
             "parked best-effort tenants %s",
             int(rate), cfg.brownout_throttle_threshold, cfg.batch_size,
             self._effective_batch(), self.drift_check_interval, parked)
+        telemetry.incident(
+            self, "brownout_enter",
+            reason=f"{int(rate)} hub throttles in the last window "
+                   f"(threshold {cfg.brownout_throttle_threshold})",
+            throttles=int(rate),
+            effective_batch=self._effective_batch(), parked=parked)
 
     def _exit_brownout(self) -> None:
         self.brownout = False

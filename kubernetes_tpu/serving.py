@@ -220,6 +220,30 @@ class ServingEndpoints:
                         payload["scheduler_brownout"] = bs_fn()
                     body = json.dumps(payload, indent=2,
                                       default=str)
+                elif path == "/debug/autopsy":
+                    # incident black boxes: the bundle listing, or one
+                    # parsed bundle (?name=). 404 without a store —
+                    # capture is opt-in via config.autopsy_dir
+                    store = getattr(sched, "autopsy", None)
+                    if store is None:
+                        self._send(404, "no autopsy store configured "
+                                        "(set config.autopsy_dir)")
+                        return
+                    name = query.get("name", [""])[0]
+                    if name:
+                        try:
+                            payload = store.load(name)
+                        except (OSError, ValueError) as e:
+                            self._send(404, f"bundle unreadable: {e}")
+                            return
+                    else:
+                        wd = getattr(sched, "watchdog", None)
+                        payload = {
+                            "dir": store.directory,
+                            "incidents": getattr(wd, "incidents", 0),
+                            "bundles": store.list(),
+                        }
+                    body = json.dumps(payload, indent=2, default=str)
                 elif path == "/debug/pod":
                     timelines = getattr(sched, "timelines", None)
                     if timelines is None:

@@ -24,4 +24,17 @@ views, not per-component counters):
 
 from kubernetes_tpu.telemetry.trace import TraceContext, new_context
 
-__all__ = ["TraceContext", "new_context"]
+
+def incident(sched, kind: str, reason: str = "", **details) -> None:
+    """Raise one incident on ``sched``'s watchdog (telemetry/watchdog):
+    the direct hook the ~8 containment sites call when they fire, so
+    the black-box bundle freezes the evidence THE CYCLE the fault
+    happened instead of waiting for the next maintenance poll. A no-op
+    before the watchdog attaches (early init, bare test schedulers) and
+    never raises — containment paths call this mid-recovery."""
+    wd = getattr(sched, "watchdog", None)
+    if wd is not None:
+        wd.incident(kind, reason=reason, details=details or None)
+
+
+__all__ = ["TraceContext", "new_context", "incident"]

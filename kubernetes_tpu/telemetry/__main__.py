@@ -1,0 +1,151 @@
+"""The telemetry CLI: ``python -m kubernetes_tpu.telemetry autopsy ...``
+
+Offline incident forensics over an autopsy bundle directory
+(config.autopsy_dir — the black boxes the SLO watchdog files):
+
+* ``autopsy list --dir D`` — one row per bundle (seq, trigger class,
+  reason, size); torn files are listed with their error.
+* ``autopsy show --dir D NAME [--section S]`` — one parsed bundle (or
+  one section of it), strict: a torn bundle exits non-zero.
+* ``autopsy diff --dir D A B`` — stats-counter / phase-p99 / SLO-stat
+  deltas between two bundles.
+* ``autopsy critical-path --dir D NAME [--pod NS/NAME]`` — per-pod
+  span breakdown (created → queued → popped → bound → acked) from the
+  bundle's timelines, wait time attributed to queue / device / binder
+  / fabric legs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _fmt_ms(v) -> str:
+    return "-" if v is None else f"{v:.1f}ms"
+
+
+def _cmd_list(args) -> int:
+    from kubernetes_tpu.telemetry.autopsy import list_bundles
+
+    rows = list_bundles(args.dir)
+    if args.json:
+        print(json.dumps(rows, indent=2, default=str))
+        return 0
+    if not rows:
+        print(f"no bundles under {args.dir}")
+        return 0
+    for r in rows:
+        if "error" in r:
+            print(f"{r['name']}  UNREADABLE: {r['error']}")
+            continue
+        print(f"{r['name']}  seq={r['seq']} kind={r['kind']} "
+              f"rule={r.get('rule') or '-'} bytes={r['bytes']}  "
+              f"{r.get('reason') or ''}")
+    return 0
+
+
+def _load(args, name: str):
+    import os
+
+    from kubernetes_tpu.telemetry.autopsy import load_bundle
+
+    path = name if os.sep in name else os.path.join(args.dir, name)
+    return load_bundle(path)
+
+
+def _cmd_show(args) -> int:
+    doc = _load(args, args.name)
+    if args.section:
+        if args.section not in doc:
+            print(f"no section {args.section!r} "
+                  f"(have: {', '.join(sorted(doc))})", file=sys.stderr)
+            return 1
+        doc = doc[args.section]
+    print(json.dumps(doc, indent=2, default=str))
+    return 0
+
+
+def _cmd_diff(args) -> int:
+    from kubernetes_tpu.telemetry.autopsy import diff_bundles
+
+    print(json.dumps(diff_bundles(_load(args, args.a),
+                                  _load(args, args.b)),
+                     indent=2, default=str))
+    return 0
+
+
+def _cmd_critical_path(args) -> int:
+    from kubernetes_tpu.telemetry.autopsy import critical_path
+
+    doc = _load(args, args.name)
+    timelines = doc.get("timelines") or []
+    if args.pod:
+        timelines = [
+            t for t in timelines
+            if f"{t.get('namespace')}/{t.get('name')}" == args.pod
+            or t.get("name") == args.pod or t.get("uid") == args.pod]
+        if not timelines:
+            print(f"pod {args.pod!r} not in this bundle's timelines",
+                  file=sys.stderr)
+            return 1
+    reports = [critical_path(t) for t in timelines]
+    if args.json:
+        print(json.dumps(reports, indent=2, default=str))
+        return 0
+    for rep in reports:
+        print(f"{rep['pod']}  total={_fmt_ms(rep['total_ms'])}  "
+              + " ".join(f"{k}={v:.1f}ms"
+                         for k, v in rep["attributed_ms"].items()))
+        for leg in rep["legs"]:
+            print(f"  {leg['leg']:<12} {leg['ms']:>9.3f}ms  "
+                  f"[{leg['attribution']}]  "
+                  f"{leg['from']} -> {leg['to']}")
+        if rep["missing"]:
+            print(f"  (missing legs: {', '.join(rep['missing'])})")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m kubernetes_tpu.telemetry")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    aut = sub.add_parser("autopsy", help="incident bundle forensics")
+    asub = aut.add_subparsers(dest="autopsy_cmd", required=True)
+
+    p = asub.add_parser("list", help="list bundles in a store dir")
+    p.add_argument("--dir", required=True)
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=_cmd_list)
+
+    p = asub.add_parser("show", help="print one parsed bundle")
+    p.add_argument("name")
+    p.add_argument("--dir", default=".")
+    p.add_argument("--section",
+                   help="print one top-level section only")
+    p.set_defaults(fn=_cmd_show)
+
+    p = asub.add_parser("diff", help="delta between two bundles")
+    p.add_argument("a")
+    p.add_argument("b")
+    p.add_argument("--dir", default=".")
+    p.set_defaults(fn=_cmd_diff)
+
+    p = asub.add_parser("critical-path",
+                        help="per-pod span breakdown from a bundle")
+    p.add_argument("name")
+    p.add_argument("--dir", default=".")
+    p.add_argument("--pod", help="ns/name, name, or uid filter")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=_cmd_critical_path)
+
+    args = ap.parse_args(argv)
+    try:
+        return args.fn(args)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,270 @@
+"""Always-on SLO watchdog: declarative breach rules over live signals.
+
+Runs on the scheduler's maintenance cadence (``Watchdog.poll`` at the
+end of ``run_maintenance``, self-throttled to ``watchdog_interval_s``)
+and evaluates a small rule set over signals the system already
+produces — no new instrumentation on the hot path:
+
+* :class:`SloRule` — live time-to-bind percentiles from
+  ``PodTimelines`` (telemetry/slo.py) against ``config.watchdog_slo``.
+* :class:`CounterDeltaRule` — deltas on health counters that have no
+  direct containment hook: 429 sheds (``hub_client_throttled``), watch
+  relists, surviving cycle crashes.
+* :class:`UnattributedCompileRule` — DeviceProfiler compiles the
+  bucket ladder cannot explain (the "why did that launch stall" class).
+* :class:`FleetUnhealthyRule` — FleetView component health (its own
+  longer cadence: a fleet scrape is live HTTP).
+
+A trip raises an *incident*: counted per class in
+``scheduler_watchdog_incidents_total`` and — when an
+:class:`~kubernetes_tpu.telemetry.autopsy.AutopsyStore` is attached —
+captured as a black-box bundle (rate-limited per class by the store).
+Containment sites raise incidents DIRECTLY through
+``telemetry.incident(sched, kind, ...)`` (device fallback, quarantine,
+brownout, drift, fenced bind, hub-degraded, slice reparent): the event
+is the trigger, no polling delay, the bundle freezes the evidence the
+very cycle it fired.
+
+The watchdog holds no thread and takes no locks of its own — poll()
+runs under the scheduler lock like the rest of maintenance, and
+``incident`` never raises (a broken autopsy must not take down the
+containment path it observes).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Optional
+
+logger = logging.getLogger("kubernetes_tpu.watchdog")
+
+# FleetView scrapes are live HTTP across every fabric component — poll
+# them far less often than the cheap in-process rules
+FLEET_RULE_MIN_INTERVAL_S = 30.0
+
+
+class Rule:
+    """One declarative breach rule. ``evaluate`` returns a list of trip
+    dicts ({"kind", "reason", "details"}); the watchdog stamps the rule
+    name and routes each trip through the incident path."""
+
+    name = "rule"
+    min_interval_s = 0.0
+
+    def evaluate(self, sched) -> list[dict]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class SloRule(Rule):
+    """Live time-to-bind stats vs the configured SLO dict. Gated on a
+    minimum bound-pod count so a cold start's empty percentiles never
+    breach; re-trips every poll while the breach persists (the autopsy
+    store's per-class rate limit keeps the bundle count bounded)."""
+
+    name = "slo"
+
+    def __init__(self, slo: dict, min_binds: int = 8):
+        self.slo = dict(slo)
+        self.min_binds = max(0, min_binds)
+
+    def evaluate(self, sched) -> list[dict]:
+        if not self.slo:
+            return []
+        from kubernetes_tpu.telemetry.slo import (evaluate_slo,
+                                                  time_to_bind_stats)
+
+        stats = time_to_bind_stats(sched.timelines)
+        if stats["count"] < self.min_binds:
+            return []
+        verdict = evaluate_slo(stats, self.slo)
+        if verdict["ok"]:
+            return []
+        worst = verdict["breaches"][0]
+        return [{"kind": "slo_breach",
+                 "reason": f"{worst['metric']}={worst['value']} "
+                           f"over limit {worst['limit']}",
+                 "details": {"stats": stats,
+                             "breaches": verdict["breaches"]}}]
+
+
+class CounterDeltaRule(Rule):
+    """Fires when a watched counter moved since the previous poll.
+    Covers the containment signals that have NO direct incident hook
+    (429 sheds happen inside the hub client, relists inside the
+    informer, crashes inside the daemon wrapper) — the hooked sites
+    (fallback/quarantine/brownout/drift/fence) are deliberately absent
+    so one fault never double-fires."""
+
+    def __init__(self, name: str, kind: str,
+                 read: Callable[..., float]):
+        self.name = name
+        self.kind = kind
+        self._read = read
+        self._last: Optional[float] = None
+
+    def evaluate(self, sched) -> list[dict]:
+        try:
+            cur = float(self._read(sched))
+        except Exception:  # noqa: BLE001 — a missing counter is not an
+            return []                            # incident
+        last, self._last = self._last, cur
+        if last is None or cur <= last:
+            return []
+        return [{"kind": self.kind,
+                 "reason": f"{self.name} advanced by {cur - last:g} "
+                           f"(now {cur:g})",
+                 "details": {"counter": self.name, "delta": cur - last,
+                             "value": cur}}]
+
+
+class UnattributedCompileRule(Rule):
+    """DeviceProfiler compiles with no attributed cause: every compile
+    should be explained by first-touch, re-bucketing, gang/batch bucket
+    growth, or a flags change — an unattributed one means an unknown
+    recompile source is eating launch walltime."""
+
+    name = "unattributed_compile"
+
+    def __init__(self):
+        self._last: Optional[int] = None
+
+    def evaluate(self, sched) -> list[dict]:
+        prof = getattr(sched, "profiler", None)
+        if prof is None:
+            return []
+        cur = int(getattr(prof, "compile_causes", {})
+                  .get("unattributed", 0))
+        last, self._last = self._last, cur
+        if last is None or cur <= last:
+            return []
+        return [{"kind": "unattributed_compile",
+                 "reason": f"{cur - last} unattributed XLA compile(s) "
+                           f"(total {cur})",
+                 "details": {"delta": cur - last, "total": cur}}]
+
+
+class FleetUnhealthyRule(Rule):
+    """FleetView says a fabric component failed healthz or its scrape —
+    the one rule that does live HTTP, so it carries its own (longer)
+    minimum interval on top of the watchdog cadence."""
+
+    name = "fleet"
+    min_interval_s = FLEET_RULE_MIN_INTERVAL_S
+
+    def evaluate(self, sched) -> list[dict]:
+        fleet = getattr(sched, "fleet", None)
+        if fleet is None:
+            return []
+        try:
+            summary = fleet.summary()
+        except Exception:  # noqa: BLE001 — a fleet view that cannot
+            return []      # even summarize is the hub-degraded story
+        if summary.get("ok", True):
+            return []
+        bad = [f"{e.get('component')}@{e.get('url')}"
+               for e in summary.get("endpoints", [])
+               if not e.get("healthy", True) or e.get("error")]
+        return [{"kind": "fleet_unhealthy",
+                 "reason": f"{summary.get('healthy', '?')}/"
+                           f"{summary.get('total', '?')} components "
+                           f"healthy",
+                 "details": {"unhealthy": bad, "summary": summary}}]
+
+
+def default_rules(config) -> list[Rule]:
+    """The stock rule set for one scheduler config (the README's rule
+    catalog). Counter reads go through the metrics registry so they see
+    exactly what /metrics exports."""
+    return [
+        SloRule(getattr(config, "watchdog_slo", {}) or {},
+                getattr(config, "watchdog_min_binds", 8)),
+        CounterDeltaRule(
+            "hub_client_throttled_total", "throttle_shed",
+            lambda s: s.metrics.hub_client_throttled.value()),
+        CounterDeltaRule(
+            "hub_watch_relists_total", "watch_relist",
+            lambda s: s.metrics.hub_watch_relists.value()),
+        CounterDeltaRule(
+            "scheduler_cycle_crashes_total", "cycle_crash",
+            lambda s: s.metrics.cycle_crashes.value()),
+        UnattributedCompileRule(),
+        FleetUnhealthyRule(),
+    ]
+
+
+class Watchdog:
+    """The scheduler's breach detector + incident router. Constructed
+    unconditionally (it is a handful of comparisons per maintenance
+    window); the autopsy store attaches only when ``autopsy_dir`` is
+    configured."""
+
+    def __init__(self, sched, rules: Optional[list[Rule]] = None,
+                 store=None, interval_s: float = 5.0,
+                 now: Callable[[], float] = time.time):
+        self.sched = sched
+        self.rules = rules if rules is not None \
+            else default_rules(sched.config)
+        self.store = store
+        self.interval_s = max(0.0, interval_s)
+        self._now = now
+        self._last_poll: Optional[float] = None
+        self._last_by_rule: dict[str, float] = {}
+        self.incidents = 0
+
+    def poll(self) -> int:
+        """Evaluate the rule set (at most once per interval); returns
+        the number of trips raised this evaluation."""
+        now = self._now()
+        if self._last_poll is not None \
+                and now - self._last_poll < self.interval_s:
+            return 0
+        self._last_poll = now
+        m = getattr(self.sched, "metrics", None)
+        if m is not None:
+            m.watchdog_evals.inc()
+        trips = 0
+        for rule in self.rules:
+            if rule.min_interval_s:
+                last = self._last_by_rule.get(rule.name)
+                if last is not None \
+                        and now - last < rule.min_interval_s:
+                    continue
+                self._last_by_rule[rule.name] = now
+            try:
+                hits = rule.evaluate(self.sched)
+            except Exception:  # noqa: BLE001 — one broken rule must
+                # not starve the rest of the set (or maintenance)
+                logger.exception("watchdog rule %s raised", rule.name)
+                continue
+            for hit in hits:
+                trips += 1
+                if m is not None:
+                    m.watchdog_rules_tripped.inc(rule=rule.name)
+                self.incident(hit.get("kind", rule.name),
+                              reason=hit.get("reason", ""),
+                              rule=rule.name,
+                              details=hit.get("details"))
+        return trips
+
+    def incident(self, kind: str, reason: str = "", rule: str = "",
+                 details: Optional[dict] = None) -> None:
+        """Raise one incident: count it, and (when a store is attached)
+        capture a black-box bundle. Never raises — containment sites
+        call this mid-recovery."""
+        try:
+            self.incidents += 1
+            m = getattr(self.sched, "metrics", None)
+            if m is not None:
+                m.watchdog_incidents.inc(kind=kind)
+            if self.store is None:
+                return
+            from kubernetes_tpu.telemetry.autopsy import collect_bundle
+
+            trigger = {"kind": kind, "reason": reason, "rule": rule}
+            if details is not None:
+                trigger["details"] = details
+            self.store.capture(
+                trigger, lambda: collect_bundle(self.sched, trigger))
+        except Exception:  # noqa: BLE001 — observability must not
+            logger.exception("incident handling failed (%s)", kind)

@@ -80,6 +80,13 @@ CYCLE_PHASES = (
                           # the gang analog of device_launch)
     "gang_commit",        # host commit of device-placed gang units
                           # (reserve-all -> bind-all, atomic rollback)
+    "commit_pull",        # pipelined waves only: the commit thread's
+                          # device pull, measured on the commit thread
+                          # (overlap view: that wall time runs CONCURRENT
+                          # with the loop thread's next dispatch, so it is
+                          # excluded from totals/host-tail — the loop
+                          # thread's actual blocked wait lands in
+                          # device_launch)
 )
 
 # the dra_* attribution views, excluded from total/host-tail arithmetic
@@ -91,6 +98,16 @@ DRA_VIEW_PHASES = ("dra_mask_compile", "dra_device_eval", "dra_commit")
 # measures the checkpoint poll), so hiding it would let a slow reload
 # path pass the --ab-scorer parity gate unseen
 VIEW_PHASES = DRA_VIEW_PHASES + ("device_compile",)
+
+# phases measured on the commit thread, CONCURRENT with loop-thread
+# work. Counting them in totals/host-tail would book overlapped wall
+# time as if serial (the pipelined arm's host-tail share over-reported
+# before these were split out). Like VIEW_PHASES they still render in
+# /debug/trace and phase_percentiles — they are attribution, not cost.
+OVERLAP_PHASES = ("commit_pull",)
+
+# everything excluded from the serial-cycle-time arithmetic
+EXCLUDED_PHASES = VIEW_PHASES + OVERLAP_PHASES
 
 # trace-export JSON-lines format version (CycleTrace.to_dict "v"):
 # v2 added per-pod placement rows (pod, chosen node, aggregate score,
@@ -196,9 +213,10 @@ class CycleTrace:
         self.phases[phase] = self.phases.get(phase, 0.0) + secs
 
     def total(self) -> float:
-        # the view phases double-count time inside the real phases
+        # view phases double-count time inside the real phases; overlap
+        # phases ran on the commit thread concurrent with the loop
         return sum(v for k, v in self.phases.items()
-                   if k not in VIEW_PHASES)
+                   if k not in EXCLUDED_PHASES)
 
     def to_dict(self) -> dict:
         d = {
@@ -462,7 +480,7 @@ class FlightRecorder:
         host = total = 0.0
         for k in list(h._series):
             phase = dict(k).get("phase", "?")
-            if phase in VIEW_PHASES:
+            if phase in EXCLUDED_PHASES:
                 continue
             s = h._series.get(k)
             if not s:

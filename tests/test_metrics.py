@@ -302,11 +302,29 @@ def test_full_exposition_round_trips_strict_parser():
     m.pod_e2e_duration.observe(0.5, attempts="2")
     m.device_compiles.inc(cause="rebucket")
     m.device_live_buffer_bytes.set(1024.0, buffer="cluster")
+    # the watchdog/autopsy family (ISSUE-20) rides the same exposition
+    m.watchdog_evals.inc()
+    m.watchdog_incidents.inc(kind="slo_breach")
+    m.watchdog_rules_tripped.inc(rule="slo")
+    m.autopsy_bundles.inc(trigger="device_fallback")
+    m.autopsy_bundles_dropped.inc(reason="rate_limited")
+    m.autopsy_store_bytes.set(2048.0)
     exp = parse_exposition(m.registry.render_text())
     names = {s.name for s in exp.samples}
     assert "scheduler_device_compiles_total" in names
     assert "scheduling_phase_duration_seconds_bucket" in names
     assert "pending_pods" in names
+    assert "scheduler_watchdog_evals_total" in names
+    assert "scheduler_autopsy_store_bytes" in names
+    assert any(s.name == "scheduler_watchdog_incidents_total"
+               and s.labels.get("kind") == "slo_breach"
+               for s in exp.samples)
+    assert any(s.name == "scheduler_autopsy_bundles_total"
+               and s.labels.get("trigger") == "device_fallback"
+               for s in exp.samples)
+    assert any(s.name == "scheduler_autopsy_bundles_dropped_total"
+               and s.labels.get("reason") == "rate_limited"
+               for s in exp.samples)
     # the nasty label survived the escape/unescape round trip
     assert any(s.labels.get("result") == 'nasty "quotes" and '
                "\\slashes\n" for s in exp.samples)

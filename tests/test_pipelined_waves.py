@@ -162,6 +162,49 @@ def test_occupancy_stat_recorded():
         s.close()
 
 
+def test_pipelined_commit_pull_attribution():
+    """Host-tail attribution under pipelined waves (ISSUE 20 satellite):
+    pipelined cycles book the commit thread's device pull as the
+    overlapped "commit_pull" phase, device_launch carries only the loop
+    thread's blocked wait, and neither the cycle total nor occupancy
+    double-counts the pull. The strict-alternation arm books no
+    commit_pull at all (the pull runs inline inside device_launch)."""
+    for pipelined in (True, False):
+        hub = mkcluster()
+        s = mksched(hub, pipelined=pipelined, batch=8)
+        try:
+            for i in range(48):
+                hub.create_pod(MakePod().name(f"p-{i}")
+                               .req(cpu="100m", memory="64Mi").obj())
+            s.run_until_idle()
+            cycles = [c for c in s.flight.last(400) if c.get("pods")]
+            assert cycles
+            pulled = [c for c in cycles
+                      if "commit_pull" in c.get("phases_ms", {})]
+            if not pipelined:
+                assert not pulled
+                continue
+            # pipelined cycles past the first dispatch ride the chain
+            assert pulled, "no pipelined cycle booked a commit_pull"
+            for c in pulled:
+                ph = c["phases_ms"]
+                # the exported total sums the booked phases WITHOUT the
+                # overlap (and without the dra_*/compile views)
+                from kubernetes_tpu.utils.tracing import EXCLUDED_PHASES
+                booked = sum(v for k, v in ph.items()
+                             if k not in EXCLUDED_PHASES)
+                # phases_ms round per-phase to 3 decimals, total_ms
+                # rounds once — allow half-ulp per booked phase
+                assert abs(c["total_ms"] - booked) < 0.0005 * (len(ph) + 1)
+                assert ph["commit_pull"] >= 0.0
+                # occupancy stays a fraction of the cycle wall even
+                # though the pull overlapped it
+                if c.get("occupancy") is not None:
+                    assert 0.0 <= c["occupancy"] <= 1.0
+        finally:
+            s.close()
+
+
 # ---------------- zero-recompile gate (satellite 3) ----------------
 
 

@@ -525,6 +525,13 @@ def test_undo_commit_survives_foreign_confirm_race():
         # NOT requeued for a re-schedule of an already-bound pod
         assert sched.cache.get_pod(assumed).spec.node_name == "n2"
         assert sched.queue.pop_batch(8) == []
+        # the timeline tells the story: this pod's /debug/pod (and any
+        # autopsy bundle) shows WHO bound it, not a silent drop
+        tl = sched.timelines.get(uid=pod.metadata.uid)
+        evs = [e for e in tl["events"] if e["event"] == "foreign_bound"]
+        assert len(evs) == 1
+        assert "n2" in evs[0]["detail"]
+        assert "undo-commit" in evs[0]["detail"]
     finally:
         sched.close()
         hub.close()
@@ -556,6 +563,12 @@ def test_commit_drops_attempt_when_foreign_bind_confirmed_first():
         assert not sched.cache.is_assumed_pod(assumed)
         assert sched.cache.get_pod(foreign).spec.node_name == "n2"
         assert sched.queue.pop_batch(8) == []
+        # the pre-commit drop stamps the same foreign_bound story
+        tl = sched.timelines.get(uid=pod.metadata.uid)
+        evs = [e for e in tl["events"] if e["event"] == "foreign_bound"]
+        assert len(evs) == 1
+        assert "n2" in evs[0]["detail"]
+        assert "pre-commit" in evs[0]["detail"]
     finally:
         sched.close()
         hub.close()

@@ -175,6 +175,39 @@ def test_host_tail_share():
     assert abs(rec.host_tail_share() - 0.4) < 1e-9
 
 
+def test_commit_pull_overlap_excluded_from_total_and_tail():
+    """Pipelined waves: the commit thread's device pull is booked as the
+    "commit_pull" overlap phase — rendered per cycle, but excluded from
+    total() and host_tail_share(); device_launch carries only the loop
+    thread's actual blocked wait. Before the split the pull landed in
+    device_launch on the pipelined arm, counting overlapped commit-thread
+    time as if the loop had been stalled on it."""
+    from kubernetes_tpu.utils.tracing import (
+        EXCLUDED_PHASES,
+        OVERLAP_PHASES,
+        VIEW_PHASES,
+    )
+
+    assert "commit_pull" in CYCLE_PHASES
+    assert "commit_pull" in OVERLAP_PHASES
+    assert set(EXCLUDED_PHASES) == set(VIEW_PHASES) | set(OVERLAP_PHASES)
+    phase, _ = _hists()
+    rec = FlightRecorder(phase_hist=phase)
+    tr = rec.begin(start=0.0, pods=1)
+    tr.add("host_plugins", 0.03)           # host
+    tr.add("device_launch", 0.06)          # loop-thread blocked wait
+    tr.add("commit", 0.01)                 # host
+    tr.add("commit_pull", 0.05)            # commit-thread pull: overlap
+    rec.record(tr)
+    # the pull never inflates the cycle total...
+    assert abs(tr.total() - 0.10) < 1e-12
+    assert tr.to_dict()["total_ms"] == 100.0
+    # ...or the host-tail attribution...
+    assert abs(rec.host_tail_share() - 0.4) < 1e-9
+    # ...but still renders per cycle for /debug/trace readers
+    assert tr.to_dict()["phases_ms"]["commit_pull"] == 50.0
+
+
 def test_recorder_jsonl_export(tmp_path):
     path = str(tmp_path / "trace.jsonl")
     rec = FlightRecorder(capacity=8, export_path=path)

@@ -1,0 +1,492 @@
+"""SLO watchdog + incident autopsy (ISSUE 20).
+
+Rule units (SLO breach / counter delta / unattributed compile / fleet
+health), the watchdog's poll cadence + incident routing, the autopsy
+store's rate-limit / retention / atomic-write contract including torn
+readers, the ``python -m kubernetes_tpu.telemetry autopsy`` CLI over
+fixture bundles, per-pod critical-path attribution, and one end-to-end
+pass: a real scheduler with an unholdable SLO files a parseable
+``slo_breach`` bundle from its own maintenance tick.
+"""
+
+import json
+import os
+from types import SimpleNamespace
+
+import pytest
+
+from kubernetes_tpu.metrics import SchedulerMetrics
+from kubernetes_tpu.telemetry.autopsy import (
+    AutopsyStore,
+    critical_path,
+    diff_bundles,
+    list_bundles,
+    load_bundle,
+)
+from kubernetes_tpu.telemetry.watchdog import (
+    CounterDeltaRule,
+    FleetUnhealthyRule,
+    SloRule,
+    UnattributedCompileRule,
+    Watchdog,
+)
+from kubernetes_tpu.utils.tracing import PodTimelines
+
+pytestmark = pytest.mark.autopsy
+
+
+def mkpodref(uid, name="p", namespace="default"):
+    return SimpleNamespace(metadata=SimpleNamespace(
+        uid=uid, name=name, namespace=namespace))
+
+
+def timelines_with_binds(latencies_s):
+    """A PodTimelines where pod i bound ``latencies_s[i]`` seconds after
+    its enqueue — exactly what time_to_bind_stats reads."""
+    tl = PodTimelines()
+    for i, lat in enumerate(latencies_s):
+        pod = mkpodref(f"u{i}", name=f"p{i}")
+        tl.event(pod, "enqueued", t=100.0)
+        tl.event(pod, "bound", t=100.0 + lat)
+    return tl
+
+
+# ------------------------------ rules ------------------------------
+
+
+def test_slo_rule_trips_on_breach_and_gates_on_min_binds():
+    sched = SimpleNamespace(timelines=timelines_with_binds([0.5] * 4))
+    rule = SloRule({"time_to_bind_p99_ms": 100.0}, min_binds=8)
+    # 4 binds < min_binds: a cold start never breaches
+    assert rule.evaluate(sched) == []
+    sched = SimpleNamespace(timelines=timelines_with_binds([0.5] * 8))
+    hits = rule.evaluate(sched)
+    assert len(hits) == 1 and hits[0]["kind"] == "slo_breach"
+    assert "time_to_bind_p99_ms" in hits[0]["reason"]
+    assert hits[0]["details"]["stats"]["count"] == 8
+    # a holdable SLO does not trip
+    ok_rule = SloRule({"time_to_bind_p99_ms": 10_000.0}, min_binds=8)
+    assert ok_rule.evaluate(sched) == []
+    # no SLO configured: the rule is inert
+    assert SloRule({}, min_binds=0).evaluate(sched) == []
+
+
+def test_counter_delta_rule_baselines_then_trips():
+    box = {"v": 7.0}
+    rule = CounterDeltaRule("my_total", "throttle_shed",
+                            lambda s: box["v"])
+    sched = SimpleNamespace()
+    # first poll only baselines — a warm restart must not replay
+    # history as a fresh incident
+    assert rule.evaluate(sched) == []
+    assert rule.evaluate(sched) == []          # flat: no trip
+    box["v"] = 10.0
+    hits = rule.evaluate(sched)
+    assert len(hits) == 1 and hits[0]["kind"] == "throttle_shed"
+    assert hits[0]["details"]["delta"] == 3.0
+    assert rule.evaluate(sched) == []          # re-baselined
+    # a broken/missing counter is not an incident
+    broken = CounterDeltaRule("gone", "x",
+                              lambda s: s.metrics.nope.value())
+    assert broken.evaluate(sched) == []
+
+
+def test_unattributed_compile_rule_reads_profiler_delta():
+    prof = SimpleNamespace(compile_causes={"unattributed": 2})
+    sched = SimpleNamespace(profiler=prof)
+    rule = UnattributedCompileRule()
+    assert rule.evaluate(sched) == []          # baseline
+    prof.compile_causes["unattributed"] = 5
+    hits = rule.evaluate(sched)
+    assert len(hits) == 1
+    assert hits[0]["kind"] == "unattributed_compile"
+    assert hits[0]["details"] == {"delta": 3, "total": 5}
+    assert UnattributedCompileRule().evaluate(
+        SimpleNamespace()) == []               # no profiler attached
+
+
+def test_fleet_unhealthy_rule_names_the_bad_endpoints():
+    summary = {"ok": False, "healthy": 1, "total": 2, "endpoints": [
+        {"component": "hub", "url": "http://h:1", "healthy": True},
+        {"component": "relay", "url": "http://r:2", "healthy": False},
+    ]}
+    sched = SimpleNamespace(
+        fleet=SimpleNamespace(summary=lambda: summary))
+    hits = FleetUnhealthyRule().evaluate(sched)
+    assert len(hits) == 1 and hits[0]["kind"] == "fleet_unhealthy"
+    assert hits[0]["details"]["unhealthy"] == ["relay@http://r:2"]
+    summary["ok"] = True
+    assert FleetUnhealthyRule().evaluate(sched) == []
+    assert FleetUnhealthyRule().evaluate(SimpleNamespace()) == []
+
+
+# --------------------------- the watchdog ---------------------------
+
+
+class TripOnce:
+    name = "trip_once"
+    min_interval_s = 0.0
+
+    def __init__(self):
+        self.fired = False
+
+    def evaluate(self, sched):
+        if self.fired:
+            return []
+        self.fired = True
+        return [{"kind": "test_trip", "reason": "once"}]
+
+
+class Broken:
+    name = "broken"
+    min_interval_s = 0.0
+
+    def evaluate(self, sched):
+        raise RuntimeError("rule bug")
+
+
+def test_watchdog_poll_throttles_counts_and_survives_broken_rules():
+    clock = {"t": 1000.0}
+    m = SchedulerMetrics()
+    sched = SimpleNamespace(metrics=m)
+    tripper = TripOnce()
+    wd = Watchdog(sched, rules=[Broken(), tripper], store=None,
+                  interval_s=5.0, now=lambda: clock["t"])
+    assert wd.poll() == 1                      # broken rule skipped
+    assert wd.incidents == 1
+    assert m.watchdog_incidents.value(kind="test_trip") == 1
+    assert m.watchdog_rules_tripped.value(rule="trip_once") == 1
+    clock["t"] += 1.0
+    assert wd.poll() == 0                      # inside the interval
+    assert m.watchdog_evals.value() == 1
+    clock["t"] += 5.0
+    assert wd.poll() == 0                      # evaluated, no trips
+    assert m.watchdog_evals.value() == 2
+
+
+def test_watchdog_per_rule_min_interval(tmp_path):
+    clock = {"t": 0.0}
+
+    class Counting:
+        name = "counting"
+        min_interval_s = 30.0
+
+        def __init__(self):
+            self.calls = 0
+
+        def evaluate(self, sched):
+            self.calls += 1
+            return []
+
+    rule = Counting()
+    wd = Watchdog(SimpleNamespace(metrics=None), rules=[rule],
+                  interval_s=0.0, now=lambda: clock["t"])
+    wd.poll()
+    clock["t"] = 10.0
+    wd.poll()                                  # rule's own gate holds
+    assert rule.calls == 1
+    clock["t"] = 31.0
+    wd.poll()
+    assert rule.calls == 2
+
+
+def test_incident_routes_to_store_and_never_raises(tmp_path):
+    m = SchedulerMetrics()
+    store = AutopsyStore(str(tmp_path), rate_limit_s=0.0, metrics=m)
+    sched = SimpleNamespace(metrics=m)
+    wd = Watchdog(sched, rules=[], store=store, interval_s=0.0)
+    wd.incident("quarantine", reason="poison pod", rule="",
+                details={"pod": "default/p0"})
+    rows = store.list()
+    assert len(rows) == 1 and rows[0]["kind"] == "quarantine"
+    doc = store.load(rows[0]["name"])
+    assert doc["trigger"]["details"] == {"pod": "default/p0"}
+    # collection walked a bare SimpleNamespace: partial bundle, named
+    # failures, never an exception out of incident()
+    assert doc.get("collect_errors")
+    assert m.watchdog_incidents.value(kind="quarantine") == 1
+
+
+def test_module_incident_helper_noops_without_watchdog():
+    from kubernetes_tpu import telemetry
+
+    telemetry.incident(SimpleNamespace(), "whatever")  # must not raise
+
+
+# --------------------------- the store ---------------------------
+
+
+def test_store_rate_limits_per_class(tmp_path):
+    clock = {"t": 0.0}
+    m = SchedulerMetrics()
+    store = AutopsyStore(str(tmp_path), rate_limit_s=30.0,
+                         now=lambda: clock["t"], metrics=m)
+    calls = {"n": 0}
+
+    def collect():
+        calls["n"] += 1
+        return {"queue": {"stats": {}}}
+
+    assert store.capture({"kind": "quarantine"}, collect) is not None
+    # same class inside the window: dropped BEFORE collection runs
+    assert store.capture({"kind": "quarantine"}, collect) is None
+    assert calls["n"] == 1
+    # a different class has its own window
+    assert store.capture({"kind": "drift"}, collect) is not None
+    clock["t"] = 31.0
+    assert store.capture({"kind": "quarantine"}, collect) is not None
+    assert m.autopsy_bundles_dropped.value(reason="rate_limited") == 1
+    assert m.autopsy_bundles.value(trigger="quarantine") == 2
+
+
+def test_store_retention_prunes_oldest_by_count_and_bytes(tmp_path):
+    m = SchedulerMetrics()
+    store = AutopsyStore(str(tmp_path), max_bundles=2, rate_limit_s=0.0,
+                         metrics=m)
+    for i in range(4):
+        store.capture({"kind": f"k{i}"}, lambda: {"pad": "x" * 64})
+    rows = store.list()
+    assert [r["seq"] for r in rows] == [3, 4]   # newest two survive
+    assert m.autopsy_bundles_dropped.value(reason="retention") == 2
+    assert list(m.autopsy_store_bytes.collect().values()) == [
+        sum(r["bytes"] for r in rows)]
+    # bytes cap: a store too small for two bundles keeps only the newest
+    small = AutopsyStore(str(tmp_path / "small"), max_bundles=100,
+                         max_bytes=4096, rate_limit_s=0.0)
+    for i in range(3):
+        small.capture({"kind": "big"}, lambda: {"pad": "y" * 3000})
+    assert len(small.list()) == 1
+
+
+def test_store_resumes_seq_after_restart(tmp_path):
+    store = AutopsyStore(str(tmp_path), rate_limit_s=0.0)
+    store.capture({"kind": "drift"}, lambda: {})
+    store.capture({"kind": "drift"}, lambda: {})
+    reborn = AutopsyStore(str(tmp_path), rate_limit_s=0.0)
+    reborn.capture({"kind": "drift"}, lambda: {})
+    assert [r["seq"] for r in reborn.list()] == [1, 2, 3]
+
+
+def test_failed_collection_still_files_trigger_only_bundle(tmp_path):
+    store = AutopsyStore(str(tmp_path), rate_limit_s=0.0)
+
+    def explode():
+        raise RuntimeError("collector bug")
+
+    path = store.capture({"kind": "cycle_crash", "reason": "r"}, explode)
+    doc = load_bundle(path)
+    assert doc["trigger"]["kind"] == "cycle_crash"
+    assert doc["collect_errors"]
+
+
+def test_torn_bundle_listing_and_strict_load(tmp_path):
+    store = AutopsyStore(str(tmp_path), rate_limit_s=0.0)
+    store.capture({"kind": "drift"}, lambda: {})
+    torn = tmp_path / "autopsy-000099-torn.json"
+    torn.write_text('{"format": 1, "trigger": {"kind": "dri')
+    # a writer killed mid-replace leaves only a .tmp — never listed
+    (tmp_path / "autopsy-000100-x.json.tmp").write_text("{}")
+    rows = list_bundles(str(tmp_path))
+    assert len(rows) == 2
+    assert "error" not in rows[0]
+    assert "error" in rows[1]
+    with pytest.raises(ValueError, match="torn or invalid"):
+        load_bundle(str(torn))
+    # not-a-bundle and future-format docs are rejected strictly
+    notb = tmp_path / "autopsy-000101-n.json"
+    notb.write_text("[1, 2]")
+    with pytest.raises(ValueError, match="not an autopsy bundle"):
+        load_bundle(str(notb))
+    newer = tmp_path / "autopsy-000102-f.json"
+    newer.write_text(json.dumps({"format": 99, "trigger": {}}))
+    with pytest.raises(ValueError, match="newer than this reader"):
+        load_bundle(str(newer))
+
+
+# ------------------------ diff + critical path ------------------------
+
+
+def fixture_timeline():
+    return {
+        "uid": "u-cp", "name": "cp", "namespace": "default",
+        "events": [
+            {"t": 1.0, "event": "enqueued", "detail": ""},
+            {"t": 1.5, "event": "popped", "detail": "attempt 1"},
+            {"t": 2.0, "event": "popped", "detail": "attempt 2"},
+            {"t": 2.5, "event": "bound", "detail": "node-0"},
+        ],
+        "wire": {"created": {"t": 0.5}, "bound": {"t": 2.6},
+                 "kubelet_recv": {"t": 2.7}, "acked": {"t": 2.8}},
+    }
+
+
+def test_critical_path_attributes_every_leg():
+    rep = critical_path(fixture_timeline())
+    assert rep["pod"] == "default/cp"
+    assert rep["missing"] == []
+    by_leg = {l["leg"]: l for l in rep["legs"]}
+    assert by_leg["watch"]["ms"] == 500.0
+    assert by_leg["queue"]["ms"] == 500.0
+    assert by_leg["retries"]["ms"] == 500.0
+    assert by_leg["schedule"]["ms"] == 500.0
+    assert by_leg["hub_commit"]["ms"] == pytest.approx(100.0)
+    assert rep["attributed_ms"] == {
+        "binder": pytest.approx(100.0),
+        "device": pytest.approx(500.0),
+        "fabric": pytest.approx(700.0),
+        "queue": pytest.approx(1000.0)}
+    assert rep["total_ms"] == pytest.approx(2300.0)
+
+
+def test_critical_path_names_missing_legs():
+    tl = fixture_timeline()
+    tl["wire"] = {}
+    rep = critical_path(tl)
+    assert set(rep["missing"]) == {"watch", "hub_commit",
+                                   "fabric_relay", "kubelet_ack"}
+    # total falls back to enqueued -> bound
+    assert rep["total_ms"] == pytest.approx(1500.0)
+
+
+def test_diff_bundles_reports_stat_phase_and_slo_movement(tmp_path):
+    a = {"seq": 1, "captured_at": 10.0, "trigger": {"kind": "drift"},
+         "queue": {"stats": {"bound": 4, "attempts": 6}},
+         "flight": {"phases": {"device_launch": {"p99_ms": 2.0}}},
+         "slo_stats": {"time_to_bind_p99_ms": 40.0}}
+    b = {"seq": 2, "captured_at": 12.5, "trigger": {"kind": "drift"},
+         "queue": {"stats": {"bound": 9, "attempts": 6}},
+         "flight": {"phases": {"device_launch": {"p99_ms": 3.5}}},
+         "slo_stats": {"time_to_bind_p99_ms": 55.0}}
+    d = diff_bundles(a, b)
+    assert d["seconds_apart"] == 2.5
+    assert d["stats_delta"] == {"bound": 5}
+    assert d["phase_p99_delta"]["device_launch"] == {
+        "p99_ms_a": 2.0, "p99_ms_b": 3.5}
+    assert d["slo_delta"]["time_to_bind_p99_ms"] == {"a": 40.0,
+                                                     "b": 55.0}
+
+
+# ------------------------------ the CLI ------------------------------
+
+
+def make_fixture_store(tmp_path):
+    store = AutopsyStore(str(tmp_path), rate_limit_s=0.0)
+    store.capture(
+        {"kind": "device_fallback", "reason": "nan batch", "rule": ""},
+        lambda: {"queue": {"stats": {"bound": 4}},
+                 "timelines": [fixture_timeline()]})
+    store.capture(
+        {"kind": "slo_breach", "reason": "p99 over", "rule": "slo"},
+        lambda: {"queue": {"stats": {"bound": 9}},
+                 "timelines": [fixture_timeline()]})
+    return store
+
+
+def cli(args):
+    from kubernetes_tpu.telemetry.__main__ import main
+
+    return main(args)
+
+
+def test_cli_list_show_diff_critical_path(tmp_path, capsys):
+    make_fixture_store(tmp_path)
+    d = str(tmp_path)
+    assert cli(["autopsy", "list", "--dir", d]) == 0
+    out = capsys.readouterr().out
+    assert "device_fallback" in out and "slo_breach" in out
+
+    assert cli(["autopsy", "list", "--dir", d, "--json"]) == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert [r["seq"] for r in rows] == [1, 2]
+
+    name = rows[1]["name"]
+    assert cli(["autopsy", "show", name, "--dir", d]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["trigger"]["rule"] == "slo"
+    assert cli(["autopsy", "show", name, "--dir", d,
+                "--section", "queue"]) == 0
+    assert json.loads(capsys.readouterr().out) == {"stats": {"bound": 9}}
+    assert cli(["autopsy", "show", name, "--dir", d,
+                "--section", "nope"]) == 1
+    capsys.readouterr()
+
+    assert cli(["autopsy", "diff", rows[0]["name"], name,
+                "--dir", d]) == 0
+    dd = json.loads(capsys.readouterr().out)
+    assert dd["stats_delta"] == {"bound": 5}
+
+    assert cli(["autopsy", "critical-path", name, "--dir", d,
+                "--json"]) == 0
+    reps = json.loads(capsys.readouterr().out)
+    assert reps[0]["pod"] == "default/cp"
+    assert cli(["autopsy", "critical-path", name, "--dir", d,
+                "--pod", "default/cp"]) == 0
+    assert "default/cp" in capsys.readouterr().out
+    assert cli(["autopsy", "critical-path", name, "--dir", d,
+                "--pod", "ghost"]) == 1
+    capsys.readouterr()
+
+
+def test_cli_errors_nonzero_on_torn_bundle(tmp_path, capsys):
+    torn = tmp_path / "autopsy-000001-torn.json"
+    torn.write_text('{"trigger": ')
+    assert cli(["autopsy", "show", torn.name,
+                "--dir", str(tmp_path)]) == 1
+    assert "error:" in capsys.readouterr().err
+
+
+# --------------------------- end to end ---------------------------
+
+
+def test_scheduler_files_slo_breach_bundle_end_to_end(tmp_path):
+    from kubernetes_tpu.api.objects import (
+        Container,
+        LABEL_HOSTNAME,
+        Node,
+        NodeStatus,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+        ResourceRequirements,
+    )
+    from kubernetes_tpu.config.types import default_config
+    from kubernetes_tpu.hub import Hub
+    from kubernetes_tpu.ops.features import Capacities
+    from kubernetes_tpu.scheduler import Scheduler
+
+    hub = Hub()
+    hub.create_node(Node(
+        metadata=ObjectMeta(name="n0",
+                            labels={LABEL_HOSTNAME: "n0"}),
+        status=NodeStatus(allocatable={"cpu": "8", "memory": "16Gi",
+                                       "pods": "110"})))
+    cfg = default_config()
+    cfg.batch_size = 4
+    cfg.autopsy_dir = str(tmp_path)
+    cfg.autopsy_rate_limit_s = 0.0
+    cfg.watchdog_interval_s = 0.0
+    cfg.watchdog_min_binds = 1
+    # no real scheduler can bind in a femtosecond: guaranteed breach
+    cfg.watchdog_slo = {"time_to_bind_p99_ms": 1e-9}
+    sched = Scheduler(hub, cfg, caps=Capacities(nodes=4, pods=16))
+    try:
+        for i in range(4):
+            hub.create_pod(Pod(
+                metadata=ObjectMeta(name=f"e2e-{i}"),
+                spec=PodSpec(containers=[Container(
+                    name="c", resources=ResourceRequirements(
+                        requests={"cpu": "100m"}))])))
+        sched.run_until_idle()
+        sched.run_maintenance()
+    finally:
+        sched.close()
+    rows = [r for r in list_bundles(str(tmp_path))
+            if r.get("kind") == "slo_breach"]
+    assert rows, "watchdog never filed the breach bundle"
+    doc = load_bundle(os.path.join(str(tmp_path), rows[0]["name"]))
+    assert doc["trigger"]["rule"] == "slo"
+    assert doc["slo_stats"]["count"] == 4
+    # the bundle's timelines drive the critical-path CLI
+    reps = [critical_path(t) for t in doc["timelines"]]
+    assert any(r["total_ms"] is not None for r in reps)
+    assert sched.watchdog.incidents >= 1
